@@ -1,0 +1,36 @@
+"""pulseportraiture_tpu — a TPU-native wideband pulsar-timing framework.
+
+A from-scratch JAX/XLA implementation of the capabilities of
+PulsePortraiture (Pennucci, Demorest & Ransom 2014; Pennucci 2019):
+measuring wideband pulse times-of-arrival (TOAs), dispersion measures
+(DMs), and scattering parameters from folded radio-pulsar archives, and
+building frequency-dependent template portraits from data.
+
+Design stance (see SURVEY.md §7): one autodiff objective instead of
+hand-derived gradients; batched `vmap`/`shard_map` fits instead of
+Python loops; jittable fixed-shape optimizers instead of scipy; masks
+instead of ragged fancy-indexing; float64 on host for TOA arithmetic,
+float32 on TPU for the chi^2 surface.
+
+Subpackages
+-----------
+ops       - Fourier-domain numerical kernels (rotation, scattering, noise)
+fit       - fit engines (1-D FFTFIT, 2-D..5-param portrait fit, LM)
+models    - template portrait models (gaussian, spline/PCA, wavelet)
+io        - PSRFITS / model-file / TOA-file I/O (no PSRCHIVE dependency)
+pipeline  - high-level pipelines (toas, align, spline, gauss, zap)
+parallel  - device-mesh sharding helpers
+synth     - synthetic data generation (the test fixture)
+viz       - matplotlib visualization (host-side)
+utils     - MJD arithmetic, misc
+"""
+
+import jax
+
+# TOA arithmetic needs float64 on host; TPU hot paths cast to f32
+# explicitly (see fit/portrait.py).
+jax.config.update("jax_enable_x64", True)
+
+from .config import *  # noqa: F401,F403
+
+__version__ = "0.1.0"

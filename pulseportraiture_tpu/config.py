@@ -1,0 +1,59 @@
+"""Behavior-defining constants.
+
+Mirrors the semantics of the reference's module-level settings
+(reference pplib.py:56-99) but exposed as an importable, overridable
+config module instead of edit-the-source constants.
+"""
+
+# --- Dispersion constant [MHz^2 s cm^3 / pc] ------------------------------
+# Two conventions exist (reference pplib.py:61-67); fitted DM values depend
+# on the choice.  The "traditional" value is the default, matching TEMPO.
+Dconst_exact = 4.148808e3
+Dconst_trad = 0.000241**-1
+Dconst = Dconst_trad
+
+# --- Scattering -----------------------------------------------------------
+# Default scattering power-law index: tau(nu) = tau * (nu/nu_tau)**alpha
+# (reference pplib.py:70).
+scattering_alpha = -4.0
+
+# --- Noise estimation -----------------------------------------------------
+# 'PS' = mean power of the top quarter of the power spectrum
+# (reference pplib.py:74-78, 2312-2338).
+default_noise_method = "PS"
+
+# --- Fourier DC term ------------------------------------------------------
+# Weight applied to the k=0 (DC) harmonic in all Fourier-domain fits.
+# 0 removes sensitivity to the baseline (reference pplib.py:82).
+F0_fact = 0.0
+
+# --- Gaussian component bounds --------------------------------------------
+# Upper bound on Gaussian FWHM [rotations] in template fits
+# (reference pplib.py:86).
+wid_max = 0.25
+
+# --- Model evolution codes ------------------------------------------------
+# Per-parameter evolution function code string for .gmodel files:
+# one digit each for (loc, wid, amp); '0' = power law, '1' = linear
+# (reference pplib.py:95).
+default_model_code = "000"
+
+# --- TOA conventions ------------------------------------------------------
+SECPERDAY = 86400.0
+# TEMPO2 convention: 0.0 MHz in a .tim line means infinite frequency
+# (reference pplib.py:3613).
+INF_FREQ = 0.0
+
+# --- Optimizer return-code strings (scipy fmin_tnc heritage; we keep the
+# same small vocabulary so downstream flag plumbing is stable) --------------
+RCSTRINGS = {
+    -1: "INFEASIBLE: Infeasible (lower bound > upper bound)",
+    0: "LOCALMINIMUM: Local minimum reached (|pg| ~= 0)",
+    1: "CONVERGED: Converged (|f_n-f_(n-1)| ~= 0)",
+    2: "CONVERGED: Converged (|x_n-x_(n-1)| ~= 0)",
+    3: "MAXFUN: Max. number of function evaluations reached",
+    4: "LSFAIL: Linear search failed",
+    5: "CONSTANT: All lower bounds are equal to the upper bounds",
+    6: "NOPROGRESS: Unable to progress",
+    7: "USERABORT: User requested end of minimization",
+}

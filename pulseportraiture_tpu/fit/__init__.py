@@ -1,0 +1,18 @@
+from .phase_shift import fit_phase_shift, fit_phase_shift_batch
+from .portrait import (
+    FitFlags,
+    FitResult,
+    fit_portrait,
+    fit_portrait_batch,
+    chi2_prime,
+)
+
+__all__ = [
+    "fit_phase_shift",
+    "fit_phase_shift_batch",
+    "FitFlags",
+    "FitResult",
+    "fit_portrait",
+    "fit_portrait_batch",
+    "chi2_prime",
+]

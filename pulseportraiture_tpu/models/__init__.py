@@ -1,0 +1,17 @@
+from .gaussian import (
+    GaussianModel,
+    evolve_parameter,
+    power_law_evolution,
+    linear_evolution,
+    gen_gaussian_profile,
+    gen_gaussian_portrait,
+)
+
+__all__ = [
+    "GaussianModel",
+    "evolve_parameter",
+    "power_law_evolution",
+    "linear_evolution",
+    "gen_gaussian_profile",
+    "gen_gaussian_portrait",
+]

@@ -1,0 +1,155 @@
+"""Frequency-evolving Gaussian-component portrait models.
+
+A model is ngauss Gaussian components whose (loc, wid, amp) each evolve
+with frequency by either a power law or a linear law, selected by a
+three-digit code string (one digit per parameter; '0' = power law,
+'1' = linear), plus a DC offset and a scattering (tau, alpha) pair —
+the .gmodel format's semantics (reference pplib.py:886-963, 1032-1084;
+grammar documented in the reference's examples/example.gmodel).
+
+The portrait generator is fully vectorized over (nchan, ngauss) and
+jittable; parameters live in a flat pytree so the LM template fitter
+(fit/lm.py) can differentiate through generation.
+"""
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.gaussian import gaussian_profile_FT
+from ..ops.scattering import scattering_portrait_FT, scattering_times
+
+
+def power_law_evolution(value, mod_index, freqs, nu_ref):
+    """param(nu) = value * (nu/nu_ref)**mod_index
+    (reference pplib.py:1032-1047)."""
+    return value * (freqs / nu_ref) ** mod_index
+
+
+def linear_evolution(value, slope, freqs, nu_ref):
+    """param(nu) = value + slope * (nu - nu_ref)
+    (reference pplib.py:1050-1065)."""
+    return value + slope * (freqs - nu_ref)
+
+
+_EVOLUTION = {"0": power_law_evolution, "1": linear_evolution}
+
+
+def evolve_parameter(value, mod, freqs, nu_ref, code_digit="0"):
+    """Dispatch on the .gmodel CODE digit (reference pplib.py:1068-1084)."""
+    return _EVOLUTION[code_digit](value, mod, freqs, nu_ref)
+
+
+@dataclass
+class GaussianModel:
+    """A .gmodel in memory.
+
+    locs/wids/amps and their evolution moduli are (ngauss,) arrays at
+    the reference frequency nu_ref [MHz]; tau is the scattering
+    timescale in *seconds* at nu_ref (the on-disk unit); fit flags are
+    kept for the template fitter and round-tripping.
+    """
+
+    name: str
+    code: str
+    nu_ref: float
+    dc: float
+    tau: float
+    alpha: float
+    locs: np.ndarray
+    wids: np.ndarray
+    amps: np.ndarray
+    mlocs: np.ndarray
+    mwids: np.ndarray
+    mamps: np.ndarray
+    fit_flags: dict = field(default_factory=dict)
+
+    @property
+    def ngauss(self):
+        return len(np.atleast_1d(self.locs))
+
+    def params_pytree(self):
+        return {
+            "dc": jnp.asarray(self.dc),
+            "tau": jnp.asarray(self.tau),
+            "alpha": jnp.asarray(self.alpha),
+            "locs": jnp.asarray(self.locs),
+            "wids": jnp.asarray(self.wids),
+            "amps": jnp.asarray(self.amps),
+            "mlocs": jnp.asarray(self.mlocs),
+            "mwids": jnp.asarray(self.mwids),
+            "mamps": jnp.asarray(self.mamps),
+        }
+
+
+def evolved_components(params, freqs, nu_ref, code="000"):
+    """(locs, wids, amps) each (nchan, ngauss) at the given freqs."""
+    ev_loc = _EVOLUTION[code[0]]
+    ev_wid = _EVOLUTION[code[1]]
+    ev_amp = _EVOLUTION[code[2]]
+    f = freqs[:, None]
+    locs = ev_loc(params["locs"][None, :], params["mlocs"][None, :], f, nu_ref)
+    wids = ev_wid(params["wids"][None, :], params["mwids"][None, :], f, nu_ref)
+    amps = ev_amp(params["amps"][None, :], params["mamps"][None, :], f, nu_ref)
+    return locs, wids, amps
+
+
+def gen_gaussian_portrait_FT(
+    params, freqs, nu_ref, nharm, P=None, code="000", scattered=True
+):
+    """rFFT (nchan, nharm) of the model portrait: DC + sum of evolved
+    Gaussian FTs, optionally times the per-channel scattering kernel.
+
+    tau in ``params`` is in seconds (gmodel convention) and needs P to
+    convert to rotations; tau=0 or scattered=False skips scattering.
+    """
+    locs, wids, amps = evolved_components(params, freqs, nu_ref, code)
+    nbin = 2 * (nharm - 1)
+    # sum over components of analytic Gaussian FTs: (nchan, ngauss, nharm)
+    gFT = gaussian_profile_FT(nharm, locs[..., None], wids[..., None], amps[..., None])
+    pFT = jnp.sum(gFT, axis=1)
+    pFT = pFT.at[..., 0].add(params["dc"] * nbin)
+    if scattered and P is not None:
+        taus = scattering_times(params["tau"] / P, params["alpha"], freqs, nu_ref)
+        pFT = pFT * scattering_portrait_FT(taus, nharm)
+    return pFT
+
+
+def gen_gaussian_portrait(
+    params, freqs, nu_ref, nbin, P=None, code="000", scattered=True
+):
+    """Model portrait (nchan, nbin) in the phase domain.
+
+    Parity: reference pplib.py:886-963 (whose JOIN rotation step lives
+    in the pipeline layer here, not in model generation).
+    """
+    nharm = nbin // 2 + 1
+    pFT = gen_gaussian_portrait_FT(params, freqs, nu_ref, nharm, P, code, scattered)
+    return jnp.fft.irfft(pFT, n=nbin, axis=-1)
+
+
+def gen_gaussian_profile(params, nbin, nu_ref=None, code="000", P=None, scattered=True):
+    """Single-frequency profile (at nu_ref): DC + components + scattering.
+
+    Parity: reference pplib.py:859-883.
+    """
+    freqs = jnp.asarray([1.0 if nu_ref is None else nu_ref])
+    prof = gen_gaussian_portrait(
+        params, freqs, 1.0 if nu_ref is None else nu_ref, nbin, P, code, scattered
+    )
+    return prof[0]
+
+
+def model_from_params(model: GaussianModel, freqs, nbin, P=None, scattered=True):
+    """Convenience: portrait from a GaussianModel dataclass."""
+    return gen_gaussian_portrait(
+        model.params_pytree(),
+        jnp.asarray(freqs),
+        model.nu_ref,
+        nbin,
+        P=P,
+        code=model.code,
+        scattered=scattered,
+    )

@@ -1,0 +1,76 @@
+"""Fourier-domain numerical kernels (JAX, batched, jittable).
+
+These are the hot primitives of the framework — everything the fit
+engines and pipelines evaluate per optimizer step.  All kernels are
+shape-polymorphic over leading batch dimensions, free of Python-level
+control flow on traced values, and dtype-polymorphic (f32 on TPU,
+f64 in CPU tests).
+"""
+
+from .phasor import (
+    DM_delay,
+    dispersion_phases,
+    phase_transform,
+    phase_shifts,
+    phasor,
+    guess_fit_freq,
+    doppler_correct_freqs,
+)
+from .rotation import (
+    rotate_profile,
+    rotate_portrait,
+    rotate_full,
+    add_DM_nu,
+    fft_shift_bins,
+)
+from .scattering import (
+    scattering_times,
+    scattering_profile_FT,
+    scattering_portrait_FT,
+    scattering_kernel_time,
+    add_scattering,
+)
+from .gaussian import (
+    gaussian_profile,
+    gaussian_profile_FT,
+    instrumental_response_FT,
+    instrumental_response_port_FT,
+    dm_smearing_width,
+)
+from .noise import (
+    get_noise,
+    get_noise_PS,
+    channel_SNRs_FT,
+    get_SNR,
+    get_scales,
+)
+
+__all__ = [
+    "DM_delay",
+    "dispersion_phases",
+    "phase_transform",
+    "phase_shifts",
+    "phasor",
+    "guess_fit_freq",
+    "doppler_correct_freqs",
+    "rotate_profile",
+    "rotate_portrait",
+    "rotate_full",
+    "add_DM_nu",
+    "fft_shift_bins",
+    "scattering_times",
+    "scattering_profile_FT",
+    "scattering_portrait_FT",
+    "scattering_kernel_time",
+    "add_scattering",
+    "gaussian_profile",
+    "gaussian_profile_FT",
+    "instrumental_response_FT",
+    "instrumental_response_port_FT",
+    "dm_smearing_width",
+    "get_noise",
+    "get_noise_PS",
+    "channel_SNRs_FT",
+    "get_SNR",
+    "get_scales",
+]

@@ -1,0 +1,110 @@
+"""Gaussian profile kernels and instrumental-response FTs.
+
+Phase-domain Gaussians (FWHM parameterization, periodic wrap-around)
+and their analytic Fourier transforms, plus channel instrumental
+response kernels ('rect' -> sinc, 'gauss' -> Gaussian) and the
+DM-smearing width.
+
+Parity targets: reference pplib.py:782-883 (gaussian_profile),
+pptoaslib.py:22-58 (gaussian_profile_FT), pptoaslib.py:124-192
+(instrumental response).
+"""
+
+import jax.numpy as jnp
+
+FWHM2SIGMA = 1.0 / (8.0 * jnp.log(2.0)) ** 0.5  # sigma = FWHM * this
+
+
+def gaussian_profile(nbin, loc, wid, amp=1.0, dtype=jnp.float64):
+    """Periodic Gaussian profile: amp * exp(-4 ln2 d^2 / wid^2) with
+    d = wrapped phase distance to loc; wid is FWHM [rot].
+
+    Wrap-around is handled exactly (distance through the nearer edge),
+    matching the reference's relocation logic (pplib.py:801-856) without
+    its |z|<20 cutoff (XLA computes the exp everywhere; underflow to 0
+    is the same result).
+    """
+    phases = (jnp.arange(nbin, dtype=dtype) + 0.5 * 0.0) / nbin
+    d = phases - loc
+    d = jnp.mod(d + 0.5, 1.0) - 0.5
+    wid = jnp.maximum(jnp.abs(wid), jnp.finfo(dtype).tiny ** 0.5)
+    return amp * jnp.exp(-4.0 * jnp.log(2.0) * (d / wid) ** 2.0)
+
+
+def gaussian_profile_FT(nharm, loc, wid, amp=1.0):
+    """Analytic rFFT coefficients (unnormalized, numpy convention) of
+    the periodic Gaussian with unit-peak amplitude ``amp``, sampled on
+    nbin = 2*(nharm-1) bins.
+
+    G(k) = amp * nbin * (wid/2) sqrt(pi/ln 2) * exp(-(pi k wid)^2/(4 ln2))
+           * exp(-2 pi i k loc)
+
+    Accurate when wid << 1 so periodic images are negligible — the
+    regime enforced by wid_max = 0.25.  Parity: reference
+    pptoaslib.py:22-58 (whose erf sinc-correction is folded into the
+    instrumental response kernels here).
+    """
+    nbin = 2 * (nharm - 1)
+    k = jnp.arange(nharm, dtype=jnp.result_type(loc, jnp.float32))
+    # |wid|: a width that evolves through zero must not flip the
+    # component's sign (matches gaussian_profile's clamping)
+    sigma = jnp.abs(wid) * FWHM2SIGMA
+    mag = (
+        amp
+        * nbin
+        * sigma
+        * jnp.sqrt(2.0 * jnp.pi)
+        * jnp.exp(-2.0 * (jnp.pi * k * sigma) ** 2.0)
+    )
+    return mag * jnp.exp(-2.0j * jnp.pi * k * loc)
+
+
+def instrumental_response_FT(width, nharm, kind="rect"):
+    """FT of a channel's instrumental smearing kernel of ``width`` [rot].
+
+    kind='rect': boxcar -> sinc(k*width); kind='gauss': Gaussian FWHM
+    ``width``.  width=0 -> identity.  Parity: reference
+    pptoaslib.py:124-155.
+    """
+    k = jnp.arange(nharm, dtype=jnp.result_type(width, jnp.float32))
+    if kind == "rect":
+        return jnp.sinc(k * width)
+    elif kind == "gauss":
+        sigma = width * FWHM2SIGMA
+        return jnp.exp(-2.0 * (jnp.pi * k * sigma) ** 2.0)
+    else:
+        raise ValueError(f"unknown instrumental response kind {kind!r}")
+
+
+def dm_smearing_width(DM, chan_bw, freqs, P):
+    """Per-channel DM-smearing width [rot]:
+    8.3e-6 s * DM * BW_MHz / nu_GHz^3 / P.
+
+    Parity: reference pptoaslib.py:158-192 (:189).
+    """
+    return 8.3e-6 * DM * chan_bw / (freqs / 1.0e3) ** 3.0 / P
+
+
+def instrumental_response_port_FT(
+    nharm, freqs, widths=(), kinds=(), DM_smear=None, chan_bw=None, P=None
+):
+    """Product of instrumental response FTs per channel ->
+    (nchan, nharm) real array.
+
+    ``widths``/``kinds`` are parallel sequences of achromatic kernels;
+    if ``DM_smear`` (a DM value) is given, a per-channel rect kernel of
+    the DM-smearing width is included.  Parity: reference
+    pptoaslib.py:158-192.
+    """
+    freqs = jnp.asarray(freqs)
+    nchan = freqs.shape[0]
+    out = jnp.ones((nchan, nharm), dtype=freqs.dtype)
+    for width, kind in zip(widths, kinds):
+        out = out * instrumental_response_FT(
+            jnp.asarray(width, freqs.dtype), nharm, kind
+        )[None, :]
+    if DM_smear is not None:
+        w = dm_smearing_width(DM_smear, chan_bw, freqs, P)
+        k = jnp.arange(nharm, dtype=freqs.dtype)
+        out = out * jnp.sinc(k[None, :] * w[:, None])
+    return out

@@ -1,0 +1,97 @@
+"""Noise / S-N estimation and analytic amplitude scales.
+
+Parity targets: reference pplib.py:2290-2424 (get_noise dispatch,
+get_noise_PS, get_SNR, get_scales).
+"""
+
+import jax.numpy as jnp
+
+
+def get_noise_PS(data, frac=0.25):
+    """Off-pulse noise std per profile from the power spectrum: the
+    mean power in the top ``frac`` of rFFT frequencies, converted to a
+    time-domain standard deviation.
+
+    For white noise of std sigma, E|X_k|^2 = nbin * sigma^2, so
+    sigma_hat = sqrt(mean_power / nbin).  Works on any (..., nbin)
+    array, returning (...).  Parity: reference pplib.py:2312-2338.
+    """
+    data = jnp.asarray(data)
+    nbin = data.shape[-1]
+    X = jnp.fft.rfft(data, axis=-1)
+    nharm = X.shape[-1]
+    kc = int((1.0 - frac) * nharm)
+    power = jnp.abs(X[..., kc:]) ** 2.0
+    return jnp.sqrt(jnp.mean(power, axis=-1) / nbin)
+
+
+def get_noise(data, method="PS", **kwargs):
+    """Dispatch noise estimator (currently 'PS'; the reference's
+    'fit' method via find_kc, pplib.py:2341-2373, is offline-only and
+    not needed on the hot path).  Parity: reference pplib.py:2290-2309.
+    """
+    if method == "PS":
+        return get_noise_PS(data, **kwargs)
+    raise ValueError(f"unknown noise method {method!r}")
+
+
+def fourier_noise(noise_std, nbin):
+    """Std of the real/imag parts of unnormalized rFFT coefficients of
+    white noise with time-domain std ``noise_std``:
+    sigma_F = noise_std * sqrt(nbin / 2).
+
+    Parity: reference pplib.py:2160-2162 — this scaling must match the
+    fit engines exactly for chi^2 to be calibrated.
+    """
+    return noise_std * jnp.sqrt(nbin / 2.0)
+
+
+def channel_SNRs_FT(dFT, mFT, errs_F, harm_weights=None):
+    """Matched-filter S/N of each channel of a data portrait against a
+    (already aligned) model portrait, in the Fourier domain.
+
+    snr_n = a_n * sqrt(S_n) with S_n = sum_k |m_nk|^2/sig_n^2 and
+    a_n = C_n/S_n (see get_scales).  Parity: reference
+    pptoaslib.py:1127-1131.
+    """
+    if harm_weights is None:
+        harm_weights = jnp.ones(dFT.shape[-1], dtype=errs_F.dtype)
+    w = harm_weights / errs_F[..., None] ** 2.0
+    S = jnp.sum(jnp.abs(mFT) ** 2.0 * w, axis=-1)
+    C = jnp.sum((dFT * jnp.conj(mFT)).real * w, axis=-1)
+    S = jnp.maximum(S, jnp.finfo(S.dtype).tiny)
+    return C / jnp.sqrt(S)
+
+
+def get_SNR(profile, noise_std=None, fudge=3.25):
+    """Equivalent-width S/N of a profile (reporting/weighting only; not
+    on the fit path).
+
+    weq = sum(p) / max(p); SNR = sum(p) / (noise * sqrt(weq)) / fudge,
+    with the reference's empirical fudge factor (pplib.py:2376-2395).
+    """
+    profile = jnp.asarray(profile)
+    p = profile - jnp.median(profile, axis=-1, keepdims=True)
+    if noise_std is None:
+        noise_std = get_noise_PS(profile)
+    peak = jnp.max(jnp.abs(p), axis=-1)
+    peak = jnp.maximum(peak, jnp.finfo(p.dtype).tiny)
+    weq = jnp.abs(jnp.sum(p, axis=-1)) / peak
+    weq = jnp.maximum(weq, 1.0)
+    return jnp.abs(jnp.sum(p, axis=-1)) / (noise_std * jnp.sqrt(weq)) / fudge
+
+
+def get_scales(dFT, mFT, errs_F, harm_weights=None):
+    """Analytic maximum-likelihood per-channel amplitudes
+    a_n = C_n / S_n (eq. 11 of Pennucci+ 2014).
+
+    dFT, mFT: (..., nchan, nharm) rFFTs of aligned data and model;
+    errs_F: (..., nchan) Fourier-domain noise.  Parity: reference
+    pplib.py:2398-2424 and pptoaslib.py:953-971.
+    """
+    if harm_weights is None:
+        harm_weights = jnp.ones(dFT.shape[-1], dtype=errs_F.dtype)
+    w = harm_weights / errs_F[..., None] ** 2.0
+    S = jnp.sum(jnp.abs(mFT) ** 2.0 * w, axis=-1)
+    C = jnp.sum((dFT * jnp.conj(mFT)).real * w, axis=-1)
+    return C / jnp.maximum(S, jnp.finfo(S.dtype).tiny)

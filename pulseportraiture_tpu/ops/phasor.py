@@ -1,0 +1,106 @@
+"""Rotation / dispersion phase models.
+
+Conventions (used consistently everywhere in this framework):
+
+- Frequencies ``nu`` are in MHz, periods ``P`` in seconds, phases in
+  rotations, DM in pc cm^-3, GM in pc^2 cm^-6 (Lam et al. 2016
+  "geometric measure").
+- The per-channel achromatic+dispersive+refractive phase delay is::
+
+      t_n = phi
+          + (Dconst   * DM / P) * (nu_n**-2 - nu_DM**-2)
+          + (Dconst**2 * GM / P) * (nu_n**-4 - nu_GM**-4)
+
+- Rotating data *by* positive ``t_n`` moves features to earlier phase
+  (a left shift); rotating the data by the fitted ``(phi, DM)`` aligns
+  it with the template.
+
+Behavioral parity targets: reference pplib.py:2672-2729 (DM_delay,
+phase_transform, guess_fit_freq) and pptoaslib.py:195-257
+(phase_shifts, phasor), re-derived rather than translated — the
+gradient/Hessian chains (reference pptoaslib.py:231-249) are replaced
+by `jax.grad` on these primitives.
+"""
+
+import jax.numpy as jnp
+
+from ..config import Dconst
+
+
+def DM_delay(DM, freq, freq_ref=jnp.inf, P=None):
+    """Dispersion delay [s] of ``freq`` relative to ``freq_ref`` [MHz].
+
+    Positive for freq < freq_ref (lower frequencies arrive later).
+    If ``P`` is given, the delay is returned in rotations instead.
+    Parity: reference pplib.py:2672-2685.
+    """
+    delay = Dconst * DM * (freq**-2.0 - freq_ref**-2.0)
+    if P is not None:
+        delay = delay / P
+    return delay
+
+
+def dispersion_phases(freqs, DM, P, nu_ref):
+    """Per-channel dispersive phase offsets [rot] relative to nu_ref."""
+    return (Dconst * DM / P) * (freqs**-2.0 - nu_ref**-2.0)
+
+
+def phase_shifts(phi, DM, GM, freqs, P, nu_DM, nu_GM):
+    """Per-channel total phase delays t_n [rot] for the portrait fit.
+
+    Parity: reference pptoaslib.py:195-228.
+    """
+    return (
+        phi
+        + (Dconst * DM / P) * (freqs**-2.0 - nu_DM**-2.0)
+        + (Dconst**2.0 * GM / P) * (freqs**-4.0 - nu_GM**-4.0)
+    )
+
+
+def phasor(delays, nharm):
+    """exp(2*pi*i * outer(delays, k)) for harmonics k = 0..nharm-1.
+
+    Multiplying a channel's rFFT by its phasor row rotates that channel
+    to *earlier* phase by ``delays`` rotations.
+    Parity: reference pptoaslib.py:252-257.
+    """
+    k = jnp.arange(nharm, dtype=delays.dtype)
+    return jnp.exp(2.0j * jnp.pi * delays[..., None] * k)
+
+
+def phase_transform(phi, DM, nu_ref1, nu_ref2, P, mod=True):
+    """Re-reference a fitted phase from nu_ref1 to nu_ref2 [MHz].
+
+    phi2 = phi1 + (Dconst*DM/P) * (nu_ref2**-2 - nu_ref1**-2), so the
+    per-channel delays t_n are invariant.  With ``mod``, result is
+    wrapped to [-0.5, 0.5).  Use nu_ref = inf for the infinite-frequency
+    (unrotated) phase.  Parity: reference pplib.py:2688-2712.
+    """
+    phi2 = phi + (Dconst * DM / P) * (nu_ref2**-2.0 - nu_ref1**-2.0)
+    if mod:
+        phi2 = jnp.mod(phi2 + 0.5, 1.0) - 0.5
+    return phi2
+
+
+def guess_fit_freq(freqs, SNRs=None):
+    """S/N- and nu^-2-weighted mean frequency — the initial guess for
+    the zero-covariance reference frequency of a (phi, DM) fit.
+
+    Parity: reference pplib.py:2715-2729: a weighted center-of-mass
+    with weights w_n = SNR_n * nu_n**-2, evaluated as
+    nu_fit = (sum w / sum (w * nu**-2))**0.5.
+    """
+    if SNRs is None:
+        SNRs = jnp.ones_like(freqs)
+    w = SNRs * freqs**-2.0
+    return (jnp.sum(w) / jnp.sum(w * freqs**-2.0)) ** 0.5
+
+
+def doppler_correct_freqs(freqs, doppler_factor):
+    """Barycenter topocentric frequencies: nu_bary = nu_topo * df.
+
+    The fitted DM transforms as DM_bary = DM_topo * df and
+    GM_bary = GM_topo * df**3 (applied in the pipeline; reference
+    pptoas.py:583-591).
+    """
+    return freqs * doppler_factor

@@ -1,0 +1,78 @@
+"""Scattering kernels.
+
+A thin-screen scattered pulse is the intrinsic profile convolved with a
+one-sided exponential of timescale tau; the timescale follows a power
+law in frequency, tau_n = tau * (nu_n / nu_tau)**alpha (alpha ~ -4).
+
+Everything here works in the Fourier (harmonic) domain with tau in
+*rotations* (phase units); conversions from seconds happen at the I/O
+boundary (tau_rot = tau_sec / P).
+
+The analytic FT of the unit-area one-sided exponential is
+    H(k) = 1 / (1 + 2*pi*i * k * tau),
+(reference pplib.py:4219-4242 uses the same form with tau in bins).
+The reference's hand-derived dH/dtau and d2H/dtau2 chains
+(pptoaslib.py:266-418) are replaced by jax.grad through this function.
+"""
+
+import jax.numpy as jnp
+
+
+def scattering_times(tau, alpha, freqs, nu_tau):
+    """Per-channel scattering timescales tau_n = tau*(nu_n/nu_tau)**alpha.
+
+    Units of tau are preserved (rotations in the fit engines).
+    Parity: reference pplib.py:4212-4216.
+    """
+    return tau * (freqs / nu_tau) ** alpha
+
+
+def scattering_profile_FT(tau, nharm):
+    """FT of the unit-area one-sided exponential exp(-t/tau)/tau, t>=0,
+    at integer harmonics k = 0..nharm-1; tau in rotations.
+
+    tau = 0 gives the identity kernel (no scattering).
+    Parity: reference pplib.py:4219-4242.
+    """
+    k = jnp.arange(nharm, dtype=jnp.result_type(tau, jnp.float32))
+    return 1.0 / (1.0 + 2.0j * jnp.pi * k * tau)
+
+
+def scattering_portrait_FT(taus, nharm):
+    """Per-channel scattering kernels; taus (..., nchan) in rotations ->
+    (..., nchan, nharm) complex.
+
+    Parity: reference pplib.py:4245-4260 (which loops channels in
+    Python; here it is one broadcast op).
+    """
+    k = jnp.arange(nharm, dtype=jnp.result_type(taus, jnp.float32))
+    return 1.0 / (1.0 + 2.0j * jnp.pi * taus[..., None] * k)
+
+
+def scattering_kernel_time(tau, nbin, dtype=jnp.float64):
+    """Time-domain one-sided exponential kernel over one rotation,
+    normalized to unit sum; tau in rotations.  tau <= 0 gives a delta.
+
+    Used by the synthetic generator; parity: reference pplib.py:1140-1161.
+    """
+    t = jnp.arange(nbin, dtype=dtype) / nbin
+    kern = jnp.where(tau > 0.0, jnp.exp(-t / jnp.where(tau > 0.0, tau, 1.0)), 0.0)
+    delta = jnp.zeros(nbin, dtype).at[0].set(1.0)
+    kern = jnp.where(tau > 0.0, kern, delta)
+    return kern / jnp.sum(kern)
+
+
+def add_scattering(port, taus, wrap=True):
+    """Circularly convolve each channel of a (…, nchan, nbin) portrait
+    with its one-sided exponential kernel (taus in rotations).
+
+    The reference uses a repeat-3 linear-convolution trick
+    (pplib.py:1164-1187) to approximate non-wrapped scattering; with
+    ``wrap`` (default) we convolve circularly via the analytic FT,
+    which matches the Fourier-domain model used in the fits exactly.
+    """
+    port = jnp.asarray(port)
+    nbin = port.shape[-1]
+    pFT = jnp.fft.rfft(port, axis=-1)
+    H = scattering_portrait_FT(jnp.asarray(taus), pFT.shape[-1])
+    return jnp.fft.irfft(pFT * H, n=nbin, axis=-1)

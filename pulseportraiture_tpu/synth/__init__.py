@@ -1,0 +1,3 @@
+from .fake import default_test_model, fake_portrait, fake_observation
+
+__all__ = ["default_test_model", "fake_portrait", "fake_observation"]

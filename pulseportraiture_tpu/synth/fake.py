@@ -1,0 +1,179 @@
+"""Synthetic data generation — the framework's test fixture.
+
+Generates data portraits with *known injected* (phi, DM, GM, tau,
+alpha, per-channel scales, noise, RFI mask, scintillation), so every
+fit engine and pipeline can be validated by parameter recovery — the
+reference's own end-to-end verification pattern (make_fake_pulsar,
+reference pplib.py:3302-3499, driven by examples/example.py).
+
+This module is portrait-level (pure arrays); the PSRFITS-archive
+writer wrapping it lives in io/psrfits.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.gaussian import GaussianModel, gen_gaussian_portrait
+from ..ops.phasor import phase_shifts
+from ..ops.phasor import phasor as make_phasor
+from ..ops.scattering import add_scattering, scattering_times
+from ..utils.bunch import DataBunch
+
+
+def default_test_model(nu_ref=1500.0):
+    """A 3-component evolving-Gaussian model like the reference's
+    examples/example.gmodel (values chosen fresh, same structure)."""
+    return GaussianModel(
+        name="FAKE_0000+0000",
+        code="000",
+        nu_ref=nu_ref,
+        dc=0.0,
+        tau=0.0,
+        alpha=-4.0,
+        locs=np.array([0.48, 0.505, 0.52]),
+        wids=np.array([0.045, 0.015, 0.022]),
+        amps=np.array([4.0, 9.5, 2.5]),
+        mlocs=np.array([-0.005, -0.003, 0.003]),
+        mwids=np.array([-0.2, 0.16, -0.3]),
+        mamps=np.array([-1.6, -2.0, -0.9]),
+    )
+
+
+def fake_portrait(
+    key,
+    model,
+    freqs,
+    nbin,
+    P,
+    phi=0.0,
+    DM=0.0,
+    GM=0.0,
+    tau=0.0,
+    alpha=None,
+    nu_ref=None,
+    scales=None,
+    noise_std=1.0,
+    zap_frac=0.0,
+    scint_nsin=0,
+    dtype=jnp.float64,
+):
+    """One (nchan, nbin) data portrait with known injected parameters.
+
+    phi/DM/GM are referenced to ``nu_ref`` (default: model.nu_ref); a
+    fit of this portrait against the clean model should recover them
+    there.  ``tau`` [s at nu_ref] scatters with index ``alpha``;
+    ``scales`` (nchan,) multiplies channels; ``noise_std`` adds white
+    noise; ``zap_frac`` randomly zero-weights channels.
+
+    Returns a DataBunch with port, model_port, weights, noise_stds,
+    freqs, P and the injected truth values.
+    """
+    freqs = jnp.asarray(freqs, dtype)
+    nchan = freqs.shape[0]
+    nu_ref = model.nu_ref if nu_ref is None else nu_ref
+    alpha = model.alpha if alpha is None else alpha
+    params = {k: v.astype(dtype) if hasattr(v, "astype") else v
+              for k, v in model.params_pytree().items()}
+
+    clean = gen_gaussian_portrait(
+        params, freqs, model.nu_ref, nbin, P=P, code=model.code, scattered=False
+    )
+
+    port = clean
+    if tau != 0.0:
+        taus = scattering_times(tau / P, alpha, freqs, nu_ref)
+        port = add_scattering(port, taus)
+
+    # delay by the injected (phi, DM, GM): rotate to *later* phase so
+    # that fitting returns positive (phi, DM, GM)
+    delays = phase_shifts(phi, DM, GM, freqs, P, nu_ref, nu_ref)
+    pFT = jnp.fft.rfft(port, axis=-1)
+    pFT = pFT * jnp.conj(make_phasor(delays, pFT.shape[-1]))
+    port = jnp.fft.irfft(pFT, n=nbin, axis=-1)
+
+    if scint_nsin:
+        k_s, key = jax.random.split(jax.random.PRNGKey(0) if key is None else key)
+        x = jnp.linspace(0.0, scint_nsin * jnp.pi, nchan)
+        pattern = jnp.sin(x + jax.random.uniform(k_s) * 2 * jnp.pi) ** 2.0 + 0.1
+        port = port * pattern[:, None]
+
+    if scales is not None:
+        port = port * jnp.asarray(scales, dtype)[:, None]
+
+    k_n, k_z = jax.random.split(key if key is not None else jax.random.PRNGKey(0))
+    if noise_std:
+        port = port + noise_std * jax.random.normal(k_n, port.shape, dtype)
+
+    weights = jnp.ones(nchan, dtype)
+    if zap_frac > 0.0:
+        weights = jnp.where(
+            jax.random.uniform(k_z, (nchan,)) < zap_frac, 0.0, 1.0
+        ).astype(dtype)
+        port = port * weights[:, None]
+
+    return DataBunch(
+        port=port,
+        model_port=clean,
+        freqs=freqs,
+        weights=weights,
+        noise_stds=jnp.full((nchan,), noise_std, dtype),
+        P=P,
+        nbin=nbin,
+        nu_ref=nu_ref,
+        phi=phi,
+        DM=DM,
+        GM=GM,
+        tau=tau,
+        alpha=alpha,
+        scales=scales,
+    )
+
+
+def fake_observation(
+    key,
+    model,
+    nsub=1,
+    nchan=64,
+    nbin=1024,
+    P=0.002,
+    lofreq=1200.0,
+    bw=800.0,
+    dDM_std=0.0,
+    **kwargs,
+):
+    """A stack of subint portraits (nsub, nchan, nbin) with per-subint
+    random dDMs drawn from N(0, dDM_std) — the shape pptoas consumes.
+
+    Returns (DataBunch with subints stacked, injected dDMs array).
+    """
+    chan_bw = bw / nchan
+    freqs = lofreq + chan_bw * (jnp.arange(nchan) + 0.5)
+    keys = jax.random.split(key, nsub + 1)
+    dDMs = dDM_std * np.asarray(
+        jax.random.normal(keys[0], (nsub,), jnp.float64)
+    )
+    subs, truths = [], []
+    base_DM = kwargs.pop("DM", 0.0)
+    for isub in range(nsub):
+        b = fake_portrait(
+            keys[isub + 1], model, freqs, nbin, P,
+            DM=base_DM + float(dDMs[isub]), **kwargs,
+        )
+        subs.append(b.port)
+        truths.append(b)
+    first = truths[0]
+    return (
+        DataBunch(
+            subints=jnp.stack(subs),
+            model_port=first.model_port,
+            freqs=freqs,
+            weights=jnp.stack([t.weights for t in truths]),
+            noise_stds=jnp.stack([t.noise_stds for t in truths]),
+            P=P,
+            nbin=nbin,
+            nu_ref=first.nu_ref,
+            DMs=base_DM + dDMs,
+        ),
+        dDMs,
+    )

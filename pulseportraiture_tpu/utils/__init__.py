@@ -1,0 +1,4 @@
+from .mjd import MJD
+from .bunch import DataBunch
+
+__all__ = ["MJD", "DataBunch"]

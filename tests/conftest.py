@@ -1,0 +1,35 @@
+"""Test configuration: run everything on CPU with 8 virtual XLA devices
+so sharding/mesh tests exercise the multi-chip code paths without TPU
+hardware (the driver separately dry-runs the real multi-chip path via
+__graft_entry__.dryrun_multichip)."""
+
+import os
+
+# 8 virtual CPU devices; must be set before the backend initializes
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# Force CPU even when the launch environment routes to a TPU plugin
+# (bench.py uses the real chip; tests must not).  The env var alone is
+# not enough here because the site customization registers the TPU
+# backend at interpreter start.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def key():
+    return jax.random.PRNGKey(42)

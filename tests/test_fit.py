@@ -1,0 +1,204 @@
+"""Fit-engine validation: parameter recovery from synthetic portraits
+with known injections (the reference's own verification pattern,
+SURVEY.md §4), error calibration, zero-covariance frequencies, and
+|dphi| parity against the independent NumPy implementation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.config import Dconst
+from pulseportraiture_tpu.fit import (
+    FitFlags,
+    fit_phase_shift,
+    fit_portrait,
+    fit_portrait_batch,
+)
+from pulseportraiture_tpu.fit.reference_numpy import fit_portrait_numpy
+from pulseportraiture_tpu.ops import gaussian_profile, phase_transform, rotate_profile
+from pulseportraiture_tpu.synth import default_test_model, fake_portrait
+
+P = 0.003  # 3 ms pulsar
+NCHAN, NBIN = 64, 1024
+FREQS = jnp.asarray(np.linspace(1200.0, 1999.0, NCHAN) + 0.5)
+
+
+def _fake(key, **kw):
+    model = default_test_model(nu_ref=1500.0)
+    kw.setdefault("noise_std", 0.05)
+    return model, fake_portrait(key, model, FREQS, NBIN, P, **kw)
+
+
+# --- 1-D FFTFIT ---------------------------------------------------------
+
+
+def test_phase_shift_recovery(rng):
+    prof = np.asarray(gaussian_profile(NBIN, 0.5, 0.03, 5.0))
+    true_phi = 0.0817
+    data = np.asarray(rotate_profile(jnp.asarray(prof), -true_phi))
+    data = 3.0 * data + rng.normal(scale=0.02, size=NBIN)
+    res = fit_phase_shift(jnp.asarray(data), jnp.asarray(prof), noise_std=0.02)
+    assert abs(float(res.phase) - true_phi) < 3.0 * float(res.phase_err)
+    assert abs(float(res.phase) - true_phi) < 1e-4
+    assert abs(float(res.scale) - 3.0) < 3.0 * float(res.scale_err)
+    assert float(res.snr) > 50.0
+
+
+def test_phase_shift_error_calibration(key):
+    """Fitted phase scatter should match the reported uncertainty."""
+    prof = gaussian_profile(NBIN, 0.5, 0.03, 5.0)
+    ntrial = 64
+    keys = jax.random.split(key, ntrial)
+    phases, errs = [], []
+    for k in keys:
+        data = prof + 0.05 * jax.random.normal(k, (NBIN,), jnp.float64)
+        res = fit_phase_shift(data, prof, noise_std=0.05)
+        phases.append(float(res.phase))
+        errs.append(float(res.phase_err))
+    z = np.asarray(phases) / np.asarray(errs)
+    # z-scores should be ~N(0,1): mean ~ 0, std in [0.6, 1.6]
+    assert abs(z.mean()) < 0.5
+    assert 0.6 < z.std() < 1.6
+
+
+# --- 2-param portrait fit ----------------------------------------------
+
+
+def test_fit_portrait_phi_dm_recovery(key):
+    true_phi, true_dm = 0.0513, 0.0037
+    model, d = _fake(key, phi=true_phi, DM=true_dm)
+    res = fit_portrait(
+        d.port, d.model_port, d.noise_stds, d.freqs, P,
+        fit_flags=FitFlags(phi=True, DM=True),
+    )
+    # re-reference the fitted phase to the injection reference
+    phi_at_ref = phase_transform(
+        float(res.phi), float(res.DM), float(res.nu_DM), d.nu_ref, P
+    )
+    assert abs(float(phi_at_ref) - true_phi) < 1e-4
+    assert abs(float(res.DM) - true_dm) < 5.0 * float(res.DM_err)
+    assert int(res.return_code) in (0, 1, 2)
+    assert float(res.snr) > 100.0
+
+
+def test_fit_portrait_zero_covariance(key):
+    """At nu_DM the phi-DM covariance must vanish (the defining
+    property; replaces the reference's closed-form table
+    pptoaslib.py:776-950)."""
+    model, d = _fake(key, phi=0.02, DM=0.002)
+    res = fit_portrait(d.port, d.model_port, d.noise_stds, d.freqs, P)
+    cov = np.asarray(res.covariance)
+    # transform covariance to the reported nu_DM:
+    # phi_ref = phi_inf + (Dconst/P) nu^-2 DM
+    nu_fit = float(
+        __import__(
+            "pulseportraiture_tpu.ops", fromlist=["guess_fit_freq"]
+        ).guess_fit_freq(d.freqs)
+    )
+    cD_fit = (Dconst / P) * nu_fit**-2.0
+    cD_out = (Dconst / P) * float(res.nu_DM) ** -2.0
+    # cov is in (phi@nu_fit, DM) coordinates; transform phi to nu_DM:
+    # phi@out = phi@fit + (cD_out - cD_fit) * DM
+    c2 = cov[:2, :2]
+    T = np.array([[1.0, cD_out - cD_fit], [0.0, 1.0]])
+    cov_out = T @ c2 @ T.T
+    rho = cov_out[0, 1] / np.sqrt(cov_out[0, 0] * cov_out[1, 1])
+    assert abs(rho) < 1e-3
+
+
+def test_fit_portrait_error_calibration(key):
+    """phi/DM pulls over noise realizations ~ N(0,1)."""
+    ntrial = 32
+    keys = jax.random.split(key, ntrial)
+    zs_phi, zs_dm = [], []
+    model = default_test_model(1500.0)
+    for k in keys:
+        d = fake_portrait(k, model, FREQS, NBIN, P, phi=0.01, DM=0.001,
+                          noise_std=0.05)
+        res = fit_portrait(d.port, d.model_port, d.noise_stds, d.freqs, P)
+        phi_ref = float(
+            phase_transform(float(res.phi), float(res.DM), float(res.nu_DM),
+                            d.nu_ref, P)
+        )
+        # the phase error applies at nu_DM; transforming to nu_ref adds
+        # DM-error leverage, so compare at nu_DM instead:
+        true_at_nudm = float(
+            phase_transform(0.01, 0.001, d.nu_ref, float(res.nu_DM), P)
+        )
+        zs_phi.append((float(res.phi) - true_at_nudm) / float(res.phi_err))
+        zs_dm.append((float(res.DM) - 0.001) / float(res.DM_err))
+    zp, zd = np.asarray(zs_phi), np.asarray(zs_dm)
+    assert abs(zp.mean()) < 0.6 and 0.5 < zp.std() < 2.0
+    assert abs(zd.mean()) < 0.6 and 0.5 < zd.std() < 2.0
+
+
+def test_fit_portrait_scales(key):
+    scales = np.linspace(0.5, 2.0, NCHAN)
+    model, d = _fake(key, phi=0.01, DM=0.001, scales=scales, noise_std=0.01)
+    res = fit_portrait(d.port, d.model_port, d.noise_stds, d.freqs, P)
+    np.testing.assert_allclose(np.asarray(res.scales), scales, rtol=0.2)
+
+
+def test_fit_portrait_masked_channels(key):
+    """Zero-weight channels must not affect the fit."""
+    model, d = _fake(key, phi=0.03, DM=0.002)
+    mask = np.ones(NCHAN)
+    mask[::7] = 0.0
+    port = np.array(d.port)
+    port[::7] = 1e6  # garbage in masked channels
+    res = fit_portrait(
+        jnp.asarray(port), d.model_port, d.noise_stds, d.freqs, P,
+        chan_mask=jnp.asarray(mask),
+    )
+    phi_at_ref = phase_transform(
+        float(res.phi), float(res.DM), float(res.nu_DM), d.nu_ref, P
+    )
+    assert abs(float(phi_at_ref) - 0.03) < 1e-4
+    assert np.all(np.asarray(res.channel_snrs)[::7] == 0.0)
+
+
+def test_fit_portrait_batch_matches_single(key):
+    keys = jax.random.split(key, 4)
+    model = default_test_model(1500.0)
+    ds = [
+        fake_portrait(k, model, FREQS, NBIN, P, phi=0.01 * (i + 1),
+                      DM=0.0005 * (i + 1), noise_std=0.05)
+        for i, k in enumerate(keys)
+    ]
+    ports = jnp.stack([d.port for d in ds])
+    models = jnp.stack([d.model_port for d in ds])
+    stds = jnp.stack([d.noise_stds for d in ds])
+    from pulseportraiture_tpu.ops import guess_fit_freq
+
+    nu_fit = guess_fit_freq(FREQS)
+    bres = fit_portrait_batch(ports, models, stds, FREQS, P, nu_fit)
+    for i, d in enumerate(ds):
+        sres = fit_portrait(d.port, d.model_port, d.noise_stds, FREQS, P,
+                            nu_fit=nu_fit)
+        assert abs(float(bres.phi[i]) - float(sres.phi)) < 1e-9
+        assert abs(float(bres.DM[i]) - float(sres.DM)) < 1e-9
+
+
+# --- parity vs the independent NumPy implementation ---------------------
+
+
+def test_parity_vs_numpy_reference(key):
+    model, d = _fake(key, phi=0.0421, DM=0.0029)
+    from pulseportraiture_tpu.ops import guess_fit_freq
+
+    nu_fit = float(guess_fit_freq(d.freqs))
+    res_jax = fit_portrait(
+        d.port, d.model_port, d.noise_stds, d.freqs, P, nu_fit=nu_fit,
+        nu_out=nu_fit,
+    )
+    res_np = fit_portrait_numpy(
+        np.asarray(d.port), np.asarray(d.model_port),
+        np.asarray(d.noise_stds), np.asarray(d.freqs), P, nu_fit,
+    )
+    # BASELINE gate: |dphi| < 1e-4, and DM agreement
+    assert abs(float(res_jax.phi) - res_np["phi"]) < 1e-4
+    assert abs(float(res_jax.DM) - res_np["DM"]) < 1e-6
+    # errors agree to 10%
+    assert abs(float(res_jax.phi_err) / res_np["phi_err"] - 1.0) < 0.1
+    assert abs(float(res_jax.DM_err) / res_np["DM_err"] - 1.0) < 0.1

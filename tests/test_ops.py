@@ -1,0 +1,149 @@
+"""Unit oracles for the Fourier-domain kernels (SURVEY.md §4):
+analytic FT identities, rotate∘unrotate = id, noise calibration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.config import Dconst
+from pulseportraiture_tpu.ops import (
+    DM_delay,
+    add_scattering,
+    fft_shift_bins,
+    gaussian_profile,
+    gaussian_profile_FT,
+    get_noise_PS,
+    get_scales,
+    guess_fit_freq,
+    instrumental_response_FT,
+    phase_transform,
+    phase_shifts,
+    rotate_portrait,
+    rotate_profile,
+    scattering_kernel_time,
+    scattering_portrait_FT,
+    scattering_profile_FT,
+    scattering_times,
+)
+
+
+def test_rotate_unrotate_identity(rng):
+    # exact for integer-bin shifts (any signal)
+    prof = rng.normal(size=256)
+    out = rotate_profile(rotate_profile(prof, 16.0 / 256), -16.0 / 256)
+    np.testing.assert_allclose(out, prof, atol=1e-10)
+    # for fractional shifts, exact on band-limited signals (the Nyquist
+    # bin of white noise is not invertible under any real-output shift)
+    smooth = np.asarray(gaussian_profile(256, 0.4, 0.05, 3.0))
+    out = rotate_profile(rotate_profile(smooth, 0.123), -0.123)
+    np.testing.assert_allclose(out, smooth, atol=1e-10)
+
+
+def test_rotate_integer_bins_is_roll(rng):
+    prof = rng.normal(size=128)
+    # positive phase rotates to earlier phase: out[j] = in[j + s]
+    out = rotate_profile(prof, 5.0 / 128)
+    np.testing.assert_allclose(out, np.roll(prof, -5), atol=1e-10)
+
+
+def test_rotate_portrait_dedisperses():
+    nchan, nbin, P = 16, 512, 0.003
+    freqs = jnp.linspace(1200.0, 1900.0, nchan)
+    DM = 0.01
+    # build a dispersed portrait: delta at phase 0.5 delayed per channel
+    delays = (Dconst * DM / P) * (freqs**-2.0 - jnp.inf**-2.0)
+    port = np.zeros((nchan, nbin))
+    prof = np.exp(-0.5 * ((np.arange(nbin) / nbin - 0.5) / 0.02) ** 2)
+    for n in range(nchan):
+        port[n] = np.asarray(fft_shift_bins(jnp.asarray(prof), -delays[n] * nbin))
+    # rotating by (0, DM) with nu_ref=inf should align all channels
+    out = rotate_portrait(jnp.asarray(port), 0.0, DM, P, freqs, jnp.inf)
+    for n in range(nchan):
+        np.testing.assert_allclose(out[n], prof, atol=1e-8)
+
+
+def test_phase_transform_consistency():
+    P, DM = 0.005, 30.0
+    phi1, nu1, nu2 = 0.1, 1400.0, 1700.0
+    phi2 = phase_transform(phi1, DM, nu1, nu2, P, mod=False)
+    # per-channel delays must be invariant
+    freqs = jnp.array([1250.0, 1500.0, 1800.0])
+    t1 = phase_shifts(phi1, DM, 0.0, freqs, P, nu1, 1.0)
+    t2 = phase_shifts(phi2, DM, 0.0, freqs, P, nu2, 1.0)
+    np.testing.assert_allclose(np.asarray(t1), np.asarray(t2), atol=1e-12)
+
+
+def test_DM_delay_sign():
+    # lower frequency arrives later: positive delay vs higher ref freq
+    assert float(DM_delay(10.0, 1200.0, 1600.0)) > 0
+
+
+def test_gaussian_FT_matches_numerical():
+    nbin = 1024
+    loc, wid, amp = 0.3, 0.05, 2.5
+    prof = gaussian_profile(nbin, loc, wid, amp)
+    num_FT = jnp.fft.rfft(prof)
+    ana_FT = gaussian_profile_FT(nbin // 2 + 1, loc, wid, amp)
+    np.testing.assert_allclose(
+        np.asarray(ana_FT), np.asarray(num_FT), atol=1e-6 * nbin * amp
+    )
+
+
+def test_scattering_FT_matches_time_domain():
+    # the sampled kernel's DFT approaches the continuous analytic FT as
+    # tau*nbin grows; discretization error is O(1/(tau*nbin))
+    for nbin, tau, tol in [(2048, 0.01, 5e-2), (4096, 0.05, 5e-3)]:
+        H_ana = scattering_profile_FT(tau, nbin // 2 + 1)
+        kern = scattering_kernel_time(tau, nbin)
+        H_num = jnp.fft.rfft(kern)
+        np.testing.assert_allclose(np.asarray(H_num), np.asarray(H_ana), atol=tol)
+
+
+def test_scattering_zero_tau_identity(rng):
+    port = jnp.asarray(rng.normal(size=(4, 256)))
+    out = add_scattering(port, jnp.zeros(4))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(port), atol=1e-12)
+
+
+def test_scattering_conserves_flux(rng):
+    prof = jnp.asarray(np.abs(rng.normal(size=(1, 512))))
+    out = add_scattering(prof, jnp.array([0.05]))
+    np.testing.assert_allclose(
+        float(jnp.sum(out)), float(jnp.sum(prof)), rtol=1e-10
+    )
+
+
+def test_scattering_times_power_law():
+    taus = scattering_times(1.0, -4.0, jnp.array([500.0, 1000.0]), 1000.0)
+    np.testing.assert_allclose(np.asarray(taus), [16.0, 1.0], rtol=1e-12)
+
+
+def test_instrumental_response_identity():
+    H = instrumental_response_FT(0.0, 100, "rect")
+    np.testing.assert_allclose(np.asarray(H), 1.0)
+
+
+def test_noise_PS_calibrated(rng):
+    sigma = 2.5
+    data = rng.normal(scale=sigma, size=(64, 2048))
+    est = np.asarray(get_noise_PS(jnp.asarray(data)))
+    assert abs(est.mean() - sigma) / sigma < 0.03
+
+
+def test_get_scales_recovers_amplitudes(rng):
+    nchan, nbin = 8, 512
+    prof = gaussian_profile(nbin, 0.5, 0.03, 1.0)
+    true_scales = jnp.asarray(1.0 + np.arange(nchan, dtype=float))
+    port = true_scales[:, None] * prof[None, :]
+    dFT = jnp.fft.rfft(port, axis=-1)
+    mFT = jnp.fft.rfft(jnp.broadcast_to(prof, (nchan, nbin)), axis=-1)
+    errs_F = jnp.ones(nchan)
+    scales = get_scales(dFT, mFT, errs_F)
+    np.testing.assert_allclose(np.asarray(scales), np.asarray(true_scales), rtol=1e-8)
+
+
+def test_guess_fit_freq_bounds():
+    freqs = jnp.linspace(1200.0, 1900.0, 32)
+    nu = float(guess_fit_freq(freqs))
+    assert 1200.0 < nu < 1900.0
