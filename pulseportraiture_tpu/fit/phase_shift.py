@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from ..config import F0_fact
 from ..ops.noise import fourier_noise, get_noise_PS
+from ..ops.phasor import cexp
 from ..utils.bunch import DataBunch
 
 
@@ -40,7 +41,7 @@ def _fit_phase_shift_core(dFT, mFT, errs_F, oversamp=8, newton_iters=5):
     phi0 = j0.astype(errs_F.dtype) / nlag
 
     def C_fn(phi):
-        return jnp.sum((x * jnp.exp(2.0j * jnp.pi * k * phi)).real)
+        return jnp.sum((x * cexp(2.0 * jnp.pi * k * phi)).real)
 
     dC = jax.grad(C_fn)
     d2C = jax.grad(dC)

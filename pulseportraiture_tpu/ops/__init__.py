@@ -8,6 +8,7 @@ f64 in CPU tests).
 """
 
 from .phasor import (
+    cexp,
     DM_delay,
     dispersion_phases,
     phase_transform,
@@ -46,6 +47,7 @@ from .noise import (
 )
 
 __all__ = [
+    "cexp",
     "DM_delay",
     "dispersion_phases",
     "phase_transform",

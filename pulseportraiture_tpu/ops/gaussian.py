@@ -12,6 +12,8 @@ pptoaslib.py:22-58 (gaussian_profile_FT), pptoaslib.py:124-192
 
 import jax.numpy as jnp
 
+from .phasor import cexp
+
 FWHM2SIGMA = 1.0 / (8.0 * jnp.log(2.0)) ** 0.5  # sigma = FWHM * this
 
 
@@ -56,7 +58,7 @@ def gaussian_profile_FT(nharm, loc, wid, amp=1.0):
         * jnp.sqrt(2.0 * jnp.pi)
         * jnp.exp(-2.0 * (jnp.pi * k * sigma) ** 2.0)
     )
-    return mag * jnp.exp(-2.0j * jnp.pi * k * loc)
+    return mag * cexp(-2.0 * jnp.pi * k * loc)
 
 
 def instrumental_response_FT(width, nharm, kind="rect"):

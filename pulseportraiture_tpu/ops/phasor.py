@@ -22,9 +22,18 @@ gradient/Hessian chains (reference pptoaslib.py:231-249) are replaced
 by `jax.grad` on these primitives.
 """
 
+import jax
 import jax.numpy as jnp
 
 from ..config import Dconst
+
+
+def cexp(x):
+    """exp(i*x) with the complex dtype matching x (f32 -> c64).
+
+    Avoids Python complex literals, whose weak-complex128 constants the
+    TPU compiler rejects (C128 unsupported on TPU)."""
+    return jax.lax.complex(jnp.cos(x), jnp.sin(x))
 
 
 def DM_delay(DM, freq, freq_ref=jnp.inf, P=None):
@@ -65,7 +74,7 @@ def phasor(delays, nharm):
     Parity: reference pptoaslib.py:252-257.
     """
     k = jnp.arange(nharm, dtype=delays.dtype)
-    return jnp.exp(2.0j * jnp.pi * delays[..., None] * k)
+    return cexp(2.0 * jnp.pi * delays[..., None] * k)
 
 
 def phase_transform(phi, DM, nu_ref1, nu_ref2, P, mod=True):

@@ -13,7 +13,7 @@ rotating with the data's own DM dedisperses it.
 
 import jax.numpy as jnp
 
-from .phasor import phase_shifts, phasor
+from .phasor import cexp, phase_shifts, phasor
 
 
 def fft_shift_bins(profile, shift_bins):
@@ -22,7 +22,7 @@ def fft_shift_bins(profile, shift_bins):
     nbin = profile.shape[-1]
     pFT = jnp.fft.rfft(profile, axis=-1)
     k = jnp.arange(pFT.shape[-1], dtype=profile.dtype)
-    pFT = pFT * jnp.exp(2.0j * jnp.pi * k * (shift_bins / nbin))
+    pFT = pFT * cexp(2.0 * jnp.pi * k * (shift_bins / nbin))
     return jnp.fft.irfft(pFT, n=nbin, axis=-1)
 
 

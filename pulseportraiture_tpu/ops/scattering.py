@@ -15,6 +15,7 @@ The reference's hand-derived dH/dtau and d2H/dtau2 chains
 (pptoaslib.py:266-418) are replaced by jax.grad through this function.
 """
 
+import jax
 import jax.numpy as jnp
 
 
@@ -35,7 +36,8 @@ def scattering_profile_FT(tau, nharm):
     Parity: reference pplib.py:4219-4242.
     """
     k = jnp.arange(nharm, dtype=jnp.result_type(tau, jnp.float32))
-    return 1.0 / (1.0 + 2.0j * jnp.pi * k * tau)
+    t = 2.0 * jnp.pi * k * tau
+    return 1.0 / jax.lax.complex(jnp.ones_like(t), t)
 
 
 def scattering_portrait_FT(taus, nharm):
@@ -46,7 +48,8 @@ def scattering_portrait_FT(taus, nharm):
     Python; here it is one broadcast op).
     """
     k = jnp.arange(nharm, dtype=jnp.result_type(taus, jnp.float32))
-    return 1.0 / (1.0 + 2.0j * jnp.pi * taus[..., None] * k)
+    t = 2.0 * jnp.pi * taus[..., None] * k
+    return 1.0 / jax.lax.complex(jnp.ones_like(t), t)
 
 
 def scattering_kernel_time(tau, nbin, dtype=jnp.float64):

@@ -1,0 +1,10 @@
+from .mesh import make_mesh, batch_sharding, replicated
+from .batch import fit_portrait_sharded, shard_batch
+
+__all__ = [
+    "make_mesh",
+    "batch_sharding",
+    "replicated",
+    "fit_portrait_sharded",
+    "shard_batch",
+]

@@ -1,0 +1,46 @@
+"""Device-mesh helpers.
+
+The workload's parallel axes (SURVEY.md §2.9) are *batch* axes:
+(archive, subint) fits are independent, and the only cross-channel
+coupling inside one fit is a sum-reduction in the objective.  The
+canonical mesh is therefore 2-D:
+
+- ``data``: archive/subint batch — embarrassingly parallel, the
+  dominant axis (DCN-safe, no communication except result gathers).
+- ``chan``: frequency channels *within* one fit — sharding this axis
+  makes XLA insert psum collectives for the chi^2 channel reduction
+  over ICI; useful when single fits are huge or batches are small.
+
+The reference has no distributed execution at all (a sequential
+Python loop over archives, pptoas.py:258); this module is its
+TPU-native replacement.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_data=None, n_chan=1, devices=None):
+    """A ('data', 'chan') mesh over the given (default: all) devices."""
+    devices = list(jax.devices() if devices is None else devices)
+    n = len(devices)
+    if n_data is None:
+        n_data = n // n_chan
+    assert n_data * n_chan <= n, f"mesh {n_data}x{n_chan} > {n} devices"
+    dev_array = np.asarray(devices[: n_data * n_chan]).reshape(n_data, n_chan)
+    return Mesh(dev_array, axis_names=("data", "chan"))
+
+
+def batch_sharding(mesh, ndim, chan_axis=None):
+    """NamedSharding: leading axis over 'data', optionally one axis over
+    'chan', rest replicated."""
+    spec = [None] * ndim
+    spec[0] = "data"
+    if chan_axis is not None and chan_axis < ndim:
+        spec[chan_axis] = "chan"
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
