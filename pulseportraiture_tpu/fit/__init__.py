@@ -1,4 +1,5 @@
 from .phase_shift import fit_phase_shift, fit_phase_shift_batch
+from .powlaw import fit_powlaw, fit_DM_to_freq_resids, powlaw, powlaw_freqs
 from .portrait import (
     FitFlags,
     FitResult,
@@ -15,4 +16,8 @@ __all__ = [
     "fit_portrait",
     "fit_portrait_batch",
     "chi2_prime",
+    "fit_powlaw",
+    "fit_DM_to_freq_resids",
+    "powlaw",
+    "powlaw_freqs",
 ]

@@ -113,7 +113,7 @@ def _tau_of(theta, log10_tau):
     return 10.0 ** theta[3] if log10_tau else theta[3]
 
 
-def _t_coeffs(freqs, P, nu_fit, dtype=None):
+def _t_coeffs(freqs, P, nu_fit):
     """t_n = phi + cvec_n * DM + gvec_n * GM."""
     cvec = (Dconst / P) * (freqs**-2.0 - nu_fit**-2.0)
     gvec = (Dconst**2.0 / P) * (freqs**-4.0 - nu_fit**-4.0)
@@ -247,19 +247,30 @@ def _newton_loop(cgh, theta0, flags_arr, max_iter, ftol, lam0=1.0e-3):
     def cond(s):
         return jnp.logical_and(s.it < max_iter, jnp.logical_not(s.done))
 
-    def body(s):
-        g, H = mask_gH(s.g, s.H)
+    def _pred(g, H):
+        """Predicted quadratic improvement of a diagonal-Newton step —
+        the convergence measure (scale-invariant in f)."""
         dH = jnp.abs(jnp.diag(H))
         dH = jnp.maximum(dH, 1e-12 * jnp.max(dH))
+        return 0.5 * jnp.sum(g**2.0 / jnp.maximum(dH, _tiny(dt))), dH
+
+    def body(s):
+        g, H = mask_gH(s.g, s.H)
+        pred_cur, dH = _pred(g, H)
+        # converged at the incumbent point (handles warm starts at the
+        # optimum, where no strictly-improving step exists)
+        conv_now = pred_cur < ftol * (jnp.abs(s.f) + 1.0)
         A = H + s.lam * jnp.diag(dH)
         step = -jnp.linalg.solve(A, g)
         theta_new = s.theta + step * flags_arr
         f_new, g_new, H_new = cgh(theta_new)
-        accept = f_new < s.f
-        # predicted improvement of the *next* step; stop when negligible
+        accept = jnp.logical_and(f_new < s.f, jnp.logical_not(conv_now))
         gm, _ = mask_gH(g_new, H_new)
-        pred = 0.5 * jnp.sum(gm**2.0 / jnp.maximum(dH, _tiny(dt)))
-        done = jnp.logical_and(accept, pred < ftol * (jnp.abs(f_new) + 1.0))
+        pred_new, _ = _pred(gm, H)
+        done = jnp.logical_or(
+            conv_now,
+            jnp.logical_and(accept, pred_new < ftol * (jnp.abs(f_new) + 1.0)),
+        )
         code = jnp.where(done, 0, s.code)
         return _NewtonState(
             theta=jnp.where(accept, theta_new, s.theta),
@@ -316,7 +327,10 @@ def _fit_portrait_core(
     ir = ir_FT if use_ir else None
     if ftol is None:
         ftol = 50.0 * float(jnp.finfo(dt).eps)
-    scatter = use_scatter or use_ir or fit_flags[3] or fit_flags[4]
+    # log10_tau implies tau = 10^theta3 > 0 always, so the no-scatter
+    # fast path would be inconsistent with the final scales/chi2
+    scatter = (use_scatter or use_ir or fit_flags[3] or fit_flags[4]
+               or log10_tau)
 
     # --- precompute: everything the optimizer reads per step ----------
     X = dFT * jnp.conj(mFT) * w  # (nchan, nharm) complex
@@ -579,15 +593,24 @@ def fit_portrait_batch(
     chan_masks=None,
     log10_tau=False,
     max_iter=40,
+    use_scatter=None,
 ):
     """vmapped portrait fit over a leading batch dimension.
 
     ports/models: (nb, nchan, nbin); noise_stds/chan_masks: (nb, nchan);
     freqs: (nchan,) shared or (nb, nchan); P, nu_fit: scalar or (nb,).
+    use_scatter: None -> derived from fit_flags/log10_tau/theta0 (a
+    fixed nonzero tau in theta0 must still be applied to the model).
     """
+    import numpy as np
+
     ports = jnp.asarray(ports)
     nb = ports.shape[0]
     nbin = ports.shape[-1]
+    if use_scatter is None:
+        use_scatter = bool(fit_flags[3]) or bool(fit_flags[4]) or log10_tau
+        if not use_scatter and theta0 is not None:
+            use_scatter = bool(np.any(np.asarray(theta0)[..., 3] != 0.0))
     w = make_weights(noise_stds, nbin, chan_masks, dtype=ports.dtype)
     dFT = jnp.fft.rfft(ports, axis=-1)
     mFT = jnp.fft.rfft(jnp.asarray(models).astype(ports.dtype), axis=-1)
@@ -608,6 +631,7 @@ def fit_portrait_batch(
             log10_tau=log10_tau,
             max_iter=max_iter,
             use_ir=False,
+            use_scatter=use_scatter,
         ),
         in_axes=(0, 0, 0, f_ax, p_ax, nf_ax, 0, 0),
     )

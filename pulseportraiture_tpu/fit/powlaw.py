@@ -1,0 +1,176 @@
+"""Power-law and linear frequency fits.
+
+Replaces the reference's lmfit-based fit_powlaw (pplib.py:1841-1880)
+with a jittable Gauss-Newton, and fit_DM_to_freq_resids
+(pplib.py:1883-1919) with a closed-form weighted linear solve.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Dconst
+from ..utils.bunch import DataBunch
+
+__all__ = ["powlaw", "powlaw_integral", "powlaw_freqs", "fit_powlaw",
+           "fit_DM_to_freq_resids"]
+
+
+def powlaw(nu, nu_ref, A, alpha):
+    """A * (nu/nu_ref)**alpha (reference pplib.py:1087-1099)."""
+    return A * (nu / nu_ref) ** alpha
+
+
+def powlaw_integral(nu2, nu1, nu_ref, A, alpha):
+    """Integral of powlaw from nu1 to nu2 (reference pplib.py:1102-1114)."""
+    alpha = jnp.asarray(alpha, float)
+    A = jnp.asarray(A, float)
+    C = A * (nu_ref ** -alpha)
+    return jnp.where(
+        alpha == -1.0,
+        A * nu_ref * jnp.log(nu2 / nu1),
+        (C / (1.0 + alpha)) * (nu2 ** (1.0 + alpha) - nu1 ** (1.0 + alpha)),
+    )
+
+
+def powlaw_freqs(lo, hi, N, alpha):
+    """N+1 channel edges between lo and hi such that each channel has
+    equal flux for a spectral index alpha (reference pplib.py:1117-1137)."""
+    import numpy as np
+
+    alpha = float(alpha)
+    if alpha == -1.0:
+        return np.exp(np.linspace(np.log(lo), np.log(hi), N + 1))
+    a1 = 1.0 + alpha
+    return (np.linspace(lo**a1, hi**a1, N + 1)) ** (1.0 / a1)
+
+
+@partial(jax.jit, static_argnames=("max_iter",))
+def _fit_powlaw_core(ys, errs, nu_ref, freqs, max_iter=30):
+    dt = ys.dtype
+    w = jnp.where(errs > 0, errs**-2.0, 0.0)
+    x = jnp.log(freqs / nu_ref)
+
+    # init: weighted log-space linear fit on positive ys
+    pos = ys > 0
+    ly = jnp.log(jnp.where(pos, ys, 1.0))
+    wp = jnp.where(pos, w, 0.0)
+    Sw = wp.sum()
+    Sx = (wp * x).sum()
+    Sy = (wp * ly).sum()
+    Sxx = (wp * x * x).sum()
+    Sxy = (wp * x * ly).sum()
+    det = Sw * Sxx - Sx**2.0
+    det = jnp.where(jnp.abs(det) > 0, det, 1.0)
+    alpha0 = (Sw * Sxy - Sx * Sy) / det
+    lnA0 = (Sxx * Sy - Sx * Sxy) / det
+    theta0 = jnp.array([jnp.exp(lnA0), alpha0], dt)
+
+    def resid(theta):
+        return (ys - theta[0] * jnp.exp(theta[1] * x)) * jnp.sqrt(w)
+
+    def body(i, theta):
+        r = resid(theta)
+        J = jax.jacfwd(resid)(theta)
+        JTJ = J.T @ J + 1e-12 * jnp.eye(2, dtype=dt)
+        step = jnp.linalg.solve(JTJ, J.T @ r)
+        return theta - step
+
+    theta = jax.lax.fori_loop(0, max_iter, body, theta0)
+    r = resid(theta)
+    J = jax.jacfwd(resid)(theta)
+    chi2 = jnp.sum(r**2.0)
+    # scale covariance by red-chi2, matching lmfit's default
+    # scale_covar=True that the reference relies on (pplib.py:1841-1880)
+    red = chi2 / jnp.maximum(ys.shape[0] - 2.0, 1.0)
+    cov = jnp.linalg.inv(J.T @ J + 1e-30 * jnp.eye(2, dtype=dt)) * red
+    return theta, cov, chi2
+
+
+def fit_powlaw(data, init_params=None, errs=None, nu_ref=None, freqs=None):
+    """Fit A*(nu/nu_ref)**alpha to data(freqs) with uncertainties.
+
+    Returns a DataBunch(amp, amp_err, alpha, alpha_err, chi2, dof,
+    red_chi2, residuals, nu_ref, freqs) mirroring reference
+    pplib.py:1841-1880 (lmfit leastsq -> Gauss-Newton here).
+    init_params is accepted for API compatibility; the initial guess is
+    derived from a weighted log-space fit.
+    """
+    ys = jnp.asarray(data, float)
+    freqs = jnp.asarray(freqs, float)
+    if errs is None:
+        errs = jnp.ones_like(ys)
+    errs = jnp.asarray(errs, float)
+    if nu_ref is None:
+        nu_ref = float(freqs.mean())
+    theta, cov, chi2 = _fit_powlaw_core(ys, errs, nu_ref, freqs)
+    dof = ys.shape[0] - 2
+    resids = ys - theta[0] * (freqs / nu_ref) ** theta[1]
+    return DataBunch(
+        amp=float(theta[0]),
+        amp_err=float(jnp.sqrt(jnp.maximum(cov[0, 0], 0.0))),
+        alpha=float(theta[1]),
+        alpha_err=float(jnp.sqrt(jnp.maximum(cov[1, 1], 0.0))),
+        chi2=float(chi2),
+        dof=int(dof),
+        red_chi2=float(chi2 / max(dof, 1)),
+        residuals=resids,
+        nu_ref=nu_ref,
+        freqs=freqs,
+    )
+
+
+def fit_DM_to_freq_resids(freqs, frequency_residuals, errs):
+    """Weighted linear fit of residuals [s] vs nu^-2 -> (DM, offset,
+    nu_ref) and uncertainties (reference pplib.py:1883-1919).
+
+    res = Dconst*DM*nu^-2 + offset = Dconst*DM*(nu^-2 - nu_ref^-2).
+
+    Deliberate deviation from the reference: np.polyfit applies `w`
+    multiplicatively to residuals, so the reference's w=errs**-2
+    effectively minimizes sum(errs^-4 * resid^2) — an inverse-variance
+    weighting in errs^2, not errs.  Here the standard chi^2
+    sum((resid/errs)^2) is minimized; with non-uniform errs the point
+    estimates differ from PulsePortraiture's (ours are the maximum-
+    likelihood ones).  Covariance is scaled by red-chi2 as
+    polyfit(cov=True) does.
+    """
+    x = jnp.asarray(freqs, float) ** -2.0
+    y = jnp.asarray(frequency_residuals, float)
+    w = jnp.asarray(errs, float) ** -2.0
+    Sw, Sx, Sy = w.sum(), (w * x).sum(), (w * y).sum()
+    Sxx, Sxy = (w * x * x).sum(), (w * x * y).sum()
+    det = Sw * Sxx - Sx**2.0
+    a = (Sw * Sxy - Sx * Sy) / det
+    b = (Sxx * Sy - Sx * Sxy) / det
+    resids = y - (a * x + b)
+    chi2 = float(jnp.sum(w * resids**2.0))
+    dof = int(y.shape[0] - 2)
+    red = chi2 / max(dof, 1)
+    # cov of (a, b), scaled by red-chi2 as polyfit(cov=True) does
+    va = Sw / det * red
+    vb = Sxx / det * red
+    vab = -Sx / det * red
+    DM = float(a / Dconst)
+    DM_err = float(jnp.sqrt(jnp.maximum(va, 0.0)) / Dconst)
+    offset = float(b)
+    offset_err = float(jnp.sqrt(jnp.maximum(vb, 0.0)))
+    nu_ref = float((-b / a) ** -0.5) if (b / a) < 0 else float("nan")
+    if nu_ref == nu_ref:  # not NaN
+        nu_ref_err = float(
+            jnp.sqrt(
+                jnp.maximum(
+                    (nu_ref**2.0 / 4.0)
+                    * ((va / a**2.0) + (vb / b**2.0) - (2.0 * vab / (a * b))),
+                    0.0,
+                )
+            )
+        )
+    else:
+        nu_ref_err = float("nan")
+    return DataBunch(
+        DM=DM, DM_err=DM_err, offset=offset, offset_err=offset_err,
+        nu_ref=nu_ref, nu_ref_err=nu_ref_err, ab_cov=float(vab),
+        residuals=resids, chi2=chi2, dof=dof, red_chi2=red,
+    )

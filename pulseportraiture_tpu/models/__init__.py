@@ -6,6 +6,16 @@ from .gaussian import (
     gen_gaussian_profile,
     gen_gaussian_portrait,
 )
+from .spline import (
+    pca,
+    reconstruct_portrait,
+    find_significant_eigvec,
+    bspline_eval,
+    gen_spline_portrait,
+    fit_spline_curve,
+    fft_resample,
+)
+from .wavelet import wavelet_smooth, smart_smooth, swt, iswt, get_red_chi2
 
 __all__ = [
     "GaussianModel",
@@ -14,4 +24,16 @@ __all__ = [
     "linear_evolution",
     "gen_gaussian_profile",
     "gen_gaussian_portrait",
+    "pca",
+    "reconstruct_portrait",
+    "find_significant_eigvec",
+    "bspline_eval",
+    "gen_spline_portrait",
+    "fit_spline_curve",
+    "fft_resample",
+    "wavelet_smooth",
+    "smart_smooth",
+    "swt",
+    "iswt",
+    "get_red_chi2",
 ]

@@ -1,0 +1,282 @@
+"""Stationary (translation-invariant) wavelet denoising.
+
+TPU-native replacement for the reference's PyWavelets-based smoothing
+(reference pplib.py:1692-1838: wavelet_smooth / smart_smooth /
+fit_wavelet_smooth_function).  Instead of pywt.swt/iswt host loops, the
+undecimated transform is implemented as FFT-domain circular
+correlation/convolution with a-trous (upsampled) filters — fully
+jittable, batched over channels with vmap, and the smart_smooth
+(nlevel, fact) search is a vectorized grid evaluation instead of
+per-profile scipy.optimize.brute.
+
+The Daubechies scaling filters are computed once on host by spectral
+factorization (no table, no pywt).  Perfect reconstruction of the
+forward/inverse pair is covered by tests/test_wavelet.py.
+"""
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.noise import get_noise_PS
+
+__all__ = [
+    "daubechies",
+    "swt",
+    "iswt",
+    "wavelet_smooth",
+    "smart_smooth",
+    "get_red_chi2",
+]
+
+
+@lru_cache(maxsize=None)
+def daubechies(N=8):
+    """Orthonormal Daubechies scaling filter with N vanishing moments
+    (length 2N), by spectral factorization of the half-band polynomial.
+
+    Returns (dec_lo, dec_hi) as float64 numpy arrays with sum(dec_lo)
+    = sqrt(2) and the usual quadrature-mirror relation.
+    """
+    if N < 1:
+        raise ValueError("N >= 1 required")
+    if N == 1:  # Haar
+        lo = np.array([1.0, 1.0]) / np.sqrt(2.0)
+    else:
+        # P(y) = sum_{k<N} C(N-1+k, k) y^k ; y = (2 - z - 1/z)/4.
+        # Build the Laurent polynomial z^{N-1} P(y(z)) and keep the
+        # roots inside the unit circle (minimum-phase factor).
+        from math import comb
+
+        py = np.array([comb(N - 1 + k, k) for k in range(N - 1, -1, -1)],
+                      dtype=float)
+        # y(z) expressed as polynomial in z (times z^-1): y = (-z^2 + 2z - 1)/(4z)
+        yz = np.array([-1.0, 2.0, -1.0]) / 4.0
+        total = np.zeros(2 * N - 1)
+        for k in range(N):  # coefficient of y^{N-1-k} is py[k]
+            term = np.array([1.0])
+            for _ in range(N - 1 - k):
+                term = np.convolve(term, yz)
+            # multiply by z^{k} to bring everything to degree 2N-2
+            padded = np.zeros(2 * N - 1)
+            off = k
+            padded[off:off + len(term)] += py[k] * term
+            total += padded
+        roots = np.roots(total)
+        keep = roots[np.abs(roots) < 1.0]
+        # h(z) ~ (1+z)^N * prod (z - r_i), normalized
+        h = np.array([1.0])
+        for _ in range(N):
+            h = np.convolve(h, [1.0, 1.0])
+        for r in keep:
+            h = np.convolve(h, [1.0, -r])
+        h = np.real(h)
+        lo = h * (np.sqrt(2.0) / h.sum())
+    hi = lo[::-1].copy()
+    hi[1::2] *= -1.0
+    return lo, hi
+
+
+def _filter_ffts(nbin, nlevel, N=8, dtype=np.float64):
+    """rfft of the a-trous upsampled (lo, hi) filters at each level,
+    zero-padded to nbin.  Host-side, cached by the jit tracer."""
+    lo, hi = daubechies(N)
+    los, his = [], []
+    for j in range(nlevel):
+        step = 2 ** j
+        for f, out in ((lo, los), (hi, his)):
+            up = np.zeros(nbin, dtype=dtype)
+            idx = (np.arange(len(f)) * step) % nbin
+            np.add.at(up, idx, f)
+            out.append(np.fft.rfft(up))
+    return np.stack(los), np.stack(his)
+
+
+@partial(jax.jit, static_argnames=("nlevel", "N"))
+def swt(x, nlevel=5, N=8):
+    """Stationary wavelet transform with periodic boundary.
+
+    x: (..., nbin).  Returns (cA, cD), each (..., nlevel, nbin), finest
+    level first (index 0 = level-1 detail), matching the convention the
+    thresholding code expects.
+    """
+    nbin = x.shape[-1]
+    loF, hiF = _filter_ffts(nbin, nlevel, N)
+    loF = jnp.asarray(loF)
+    hiF = jnp.asarray(hiF)
+    cAs, cDs = [], []
+    a = x
+    for j in range(nlevel):
+        aF = jnp.fft.rfft(a, axis=-1)
+        # circular correlation = multiply by conj(filter fft)
+        a_next = jnp.fft.irfft(aF * jnp.conj(loF[j]), n=nbin, axis=-1)
+        d = jnp.fft.irfft(aF * jnp.conj(hiF[j]), n=nbin, axis=-1)
+        cAs.append(a_next)
+        cDs.append(d)
+        a = a_next
+    return jnp.stack(cAs, axis=-2), jnp.stack(cDs, axis=-2)
+
+
+@partial(jax.jit, static_argnames=("N",))
+def iswt(cA, cD, N=8):
+    """Inverse of swt: reconstruct from the coarsest approximation and
+    all detail levels.  cA, cD: (..., nlevel, nbin)."""
+    nlevel, nbin = cA.shape[-2], cA.shape[-1]
+    loF, hiF = _filter_ffts(nbin, nlevel, N)
+    loF = jnp.asarray(loF)
+    hiF = jnp.asarray(hiF)
+    a = cA[..., -1, :]
+    for j in range(nlevel - 1, -1, -1):
+        aF = jnp.fft.rfft(a, axis=-1)
+        dF = jnp.fft.rfft(cD[..., j, :], axis=-1)
+        # synthesis: circular convolution with the same filters, halved
+        a = 0.5 * jnp.fft.irfft(aF * loF[j] + dF * hiF[j], n=nbin, axis=-1)
+    return a
+
+
+def _universal_threshold(cD1, nbin, fact):
+    """fact * (MAD/0.6745) * sqrt(2 ln nbin), from the finest-level
+    coefficients (reference pplib.py:1725-1727 uses coeffs[0] = the
+    first swt level)."""
+    mad = jnp.median(jnp.abs(cD1), axis=-1)
+    return fact * (mad / 0.6745) * jnp.sqrt(2.0 * jnp.log(nbin))
+
+
+def _threshold(c, t, threshtype):
+    if threshtype == "hard":
+        return jnp.where(jnp.abs(c) > t, c, 0.0)
+    elif threshtype == "soft":
+        return jnp.sign(c) * jnp.maximum(jnp.abs(c) - t, 0.0)
+    raise ValueError(f"unknown threshtype {threshtype!r}")
+
+
+@partial(jax.jit, static_argnames=("nlevel", "threshtype", "N"))
+def _wavelet_smooth_1d(prof, fact, nlevel, threshtype="hard", N=8):
+    nbin = prof.shape[-1]
+    cA, cD = swt(prof, nlevel=nlevel, N=N)
+    # reference thresholds ALL coefficients (approx + detail) of the
+    # stacked pywt.swt output (pplib.py:1728-1729); threshold value from
+    # the first (coarsest-listed) element.  pywt.swt returns
+    # [(cA_n, cD_n), ..., (cA_1, cD_1)] so coeffs[0] is the COARSEST
+    # level pair; its median-abs is dominated by cA_n.  We use the
+    # coarsest approximation+detail, matching that behavior.
+    ref = jnp.concatenate([cA[..., -1, :], cD[..., -1, :]], axis=-1)
+    t = _universal_threshold(ref, nbin, fact)
+    t = t[..., None, None]
+    cA = _threshold(cA, t, threshtype)
+    cD = _threshold(cD, t, threshtype)
+    return iswt(cA, cD, N=N)
+
+
+def wavelet_smooth(port, nlevel=5, threshtype="hard", fact=1.0, N=8):
+    """Wavelet-denoise a profile (nbin,) or portrait (nchan, nbin).
+
+    Reference behavior: pplib.py:1692-1737 (pywt swt -> universal hard
+    threshold -> iswt), but batched on device instead of a per-channel
+    host loop.
+    """
+    port = jnp.asarray(port)
+    fact = jnp.asarray(fact, port.dtype)
+    return _wavelet_smooth_1d(port, fact, nlevel, threshtype, N)
+
+
+def get_red_chi2(data, model, errs=None, dof=None):
+    """Reduced chi^2 between data and model (reference pplib.py:754-779).
+
+    1-D or 2-D; errs estimated per-profile from the power spectrum if
+    not given; dof defaults to sum(shape) as in the reference.
+    """
+    data = jnp.asarray(data)
+    model = jnp.asarray(model)
+    if errs is None:
+        errs = get_noise_PS(data)
+    if dof is None:
+        dof = sum(data.shape)
+    resids = (data - model) / jnp.expand_dims(jnp.asarray(errs), -1) \
+        if data.ndim == 2 else (data - model) / errs
+    return jnp.sum(resids**2.0) / dof
+
+
+@partial(jax.jit, static_argnames=("nlevel", "threshtype", "N", "nfact"))
+def _smooth_score_grid(prof, nlevel, threshtype="hard", N=8, nfact=30,
+                       fact_max=3.0, rchi2_tol=0.1):
+    """For one profile and one nlevel, evaluate the smart_smooth score on
+    a fact grid.  Returns (scores, facts, smoothed) with leading axis nfact.
+
+    Score = pseudo-S/N (Fourier signal power / Fourier noise), zeroed
+    when |red_chi2 - 1| > rchi2_tol (reference pplib.py:1814-1838).
+    """
+    nbin = prof.shape[-1]
+    facts = jnp.linspace(0.0, fact_max, nfact, dtype=prof.dtype)
+    cA, cD = swt(prof, nlevel=nlevel, N=N)
+    ref = jnp.concatenate([cA[-1], cD[-1]], axis=-1)
+    t0 = _universal_threshold(ref, nbin, 1.0)
+
+    def one(fact):
+        t = fact * t0
+        sm = iswt(_threshold(cA, t, threshtype), _threshold(cD, t, threshtype),
+                  N=N)
+        sig = jnp.sum(jnp.abs(jnp.fft.rfft(sm)[1:]) ** 2.0)
+        noise = get_noise_PS(sm) * jnp.sqrt(nbin / 2.0)
+        snr = jnp.where(noise > 0.0, sig / jnp.where(noise > 0, noise, 1.0),
+                        jnp.inf)
+        snr = jnp.where(sig > 0.0, snr, 0.0)
+        # red chi2 of data vs smooth, noise from the data profile
+        dnoise = get_noise_PS(prof)
+        rchi2 = jnp.sum(((prof - sm) / jnp.maximum(dnoise, 1e-300)) ** 2.0) \
+            / nbin
+        snr = jnp.where(jnp.abs(rchi2 - 1.0) > rchi2_tol, 0.0, snr)
+        return snr, sm, rchi2
+
+    scores, smoothed, rchi2s = jax.vmap(one)(facts)
+    return scores, facts, smoothed, rchi2s
+
+
+def smart_smooth(port, try_nlevels=None, rchi2_tol=0.1, threshtype="hard",
+                 N=8, nfact=30, fact_max=3.0):
+    """Auto-tuned wavelet smoothing (reference pplib.py:1740-1811).
+
+    For each profile, maximize pseudo-S/N over (nlevel, fact) subject to
+    reduced-chi2 within rchi2_tol of 1; profiles with no acceptable
+    smoothing are zeroed.  The reference brute-forces fact with
+    opt.brute per (profile, nlevel) on host; here the whole
+    (nlevel x fact) grid is evaluated as batched device ops.
+    """
+    port = jnp.asarray(port)
+    one_prof = port.ndim == 1
+    if one_prof:
+        port = port[None]
+    nchan, nbin = port.shape
+    if nbin % 2 != 0 or try_nlevels == 0:
+        out = port[0] if one_prof else port
+        return out
+    if np.modf(np.log2(nbin))[0] != 0.0:
+        try_nlevels = 1
+    elif try_nlevels is None:
+        try_nlevels = int(np.log2(nbin))
+    try_nlevels = min(try_nlevels, int(np.log2(nbin)))
+
+    best_score = jnp.full((nchan,), -jnp.inf, port.dtype)
+    best_sm = jnp.zeros_like(port)
+    for ilevel in range(try_nlevels):
+        scores, facts, smoothed, _ = jax.vmap(
+            lambda p: _smooth_score_grid(
+                p, ilevel + 1, threshtype, N, nfact, fact_max, rchi2_tol
+            )
+        )(port)
+        i = jnp.argmax(scores, axis=-1)
+        sc = jnp.take_along_axis(scores, i[:, None], axis=-1)[:, 0]
+        sm = jnp.take_along_axis(
+            smoothed, i[:, None, None], axis=1
+        )[:, 0, :]
+        better = sc > best_score
+        best_score = jnp.where(better, sc, best_score)
+        best_sm = jnp.where(better[:, None], sm, best_sm)
+
+    # zero out profiles whose best smoothing never met the chi2 gate,
+    # and all-zero inputs (reference skips them / zeroes them)
+    ok = (best_score > 0.0) & jnp.any(port != 0.0, axis=-1)
+    best_sm = jnp.where(ok[:, None], best_sm, 0.0)
+    return best_sm[0] if one_prof else best_sm

@@ -43,13 +43,21 @@ def fit_portrait_sharded(
     log10_tau=False,
     max_iter=40,
     shard_channels=False,
+    use_scatter=None,
 ):
     """Batched (nb, nchan, nbin) portrait fit sharded over the mesh.
 
     freqs may be (nchan,) shared or (nb, nchan); P_s/nu_fit scalar or
     (nb,).  Returns a FitResult with batched leaves (still sharded;
-    use jax.device_get to fetch).
+    use jax.device_get to fetch).  use_scatter: None -> derived from
+    fit_flags/log10_tau/theta0 so a fixed nonzero tau is not ignored.
     """
+    import numpy as np
+
+    if use_scatter is None:
+        use_scatter = bool(fit_flags[3]) or bool(fit_flags[4]) or log10_tau
+        if not use_scatter and theta0 is not None:
+            use_scatter = bool(np.any(np.asarray(theta0)[..., 3] != 0.0))
     ports = jnp.asarray(ports)
     nb, nchan, nbin = ports.shape
     w = make_weights(noise_stds, nbin, dtype=ports.dtype)
@@ -71,6 +79,7 @@ def fit_portrait_sharded(
             log10_tau=log10_tau,
             max_iter=max_iter,
             use_ir=False,
+            use_scatter=use_scatter,
         ),
         in_axes=(0, 0, 0, f_ax, 0, 0, 0, 0),
     )

@@ -1,0 +1,166 @@
+"""Tests for the PCA/B-spline/wavelet modeling stack.
+
+Oracles (SURVEY.md §4): perfect reconstruction of the SWT pair,
+B-spline evaluation parity with scipy.interpolate.splev, PCA parity
+with np.cov+eigh, denoising actually denoises, spline portrait model
+recovers a synthetic frequency-evolving portrait.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.interpolate as si
+
+from pulseportraiture_tpu.fit.powlaw import (fit_DM_to_freq_resids,
+                                             fit_powlaw, powlaw,
+                                             powlaw_freqs)
+from pulseportraiture_tpu.models.spline import (bspline_eval, fft_resample,
+                                                fit_spline_curve,
+                                                gen_spline_portrait, pca,
+                                                reconstruct_portrait)
+from pulseportraiture_tpu.models.wavelet import (daubechies, iswt,
+                                                 smart_smooth, swt,
+                                                 wavelet_smooth)
+
+
+class TestWavelet:
+    def test_daubechies_orthonormal(self):
+        for N in (2, 4, 8):
+            lo, hi = daubechies(N)
+            assert len(lo) == 2 * N
+            assert np.isclose(lo.sum(), np.sqrt(2.0))
+            assert np.isclose(np.sum(lo**2), 1.0)
+            # orthogonality to even shifts
+            for s in range(2, 2 * N, 2):
+                assert abs(np.sum(lo[s:] * lo[:-s])) < 1e-10
+
+    def test_swt_perfect_reconstruction(self, rng):
+        x = jnp.asarray(rng.normal(size=256))
+        cA, cD = swt(x, nlevel=4)
+        xr = iswt(cA, cD)
+        assert np.allclose(np.asarray(xr), np.asarray(x), atol=1e-10)
+
+    def test_swt_batched(self, rng):
+        x = jnp.asarray(rng.normal(size=(3, 128)))
+        cA, cD = swt(x, nlevel=3)
+        assert cA.shape == (3, 3, 128)
+        xr = iswt(cA, cD)
+        assert np.allclose(np.asarray(xr), np.asarray(x), atol=1e-10)
+
+    def test_denoise_improves_mse(self, rng):
+        nbin = 512
+        t = np.linspace(0, 1, nbin, endpoint=False)
+        clean = np.exp(-0.5 * ((t - 0.5) / 0.02) ** 2)
+        noisy = clean + 0.05 * rng.normal(size=nbin)
+        sm = np.asarray(wavelet_smooth(noisy, nlevel=5, fact=1.0))
+        assert np.mean((sm - clean) ** 2) < 0.5 * np.mean((noisy - clean) ** 2)
+
+    def test_smart_smooth_zeroes_pure_noise_keeps_signal(self, rng):
+        nbin = 256
+        t = np.linspace(0, 1, nbin, endpoint=False)
+        clean = np.exp(-0.5 * ((t - 0.5) / 0.03) ** 2)
+        port = np.stack([clean + 0.05 * rng.normal(size=nbin),
+                         np.zeros(nbin)])
+        sm = np.asarray(smart_smooth(port))
+        assert np.mean((sm[0] - clean) ** 2) < np.mean(
+            (port[0] - clean) ** 2)
+        assert np.all(sm[1] == 0.0)
+
+
+class TestPCA:
+    def test_pca_matches_numpy(self, rng):
+        port = rng.normal(size=(32, 64))
+        w = rng.uniform(1.0, 2.0, size=32)
+        eigval, eigvec = pca(jnp.asarray(port), weights=jnp.asarray(w))
+        mean = (port.T * w).T.sum(0) / w.sum()
+        cov = np.cov((port - mean).T, aweights=w, ddof=1)
+        ev_np, evec_np = np.linalg.eigh(cov)
+        assert np.allclose(np.asarray(eigval), ev_np[::-1], atol=1e-8)
+        # leading (non-degenerate) eigvectors match up to sign; the
+        # null space of the rank-deficient cov is arbitrary
+        lead = np.asarray(eigvec)[:, :20]
+        dots = np.abs(np.sum(lead * evec_np[:, ::-1][:, :20], axis=0))
+        assert np.allclose(dots, 1.0, atol=1e-6)
+
+    def test_reconstruct_identity_full_basis(self, rng):
+        port = rng.normal(size=(16, 32))
+        eigval, eigvec = pca(jnp.asarray(port))
+        mean = port.mean(0)
+        rec = reconstruct_portrait(jnp.asarray(port), jnp.asarray(mean),
+                                   eigvec)
+        assert np.allclose(np.asarray(rec), port, atol=1e-8)
+
+
+class TestBSpline:
+    def test_matches_scipy_splev(self, rng):
+        freqs = np.linspace(1000.0, 2000.0, 64)
+        proj = np.stack([np.sin(freqs / 200.0), np.cos(freqs / 300.0)]).T
+        proj += 0.01 * rng.normal(size=proj.shape)
+        tck = fit_spline_curve(proj, freqs, sfac=1.0)
+        x = np.linspace(1000.0, 2000.0, 200)
+        ours = np.asarray(bspline_eval(x, tck))
+        scipys = np.array(si.splev(x, (tck[0], list(tck[1]), tck[2]))).T
+        assert np.allclose(ours, scipys, atol=1e-8)
+
+    def test_gen_spline_portrait_recovers_evolution(self, rng):
+        nchan, nbin = 64, 128
+        freqs = np.linspace(1200.0, 1800.0, nchan)
+        t = np.arange(nbin) / nbin
+        mean = np.exp(-0.5 * ((t - 0.5) / 0.05) ** 2)
+        ev1 = np.roll(mean, 5) - mean  # a shape-evolution direction
+        coef = 0.3 * (freqs - 1500.0) / 300.0
+        port = mean + np.outer(coef, ev1)
+        eigval, eigvec = pca(jnp.asarray(port))
+        k = 1
+        vecs = np.asarray(eigvec)[:, :k]
+        proj = (port - mean) @ vecs
+        tck = fit_spline_curve(proj, freqs, sfac=0.01)
+        model = np.asarray(
+            gen_spline_portrait(jnp.asarray(mean), jnp.asarray(freqs),
+                                jnp.asarray(vecs), tck))
+        assert np.allclose(model, port, atol=1e-3)
+
+    def test_fft_resample(self):
+        nbin = 64
+        t = np.arange(nbin) / nbin
+        x = np.sin(2 * np.pi * 3 * t) + 0.5 * np.cos(2 * np.pi * 5 * t)
+        up = np.asarray(fft_resample(jnp.asarray(x), 128))
+        t2 = np.arange(128) / 128.0
+        expect = np.sin(2 * np.pi * 3 * t2) + 0.5 * np.cos(2 * np.pi * 5 * t2)
+        assert np.allclose(up, expect, atol=1e-10)
+
+
+class TestPowlaw:
+    def test_fit_powlaw_recovers(self, rng):
+        freqs = np.linspace(1000.0, 2000.0, 50)
+        truth = powlaw(freqs, 1500.0, 2.5, -1.8)
+        noisy = truth * (1.0 + 0.01 * rng.normal(size=50))
+        res = fit_powlaw(noisy, errs=0.025 * np.asarray(truth),
+                         nu_ref=1500.0, freqs=freqs)
+        assert abs(res.amp - 2.5) < 0.1
+        assert abs(res.alpha + 1.8) < 0.1
+        assert res.alpha_err > 0
+
+    def test_powlaw_freqs_equal_flux(self):
+        edges = powlaw_freqs(1000.0, 2000.0, 8, -1.0)
+        assert len(edges) == 9
+        from pulseportraiture_tpu.fit.powlaw import powlaw_integral
+
+        fluxes = [
+            float(powlaw_integral(edges[i + 1], edges[i], 1500.0, 1.0, -1.0))
+            for i in range(8)
+        ]
+        assert np.allclose(fluxes, fluxes[0])
+
+    def test_fit_dm_to_freq_resids(self, rng):
+        from pulseportraiture_tpu.config import Dconst
+
+        freqs = np.linspace(1000.0, 2000.0, 64)
+        DM_true, off = 3.0e-3, 5.0e-6
+        resids = Dconst * DM_true * freqs**-2.0 + off
+        errs = np.full(64, 1.0e-7)
+        resids = resids + errs * rng.normal(size=64)
+        out = fit_DM_to_freq_resids(freqs, resids, errs)
+        assert abs(out.DM - DM_true) < 5 * out.DM_err
+        assert abs(out.offset - off) < 5 * out.offset_err
+        assert out.red_chi2 == pytest.approx(1.0, abs=0.5)
