@@ -1,5 +1,7 @@
 from .phase_shift import fit_phase_shift, fit_phase_shift_batch
 from .powlaw import fit_powlaw, fit_DM_to_freq_resids, powlaw, powlaw_freqs
+from .lm import levenberg_marquardt, LMResult
+from .gauss import fit_gaussian_profile, fit_gaussian_portrait
 from .portrait import (
     FitFlags,
     FitResult,
@@ -20,4 +22,8 @@ __all__ = [
     "fit_DM_to_freq_resids",
     "powlaw",
     "powlaw_freqs",
+    "levenberg_marquardt",
+    "LMResult",
+    "fit_gaussian_profile",
+    "fit_gaussian_portrait",
 ]

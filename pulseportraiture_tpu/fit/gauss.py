@@ -1,0 +1,272 @@
+"""Gaussian template fitting: profile and evolving-portrait fits.
+
+TPU-native replacement for the reference's lmfit-based template
+builders (fit_gaussian_profile pplib.py:1922-2002,
+fit_gaussian_portrait pplib.py:2005-2133), driven by the JAX
+Levenberg-Marquardt engine in fit/lm.py.  Model generation is the
+analytic-FT Gaussian portrait from models/gaussian.py, so the Jacobian
+comes from autodiff through the FFT instead of finite differences.
+
+Flat parameter layouts mirror the reference exactly (so .gmodel round-
+tripping and ppgauss-style iteration carry over):
+
+profile:  [dc, tau_bins, (loc, wid, amp) * ngauss]
+portrait: [dc, tau_bins, (loc, mloc, wid, mwid, amp, mamp) * ngauss]
+          (+ per-join (phase, DM) pairs, + scattering index, handled as
+          separate arguments like the reference's lmfit Parameters)
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Dconst, wid_max
+from ..ops.gaussian import gaussian_profile_FT
+from ..ops.phasor import cexp
+from ..ops.scattering import (scattering_portrait_FT, scattering_profile_FT,
+                              scattering_times)
+from ..utils.bunch import DataBunch
+from .lm import levenberg_marquardt
+
+__all__ = ["fit_gaussian_profile", "fit_gaussian_portrait",
+           "gen_gaussian_profile_flat", "gen_gaussian_portrait_flat"]
+
+
+def _profile_FT_flat(theta, nbin):
+    """rFFT of DC + ngauss Gaussians + scattering, theta as in the
+    profile layout (tau in bins)."""
+    nharm = nbin // 2 + 1
+    dc, tau = theta[0], theta[1]
+    locs, wids, amps = theta[2::3], theta[3::3], theta[4::3]
+    gFT = gaussian_profile_FT(nharm, locs[:, None], wids[:, None],
+                              amps[:, None])
+    pFT = jnp.sum(gFT, axis=0)
+    pFT = pFT.at[0].add(dc * nbin)
+    return pFT * scattering_profile_FT(tau / nbin, nharm)
+
+
+def gen_gaussian_profile_flat(theta, nbin):
+    """Phase-domain profile from the flat layout (reference
+    gen_gaussian_profile, pplib.py:859-883; tau in bins)."""
+    return jnp.fft.irfft(_profile_FT_flat(jnp.asarray(theta, float), nbin),
+                         n=nbin)
+
+
+def _profile_resid(theta, data, errs):
+    nbin = data.shape[-1]
+    return (data - jnp.fft.irfft(_profile_FT_flat(theta, nbin), n=nbin)) / errs
+
+
+def fit_gaussian_profile(data, init_params, errs, fit_flags=None,
+                         fit_scattering=False, quiet=True):
+    """Fit DC + ngauss Gaussians (+ scattering tau) to a profile.
+
+    init_params: [dc, tau_bins, (loc, wid, amp)*ngauss].  Bounds follow
+    the reference: tau >= 0, 0 <= wid <= wid_max, amp >= 0
+    (pplib.py:1954-1974).  fit_flags covers the NON-scattering params
+    (dc + 3*ngauss entries) as in the reference signature; scattering
+    is toggled by fit_scattering.  Returns DataBunch(fitted_params,
+    fit_errs, residuals, chi2, dof, red_chi2).
+    """
+    data = jnp.asarray(data, float)
+    errs_arr = jnp.broadcast_to(jnp.asarray(errs, float), data.shape)
+    x0 = np.asarray(init_params, float)
+    n = len(x0)
+    ngauss = (n - 2) // 3
+    vary = np.ones(n, bool)
+    if fit_flags is not None:
+        ff = [bool(f) for f in fit_flags]
+        vary[0] = ff[0]
+        vary[2:] = ff[1:]
+    vary[1] = bool(fit_scattering)
+    nbin = data.shape[-1]
+    lower = np.full(n, -np.inf)
+    upper = np.full(n, np.inf)
+    lower[1] = 0.0
+    # wids: reference uses min=0 (pplib.py:1969), but an exactly-zero
+    # width is a stationary trap (all derivatives vanish, the component
+    # can never regrow).  A half-bin floor is below anything resolvable
+    # and keeps the optimizer out of the trap.
+    lower[3::3] = 0.5 / nbin
+    upper[3::3] = wid_max
+    lower[4::3] = 0.0  # amps
+    res = levenberg_marquardt(_profile_resid, x0, aux=(data, errs_arr),
+                              lower=lower, upper=upper, vary=vary)
+    residuals = np.asarray(_profile_resid(res.x, data, errs_arr)) * \
+        np.asarray(errs_arr)
+    dof = int(res.dof)
+    out = DataBunch(
+        fitted_params=np.asarray(res.x),
+        fit_errs=np.asarray(res.x_err),
+        residuals=residuals,
+        chi2=float(res.chi2),
+        dof=dof,
+        red_chi2=float(res.chi2) / max(dof, 1),
+    )
+    if not quiet:
+        print(f"Gaussians: {ngauss}  DoF: {dof}  "
+              f"reduced chi-sq: {out.red_chi2:.2f}")
+    return out
+
+
+# --------------------------------------------------------------------------
+# Portrait fit
+# --------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("code", "nbin", "njoin"))
+def _portrait_FT_flat(theta, join_theta, alpha_s, freqs, nu_ref, P,
+                      join_mask, code="000", nbin=None, njoin=0):
+    """(nchan, nharm) model rFFT from the flat portrait layout.
+
+    theta: [dc, tau_bins, (loc, mloc, wid, mwid, amp, mamp)*ngauss];
+    join_theta: (njoin, 2) of (phase, DM) applied to channels selected
+    by join_mask (njoin, nchan); alpha_s: scattering index.
+    """
+    from ..models.gaussian import _EVOLUTION
+
+    nharm = nbin // 2 + 1
+    dc, tau = theta[0], theta[1]
+    locs, mlocs = theta[2::6], theta[3::6]
+    wids, mwids = theta[4::6], theta[5::6]
+    amps, mamps = theta[6::6], theta[7::6]
+    f = freqs[:, None]
+    locs_c = _EVOLUTION[code[0]](locs[None, :], mlocs[None, :], f, nu_ref)
+    wids_c = _EVOLUTION[code[1]](wids[None, :], mwids[None, :], f, nu_ref)
+    amps_c = _EVOLUTION[code[2]](amps[None, :], mamps[None, :], f, nu_ref)
+    gFT = gaussian_profile_FT(nharm, locs_c[..., None], wids_c[..., None],
+                              amps_c[..., None])
+    pFT = jnp.sum(gFT, axis=1)
+    pFT = pFT.at[:, 0].add(dc * nbin)
+    taus = scattering_times(tau / nbin, alpha_s, freqs, nu_ref)
+    pFT = pFT * scattering_portrait_FT(taus, nharm)
+    if njoin:
+        k = jnp.arange(nharm, dtype=freqs.dtype)
+        for ij in range(njoin):
+            phi, DM = join_theta[ij, 0], join_theta[ij, 1]
+            delays = phi + (Dconst * DM / P) * (freqs**-2.0 - nu_ref**-2.0)
+            rot = jnp.conj(cexp(2.0 * jnp.pi * delays[:, None] * k))
+            pFT = jnp.where(join_mask[ij][:, None], pFT * rot, pFT)
+    return pFT
+
+
+def gen_gaussian_portrait_flat(theta, freqs, nu_ref, nbin, alpha_s,
+                               code="000", join_theta=None, join_mask=None,
+                               P=None):
+    """Phase-domain portrait from the flat layout (reference
+    gen_gaussian_portrait, pplib.py:886-963, incl. JOIN rotations)."""
+    theta = jnp.asarray(theta, float)
+    freqs = jnp.asarray(freqs, float)
+    njoin = 0 if join_theta is None else int(np.shape(join_theta)[0])
+    if join_theta is None:
+        join_theta = jnp.zeros((0, 2))
+        join_mask = jnp.zeros((0, len(freqs)), bool)
+    pFT = _portrait_FT_flat(theta, jnp.asarray(join_theta),
+                            jnp.asarray(alpha_s, float), freqs,
+                            jnp.asarray(nu_ref, float),
+                            jnp.asarray(1.0 if P is None else P, float),
+                            jnp.asarray(join_mask), code=code, nbin=nbin,
+                            njoin=njoin)
+    return jnp.fft.irfft(pFT, n=nbin, axis=-1)
+
+
+def _make_portrait_resid(code, nbin, njoin, nmain):
+    """Residual over the concatenated [theta, join.flat, alpha_s]."""
+
+    def resid(x, data, errs, freqs, nu_ref, P, join_mask):
+        theta = x[:nmain]
+        join_theta = x[nmain:nmain + 2 * njoin].reshape(njoin, 2)
+        alpha_s = x[-1]
+        pFT = _portrait_FT_flat(theta, join_theta, alpha_s, freqs, nu_ref,
+                                P, join_mask, code=code, nbin=nbin,
+                                njoin=njoin)
+        model = jnp.fft.irfft(pFT, n=nbin, axis=-1)
+        return ((data - model) / errs[:, None]).ravel()
+
+    return resid
+
+
+_PORTRAIT_RESID_CACHE = {}
+
+
+def fit_gaussian_portrait(data, init_params, scattering_index, errs,
+                          fit_flags, fit_scattering_index, freqs, nu_ref,
+                          model_code="000", join_params=None, P=None,
+                          quiet=True):
+    """Fit evolving Gaussian components to an (nchan, nbin) portrait.
+
+    init_params: [dc, tau_bins, (loc, mloc, wid, mwid, amp, mamp)*g];
+    fit_flags: same length; join_params = (join_ichans, values, flags)
+    with values/flags = [phase1, DM1, phase2, DM2, ...] as in the
+    reference (pplib.py:2073-2092).  Bounds: tau >= 0,
+    0 <= wid <= wid_max, amp >= 0.  Returns DataBunch(fitted_params,
+    fit_errs, scattering_index, scattering_index_err, join_fit, chi2,
+    dof, red_chi2, residuals).
+    """
+    data = jnp.asarray(data, float)
+    nchan, nbin = data.shape
+    errs = jnp.broadcast_to(jnp.asarray(errs, float), (nchan,))
+    freqs = jnp.asarray(freqs, float)
+    x0_main = np.asarray(init_params, float)
+    nmain = len(x0_main)
+    vary_main = np.asarray(fit_flags, bool)
+
+    if join_params:
+        join_ichans, join_vals, join_flags = join_params
+        njoin = len(join_ichans)
+        join_mask = np.zeros((njoin, nchan), bool)
+        for ij, ichans in enumerate(join_ichans):
+            join_mask[ij, np.asarray(ichans)] = True
+        x0_join = np.asarray(join_vals, float)
+        vary_join = np.asarray(join_flags, bool)
+    else:
+        njoin = 0
+        join_mask = np.zeros((0, nchan), bool)
+        x0_join = np.zeros(0)
+        vary_join = np.zeros(0, bool)
+
+    x0 = np.concatenate([x0_main, x0_join, [float(scattering_index)]])
+    vary = np.concatenate([vary_main, vary_join, [bool(fit_scattering_index)]])
+    n = len(x0)
+    lower = np.full(n, -np.inf)
+    upper = np.full(n, np.inf)
+    lower[1] = 0.0
+    lower[4:nmain:6] = 0.5 / nbin  # wids: half-bin floor (see profile fit)
+    upper[4:nmain:6] = wid_max
+    lower[6:nmain:6] = 0.0       # amps
+
+    key = (model_code, nbin, njoin, nmain)
+    if key not in _PORTRAIT_RESID_CACHE:
+        _PORTRAIT_RESID_CACHE[key] = _make_portrait_resid(
+            model_code, nbin, njoin, nmain)
+    resid = _PORTRAIT_RESID_CACHE[key]
+
+    aux = (data, errs, freqs, jnp.asarray(nu_ref, float),
+           jnp.asarray(1.0 if P is None else P, float),
+           jnp.asarray(join_mask))
+    res = levenberg_marquardt(resid, x0, aux=aux, lower=lower, upper=upper,
+                              vary=vary, max_iter=200)
+    x = np.asarray(res.x)
+    x_err = np.asarray(res.x_err)
+    residuals = np.asarray(resid(res.x, *aux)).reshape(nchan, nbin) * \
+        np.asarray(errs)[:, None]
+    dof = int(res.dof)
+    out = DataBunch(
+        fitted_params=x[:nmain],
+        fit_errs=x_err[:nmain],
+        join_fit=x[nmain:nmain + 2 * njoin],
+        join_fit_errs=x_err[nmain:nmain + 2 * njoin],
+        scattering_index=float(x[-1]),
+        scattering_index_err=float(x_err[-1]),
+        residuals=residuals,
+        chi2=float(res.chi2),
+        dof=dof,
+        red_chi2=float(res.chi2) / max(dof, 1),
+        nfev=int(res.nfev),
+    )
+    if not quiet:
+        print(f"Gaussian portrait fit: ngauss={(nmain - 2) // 6} "
+              f"DoF={dof} reduced chi-sq: {out.red_chi2:.2f}")
+    return out

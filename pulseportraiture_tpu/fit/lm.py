@@ -1,0 +1,215 @@
+"""Bounded Levenberg-Marquardt least squares in JAX.
+
+Replaces the reference's lmfit/MINPACK dependency (used by
+fit_gaussian_profile pplib.py:1922-2002, fit_gaussian_portrait
+pplib.py:2005-2133, fit_powlaw pplib.py:1841-1880).  Bounds are handled
+with the same MINUIT-style parameter transforms lmfit uses, so bounded
+parameters stay strictly inside their intervals and the Jacobian is
+taken in the unbounded internal space by autodiff.  The loop is a
+fixed-shape `lax.while_loop`; frozen parameters (vary=False) have their
+Jacobian columns masked rather than changing the parameter vector's
+shape, keeping everything jittable.
+
+Error bars follow lmfit's default convention: covariance scaled by
+reduced chi^2 (scale_covar=True), reported in external space via the
+transform's chain rule.
+"""
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["LMResult", "levenberg_marquardt"]
+
+
+# --- bound transforms (lmfit/MINUIT convention) ---------------------------
+# free:        x = u
+# lower only:  x = lo - 1 + sqrt(u^2 + 1)
+# upper only:  x = hi + 1 - sqrt(u^2 + 1)
+# two-sided:   x = lo + (hi - lo)/2 * (sin(u) + 1)
+
+
+def _to_external(u, lo, hi, kind):
+    s = jnp.sqrt(u**2.0 + 1.0)
+    return jnp.where(
+        kind == 0, u,
+        jnp.where(
+            kind == 1, lo - 1.0 + s,
+            jnp.where(kind == 2, hi + 1.0 - s,
+                      lo + 0.5 * (hi - lo) * (jnp.sin(u) + 1.0)),
+        ),
+    )
+
+
+def _to_internal(x, lo, hi, kind):
+    xl = jnp.sqrt(jnp.maximum((x - lo + 1.0) ** 2.0 - 1.0, 0.0))
+    xu = jnp.sqrt(jnp.maximum((hi - x + 1.0) ** 2.0 - 1.0, 0.0))
+    frac = jnp.clip(2.0 * (x - lo) / jnp.where(hi > lo, hi - lo, 1.0) - 1.0,
+                    -1.0, 1.0)
+    return jnp.where(
+        kind == 0, x,
+        jnp.where(kind == 1, xl, jnp.where(kind == 2, -xu, jnp.arcsin(frac))),
+    )
+
+
+def _bounds_spec(lower, upper, n, dtype):
+    lo = np.full(n, -np.inf) if lower is None else np.asarray(lower, float)
+    hi = np.full(n, np.inf) if upper is None else np.asarray(upper, float)
+    kind = np.zeros(n, np.int32)
+    kind[np.isfinite(lo) & ~np.isfinite(hi)] = 1
+    kind[~np.isfinite(lo) & np.isfinite(hi)] = 2
+    kind[np.isfinite(lo) & np.isfinite(hi)] = 3
+    # replace infs by dummies so the transforms never see inf arithmetic
+    lo = np.where(np.isfinite(lo), lo, 0.0)
+    hi = np.where(np.isfinite(hi), hi, 0.0)
+    return (jnp.asarray(lo, dtype), jnp.asarray(hi, dtype),
+            jnp.asarray(kind))
+
+
+class LMResult(NamedTuple):
+    x: jnp.ndarray          # fitted external parameters
+    x_err: jnp.ndarray      # 1-sigma errors (scale_covar convention)
+    chi2: jnp.ndarray
+    dof: jnp.ndarray
+    nfev: jnp.ndarray
+    cov: jnp.ndarray        # external-space covariance (scaled)
+    success: jnp.ndarray
+
+
+class _LMState(NamedTuple):
+    u: jnp.ndarray
+    f: jnp.ndarray
+    lam: jnp.ndarray
+    it: jnp.ndarray
+    nfev: jnp.ndarray
+    done: jnp.ndarray
+
+
+@partial(jax.jit, static_argnames=("resid_fn", "max_iter"))
+def _lm_core(resid_fn, aux, x0, lo, hi, kind, vary, max_iter=100, ftol=1e-10,
+             lam0=1e-3):
+    dt = x0.dtype
+    u0 = _to_internal(x0, lo, hi, kind)
+    vary = vary.astype(dt)
+    nvary = jnp.sum(vary)
+
+    def rfun(u):
+        return resid_fn(_to_external(u, lo, hi, kind), *aux)
+
+    def chi2_of(u):
+        r = rfun(u)
+        return jnp.sum(r**2.0)
+
+    def jac(u):
+        J = jax.jacfwd(rfun)(u)  # (nres, nparam)
+        return J * vary[None, :]
+
+    def cond(s):
+        return jnp.logical_and(s.it < max_iter, jnp.logical_not(s.done))
+
+    def body(s):
+        r = rfun(s.u)
+        J = jac(s.u)
+        g = J.T @ r
+        JTJ = J.T @ J
+        dJ = jnp.diag(JTJ)
+        dJ = jnp.maximum(dJ, 1e-14 * jnp.max(dJ))
+        A = JTJ + s.lam * jnp.diag(dJ) + jnp.diag(1.0 - vary)
+        step = -jnp.linalg.solve(A, g) * vary
+        # near-degenerate Jacobian columns (e.g. a parameter just
+        # inside a bound) can produce explosive internal steps; clamp
+        # each element to a generous multiple of its current scale
+        smax = 100.0 * (1.0 + jnp.abs(s.u))
+        step = jnp.clip(step, -smax, smax)
+        f_new = chi2_of(s.u + step)
+        accept = f_new < s.f
+        # converged: accepted near-Newton step (small damping) with
+        # negligible relative improvement.  With large lam a small
+        # improvement only means the step was short, not convergence.
+        rel = (s.f - f_new) / (jnp.abs(s.f) + 1e-300)
+        done = jnp.logical_and(jnp.logical_and(accept, rel < ftol),
+                               s.lam <= lam0)
+        # also converged if the gradient is essentially zero
+        gnorm = jnp.max(jnp.abs(g * vary))
+        done = jnp.logical_or(done, gnorm < 1e-14 * (s.f + 1.0))
+        return _LMState(
+            u=jnp.where(accept, s.u + step, s.u),
+            f=jnp.where(accept, f_new, s.f),
+            lam=jnp.where(accept, s.lam * 0.3, s.lam * 5.0).clip(1e-12, 1e12),
+            it=s.it + 1,
+            nfev=s.nfev + 1,
+            done=done,
+        )
+
+    s0 = _LMState(
+        u=u0,
+        f=chi2_of(u0),
+        lam=jnp.asarray(lam0, dt),
+        it=jnp.asarray(0, jnp.int32),
+        nfev=jnp.asarray(1, jnp.int32),
+        done=jnp.asarray(False),
+    )
+    s = jax.lax.while_loop(cond, body, s0)
+
+    # --- covariance in external space, lmfit scale_covar convention ---
+    r = rfun(s.u)
+    J = jac(s.u)
+    JTJ = J.T @ J + jnp.diag(1.0 - vary)
+    cov_u = jnp.linalg.inv(JTJ)
+    nres = r.shape[0]
+    dof = nres - nvary
+    red = s.f / jnp.maximum(dof, 1.0)
+    cov_u = cov_u * red
+    # the transform is elementwise, so dx/du is diagonal
+    D = jax.vmap(jax.grad(_to_external), in_axes=(0, 0, 0, 0))(
+        s.u, lo, hi, kind)
+    cov_x = cov_u * jnp.outer(D, D) * jnp.outer(vary, vary)
+    x = _to_external(s.u, lo, hi, kind)
+    x_err = jnp.sqrt(jnp.maximum(jnp.diagonal(cov_x), 0.0))
+    return LMResult(
+        x=x, x_err=x_err, chi2=s.f, dof=dof, nfev=s.nfev, cov=cov_x,
+        success=s.done | (s.it < max_iter),
+    )
+
+
+def levenberg_marquardt(resid_fn, x0, aux=(), lower=None, upper=None,
+                        vary=None, max_iter=100, ftol=1e-10):
+    """Minimize sum(resid_fn(x, *aux)**2) over x with optional bounds.
+
+    resid_fn: callable (x, *aux) -> residual vector; must be
+    jax-traceable and HASHABLE (a module-level function).  Pass data
+    arrays through `aux` — they are traced operands, so repeated fits
+    with different data reuse one compilation.
+    x0: (n,) initial external parameters (clipped into bounds).
+    lower/upper: (n,) bounds with +-inf for unbounded; vary: (n,) bool.
+    """
+    x0 = jnp.asarray(x0, float)
+    n = x0.shape[0]
+    lo, hi, kind = _bounds_spec(lower, upper, n, x0.dtype)
+    if vary is None:
+        vary = jnp.ones(n, bool)
+    vary = jnp.asarray(vary)
+    # Nudge VARYING parameters strictly inside their bounds: at the
+    # exact bound every transform has dx/du = 0 (u = 0 for one-sided,
+    # the arcsin endpoints for two-sided), which zeroes the Jacobian
+    # column and freezes the parameter forever.  Frozen (vary=False)
+    # parameters keep their exact value.  The nudge must be large
+    # enough that dx/du ~ sqrt(2*eps) does not make the column
+    # numerically singular (which produces explosive internal steps).
+    eps = 1e-4
+    inside3 = jnp.clip(x0, lo + eps * (hi - lo), hi - eps * (hi - lo))
+    inside1 = jnp.maximum(x0, lo + eps * (1.0 + jnp.abs(lo)))
+    inside2 = jnp.minimum(x0, hi - eps * (1.0 + jnp.abs(hi)))
+    x0 = jnp.where(vary & (kind == 3), inside3, x0)
+    x0 = jnp.where(vary & (kind == 1), inside1, x0)
+    x0 = jnp.where(vary & (kind == 2), inside2, x0)
+    # frozen params still need finite internal coordinates
+    x0 = jnp.where(~vary & (kind == 3),
+                   jnp.clip(x0, lo, hi), x0)
+    x0 = jnp.where(~vary & (kind == 1), jnp.maximum(x0, lo), x0)
+    x0 = jnp.where(~vary & (kind == 2), jnp.minimum(x0, hi), x0)
+    return _lm_core(resid_fn, tuple(aux), x0, lo, hi, kind, vary,
+                    max_iter=max_iter, ftol=ftol)

@@ -491,6 +491,19 @@ def _fit_portrait_core(
     )
 
 
+def derive_use_scatter(fit_flags, log10_tau, theta0):
+    """True when the scattering kernel must be active: tau/alpha fitted,
+    log10 parameterization (tau = 10^theta3 > 0 always), or a fixed
+    nonzero tau seeded in theta0."""
+    import numpy as np
+
+    if bool(fit_flags[3]) or bool(fit_flags[4]) or log10_tau:
+        return True
+    if theta0 is not None:
+        return bool(np.any(np.asarray(theta0)[..., 3] != 0.0))
+    return False
+
+
 def make_weights(noise_stds, nbin, chan_mask=None, dtype=None):
     """w_nk = chan_mask_n / sigma_F,n^2, DC harmonic scaled by F0_fact.
 
@@ -602,15 +615,11 @@ def fit_portrait_batch(
     use_scatter: None -> derived from fit_flags/log10_tau/theta0 (a
     fixed nonzero tau in theta0 must still be applied to the model).
     """
-    import numpy as np
-
     ports = jnp.asarray(ports)
     nb = ports.shape[0]
     nbin = ports.shape[-1]
     if use_scatter is None:
-        use_scatter = bool(fit_flags[3]) or bool(fit_flags[4]) or log10_tau
-        if not use_scatter and theta0 is not None:
-            use_scatter = bool(np.any(np.asarray(theta0)[..., 3] != 0.0))
+        use_scatter = derive_use_scatter(fit_flags, log10_tau, theta0)
     w = make_weights(noise_stds, nbin, chan_masks, dtype=ports.dtype)
     dFT = jnp.fft.rfft(ports, axis=-1)
     mFT = jnp.fft.rfft(jnp.asarray(models).astype(ports.dtype), axis=-1)

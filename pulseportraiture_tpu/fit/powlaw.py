@@ -46,8 +46,16 @@ def powlaw_freqs(lo, hi, N, alpha):
     return (np.linspace(lo**a1, hi**a1, N + 1)) ** (1.0 / a1)
 
 
-@partial(jax.jit, static_argnames=("max_iter",))
-def _fit_powlaw_core(ys, errs, nu_ref, freqs, max_iter=30):
+def _powlaw_resid(theta, ys, sqrtw, x):
+    return (ys - theta[0] * jnp.exp(theta[1] * x)) * sqrtw
+
+
+def _fit_powlaw_core(ys, errs, nu_ref, freqs):
+    """Weighted log-space init + damped LM (fit/lm.py).  The LM engine
+    already scales the covariance by red-chi2, matching lmfit's default
+    scale_covar=True that the reference relies on (pplib.py:1841-1880)."""
+    from .lm import levenberg_marquardt
+
     dt = ys.dtype
     w = jnp.where(errs > 0, errs**-2.0, 0.0)
     x = jnp.log(freqs / nu_ref)
@@ -64,28 +72,12 @@ def _fit_powlaw_core(ys, errs, nu_ref, freqs, max_iter=30):
     det = Sw * Sxx - Sx**2.0
     det = jnp.where(jnp.abs(det) > 0, det, 1.0)
     alpha0 = (Sw * Sxy - Sx * Sy) / det
-    lnA0 = (Sxx * Sy - Sx * Sxy) / det
+    lnA0 = jnp.clip((Sxx * Sy - Sx * Sxy) / det, -300.0, 300.0)
     theta0 = jnp.array([jnp.exp(lnA0), alpha0], dt)
 
-    def resid(theta):
-        return (ys - theta[0] * jnp.exp(theta[1] * x)) * jnp.sqrt(w)
-
-    def body(i, theta):
-        r = resid(theta)
-        J = jax.jacfwd(resid)(theta)
-        JTJ = J.T @ J + 1e-12 * jnp.eye(2, dtype=dt)
-        step = jnp.linalg.solve(JTJ, J.T @ r)
-        return theta - step
-
-    theta = jax.lax.fori_loop(0, max_iter, body, theta0)
-    r = resid(theta)
-    J = jax.jacfwd(resid)(theta)
-    chi2 = jnp.sum(r**2.0)
-    # scale covariance by red-chi2, matching lmfit's default
-    # scale_covar=True that the reference relies on (pplib.py:1841-1880)
-    red = chi2 / jnp.maximum(ys.shape[0] - 2.0, 1.0)
-    cov = jnp.linalg.inv(J.T @ J + 1e-30 * jnp.eye(2, dtype=dt)) * red
-    return theta, cov, chi2
+    res = levenberg_marquardt(_powlaw_resid, theta0,
+                              aux=(ys, jnp.sqrt(w), x), max_iter=100)
+    return res.x, res.cov, res.chi2
 
 
 def fit_powlaw(data, init_params=None, errs=None, nu_ref=None, freqs=None):

@@ -89,7 +89,9 @@ def find_significant_eigvec(eigvec, check_max=10, return_max=10,
     """
     eigvec = np.asarray(eigvec)
     nbin = eigvec.shape[0]
-    ncheck = min(max(check_max, return_max), eigvec.shape[1])
+    # the loop below never examines candidates past check_max, so only
+    # smooth that many (smoothing is the expensive step)
+    ncheck = min(check_max, eigvec.shape[1])
     # smooth all candidates at once on device
     cands = eigvec.T[:ncheck]
     smoothed = np.asarray(smart_smooth(cands, **kwargs))

@@ -223,10 +223,18 @@ def _smooth_score_grid(prof, nlevel, threshtype="hard", N=8, nfact=30,
         snr = jnp.where(noise > 0.0, sig / jnp.where(noise > 0, noise, 1.0),
                         jnp.inf)
         snr = jnp.where(sig > 0.0, snr, 0.0)
-        # red chi2 of data vs smooth, noise from the data profile
+        # red chi2 of data vs smooth, noise from the data profile; a
+        # zero noise estimate means the gate cannot be evaluated ->
+        # treat as failed (inf), never NaN (NaN comparisons would
+        # silently PASS the gate)
         dnoise = get_noise_PS(prof)
-        rchi2 = jnp.sum(((prof - sm) / jnp.maximum(dnoise, 1e-300)) ** 2.0) \
-            / nbin
+        good_noise = dnoise > 0.0
+        rchi2 = jnp.where(
+            good_noise,
+            jnp.sum(((prof - sm) / jnp.where(good_noise, dnoise, 1.0))
+                    ** 2.0) / nbin,
+            jnp.inf,
+        )
         snr = jnp.where(jnp.abs(rchi2 - 1.0) > rchi2_tol, 0.0, snr)
         return snr, sm, rchi2
 

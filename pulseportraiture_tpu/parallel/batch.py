@@ -14,7 +14,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..fit.portrait import FitFlags, _fit_portrait_core, make_weights
+from ..fit.portrait import (FitFlags, _fit_portrait_core, derive_use_scatter,
+                            make_weights)
 from .mesh import batch_sharding
 
 
@@ -52,12 +53,8 @@ def fit_portrait_sharded(
     use jax.device_get to fetch).  use_scatter: None -> derived from
     fit_flags/log10_tau/theta0 so a fixed nonzero tau is not ignored.
     """
-    import numpy as np
-
     if use_scatter is None:
-        use_scatter = bool(fit_flags[3]) or bool(fit_flags[4]) or log10_tau
-        if not use_scatter and theta0 is not None:
-            use_scatter = bool(np.any(np.asarray(theta0)[..., 3] != 0.0))
+        use_scatter = derive_use_scatter(fit_flags, log10_tau, theta0)
     ports = jnp.asarray(ports)
     nb, nchan, nbin = ports.shape
     w = make_weights(noise_stds, nbin, dtype=ports.dtype)

@@ -1,0 +1,138 @@
+"""Tests for the LM engine and Gaussian template fitting.
+
+Oracle (SURVEY.md §4): generate profiles/portraits from known Gaussian
+parameters + noise, fit, assert recovery within uncertainties; bounds
+respected; frozen parameters unchanged.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from pulseportraiture_tpu.fit.gauss import (fit_gaussian_portrait,
+                                            fit_gaussian_profile,
+                                            gen_gaussian_portrait_flat,
+                                            gen_gaussian_profile_flat)
+from pulseportraiture_tpu.fit.lm import levenberg_marquardt
+
+
+def _rosenbrock_resid(x):
+    return jnp.array([10.0 * (x[1] - x[0] ** 2.0), 1.0 - x[0]])
+
+
+def _linear_resid(x, t, y, s):
+    return (y - (x[0] + x[1] * t)) / s
+
+
+class TestLM:
+    def test_rosenbrock(self):
+        res = levenberg_marquardt(_rosenbrock_resid, np.array([-1.2, 1.0]),
+                                  max_iter=200)
+        assert np.allclose(np.asarray(res.x), [1.0, 1.0], atol=1e-6)
+
+    def test_linear_with_errors(self, rng):
+        t = np.linspace(0, 1, 50)
+        y = 2.0 + 3.0 * t + 0.1 * rng.normal(size=50)
+        s = np.full(50, 0.1)
+        res = levenberg_marquardt(_linear_resid, np.zeros(2), aux=(t, y, s))
+        assert abs(float(res.x[0]) - 2.0) < 5 * float(res.x_err[0])
+        assert abs(float(res.x[1]) - 3.0) < 5 * float(res.x_err[1])
+        # analytic errors for weighted linear LS, scaled by red-chi2
+        X = np.stack([np.ones(50), t]).T / 0.1
+        cov = np.linalg.inv(X.T @ X)
+        chi2 = float(res.chi2)
+        scale = chi2 / 48.0
+        assert np.allclose(np.asarray(res.x_err),
+                           np.sqrt(np.diag(cov) * scale), rtol=0.05)
+
+    def test_bounds_respected(self):
+        # minimize (x-2)^2 with x <= 1 -> x -> 1
+        res = levenberg_marquardt(lambda x: x - 2.0, np.array([0.0]),
+                                  upper=np.array([1.0]), max_iter=100)
+        assert float(res.x[0]) <= 1.0 + 1e-8
+        assert float(res.x[0]) > 0.9
+
+    def test_vary_mask_freezes(self):
+        res = levenberg_marquardt(_rosenbrock_resid, np.array([-1.2, 1.0]),
+                                  vary=np.array([False, True]), max_iter=100)
+        assert float(res.x[0]) == -1.2
+        assert float(res.x_err[0]) == 0.0
+
+
+class TestGaussianProfile:
+    def test_recover_two_gaussians(self, rng):
+        nbin = 512
+        truth = np.array([0.05, 0.0, 0.30, 0.04, 1.0, 0.55, 0.02, 0.6])
+        prof = np.asarray(gen_gaussian_profile_flat(truth, nbin))
+        noise = 0.01
+        data = prof + noise * rng.normal(size=nbin)
+        x0 = np.array([0.0, 0.0, 0.28, 0.05, 0.8, 0.57, 0.03, 0.5])
+        res = fit_gaussian_profile(data, x0, noise)
+        assert res.red_chi2 < 1.5
+        # locations recovered well within a bin
+        assert abs(res.fitted_params[2] - 0.30) < 2.0 / nbin
+        assert abs(res.fitted_params[5] - 0.55) < 2.0 / nbin
+        assert abs(res.fitted_params[4] - 1.0) < 0.05
+        # tau frozen at 0 without fit_scattering
+        assert res.fitted_params[1] == 0.0
+
+    def test_recover_scattering(self, rng):
+        nbin = 512
+        truth = np.array([0.0, 12.0, 0.5, 0.03, 1.0])
+        prof = np.asarray(gen_gaussian_profile_flat(truth, nbin))
+        data = prof + 0.005 * rng.normal(size=nbin)
+        x0 = np.array([0.0, 2.0, 0.49, 0.035, 0.9])
+        res = fit_gaussian_profile(data, x0, 0.005, fit_scattering=True)
+        assert abs(res.fitted_params[1] - 12.0) < 1.5
+
+    def test_tau_seeded_at_bound_escapes(self, rng):
+        # regression: a varying parameter starting exactly at its bound
+        # must not be frozen by a zero transform derivative
+        nbin = 512
+        truth = np.array([0.0, 12.0, 0.5, 0.03, 1.0])
+        prof = np.asarray(gen_gaussian_profile_flat(truth, nbin))
+        data = prof + 0.005 * rng.normal(size=nbin)
+        x0 = np.array([0.0, 0.0, 0.49, 0.035, 0.9])  # tau at bound 0
+        res = fit_gaussian_profile(data, x0, 0.005, fit_scattering=True)
+        assert res.fitted_params[1] > 5.0
+        assert res.red_chi2 < 2.0
+
+
+class TestGaussianPortrait:
+    def test_recover_evolving_portrait(self, rng):
+        nchan, nbin = 32, 256
+        freqs = np.linspace(1300.0, 1700.0, nchan)
+        nu_ref = 1500.0
+        # dc, tau, loc, mloc, wid, mwid, amp, mamp (power-law code '000')
+        truth = np.array([0.0, 0.0, 0.45, 0.02, 0.03, -0.3, 1.0, -1.5])
+        port = np.asarray(gen_gaussian_portrait_flat(
+            truth, freqs, nu_ref, nbin, alpha_s=-4.0))
+        noise = 0.01
+        data = port + noise * rng.normal(size=(nchan, nbin))
+        x0 = np.array([0.0, 0.0, 0.44, 0.0, 0.035, 0.0, 0.9, 0.0])
+        flags = np.array([1, 0, 1, 1, 1, 1, 1, 1])
+        res = fit_gaussian_portrait(data, x0, -4.0, np.full(nchan, noise),
+                                    flags, False, freqs, nu_ref)
+        assert res.red_chi2 < 1.5
+        p = res.fitted_params
+        assert abs(p[2] - 0.45) < 2.0 / nbin     # loc
+        assert abs(p[3] - 0.02) < 0.02           # loc evolution index
+        assert abs(p[6] - 1.0) < 0.05            # amp
+        assert abs(p[7] + 1.5) < 0.3             # spectral index
+
+    def test_join_rotation_applied(self):
+        nchan, nbin = 16, 128
+        freqs = np.linspace(1300.0, 1700.0, nchan)
+        theta = np.array([0.0, 0.0, 0.5, 0.0, 0.04, 0.0, 1.0, 0.0])
+        base = np.asarray(gen_gaussian_portrait_flat(
+            theta, freqs, 1500.0, nbin, alpha_s=-4.0))
+        jm = np.zeros((1, nchan), bool)
+        jm[0, :8] = True
+        rot = np.asarray(gen_gaussian_portrait_flat(
+            theta, freqs, 1500.0, nbin, alpha_s=-4.0,
+            join_theta=np.array([[0.1, 0.0]]), join_mask=jm, P=0.003))
+        # unjoined channels identical, joined channels rotated
+        assert np.allclose(rot[8:], base[8:], atol=1e-12)
+        assert not np.allclose(rot[:8], base[:8], atol=1e-3)
+        shift = np.argmax(base[0]) - np.argmax(rot[0])
+        assert abs((shift % nbin) - round(0.1 * nbin)) <= 1 or \
+            abs((-shift % nbin) - round(0.1 * nbin)) <= 1

@@ -152,6 +152,17 @@ class TestPowlaw:
         ]
         assert np.allclose(fluxes, fluxes[0])
 
+    def test_fit_powlaw_noisy_stays_finite(self, rng):
+        # regression: undamped Gauss-Newton diverged to NaN on low-S/N
+        # data with negative fluxes
+        freqs = np.linspace(1000.0, 2000.0, 16)
+        truth = powlaw(freqs, 1500.0, 1.0, -1.5)
+        noisy = truth + 1.5 * np.mean(truth) * rng.normal(size=16)
+        res = fit_powlaw(noisy, errs=1.5 * np.mean(truth) * np.ones(16),
+                         nu_ref=1500.0, freqs=freqs)
+        assert np.isfinite(res.amp) and np.isfinite(res.alpha)
+        assert np.isfinite(res.amp_err) and np.isfinite(res.alpha_err)
+
     def test_fit_dm_to_freq_resids(self, rng):
         from pulseportraiture_tpu.config import Dconst
 
