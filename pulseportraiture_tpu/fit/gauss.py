@@ -25,8 +25,7 @@ import numpy as np
 from ..config import Dconst, wid_max
 from ..ops.gaussian import gaussian_profile_FT
 from ..ops.phasor import cexp
-from ..ops.scattering import (scattering_portrait_FT, scattering_profile_FT,
-                              scattering_times)
+from ..ops.scattering import scattering_profile_FT
 from ..utils.bunch import DataBunch
 from .lm import levenberg_marquardt
 
@@ -125,23 +124,18 @@ def _portrait_FT_flat(theta, join_theta, alpha_s, freqs, nu_ref, P,
     join_theta: (njoin, 2) of (phase, DM) applied to channels selected
     by join_mask (njoin, nchan); alpha_s: scattering index.
     """
-    from ..models.gaussian import _EVOLUTION
+    from ..models.gaussian import apply_scattering_FT, gaussian_components_FT
 
     nharm = nbin // 2 + 1
-    dc, tau = theta[0], theta[1]
-    locs, mlocs = theta[2::6], theta[3::6]
-    wids, mwids = theta[4::6], theta[5::6]
-    amps, mamps = theta[6::6], theta[7::6]
-    f = freqs[:, None]
-    locs_c = _EVOLUTION[code[0]](locs[None, :], mlocs[None, :], f, nu_ref)
-    wids_c = _EVOLUTION[code[1]](wids[None, :], mwids[None, :], f, nu_ref)
-    amps_c = _EVOLUTION[code[2]](amps[None, :], mamps[None, :], f, nu_ref)
-    gFT = gaussian_profile_FT(nharm, locs_c[..., None], wids_c[..., None],
-                              amps_c[..., None])
-    pFT = jnp.sum(gFT, axis=1)
-    pFT = pFT.at[:, 0].add(dc * nbin)
-    taus = scattering_times(tau / nbin, alpha_s, freqs, nu_ref)
-    pFT = pFT * scattering_portrait_FT(taus, nharm)
+    params = {
+        "dc": theta[0],
+        "locs": theta[2::6], "mlocs": theta[3::6],
+        "wids": theta[4::6], "mwids": theta[5::6],
+        "amps": theta[6::6], "mamps": theta[7::6],
+    }
+    pFT = gaussian_components_FT(params, freqs, nu_ref, nharm, code)
+    # tau in this layout is in bins (the fitter's unit): /nbin -> rotations
+    pFT = apply_scattering_FT(pFT, theta[1] / nbin, alpha_s, freqs, nu_ref)
     if njoin:
         k = jnp.arange(nharm, dtype=freqs.dtype)
         for ij in range(njoin):

@@ -82,6 +82,8 @@ class LMResult(NamedTuple):
 class _LMState(NamedTuple):
     u: jnp.ndarray
     f: jnp.ndarray
+    r: jnp.ndarray   # residual at u (kept so rejected steps don't recompute)
+    J: jnp.ndarray   # Jacobian at u (ditto — the dominant per-step cost)
     lam: jnp.ndarray
     it: jnp.ndarray
     nfev: jnp.ndarray
@@ -99,10 +101,6 @@ def _lm_core(resid_fn, aux, x0, lo, hi, kind, vary, max_iter=100, ftol=1e-10,
     def rfun(u):
         return resid_fn(_to_external(u, lo, hi, kind), *aux)
 
-    def chi2_of(u):
-        r = rfun(u)
-        return jnp.sum(r**2.0)
-
     def jac(u):
         J = jax.jacfwd(rfun)(u)  # (nres, nparam)
         return J * vary[None, :]
@@ -111,10 +109,8 @@ def _lm_core(resid_fn, aux, x0, lo, hi, kind, vary, max_iter=100, ftol=1e-10,
         return jnp.logical_and(s.it < max_iter, jnp.logical_not(s.done))
 
     def body(s):
-        r = rfun(s.u)
-        J = jac(s.u)
-        g = J.T @ r
-        JTJ = J.T @ J
+        g = s.J.T @ s.r
+        JTJ = s.J.T @ s.J
         dJ = jnp.diag(JTJ)
         dJ = jnp.maximum(dJ, 1e-14 * jnp.max(dJ))
         A = JTJ + s.lam * jnp.diag(dJ) + jnp.diag(1.0 - vary)
@@ -124,7 +120,9 @@ def _lm_core(resid_fn, aux, x0, lo, hi, kind, vary, max_iter=100, ftol=1e-10,
         # each element to a generous multiple of its current scale
         smax = 100.0 * (1.0 + jnp.abs(s.u))
         step = jnp.clip(step, -smax, smax)
-        f_new = chi2_of(s.u + step)
+        u_try = s.u + step
+        r_try = rfun(u_try)
+        f_new = jnp.sum(r_try**2.0)
         accept = f_new < s.f
         # converged: accepted near-Newton step (small damping) with
         # negligible relative improvement.  With large lam a small
@@ -135,18 +133,28 @@ def _lm_core(resid_fn, aux, x0, lo, hi, kind, vary, max_iter=100, ftol=1e-10,
         # also converged if the gradient is essentially zero
         gnorm = jnp.max(jnp.abs(g * vary))
         done = jnp.logical_or(done, gnorm < 1e-14 * (s.f + 1.0))
+        u_new = jnp.where(accept, u_try, s.u)
+        # the Jacobian only changes when the step is accepted; a
+        # rejected step reuses the stored one (skipping the dominant
+        # per-iteration cost during lambda adjustment)
+        J_new = jax.lax.cond(accept, jac, lambda _: s.J, u_new)
         return _LMState(
-            u=jnp.where(accept, s.u + step, s.u),
+            u=u_new,
             f=jnp.where(accept, f_new, s.f),
+            r=jnp.where(accept, r_try, s.r),
+            J=J_new,
             lam=jnp.where(accept, s.lam * 0.3, s.lam * 5.0).clip(1e-12, 1e12),
             it=s.it + 1,
             nfev=s.nfev + 1,
             done=done,
         )
 
+    r0 = rfun(u0)
     s0 = _LMState(
         u=u0,
-        f=chi2_of(u0),
+        f=jnp.sum(r0**2.0),
+        r=r0,
+        J=jac(u0),
         lam=jnp.asarray(lam0, dt),
         it=jnp.asarray(0, jnp.int32),
         nfev=jnp.asarray(1, jnp.int32),
@@ -155,8 +163,7 @@ def _lm_core(resid_fn, aux, x0, lo, hi, kind, vary, max_iter=100, ftol=1e-10,
     s = jax.lax.while_loop(cond, body, s0)
 
     # --- covariance in external space, lmfit scale_covar convention ---
-    r = rfun(s.u)
-    J = jac(s.u)
+    r, J = s.r, s.J
     JTJ = J.T @ J + jnp.diag(1.0 - vary)
     cov_u = jnp.linalg.inv(JTJ)
     nres = r.shape[0]

@@ -1,0 +1,33 @@
+"""Data access & formats (SURVEY §2.2 L1): PSRFITS archives without
+PSRCHIVE, model-file formats, TOA/tim writers, telescope codes."""
+
+from .psrfits import (  # noqa: F401
+    Archive,
+    load_data,
+    new_archive,
+    parse_parfile,
+    read_archive,
+    unload_new_archive,
+    write_archive_file,
+)
+from .gmodel import (  # noqa: F401
+    gen_gmodel_portrait,
+    model_from_flat,
+    model_to_flat,
+    read_gmodel,
+    write_gmodel,
+)
+from .splmodel import (  # noqa: F401
+    SplineModel,
+    read_spline_model,
+    spline_model_coords,
+    write_spline_model,
+)
+from .telescopes import telescope_code, telescope_code_dict  # noqa: F401
+from .tim import (  # noqa: F401
+    TOA,
+    filter_TOAs,
+    toa_string,
+    write_princeton_TOAs,
+    write_TOAs,
+)

@@ -1,0 +1,119 @@
+"""Spline (PCA + B-spline) model files.
+
+Two on-disk forms:
+- the reference-compatible pickle `[modelname, source, datafile,
+  mean_prof, eigvec, tck]` (reference ppspline.py:219-244, read at
+  pplib.py:3060-3096), readable/writable for migration;
+- a versioned `.npz` (preferred): same content, no pickle execution
+  risk, forward-compatible via a format-version key.
+
+`SplineModel.portrait(freqs, nbin)` evaluates through the jittable
+B-spline generator (models/spline.py).
+"""
+
+import pickle
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..models.spline import gen_spline_portrait
+
+NPZ_VERSION = 1
+
+
+@dataclass
+class SplineModel:
+    modelname: str
+    source: str
+    datafile: str
+    mean_prof: np.ndarray  # (nbin,)
+    eigvec: np.ndarray     # (nbin, ncomp)
+    tck: tuple             # (t (nknot,), c (ncomp, ncoef), k)
+    extra: dict = field(default_factory=dict)
+
+    @property
+    def nbin(self):
+        return len(self.mean_prof)
+
+    @property
+    def ncomp(self):
+        return self.eigvec.shape[1] if self.eigvec.ndim == 2 else 0
+
+    def freq_range(self):
+        t = np.asarray(self.tck[0], float)
+        return float(t.min()), float(t.max())
+
+    def portrait(self, freqs, nbin=None):
+        """Model portrait at the given frequencies (and optionally a
+        different nbin, via Fourier resampling + half-bin fix)."""
+        return np.asarray(gen_spline_portrait(
+            self.mean_prof, np.atleast_1d(np.asarray(freqs, float)),
+            self.eigvec, self.tck, nbin=nbin))
+
+
+def _normalize_tck(tck):
+    t, c, k = tck
+    t = np.asarray(t, float)
+    c = np.asarray([np.asarray(ci, float) for ci in c]) \
+        if isinstance(c, (list, tuple)) else np.asarray(c, float)
+    if c.ndim == 1:
+        c = c[None]
+    return (t, c, int(k))
+
+
+def write_spline_model(model, filename, quiet=False):
+    """Write a SplineModel; `.spl` extension -> reference-compatible
+    pickle, anything else -> versioned npz."""
+    t, c, k = _normalize_tck(model.tck)
+    if str(filename).endswith(".spl"):
+        payload = [model.modelname, model.source, model.datafile,
+                   np.asarray(model.mean_prof), np.asarray(model.eigvec),
+                   (t, [ci for ci in c], k)]
+        with open(filename, "wb") as f:
+            pickle.dump(payload, f, protocol=2)
+    else:
+        np.savez(
+            filename, format_version=NPZ_VERSION,
+            modelname=model.modelname, source=model.source,
+            datafile=model.datafile,
+            mean_prof=np.asarray(model.mean_prof),
+            eigvec=np.asarray(model.eigvec),
+            tck_t=t, tck_c=c, tck_k=k)
+    if not quiet:
+        print(f"{filename} written.")
+
+
+def read_spline_model(modelfile, quiet=False):
+    """Read either on-disk form -> SplineModel (reference
+    read_spline_model, pplib.py:3060-3096)."""
+    if not quiet:
+        print(f"Reading model from {modelfile}...")
+    name = str(modelfile)
+    if name.endswith((".npz", ".ppspl")):
+        z = np.load(modelfile, allow_pickle=False)
+        return SplineModel(
+            modelname=str(z["modelname"]), source=str(z["source"]),
+            datafile=str(z["datafile"]), mean_prof=z["mean_prof"],
+            eigvec=z["eigvec"],
+            tck=(z["tck_t"], z["tck_c"], int(z["tck_k"])))
+    with open(modelfile, "rb") as f:
+        try:
+            payload = pickle.load(f)
+        except UnicodeDecodeError:
+            f.seek(0)
+            payload = pickle.load(f, encoding="latin1")
+    modelname, source, datafile, mean_prof, eigvec, tck = payload
+    return SplineModel(
+        modelname=str(modelname), source=str(source),
+        datafile=str(datafile), mean_prof=np.asarray(mean_prof, float),
+        eigvec=np.asarray(eigvec, float), tck=_normalize_tck(tck))
+
+
+def spline_model_coords(model, freqs):
+    """Projected curve coordinates at the given frequencies (reference
+    get_spline_model_coords, pplib.py:3099-3123)."""
+    from ..models.spline import bspline_eval
+
+    return np.asarray(bspline_eval(
+        np.atleast_1d(np.asarray(freqs, float)),
+        _normalize_tck(model.tck)))

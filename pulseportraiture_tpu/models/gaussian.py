@@ -96,6 +96,26 @@ def evolved_components(params, freqs, nu_ref, code="000"):
     return locs, wids, amps
 
 
+def gaussian_components_FT(params, freqs, nu_ref, nharm, code="000"):
+    """rFFT (nchan, nharm) of DC + the sum of evolved Gaussian
+    components — the shared spectral-model core used by both the
+    pytree generator below and the flat-layout template fitter
+    (fit/gauss.py)."""
+    locs, wids, amps = evolved_components(params, freqs, nu_ref, code)
+    nbin = 2 * (nharm - 1)
+    # sum over components of analytic Gaussian FTs: (nchan, ngauss, nharm)
+    gFT = gaussian_profile_FT(nharm, locs[..., None], wids[..., None], amps[..., None])
+    pFT = jnp.sum(gFT, axis=1)
+    return pFT.at[..., 0].add(params["dc"] * nbin)
+
+
+def apply_scattering_FT(pFT, tau_rot, alpha, freqs, nu_ref):
+    """Multiply a model rFFT by the per-channel scattering kernel with
+    tau given in rotations at nu_ref."""
+    taus = scattering_times(tau_rot, alpha, freqs, nu_ref)
+    return pFT * scattering_portrait_FT(taus, pFT.shape[-1])
+
+
 def gen_gaussian_portrait_FT(
     params, freqs, nu_ref, nharm, P=None, code="000", scattered=True
 ):
@@ -105,15 +125,10 @@ def gen_gaussian_portrait_FT(
     tau in ``params`` is in seconds (gmodel convention) and needs P to
     convert to rotations; tau=0 or scattered=False skips scattering.
     """
-    locs, wids, amps = evolved_components(params, freqs, nu_ref, code)
-    nbin = 2 * (nharm - 1)
-    # sum over components of analytic Gaussian FTs: (nchan, ngauss, nharm)
-    gFT = gaussian_profile_FT(nharm, locs[..., None], wids[..., None], amps[..., None])
-    pFT = jnp.sum(gFT, axis=1)
-    pFT = pFT.at[..., 0].add(params["dc"] * nbin)
+    pFT = gaussian_components_FT(params, freqs, nu_ref, nharm, code)
     if scattered and P is not None:
-        taus = scattering_times(params["tau"] / P, params["alpha"], freqs, nu_ref)
-        pFT = pFT * scattering_portrait_FT(taus, nharm)
+        pFT = apply_scattering_FT(pFT, params["tau"] / P, params["alpha"],
+                                  freqs, nu_ref)
     return pFT
 
 

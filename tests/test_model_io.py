@@ -1,0 +1,186 @@
+"""Model-file formats + TOA writer tests.
+
+Oracles: write -> read round-trips preserve parameters exactly
+(text precision for gmodel); the reference's own example.gmodel
+grammar (comments, trailing flag comments) parses; tim lines contain
+the -pp_dm/-pp_dme flags with the documented formatting.
+"""
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.io.gmodel import (
+    gen_gmodel_portrait,
+    model_from_flat,
+    model_to_flat,
+    read_gmodel,
+    write_gmodel,
+)
+from pulseportraiture_tpu.io.splmodel import (
+    SplineModel,
+    read_spline_model,
+    spline_model_coords,
+    write_spline_model,
+)
+from pulseportraiture_tpu.io.tim import (
+    TOA,
+    filter_TOAs,
+    toa_string,
+    write_TOAs,
+)
+from pulseportraiture_tpu.utils.mjd import MJD
+
+
+def _toy_model():
+    return model_from_flat(
+        "TEST_MODEL", "000", 1400.0,
+        [0.001, 0.0,
+         0.25, -0.005, 0.03, -2.0, 5.0, -1.5,
+         0.30, 0.002, 0.015, 1.6, 9.0, -2.0],
+        [1, 0, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1],
+        alpha=-4.0, fit_alpha=0)
+
+
+def test_gmodel_roundtrip(tmp_path):
+    m = _toy_model()
+    path = tmp_path / "m.gmodel"
+    write_gmodel(m, path, quiet=True)
+    back = read_gmodel(path, quiet=True)
+    assert back.name == "TEST_MODEL"
+    assert back.code == "000"
+    assert back.nu_ref == 1400.0
+    assert back.ngauss == 2
+    p0, f0 = model_to_flat(m)
+    p1, f1 = model_to_flat(back)
+    np.testing.assert_allclose(p1, p0, atol=1e-8)
+    np.testing.assert_array_equal(f1, f0)
+    assert back.fit_flags["alpha"] == 0
+
+
+def test_gmodel_reference_grammar(tmp_path):
+    """A file in the exact documented grammar (with comment lines and
+    a trailing '#FIT flag' comment on ALPHA) parses."""
+    text = """#A comment
+MODEL   PSR_TEST
+CODE    010
+
+FREQ    1300.00000
+DC      0.00889801 1
+TAU     0.00000000 1
+ALPHA  -4.000      0  #FIT flag
+
+#COMPNN     LOC   FIT? ...
+COMP01  0.21925557 1  -0.00518501 1   0.04823579 1  -2.08031160 1    5.13274758 1   -1.65717015 1
+COMP02  0.23409622 1  -0.00271530 1   0.01573809 1   1.61520300 1    9.46117549 1   -2.07617616 1
+"""
+    path = tmp_path / "ref.gmodel"
+    path.write_text(text)
+    m = read_gmodel(path, quiet=True)
+    assert m.ngauss == 2
+    assert m.code == "010"
+    assert m.alpha == -4.0
+    assert m.locs[0] == pytest.approx(0.21925557)
+    assert m.mamps[1] == pytest.approx(-2.07617616)
+    port = gen_gmodel_portrait(m, np.arange(128), [1250.0, 1350.0])
+    assert port.shape == (2, 128)
+    assert np.isfinite(port).all()
+
+
+def test_gmodel_portrait_scattering_needs_P(tmp_path):
+    m = _toy_model()
+    m.tau = 1e-4
+    with pytest.raises(ValueError):
+        gen_gmodel_portrait(m, np.arange(64), [1400.0])
+    port = gen_gmodel_portrait(m, np.arange(64), [1400.0], P=0.005)
+    assert np.isfinite(port).all()
+
+
+def test_spline_model_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    nbin, ncomp, ncoef = 64, 2, 7
+    t = np.concatenate([[1200.0] * 4, [1350.0, 1500.0, 1650.0],
+                        [1800.0] * 4])
+    model = SplineModel(
+        modelname="spl_test", source="J0000+0000", datafile="avg.fits",
+        mean_prof=rng.normal(size=nbin),
+        eigvec=rng.normal(size=(nbin, ncomp)),
+        tck=(t, rng.normal(size=(ncomp, ncoef)), 3))
+    for name in ("m.spl", "m.ppspl.npz"):
+        path = tmp_path / name
+        write_spline_model(model, path, quiet=True)
+        back = read_spline_model(path, quiet=True)
+        assert back.modelname == "spl_test"
+        np.testing.assert_allclose(back.mean_prof, model.mean_prof)
+        np.testing.assert_allclose(back.eigvec, model.eigvec)
+        np.testing.assert_allclose(back.tck[0], model.tck[0])
+        np.testing.assert_allclose(back.tck[1], model.tck[1])
+        assert back.tck[2] == 3
+        # evaluation parity between forms
+        freqs = np.linspace(1250.0, 1750.0, 5)
+        np.testing.assert_allclose(back.portrait(freqs),
+                                   model.portrait(freqs), atol=1e-10)
+    coords = spline_model_coords(model, [1400.0, 1500.0])
+    assert coords.shape == (2, ncomp)
+
+
+def test_spline_eval_matches_scipy():
+    import scipy.interpolate as si
+
+    rng = np.random.default_rng(1)
+    x = np.linspace(1200.0, 1800.0, 40)
+    y = np.vstack([np.sin(x / 100.0), np.cos(x / 150.0)])
+    (tck, u), _ = si.splprep([y[0], y[1]], u=x, s=1.0), None
+    model = SplineModel("m", "s", "d", np.zeros(8),
+                        np.zeros((8, 2)), tck)
+    got = spline_model_coords(model, x)
+    want = np.array(si.splev(x, tck)).T
+    np.testing.assert_allclose(got, want, atol=1e-8)
+
+
+def _toy_toas():
+    return [
+        TOA("a.fits", 1450.0, MJD(55000, 0.25), 1.5, "GBT", "1",
+            DM=10.0000005, DM_error=2e-4,
+            flags={"be": "GUPPI", "snr": 50.0, "subint": 0,
+                   "phs": 0.123456789, "flux": 1.23456,
+                   "phi_dm_cov": 1.3e-9}),
+        TOA("b.fits", np.inf, MJD(55001, 0.5), 2.5, "GBT", "1",
+            flags={"snr": 5.0}),
+    ]
+
+
+def test_toa_string_format():
+    toas = _toy_toas()
+    s = toa_string(toas[0])
+    parts = s.split()
+    assert parts[0] == "a.fits"
+    assert parts[1] == "1450.00000000"
+    assert parts[2].startswith("55000.250000")
+    assert "-pp_dm 10.0000005" in s
+    assert "-pp_dme 0.0002000" in s
+    assert "-be GUPPI" in s
+    assert "-subint 0" in s
+    assert "-phs 0.12345679" in s
+    assert "-flux 1.23456" in s
+    assert "-phi_dm_cov 1.3e-09" in s
+    # infinite frequency -> 0.0 MHz (TEMPO2 convention)
+    s2 = toa_string(toas[1])
+    assert s2.split()[1] == "0.00000000"
+
+
+def test_write_and_filter_toas(tmp_path):
+    toas = _toy_toas()
+    out = tmp_path / "t.tim"
+    write_TOAs(toas, outfile=str(out), SNR_cutoff=10.0)
+    lines = out.read_text().strip().splitlines()
+    assert len(lines) == 1  # snr=5 filtered out
+    assert lines[0].startswith("a.fits")
+    # append behavior
+    write_TOAs(toas, outfile=str(out), SNR_cutoff=0.0)
+    assert len(out.read_text().strip().splitlines()) == 3
+    kept, culled = filter_TOAs(toas, "snr", 10.0, ">=",
+                               return_culled=True)
+    assert len(kept) == 1 and len(culled) == 1
+    # unknown flag: pass_unflagged
+    kept = filter_TOAs(toas, "nosuch", 0, pass_unflagged=True)
+    assert len(kept) == 2
