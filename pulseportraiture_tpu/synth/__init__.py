@@ -1,3 +1,5 @@
-from .fake import default_test_model, fake_portrait, fake_observation
+from .archive import add_scintillation, make_fake_pulsar
+from .fake import default_test_model, fake_observation, fake_portrait
 
-__all__ = ["default_test_model", "fake_portrait", "fake_observation"]
+__all__ = ["add_scintillation", "default_test_model", "fake_observation",
+           "fake_portrait", "make_fake_pulsar"]
