@@ -1,0 +1,4 @@
+"""Pipeline / orchestration layer (SURVEY §2.2 L4): TOA measurement,
+align-and-average, template building, channel zapping."""
+
+from .toas import GetTOAs  # noqa: F401

@@ -1,0 +1,157 @@
+"""GetTOAs end-to-end: fake archives with known injected dDMs ->
+wideband TOAs recover them (the reference's examples/example.py
+verification flow, SURVEY §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.io import write_gmodel
+from pulseportraiture_tpu.io.tim import write_TOAs
+from pulseportraiture_tpu.pipeline import GetTOAs
+from pulseportraiture_tpu.synth import default_test_model, make_fake_pulsar
+from pulseportraiture_tpu.utils.mjd import MJD
+
+PAR = {"PSR": "J1744-1134", "RAJ": "17:44:29.4", "DECJ": "-11:34:54.6",
+       "P0": 0.004074, "PEPOCH": 55000.0, "DM": 3.139}
+DDMS = [2e-4, -3e-4, 4e-4]
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    root = tmp_path_factory.mktemp("toas")
+    model = default_test_model(1500.0)
+    gmodel = str(root / "model.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files = []
+    for i, dDM in enumerate(DDMS):
+        path = str(root / f"fake-{i}.fits")
+        make_fake_pulsar(model, PAR, outfile=path, nsub=3, nchan=32,
+                         nbin=256, nu0=1500.0, bw=800.0, tsub=60.0,
+                         phase=0.0, dDM=dDM,
+                         start_MJD=MJD(55100 + 10 * i, 0.1),
+                         noise_stds=0.08, dedispersed=False, quiet=True,
+                         rng=100 + i)
+        files.append(path)
+    meta = root / "meta.txt"
+    meta.write_text("\n".join(files) + "\n")
+    return str(meta), gmodel, files
+
+
+def test_metafile_and_gmodel_dispatch(dataset):
+    meta, gmodel, files = dataset
+    gt = GetTOAs(meta, gmodel, quiet=True)
+    assert gt.datafiles == files
+    assert gt.model.kind == "gmodel"
+
+
+def test_get_toas_recovers_injected_ddms(dataset):
+    meta, gmodel, files = dataset
+    gt = GetTOAs(meta, gmodel, quiet=True)
+    gt.get_TOAs(quiet=True)
+    assert len(gt.order) == 3
+    assert len(gt.TOA_list) == 9  # 3 archives x 3 subints
+    for i, dDM in enumerate(DDMS):
+        # injected dDM recovered within 4 sigma and 2e-4 absolute
+        assert gt.DeltaDM_means[i] == pytest.approx(
+            dDM, abs=max(4 * gt.DeltaDM_errs[i], 2e-4))
+        ok = gt.ok_isubs[i]
+        assert np.all(np.isfinite(gt.phis[i][ok]))
+        assert np.all(gt.snrs[i][ok] > 20)
+        assert np.all(np.asarray(gt.rcs[i])[ok] >= 0)
+    # the recovered phase at nu_DM is the dispersive delay of the
+    # injected total DM at nu_DM minus the folding alignment at nu0
+    # (the data is dispersed; injected achromatic phase was 0)
+    from pulseportraiture_tpu.config import Dconst
+
+    P = PAR["P0"]
+    for i, dDM in enumerate(DDMS):
+        ok = gt.ok_isubs[i]
+        for isub in ok:
+            nu_DM = gt.nu_refs[i][isub][0]
+            expect = (Dconst * (PAR["DM"] + dDM) * nu_DM ** -2.0 / P
+                      - Dconst * PAR["DM"] * 1500.0 ** -2.0 / P)
+            expect = ((expect + 0.5) % 1.0) - 0.5
+            got = gt.phis[i][isub]
+            diff = ((got - expect + 0.5) % 1.0) - 0.5
+            assert abs(diff) < 2e-3, (i, isub, got, expect)
+
+
+def test_toa_flags_and_tim_output(dataset, tmp_path):
+    meta, gmodel, files = dataset
+    gt = GetTOAs(files[0], gmodel, quiet=True)
+    gt.get_TOAs(quiet=True, print_phase=True,
+                addtnl_toa_flags={"pta": "TEST"})
+    toa = gt.TOA_list[0]
+    for key in ("be", "fe", "f", "nbin", "nch", "nchx", "bw", "chbw",
+                "subint", "tobs", "fratio", "tmplt", "snr", "gof",
+                "phs", "phs_err", "pta", "phi_DM_cov"):
+        assert key in toa.flags, key
+    assert toa.DM is not None and toa.DM_error is not None
+    assert toa.flags["nchx"] == 32
+    out = tmp_path / "out.tim"
+    write_TOAs(gt.TOA_list, outfile=str(out), append=False)
+    lines = out.read_text().strip().splitlines()
+    assert len(lines) == 3
+    assert "-pp_dm " in lines[0] and "-pta TEST" in lines[0]
+
+
+def test_one_dm(dataset):
+    meta, gmodel, files = dataset
+    gt = GetTOAs(files[1], gmodel, quiet=True)
+    gt.get_TOAs(quiet=True)
+    gt.apply_one_DM()
+    dms = {t.DM for t in gt.TOA_list}
+    assert len(dms) == 1
+    assert list(dms)[0] == pytest.approx(gt.DM0s[0] + gt.DeltaDM_means[0])
+    assert gt.TOA_list[0].flags["one_DM"] == "True"
+
+
+def test_zapped_channels_masked(dataset, tmp_path):
+    """Archives with zapped channels still fit; masked channels get
+    zero scales."""
+    model = default_test_model(1500.0)
+    w = np.ones((2, 32))
+    w[:, :5] = 0.0
+    path = str(tmp_path / "zap.fits")
+    make_fake_pulsar(model, PAR, outfile=path, nsub=2, nchan=32, nbin=256,
+                     tsub=60.0, noise_stds=0.08, weights=w,
+                     dedispersed=False, quiet=True, rng=5)
+    meta, gmodel, files = dataset
+    gt = GetTOAs(path, gmodel, quiet=True)
+    gt.get_TOAs(quiet=True)
+    assert len(gt.TOA_list) == 2
+    assert gt.TOA_list[0].flags["nchx"] == 27
+    assert np.all(gt.scales[0][:, :5] == 0.0)
+    assert np.all(np.isfinite(gt.DMs[0][gt.ok_isubs[0]]))
+
+
+def test_narrowband_toas(dataset):
+    meta, gmodel, files = dataset
+    gt = GetTOAs(files[0], gmodel, quiet=True)
+    gt.get_narrowband_TOAs(quiet=True)
+    assert len(gt.TOA_list) == 3 * 32
+    t = gt.TOA_list[0]
+    assert "chan" in t.flags and t.DM is None
+    assert t.TOA_error < 100.0  # us
+
+
+def test_channels_to_zap_flags_corrupted(dataset, tmp_path):
+    model = default_test_model(1500.0)
+    path = str(tmp_path / "rfi.fits")
+    make_fake_pulsar(model, PAR, outfile=path, nsub=1, nchan=32, nbin=256,
+                     tsub=60.0, noise_stds=0.05, dedispersed=False,
+                     quiet=True, rng=11)
+    # corrupt one channel with junk after generation
+    from pulseportraiture_tpu.io.psrfits import read_archive
+
+    arch = read_archive(path)
+    rng = np.random.default_rng(0)
+    arch.amps[0, 0, 10] += 10.0 * rng.standard_normal(256)
+    arch.unload(path)
+    meta, gmodel, files = dataset
+    gt = GetTOAs(path, gmodel, quiet=True)
+    gt.get_TOAs(quiet=True)
+    zaps = gt.get_channels_to_zap(SNR_threshold=5.0, rchi2_threshold=2.0)
+    assert 10 in zaps[0][0]
+    assert len(zaps[0][0]) <= 4  # does not flag the whole band
