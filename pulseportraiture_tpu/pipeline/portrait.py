@@ -1,0 +1,324 @@
+"""DataPortrait: one (t-scrunched) data portrait + metadata, the base
+object the template builders (gauss/spline) and interactive use share.
+
+Parity target: reference pplib.DataPortrait (pplib.py:155-670),
+including the metafile JOIN path that concatenates archives from
+different receivers into one frequency-sorted portrait with per-join
+(phase, dDM) alignment parameters (pplib.py:163-349).
+
+TPU-first notes: the portrait is small host state (model building is
+offline); heavy math (phase fits, rotations, wavelet smoothing) calls
+into the jitted ops/fit kernels.
+"""
+
+import numpy as np
+
+from ..fit.phase_shift import fit_phase_shift
+from ..fit.powlaw import fit_powlaw
+from ..io.psrfits import load_data, noise_std_ps, unload_new_archive
+from ..ops.rotation import rotate_portrait
+from ..utils.bunch import DataBunch
+from .toas import _is_metafile, _read_metafile
+
+
+def normalize_portrait(port, method="rms", weights=None,
+                       return_norms=False):
+    """Normalize each channel profile (reference pplib.py:2553-2598):
+    'mean' | 'max' | 'prof' (scale vs the weighted mean profile via a
+    phase-shift fit) | 'rms' (unit noise) | 'abs' (unit L2 norm)."""
+    port = np.asarray(port, float)
+    if method not in ("mean", "max", "prof", "rms", "abs"):
+        raise ValueError(f"unknown normalization method {method!r}")
+    norm_port = np.zeros_like(port)
+    norm_vals = np.ones(len(port))
+    if method == "prof":
+        good = np.where(port.sum(axis=1) != 0.0)[0]
+        w = np.ones(len(good)) if weights is None \
+            else np.asarray(weights)[good]
+        mean_prof = np.average(port[good], axis=0, weights=w)
+    for ichan in range(len(port)):
+        if not port[ichan].any():
+            continue
+        if method == "mean":
+            norm = port[ichan].mean()
+        elif method == "max":
+            norm = port[ichan].max()
+        elif method == "prof":
+            norm = float(fit_phase_shift(port[ichan], mean_prof).scale)
+        elif method == "rms":
+            norm = float(noise_std_ps(port[ichan]))
+        else:
+            norm = float(np.sqrt((port[ichan] ** 2).sum()))
+        if norm != 0.0:
+            norm_port[ichan] = port[ichan] / norm
+            norm_vals[ichan] = norm
+    return (norm_port, norm_vals) if return_norms else norm_port
+
+
+class DataPortrait:
+    """Load one archive — or a metafile of archives from different
+    receivers (JOIN path) — into a t/p-scrunched portrait ready for
+    template building."""
+
+    def __init__(self, datafile=None, joinfile=None, quiet=False,
+                 **load_data_kwargs):
+        self.datafile = datafile
+        self.joinfile = joinfile
+        self.norm_values = None
+        self.joins = []
+        load_data_kwargs.setdefault("tscrunch", True)
+        load_data_kwargs.setdefault("pscrunch", True)
+        load_data_kwargs.setdefault("dedisperse", True)
+        if isinstance(datafile, str) and _is_metafile(datafile):
+            self._init_join(datafile, quiet, load_data_kwargs)
+        else:
+            self._init_single(datafile, quiet, load_data_kwargs)
+        if joinfile:
+            self.apply_joinfile(joinfile, quiet=quiet)
+
+    # -- construction ------------------------------------------------------
+    def _unpack(self, d):
+        self.data = d
+        self.source = d.source
+        self.nbin = d.nbin
+        self.phases = d.phases
+        self.nu0 = d.nu0
+        self.bw = d.bw
+        self.Ps = np.atleast_1d(np.asarray(d.Ps))
+        self.freqs = np.atleast_2d(np.asarray(d.freqs))
+        self.port = np.asarray(d.subints[0, 0], float)
+        self.weights = np.asarray(d.weights[0], float)
+        self.noise_stds = np.asarray(d.noise_stds[0, 0], float)
+        self.SNRs = np.asarray(d.SNRs[0, 0], float)
+        self.ok_ichans = np.asarray(d.ok_ichans[0], int)
+        self._condense()
+
+    def _condense(self):
+        """x-suffixed views keep only unzapped channels (reference
+        convention); masks keep the full arrays static elsewhere."""
+        okc = self.ok_ichans
+        self.portx = self.port[okc]
+        self.freqsxs = [self.freqs[0][okc]]
+        self.noise_stdsxs = [self.noise_stds[okc]]
+        self.SNRsxs = [self.SNRs[okc]]
+
+    def _init_single(self, datafile, quiet, kwargs):
+        d = load_data(datafile, quiet=quiet, **kwargs)
+        self._unpack(d)
+
+    def _init_join(self, metafile, quiet, kwargs):
+        """Concatenate archives across receivers, sorted by frequency;
+        per-archive (phase, dDM) JOIN parameters seeded by mean-profile
+        phase fits against the first archive (pplib.py:163-315)."""
+        paths = _read_metafile(metafile)
+        datas = [load_data(p, quiet=quiet, **kwargs) for p in paths]
+        nbin = datas[0].nbin
+        for d in datas[1:]:
+            if d.nbin != nbin:
+                raise ValueError("JOIN archives must share nbin")
+        ports = [np.asarray(d.subints[0, 0], float) for d in datas]
+        freqs = np.concatenate([np.asarray(d.freqs[0]) for d in datas])
+        order = np.argsort(freqs)
+        inv = np.empty_like(order)
+        inv[order] = np.arange(len(order))
+        # bookkeeping: channel indices of each archive in sorted port
+        self.join_params = []
+        self.join_fit_flags = []
+        self.join_ichans = []
+        start = 0
+        ref_prof = ports[0].mean(axis=0)
+        for iarch, d in enumerate(datas):
+            n = ports[iarch].shape[0]
+            self.join_ichans.append(np.sort(inv[start:start + n]))
+            start += n
+            if iarch == 0:
+                phase_guess = 0.0
+            else:
+                r = fit_phase_shift(ports[iarch].mean(axis=0), ref_prof)
+                phase_guess = float(r.phase)
+            # (phase, dDM) per join; first archive is the fixed anchor
+            self.join_params.extend([phase_guess, 0.0])
+            self.join_fit_flags.extend(
+                [0, 0] if iarch == 0 else [1, 1])
+            self.joins.append(paths[iarch])
+        d0 = datas[0]
+        port = np.concatenate(ports, axis=0)[order]
+        self.data = d0
+        self.source = d0.source
+        self.nbin = nbin
+        self.phases = d0.phases
+        self.Ps = np.atleast_1d(np.asarray(d0.Ps))
+        all_freqs = freqs[order]
+        self.freqs = all_freqs[None, :]
+        self.nu0 = float(all_freqs.mean())
+        self.bw = float(all_freqs.max() - all_freqs.min())
+        self.port = port
+        self.weights = np.concatenate(
+            [np.asarray(d.weights[0]) for d in datas])[order]
+        self.noise_stds = np.concatenate(
+            [np.asarray(d.noise_stds[0, 0]) for d in datas])[order]
+        self.SNRs = np.concatenate(
+            [np.asarray(d.SNRs[0, 0]) for d in datas])[order]
+        self.ok_ichans = np.where(self.weights > 0)[0]
+        self._condense()
+
+    # -- transforms --------------------------------------------------------
+    def normalize_portrait(self, method="rms"):
+        """In-place channel normalization; remembers the values so
+        unnormalize_portrait can restore (pplib.py:379-420)."""
+        self.port, norms = normalize_portrait(
+            self.port, method, weights=self.weights, return_norms=True)
+        self.norm_values = norms
+        self.norm_method = method
+        self.noise_stds = np.where(norms != 0.0,
+                                   self.noise_stds / norms,
+                                   self.noise_stds)
+        self._condense()
+        return norms
+
+    def unnormalize_portrait(self):
+        if self.norm_values is None:
+            raise RuntimeError("portrait was not normalized")
+        self.port = self.port * self.norm_values[:, None]
+        self.noise_stds = self.noise_stds * self.norm_values
+        self.norm_values = None
+        self._condense()
+
+    def smooth_portrait(self, **kwargs):
+        """Wavelet-denoise every channel profile (pplib.py:422-446)."""
+        from ..models.wavelet import wavelet_smooth
+
+        self.port = np.asarray(wavelet_smooth(self.port, **kwargs))
+        self._condense()
+
+    def fit_flux_profile(self, guessA=1.0, guessalpha=0.0, plot=False,
+                         savefig=None, quiet=True):
+        """Power-law fit to the phase-averaged flux vs frequency
+        (pplib.py:448-506)."""
+        okc = self.ok_ichans
+        fluxes = self.port[okc].mean(axis=1)
+        flux_errs = self.noise_stds[okc] / np.sqrt(self.nbin)
+        flux_errs = np.where(flux_errs > 0, flux_errs, 1.0)
+        freqs = self.freqs[0][okc]
+        res = fit_powlaw(fluxes, init_params=[guessA, guessalpha],
+                         errs=flux_errs, nu_ref=self.nu0, freqs=freqs)
+        self.flux_fit = res
+        if plot:
+            from ..viz.plots import plot_flux_profile
+
+            plot_flux_profile(freqs, fluxes, flux_errs, res, self.nu0,
+                              savefig=savefig)
+        if not quiet:
+            print(f"flux spectral index alpha = {float(res.alpha):.3f} "
+                  f"+/- {float(res.alpha_err):.3f}")
+        return res
+
+    def rotate_stuff(self, phase=0.0, DM=0.0, ichans=None, nu_ref=None,
+                     model=False):
+        """Coherently rotate the data (or model) portrait and any
+        derived products (pplib.py:545-592)."""
+        P = float(self.Ps[0])
+        if nu_ref is None:
+            nu_ref = self.nu0
+        if ichans is None:
+            ichans = np.arange(self.port.shape[0])
+        ichans = np.asarray(ichans, int)
+        freqs = self.freqs[0][ichans]
+        if not model:
+            self.port[ichans] = np.asarray(rotate_portrait(
+                self.port[ichans], phase, DM, P, freqs, nu_ref))
+            for attr in ("prof", "mean_prof"):
+                if hasattr(self, attr):
+                    setattr(self, attr, np.asarray(rotate_portrait(
+                        getattr(self, attr)[None], phase))[0])
+            if hasattr(self, "eigvec"):
+                self.eigvec = np.asarray(rotate_portrait(
+                    self.eigvec.T, phase)).T
+            self._condense()
+        elif hasattr(self, "model"):
+            self.model[ichans] = np.asarray(rotate_portrait(
+                self.model[ichans], phase, DM, P, freqs, nu_ref))
+            if hasattr(self, "modelx"):
+                self.modelx = self.model[self.ok_ichans]
+            if hasattr(self, "smooth_mean_prof"):
+                self.smooth_mean_prof = np.asarray(rotate_portrait(
+                    self.smooth_mean_prof[None], phase))[0]
+            if hasattr(self, "smooth_eigvec"):
+                self.smooth_eigvec = np.asarray(rotate_portrait(
+                    self.smooth_eigvec.T, phase)).T
+
+    # -- JOIN persistence --------------------------------------------------
+    def write_join_parameters(self, outfile, quiet=False):
+        """Persist JOIN (phase, dDM) pairs (pplib.py:508-543)."""
+        with open(outfile, "w") as f:
+            for iarch, path in enumerate(self.joins):
+                phi, dDM = self.join_params[2 * iarch: 2 * iarch + 2]
+                f.write(f"{path} {phi:+.8f} {dDM:+.8f}\n")
+        if not quiet:
+            print(f"{outfile} written.")
+
+    def apply_joinfile(self, joinfile, quiet=False):
+        """Rotate each join's channels by persisted (phase, dDM)
+        (pplib.py:351-377)."""
+        with open(joinfile) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) < 3:
+                    continue
+                path, phi, dDM = parts[0], float(parts[1]), float(parts[2])
+                if path in self.joins:
+                    iarch = self.joins.index(path)
+                    self.rotate_stuff(phase=phi, DM=dDM,
+                                      ichans=self.join_ichans[iarch])
+                    self.join_params[2 * iarch] = phi
+                    self.join_params[2 * iarch + 1] = dDM
+        if not quiet:
+            print(f"Applied {joinfile}.")
+
+    # -- output ------------------------------------------------------------
+    def unload_archive(self, outfile, quiet=False):
+        """Write the (possibly transformed) portrait back to a PSRFITS
+        file via the archive cloning path (pplib.py:594-616)."""
+        arch = self.data.arch
+        if arch is None:
+            from ..io.psrfits import read_archive
+
+            arch = read_archive(self.datafile)
+        unload_new_archive(self.port[None, None], arch, outfile,
+                           DM=self.data.DM, dmc=1,
+                           weights=self.weights[None], quiet=quiet)
+
+    def write_model_archive(self, outfile, quiet=False):
+        """Write the model portrait as an archive (pplib.py:618-636)."""
+        if not hasattr(self, "model"):
+            raise RuntimeError("no model built yet")
+        arch = self.data.arch
+        if arch is None:
+            from ..io.psrfits import read_archive
+
+            arch = read_archive(self.datafile)
+        unload_new_archive(np.asarray(self.model)[None, None], arch,
+                           outfile, DM=0.0, dmc=1,
+                           weights=np.ones_like(self.weights)[None],
+                           quiet=quiet)
+
+    # -- plotting ----------------------------------------------------------
+    def show_data_portrait(self, **kwargs):
+        from ..viz.plots import show_portrait
+
+        show_portrait(self.port * (self.weights > 0)[:, None],
+                      self.phases, self.freqs[0], **kwargs)
+
+    def show_model_portrait(self, **kwargs):
+        from ..viz.plots import show_portrait
+
+        show_portrait(np.asarray(self.model), self.phases, self.freqs[0],
+                      **kwargs)
+
+    def show_model_fit(self, **kwargs):
+        from ..viz.plots import show_residual_plot
+
+        show_residual_plot(self.port, np.asarray(self.model),
+                           self.phases, self.freqs[0],
+                           noise_stds=self.noise_stds,
+                           weights=self.weights, **kwargs)

@@ -1,0 +1,98 @@
+"""Channel-zap proposals and application (ppzap equivalent).
+
+Parity target: reference ppzap.py:24-104.  Two paths, as in the
+reference CLI: the model-less median algorithm on per-channel noise
+levels, and the model-based path using GetTOAs red-chi2/S-N cuts
+(pipeline/toas.get_channels_to_zap).  Where the reference only emits
+`paz` shell commands, this module can also apply the zaps directly
+(weight edits through the archive writer) since there is no external
+PSRCHIVE to delegate to.
+"""
+
+import numpy as np
+
+from ..io.psrfits import read_archive
+
+
+def get_zap_channels(data, nstd=3):
+    """Iterative median + nstd*std cut on per-channel noise levels
+    (reference ppzap.py:24-54).  data: a load_data DataBunch.
+    Returns [subint][channel indices]."""
+    zap_channels = []
+    for isub in data.ok_isubs:
+        ichans = list(np.asarray(data.ok_ichans[isub]).copy())
+        zap_ichans = []
+        while len(ichans):
+            noise_stds = data.noise_stds[isub, 0, ichans]
+            median = np.median(noise_stds)
+            std = np.std(noise_stds)
+            bad = list(np.where(noise_stds > median + nstd * std)[0])
+            if not bad:
+                break
+            flagged = [ichans[i] for i in bad]
+            zap_ichans.extend(flagged)
+            for ichan in flagged:
+                ichans.remove(ichan)
+        zap_channels.append(sorted(zap_ichans))
+    return zap_channels
+
+
+def print_paz_cmds(datafiles, zap_list, all_subs=False, modify=True,
+                   outfile=None, quiet=False):
+    """Emit PSRCHIVE `paz` commands for a zap list (reference
+    ppzap.py:57-104) — for users whose downstream tooling is PSRCHIVE.
+    Returns the command lines."""
+    lines = []
+    for iarch, datafile in enumerate(datafiles):
+        count = sum(len(z) for z in zap_list[iarch])
+        if not count:
+            continue
+        if modify:
+            paz_outfile = datafile
+        else:
+            ii = datafile[::-1].find(".")
+            paz_outfile = (datafile + ".zap" if ii < 0
+                           else datafile[:-ii] + "zap")
+            lines.append(f"paz -e zap {datafile}")
+        last = ""
+        for isub, bad_ichans in enumerate(zap_list[iarch]):
+            for bad in bad_ichans:
+                if not all_subs:
+                    lines.append(
+                        f"paz -m -I -z {bad} -w {isub} {paz_outfile}")
+                else:
+                    line = f"paz -m -z {bad} {paz_outfile}"
+                    if line != last:
+                        lines.append(line)
+                    last = line
+    if outfile is not None:
+        with open(outfile, "a") as f:
+            f.write("".join(line + "\n" for line in lines))
+        if not quiet:
+            print(f"Wrote {outfile}.")
+    elif not quiet:
+        for line in lines:
+            print(line)
+    return lines
+
+
+def apply_zaps(datafile, zap_channels, all_subs=False, outfile=None,
+               quiet=False):
+    """Zero the weights of flagged channels directly in the archive —
+    the internal replacement for shelling out to `paz`.
+    zap_channels: [subint][channel indices]."""
+    arch = read_archive(datafile)
+    w = arch.get_weights()
+    for isub, chans in enumerate(zap_channels):
+        if not len(chans):
+            continue
+        if all_subs:
+            w[:, np.asarray(chans, int)] = 0.0
+        elif isub < len(w):
+            w[isub, np.asarray(chans, int)] = 0.0
+    arch.set_weights(w)
+    arch.unload(outfile or datafile)
+    if not quiet:
+        print(f"Zapped {sum(map(len, zap_channels))} channel entries in "
+              f"{outfile or datafile}.")
+    return w

@@ -1,0 +1,12 @@
+"""Host-side matplotlib visualization (SURVEY §2.2 'Visualization')."""
+
+from .plots import (  # noqa: F401
+    plot_flux_profile,
+    set_colormap,
+    show_eigenprofiles,
+    show_portrait,
+    show_profiles,
+    show_residual_plot,
+    show_spline_curve_projections,
+    show_stacked_profiles,
+)

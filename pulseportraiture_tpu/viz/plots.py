@@ -1,0 +1,240 @@
+"""Diagnostic plots.
+
+Parity targets: reference pplib.py:3652-4207 (show_portrait,
+show_stacked_profiles, show_profiles, show_residual_plot,
+show_spline_curve_projections, show_eigenprofiles) and the flux-profile
+plot of fit_flux_profile (pplib.py:448-506).  All host-side matplotlib;
+headless-safe (Agg) unless a display is configured.
+"""
+
+import os
+
+import matplotlib
+
+if not os.environ.get("DISPLAY"):
+    matplotlib.use("Agg", force=False)
+
+import matplotlib.pyplot as plt
+import numpy as np
+
+
+def set_colormap(name="viridis"):
+    """Set the default image colormap (reference pplib.py:677)."""
+    matplotlib.rcParams["image.cmap"] = name
+
+
+def _finish(fig, show, savefig):
+    if savefig:
+        fig.savefig(savefig, bbox_inches="tight", dpi=120)
+        plt.close(fig)
+    elif show:
+        plt.show()
+    return fig
+
+
+def show_portrait(port, phases=None, freqs=None, title=None, prof=True,
+                  fluxprof=True, show=True, savefig=None):
+    """Portrait image with optional average-profile and flux side
+    panels (reference pplib.py:3652-3757)."""
+    port = np.asarray(port)
+    nchan, nbin = port.shape
+    phases = np.asarray(phases) if phases is not None else \
+        (np.arange(nbin) + 0.5) / nbin
+    freqs = np.asarray(freqs) if freqs is not None else np.arange(nchan)
+    grid = (2 if prof else 1, 2 if fluxprof else 1)
+    fig = plt.figure(figsize=(7, 6))
+    gs = fig.add_gridspec(grid[0], grid[1],
+                          width_ratios=[3, 1][: grid[1]],
+                          height_ratios=([1, 3] if prof else [1]),
+                          hspace=0.05, wspace=0.05)
+    ax_im = fig.add_subplot(gs[-1, 0])
+    extent = [phases[0], phases[-1], freqs[0], freqs[-1]]
+    ax_im.imshow(port, aspect="auto", origin="lower", extent=extent)
+    ax_im.set_xlabel("Phase [rot]")
+    ax_im.set_ylabel("Frequency [MHz]")
+    if prof:
+        ax_p = fig.add_subplot(gs[0, 0], sharex=ax_im)
+        ax_p.plot(phases, port.mean(axis=0), "k-", lw=1)
+        ax_p.tick_params(labelbottom=False)
+        ax_p.set_ylabel("Flux")
+        if title:
+            ax_p.set_title(title)
+    elif title:
+        ax_im.set_title(title)
+    if fluxprof:
+        ax_f = fig.add_subplot(gs[-1, 1], sharey=ax_im)
+        ax_f.plot(port.mean(axis=1), freqs, "k-", lw=1)
+        ax_f.tick_params(labelleft=False)
+        ax_f.set_xlabel("Flux")
+    return _finish(fig, show, savefig)
+
+
+def show_stacked_profiles(port, freqs=None, spacing=None, show=True,
+                          savefig=None, title=None):
+    """Vertically offset per-channel profiles (reference
+    pplib.py:3760-3824)."""
+    port = np.asarray(port)
+    nchan, nbin = port.shape
+    if spacing is None:
+        spacing = 1.1 * np.abs(port).max()
+    fig, ax = plt.subplots(figsize=(5, 8))
+    phases = (np.arange(nbin) + 0.5) / nbin
+    for i in range(nchan):
+        ax.plot(phases, port[i] + i * spacing, "k-", lw=0.6)
+    ax.set_xlabel("Phase [rot]")
+    ax.set_yticks([])
+    if freqs is not None:
+        ax.set_ylabel(f"{freqs[0]:.0f}..{freqs[-1]:.0f} MHz (stacked)")
+    if title:
+        ax.set_title(title)
+    return _finish(fig, show, savefig)
+
+
+def show_profiles(profiles, labels=None, show=True, savefig=None,
+                  title=None):
+    """Overlayed profiles (reference pplib.py:3827-3850)."""
+    profiles = np.atleast_2d(np.asarray(profiles))
+    nbin = profiles.shape[-1]
+    phases = (np.arange(nbin) + 0.5) / nbin
+    fig, ax = plt.subplots(figsize=(6, 4))
+    for i, prof in enumerate(profiles):
+        label = labels[i] if labels else None
+        ax.plot(phases, prof, lw=1, label=label)
+    ax.set_xlabel("Phase [rot]")
+    ax.set_ylabel("Flux")
+    if labels:
+        ax.legend()
+    if title:
+        ax.set_title(title)
+    return _finish(fig, show, savefig)
+
+
+def show_residual_plot(port, model, phases=None, freqs=None,
+                       noise_stds=None, weights=None, titles=None,
+                       show=True, savefig=None):
+    """Data / model / residual triptych with a per-channel reduced-chi2
+    histogram (reference pplib.py:3853-3974)."""
+    port = np.asarray(port)
+    model = np.asarray(model)
+    resid = port - model
+    nchan, nbin = port.shape
+    phases = np.asarray(phases) if phases is not None else \
+        (np.arange(nbin) + 0.5) / nbin
+    freqs = np.asarray(freqs) if freqs is not None else np.arange(nchan)
+    extent = [phases[0], phases[-1], freqs[0], freqs[-1]]
+    fig, axes = plt.subplots(2, 2, figsize=(9, 7))
+    panels = [(port, "Data"), (model, "Model"), (resid, "Residuals")]
+    for ax, (img, name) in zip(axes.flat, panels):
+        ax.imshow(img, aspect="auto", origin="lower", extent=extent)
+        ax.set_title(titles[panels.index((img, name))] if titles else name)
+        ax.set_xlabel("Phase [rot]")
+        ax.set_ylabel("Frequency [MHz]")
+    ax = axes.flat[3]
+    if noise_stds is not None:
+        sig = np.where(np.asarray(noise_stds) > 0, noise_stds, np.inf)
+        rchi2 = (resid ** 2).sum(axis=1) / sig ** 2 / max(nbin - 1, 1)
+        if weights is not None:
+            rchi2 = rchi2[np.asarray(weights) > 0]
+        ax.hist(rchi2[np.isfinite(rchi2)], bins=min(30, max(5, nchan // 4)),
+                color="0.3")
+        ax.set_xlabel(r"Channel red-$\chi^2$")
+        ax.set_ylabel("Count")
+    else:
+        ax.axis("off")
+    fig.tight_layout()
+    return _finish(fig, show, savefig)
+
+
+def plot_flux_profile(freqs, fluxes, flux_errs, fit_result, nu_ref,
+                      show=True, savefig=None):
+    """Flux vs frequency with the fitted power law (reference
+    fit_flux_profile plot, pplib.py:448-506)."""
+    fig, ax = plt.subplots(figsize=(6, 4))
+    ax.errorbar(freqs, fluxes, yerr=flux_errs, fmt="k.", ms=4, lw=0.8)
+    grid = np.linspace(min(freqs), max(freqs), 200)
+    A = float(fit_result.amp)
+    alpha = float(fit_result.alpha)
+    ax.plot(grid, A * (grid / nu_ref) ** alpha, "r-", lw=1,
+            label=rf"$\alpha$ = {alpha:.2f}")
+    ax.set_xlabel("Frequency [MHz]")
+    ax.set_ylabel("Flux")
+    ax.legend()
+    return _finish(fig, show, savefig)
+
+
+def show_eigenprofiles(eigvec, smooth_eigvec=None, mean_prof=None,
+                       smooth_mean_prof=None, show=True, savefig=None,
+                       title=None):
+    """Mean profile + significant eigenprofiles, raw and smoothed
+    (reference pplib.py:4126-4207)."""
+    eigvec = np.asarray(eigvec)
+    ncomp = eigvec.shape[1] if eigvec.ndim == 2 else 0
+    nrows = ncomp + (1 if mean_prof is not None else 0)
+    fig, axes = plt.subplots(max(nrows, 1), 1,
+                             figsize=(6, 2 * max(nrows, 1)),
+                             sharex=True, squeeze=False)
+    irow = 0
+    if mean_prof is not None:
+        ax = axes[irow, 0]
+        ax.plot(mean_prof, "k-", lw=0.8, label="mean")
+        if smooth_mean_prof is not None:
+            ax.plot(smooth_mean_prof, "r-", lw=1, label="smoothed")
+        ax.legend(loc="upper right", fontsize=7)
+        irow += 1
+    for icomp in range(ncomp):
+        ax = axes[irow, 0]
+        ax.plot(eigvec[:, icomp], "k-", lw=0.8,
+                label=f"eigvec {icomp}")
+        if smooth_eigvec is not None:
+            ax.plot(np.asarray(smooth_eigvec)[:, icomp], "r-", lw=1)
+        ax.legend(loc="upper right", fontsize=7)
+        irow += 1
+    axes[-1, 0].set_xlabel("Phase bin")
+    if title:
+        axes[0, 0].set_title(title)
+    fig.tight_layout()
+    return _finish(fig, show, savefig)
+
+
+def show_spline_curve_projections(proj, freqs, tck=None, ncoord=None,
+                                  show=True, savefig=None, title=None):
+    """Pairwise projected-coordinate plots + coordinate-vs-frequency
+    with spline curves and knots (reference pplib.py:3977-4123)."""
+    from ..models.spline import bspline_eval
+
+    proj = np.asarray(proj)
+    freqs = np.asarray(freqs)
+    ncomp = proj.shape[1] if ncoord is None else ncoord
+    if tck is not None:
+        grid = np.linspace(freqs.min(), freqs.max(), 256)
+        curve = np.asarray(bspline_eval(grid, tck))
+        knots = np.asarray(tck[0])
+        kin = knots[(knots >= freqs.min()) & (knots <= freqs.max())]
+        knot_vals = np.asarray(bspline_eval(kin, tck)) if len(kin) else None
+    npair = max(ncomp - 1, 0)
+    fig, axes = plt.subplots(1, npair + ncomp,
+                             figsize=(3 * (npair + ncomp), 3),
+                             squeeze=False)
+    icol = 0
+    for i in range(npair):
+        ax = axes[0, icol]
+        ax.plot(proj[:, i], proj[:, i + 1], "k.", ms=3)
+        if tck is not None:
+            ax.plot(curve[:, i], curve[:, i + 1], "r-", lw=1)
+        ax.set_xlabel(f"coord {i}")
+        ax.set_ylabel(f"coord {i + 1}")
+        icol += 1
+    for i in range(ncomp):
+        ax = axes[0, icol]
+        ax.plot(freqs, proj[:, i], "k.", ms=3)
+        if tck is not None:
+            ax.plot(grid, curve[:, i], "r-", lw=1)
+            if knot_vals is not None:
+                ax.plot(kin, knot_vals[:, i], "b|", ms=10)
+        ax.set_xlabel("Frequency [MHz]")
+        ax.set_ylabel(f"coord {i}")
+        icol += 1
+    if title:
+        fig.suptitle(title)
+    fig.tight_layout()
+    return _finish(fig, show, savefig)
