@@ -1,0 +1,380 @@
+"""Evolving Gaussian-component template building (ppgauss equivalent).
+
+Parity target: reference ppgauss.DataPortrait (ppgauss.py:27-379):
+initial per-profile component fit (auto single-Gaussian or interactive
+GaussianSelector), iterative portrait fitting alternating with a
+(phi, DM) convergence check that rotates the data between iterations,
+JOIN un-rotation, and .gmodel/error-file output.
+
+The template fitter is the JAX LM engine (fit/gauss.py); the
+convergence check is the fused-Newton (phi, DM) portrait fit.  The
+interactive GUI lives in viz/selector.py (host matplotlib); the
+auto_gauss path used by headless pipelines is first-class here.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..config import default_model_code, scattering_alpha, wid_max
+from ..fit.gauss import fit_gaussian_portrait, fit_gaussian_profile
+from ..fit.phase_shift import fit_phase_shift
+from ..fit.portrait import FitFlags, fit_portrait
+from ..io.gmodel import model_from_flat, read_gmodel, write_gmodel
+from ..io.psrfits import noise_std_ps
+from ..models.gaussian import gen_gaussian_profile
+from ..ops.phasor import guess_fit_freq
+from ..ops.rotation import rotate_portrait
+from .portrait import DataPortrait as _BasePortrait
+
+
+def profile_to_portrait_params(profile_params):
+    """[dc, tau, (loc, wid, amp)*g] -> [dc, tau, (loc, mloc, wid, mwid,
+    amp, mamp)*g] with zero evolution slopes (ppgauss.py:147-156)."""
+    profile_params = np.asarray(profile_params, float)
+    ngauss = (len(profile_params) - 2) // 3
+    out = np.zeros(2 + 6 * ngauss)
+    out[:2] = profile_params[:2]
+    for ig in range(ngauss):
+        loc, wid, amp = profile_params[2 + 3 * ig: 5 + 3 * ig]
+        out[2 + 6 * ig: 8 + 6 * ig] = [loc, 0.0, wid, 0.0, amp, 0.0]
+    return out
+
+
+class GaussPortrait(_BasePortrait):
+    """DataPortrait specialized with make_gaussian_model (alias
+    `DataPortrait` kept for ppgauss-style scripts)."""
+
+    # -- initial profile fit ----------------------------------------------
+    def select_ref_profile(self, nu_ref=None, bw_ref=None):
+        """Mean profile of the (nu_ref, bw) band slice, or of the whole
+        portrait (ppgauss.py:129-146).  Returns (profile, nu_ref)."""
+        freqs = self.freqs[0]
+        okc = self.ok_ichans
+        if nu_ref is None:
+            prof = self.portx.mean(axis=0)
+            nu_ref = float(freqs[okc].mean())
+        else:
+            bw_ref = bw_ref or abs(self.bw) / 4.0
+            sel = okc[np.abs(freqs[okc] - nu_ref) <= bw_ref / 2.0]
+            if not len(sel):
+                raise ValueError("no unzapped channels in the reference "
+                                 "band slice")
+            prof = self.port[sel].mean(axis=0)
+        return np.asarray(prof, float), float(nu_ref)
+
+    def fit_profile(self, profile=None, tau=0.0, fixscat=True,
+                    auto_gauss=0.0, profile_fit_flags=None, show=True):
+        """Fit Gaussian components to a single profile.  With
+        auto_gauss != 0 (initial width guess [rot]) this runs
+        non-interactively (the reference's auto_gauss path,
+        ppgauss.py:450-487); otherwise it launches the interactive
+        GaussianSelector GUI."""
+        if profile is None:
+            profile, _ = self.select_ref_profile()
+        noise = float(noise_std_ps(profile))
+        if auto_gauss:
+            amp = float(profile.max())
+            wid = float(auto_gauss)
+            first = amp * np.asarray(gen_gaussian_profile(
+                {"dc": 0.0, "locs": np.array([0.5]),
+                 "wids": np.array([wid]), "amps": np.array([1.0]),
+                 "mlocs": np.zeros(1), "mwids": np.zeros(1),
+                 "mamps": np.zeros(1), "tau": 0.0, "alpha": 0.0},
+                len(profile), scattered=False))
+            loc = 0.5 + float(fit_phase_shift(profile, first, noise).phase)
+            loc %= 1.0
+            init = [0.0, tau, loc, wid, amp]
+            fgp = fit_gaussian_profile(
+                profile, init, noise, fit_flags=profile_fit_flags,
+                fit_scattering=not fixscat, quiet=True)
+            self.init_params = np.asarray(fgp.fitted_params)
+            self.init_param_errs = np.asarray(fgp.fit_errs)
+        else:
+            from ..viz.selector import GaussianSelector
+
+            sel = GaussianSelector(profile, noise, tau=tau,
+                                   fixscat=fixscat, show=show)
+            self.init_params = np.asarray(sel.fitted_params)
+            self.init_param_errs = np.asarray(sel.fit_errs)
+        self.ngauss = (len(self.init_params) - 2) // 3
+        return self.init_params
+
+    def auto_fit_profile(self, profile=None, max_ngauss=8, wid0=0.02,
+                         rchi2_tol=0.1, tau=0.0, fixscat=True,
+                         quiet=True):
+        """Iterative multi-component auto fit: add a Gaussian at the
+        residual peak and refit until reduced chi2 is within
+        rchi2_tol of 1 (or adding stops helping).  This is the
+        headless replacement for hand-sketching components in the GUI
+        — the reference's only automatic path is single-Gaussian
+        (ppgauss.py:450-487)."""
+        if profile is None:
+            profile, _ = self.select_ref_profile()
+        profile = np.asarray(profile, float)
+        noise = float(noise_std_ps(profile))
+        nbin = len(profile)
+        params = [0.0, tau]
+        resid = profile.copy()
+        best = None
+        for _ in range(max_ngauss):
+            ipeak = int(np.argmax(resid))
+            params = list(params) + [(ipeak + 0.5) / nbin, wid0,
+                                     max(float(resid[ipeak]), noise)]
+            fgp = fit_gaussian_profile(profile, np.asarray(params), noise,
+                                       fit_scattering=not fixscat,
+                                       quiet=True)
+            red = float(fgp.chi2) / max(int(fgp.dof), 1)
+            if best is None or red < best[0] * 0.99:
+                best = (red, np.asarray(fgp.fitted_params),
+                        np.asarray(fgp.fit_errs))
+                params = list(fgp.fitted_params)
+                resid = np.asarray(fgp.residuals)
+                if red < 1.0 + rchi2_tol:
+                    break
+            else:  # adding components stopped helping
+                break
+        self.init_params = best[1]
+        self.init_param_errs = best[2]
+        self.ngauss = (len(self.init_params) - 2) // 3
+        if not quiet:
+            print(f"auto_fit_profile: {self.ngauss} components, "
+                  f"red chi2 = {best[0]:.2f}")
+        return self.init_params
+
+    # -- the main loop -----------------------------------------------------
+    def make_gaussian_model(self, modelfile=None, ref_prof=(None, None),
+                            tau=0.0, fixloc=False, fixwid=False,
+                            fixamp=False, fixscat=True, fixalpha=True,
+                            scattering_index=scattering_alpha,
+                            model_code=default_model_code, niter=0,
+                            fiducial_gaussian=False, auto_gauss=0.0,
+                            writemodel=False, outfile=None,
+                            writeerrfile=False, errfile=None,
+                            model_name=None, residplot=None, quiet=False):
+        """Fit the evolving-Gaussian portrait model (reference
+        ppgauss.py:62-245; same options).  Returns the fitted
+        GaussianModel."""
+        P = float(self.Ps[0])
+        nbin = self.nbin
+        njoin = len(getattr(self, "join_ichans", []))
+        if modelfile:
+            start_model = read_gmodel(modelfile, quiet=quiet)
+            self.nu_ref = start_model.nu_ref
+            model_code = start_model.code
+            scattering_index = start_model.alpha
+            from ..io.gmodel import model_to_flat
+
+            init_portrait, flat_flags = model_to_flat(start_model)
+            init_portrait = init_portrait.copy()
+            init_portrait[1] *= nbin / P  # tau seconds -> bins
+            self.ngauss = start_model.ngauss
+            model_name = model_name or start_model.name
+        else:
+            profile, nu_ref = self.select_ref_profile(*ref_prof)
+            self.nu_ref = nu_ref
+            if not len(np.atleast_1d(getattr(self, "init_params", []))):
+                self.auto_fit_profile(profile, wid0=auto_gauss or 0.02,
+                                      tau=tau, fixscat=fixscat,
+                                      quiet=quiet)
+            init_portrait = profile_to_portrait_params(self.init_params)
+        model_name = model_name or (str(self.datafile) + ".gmodel")
+
+        # portrait-layout fit flags (ppgauss.py:147-166)
+        ngauss = self.ngauss
+        flags = np.zeros(2 + 6 * ngauss, int)
+        flags[0] = 1                       # dc
+        flags[1] = int(not fixscat)        # tau
+        for ig in range(ngauss):
+            flags[2 + 6 * ig + 0] = 1                  # loc
+            flags[2 + 6 * ig + 1] = int(not fixloc)    # mloc
+            flags[2 + 6 * ig + 2] = 1                  # wid
+            flags[2 + 6 * ig + 3] = int(not fixwid)    # mwid
+            flags[2 + 6 * ig + 4] = 1                  # amp
+            flags[2 + 6 * ig + 5] = int(not fixamp)    # mamp
+        if fiducial_gaussian and ngauss:
+            flags[2 + 1] = 0  # first component's loc evolution fixed
+        self._flags_cache = flags
+
+        join_params = None
+        if njoin:
+            join_params = (self.join_ichans,
+                           np.asarray(self.join_params, float),
+                           np.asarray(self.join_fit_flags, int))
+
+        self.nu_fit = float(guess_fit_freq(jnp.asarray(self.freqsxs[0]),
+                                           jnp.asarray(self.SNRsxs[0])))
+        errs = np.where(self.noise_stds > 0, self.noise_stds,
+                        np.median(self.noise_stds[self.ok_ichans]))
+        x0 = init_portrait
+        self.niter = int(niter)
+        itern = 0
+        converged = False
+        while True:
+            if not quiet:
+                print(f"Fitting Gaussian model portrait... "
+                      f"(iteration {itern})")
+            fgp = fit_gaussian_portrait(
+                self.port[self.ok_ichans], x0, scattering_index,
+                errs[self.ok_ichans], flags, int(not fixalpha),
+                self.freqsxs[0], self.nu_ref, model_code=model_code,
+                join_params=join_params, P=P, quiet=True)
+            self.fitted_params = np.asarray(fgp.fitted_params)
+            self.fit_errs = np.asarray(fgp.fit_errs)
+            self.portrait_red_chi2 = float(fgp.red_chi2)
+            scattering_index = float(fgp.scattering_index)
+            if njoin:
+                self.join_params = list(np.asarray(fgp.join_fit, float))
+            x0 = self.fitted_params
+            self._rebuild_model(model_code, scattering_index, P)
+            converged = self.check_convergence(efac=1.0, quiet=quiet)
+            if writemodel:
+                self.write_model(outfile=outfile, quiet=True)
+            if writeerrfile:
+                self.write_errfile(errfile=errfile, quiet=True)
+            itern += 1
+            if converged or itern > self.niter:
+                break
+            # rotate the *data* by the fitted residual (phi, DM)
+            # (ppgauss.py:198-202)
+            if not njoin:
+                self.rotate_stuff(phase=self.phi, DM=self.DM,
+                                  nu_ref=self.nu_fit)
+
+        # JOIN un-rotation at the end (ppgauss.py:213-231)
+        if njoin:
+            for ii in range(njoin):
+                jic = self.join_ichans[ii]
+                phi_j = self.join_params[2 * ii]
+                dDM_j = self.join_params[2 * ii + 1]
+                self.port[jic] = np.asarray(rotate_portrait(
+                    jnp.asarray(self.port[jic]), -phi_j, -dDM_j, P,
+                    jnp.asarray(self.freqs[0][jic]), self.nu_ref))
+            self._condense()
+
+        self.model_name = model_name
+        self.model_code = model_code
+        self.scattering_index = scattering_index
+        self.gaussian_model = self._to_gmodel(model_name, model_code,
+                                              scattering_index,
+                                              int(not fixalpha), flags, P)
+        if residplot:
+            from ..viz.plots import show_residual_plot
+
+            show_residual_plot(self.port, np.asarray(self.model),
+                               self.phases, self.freqs[0],
+                               noise_stds=self.noise_stds,
+                               weights=self.weights, show=False,
+                               savefig=residplot)
+        if not quiet:
+            resid = self.portx - self.model[self.ok_ichans]
+            print(f"\nResiduals mean: {resid.mean():.2e}")
+            print(f"Residuals std:  {resid.std():.2e}")
+            print(f"Data std:       "
+                  f"{np.median(self.noise_stdsxs[0]):.2e}\n")
+        return self.gaussian_model
+
+    def _rebuild_model(self, model_code, alpha, P):
+        from ..fit.gauss import gen_gaussian_portrait_flat
+
+        self.model = np.asarray(gen_gaussian_portrait_flat(
+            self.fitted_params, jnp.asarray(self.freqs[0]), self.nu_ref,
+            self.nbin, alpha, code=model_code, P=P))
+        self.modelx = self.model[self.ok_ichans]
+
+    def _to_gmodel(self, name, code, alpha, fit_alpha, flags, P):
+        params = self.fitted_params.copy()
+        params[1] *= P / self.nbin  # tau bins -> seconds
+        return model_from_flat(name, code, self.nu_ref, params, flags,
+                               alpha, fit_alpha)
+
+    def check_convergence(self, efac=1.0, quiet=False):
+        """Fit (phi, DM) of the data against the current model:
+        converged when both are within their errors (ppgauss.py:
+        285-341; the reference's None-return defect on the mixed
+        branch is fixed — this always returns a bool)."""
+        portx = self.portx
+        modelx = self.modelx
+        njoin = len(getattr(self, "join_ichans", []))
+        if njoin:
+            portx = portx.copy()
+            modelx = modelx.copy()
+            P = float(self.Ps[0])
+            for ii in range(njoin):
+                jic = self.join_ichans[ii]
+                okpos = np.searchsorted(self.ok_ichans, jic)
+                okpos = okpos[(okpos < len(self.ok_ichans))
+                              & (np.isin(jic, self.ok_ichans))]
+                if not len(okpos):
+                    continue
+                phi_j = self.join_params[2 * ii]
+                dDM_j = self.join_params[2 * ii + 1]
+                fsel = self.freqsxs[0][okpos]
+                portx[okpos] = np.asarray(rotate_portrait(
+                    jnp.asarray(portx[okpos]), -phi_j, -dDM_j, P,
+                    jnp.asarray(fsel), self.nu_ref))
+                modelx[okpos] = np.asarray(rotate_portrait(
+                    jnp.asarray(modelx[okpos]), -phi_j, -dDM_j, P,
+                    jnp.asarray(fsel), self.nu_ref))
+        res = fit_portrait(
+            jnp.asarray(portx), jnp.asarray(modelx),
+            jnp.asarray(self.noise_stdsxs[0]),
+            jnp.asarray(self.freqsxs[0]), float(self.Ps[0]),
+            nu_fit=self.nu_fit, nu_out=self.nu_fit,
+            fit_flags=FitFlags(True, True, False, False, False))
+        self.phi = float(res.phi)
+        self.phierr = float(res.phi_err)
+        self.DM = float(res.DM)
+        self.DMerr = float(res.DM_err)
+        self.red_chi2 = float(res.red_chi2)
+        if not quiet:
+            print(f" phase offset of {self.phi:.2e} +/- "
+                  f"{self.phierr:.2e} [rot]")
+            print(f" DM of {self.DM:.6e} +/- {self.DMerr:.2e} "
+                  f"[cm**-3 pc]")
+            print(f" red. chi**2 of {self.red_chi2:.2f}.")
+        phase_ok = min(abs(self.phi), abs(1 - self.phi)) < \
+            abs(self.phierr) * efac
+        dm_ok = abs(self.DM) < abs(self.DMerr) * efac
+        if phase_ok and dm_ok and not quiet:
+            print("\nIteration converged.\n")
+        return bool(phase_ok and dm_ok)
+
+    # -- output ------------------------------------------------------------
+    def write_model(self, outfile=None, quiet=False):
+        """Write the fitted .gmodel (ppgauss.py:343-361; written after
+        every iteration 'for safety' by make_gaussian_model)."""
+        if not hasattr(self, "fitted_params"):
+            raise RuntimeError("no fitted model yet")
+        outfile = outfile or (str(self.datafile) + ".gmodel")
+        model = self._to_gmodel(
+            getattr(self, "model_name", outfile),
+            getattr(self, "model_code", default_model_code),
+            getattr(self, "scattering_index", scattering_alpha),
+            0, self._current_flags(), float(self.Ps[0]))
+        write_gmodel(model, outfile, quiet=quiet)
+        return outfile
+
+    def write_errfile(self, errfile=None, quiet=False):
+        """Write the parameter errors as a .gmodel-grammar file
+        (ppgauss.py:363-379)."""
+        if not hasattr(self, "fit_errs"):
+            raise RuntimeError("no fitted model yet")
+        errfile = errfile or (str(self.datafile) + ".gmodel_errs")
+        errs = self.fit_errs.copy()
+        errs[1] *= float(self.Ps[0]) / self.nbin
+        model = model_from_flat(
+            getattr(self, "model_name", errfile) + "_errs",
+            getattr(self, "model_code", default_model_code),
+            self.nu_ref, errs, self._current_flags(),
+            getattr(self, "scattering_index", scattering_alpha), 0)
+        write_gmodel(model, errfile, quiet=quiet)
+        return errfile
+
+    def _current_flags(self):
+        n = len(self.fitted_params)
+        return getattr(self, "_flags_cache", np.ones(n, int))
+
+
+# reference ppgauss scripts use the name DataPortrait
+DataPortrait = GaussPortrait

@@ -1,0 +1,170 @@
+"""Template-builder pipelines: ppgauss/ppspline equivalents.
+
+Oracles: models built from noisy synthetic data reproduce the clean
+generating portrait (residuals at the noise level); built templates
+feed back into GetTOAs and recover injections (the full reference
+workflow example.py: align -> model -> TOAs)."""
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.io.gmodel import gen_gmodel_portrait, read_gmodel
+from pulseportraiture_tpu.io.splmodel import read_spline_model
+from pulseportraiture_tpu.pipeline.gauss import (
+    GaussPortrait,
+    profile_to_portrait_params,
+)
+from pulseportraiture_tpu.pipeline.spline import SplinePortrait
+from pulseportraiture_tpu.synth import default_test_model, make_fake_pulsar
+from pulseportraiture_tpu.utils.mjd import MJD
+
+PAR = {"PSR": "J1909-3744", "RAJ": "19:09:47.4", "DECJ": "-37:44:14.5",
+       "P0": 0.002947, "PEPOCH": 55000.0, "DM": 10.391}
+
+
+@pytest.fixture(scope="module")
+def avg_file(tmp_path_factory):
+    """A high-S/N 'average' archive (the template-building input)."""
+    root = tmp_path_factory.mktemp("models")
+    model = default_test_model(1500.0)
+    path = str(root / "avg.fits")
+    make_fake_pulsar(model, PAR, outfile=path, nsub=1, nchan=48, nbin=256,
+                     nu0=1500.0, bw=800.0, tsub=1800.0, noise_stds=0.01,
+                     dedispersed=True, start_MJD=MJD(55200, 0.3),
+                     quiet=True, rng=21)
+    return path, model
+
+
+def test_gauss_model_recovery(avg_file, tmp_path):
+    path, truth = avg_file
+    dp = GaussPortrait(path, quiet=True)
+    gm = dp.make_gaussian_model(ref_prof=(1500.0, 200.0), niter=3,
+                                auto_gauss=0.02, quiet=True)
+    # fitted model portrait ~ clean generating portrait
+    clean = np.asarray(gen_gmodel_portrait(truth, dp.phases, dp.freqs[0],
+                                           P=float(dp.Ps[0])))
+    resid = dp.model - clean
+    assert np.sqrt((resid ** 2).mean()) < 0.05  # ~5x noise, multi-comp
+    assert dp.portrait_red_chi2 < 2.0
+    # round-trip to disk and back into a portrait generator
+    out = str(tmp_path / "fit.gmodel")
+    dp.model_name = "TEST_FIT"
+    dp.write_model(out, quiet=True)
+    back = read_gmodel(out, quiet=True)
+    assert back.ngauss == dp.ngauss
+    port = gen_gmodel_portrait(back, dp.phases, dp.freqs[0],
+                               P=float(dp.Ps[0]))
+    np.testing.assert_allclose(port, dp.model, atol=2e-4)
+    err_out = dp.write_errfile(str(tmp_path / "fit.gmodel_errs"),
+                               quiet=True)
+    errs = read_gmodel(err_out, quiet=True)
+    assert errs.ngauss == dp.ngauss
+
+
+def test_gauss_resume_from_modelfile(avg_file, tmp_path):
+    path, truth = avg_file
+    from pulseportraiture_tpu.io.gmodel import write_gmodel
+
+    seed = str(tmp_path / "seed.gmodel")
+    write_gmodel(truth, seed, quiet=True)
+    dp = GaussPortrait(path, quiet=True)
+    dp.make_gaussian_model(modelfile=seed, niter=1, quiet=True)
+    assert dp.ngauss == truth.ngauss
+    assert dp.nu_ref == truth.nu_ref
+    clean = np.asarray(gen_gmodel_portrait(truth, dp.phases, dp.freqs[0],
+                                           P=float(dp.Ps[0])))
+    assert np.sqrt(((dp.model - clean) ** 2).mean()) < 0.03
+
+
+def test_profile_to_portrait_params():
+    out = profile_to_portrait_params([0.1, 2.0, 0.5, 0.05, 3.0,
+                                      0.7, 0.02, 1.5])
+    np.testing.assert_allclose(
+        out, [0.1, 2.0, 0.5, 0.0, 0.05, 0.0, 3.0, 0.0,
+              0.7, 0.0, 0.02, 0.0, 1.5, 0.0])
+
+
+def test_spline_model_recovery(avg_file, tmp_path):
+    path, truth = avg_file
+    dp = SplinePortrait(path, quiet=True)
+    dp.normalize_portrait("prof")
+    spl = dp.make_spline_model(max_ncomp=4, smooth=True, snr_cutoff=50.0,
+                               quiet=True)
+    assert dp.ncomp >= 1  # evolving profile shape -> >=1 component
+    # model matches the (normalized) data at the noise level
+    resid = dp.portx - dp.modelx
+    assert np.abs(resid).std() < 3.0 * np.median(dp.noise_stdsxs[0])
+    # persistence round-trip, both formats
+    for name in ("m.spl", "m.npz"):
+        out = str(tmp_path / name)
+        dp.write_model(out, quiet=True)
+        back = read_spline_model(out, quiet=True)
+        got = back.portrait(dp.freqsxs[0])
+        np.testing.assert_allclose(got, dp.modelx, atol=1e-8)
+
+
+def test_spline_model_zero_components(avg_file, tmp_path):
+    """With an impossible S/N cutoff the model degrades to the mean
+    profile (reference ncomp == 0 branch)."""
+    path, truth = avg_file
+    dp = SplinePortrait(path, quiet=True)
+    dp.make_spline_model(snr_cutoff=np.inf, smooth=False, quiet=True)
+    assert dp.ncomp == 0
+    assert np.allclose(dp.model, dp.model[0])
+
+
+def test_selector_programmatic():
+    """GaussianSelector's non-GUI action API drives the same fit."""
+    import matplotlib
+
+    matplotlib.use("Agg", force=True)
+    from pulseportraiture_tpu.viz.selector import GaussianSelector
+
+    from pulseportraiture_tpu.fit.gauss import gen_gaussian_profile_flat
+
+    prof = np.asarray(gen_gaussian_profile_flat(
+        np.array([0.0, 0.0, 0.42, 0.04, 6.0]), 256))
+    rng = np.random.default_rng(0)
+    noisy = prof + 0.02 * rng.standard_normal(256)
+    sel = GaussianSelector(noisy, show=False)
+    sel.add_component(0.45, 0.06, noisy.max())
+    sel.do_fit()
+    assert sel.chi2 / sel.dof < 1.5
+    fitted = sel.fitted_params
+    assert fitted[2] == pytest.approx(0.42, abs=1e-3)  # loc
+    assert fitted[3] == pytest.approx(0.04, abs=2e-3)  # wid
+    sel.add_component(0.3, 0.05, 0.5)
+    sel.remove_last()
+    assert sel.ngauss == 1
+
+
+def test_built_templates_feed_pptoas(avg_file, tmp_path):
+    """The reference workflow: build both template kinds from the
+    average portrait, then measure TOAs on fresh epochs with each."""
+    path, truth = avg_file
+    from pulseportraiture_tpu.io.gmodel import write_gmodel
+    from pulseportraiture_tpu.pipeline import GetTOAs
+
+    # template files
+    dpg = GaussPortrait(path, quiet=True)
+    dpg.make_gaussian_model(ref_prof=(1500.0, 200.0), niter=2,
+                            auto_gauss=0.02, quiet=True)
+    gfile = str(tmp_path / "tmpl.gmodel")
+    dpg.model_name = "TMPL"
+    dpg.write_model(gfile, quiet=True)
+    dps = SplinePortrait(path, quiet=True)
+    dps.make_spline_model(max_ncomp=4, snr_cutoff=50.0, quiet=True)
+    sfile = str(tmp_path / "tmpl.spl")
+    dps.write_model(sfile, quiet=True)
+    # fresh epoch with a known dDM
+    epoch = str(tmp_path / "epoch.fits")
+    make_fake_pulsar(truth, PAR, outfile=epoch, nsub=2, nchan=48,
+                     nbin=256, tsub=120.0, noise_stds=0.05, dDM=3e-4,
+                     dedispersed=False, start_MJD=MJD(55300, 0.2),
+                     quiet=True, rng=33)
+    for tmpl in (gfile, sfile):
+        gt = GetTOAs(epoch, tmpl, quiet=True)
+        gt.get_TOAs(quiet=True)
+        assert len(gt.TOA_list) == 2
+        assert gt.DeltaDM_means[0] == pytest.approx(
+            3e-4, abs=max(5 * gt.DeltaDM_errs[0], 2e-4)), tmpl
