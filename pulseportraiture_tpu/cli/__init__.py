@@ -1,0 +1,7 @@
+"""Command-line tools (SURVEY §2.2 L5): the five user entry points,
+flag-compatible with the reference's OptionParser CLIs (pptoas.py:1479,
+ppalign.py:283, ppgauss.py:666, ppspline.py:291, ppzap.py:107).
+
+Run as `python -m pulseportraiture_tpu.cli.<tool>` or via the console
+scripts installed by setup.py.
+"""

@@ -1,0 +1,86 @@
+"""ppgauss — fit an evolving Gaussian-component model.
+
+Flag parity: reference ppgauss.py:666-812.
+"""
+
+import argparse
+import sys
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="ppgauss", description=__doc__.splitlines()[0])
+    p.add_argument("-d", "--datafile", default=None,
+                   help="PSRFITS archive to fit.")
+    p.add_argument("-M", "--metafile", default=None,
+                   help="Metafile of archives (JOIN fit across receivers).")
+    p.add_argument("-I", "--improve", dest="modelfile", default=None,
+                   help="Start from an existing .gmodel and improve it.")
+    p.add_argument("-o", "--outfile", default=None,
+                   help="Output model file name.")
+    p.add_argument("-e", "--errfile", default=None,
+                   help="Output parameter-error file name.")
+    p.add_argument("-j", "--joinfile", default=None,
+                   help="Joinfile with previously fitted JOIN parameters.")
+    p.add_argument("-m", "--model_name", default=None)
+    p.add_argument("--nu_ref", type=float, default=None,
+                   help="Reference frequency [MHz] of the model.")
+    p.add_argument("--bw", dest="bw_ref", type=float, default=None,
+                   help="Bandwidth [MHz] of the reference profile slice.")
+    p.add_argument("--tau", type=float, default=0.0,
+                   help="Scattering timescale [bin].")
+    p.add_argument("--fitloc", dest="fixloc", action="store_false",
+                   default=True, help="Let component positions evolve.")
+    p.add_argument("--fixwid", action="store_true", default=False,
+                   help="Do not let widths evolve.")
+    p.add_argument("--fixamp", action="store_true", default=False,
+                   help="Do not let amplitudes evolve.")
+    p.add_argument("--fitscat", dest="fixscat", action="store_false",
+                   default=True, help="Fit a scattering timescale.")
+    p.add_argument("--fitalpha", dest="fixalpha", action="store_false",
+                   default=True, help="Fit the scattering index.")
+    p.add_argument("--mcode", dest="model_code", default="000",
+                   help="Three-digit evolution-function code.")
+    p.add_argument("--niter", type=int, default=0,
+                   help="Number of iterations after the initial fit.")
+    p.add_argument("--fgauss", action="store_true", default=False,
+                   help="Fix the first component as fiducial.")
+    p.add_argument("--autogauss", dest="auto_gauss", type=float,
+                   default=0.0,
+                   help="Initial single-Gaussian width guess [rot] for a "
+                        "non-interactive fit.")
+    p.add_argument("--norm", dest="normalize", default=None,
+                   choices=(None, "mean", "max", "prof", "rms", "abs"))
+    p.add_argument("--figure", default=False,
+                   help="Save a residual plot to this file name.")
+    p.add_argument("--verbose", dest="quiet", action="store_false",
+                   default=True)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if not args.datafile and not args.metafile:
+        build_parser().error("need -d datafile or -M metafile")
+    from ..pipeline.gauss import GaussPortrait
+
+    dp = GaussPortrait(args.metafile or args.datafile,
+                       joinfile=args.joinfile, quiet=args.quiet)
+    if args.normalize:
+        dp.normalize_portrait(args.normalize)
+    datafile = args.metafile or args.datafile
+    outfile = args.outfile or (datafile + ".gmodel")
+    dp.make_gaussian_model(
+        modelfile=args.modelfile, ref_prof=(args.nu_ref, args.bw_ref),
+        tau=args.tau, fixloc=args.fixloc, fixwid=args.fixwid,
+        fixamp=args.fixamp, fixscat=args.fixscat, fixalpha=args.fixalpha,
+        model_code=args.model_code, niter=args.niter,
+        fiducial_gaussian=args.fgauss, auto_gauss=args.auto_gauss,
+        writemodel=True, outfile=outfile, writeerrfile=bool(args.errfile),
+        errfile=args.errfile, model_name=args.model_name,
+        residplot=args.figure or None, quiet=args.quiet)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,66 @@
+"""ppspline — build a PCA + B-spline model.
+
+Flag parity: reference ppspline.py:291-397 (default norm 'prof').
+"""
+
+import argparse
+import sys
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="ppspline", description=__doc__.splitlines()[0])
+    p.add_argument("-d", "--datafile", required=True,
+                   help="PSRFITS archive (an averaged portrait).")
+    p.add_argument("-o", "--modelfile", default=None,
+                   help="Output model file name. [default=<datafile>.spl]")
+    p.add_argument("-l", "--model_name", default=None)
+    p.add_argument("-a", "--archive", default=None,
+                   help="Also write the model reconstruction as a PSRFITS "
+                        "archive with this name.")
+    p.add_argument("-N", "--norm", default="prof",
+                   choices=("None", "mean", "max", "prof", "rms", "abs"))
+    p.add_argument("-s", "--smooth", action="store_true", default=False,
+                   help="Wavelet-smooth the eigenvectors and mean.")
+    p.add_argument("-n", "--max_ncomp", type=int, default=10)
+    p.add_argument("-S", "--snr", dest="snr_cutoff", type=float,
+                   default=150.0)
+    p.add_argument("-T", "--rchi2_tol", type=float, default=0.1)
+    p.add_argument("-k", "--degree", dest="k", type=int, default=3)
+    p.add_argument("-f", "--sfac", type=float, default=1.0)
+    p.add_argument("-t", "--knots", dest="max_nbreak", type=int,
+                   default=None)
+    p.add_argument("--plots", dest="make_plots", action="store_true",
+                   default=False,
+                   help="Save eigenprofile and spline-projection plots.")
+    p.add_argument("--quiet", action="store_true", default=False)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    from ..pipeline.spline import SplinePortrait
+
+    dp = SplinePortrait(args.datafile, quiet=args.quiet)
+    if args.norm and args.norm != "None":
+        dp.normalize_portrait(args.norm)
+    dp.make_spline_model(
+        max_ncomp=args.max_ncomp, smooth=args.smooth,
+        snr_cutoff=args.snr_cutoff, rchi2_tol=args.rchi2_tol, k=args.k,
+        sfac=args.sfac, max_nbreak=args.max_nbreak,
+        model_name=args.model_name, quiet=args.quiet)
+    outfile = args.modelfile or (args.datafile + ".spl")
+    dp.write_model(outfile, quiet=args.quiet)
+    if args.archive:
+        dp.write_model_archive(args.archive, quiet=args.quiet)
+    if args.make_plots:
+        dp.show_eigenprofiles(show=False,
+                              savefig=outfile + ".eigen.png")
+        if dp.ncomp:
+            dp.show_spline_curve_projections(
+                show=False, savefig=outfile + ".proj.png")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,127 @@
+"""pptoas — measure wideband TOAs and DMs.
+
+Flag parity: reference pptoas.py:1479-1687 (same dests/defaults; the
+scipy `method`/`bounds` knobs have no analogue in the fused-Newton
+engine and are accepted-but-ignored for script compatibility).
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="pptoas", description=__doc__.splitlines()[0])
+    p.add_argument("-d", "--datafiles", metavar="archive", required=True,
+                   help="PSRFITS archive or metafile of archive names.")
+    p.add_argument("-m", "--modelfile", metavar="model", required=True,
+                   help=".gmodel, spline model, or PSRFITS template.")
+    p.add_argument("-o", "--outfile", metavar="timfile", default=None,
+                   help="Output .tim file (appends). [default=stdout]")
+    p.add_argument("--narrowband", action="store_true", default=False,
+                   help="Make narrowband (per-channel) TOAs instead.")
+    p.add_argument("--errfile", metavar="errfile", default=None,
+                   help="Write fitted DM errors to this file (appends).")
+    p.add_argument("-T", "--tscrunch", action="store_true", default=False,
+                   help="tscrunch archives before measurement.")
+    p.add_argument("-f", "--format", dest="format", default="ipta",
+                   choices=("ipta", "princeton"),
+                   help="Output TOA format.")
+    p.add_argument("--nu_ref", dest="nu_ref_DM", default=None,
+                   help="Output reference frequency [MHz]; 'inf' for "
+                        "infinite. [default: zero-covariance frequency]")
+    p.add_argument("--DM", dest="DM0", default=None, type=float,
+                   help="Nominal DM [cm**-3 pc] for offset-DM reporting.")
+    p.add_argument("--no_bary", dest="bary", action="store_false",
+                   default=True, help="No Doppler correction of DM/GM/tau.")
+    p.add_argument("--one_DM", action="store_true", default=False,
+                   help="Single (mean) DM value per epoch in the .tim.")
+    p.add_argument("--fix_DM", dest="fit_DM", action="store_false",
+                   default=True, help="Do not fit for DM.")
+    p.add_argument("--fit_dt4", dest="fit_GM", action="store_true",
+                   default=False, help="Fit nu**-4 'GM' delays.")
+    p.add_argument("--fit_scat", action="store_true", default=False,
+                   help="Fit scattering timescale and index per TOA.")
+    p.add_argument("--no_logscat", dest="log10_tau", action="store_false",
+                   default=True, help="Fit tau linearly, not log10(tau).")
+    p.add_argument("--scat_guess", default=None,
+                   help="'tau[s],freq[MHz],alpha' initial scattering guess.")
+    p.add_argument("--fix_alpha", action="store_true", default=False,
+                   help="Hold the scattering index fixed (with --fit_scat).")
+    p.add_argument("--nu_tau", dest="nu_ref_tau", default=None, type=float,
+                   help="Output reference frequency [MHz] for tau.")
+    p.add_argument("--print_phase", action="store_true", default=False,
+                   help="Add -phs/-phs_err flags to TOA lines.")
+    p.add_argument("--print_flux", action="store_true", default=False,
+                   help="Add flux-estimate flags to TOA lines.")
+    p.add_argument("--print_parangle", action="store_true", default=False,
+                   help="Add parallactic-angle flags to TOA lines.")
+    p.add_argument("--flags", default="",
+                   help="Comma-separated extra TOA flag pairs k1,v1,k2,v2.")
+    p.add_argument("--snr_cut", dest="snr_cutoff", default=0.0, type=float,
+                   help="Minimum snr flag value for written TOAs.")
+    p.add_argument("--showplot", action="store_true", default=False,
+                   help="Save per-subint fit plots next to the archives.")
+    p.add_argument("--quiet", action="store_true", default=False)
+    # accepted for reference-script compatibility; no-ops here:
+    p.add_argument("--psrchive", action="store_true", default=False,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--method", default=None, help=argparse.SUPPRESS)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    from ..io.tim import write_princeton_TOAs, write_TOAs
+    from ..pipeline import GetTOAs
+
+    nu_ref_DM = args.nu_ref_DM
+    if nu_ref_DM is not None:
+        nu_ref_DM = np.inf if str(nu_ref_DM).lower() == "inf" \
+            else float(nu_ref_DM)
+    nu_refs = None
+    if nu_ref_DM is not None or args.nu_ref_tau is not None:
+        nu_refs = (nu_ref_DM, args.nu_ref_tau)
+    scat_guess = None
+    if args.scat_guess:
+        scat_guess = [float(x) for x in args.scat_guess.split(",")]
+    addtnl = {}
+    if args.flags:
+        parts = args.flags.split(",")
+        addtnl = dict(zip(parts[0::2], parts[1::2]))
+
+    gt = GetTOAs(args.datafiles, args.modelfile, quiet=args.quiet)
+    if args.narrowband or args.psrchive:
+        gt.get_narrowband_TOAs(tscrunch=args.tscrunch,
+                               print_phase=args.print_phase,
+                               addtnl_toa_flags=addtnl, quiet=args.quiet)
+    else:
+        gt.get_TOAs(tscrunch=args.tscrunch, nu_refs=nu_refs, DM0=args.DM0,
+                    bary=args.bary, fit_DM=args.fit_DM, fit_GM=args.fit_GM,
+                    fit_scat=args.fit_scat, log10_tau=args.log10_tau,
+                    scat_guess=scat_guess, fix_alpha=args.fix_alpha,
+                    print_phase=args.print_phase,
+                    print_flux=args.print_flux,
+                    print_parangle=args.print_parangle,
+                    addtnl_toa_flags=addtnl, quiet=args.quiet)
+        if args.one_DM:
+            gt.apply_one_DM()
+    if args.format == "princeton":
+        dDMs = [toa.DM - gt.DM0s[gt.order.index(toa.archive)]
+                if toa.DM is not None else 0.0 for toa in gt.TOA_list]
+        write_princeton_TOAs(gt.TOA_list, outfile=args.outfile, dDMs=dDMs)
+        if args.errfile:
+            with open(args.errfile, "a") as f:
+                for toa in gt.TOA_list:
+                    if toa.DM_error is not None:
+                        f.write(f"{toa.DM_error:.5e}\n")
+    else:
+        write_TOAs(gt.TOA_list, SNR_cutoff=args.snr_cutoff,
+                   outfile=args.outfile, append=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

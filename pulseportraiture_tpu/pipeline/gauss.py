@@ -179,6 +179,8 @@ class GaussPortrait(_BasePortrait):
                                       quiet=quiet)
             init_portrait = profile_to_portrait_params(self.init_params)
         model_name = model_name or (str(self.datafile) + ".gmodel")
+        self.model_name = model_name
+        self.model_code = model_code
 
         # portrait-layout fit flags (ppgauss.py:147-166)
         ngauss = self.ngauss
@@ -252,8 +254,6 @@ class GaussPortrait(_BasePortrait):
                     jnp.asarray(self.freqs[0][jic]), self.nu_ref))
             self._condense()
 
-        self.model_name = model_name
-        self.model_code = model_code
         self.scattering_index = scattering_index
         self.gaussian_model = self._to_gmodel(model_name, model_code,
                                               scattering_index,

@@ -572,6 +572,73 @@ class GetTOAs:
                         toa_flags))
 
     # ------------------------------------------------------------------
+    def _fitted_model(self, iarch, isub, d, modelx, freqs0):
+        """The template rotated onto the (dispersed) data at the
+        fitted (phi, DM), including any fitted scattering — the
+        reconstruction used by show_fit and channel zapping
+        (reference show_fit, pptoas.py:1375-1476)."""
+        from ..ops.rotation import rotate_portrait
+
+        nbin = modelx.shape[-1]
+        tau_r = float(self.taus[iarch][isub])
+        if getattr(self, "log10_tau", False) and np.isfinite(tau_r):
+            tau_r = 10.0 ** tau_r
+        port_model = modelx
+        if np.isfinite(tau_r) and tau_r > 0.0:
+            tt = np.asarray(scattering_times(
+                tau_r, float(self.alphas[iarch][isub]), freqs0,
+                float(self.nu_refs[iarch][isub][2])))
+            B = np.asarray(scattering_portrait_FT(jnp.asarray(tt),
+                                                  nbin // 2 + 1))
+            port_model = np.fft.irfft(B * np.fft.rfft(modelx, axis=-1),
+                                      n=nbin, axis=-1)
+        phi = float(self.phis[iarch][isub])
+        DM = float(self.DMs[iarch][isub])
+        df = float(self.doppler_fs[iarch][isub]) if self.bary else 1.0
+        return np.asarray(rotate_portrait(
+            jnp.asarray(port_model), -phi, -DM / df,
+            float(self.Ps[iarch][isub]), jnp.asarray(freqs0),
+            float(self.nu_refs[iarch][isub][0])))
+
+    def show_subint(self, datafile=None, isub=0, show=True,
+                    savefig=False):
+        """Display one subintegration portrait (reference
+        pptoas.py:1345-1373)."""
+        from ..viz.plots import show_portrait
+
+        datafile = datafile or self.order[0]
+        d = load_data(datafile, dedisperse=False, dededisperse=True,
+                      tscrunch=self.tscrunch, pscrunch=True, quiet=True)
+        return show_portrait(
+            np.asarray(d.subints[isub, 0]) *
+            (np.asarray(d.weights[isub]) > 0)[:, None],
+            d.phases, d.freqs[isub],
+            title=f"{datafile} subint {isub}", show=show,
+            savefig=savefig or None)
+
+    def show_fit(self, datafile=None, isub=0, show=True, savefig=False):
+        """Data / fitted-model / residual triptych for one subint
+        (reference pptoas.py:1375-1476)."""
+        from ..viz.plots import show_residual_plot
+
+        datafile = datafile or self.order[0]
+        iarch = self.order.index(datafile)
+        d = load_data(datafile, dedisperse=False, dededisperse=True,
+                      tscrunch=self.tscrunch, pscrunch=True, quiet=True)
+        freqs0 = np.asarray(d.freqs[0], float)
+        modelx = self.model.portrait(freqs0, d.nbin,
+                                     P=float(np.mean(d.Ps)))
+        aligned = self._fitted_model(iarch, isub, d, modelx, freqs0)
+        scaled = self.scales[iarch][isub][:, None] * aligned
+        return show_residual_plot(
+            np.asarray(d.subints[isub, 0]), scaled, d.phases, freqs0,
+            noise_stds=np.asarray(d.noise_stds[isub, 0]),
+            weights=np.asarray(d.weights[isub]),
+            titles=(f"{datafile} subint {isub}",
+                    str(self.modelfile), "Residuals"),
+            show=show, savefig=savefig or None)
+
+    # ------------------------------------------------------------------
     def get_channels_to_zap(self, SNR_threshold=8.0, rchi2_threshold=1.3,
                             iterate=True, show=False):
         """Flag channels with bad per-channel reduced chi2 or low S/N

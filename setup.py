@@ -1,0 +1,23 @@
+"""Package install (reference setup.py:1-14 installs flat py_modules +
+scripts; here a proper package with console entry points)."""
+
+from setuptools import find_packages, setup
+
+setup(
+    name="pulseportraiture_tpu",
+    version="0.1.0",
+    description="TPU-native (JAX/XLA/Pallas) wideband pulsar-timing "
+                "framework with PulsePortraiture's capabilities",
+    packages=find_packages(exclude=("tests",)),
+    python_requires=">=3.10",
+    install_requires=["numpy", "scipy", "jax", "matplotlib"],
+    entry_points={
+        "console_scripts": [
+            "pptoas=pulseportraiture_tpu.cli.pptoas:main",
+            "ppalign=pulseportraiture_tpu.cli.ppalign:main",
+            "ppgauss=pulseportraiture_tpu.cli.ppgauss:main",
+            "ppspline=pulseportraiture_tpu.cli.ppspline:main",
+            "ppzap=pulseportraiture_tpu.cli.ppzap:main",
+        ]
+    },
+)
