@@ -45,6 +45,20 @@ from .noise import (
     get_SNR,
     get_scales,
 )
+from .filters import (
+    wiener_filter,
+    brickwall_filter,
+    fit_brickwall,
+    half_triangle_function,
+    find_kc,
+    get_noise_fit,
+)
+from .ism import (
+    mean_C2N,
+    dDM,
+    GM_from_DMc,
+    DMc_from_GM,
+)
 
 __all__ = [
     "cexp",
@@ -75,4 +89,14 @@ __all__ = [
     "channel_SNRs_FT",
     "get_SNR",
     "get_scales",
+    "wiener_filter",
+    "brickwall_filter",
+    "fit_brickwall",
+    "half_triangle_function",
+    "find_kc",
+    "get_noise_fit",
+    "mean_C2N",
+    "dDM",
+    "GM_from_DMc",
+    "DMc_from_GM",
 ]

@@ -26,12 +26,21 @@ def get_noise_PS(data, frac=0.25):
 
 
 def get_noise(data, method="PS", **kwargs):
-    """Dispatch noise estimator (currently 'PS'; the reference's
-    'fit' method via find_kc, pplib.py:2341-2373, is offline-only and
-    not needed on the hot path).  Parity: reference pplib.py:2290-2309.
+    """Dispatch noise estimator: 'PS' (power-spectrum tail, jax, hot
+    path) or 'fit' (noise-floor-cutoff fit, host-side numpy, offline).
+    Parity: reference pplib.py:2290-2309.
     """
     if method == "PS":
         return get_noise_PS(data, **kwargs)
+    if method == "fit":
+        from .filters import get_noise_fit
+
+        import numpy as np
+
+        data = np.asarray(data)
+        # match get_noise_PS's batching: 2-D input -> per-channel noise
+        kwargs.setdefault("chans", data.ndim >= 2)
+        return get_noise_fit(data, **kwargs)
     raise ValueError(f"unknown noise method {method!r}")
 
 

@@ -1,0 +1,162 @@
+"""Tests for ops.filters (noise-floor cutoff, Wiener/brickwall) and
+ops.ism (scattering-screen helpers, GM<->DMc conversions)."""
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.ops.filters import (
+    brickwall_filter,
+    find_kc,
+    fit_brickwall,
+    get_noise_fit,
+    half_triangle_function,
+    wiener_filter,
+)
+from pulseportraiture_tpu.ops.ism import (
+    DMc_from_GM,
+    GM_from_DMc,
+    dDM,
+    mean_C2N,
+)
+
+
+def _noisy_gaussian_profile(nbin=512, width=0.02, amp=50.0, noise=1.0, seed=0):
+    rng = np.random.default_rng(seed)
+    x = np.arange(nbin) / nbin
+    prof = amp * np.exp(-0.5 * ((x - 0.5) / width) ** 2)
+    return prof + noise * rng.standard_normal(nbin), noise
+
+
+class TestWienerBrickwall:
+    def test_wiener_range_and_shape(self):
+        prof, noise = _noisy_gaussian_profile()
+        wf = wiener_filter(prof, noise)
+        assert wf.shape == (len(prof) // 2 + 1,)
+        assert np.all(wf >= 0.0) and np.all(wf <= 1.0)
+
+    def test_wiener_passes_signal_kills_noise(self):
+        prof, noise = _noisy_gaussian_profile()
+        wf = wiener_filter(prof, noise)
+        # strong low harmonics pass, noise-floor harmonics are crushed
+        assert wf[1:5].min() > 0.85
+        assert wf[-50:].mean() < 0.3
+
+    def test_wiener_noise_floor_units(self):
+        # a harmonic with power ~100x the noise floor must pass nearly
+        # unattenuated (guards the nbin/2 floor-units bug)
+        rng = np.random.default_rng(7)
+        nbin = 512
+        x = np.arange(nbin) / nbin
+        prof = 2.0 * np.cos(2 * np.pi * 3 * x) + rng.standard_normal(nbin)
+        # harmonic 3 power: (nbin*amp/2)^2*... in pows units = nbin*amp^2/4
+        wf = wiener_filter(prof, 1.0)
+        assert wf[3] > 0.95
+
+    def test_brickwall(self):
+        fk = brickwall_filter(10, 4)
+        assert np.array_equal(fk, [1, 1, 1, 1, 0, 0, 0, 0, 0, 0])
+
+    def test_fit_brickwall_matches_signal_extent(self):
+        prof, noise = _noisy_gaussian_profile(width=0.05)
+        kc = fit_brickwall(prof, noise)
+        # Gaussian of width w has harmonics out to ~ 1/(2 pi w) ~ 3;
+        # allow a generous band but require the cutoff to be small
+        assert 1 <= kc < 40
+
+    def test_fit_brickwall_is_argmin_of_explicit_cost(self):
+        prof, noise = _noisy_gaussian_profile(seed=3)
+        wf = wiener_filter(prof, noise)
+        N = len(wf)
+        explicit = np.array(
+            [np.sum((wf - brickwall_filter(N, ii)) ** 2) for ii in range(N)]
+        )
+        assert fit_brickwall(prof, noise) == int(np.argmin(explicit))
+
+
+class TestFindKc:
+    def test_half_triangle_function(self):
+        fn = half_triangle_function(4, 8.0, 1.0, 8)
+        assert fn[0] == pytest.approx(9.0)
+        assert np.allclose(fn[4:], 1.0)
+
+    def test_find_kc_locates_noise_floor(self):
+        # power spectrum: exponential decay to a flat floor at k=30
+        rng = np.random.default_rng(1)
+        N = 200
+        k = np.arange(N)
+        pows = 1e4 * np.exp(-k / 6.0) + 1.0 * (1 + 0.1 * rng.standard_normal(N))
+        kc = find_kc(pows)
+        # signal crosses the floor at k ~= 55; the 0.5%-decay criterion
+        # lands above that (conservative = safe for noise estimation)
+        assert 30 <= kc <= 150
+
+    def test_find_kc_half_tri(self):
+        rng = np.random.default_rng(2)
+        N = 150
+        pows = 10 ** half_triangle_function(25, 4.0, 0.0, N)
+        pows *= 1 + 0.05 * rng.standard_normal(N)
+        kc = find_kc(pows, fn="half_tri")
+        assert 10 <= kc <= 60
+
+    def test_find_kc_zero_power_is_finite(self):
+        # exact-zero DC power (baseline-removed profile) must not NaN
+        # the grid and degenerate to kc = N-1
+        rng = np.random.default_rng(11)
+        N = 513
+        pows = np.abs(rng.standard_normal(N)) + 0.5
+        pows[:10] = 1e4 * np.exp(-np.arange(10) / 1.5)
+        pows[0] = 0.0
+        kc = find_kc(pows)
+        assert kc < N - 1
+
+    def test_get_noise_fit_zero_dc(self):
+        rng = np.random.default_rng(12)
+        prof = 2.0 * rng.standard_normal(1024)
+        prof -= prof.mean()  # exact-zero DC
+        est = get_noise_fit(prof)
+        assert est == pytest.approx(2.0, rel=0.35)
+
+    def test_get_noise_fit_recovers_sigma(self):
+        prof, noise = _noisy_gaussian_profile(nbin=1024, noise=2.0, seed=5)
+        est = get_noise_fit(prof)
+        assert est == pytest.approx(2.0, rel=0.35)
+
+    def test_get_noise_fit_chans(self):
+        profs = np.stack([_noisy_gaussian_profile(seed=s)[0] for s in range(3)])
+        est = get_noise_fit(profs, chans=True)
+        assert est.shape == (3,)
+        assert np.all(est > 0)
+
+    def test_get_noise_dispatch_fit_is_per_channel_for_2d(self):
+        from pulseportraiture_tpu.ops import get_noise
+
+        profs = np.stack([_noisy_gaussian_profile(seed=s)[0] for s in range(3)])
+        est = np.asarray(get_noise(profs, method="fit"))
+        assert est.shape == (3,)
+
+    def test_find_kc_all_zero_channel(self):
+        # fully zapped channel: no NaN grid, no warnings, returns 0
+        with np.errstate(divide="raise", invalid="raise"):
+            assert find_kc(np.zeros(128)) == 0
+        assert get_noise_fit(np.zeros(256)) == 0.0
+
+
+class TestISM:
+    def test_mean_c2n_scalings(self):
+        # positive, and decreasing with scintillation bandwidth
+        a = mean_C2N(1400.0, 1.0, 1.0)
+        b = mean_C2N(1400.0, 1.0, 10.0)
+        assert a > b > 0
+
+    def test_ddm_positive_and_screen_scaling(self):
+        d1 = dDM(1.0, 0.5, 1400.0, 1.0)
+        d2 = dDM(1.0, 0.25, 1400.0, 1.0)
+        assert d1 > d2 > 0
+
+    def test_gm_dmc_roundtrip(self):
+        # DMc_from_GM is the exact inverse of GM_from_DMc (the
+        # reference's version is not; defect documented in ops/ism.py)
+        DMc = 1e-3
+        GM = GM_from_DMc(DMc, 1.0, 10.0)
+        assert GM > 0
+        assert DMc_from_GM(GM, 1.0, 10.0) == pytest.approx(DMc, rel=1e-12)
