@@ -121,7 +121,7 @@ def find_kc(pows, errs=1.0, fn="exp_dc", Ns=20):
     elif fn == "half_tri":
         a_r = np.linspace(1, N, Ns)
     else:
-        return 0
+        raise ValueError(f"unknown noise-floor fit function {fn!r}")
     b_r = np.linspace(0, hi - lo, Ns)
     dc_r = np.linspace(lo, hi, Ns)
     grid = np.stack(
@@ -130,6 +130,13 @@ def find_kc(pows, errs=1.0, fn="exp_dc", Ns=20):
     models = _kc_models(grid, N, fn)
     chi2 = np.sum(((data[None, :] - models) / errs) ** 2, axis=1)
     a, b, dc = grid[np.argmin(chi2)]
+    # significance check: a fitted decay height within the residual
+    # scatter means the spectrum is flat (pure noise floor) — cutoff 0.
+    # Without this, a tiny spurious b with slow decay returns N-1 and
+    # the noise would be estimated from only the last few harmonics.
+    resid = data - _kc_models(grid[np.argmin(chi2)][None], N, fn)[0]
+    if b <= 2.0 * resid.std():
+        return 0
     if fn == "exp_dc":
         decayed = np.where(np.exp(-a * np.arange(N)) < 0.005)[0]
         return int(decayed.min()) if len(decayed) else N - 1
@@ -145,7 +152,11 @@ def get_noise_fit(data, fact=1.1, chans=False):
     """
     data = np.asarray(data, np.float64)
     if chans:
-        return np.array([get_noise_fit(prof, fact=fact) for prof in data])
+        # per-profile estimate over all leading axes, matching
+        # get_noise_PS's batching: (..., nbin) -> (...)
+        flat = data.reshape(-1, data.shape[-1])
+        out = np.array([get_noise_fit(prof, fact=fact) for prof in flat])
+        return out.reshape(data.shape[:-1])
     raveld = data.ravel()
     FFT = np.fft.rfft(raveld)
     pows = (FFT * np.conj(FFT)).real / len(raveld)
