@@ -75,12 +75,24 @@ class Header:
 
 class HDU:
     """One header-data unit.  `data` is None, an ndarray (image), or an
-    OrderedDict of column name -> ndarray (bintable, rows-first)."""
+    OrderedDict of column name -> ndarray (bintable, rows-first).
 
-    def __init__(self, header, data=None, name=""):
+    For bintables, `raw` keeps the undecoded table payload and
+    `layout` maps column name -> (byte_offset, tform_code, repeat) so
+    callers (the native SUBINT fast path) can decode columns straight
+    from the wire bytes; columns listed in a reader's `defer` set are
+    left as None in `data` and must be fetched through these."""
+
+    def __init__(self, header, data=None, name="", raw=None, layout=None):
         self.header = header
         self.data = data
         self.name = name or header.get("EXTNAME", "")
+        self.raw = raw
+        self.layout = layout or {}
+
+    @property
+    def row_stride(self):
+        return int(self.header.get("NAXIS1", 0))
 
 
 # --------------------------------------------------------------------------
@@ -234,20 +246,28 @@ def _data_size(header):
     return size
 
 
-def _read_hdu(buf, off):
+def _read_hdu(buf, off, defer=()):
     header, off = _read_header(buf, off)
     size = _data_size(header)
     raw = buf[off:off + size]
     off += ((size + BLOCK - 1) // BLOCK) * BLOCK
     xt = str(header.get("XTENSION", "")).strip()
     data = None
+    layout = None
     if xt == "BINTABLE":
         names, dt = _table_dtype(header)
         nrows = header["NAXIS2"]
         rec = np.frombuffer(raw, dtype=dt, count=nrows)
         data = OrderedDict()
+        layout = {}
         for i, name in enumerate(names):
-            col = rec[f"f{i + 1}"]
+            fname = f"f{i + 1}"
+            repeat, code, _ = parse_tform(str(header[f"TFORM{i + 1}"]))
+            layout[name] = (int(dt.fields[fname][1]), code, repeat)
+            if name in defer:
+                data[name] = None
+                continue
+            col = rec[fname]
             tdim = header.get(f"TDIM{i + 1}")
             if tdim:
                 shape = tuple(int(x) for x in str(tdim).strip("() ").split(","))
@@ -255,7 +275,8 @@ def _read_hdu(buf, off):
             if col.dtype.kind in "iufc":
                 col = col.astype(col.dtype.newbyteorder("="))
             data[name] = col
-    elif size and header.get("NAXIS", 0) > 0:
+        return HDU(header, data, raw=raw, layout=layout), off
+    if size and header.get("NAXIS", 0) > 0:
         bitpix = header["BITPIX"]
         dt = {8: "u1", 16: ">i2", 32: ">i4", 64: ">i8",
               -32: ">f4", -64: ">f8"}[bitpix]
@@ -266,8 +287,13 @@ def _read_hdu(buf, off):
     return HDU(header, data), off
 
 
-def read_fits(path):
-    """Read a FITS file -> list of HDU."""
+def read_fits(path, defer=()):
+    """Read a FITS file -> list of HDU.
+
+    Column names in `defer` are not decoded in bintables (left None in
+    `hdu.data`); fetch them from `hdu.raw`/`hdu.layout` — used by the
+    native SUBINT fast path to avoid a second pass over the big DATA
+    column."""
     with open(path, "rb") as f:
         buf = f.read()
     hdus = []
@@ -275,7 +301,7 @@ def read_fits(path):
     while off < len(buf):
         if not buf[off:off + BLOCK].strip():
             break
-        hdu, off = _read_hdu(buf, off)
+        hdu, off = _read_hdu(buf, off, defer=defer)
         hdus.append(hdu)
     return hdus
 

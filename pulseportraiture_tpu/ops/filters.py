@@ -129,12 +129,13 @@ def find_kc(pows, errs=1.0, fn="exp_dc", Ns=20):
     )
     models = _kc_models(grid, N, fn)
     chi2 = np.sum(((data[None, :] - models) / errs) ** 2, axis=1)
-    a, b, dc = grid[np.argmin(chi2)]
+    imin = int(np.argmin(chi2))
+    a, b, dc = grid[imin]
     # significance check: a fitted decay height within the residual
     # scatter means the spectrum is flat (pure noise floor) — cutoff 0.
     # Without this, a tiny spurious b with slow decay returns N-1 and
     # the noise would be estimated from only the last few harmonics.
-    resid = data - _kc_models(grid[np.argmin(chi2)][None], N, fn)[0]
+    resid = data - models[imin]
     if b <= 2.0 * resid.std():
         return 0
     if fn == "exp_dc":
