@@ -2,6 +2,10 @@
 vs the single-core NumPy reference implementation (BASELINE.md config 2:
 batch of synthetic archives at 512 chan x 2048 bin).
 
+Measures the full fit from time-domain portraits — matmul real DFTs,
+CCF phase seed, damped-Newton loop, covariance/packaging — through
+fit_portrait_batch_fast (the complex-free TPU throughput path).
+
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 """
@@ -17,9 +21,8 @@ def main():
     import jax.numpy as jnp
 
     import pulseportraiture_tpu  # noqa: F401  (x64 host config)
-    from pulseportraiture_tpu.fit.portrait import FitFlags, _fit_portrait_core
+    from pulseportraiture_tpu.fit import fit_portrait_batch_fast
     from pulseportraiture_tpu.fit.reference_numpy import fit_portrait_numpy
-    from functools import partial
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
@@ -32,45 +35,43 @@ def main():
     # --- synthesize the batch on device (f32) ---------------------------
     from __graft_entry__ import _synth_batch
 
-    args = _synth_batch(NB, NCHAN, NBIN, DTYPE)
-    dFT, mFT, w, freqs, Ps, nus, nu_out, theta0 = args
-
-    fit = jax.vmap(
-        partial(
-            _fit_portrait_core,
-            fit_flags=FitFlags(True, True, False, False, False),
-            log10_tau=False,
-            max_iter=25,
-            use_ir=False,
-        ),
-        in_axes=(0, 0, 0, None, 0, 0, 0, 0),
+    dFT, mFT, w, freqs, Ps, nus, nu_out, theta0 = _synth_batch(
+        NB, NCHAN, NBIN, DTYPE
     )
-    fit = jax.jit(fit)
+    ports = jnp.fft.irfft(dFT, n=NBIN, axis=-1).astype(DTYPE)
+    models = jnp.fft.irfft(mFT, n=NBIN, axis=-1).astype(DTYPE)
+    noise = jnp.full((NB, NCHAN), 0.05, DTYPE)
+    jax.block_until_ready(ports)
+
+    def run():
+        return fit_portrait_batch_fast(
+            ports, models, noise, freqs, Ps, nus, max_iter=25
+        )
 
     # warmup/compile; timing forces a host transfer per rep because
     # block_until_ready can return early under the tunneled TPU runtime
-    res = fit(*args)
+    res = run()
     _ = np.asarray(res.phi)
 
     nrep = 5
     t0 = time.perf_counter()
     for _ in range(nrep):
-        res = fit(*args)
+        res = run()
         _ = np.asarray(res.phi)
     t_tpu = (time.perf_counter() - t0) / nrep
     toas_per_sec = NB / t_tpu
 
     # --- single-core NumPy baseline on a few portraits ------------------
-    ports_np = np.asarray(jnp.fft.irfft(dFT, n=NBIN, axis=-1), np.float64)
-    models_np = np.asarray(jnp.fft.irfft(mFT, n=NBIN, axis=-1), np.float64)
+    ports_np = np.asarray(ports, np.float64)
+    models_np = np.asarray(models, np.float64)
     freqs_np = np.asarray(freqs, np.float64)
-    noise = np.full(NCHAN, 0.05)
+    noise_np = np.full(NCHAN, 0.05)
 
     n_base = 3
     t0 = time.perf_counter()
     base_res = [
         fit_portrait_numpy(
-            ports_np[i], models_np[i], noise, freqs_np, P, NU_FIT
+            ports_np[i], models_np[i], noise_np, freqs_np, P, NU_FIT
         )
         for i in range(n_base)
     ]

@@ -32,6 +32,13 @@ F0_fact = 0.0
 # (reference pplib.py:86).
 wid_max = 0.25
 
+# --- Pallas kernels -------------------------------------------------------
+# Fused TPU kernel for the fit's harmonic-moment hot loop
+# (ops/pallas_kernels.py).  'auto' = on TPU backends only; False
+# forces it off; True forces it on for f32 data (f64 always takes the
+# XLA path, which is the reference implementation).
+use_pallas = "auto"
+
 # --- Model evolution codes ------------------------------------------------
 # Per-parameter evolution function code string for .gmodel files:
 # one digit each for (loc, wid, amp); '0' = power law, '1' = linear

@@ -7,6 +7,7 @@ from .portrait import (
     FitResult,
     fit_portrait,
     fit_portrait_batch,
+    fit_portrait_batch_fast,
     chi2_prime,
 )
 
@@ -17,6 +18,7 @@ __all__ = [
     "FitResult",
     "fit_portrait",
     "fit_portrait_batch",
+    "fit_portrait_batch_fast",
     "chi2_prime",
     "fit_powlaw",
     "fit_DM_to_freq_resids",

@@ -44,12 +44,13 @@ linear solve), replacing the reference's per-flag-combination
 closed-form polynomial-root branches (pptoaslib.py:776-950).
 """
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 
+from .. import config
 from ..config import Dconst, F0_fact
 from ..ops.noise import fourier_noise
 from ..ops.phasor import cexp
@@ -164,26 +165,50 @@ def _chi2_prime_X(theta, X, M2, freqs, P, nu_fit, ir_FT, log10_tau):
     return -jnp.sum(jnp.where(good, C**2.0 / S_safe, 0.0))
 
 
-def _cgh_fast(theta, X, S0inv, cvec, gvec):
-    """(f, grad5, hess5) of chi2' in ONE pass over X — the fused
-    analytic fast path for fits with no active scattering parameters.
+def use_pallas_moments(dtype):
+    """Pallas fused kernel only on TPU backends, f32 data, and when not
+    disabled via config (the XLA path is the reference)."""
+    setting = getattr(config, "use_pallas", "auto")
+    if setting is False:
+        return False
+    on_tpu = jax.default_backend() == "tpu"
+    return (setting is True or on_tpu) and jnp.dtype(dtype) == jnp.float32
 
-    S0inv: precomputed 1/S_n (0 for masked channels); cvec/gvec: the
-    linear coefficients of t_n in (DM, GM).
-    """
+
+def _moments_xla(t_n, X):
+    """Harmonic moments (C, C1, C2) of complex X under rotation t_n —
+    the XLA reference path (one read of X, three fused reductions)."""
     nharm = X.shape[-1]
-    dt = S0inv.dtype
+    dt = t_n.dtype
     k2pi = 2.0 * jnp.pi * jnp.arange(nharm, dtype=dt)
-    t_n = theta[0] + cvec * theta[1] + gvec * theta[2]
-    ph = cexp(t_n[:, None] * k2pi)
-    W = X * ph
-    # harmonic moments: one read of X, three reductions (XLA fuses)
-    Z0 = jnp.sum(W, axis=-1)
-    Z1 = jnp.sum(W * k2pi, axis=-1)
-    Z2 = jnp.sum(W * k2pi**2, axis=-1)
-    C = Z0.real
-    C1 = -Z1.imag
-    C2 = -Z2.real
+    W = X * cexp(t_n[:, None] * k2pi)
+    return (
+        jnp.sum(W, axis=-1).real,
+        -jnp.sum(W * k2pi, axis=-1).imag,
+        -jnp.sum(W * k2pi**2, axis=-1).real,
+    )
+
+
+def _moments_real_xla(t_n, Xr, Xi):
+    """Same moments from split real/imag parts, with no complex types
+    anywhere (the real core's XLA fallback)."""
+    nharm = Xr.shape[-1]
+    dt = t_n.dtype
+    k2pi = 2.0 * jnp.pi * jnp.arange(nharm, dtype=dt)
+    ang = t_n[:, None] * k2pi
+    c = jnp.cos(ang)
+    s = jnp.sin(ang)
+    wr = Xr * c - Xi * s
+    wi = Xr * s + Xi * c
+    return (
+        jnp.sum(wr, axis=-1),
+        -jnp.sum(wi * k2pi, axis=-1),
+        -jnp.sum(wr * k2pi**2, axis=-1),
+    )
+
+
+def _cgh_tail(C, C1, C2, S0inv, cvec, gvec, dt):
+    """(f, grad5, hess5) from the harmonic moments."""
     f = -jnp.sum(C**2.0 * S0inv)
     base1 = 2.0 * C * C1 * S0inv  # dchi2'/dt_n
     base2 = 2.0 * (C1**2.0 + C * C2) * S0inv
@@ -194,6 +219,19 @@ def _cgh_fast(theta, X, S0inv, cvec, gvec):
     g5 = jnp.zeros(5, dt).at[:3].set(g3)
     H5 = jnp.zeros((5, 5), dt).at[:3, :3].set(H3)
     return f, g5, H5
+
+
+def _cgh_fast(theta, X, S0inv, cvec, gvec):
+    """(f, grad5, hess5) of chi2' in ONE pass over X — the fused
+    analytic fast path for fits with no active scattering parameters.
+
+    S0inv: precomputed 1/S_n (0 for masked channels); cvec/gvec: the
+    linear coefficients of t_n in (DM, GM).
+    """
+    dt = S0inv.dtype
+    t_n = theta[0] + cvec * theta[1] + gvec * theta[2]
+    C, C1, C2 = _moments_xla(t_n, X)
+    return _cgh_tail(C, C1, C2, S0inv, cvec, gvec, dt)
 
 
 def _initial_phase_guess(X, cvec, DM0, oversamp=2):
@@ -368,10 +406,22 @@ def _fit_portrait_core(
     s = _newton_loop(cgh, theta0, flags_arr, max_iter, ftol)
     theta = s.theta
 
+    _, _, H = cgh(theta)
+    M2s = (mFT.real**2 + mFT.imag**2) * w
+    C, S = _CS_general(theta, X, M2s, freqs, P, nu_fit, ir, log10_tau)
+    Sd = jnp.sum((dFT.real**2 + dFT.imag**2) * w)
+    return _finalize_fit(
+        theta, s, H, C, S, Sd, dFT.shape[-1], flags_arr, fit_flags,
+        P, nu_fit, nu_out, log10_tau, dt)
+
+
+def _finalize_fit(theta, s, H, C, S, Sd, nharm, flags_arr, fit_flags,
+                  P, nu_fit, nu_out, log10_tau, dt):
+    """Covariance, zero-covariance frequencies, re-referencing, scales,
+    S/N, and chi2 packaging shared by the complex and real fit cores."""
     # --- covariance: chi2 ~ chi2_min + 0.5 d^T H d  =>  cov = 2 H^-1 on
     # the fitted subset (reference "inverted half-Hessian",
     # pplib.py:2266-2273 / pptoaslib.py:674-678)
-    _, _, H = cgh(theta)
     Hm = H * jnp.outer(flags_arr, flags_arr) + jnp.diag(1.0 - flags_arr)
     cov = 2.0 * jnp.linalg.inv(Hm) * jnp.outer(flags_arr, flags_arr)
 
@@ -451,17 +501,14 @@ def _fit_portrait_core(
     alpha_err = jnp.sqrt(jnp.maximum(cov[4, 4], 0.0))
 
     # --- scales / SNRs / chi2
-    M2s = (mFT.real**2 + mFT.imag**2) * w
-    C, S = _CS_general(theta, X, M2s, freqs, P, nu_fit, ir, log10_tau)
     S_safe = jnp.maximum(S, _tiny(dt))
     scales = C / S_safe
     scale_errs = S_safe**-0.5
     mask = (S > 0.0).astype(dt)
     channel_snrs = C / jnp.sqrt(S_safe) * mask
     snr = jnp.sqrt(jnp.maximum(jnp.sum(channel_snrs**2.0), 0.0))
-    Sd = jnp.sum((dFT.real**2 + dFT.imag**2) * w)
     chi2 = Sd + s.f
-    nbin = 2 * (dFT.shape[-1] - 1)
+    nbin = 2 * (nharm - 1)
     nfit = jnp.sum(flags_arr)
     dof = jnp.sum(mask) * (nbin - 1.0) - nfit - jnp.sum(mask)
 
@@ -489,6 +536,211 @@ def _fit_portrait_core(
         nfeval=s.nfev,
         return_code=s.code,
     )
+
+
+def _initial_phase_guess_real(Xr, Xi, cvec, DM0, oversamp=2):
+    """_initial_phase_guess on split real/imag parts (complex-free):
+    derotate by DM0, sum channels, dense CCF via the matmul inverse
+    DFT, argmax."""
+    from ..ops.fourier import irfft_mm
+
+    nharm = Xr.shape[-1]
+    nbin = 2 * (nharm - 1)
+    dt = cvec.dtype
+    k = jnp.arange(nharm, dtype=dt)
+    ang = 2.0 * jnp.pi * (cvec * DM0)[:, None] * k
+    c = jnp.cos(ang)
+    s = jnp.sin(ang)
+    xr = jnp.sum(Xr * c - Xi * s, axis=0)
+    xi = jnp.sum(Xr * s + Xi * c, axis=0)
+    nlag = nbin * oversamp
+    ccf = irfft_mm(xr, xi, n=nlag)
+    j0 = jnp.argmax(ccf)
+    phi0 = j0.astype(dt) / nlag
+    return jnp.mod(phi0 + 0.5, 1.0) - 0.5
+
+
+def prepare_portrait_fit_real(port, model, w, freqs, P, nu_fit, theta0,
+                              seed_phi=True):
+    """Everything before the Newton loop, in pure real arithmetic:
+    matmul DFTs (ops/fourier.py — XLA's TPU FFT is ~2000x slower at
+    these shapes), weighted cross-spectrum as a real pair, model/data
+    powers, and the CCF phase seed.
+
+    Being complex-free end to end lets the whole fit live in ONE
+    program together with the Pallas moment kernel (the runtime cannot
+    compile complex values and Mosaic kernels into the same program).
+    Returns (Xr, Xi, S0, Sd, theta0_seeded).
+    """
+    from ..ops.fourier import rfft_mm
+
+    dt = w.dtype
+    dr, di = rfft_mm(port)
+    mr, mi = rfft_mm(model)
+    # X = dFT * conj(mFT) * w, split into parts
+    Xr = (dr * mr + di * mi) * w
+    Xi = (di * mr - dr * mi) * w
+    cvec, _ = _t_coeffs(freqs, P, nu_fit)
+    cvec = cvec.astype(dt)
+    S0 = jnp.sum((mr**2 + mi**2) * w, axis=-1)
+    Sd = jnp.sum((dr**2 + di**2) * w)
+    if seed_phi:
+        phi0 = _initial_phase_guess_real(Xr, Xi, cvec, theta0[1])
+        theta0 = jnp.where(jnp.arange(5) == 0, phi0, theta0).astype(dt)
+    else:
+        theta0 = theta0.astype(dt)
+    return Xr.astype(dt), Xi.astype(dt), S0, Sd, theta0
+
+
+@partial(
+    jax.jit,
+    static_argnames=("fit_flags", "max_iter", "pallas"),
+)
+def _fit_portrait_core_real(
+    Xr,
+    Xi,
+    S0,
+    Sd,
+    freqs,
+    P,
+    nu_fit,
+    nu_out,
+    theta0,
+    fit_flags=FitFlags(),
+    max_iter=40,
+    ftol=None,
+    pallas=False,
+):
+    """Stage 2 of the split fit: the (phi, DM, GM) Newton loop and
+    result packaging in pure real arithmetic.
+
+    Only valid for fits with no active scattering parameters (the
+    _cgh_fast regime).  With pallas=True the harmonic moments run in
+    the fused TPU kernel; otherwise through equivalent real XLA ops —
+    results match _fit_portrait_core to round-off either way.
+    """
+    assert not (fit_flags[3] or fit_flags[4]), (
+        "real core handles the no-scattering path only")
+    dt = S0.dtype
+    nharm = Xr.shape[-1]
+    flags_arr = FitFlags(*fit_flags).as_array(dt)
+    if ftol is None:
+        ftol = 50.0 * float(jnp.finfo(dt).eps)
+    good0 = S0 > 0.0
+    S0inv = jnp.where(good0, 1.0 / jnp.where(good0, S0, 1.0), 0.0)
+    cvec, gvec = _t_coeffs(freqs, P, nu_fit)
+    cvec = cvec.astype(dt)
+    gvec = gvec.astype(dt)
+
+    if pallas:
+        # pad the harmonic axis for the kernel ONCE, outside the Newton
+        # loop (zero columns contribute nothing to the moments; padding
+        # inside the loop would copy the cross-spectrum every iteration)
+        hp = -nharm % 128
+        Xr = jnp.pad(Xr, ((0, 0), (0, hp)))
+        Xi = jnp.pad(Xi, ((0, 0), (0, hp)))
+
+    def moments(theta):
+        t_n = theta[0] + cvec * theta[1] + gvec * theta[2]
+        if pallas:
+            from ..ops.pallas_kernels import harmonic_moments_real
+
+            return harmonic_moments_real(Xr, Xi, t_n)
+        return _moments_real_xla(t_n, Xr, Xi)
+
+    def cgh(theta):
+        C, C1, C2 = moments(theta)
+        return _cgh_tail(C, C1, C2, S0inv, cvec, gvec, dt)
+
+    s = _newton_loop(cgh, theta0.astype(dt), flags_arr, max_iter, ftol)
+    theta = s.theta
+
+    # one moment pass at the solution serves both the final Hessian and
+    # the scales' C vector
+    C, C1, C2 = moments(theta)
+    _, _, H = _cgh_tail(C, C1, C2, S0inv, cvec, gvec, dt)
+    return _finalize_fit(
+        theta, s, H, C, S0, Sd, nharm, flags_arr, fit_flags,
+        P, nu_fit, nu_out, False, dt)
+
+
+def fit_portrait_batch_fast(
+    ports,
+    models,
+    noise_stds,
+    freqs,
+    P,
+    nu_fit,
+    nu_out=None,
+    theta0=None,
+    fit_flags=FitFlags(),
+    chan_masks=None,
+    max_iter=40,
+    pallas=None,
+):
+    """Batched (phi, DM[, GM]) fit through the split real-arithmetic
+    path: one jit program for the complex preparation, a second
+    complex-free program for the Newton loop so the Pallas moment
+    kernel can run on TPU.  Same results as fit_portrait_batch for
+    no-scattering fits; this is the TPU throughput path (bench.py).
+
+    pallas: None -> use the fused kernel on TPU f32 (use_pallas_moments).
+    """
+    if fit_flags[3] or fit_flags[4]:
+        raise ValueError("fit_portrait_batch_fast: no-scattering fits only")
+    if theta0 is not None and bool(jnp.any(jnp.asarray(theta0)[..., 3] != 0.0)):
+        # a fixed nonzero tau seed activates the scattering kernel in
+        # fit_portrait_batch (derive_use_scatter); the real core would
+        # silently fit as if tau = 0 — refuse instead
+        raise ValueError(
+            "fit_portrait_batch_fast: fixed nonzero tau in theta0 requires "
+            "the scattering kernel; use fit_portrait_batch"
+        )
+    ports = jnp.asarray(ports)
+    nb = ports.shape[0]
+    dt = ports.dtype
+    freqs = jnp.asarray(freqs, dt)
+    f_ax = 0 if freqs.ndim == 2 else None
+    P = jnp.asarray(P, dt)
+    p_ax = 0 if P.ndim == 1 else None
+    nu_fit = jnp.asarray(nu_fit, dt)
+    nf_ax = 0 if nu_fit.ndim == 1 else None
+    if theta0 is None:
+        theta0 = jnp.zeros((nb, 5), dt)
+    nu_out_val = jnp.full((nb,), -1.0 if nu_out is None else nu_out, dt)
+    if chan_masks is None:
+        chan_masks = jnp.ones(ports.shape[:2], dt)
+    if pallas is None:
+        pallas = use_pallas_moments(dt)
+
+    fit = _fast_batch_fn(
+        FitFlags(*[bool(f) for f in fit_flags]), int(max_iter),
+        bool(pallas), f_ax, p_ax, nf_ax)
+    return fit(
+        ports, jnp.asarray(models), jnp.asarray(noise_stds), chan_masks,
+        freqs, P, nu_fit, nu_out_val, theta0)
+
+
+@lru_cache(maxsize=None)
+def _fast_batch_fn(fit_flags, max_iter, pallas, f_ax, p_ax, nf_ax):
+    """Cached jitted end-to-end fast fit — a fresh jit per call would
+    recompile every invocation.  One program: matmul DFTs, real
+    cross-spectrum, CCF seed, Newton loop (Pallas moments when
+    enabled), finalize — no complex types anywhere."""
+
+    def one(port, model, noise_stds, chan_mask, freqs, P, nu_fit, nu_out,
+            theta0):
+        nbin = port.shape[-1]
+        w = make_weights(noise_stds, nbin, chan_mask, dtype=port.dtype)
+        Xr, Xi, S0, Sd, th0 = prepare_portrait_fit_real(
+            port, model.astype(port.dtype), w, freqs, P, nu_fit, theta0,
+            seed_phi=bool(fit_flags[0]))
+        return _fit_portrait_core_real.__wrapped__(
+            Xr, Xi, S0, Sd, freqs, P, nu_fit, nu_out, th0,
+            fit_flags=fit_flags, max_iter=max_iter, pallas=pallas)
+
+    return jax.jit(jax.vmap(
+        one, in_axes=(0, 0, 0, 0, f_ax, p_ax, nf_ax, 0, 0)))
 
 
 def derive_use_scatter(fit_flags, log10_tau, theta0):

@@ -1,0 +1,152 @@
+"""Pallas TPU kernels for the fit hot loop.
+
+The wideband fit's per-iteration cost is the harmonic-moment
+computation (fit/portrait.py _cgh_fast): build the per-channel phasor
+e^{i 2 pi t_n k}, multiply into the weighted cross-spectrum X, and
+reduce three moments over harmonics.  The XLA path materializes the
+(nchan, nharm) phasor and W = X * ph between fusions; this kernel
+fuses phasor generation (VPU sin/cos), the complex multiply, and all
+three reductions in a single VMEM pass — X is read from HBM exactly
+once per iteration and nothing (nchan, nharm)-shaped is written back.
+
+Used automatically on TPU backends (fit/portrait.py dispatches); the
+XLA path remains the reference implementation and the two are tested
+against each other (tests/test_pallas.py, interpret mode on CPU).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on all platforms; guard anyway
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+# channel-block rows per kernel instance (f32 sublane tile is 8;
+# 128 keeps the VPU busy and the (BN, nharm) X block well under VMEM)
+_BN = 128
+_LANE = 128
+
+
+def _moments_kernel(t_ref, xr_ref, xi_ref, out_ref):
+    """One (BN, Hp) block: phasor + complex multiply + 3 reductions.
+
+    t_ref: (BN, 1) phases t_n [rotations]; xr/xi: (BN, Hp) real/imag
+    of X with zero padding; out: (BN, LANE) with lanes 0/1/2 holding
+    (C, C1, C2) per channel row.
+    """
+    xr = xr_ref[:]
+    xi = xi_ref[:]
+    bn, hp = xr.shape
+    k_int = jax.lax.broadcasted_iota(jnp.int32, (bn, hp), 1)
+    k2pi = 2.0 * jnp.pi * k_int.astype(xr.dtype)
+    ang = t_ref[:] * k2pi  # (BN, Hp)
+    c = jnp.cos(ang)
+    s = jnp.sin(ang)
+    wr = xr * c - xi * s
+    wi = xr * s + xi * c
+    C = jnp.sum(wr, axis=1, keepdims=True)                 # Z0.real
+    C1 = -jnp.sum(wi * k2pi, axis=1, keepdims=True)        # -Z1.imag
+    C2 = -jnp.sum(wr * k2pi * k2pi, axis=1, keepdims=True)  # -Z2.real
+    lane = jax.lax.broadcasted_iota(jnp.int32, (bn, _LANE), 1)
+    out = jnp.where(lane == 0, C, 0.0)
+    out = jnp.where(lane == 1, C1, out)
+    out = jnp.where(lane == 2, C2, out)
+    out_ref[:] = out
+
+
+def _moments_impl(Xr, Xi, t, interpret=None):
+    """(C, C1, C2) harmonic moments of X = Xr + i Xi under per-channel
+    rotation t — everything real-valued in and out.
+
+    Xr, Xi: (nchan, nharm) real/imag parts; t: (nchan,) phases in
+    rotations.  Returns three (nchan,) real arrays:
+      C  = Re sum_k X e^{i 2 pi t k}
+      C1 = -Im sum_k X e^{i 2 pi t k} (2 pi k)
+      C2 = -Re sum_k X e^{i 2 pi t k} (2 pi k)^2
+    Matches the XLA forms in fit/portrait.py exactly (same f32 sin/cos
+    semantics).
+
+    The split-real signature is deliberate: the tunneled TPU runtime
+    fails to compile programs that contain BOTH a complex-typed value
+    and a Mosaic kernel, so the fit's real core (fit/portrait.py
+    _fit_portrait_core_real) keeps the whole program complex-free.
+    """
+    if interpret is None:
+        # Mosaic compiles on TPU only; everywhere else (CPU tests,
+        # virtual-device meshes) fall back to interpret mode
+        interpret = jax.default_backend() != "tpu"
+    nchan, nharm = Xr.shape
+    dt = Xr.dtype
+    np_ = -nchan % _BN
+    hp = -nharm % _LANE
+    xr = jnp.pad(Xr, ((0, np_), (0, hp)))
+    xi = jnp.pad(Xi, ((0, np_), (0, hp)))
+    tcol = jnp.pad(t.astype(dt), (0, np_)).reshape(-1, 1)
+    nb = (nchan + np_) // _BN
+    # index maps use i*0 instead of literal 0: under jax_enable_x64 a
+    # literal becomes an i64 constant next to the i32 grid index, which
+    # Mosaic fails to legalize ("func.return (i32, i64)")
+    out = pl.pallas_call(
+        _moments_kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((_BN, 1), lambda i: (i, i * 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((_BN, nharm + hp), lambda i: (i, i * 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((_BN, nharm + hp), lambda i: (i, i * 0),
+                         memory_space=_VMEM),
+        ],
+        out_specs=pl.BlockSpec((_BN, _LANE), lambda i: (i, i * 0),
+                               memory_space=_VMEM),
+        out_shape=jax.ShapeDtypeStruct((nchan + np_, _LANE), dt),
+        interpret=interpret,
+    )(tcol, xr, xi)
+    return out[:nchan, 0], out[:nchan, 1], out[:nchan, 2]
+
+
+@jax.custom_batching.custom_vmap
+def harmonic_moments_real(Xr, Xi, t):
+    return _moments_impl(Xr, Xi, t)
+
+
+@harmonic_moments_real.def_vmap
+def _moments_vmap_rule(axis_size, in_batched, Xr, Xi, t):
+    """vmap by flattening the batch into kernel rows: one big Pallas
+    grid instead of a small per-fit grid replicated axis_size times
+    (which loses to XLA on dispatch/pipelining)."""
+    xb, ib, tb = in_batched
+    if not xb:
+        Xr = jnp.broadcast_to(Xr, (axis_size,) + Xr.shape)
+    if not ib:
+        Xi = jnp.broadcast_to(Xi, (axis_size,) + Xi.shape)
+    if not tb:
+        t = jnp.broadcast_to(t, (axis_size,) + t.shape)
+    nb, nchan, nharm = Xr.shape
+    C, C1, C2 = harmonic_moments_real(
+        Xr.reshape(nb * nchan, nharm),
+        Xi.reshape(nb * nchan, nharm),
+        t.reshape(nb * nchan),
+    )
+    out = tuple(c.reshape(nb, nchan) for c in (C, C1, C2))
+    return out, (True, True, True)
+
+
+def harmonic_moments(X, t, interpret=False):
+    """Complex-input convenience wrapper (tests / CPU interpret mode).
+
+    Do not use inside TPU programs that reach the Pallas kernel — see
+    harmonic_moments_real for why.
+    """
+    dt = jnp.float32 if X.dtype == jnp.complex64 else jnp.float64
+    xr, xi = X.real.astype(dt), X.imag.astype(dt)
+    if interpret:
+        return _moments_impl(xr, xi, t, interpret=True)
+    return harmonic_moments_real(xr, xi, t)

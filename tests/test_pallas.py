@@ -1,0 +1,162 @@
+"""Fast-path validation: matmul real DFT parity with numpy's FFT, the
+Pallas harmonic-moment kernel (interpret mode on CPU) against the XLA
+reference forms, and end-to-end fit_portrait_batch_fast parity with the
+complex-arithmetic fit_portrait_batch."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.fit import fit_portrait_batch, fit_portrait_batch_fast
+from pulseportraiture_tpu.fit.portrait import _moments_real_xla, _moments_xla
+from pulseportraiture_tpu.ops.fourier import irfft_mm, rfft_mm
+from pulseportraiture_tpu.ops.pallas_kernels import harmonic_moments_real
+from pulseportraiture_tpu.synth import default_test_model, fake_portrait
+
+P = 0.003
+NCHAN, NBIN = 32, 512
+FREQS = jnp.asarray(np.linspace(1200.0, 1999.0, NCHAN) + 0.5)
+
+
+# --- matmul DFT ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [64, 255, 1024])
+def test_rfft_mm_matches_numpy(rng, n):
+    x = jnp.asarray(rng.normal(size=(5, n)))
+    Xr, Xi = rfft_mm(x)
+    ref = np.fft.rfft(np.asarray(x))
+    assert np.allclose(Xr, ref.real, atol=1e-10 * n)
+    assert np.allclose(Xi, ref.imag, atol=1e-10 * n)
+
+
+@pytest.mark.parametrize("n", [64, 255, 1024])
+def test_irfft_mm_roundtrip(rng, n):
+    x = jnp.asarray(rng.normal(size=(3, n)))
+    Xr, Xi = rfft_mm(x)
+    back = irfft_mm(Xr, Xi, n)
+    assert np.allclose(back, x, atol=1e-11 * n)
+
+
+# --- Pallas moment kernel (interpret mode on CPU) ------------------------
+
+
+@pytest.mark.parametrize("nchan,nharm", [(8, 33), (130, 257), (64, 128)])
+def test_harmonic_moments_match_xla(rng, nchan, nharm):
+    Xr = jnp.asarray(rng.normal(size=(nchan, nharm)), jnp.float32)
+    Xi = jnp.asarray(rng.normal(size=(nchan, nharm)), jnp.float32)
+    t = jnp.asarray(rng.uniform(-0.5, 0.5, nchan), jnp.float32)
+    C, C1, C2 = harmonic_moments_real(Xr, Xi, t)
+    Cx, C1x, C2x = _moments_real_xla(t, Xr, Xi)
+    # identical math, different schedule: f32 sin/cos of large angles
+    # (up to 2 pi t k ~ 1e3 rad) reduce differently between the two,
+    # bounding agreement at ~1e-3 relative; the f64 end-to-end parity
+    # test below pins the math itself
+    for a, b in ((C, Cx), (C1, C1x), (C2, C2x)):
+        tol = 2e-3 * max(1.0, float(jnp.abs(b).max()))
+        assert np.allclose(a, b, atol=tol)
+
+
+def test_harmonic_moments_vmap_flattens(rng):
+    """The custom vmap rule must equal a python loop over the batch."""
+    nb, nchan, nharm = 3, 16, 65
+    Xr = jnp.asarray(rng.normal(size=(nb, nchan, nharm)), jnp.float32)
+    Xi = jnp.asarray(rng.normal(size=(nb, nchan, nharm)), jnp.float32)
+    t = jnp.asarray(rng.uniform(-0.5, 0.5, (nb, nchan)), jnp.float32)
+    Cb, C1b, C2b = jax.vmap(harmonic_moments_real)(Xr, Xi, t)
+    for i in range(nb):
+        C, C1, C2 = harmonic_moments_real(Xr[i], Xi[i], t[i])
+        assert np.allclose(Cb[i], C, rtol=1e-6, atol=1e-4)
+        assert np.allclose(C1b[i], C1, rtol=1e-6, atol=1e-2)
+        assert np.allclose(C2b[i], C2, rtol=1e-6, atol=1.0)
+
+
+def test_moments_real_vs_complex(rng):
+    """Split-real XLA moments == complex XLA moments (f64)."""
+    nchan, nharm = 16, 129
+    X = jnp.asarray(rng.normal(size=(nchan, nharm)) + 1j * rng.normal(size=(nchan, nharm)))
+    t = jnp.asarray(rng.uniform(-0.5, 0.5, nchan))
+    Cc, C1c, C2c = _moments_xla(t, X)
+    Cr, C1r, C2r = _moments_real_xla(t, X.real, X.imag)
+    assert np.allclose(Cc, Cr)
+    assert np.allclose(C1c, C1r)
+    assert np.allclose(C2c, C2r)
+
+
+# --- end-to-end fast-path parity ----------------------------------------
+
+
+def _batch(key, nb=4):
+    model = default_test_model(nu_ref=1500.0)
+    keys = jax.random.split(key, nb)
+    phis = np.linspace(-0.2, 0.25, nb)
+    dms = np.linspace(-2e-3, 3e-3, nb)
+    ports, models, stds = [], [], []
+    for k, phi, dm in zip(keys, phis, dms):
+        pb = fake_portrait(k, model, FREQS, NBIN, P, phi=phi, DM=dm, noise_std=0.05)
+        ports.append(pb.port)
+        models.append(pb.model_port)
+        stds.append(pb.noise_stds)
+    return (jnp.stack(ports), jnp.stack(models), jnp.stack(stds)), phis, dms
+
+
+@pytest.mark.parametrize("pallas", [False, True])
+def test_fast_batch_matches_reference(key, pallas):
+    (ports, models, stds), phis, dms = _batch(key)
+    a = fit_portrait_batch(ports, models, stds, FREQS, P, 1500.0)
+    b = fit_portrait_batch_fast(
+        ports, models, stds, FREQS, P, 1500.0, pallas=pallas
+    )
+    assert np.allclose(a.phi, b.phi, atol=1e-10)
+    assert np.allclose(a.DM, b.DM, atol=1e-10)
+    assert np.allclose(a.phi_err, b.phi_err, rtol=1e-6)
+    assert np.allclose(a.DM_err, b.DM_err, rtol=1e-6)
+    assert np.allclose(a.snr, b.snr, rtol=1e-8)
+    assert np.allclose(a.chi2, b.chi2, rtol=1e-6)
+    assert np.allclose(a.nu_DM, b.nu_DM, rtol=1e-8)
+    # the fast path must still recover the injections
+    assert np.abs(np.asarray(b.phi) - phis).max() < 1e-3
+
+
+def test_fast_batch_masked_channels(key):
+    (ports, models, stds), phis, dms = _batch(key)
+    mask = jnp.ones(ports.shape[:2])
+    mask = mask.at[:, ::5].set(0.0)
+    a = fit_portrait_batch(
+        ports, models, stds, FREQS, P, 1500.0, chan_masks=mask
+    )
+    b = fit_portrait_batch_fast(
+        ports, models, stds, FREQS, P, 1500.0, chan_masks=mask
+    )
+    assert np.allclose(a.phi, b.phi, atol=1e-10)
+    assert np.allclose(a.DM, b.DM, atol=1e-10)
+
+
+def test_fast_batch_rejects_scattering_flags():
+    from pulseportraiture_tpu.fit import FitFlags
+
+    with pytest.raises(ValueError):
+        fit_portrait_batch_fast(
+            jnp.zeros((1, 4, 64)),
+            jnp.zeros((1, 4, 64)),
+            jnp.ones((1, 4)),
+            jnp.linspace(1000.0, 1100.0, 4),
+            P,
+            1050.0,
+            fit_flags=FitFlags(True, True, False, True, False),
+        )
+
+
+def test_fast_batch_rejects_fixed_tau_seed():
+    theta0 = jnp.zeros((1, 5)).at[0, 3].set(1.0e-4)
+    with pytest.raises(ValueError):
+        fit_portrait_batch_fast(
+            jnp.zeros((1, 4, 64)),
+            jnp.zeros((1, 4, 64)),
+            jnp.ones((1, 4)),
+            jnp.linspace(1000.0, 1100.0, 4),
+            P,
+            1050.0,
+            theta0=theta0,
+        )
