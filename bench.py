@@ -27,20 +27,56 @@ def main():
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
 
-    NB, NCHAN, NBIN = 128, 512, 2048
+    # batch size amortizes the tunneled runtime's ~100 ms per-dispatch
+    # floor; 640 x 512 x 2048 (f32) keeps all DFT intermediates in HBM
+    NB, NCHAN, NBIN = 640, 512, 2048
     DTYPE = jnp.float32
     P = 0.003
     NU_FIT = 1500.0
 
     # --- synthesize the batch on device (f32) ---------------------------
-    from __graft_entry__ import _synth_batch
+    # complex-free: known (phi, DM) injected via matmul DFT rotations
+    # (jnp.fft is unusably slow on this TPU runtime); synth runs at a
+    # smaller batch and tiles up, and the shared model portrait stays a
+    # broadcast instead of NB materialized copies
+    from pulseportraiture_tpu.models.gaussian import gen_gaussian_portrait
+    from pulseportraiture_tpu.ops.fourier import irfft_mm, rfft_mm
+    from pulseportraiture_tpu.ops.phasor import phase_shifts
+    from pulseportraiture_tpu.synth import default_test_model
 
-    dFT, mFT, w, freqs, Ps, nus, nu_out, theta0 = _synth_batch(
-        NB, NCHAN, NBIN, DTYPE
-    )
-    ports = jnp.fft.irfft(dFT, n=NBIN, axis=-1).astype(DTYPE)
-    models = jnp.fft.irfft(mFT, n=NBIN, axis=-1).astype(DTYPE)
+    NB_SYNTH = 128
+    tmodel = default_test_model(NU_FIT)
+    freqs = jnp.linspace(1300.0, 1899.0, NCHAN, dtype=DTYPE)
+    params = {k: jnp.asarray(v, DTYPE)
+              for k, v in tmodel.params_pytree().items()}
+    model_clean = gen_gaussian_portrait(
+        params, freqs, tmodel.nu_ref, NBIN, P=P, code=tmodel.code,
+        scattered=False).astype(DTYPE)
+
+    @jax.jit
+    def synth(key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        phis = 0.1 * jax.random.uniform(k1, (NB_SYNTH,), DTYPE)
+        dms = 0.003 * jax.random.uniform(k2, (NB_SYNTH,), DTYPE)
+        delays = jax.vmap(
+            lambda ph, dm: phase_shifts(ph, dm, 0.0, freqs, P, NU_FIT,
+                                        NU_FIT))(phis, dms)
+        Xr, Xi = rfft_mm(model_clean)
+        k = jnp.arange(Xr.shape[-1], dtype=DTYPE)
+        ang = -2.0 * jnp.pi * delays[..., None] * k  # rotate by -delays
+        c, s = jnp.cos(ang), jnp.sin(ang)
+        rot = irfft_mm(Xr * c - Xi * s, Xr * s + Xi * c, NBIN)
+        return rot + 0.05 * jax.random.normal(k3, rot.shape, DTYPE)
+
+    ports_s = synth(jax.random.PRNGKey(0))
+    ports = jnp.tile(ports_s, (NB // NB_SYNTH, 1, 1))
+    del ports_s
+    # 2-D template -> fit_portrait_batch_fast vmaps it with in_axes=None
+    # (no NB materialized copies in HBM)
+    models = model_clean
     noise = jnp.full((NB, NCHAN), 0.05, DTYPE)
+    Ps = jnp.full((NB,), P, DTYPE)
+    nus = jnp.full((NB,), NU_FIT, DTYPE)
     jax.block_until_ready(ports)
 
     def run():
@@ -62,16 +98,18 @@ def main():
     toas_per_sec = NB / t_tpu
 
     # --- single-core NumPy baseline on a few portraits ------------------
-    ports_np = np.asarray(ports, np.float64)
-    models_np = np.asarray(models, np.float64)
+    # transfer ONLY what the baseline needs: pulling the full batch
+    # through the tunneled runtime is gigabytes and takes minutes
+    n_base = 3
+    ports_np = np.asarray(ports[:n_base], np.float64)
+    model_np = np.asarray(model_clean, np.float64)
     freqs_np = np.asarray(freqs, np.float64)
     noise_np = np.full(NCHAN, 0.05)
 
-    n_base = 3
     t0 = time.perf_counter()
     base_res = [
         fit_portrait_numpy(
-            ports_np[i], models_np[i], noise_np, freqs_np, P, NU_FIT
+            ports_np[i], model_np, noise_np, freqs_np, P, NU_FIT
         )
         for i in range(n_base)
     ]

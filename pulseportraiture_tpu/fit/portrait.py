@@ -684,6 +684,8 @@ def fit_portrait_batch_fast(
     kernel can run on TPU.  Same results as fit_portrait_batch for
     no-scattering fits; this is the TPU throughput path (bench.py).
 
+    models may be (nb, nchan, nbin) or a shared (nchan, nbin) template
+    (vmapped with in_axes=None — no batch materialization).
     pallas: None -> use the fused kernel on TPU f32 (use_pallas_moments).
     """
     if fit_flags[3] or fit_flags[4]:
@@ -699,6 +701,8 @@ def fit_portrait_batch_fast(
     ports = jnp.asarray(ports)
     nb = ports.shape[0]
     dt = ports.dtype
+    models = jnp.asarray(models)
+    m_ax = 0 if models.ndim == 3 else None  # 2-D = shared template
     freqs = jnp.asarray(freqs, dt)
     f_ax = 0 if freqs.ndim == 2 else None
     P = jnp.asarray(P, dt)
@@ -715,14 +719,14 @@ def fit_portrait_batch_fast(
 
     fit = _fast_batch_fn(
         FitFlags(*[bool(f) for f in fit_flags]), int(max_iter),
-        bool(pallas), f_ax, p_ax, nf_ax)
+        bool(pallas), m_ax, f_ax, p_ax, nf_ax)
     return fit(
-        ports, jnp.asarray(models), jnp.asarray(noise_stds), chan_masks,
+        ports, models, jnp.asarray(noise_stds), chan_masks,
         freqs, P, nu_fit, nu_out_val, theta0)
 
 
 @lru_cache(maxsize=None)
-def _fast_batch_fn(fit_flags, max_iter, pallas, f_ax, p_ax, nf_ax):
+def _fast_batch_fn(fit_flags, max_iter, pallas, m_ax, f_ax, p_ax, nf_ax):
     """Cached jitted end-to-end fast fit — a fresh jit per call would
     recompile every invocation.  One program: matmul DFTs, real
     cross-spectrum, CCF seed, Newton loop (Pallas moments when
@@ -740,7 +744,7 @@ def _fast_batch_fn(fit_flags, max_iter, pallas, f_ax, p_ax, nf_ax):
             fit_flags=fit_flags, max_iter=max_iter, pallas=pallas)
 
     return jax.jit(jax.vmap(
-        one, in_axes=(0, 0, 0, 0, f_ax, p_ax, nf_ax, 0, 0)))
+        one, in_axes=(0, m_ax, 0, 0, f_ax, p_ax, nf_ax, 0, 0)))
 
 
 def derive_use_scatter(fit_flags, log10_tau, theta0):
@@ -859,6 +863,7 @@ def fit_portrait_batch(
     log10_tau=False,
     max_iter=40,
     use_scatter=None,
+    ir_FT=None,
 ):
     """vmapped portrait fit over a leading batch dimension.
 
@@ -866,6 +871,9 @@ def fit_portrait_batch(
     freqs: (nchan,) shared or (nb, nchan); P, nu_fit: scalar or (nb,).
     use_scatter: None -> derived from fit_flags/log10_tau/theta0 (a
     fixed nonzero tau in theta0 must still be applied to the model).
+    ir_FT: optional (nchan, nharm) instrumental-response FT shared by
+    the whole batch (ops.instrumental_response_port_FT; reference
+    convolves the model per subint at pptoas.py:428-434).
     """
     ports = jnp.asarray(ports)
     nb = ports.shape[0]
@@ -885,15 +893,17 @@ def fit_portrait_batch(
         theta0 = jnp.zeros((nb, 5), w.dtype)
     nu_out_val = jnp.full((nb,), -1.0 if nu_out is None else nu_out, w.dtype)
 
+    use_ir = ir_FT is not None
     core = jax.vmap(
         partial(
             _fit_portrait_core,
             fit_flags=FitFlags(*[bool(f) for f in fit_flags]),
             log10_tau=log10_tau,
             max_iter=max_iter,
-            use_ir=False,
+            use_ir=use_ir,
             use_scatter=use_scatter,
         ),
-        in_axes=(0, 0, 0, f_ax, p_ax, nf_ax, 0, 0),
+        in_axes=(0, 0, 0, f_ax, p_ax, nf_ax, 0, 0, None),
     )
-    return core(dFT, mFT, w, freqs, P, nu_fit, nu_out_val, theta0)
+    ir_arg = jnp.asarray(ir_FT, w.dtype) if use_ir else None
+    return core(dFT, mFT, w, freqs, P, nu_fit, nu_out_val, theta0, ir_arg)

@@ -92,6 +92,11 @@ class GetTOAs:
                 f"> {MAX_NFILE} archives in one run; split the metafile")
         self.modelfile = str(modelfile)
         self.model = TemplateModel(modelfile, quiet=quiet)
+        # mutable instrumental-response config (parity:
+        # pptoas.py:156-158): set "DM-smear" True and/or append
+        # (width [rot], kind) pairs to wids/irf_types before get_TOAs
+        self.instrumental_response_dict = {
+            "DM-smear": False, "wids": [], "irf_types": []}
         self.obs = []
         self.doppler_fs = []
         self.nu0s = []
@@ -238,6 +243,29 @@ class GetTOAs:
                     flags = base
                 groups.setdefault(flags, []).append(i)
 
+            # instrumental-response FT for this archive's layout
+            # (pptoas.py:428-434): product of configured achromatic
+            # kernels and, optionally, per-channel DM-smearing sincs
+            ird = self.instrumental_response_dict
+            if len(ird["wids"]) != len(ird["irf_types"]):
+                raise ValueError(
+                    "instrumental_response_dict: wids and irf_types must "
+                    f"pair up (got {len(ird['wids'])} widths, "
+                    f"{len(ird['irf_types'])} kinds)")
+            if ird["wids"] or ird["DM-smear"]:
+                from ..ops.gaussian import instrumental_response_port_FT
+
+                chan_bw = float(np.abs(np.median(np.diff(freqs0)))) \
+                    if nchan > 1 else float(d.bw) / max(nchan, 1)
+                ir_FT = instrumental_response_port_FT(
+                    nbin // 2 + 1, jnp.asarray(freqs0),
+                    widths=tuple(ird["wids"]),
+                    kinds=tuple(ird["irf_types"]),
+                    DM_smear=DM_guess if ird["DM-smear"] else None,
+                    chan_bw=chan_bw, P=P_mean)
+            else:
+                ir_FT = None
+
             fit_duration = 0.0
             res_arrays = {k: np.full(nok, np.nan) for k in
                           ("phi", "phi_err", "DM", "DM_err", "GM", "GM_err",
@@ -267,6 +295,7 @@ class GetTOAs:
                     chan_masks=jnp.asarray(masks[idx]),
                     log10_tau=log10_tau and flags[3],
                     max_iter=max_iter,
+                    ir_FT=ir_FT,
                 )
                 r = {k: np.asarray(v) for k, v in r._asdict().items()}
                 fit_duration += time.time() - tfit
@@ -570,6 +599,105 @@ class GetTOAs:
                         float(phase_err[j, ichan]) * P * 1e6,
                         d.telescope, d.telescope_code, None, None,
                         toa_flags))
+
+    # ------------------------------------------------------------------
+    def get_crosscheck_TOAs(self, datafile=None, tscrunch=False,
+                            DM0=None, oversamp=16, addtnl_toa_flags={},
+                            quiet=None):
+        """Independent-algorithm TOA cross-check (the role of the
+        reference's get_psrchive_TOAs, pptoas.py:1191-1264, which
+        delegated to PSRCHIVE's ArrivalTime/'pat'; with the PSRCHIVE
+        dependency dropped, this provides the second opinion).
+
+        Pure-NumPy f64 time-domain estimator sharing no code with the
+        harmonic-domain Newton engine: channels are derotated by the
+        header DM, frequency-scrunched with 1/sigma^2 weights, and the
+        phase shift found by argmax of the oversampled circular
+        cross-correlation with the scrunched template, refined by
+        parabolic interpolation; errors from the FFTFIT curvature
+        formula.  Returns the list of TOA objects (also appended to
+        TOA_list)."""
+        if quiet is None:
+            quiet = self.quiet
+        datafiles = self.datafiles if datafile is None else [datafile]
+        out = []
+        for datafile in datafiles:
+            try:
+                d = load_data(datafile, dedisperse=False,
+                              dededisperse=True, tscrunch=tscrunch,
+                              pscrunch=True, quiet=quiet)
+            except Exception as e:
+                print(f"Skipping {datafile}: {e}")
+                continue
+            ok = np.asarray(d.ok_isubs, int)
+            if len(ok) == 0:
+                continue
+            nchan, nbin = d.nchan, d.nbin
+            nharm = nbin // 2 + 1
+            freqs0 = np.asarray(d.freqs[0], float)
+            P_mean = float(np.mean(d.Ps[ok]))
+            modelx = np.asarray(
+                self.model.portrait(freqs0, nbin, P=P_mean), float)
+            DM_guess = float(d.DM) if d.DM else (DM0 or 0.0)
+            k = np.arange(nharm)
+            nlag = nbin * oversamp
+            Mf_chan = np.fft.rfft(modelx, axis=-1)  # constant per archive
+            for isub in ok:
+                P = float(d.Ps[isub])
+                okc = np.asarray(d.ok_ichans[isub], int)
+                if len(okc) == 0:
+                    continue
+                port = np.asarray(d.subints[isub, 0], float)
+                sig = np.asarray(d.noise_stds[isub, 0], float)
+                wch = np.zeros(nchan)
+                wch[okc] = np.where(sig[okc] > 0, sig[okc] ** -2.0, 0.0)
+                # derotate the DATA by the header DM so its channels add
+                # coherently; the template's channels are already
+                # aligned (no dispersion), so they sum as-is
+                delays = (Dconst * DM_guess / P) * (
+                    freqs0 ** -2.0 - float(d.nu0) ** -2.0)
+                ph = np.exp(2.0j * np.pi * np.outer(delays, k))
+                Df = (np.fft.rfft(port, axis=-1) * ph * wch[:, None]).sum(0)
+                Mf = (Mf_chan * wch[:, None]).sum(0)
+                # oversampled circular CCF + parabolic refinement
+                cc = np.fft.irfft(Df * np.conj(Mf), n=nlag)
+                j0 = int(np.argmax(cc))
+                ym, y0, yp = cc[(j0 - 1) % nlag], cc[j0], cc[(j0 + 1) % nlag]
+                denom = ym - 2.0 * y0 + yp
+                frac = 0.5 * (ym - yp) / denom if denom != 0.0 else 0.0
+                phi = (j0 + frac) / nlag
+                phi = (phi + 0.5) % 1.0 - 0.5
+                # FFTFIT curvature error: the scrunched profile's noise
+                # (E|rfft_k|^2 = nbin sigma^2 for white noise; same
+                # convention as ops/noise.get_noise_PS)
+                prof = np.fft.irfft(Df / max(wch.sum(), 1e-300), n=nbin)
+                spec = np.abs(np.fft.rfft(prof)) ** 2
+                noise = np.sqrt(np.mean(spec[-len(spec) // 4:]) / nbin)
+                sigF = noise * np.sqrt(nbin / 2.0) * max(wch.sum(), 1e-300)
+                e = np.exp(2.0j * np.pi * k * phi)
+                p = (np.abs(Mf) ** 2).sum() / sigF ** 2
+                c = np.real(Df * np.conj(Mf) * e).sum() / sigF ** 2
+                c2 = np.real(Df * np.conj(Mf) * e
+                             * (2.0 * np.pi * k) ** 2).sum() / sigF ** 2
+                scale = max(c, 0.0) / p
+                phi_err = (abs(scale * c2)) ** -0.5 \
+                    if scale > 0 and c2 != 0 else 1.0 / nbin
+                toa_mjd = d.epochs[isub].add_seconds(
+                    phi * P + d.backend_delay)
+                toa_flags = {
+                    "be": d.backend, "fe": d.frontend,
+                    "f": f"{d.frontend}_{d.backend}",
+                    "nbin": int(nbin), "subint": int(isub),
+                    "tobs": float(d.subtimes[isub]),
+                    "tmplt": self.modelfile, "alg": "ccf-parabolic",
+                }
+                toa_flags.update(addtnl_toa_flags)
+                toa = TOA(datafile, float(d.nu0), toa_mjd,
+                          phi_err * P * 1e6, d.telescope,
+                          d.telescope_code, None, None, toa_flags)
+                out.append(toa)
+                self.TOA_list.append(toa)
+        return out
 
     # ------------------------------------------------------------------
     def _fitted_model(self, iarch, isub, d, modelx, freqs0):

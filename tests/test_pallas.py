@@ -119,6 +119,20 @@ def test_fast_batch_matches_reference(key, pallas):
     assert np.abs(np.asarray(b.phi) - phis).max() < 1e-3
 
 
+def test_fast_batch_shared_model(key):
+    """A shared 2-D template gives the same answers as per-batch
+    copies of it."""
+    (ports, models, stds), phis, dms = _batch(key)
+    shared = models[0]
+    a = fit_portrait_batch_fast(
+        ports, jnp.broadcast_to(shared, ports.shape), stds, FREQS, P,
+        1500.0)
+    b = fit_portrait_batch_fast(ports, shared, stds, FREQS, P, 1500.0)
+    assert np.allclose(a.phi, b.phi, atol=1e-12)
+    assert np.allclose(a.DM, b.DM, atol=1e-12)
+    assert np.allclose(a.snr, b.snr, rtol=1e-10)
+
+
 def test_fast_batch_masked_channels(key):
     (ports, models, stds), phis, dms = _batch(key)
     mask = jnp.ones(ports.shape[:2])

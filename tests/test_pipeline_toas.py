@@ -155,3 +155,68 @@ def test_channels_to_zap_flags_corrupted(dataset, tmp_path):
     zaps = gt.get_channels_to_zap(SNR_threshold=5.0, rchi2_threshold=2.0)
     assert 10 in zaps[0][0]
     assert len(zaps[0][0]) <= 4  # does not flag the whole band
+
+
+def test_crosscheck_toas_agree_with_wideband(dataset):
+    """The independent time-domain CCF estimator must agree with the
+    harmonic-domain Newton fit at the few-bin-error level (the role of
+    the reference's get_psrchive_TOAs cross-check, pptoas.py:1191)."""
+    meta, gmodel, files = dataset
+    gt = GetTOAs(files[0], gmodel, quiet=True)
+    gt.get_TOAs(quiet=True)
+    gt2 = GetTOAs(files[0], gmodel, quiet=True)
+    toas = gt2.get_crosscheck_TOAs(quiet=True)
+    assert len(toas) == 3
+    assert toas[0].flags["alg"] == "ccf-parabolic"
+    from pulseportraiture_tpu.config import Dconst
+
+    P = PAR["P0"]
+    for j, isub in enumerate(gt.ok_isubs[0]):
+        # re-reference the wideband TOA (at its nu_DM) to the
+        # crosscheck's nu0 via the fitted DM: t(nu) = t_inf +
+        # Dconst*DM/nu^2 seconds
+        nu_DM = float(gt.nu_refs[0][isub][0])
+        nu0 = toas[j].frequency
+        shift = Dconst * float(gt.DMs[0][isub]) * (nu0 ** -2.0
+                                                   - nu_DM ** -2.0)
+        t_wb = gt.TOAs[0][isub]
+        t_cc = toas[j].MJD
+        dt_sec = ((t_wb.day - t_cc.day) * 86400.0
+                  + (t_wb.frac - t_cc.frac) * 86400.0 + shift)
+        dphi = (dt_sec / P) % 1.0
+        dphi = min(dphi, 1.0 - dphi)
+        # independent estimators: allow a few phase bins (nbin=256)
+        assert dphi < 10.0 / 256.0, (isub, dphi)
+
+
+def test_instrumental_response_plumbed(dataset):
+    """Enabling the instrumental-response config changes the model the
+    fit sees but leaves the TOAs nearly unchanged for thin channels."""
+    meta, gmodel, files = dataset
+    gt = GetTOAs(files[0], gmodel, quiet=True)
+    gt.get_TOAs(quiet=True)
+    gt_ir = GetTOAs(files[0], gmodel, quiet=True)
+    gt_ir.instrumental_response_dict["DM-smear"] = True
+    gt_ir.get_TOAs(quiet=True)
+    ok = gt.ok_isubs[0]
+    assert np.all(np.isfinite(gt_ir.phis[0][ok]))
+    # each run references phi to its own nu_DM — compare at a common
+    # frequency; DM-smearing kernels are symmetric so the phase budge
+    # should be small
+    from pulseportraiture_tpu.ops import phase_transform
+
+    P = PAR["P0"]
+    for isub in ok:
+        a = float(phase_transform(gt.phis[0][isub], gt.DMs[0][isub],
+                                  gt.nu_refs[0][isub][0], 1500.0, P))
+        b = float(phase_transform(gt_ir.phis[0][isub], gt_ir.DMs[0][isub],
+                                  gt_ir.nu_refs[0][isub][0], 1500.0, P))
+        d = abs(a - b) % 1.0
+        assert min(d, 1.0 - d) < 2e-3, (isub, a, b)
+    # wide boxcar smearing must actually change the fit
+    gt_w = GetTOAs(files[0], gmodel, quiet=True)
+    gt_w.instrumental_response_dict["wids"].append(0.05)
+    gt_w.instrumental_response_dict["irf_types"].append("rect")
+    gt_w.get_TOAs(quiet=True)
+    assert np.all(np.isfinite(gt_w.phis[0][ok]))
+    assert not np.allclose(gt_w.snrs[0][ok], gt.snrs[0][ok])
