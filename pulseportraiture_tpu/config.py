@@ -39,6 +39,11 @@ wid_max = 0.25
 # XLA path, which is the reference implementation).
 use_pallas = "auto"
 
+# Route no-scattering pipeline fits through the complex-free f32 fast
+# path (fit_portrait_batch_fast).  'auto' = on TPU backends (where
+# complex FFTs are unsupported or unusably slow); True/False force.
+use_fast_fit = "auto"
+
 # --- Model evolution codes ------------------------------------------------
 # Per-parameter evolution function code string for .gmodel files:
 # one digit each for (loc, wid, amp); '0' = power law, '1' = linear

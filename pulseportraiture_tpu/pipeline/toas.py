@@ -21,11 +21,14 @@ statistics — re-architected TPU-first:
 
 import time
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import config
 from ..config import Dconst, scattering_alpha
-from ..fit.portrait import FitFlags, fit_portrait_batch
+from ..fit.portrait import (FitFlags, fit_portrait_batch,
+                            fit_portrait_batch_fast)
 from ..io.psrfits import load_data
 from ..io.tim import TOA
 from ..ops.scattering import scattering_portrait_FT, scattering_times
@@ -281,22 +284,50 @@ class GetTOAs:
             for flags, idx in groups.items():
                 idx = np.asarray(idx, int)
                 tfit = time.time()
-                r = fit_portrait_batch(
-                    jnp.asarray(ports[idx]),
-                    jnp.asarray(np.broadcast_to(modelx,
-                                                ports[idx].shape)),
-                    jnp.asarray(noise[idx]),
-                    jnp.asarray(freqs0),
-                    jnp.asarray(d.Ps[ok][idx]),
-                    jnp.asarray(nu_fit_arr[idx]),
-                    nu_out=nu_ref_DM,
-                    theta0=jnp.asarray(theta0[idx]),
-                    fit_flags=FitFlags(*flags),
-                    chan_masks=jnp.asarray(masks[idx]),
-                    log10_tau=log10_tau and flags[3],
-                    max_iter=max_iter,
-                    ir_FT=ir_FT,
-                )
+                # no-scattering fits route through the complex-free f32
+                # fast path on TPU backends, where complex FFTs are
+                # unsupported/unusably slow (config.use_fast_fit)
+                fast_setting = getattr(config, "use_fast_fit", "auto")
+                use_fast = (not flags[3] and not flags[4]
+                            and ir_FT is None
+                            # a fixed nonzero tau seed (scat_guess, or a
+                            # scattering run's degenerate subint group)
+                            # still needs the scattering kernel
+                            and not np.any(theta0[idx][:, 3] != 0.0)
+                            and fast_setting is not False
+                            and (fast_setting is True
+                                 or jax.default_backend() == "tpu"))
+                if use_fast:
+                    r = fit_portrait_batch_fast(
+                        jnp.asarray(ports[idx], jnp.float32),
+                        jnp.asarray(modelx, jnp.float32),
+                        jnp.asarray(noise[idx], jnp.float32),
+                        jnp.asarray(freqs0, jnp.float32),
+                        jnp.asarray(d.Ps[ok][idx], jnp.float32),
+                        jnp.asarray(nu_fit_arr[idx], jnp.float32),
+                        nu_out=nu_ref_DM,
+                        theta0=jnp.asarray(theta0[idx], jnp.float32),
+                        fit_flags=FitFlags(*flags),
+                        chan_masks=jnp.asarray(masks[idx], jnp.float32),
+                        max_iter=max_iter,
+                    )
+                else:
+                    r = fit_portrait_batch(
+                        jnp.asarray(ports[idx]),
+                        jnp.asarray(np.broadcast_to(modelx,
+                                                    ports[idx].shape)),
+                        jnp.asarray(noise[idx]),
+                        jnp.asarray(freqs0),
+                        jnp.asarray(d.Ps[ok][idx]),
+                        jnp.asarray(nu_fit_arr[idx]),
+                        nu_out=nu_ref_DM,
+                        theta0=jnp.asarray(theta0[idx]),
+                        fit_flags=FitFlags(*flags),
+                        chan_masks=jnp.asarray(masks[idx]),
+                        log10_tau=log10_tau and flags[3],
+                        max_iter=max_iter,
+                        ir_FT=ir_FT,
+                    )
                 r = {k: np.asarray(v) for k, v in r._asdict().items()}
                 fit_duration += time.time() - tfit
                 for k_res, k_arr in (

@@ -220,3 +220,64 @@ def test_instrumental_response_plumbed(dataset):
     gt_w.get_TOAs(quiet=True)
     assert np.all(np.isfinite(gt_w.phis[0][ok]))
     assert not np.allclose(gt_w.snrs[0][ok], gt.snrs[0][ok])
+
+
+def test_fast_fit_routing_matches_reference(dataset):
+    """config.use_fast_fit=True routes no-scattering pipeline fits
+    through the complex-free f32 fast path; TOAs must agree with the
+    complex f64 reference path to well under a phase bin."""
+    from pulseportraiture_tpu import config
+
+    meta, gmodel, files = dataset
+    old = config.use_fast_fit
+    try:
+        # pin the baseline to the complex path even on TPU hosts, so
+        # this never compares the fast path against itself
+        config.use_fast_fit = False
+        gt = GetTOAs(files[0], gmodel, quiet=True)
+        gt.get_TOAs(quiet=True)
+        config.use_fast_fit = True
+        gt_f = GetTOAs(files[0], gmodel, quiet=True)
+        gt_f.get_TOAs(quiet=True)
+    finally:
+        config.use_fast_fit = old
+    ok = gt.ok_isubs[0]
+    from pulseportraiture_tpu.ops import phase_transform
+
+    P = PAR["P0"]
+    for isub in ok:
+        a = float(phase_transform(gt.phis[0][isub], gt.DMs[0][isub],
+                                  gt.nu_refs[0][isub][0], 1500.0, P))
+        b = float(phase_transform(gt_f.phis[0][isub], gt_f.DMs[0][isub],
+                                  gt_f.nu_refs[0][isub][0], 1500.0, P))
+        d = abs(a - b) % 1.0
+        assert min(d, 1.0 - d) < 1e-4, (isub, a, b)
+    assert np.allclose(gt_f.DMs[0][ok], gt.DMs[0][ok], atol=1e-5)
+    assert np.all(np.isfinite(gt_f.snrs[0][ok]))
+
+
+def test_fast_routing_scat_degenerate_subint(dataset, tmp_path):
+    """A fit_scat run with a 1-good-channel subint must not crash when
+    fast routing is enabled: the degenerate phase-only group carries a
+    nonzero log10-tau seed, which the fast path cannot represent, so it
+    must fall back to the scattering-capable engine."""
+    from pulseportraiture_tpu import config
+
+    model = default_test_model(1500.0)
+    w = np.ones((2, 32))
+    w[0, 1:] = 0.0  # subint 0: single good channel
+    path = str(tmp_path / "degen.fits")
+    make_fake_pulsar(model, PAR, outfile=path, nsub=2, nchan=32, nbin=256,
+                     tsub=60.0, noise_stds=0.08, weights=w,
+                     dedispersed=False, quiet=True, rng=7)
+    meta, gmodel, files = dataset
+    old = config.use_fast_fit
+    try:
+        config.use_fast_fit = True
+        gt = GetTOAs(path, gmodel, quiet=True)
+        gt.get_TOAs(fit_scat=True, quiet=True)
+    finally:
+        config.use_fast_fit = old
+    ok = gt.ok_isubs[0]
+    assert len(gt.TOA_list) == len(ok)
+    assert np.all(np.isfinite(gt.phis[0][ok]))
