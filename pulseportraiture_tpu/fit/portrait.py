@@ -690,14 +690,7 @@ def fit_portrait_batch_fast(
     """
     if fit_flags[3] or fit_flags[4]:
         raise ValueError("fit_portrait_batch_fast: no-scattering fits only")
-    if theta0 is not None and bool(jnp.any(jnp.asarray(theta0)[..., 3] != 0.0)):
-        # a fixed nonzero tau seed activates the scattering kernel in
-        # fit_portrait_batch (derive_use_scatter); the real core would
-        # silently fit as if tau = 0 — refuse instead
-        raise ValueError(
-            "fit_portrait_batch_fast: fixed nonzero tau in theta0 requires "
-            "the scattering kernel; use fit_portrait_batch"
-        )
+    reject_fixed_tau_seed(theta0, "fit_portrait_batch_fast")
     ports = jnp.asarray(ports)
     nb = ports.shape[0]
     dt = ports.dtype
@@ -725,24 +718,41 @@ def fit_portrait_batch_fast(
         freqs, P, nu_fit, nu_out_val, theta0)
 
 
+def fast_fit_one(port, model, noise_stds, chan_mask, freqs, P, nu_fit,
+                 nu_out, theta0, *, fit_flags, max_iter, pallas):
+    """One complex-free fast fit: weights, matmul DFTs + CCF seed, real
+    Newton core — the per-element body shared by the vmapped batch
+    (_fast_batch_fn) and the sharded scale-out path
+    (parallel.fit_portrait_sharded_fast)."""
+    nbin = port.shape[-1]
+    w = make_weights(noise_stds, nbin, chan_mask, dtype=port.dtype)
+    Xr, Xi, S0, Sd, th0 = prepare_portrait_fit_real(
+        port, model.astype(port.dtype), w, freqs, P, nu_fit, theta0,
+        seed_phi=bool(fit_flags[0]))
+    return _fit_portrait_core_real.__wrapped__(
+        Xr, Xi, S0, Sd, freqs, P, nu_fit, nu_out, th0,
+        fit_flags=fit_flags, max_iter=max_iter, pallas=pallas)
+
+
+def reject_fixed_tau_seed(theta0, caller):
+    """The real core has no scattering kernel, so a fixed nonzero tau
+    seed (which fit_portrait_batch would apply via derive_use_scatter)
+    must be refused, not silently dropped."""
+    if theta0 is not None and bool(jnp.any(jnp.asarray(theta0)[..., 3]
+                                           != 0.0)):
+        raise ValueError(
+            f"{caller}: fixed nonzero tau in theta0 requires the "
+            "scattering kernel; use the complex engine instead")
+
+
 @lru_cache(maxsize=None)
 def _fast_batch_fn(fit_flags, max_iter, pallas, m_ax, f_ax, p_ax, nf_ax):
     """Cached jitted end-to-end fast fit — a fresh jit per call would
     recompile every invocation.  One program: matmul DFTs, real
     cross-spectrum, CCF seed, Newton loop (Pallas moments when
     enabled), finalize — no complex types anywhere."""
-
-    def one(port, model, noise_stds, chan_mask, freqs, P, nu_fit, nu_out,
-            theta0):
-        nbin = port.shape[-1]
-        w = make_weights(noise_stds, nbin, chan_mask, dtype=port.dtype)
-        Xr, Xi, S0, Sd, th0 = prepare_portrait_fit_real(
-            port, model.astype(port.dtype), w, freqs, P, nu_fit, theta0,
-            seed_phi=bool(fit_flags[0]))
-        return _fit_portrait_core_real.__wrapped__(
-            Xr, Xi, S0, Sd, freqs, P, nu_fit, nu_out, th0,
-            fit_flags=fit_flags, max_iter=max_iter, pallas=pallas)
-
+    one = partial(fast_fit_one, fit_flags=fit_flags, max_iter=max_iter,
+                  pallas=pallas)
     return jax.jit(jax.vmap(
         one, in_axes=(0, m_ax, 0, 0, f_ax, p_ax, nf_ax, 0, 0)))
 

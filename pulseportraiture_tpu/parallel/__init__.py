@@ -1,10 +1,12 @@
 from .mesh import make_mesh, batch_sharding, replicated
-from .batch import fit_portrait_sharded, shard_batch
+from .batch import (fit_portrait_sharded, fit_portrait_sharded_fast,
+                    shard_batch)
 
 __all__ = [
     "make_mesh",
     "batch_sharding",
     "replicated",
     "fit_portrait_sharded",
+    "fit_portrait_sharded_fast",
     "shard_batch",
 ]

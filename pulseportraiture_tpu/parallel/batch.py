@@ -8,14 +8,14 @@ collectives over ICI for the chi^2 channel reductions).  Replaces the
 reference's sequential per-archive Python loop (pptoas.py:258-384).
 """
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..fit.portrait import (FitFlags, _fit_portrait_core, derive_use_scatter,
-                            make_weights)
+                            fast_fit_one, make_weights)
 from .mesh import batch_sharding
 
 
@@ -99,3 +99,97 @@ def fit_portrait_sharded(
     mFT = jax.device_put(mFT, sh3)
     w = jax.device_put(w, sh3)
     return jitted(dFT, mFT, w, freqs, P_s, nu_fit, nu_out_val, theta0)
+
+
+def fit_portrait_sharded_fast(
+    mesh,
+    ports,
+    models,
+    noise_stds,
+    freqs,
+    P_s,
+    nu_fit,
+    theta0=None,
+    nu_out=None,
+    fit_flags=FitFlags(),
+    chan_masks=None,
+    max_iter=40,
+    shard_channels=False,
+    pallas=False,
+):
+    """fit_portrait_sharded through the complex-free real-arithmetic
+    core (fit/portrait.py _fit_portrait_core_real): matmul DFTs, CCF
+    seed, and the Newton loop in one sharded program — the scale-out
+    path for TPU runtimes that cannot compile complex FFTs.
+
+    models may be (nb, nchan, nbin) or a shared (nchan, nbin) template.
+    No-scattering fits only.  pallas stays opt-in here: the fused
+    kernel is not auto-partitionable, so with channel sharding XLA
+    would replicate it; the XLA real path shards cleanly (psum over
+    'chan' for the channel reductions).
+    """
+    from ..fit.portrait import reject_fixed_tau_seed
+
+    if fit_flags[3] or fit_flags[4]:
+        raise ValueError("fit_portrait_sharded_fast: no-scattering only")
+    reject_fixed_tau_seed(theta0, "fit_portrait_sharded_fast")
+    ports = jnp.asarray(ports)
+    nb, nchan, nbin = ports.shape
+    dt = ports.dtype
+    models = jnp.asarray(models, dt)
+    m_ax = 0 if models.ndim == 3 else None
+    freqs = jnp.asarray(freqs, dt)
+    f_ax = 0 if freqs.ndim == 2 else None
+    P_s = jnp.broadcast_to(jnp.asarray(P_s, dt), (nb,))
+    nu_fit = jnp.broadcast_to(jnp.asarray(nu_fit, dt), (nb,))
+    nu_out_val = jnp.full((nb,), -1.0 if nu_out is None else nu_out, dt)
+    if theta0 is None:
+        theta0 = jnp.zeros((nb, 5), dt)
+    theta0 = jnp.asarray(theta0, dt)
+    if chan_masks is None:
+        chan_masks = jnp.ones((nb, nchan), dt)
+    chan_masks = jnp.asarray(chan_masks, dt)
+    noise_stds = jnp.asarray(noise_stds, dt)
+    flags = FitFlags(*[bool(f) for f in fit_flags])
+
+    jitted, shardings = _sharded_fast_fn(
+        mesh, flags, int(max_iter), bool(pallas), m_ax, f_ax,
+        bool(shard_channels))
+    sh3, shm, sh2c, _, _, _ = shardings
+    ports = jax.device_put(ports, sh3)
+    models = jax.device_put(models, shm)
+    noise_stds = jax.device_put(noise_stds, sh2c)
+    chan_masks = jax.device_put(chan_masks, sh2c)
+    return jitted(ports, models, noise_stds, chan_masks, freqs, P_s,
+                  nu_fit, nu_out_val, theta0)
+
+
+@lru_cache(maxsize=None)
+def _sharded_fast_fn(mesh, flags, max_iter, pallas, m_ax, f_ax,
+                     shard_channels):
+    """Cached sharded jit of the shared per-element fast fit
+    (fit.portrait.fast_fit_one) — a fresh jit per call would recompile
+    the full sharded program every invocation.  Mesh is hashable, so it
+    keys the cache."""
+    one = partial(fast_fit_one, fit_flags=flags, max_iter=max_iter,
+                  pallas=pallas)
+    core = jax.vmap(one, in_axes=(0, m_ax, 0, 0, f_ax, 0, 0, 0, 0))
+
+    chan_axis = 1 if shard_channels else None
+    sh3 = batch_sharding(mesh, 3, chan_axis)   # (nb, nchan, nbin)
+    sh2c = batch_sharding(mesh, 2, chan_axis)  # (nb, nchan)
+    sh_theta = batch_sharding(mesh, 2)
+    sh1 = batch_sharding(mesh, 1)
+    shm = (
+        sh3 if m_ax == 0
+        else NamedSharding(mesh, P("chan", None) if shard_channels else P())
+    )
+    shf = (
+        sh2c if f_ax == 0
+        else NamedSharding(mesh, P("chan") if shard_channels else P())
+    )
+    jitted = jax.jit(
+        core,
+        in_shardings=(sh3, shm, sh2c, sh2c, shf, sh1, sh1, sh1, sh_theta),
+    )
+    return jitted, (sh3, shm, sh2c, shf, sh_theta, sh1)

@@ -67,3 +67,33 @@ def test_data_x_chan_mesh_matches_batch(batch):
         mesh, ports, models, stds, FREQS, P, nu_fit, shard_channels=True
     )
     _check(res, ref)
+
+
+def test_sharded_fast_matches_batch(batch):
+    """The complex-free sharded core (the real-TPU-pod path) matches
+    the batch reference on both mesh shapes, incl. a shared template."""
+    from pulseportraiture_tpu.parallel import fit_portrait_sharded_fast
+
+    ports, models, stds = batch
+    nu_fit = guess_fit_freq(FREQS)
+    ref = fit_portrait_batch(ports, models, stds, FREQS, P, nu_fit)
+    res = fit_portrait_sharded_fast(
+        make_mesh(n_data=8, n_chan=1), ports, models, stds, FREQS, P,
+        nu_fit)
+    _check(res, ref)
+    res2 = fit_portrait_sharded_fast(
+        make_mesh(n_data=4, n_chan=2), ports, models, stds, FREQS, P,
+        nu_fit, shard_channels=True)
+    _check(res2, ref)
+    # shared 2-D template path (fake_portrait's model_port is the same
+    # clean template for every element, so ref is the right oracle)
+    res3 = fit_portrait_sharded_fast(
+        make_mesh(n_data=8, n_chan=1), ports, models[0], stds, FREQS, P,
+        nu_fit)
+    _check(res3, ref)
+    # the guard shared with fit_portrait_batch_fast
+    bad = jnp.zeros((NB, 5)).at[0, 3].set(1e-4)
+    with pytest.raises(ValueError):
+        fit_portrait_sharded_fast(
+            make_mesh(n_data=8, n_chan=1), ports, models, stds, FREQS, P,
+            nu_fit, theta0=bad)
