@@ -95,6 +95,9 @@ def main(argv=None):
     gt = GetTOAs(args.datafiles, args.modelfile, quiet=args.quiet)
     if args.narrowband or args.psrchive:
         gt.get_narrowband_TOAs(tscrunch=args.tscrunch,
+                               fit_scat=args.fit_scat,
+                               log10_tau=args.log10_tau,
+                               scat_guess=scat_guess,
                                print_phase=args.print_phase,
                                addtnl_toa_flags=addtnl, quiet=args.quiet)
     else:

@@ -51,6 +51,22 @@ def weighted_mean(values, errs):
     return mean, float(wsum ** -0.5)
 
 
+def scat_time_flags(tau_rot, tau_err_rot, seconds_per_rot, log10_tau):
+    """TOA flag dict for a fitted scattering timescale.
+
+    tau_rot/tau_err_rot: FitResult.tau/tau_err (linear rotations always,
+    whatever the fit's internal parameterization); seconds_per_rot:
+    P or P/doppler_factor.  scat_time is microseconds."""
+    flags = {"scat_time": tau_rot * seconds_per_rot * 1e6}
+    if log10_tau:
+        safe = max(tau_rot, 1e-300)
+        flags["log10_scat_time"] = np.log10(safe * seconds_per_rot)
+        flags["log10_scat_time_err"] = tau_err_rot / (safe * np.log(10.0))
+    else:
+        flags["scat_time_err"] = tau_err_rot * seconds_per_rot * 1e6
+    return flags
+
+
 def _read_metafile(path):
     with open(path) as f:
         return [line.strip() for line in f
@@ -324,7 +340,12 @@ class GetTOAs:
                         theta0=jnp.asarray(theta0[idx]),
                         fit_flags=FitFlags(*flags),
                         chan_masks=jnp.asarray(masks[idx]),
-                        log10_tau=log10_tau and flags[3],
+                        # unconditional: a degenerate (phase-only) group
+                        # in a log10 scattering run still carries its
+                        # fixed tau seed in log10 space, and the engine
+                        # must decode it that way (log10_tau is already
+                        # False whenever fit_scat is off)
+                        log10_tau=log10_tau,
                         max_iter=max_iter,
                         ir_FT=ir_FT,
                     )
@@ -421,9 +442,9 @@ class GetTOAs:
                 # flux estimate (pptoas.py:595-624)
                 if print_flux:
                     okc = np.asarray(d.ok_ichans[isub], int)
+                    # FitResult.tau is linear rotations regardless of
+                    # the log10 parameterization (fit/portrait.py)
                     tau_r = res_arrays["tau"][j]
-                    if log10_tau and self.fit_flags[3]:
-                        tau_r = 10.0 ** tau_r
                     if tau_r and np.isfinite(tau_r) and tau_r > 0:
                         tt = np.asarray(scattering_times(
                             tau_r, res_arrays["alpha"][j], freqs0,
@@ -458,16 +479,10 @@ class GetTOAs:
                     toa_flags["gm"] = GM_j
                     toa_flags["gm_err"] = float(GM_errs[isub])
                 if self.fit_flags[3]:
-                    tau_j = float(res_arrays["tau"][j])
-                    tau_err_j = float(res_arrays["tau_err"][j])
-                    if log10_tau:
-                        toa_flags["scat_time"] = 10.0 ** tau_j * P / df * 1e6
-                        toa_flags["log10_scat_time"] = tau_j + \
-                            np.log10(P / df)
-                        toa_flags["log10_scat_time_err"] = tau_err_j
-                    else:
-                        toa_flags["scat_time"] = tau_j * P / df * 1e6
-                        toa_flags["scat_time_err"] = tau_err_j * P / df * 1e6
+                    toa_flags.update(scat_time_flags(
+                        float(res_arrays["tau"][j]),
+                        float(res_arrays["tau_err"][j]), P / df,
+                        log10_tau))
                     toa_flags["scat_ref_freq"] = \
                         float(res_arrays["nu_tau"][j]) * df
                     toa_flags["scat_ind"] = float(res_arrays["alpha"][j])
@@ -572,15 +587,30 @@ class GetTOAs:
 
     # ------------------------------------------------------------------
     def get_narrowband_TOAs(self, datafile=None, tscrunch=False,
-                            print_phase=False, addtnl_toa_flags={},
+                            fit_scat=False, log10_tau=True,
+                            scat_guess=None, print_phase=False,
+                            addtnl_toa_flags={}, max_iter=40,
                             quiet=None):
         """Per-channel 1-D FFTFIT TOAs (reference pptoas.py:794-1189),
         batched: every (subint, channel) profile of an archive is fitted
-        in one vmapped phase-shift call."""
+        in one vmapped phase-shift call.
+
+        fit_scat=True fits a per-channel scattering timescale alongside
+        the phase by running the 5-parameter engine on single-channel
+        portraits with flags (phi, tau) — the capability the reference
+        stubbed out ('NOT YET IMPLEMENTED', pptoas.py:1046-1049).
+        scat_guess: optional (tau [s], freq [MHz], alpha) seed, as in
+        get_TOAs.  The linear parameterization (log10_tau=False) only
+        converges from a realistic seed, so it requires scat_guess."""
         from ..fit.phase_shift import fit_phase_shift_batch
 
         if quiet is None:
             quiet = self.quiet
+        if fit_scat and not log10_tau and scat_guess is None:
+            raise ValueError(
+                "get_narrowband_TOAs: log10_tau=False needs scat_guess "
+                "(the linear parameterization cannot converge from the "
+                "neutral half-bin seed)")
         datafiles = self.datafiles if datafile is None else [datafile]
         for datafile in datafiles:
             try:
@@ -597,12 +627,54 @@ class GetTOAs:
             P_mean = float(np.mean(d.Ps[ok]))
             modelx = self.model.portrait(freqs0, nbin, P=P_mean)
             ports = jnp.asarray(d.subints[ok, 0])  # (nok, nchan, nbin)
-            models = jnp.broadcast_to(jnp.asarray(modelx), ports.shape)
             noise = jnp.asarray(d.noise_stds[ok, 0])
-            r = fit_phase_shift_batch(ports, models, noise)
-            phase = np.asarray(r.phase)
-            phase_err = np.asarray(r.phase_err)
-            snr = np.asarray(r.snr)
+            nok = len(ok)
+            taus = tau_errs = None
+            if fit_scat:
+                # (nok*nchan) single-channel portraits through the
+                # 5-param engine with flags (phi, tau); phase seeded by
+                # the CCF, tau by half a bin
+                flat_ports = ports.reshape(nok * nchan, 1, nbin)
+                flat_models = jnp.broadcast_to(
+                    jnp.asarray(modelx), ports.shape
+                ).reshape(nok * nchan, 1, nbin)
+                flat_noise = noise.reshape(nok * nchan, 1)
+                flat_freqs = jnp.broadcast_to(
+                    jnp.asarray(freqs0), (nok, nchan)
+                ).reshape(nok * nchan, 1)
+                flat_P = jnp.repeat(jnp.asarray(d.Ps[ok]), nchan)
+                masks = jnp.asarray(
+                    (d.weights[ok] > 0.0).reshape(nok * nchan, 1), float)
+                th0 = np.zeros((nok * nchan, 5))
+                if scat_guess is not None:
+                    t_s, nu_s, a_s = scat_guess
+                    tau_seed = ((t_s / P_mean)
+                                * (np.asarray(flat_freqs[:, 0]) / nu_s)
+                                ** a_s)
+                else:
+                    tau_seed = np.full(nok * nchan, 0.5 / nbin)
+                th0[:, 3] = (np.log10(np.maximum(tau_seed, 1e-12))
+                             if log10_tau else tau_seed)
+                r = fit_portrait_batch(
+                    flat_ports, flat_models, flat_noise, flat_freqs,
+                    flat_P, flat_freqs[:, 0],
+                    fit_flags=FitFlags(True, False, False, True, False),
+                    theta0=jnp.asarray(th0), chan_masks=masks,
+                    log10_tau=log10_tau, max_iter=max_iter)
+                phase = np.asarray(r.phi).reshape(nok, nchan)
+                phase_err = np.asarray(r.phi_err).reshape(nok, nchan)
+                snr = np.asarray(r.snr).reshape(nok, nchan)
+                dof = np.maximum(np.asarray(r.dof), 1.0)
+                red_chi2 = (np.asarray(r.chi2) / dof).reshape(nok, nchan)
+                taus = np.asarray(r.tau).reshape(nok, nchan)
+                tau_errs = np.asarray(r.tau_err).reshape(nok, nchan)
+            else:
+                models = jnp.broadcast_to(jnp.asarray(modelx), ports.shape)
+                r = fit_phase_shift_batch(ports, models, noise)
+                phase = np.asarray(r.phase)
+                phase_err = np.asarray(r.phase_err)
+                snr = np.asarray(r.snr)
+                red_chi2 = np.asarray(r.red_chi2)
             self.order.append(datafile)
             self.ok_isubs.append(ok)
             for j, isub in enumerate(ok):
@@ -619,8 +691,15 @@ class GetTOAs:
                         "tobs": float(d.subtimes[isub]),
                         "tmplt": self.modelfile,
                         "snr": float(snr[j, ichan]),
-                        "gof": float(np.asarray(r.red_chi2)[j, ichan]),
+                        "gof": float(red_chi2[j, ichan]),
                     }
+                    if fit_scat:
+                        toa_flags.update(scat_time_flags(
+                            float(taus[j, ichan]),
+                            float(tau_errs[j, ichan]), P, log10_tau))
+                        # each channel's tau is referenced to its own
+                        # frequency
+                        toa_flags["scat_ref_freq"] = float(freqs0[ichan])
                     if print_phase:
                         toa_flags["phs"] = float(phase[j, ichan])
                         toa_flags["phs_err"] = float(phase_err[j, ichan])
@@ -739,9 +818,8 @@ class GetTOAs:
         from ..ops.rotation import rotate_portrait
 
         nbin = modelx.shape[-1]
+        # self.taus stores FitResult.tau: linear rotations always
         tau_r = float(self.taus[iarch][isub])
-        if getattr(self, "log10_tau", False) and np.isfinite(tau_r):
-            tau_r = 10.0 ** tau_r
         port_model = modelx
         if np.isfinite(tau_r) and tau_r > 0.0:
             tt = np.asarray(scattering_times(

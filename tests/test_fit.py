@@ -202,3 +202,31 @@ def test_parity_vs_numpy_reference(key):
     # errors agree to 10%
     assert abs(float(res_jax.phi_err) / res_np["phi_err"] - 1.0) < 0.1
     assert abs(float(res_jax.DM_err) / res_np["DM_err"] - 1.0) < 0.1
+
+
+@pytest.mark.parametrize("log10_tau", [True, False])
+def test_fit_portrait_tau_recovery(key, log10_tau):
+    """(phi, DM, tau) fit recovers an injected scattering timescale;
+    FitResult.tau is linear rotations for BOTH parameterizations."""
+    from pulseportraiture_tpu.fit import fit_portrait_batch
+
+    model, pb = _fake(key, phi=0.02, DM=1e-3, tau=1.5e-4, alpha=-4.0,
+                      noise_std=0.02)
+    th0 = np.zeros((1, 5))
+    # log10 parameterization recovers from the neutral half-bin seed;
+    # the linear one needs a scat_guess-quality seed (this is why the
+    # reference and the pipeline default to log10, pptoas.py:1497)
+    seed = 0.5 / NBIN if log10_tau else 0.01
+    th0[0, 3] = np.log10(seed) if log10_tau else seed
+    th0[0, 4] = -4.0
+    r = fit_portrait_batch(
+        pb.port[None], pb.model_port[None], pb.noise_stds[None], FREQS, P,
+        1500.0, fit_flags=FitFlags(True, True, False, True, False),
+        theta0=jnp.asarray(th0), log10_tau=log10_tau, max_iter=60)
+    # injected tau was 1.5e-4 s at nu_ref=1500; result is linear
+    # rotations at r.nu_tau with index alpha=-4
+    nu_tau = float(r.nu_tau[0])
+    expect_rot = (1.5e-4 / P) * (nu_tau / 1500.0) ** -4.0
+    got = float(r.tau[0])
+    assert abs(got - expect_rot) / expect_rot < 0.1, (got, expect_rot)
+    assert abs(float(r.phi[0]) - 0.02) < 1e-3

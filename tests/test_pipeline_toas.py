@@ -281,3 +281,34 @@ def test_fast_routing_scat_degenerate_subint(dataset, tmp_path):
     ok = gt.ok_isubs[0]
     assert len(gt.TOA_list) == len(ok)
     assert np.all(np.isfinite(gt.phis[0][ok]))
+
+
+def test_narrowband_scattering_fit(dataset, tmp_path):
+    """Per-channel (phi, tau) narrowband fits — the capability the
+    reference stubbed out (pptoas.py:1046-1049) — recover an injected
+    scattering timescale."""
+    model = default_test_model(1500.0)
+    t_scat = 2e-4  # seconds at nu0=1500; P=4.074 ms -> ~0.05 rot
+    path = str(tmp_path / "scat.fits")
+    make_fake_pulsar(model, PAR, outfile=path, nsub=2, nchan=16, nbin=256,
+                     nu0=1500.0, bw=200.0, tsub=60.0, noise_stds=0.02,
+                     t_scat=t_scat, alpha=-4.0, dedispersed=False,
+                     quiet=True, rng=3)
+    meta, gmodel, files = dataset
+    gt = GetTOAs(path, gmodel, quiet=True)
+    gt.get_narrowband_TOAs(fit_scat=True, quiet=True)
+    assert len(gt.TOA_list) == 2 * 16
+    P = PAR["P0"]
+    # per-channel expected tau: t_scat * (nu/1500)^-4
+    by_chan = {}
+    for t in gt.TOA_list:
+        assert "scat_time" in t.flags
+        by_chan.setdefault(round(t.frequency, 3), []).append(
+            t.flags["scat_time"] * 1e-6)  # us -> s
+    ratios = []
+    for nu, vals in by_chan.items():
+        expect = t_scat * (nu / 1500.0) ** -4.0
+        got = np.median(vals)
+        ratios.append(got / expect)
+    # recover tau within 25% in the median across the band
+    assert 0.75 < np.median(ratios) < 1.25, ratios
