@@ -64,6 +64,8 @@ def build_parser():
                    help="Minimum snr flag value for written TOAs.")
     p.add_argument("--showplot", action="store_true", default=False,
                    help="Save per-subint fit plots next to the archives.")
+    p.add_argument("--prefetch", action="store_true", default=False,
+                   help="Overlap archive IO with fitting (long lists).")
     p.add_argument("--quiet", action="store_true", default=False)
     # accepted for reference-script compatibility; no-ops here:
     p.add_argument("--psrchive", action="store_true", default=False,
@@ -108,7 +110,8 @@ def main(argv=None):
                     print_phase=args.print_phase,
                     print_flux=args.print_flux,
                     print_parangle=args.print_parangle,
-                    addtnl_toa_flags=addtnl, quiet=args.quiet)
+                    addtnl_toa_flags=addtnl, prefetch=args.prefetch,
+                    quiet=args.quiet)
         if args.one_DM:
             gt.apply_one_DM()
     if args.format == "princeton":

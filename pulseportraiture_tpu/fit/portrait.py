@@ -734,6 +734,16 @@ def fast_fit_one(port, model, noise_stds, chan_mask, freqs, P, nu_fit,
         fit_flags=fit_flags, max_iter=max_iter, pallas=pallas)
 
 
+def use_fast_fit_default():
+    """Whether no-scattering pipeline fits should take the complex-free
+    f32 fast path: config.use_fast_fit ('auto' = TPU backends, where
+    complex FFTs are unsupported or unusably slow)."""
+    setting = getattr(config, "use_fast_fit", "auto")
+    if setting is False:
+        return False
+    return setting is True or jax.default_backend() == "tpu"
+
+
 def reject_fixed_tau_seed(theta0, caller):
     """The real core has no scattering kernel, so a fixed nonzero tau
     seed (which fit_portrait_batch would apply via derive_use_scatter)

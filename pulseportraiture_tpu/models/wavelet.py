@@ -11,7 +11,7 @@ per-profile scipy.optimize.brute.
 
 The Daubechies scaling filters are computed once on host by spectral
 factorization (no table, no pywt).  Perfect reconstruction of the
-forward/inverse pair is covered by tests/test_wavelet.py.
+forward/inverse pair is covered by tests/test_spline.py.
 """
 
 from functools import lru_cache, partial

@@ -14,7 +14,9 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..fit.phase_shift import fit_phase_shift
-from ..fit.portrait import FitFlags, fit_portrait_batch
+from ..fit.portrait import (FitFlags, fit_portrait_batch,
+                            fit_portrait_batch_fast, use_fast_fit_default)
+from ..utils.device import host_compute
 from ..io.psrfits import load_data, read_archive, unload_new_archive
 from ..models.gaussian import gen_gaussian_profile
 from ..ops.rotation import rotate_portrait
@@ -127,6 +129,11 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
         aligned = np.zeros((npol, nchan, nbin))
         total_weights = np.zeros((nchan, nbin))
         model_j = jnp.asarray(model_port)
+        use_fast = use_fast_fit_default()
+        if use_fast:
+            # hoisted: one H2D transfer of the shared template per
+            # iteration, not one per archive
+            model_f32 = jnp.asarray(model_port, jnp.float32)
         mean_model = model_port.mean(axis=0)
         for path in datafiles:
             if path in skip_these:
@@ -158,30 +165,40 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
             DM_guess = 0.0 if d.dmc else float(d.DM)
 
             # phase guesses from the f-scrunched profiles vs the mean
-            # template profile (ppalign.py:214-219)
+            # template profile (ppalign.py:214-219); complex phasors ->
+            # host CPU when the accelerator cannot compile them
             theta0 = np.zeros((len(ok), 5))
             theta0[:, 1] = DM_guess
-            for j in range(len(ok)):
-                rot = np.asarray(rotate_portrait(
-                    jnp.asarray(ports[j]), 0.0, DM_guess, float(Ps_ok[j]),
-                    jnp.asarray(freqs0), np.inf))
-                r = fit_phase_shift(rot.mean(axis=0), mean_model,
-                                    np.median(noise[j]))
-                theta0[j, 0] = float(r.phase)
+            with host_compute():
+                for j in range(len(ok)):
+                    rot = np.asarray(rotate_portrait(
+                        jnp.asarray(ports[j]), 0.0, DM_guess,
+                        float(Ps_ok[j]), jnp.asarray(freqs0), np.inf))
+                    r = fit_phase_shift(rot.mean(axis=0), mean_model,
+                                        np.median(noise[j]))
+                    theta0[j, 0] = float(r.phase)
 
             nchx = masks.sum(axis=1)
             if nchan > 1 and np.all(nchx > 1):
-                res = fit_portrait_batch(
-                    jnp.asarray(ports), jnp.broadcast_to(
-                        model_j, ports.shape),
-                    jnp.asarray(noise), jnp.asarray(freqs0),
-                    jnp.asarray(Ps_ok),
-                    jnp.asarray(np.full(len(ok), freqs0.mean())),
+                # complex-free f32 fast path on TPU backends (ppalign's
+                # fit is always (phi[, DM]) — never scattering)
+                if use_fast:
+                    fitter, ft = fit_portrait_batch_fast, jnp.float32
+                    model_arg = model_f32  # shared 2-D
+                else:
+                    fitter, ft = fit_portrait_batch, None
+                    model_arg = jnp.broadcast_to(model_j, ports.shape)
+                res = fitter(
+                    jnp.asarray(ports, ft),
+                    model_arg,
+                    jnp.asarray(noise, ft), jnp.asarray(freqs0, ft),
+                    jnp.asarray(Ps_ok, ft),
+                    jnp.asarray(np.full(len(ok), freqs0.mean()), ft),
                     nu_out=freqs0.mean(),
-                    theta0=jnp.asarray(theta0),
+                    theta0=jnp.asarray(theta0, ft),
                     fit_flags=FitFlags(True, bool(fit_dm), False, False,
                                        False),
-                    chan_masks=jnp.asarray(masks))
+                    chan_masks=jnp.asarray(masks, ft))
                 phis = np.asarray(res.phi)
                 DMs = np.asarray(res.DM)
                 scales = np.asarray(res.scales) * masks
@@ -195,15 +212,17 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
             # weighted accumulate of back-rotated subints
             # (ppalign.py:236-242): weights = scales / noise^2
             sub_cube = np.asarray(d.subints[ok], float)  # (nok, npol, ...)
-            for j in range(len(ok)):
-                rotated = np.asarray(rotate_portrait(
-                    jnp.asarray(sub_cube[j]), float(phis[j]),
-                    float(DMs[j]), float(Ps_ok[j]), jnp.asarray(freqs0),
-                    float(nu_ref_fit[j])))
-                noise_j = np.where(noise[j] > 0, noise[j], np.inf)
-                w_j = masks[j] * np.maximum(scales[j], 0.0) / noise_j ** 2
-                aligned += rotated * w_j[None, :, None]
-                total_weights += w_j[:, None]
+            with host_compute():
+                for j in range(len(ok)):
+                    rotated = np.asarray(rotate_portrait(
+                        jnp.asarray(sub_cube[j]), float(phis[j]),
+                        float(DMs[j]), float(Ps_ok[j]),
+                        jnp.asarray(freqs0), float(nu_ref_fit[j])))
+                    noise_j = np.where(noise[j] > 0, noise[j], np.inf)
+                    w_j = (masks[j] * np.maximum(scales[j], 0.0)
+                           / noise_j ** 2)
+                    aligned += rotated * w_j[None, :, None]
+                    total_weights += w_j[:, None]
         if not total_weights.any():
             raise RuntimeError("no archives could be aligned")
         aligned /= np.maximum(total_weights, 1e-30)[None]
@@ -221,7 +240,9 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
         peak = np.argmax(prof) / nbin
         rot_phase = peak - place
     if rot_phase:
-        final = np.asarray(rotate_portrait(jnp.asarray(final), rot_phase))
+        with host_compute():
+            final = np.asarray(rotate_portrait(jnp.asarray(final),
+                                               rot_phase))
         model_port = final[0]
 
     # write into a cloned archive with DM=0 and unit weights

@@ -5,22 +5,11 @@ as the template, mirroring the reference's try/except dispatch
 on file magic instead of parse failures.
 """
 
-import jax
 import numpy as np
 
 from ..io.gmodel import gen_gmodel_portrait, read_gmodel
 from ..io.splmodel import read_spline_model
-
-
-def _cpu_device():
-    """The host CPU device, for one-time template generation: the
-    Gaussian/spline generators use complex phasors, which some TPU
-    runtimes cannot compile (and the build is not worth a TPU dispatch
-    anyway)."""
-    try:
-        return jax.local_devices(backend="cpu")[0]
-    except Exception:  # pragma: no cover - CPU backend always exists
-        return None
+from ..utils.device import host_compute
 
 
 def sniff_model_type(path):
@@ -92,7 +81,9 @@ class TemplateModel:
         if key in self._cache:
             return self._cache[key]
         if self.kind in ("gmodel", "spline"):
-            with jax.default_device(_cpu_device()):
+            # one-time template generation uses complex phasors, which
+            # some TPU runtimes cannot compile — build on host
+            with host_compute():
                 if self.kind == "gmodel":
                     port = gen_gmodel_portrait(self.gauss, np.arange(nbin),
                                                freqs, P=P, quiet=True)

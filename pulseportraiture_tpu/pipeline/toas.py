@@ -21,14 +21,12 @@ statistics — re-architected TPU-first:
 
 import time
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import config
 from ..config import Dconst, scattering_alpha
 from ..fit.portrait import (FitFlags, fit_portrait_batch,
-                            fit_portrait_batch_fast)
+                            fit_portrait_batch_fast, use_fast_fit_default)
 from ..io.psrfits import load_data
 from ..io.tim import TOA
 from ..ops.scattering import scattering_portrait_FT, scattering_times
@@ -65,6 +63,35 @@ def scat_time_flags(tau_rot, tau_err_rot, seconds_per_rot, log10_tau):
     else:
         flags["scat_time_err"] = tau_err_rot * seconds_per_rot * 1e6
     return flags
+
+
+def _iter_archives(datafiles, loader, prefetch):
+    """Yield (datafile, DataBunch-or-Exception).  With prefetch, a
+    single worker thread loads archive i+1 while the caller fits
+    archive i — IO/compute overlap for long archive lists (the
+    reference loads and fits strictly sequentially, pptoas.py:258)."""
+    if not prefetch or len(datafiles) <= 1:
+        for f in datafiles:
+            try:
+                yield f, loader(f)
+            except Exception as e:
+                yield f, e
+        return
+    from concurrent.futures import ThreadPoolExecutor
+
+    def safe(f):
+        try:
+            return loader(f)
+        except Exception as e:
+            return e
+
+    with ThreadPoolExecutor(max_workers=1) as ex:
+        fut = ex.submit(safe, datafiles[0])
+        for i, f in enumerate(datafiles):
+            d = fut.result()
+            if i + 1 < len(datafiles):
+                fut = ex.submit(safe, datafiles[i + 1])
+            yield f, d
 
 
 def _read_metafile(path):
@@ -165,10 +192,11 @@ class GetTOAs:
                  fit_scat=False, log10_tau=True, scat_guess=None,
                  fix_alpha=False, print_phase=False, print_flux=False,
                  print_parangle=False, addtnl_toa_flags={},
-                 nu_fits=None, max_iter=40, quiet=None):
+                 nu_fits=None, max_iter=40, prefetch=False, quiet=None):
         """Measure wideband TOAs (reference pptoas.py:161-792; same
         options minus the scipy `method`/`bounds` knobs, which have no
-        analogue in the fused-Newton engine)."""
+        analogue in the fused-Newton engine).  prefetch=True overlaps
+        the next archive's load with the current archive's fits."""
         if quiet is None:
             quiet = self.quiet
         if not fit_scat:
@@ -183,15 +211,23 @@ class GetTOAs:
         nu_ref_DM = nu_refs[0] if nu_refs is not None else None
         nu_ref_tau = nu_refs[1] if nu_refs is not None else None
 
-        for datafile in datafiles:
-            t_start = time.time()
+        load_times = {}
+
+        def _loader(f):
+            t0 = time.time()
             try:
-                d = load_data(datafile, dedisperse=False, dededisperse=True,
-                              tscrunch=tscrunch, pscrunch=True,
-                              flux_prof=False, refresh_arch=False,
-                              return_arch=False, quiet=quiet)
-            except Exception as e:  # skip-and-continue (pptoas.py:261-304)
-                print(f"Skipping {datafile}: {e}")
+                return load_data(f, dedisperse=False, dededisperse=True,
+                                 tscrunch=tscrunch, pscrunch=True,
+                                 flux_prof=False, refresh_arch=False,
+                                 return_arch=False, quiet=quiet)
+            finally:
+                load_times[f] = time.time() - t0
+
+        for datafile, d in _iter_archives(datafiles, _loader, prefetch):
+            t_start = time.time()
+            if isinstance(d, Exception):
+                # skip-and-continue (pptoas.py:261-304)
+                print(f"Skipping {datafile}: {d}")
                 continue
             if d.nsub == 0 or len(d.ok_isubs) == 0:
                 print(f"No subints to fit in {datafile}; skipping.")
@@ -303,16 +339,13 @@ class GetTOAs:
                 # no-scattering fits route through the complex-free f32
                 # fast path on TPU backends, where complex FFTs are
                 # unsupported/unusably slow (config.use_fast_fit)
-                fast_setting = getattr(config, "use_fast_fit", "auto")
                 use_fast = (not flags[3] and not flags[4]
                             and ir_FT is None
                             # a fixed nonzero tau seed (scat_guess, or a
                             # scattering run's degenerate subint group)
                             # still needs the scattering kernel
                             and not np.any(theta0[idx][:, 3] != 0.0)
-                            and fast_setting is not False
-                            and (fast_setting is True
-                                 or jax.default_backend() == "tpu"))
+                            and use_fast_fit_default())
                 if use_fast:
                     r = fit_portrait_batch_fast(
                         jnp.asarray(ports[idx], jnp.float32),
@@ -577,7 +610,10 @@ class GetTOAs:
             self.rcs.append(rcs)
             self.fit_durations.append(fit_duration)
             if not quiet:
-                tot = time.time() - t_start
+                # the load happened inside the archive iterator (maybe
+                # on the prefetch thread) — count it back into 'total'
+                tot = (time.time() - t_start
+                       + load_times.get(datafile, 0.0))
                 print("--------------------------")
                 print(datafile)
                 print(f"~{fit_duration / max(nok, 1):.4f} sec/TOA (fit), "

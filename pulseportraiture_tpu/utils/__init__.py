@@ -1,4 +1,5 @@
 from .mjd import MJD
 from .bunch import DataBunch
+from .device import host_compute
 
-__all__ = ["MJD", "DataBunch"]
+__all__ = ["MJD", "DataBunch", "host_compute"]

@@ -1,0 +1,20 @@
+"""Device-placement helpers."""
+
+import contextlib
+
+import jax
+
+
+def host_compute():
+    """Context manager pinning jnp ops to the host CPU backend when the
+    session's default backend is an accelerator.
+
+    Used for small offline computations that need complex arithmetic
+    (rotation phasors, 1-D FFTFIT guesses, template generation): some
+    TPU runtimes cannot compile complex FFTs at all, and a host round
+    trip is cheaper than an accelerator dispatch for these sizes
+    anyway.
+    """
+    if jax.default_backend() == "cpu":
+        return contextlib.nullcontext()
+    return jax.default_device(jax.local_devices(backend="cpu")[0])

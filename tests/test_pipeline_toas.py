@@ -312,3 +312,17 @@ def test_narrowband_scattering_fit(dataset, tmp_path):
         ratios.append(got / expect)
     # recover tau within 25% in the median across the band
     assert 0.75 < np.median(ratios) < 1.25, ratios
+
+
+def test_prefetch_identical_results(dataset):
+    """prefetch=True (IO/compute overlap) must not change any result."""
+    meta, gmodel, files = dataset
+    gt = GetTOAs(meta, gmodel, quiet=True)
+    gt.get_TOAs(quiet=True)
+    gt_p = GetTOAs(meta, gmodel, quiet=True)
+    gt_p.get_TOAs(prefetch=True, quiet=True)
+    assert gt_p.order == gt.order
+    for i in range(len(gt.order)):
+        np.testing.assert_array_equal(gt_p.phis[i], gt.phis[i])
+        np.testing.assert_array_equal(gt_p.DMs[i], gt.DMs[i])
+    assert len(gt_p.TOA_list) == len(gt.TOA_list)
