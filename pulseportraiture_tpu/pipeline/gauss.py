@@ -16,6 +16,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from ..utils.device import on_host
 from ..config import default_model_code, scattering_alpha, wid_max
 from ..fit.gauss import fit_gaussian_portrait, fit_gaussian_profile
 from ..fit.phase_shift import fit_phase_shift
@@ -63,6 +64,7 @@ class GaussPortrait(_BasePortrait):
             prof = self.port[sel].mean(axis=0)
         return np.asarray(prof, float), float(nu_ref)
 
+    @on_host
     def fit_profile(self, profile=None, tau=0.0, fixscat=True,
                     auto_gauss=0.0, profile_fit_flags=None, show=True):
         """Fit Gaussian components to a single profile.  With
@@ -100,6 +102,7 @@ class GaussPortrait(_BasePortrait):
         self.ngauss = (len(self.init_params) - 2) // 3
         return self.init_params
 
+    @on_host
     def auto_fit_profile(self, profile=None, max_ngauss=8, wid0=0.02,
                          rchi2_tol=0.1, tau=0.0, fixscat=True,
                          quiet=True):
@@ -143,6 +146,7 @@ class GaussPortrait(_BasePortrait):
         return self.init_params
 
     # -- the main loop -----------------------------------------------------
+    @on_host
     def make_gaussian_model(self, modelfile=None, ref_prof=(None, None),
                             tau=0.0, fixloc=False, fixwid=False,
                             fixamp=False, fixscat=True, fixalpha=True,

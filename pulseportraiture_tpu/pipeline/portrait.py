@@ -18,9 +18,11 @@ from ..fit.powlaw import fit_powlaw
 from ..io.psrfits import load_data, noise_std_ps, unload_new_archive
 from ..ops.rotation import rotate_portrait
 from ..utils.bunch import DataBunch
+from ..utils.device import on_host
 from .toas import _is_metafile, _read_metafile
 
 
+@on_host
 def normalize_portrait(port, method="rms", weights=None,
                        return_norms=False):
     """Normalize each channel profile (reference pplib.py:2553-2598):
@@ -60,6 +62,7 @@ class DataPortrait:
     receivers (JOIN path) — into a t/p-scrunched portrait ready for
     template building."""
 
+    @on_host
     def __init__(self, datafile=None, joinfile=None, quiet=False,
                  **load_data_kwargs):
         self.datafile = datafile
@@ -184,6 +187,7 @@ class DataPortrait:
         self.norm_values = None
         self._condense()
 
+    @on_host
     def smooth_portrait(self, **kwargs):
         """Wavelet-denoise every channel profile (pplib.py:422-446)."""
         from ..models.wavelet import wavelet_smooth
@@ -191,6 +195,7 @@ class DataPortrait:
         self.port = np.asarray(wavelet_smooth(self.port, **kwargs))
         self._condense()
 
+    @on_host
     def fit_flux_profile(self, guessA=1.0, guessalpha=0.0, plot=False,
                          savefig=None, quiet=True):
         """Power-law fit to the phase-averaged flux vs frequency
@@ -213,6 +218,7 @@ class DataPortrait:
                   f"+/- {float(res.alpha_err):.3f}")
         return res
 
+    @on_host
     def rotate_stuff(self, phase=0.0, DM=0.0, ichans=None, nu_ref=None,
                      model=False):
         """Coherently rotate the data (or model) portrait and any

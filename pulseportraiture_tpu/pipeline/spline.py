@@ -13,6 +13,7 @@ stays on host (offline model building, SURVEY §7.2 step 6).
 
 import numpy as np
 
+from ..utils.device import on_host
 from ..io.splmodel import SplineModel, write_spline_model
 from ..models.spline import (
     fit_spline_curve,
@@ -31,6 +32,7 @@ class SplinePortrait(_BasePortrait):
     distinct, with `DataPortrait` kept as an alias in ppspline-style
     scripts via pipeline.spline.DataPortrait)."""
 
+    @on_host
     def make_spline_model(self, max_ncomp=10, smooth=True,
                           snr_cutoff=150.0, rchi2_tol=0.1, k=3, sfac=1.0,
                           max_nbreak=None, model_name=None, quiet=False,

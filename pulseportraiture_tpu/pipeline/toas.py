@@ -31,6 +31,7 @@ from ..io.psrfits import load_data
 from ..io.tim import TOA
 from ..ops.scattering import scattering_portrait_FT, scattering_times
 from ..utils.bunch import DataBunch
+from ..utils.device import on_host
 from .models import TemplateModel
 
 MAX_NFILE = 999  # parity: cfitsio open-file guard (pptoas.py:28-33)
@@ -846,6 +847,7 @@ class GetTOAs:
         return out
 
     # ------------------------------------------------------------------
+    @on_host
     def _fitted_model(self, iarch, isub, d, modelx, freqs0):
         """The template rotated onto the (dispersed) data at the
         fitted (phi, DM), including any fitted scattering — the
@@ -912,6 +914,7 @@ class GetTOAs:
             show=show, savefig=savefig or None)
 
     # ------------------------------------------------------------------
+    @on_host
     def get_channels_to_zap(self, SNR_threshold=8.0, rchi2_threshold=1.3,
                             iterate=True, show=False):
         """Flag channels with bad per-channel reduced chi2 or low S/N

@@ -1,6 +1,7 @@
 """Device-placement helpers."""
 
 import contextlib
+import functools
 
 import jax
 
@@ -18,3 +19,16 @@ def host_compute():
     if jax.default_backend() == "cpu":
         return contextlib.nullcontext()
     return jax.default_device(jax.local_devices(backend="cpu")[0])
+
+
+def on_host(fn):
+    """Decorator: run the whole function under host_compute().
+
+    For offline entry points (template building, normalization, zap
+    proposals) whose math uses complex phasors/FFTs — keeps them usable
+    in sessions whose default backend cannot compile complex types."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with host_compute():
+            return fn(*args, **kwargs)
+    return wrapper
