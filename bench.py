@@ -21,8 +21,13 @@ def main():
     import jax.numpy as jnp
 
     import pulseportraiture_tpu  # noqa: F401  (x64 host config)
+    from pulseportraiture_tpu import config
     from pulseportraiture_tpu.fit import fit_portrait_batch_fast
     from pulseportraiture_tpu.fit.reference_numpy import fit_portrait_numpy
+
+    # 3-pass DFTs: ~20% faster, still passes the |dphi| gate below
+    # (must be set before the first jit trace — the program caches it)
+    config.dft_precision = "high"
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"

@@ -44,6 +44,12 @@ use_pallas = "auto"
 # complex FFTs are unsupported or unusably slow); True/False force.
 use_fast_fit = "auto"
 
+# Matmul-DFT precision (ops/fourier.py) on accelerators:
+# 'highest' = 6-pass bf16 (f32-exact to ~1e-7), 'high' = 3-pass
+# (~1e-6 relative, ~20% faster end-to-end at bench shapes).  Both pass
+# the |dphi| < 1e-4 accuracy gate; f64 inputs are unaffected.
+dft_precision = "highest"
+
 # --- Model evolution codes ------------------------------------------------
 # Per-parameter evolution function code string for .gmodel files:
 # one digit each for (loc, wid, amp); '0' = power law, '1' = linear
