@@ -750,7 +750,7 @@ class GetTOAs:
     # ------------------------------------------------------------------
     def get_crosscheck_TOAs(self, datafile=None, tscrunch=False,
                             DM0=None, oversamp=16, addtnl_toa_flags={},
-                            quiet=None):
+                            append_to_list=False, quiet=None):
         """Independent-algorithm TOA cross-check (the role of the
         reference's get_psrchive_TOAs, pptoas.py:1191-1264, which
         delegated to PSRCHIVE's ArrivalTime/'pat'; with the PSRCHIVE
@@ -762,8 +762,10 @@ class GetTOAs:
         phase shift found by argmax of the oversampled circular
         cross-correlation with the scrunched template, refined by
         parabolic interpolation; errors from the FFTFIT curvature
-        formula.  Returns the list of TOA objects (also appended to
-        TOA_list)."""
+        formula.  Returns the list of TOA objects; append_to_list=True
+        additionally appends them to TOA_list (off by default so a
+        cross-check never contaminates a .tim written from a prior
+        get_TOAs run)."""
         if quiet is None:
             quiet = self.quiet
         datafiles = self.datafiles if datafile is None else [datafile]
@@ -843,8 +845,28 @@ class GetTOAs:
                           phi_err * P * 1e6, d.telescope,
                           d.telescope_code, None, None, toa_flags)
                 out.append(toa)
-                self.TOA_list.append(toa)
+                if append_to_list:
+                    self.TOA_list.append(toa)
         return out
+
+    def get_psrchive_TOAs(self, datafile=None, tscrunch=False,
+                          algorithm="PGS", addtnl_toa_flags={},
+                          quiet=None, **kwargs):
+        """Compatibility shim for the reference's PSRCHIVE-delegating
+        cross-check (pptoas.py:1191-1264).  PSRCHIVE is not a
+        dependency here; the internal time-domain CCF estimator
+        (get_crosscheck_TOAs) provides the independent second opinion.
+        `algorithm` and any extra pat-oriented kwargs are accepted for
+        signature compatibility and ignored (the shift algorithm is
+        always 'ccf-parabolic', recorded in each TOA's -alg flag)."""
+        if (algorithm != "PGS" or kwargs) and not (quiet or self.quiet):
+            ignored = ([f"algorithm={algorithm!r}"] if algorithm != "PGS"
+                       else []) + [f"{k}=..." for k in kwargs]
+            print("get_psrchive_TOAs: ignoring PSRCHIVE-specific "
+                  f"option(s) {', '.join(ignored)}")
+        return self.get_crosscheck_TOAs(
+            datafile=datafile, tscrunch=tscrunch,
+            addtnl_toa_flags=addtnl_toa_flags, quiet=quiet)
 
     # ------------------------------------------------------------------
     @on_host
