@@ -1,0 +1,263 @@
+"""Cross-archive streaming TOA measurement — the at-scale driver.
+
+GetTOAs dispatches one batched fit per archive; on the tunneled TPU
+runtime each dispatch has a ~100 ms floor, so a 1000-archive campaign
+with modest per-archive subint counts is dispatch-bound, not
+compute-bound.  This driver instead POOLS ok subints across archives
+into shape buckets — keyed by (nchan, nbin, channel-frequency layout,
+effective fit flags, and the template period when the template depends
+on P) — and fires one large fused fit per full bucket, overlapping
+archive IO with device compute via the same prefetch loader GetTOAs
+uses.  Results are scattered back to their archives and returned in
+archive order; only the few per-subint fields needed for TOA assembly
+are retained, so host memory stays O(bucket), not O(campaign).
+
+Scope: the common campaign configuration — wideband (phi[, DM]) fits,
+no scattering / GM / instrumental response / flux.  For those, use
+GetTOAs.  The fit engine is chosen by config.use_fast_fit exactly as
+in GetTOAs (complex-free f32 fast path on TPU backends), and subints
+with a single usable channel are demoted to phase-only buckets (the
+degenerate-geometry fallback, pptoas.py:519-527).
+
+The reference has no analogue (strictly sequential archive loop,
+pptoas.py:258); this is new capability enabled by the batched engine.
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..fit.portrait import (FitFlags, fit_portrait_batch,
+                            fit_portrait_batch_fast, use_fast_fit_default)
+from ..io.tim import TOA
+from ..utils.bunch import DataBunch
+from .models import TemplateModel
+from .toas import (_is_metafile, _iter_archives, _read_metafile,
+                   delta_dm_stats, load_for_toas, snr_weighted_nu_fit)
+
+
+class _Bucket:
+    """Pending subints sharing one (layout, flags) key."""
+
+    def __init__(self, freqs, nbin, modelx, flags):
+        self.freqs = freqs          # (nchan,)
+        self.nbin = int(nbin)
+        self.modelx = modelx        # (nchan, nbin) template
+        self.flags = flags          # effective FitFlags tuple
+        self.ports = []             # each (nchan, nbin)
+        self.noise = []             # each (nchan,)
+        self.masks = []             # each (nchan,)
+        self.Ps = []
+        self.nu_fits = []
+        self.theta0 = []            # each (5,)
+        self.owners = []            # (archive_index, isub)
+
+    def __len__(self):
+        return len(self.ports)
+
+
+def _flush(bucket, nu_ref_DM, max_iter, nsub_batch, results):
+    """Fit every pending subint of a bucket in ONE dispatch and scatter
+    the results into per-(archive, subint) records.  The batch is
+    always padded to a multiple of nsub_batch so dispatch shapes stay
+    canonical (each distinct shape costs an XLA compile)."""
+    n = len(bucket)
+    if n == 0:
+        return 0.0
+    pad = (-n) % nsub_batch
+    idx0 = list(range(n)) + [0] * pad  # pad with copies of subint 0
+    ports = np.stack([bucket.ports[i] for i in idx0])
+    noise = np.stack([bucket.noise[i] for i in idx0])
+    masks = np.stack([bucket.masks[i] for i in idx0])
+    Ps = np.asarray([bucket.Ps[i] for i in idx0])
+    nu_fit = np.asarray([bucket.nu_fits[i] for i in idx0])
+    theta0 = np.stack([bucket.theta0[i] for i in idx0])
+    flags = FitFlags(*bucket.flags)
+
+    t0 = time.time()
+    if use_fast_fit_default():
+        ft = jnp.float32
+        r = fit_portrait_batch_fast(
+            jnp.asarray(ports, ft), jnp.asarray(bucket.modelx, ft),
+            jnp.asarray(noise, ft), jnp.asarray(bucket.freqs, ft),
+            jnp.asarray(Ps, ft), jnp.asarray(nu_fit, ft),
+            nu_out=nu_ref_DM, theta0=jnp.asarray(theta0, ft),
+            fit_flags=flags, chan_masks=jnp.asarray(masks, ft),
+            max_iter=max_iter)
+    else:
+        r = fit_portrait_batch(
+            jnp.asarray(ports),
+            jnp.broadcast_to(jnp.asarray(bucket.modelx), ports.shape),
+            jnp.asarray(noise), jnp.asarray(bucket.freqs),
+            jnp.asarray(Ps), jnp.asarray(nu_fit),
+            nu_out=nu_ref_DM, theta0=jnp.asarray(theta0),
+            fit_flags=flags, chan_masks=jnp.asarray(masks),
+            max_iter=max_iter)
+    out = {k: np.asarray(v) for k, v in r._asdict().items()}
+    dt = time.time() - t0
+    for i in range(n):  # padded lanes are discarded
+        results[bucket.owners[i]] = {k: out[k][i] for k in
+                                     ("phi", "phi_err", "DM", "DM_err",
+                                      "nu_DM", "snr", "chi2", "dof",
+                                      "nfeval", "return_code")}
+    bucket.ports.clear(); bucket.noise.clear(); bucket.masks.clear()
+    bucket.Ps.clear(); bucket.nu_fits.clear(); bucket.theta0.clear()
+    bucket.owners.clear()
+    return dt
+
+
+def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
+                         fit_DM=True, nu_ref_DM=None, DM0=None, bary=True,
+                         tscrunch=False, max_iter=25, prefetch=True,
+                         addtnl_toa_flags={}, quiet=False):
+    """Measure wideband (phi[, DM]) TOAs for many archives with
+    cross-archive batched dispatches.
+
+    Returns a DataBunch with:
+      TOA_list        — TOA objects in archive order
+      order           — archive paths measured
+      DeltaDM_means / DeltaDM_errs — per-archive offset-DM statistics
+      fit_duration    — total seconds spent in fit dispatches
+      nfit            — number of fused dispatches fired
+    """
+    if isinstance(datafiles, str):
+        datafiles = (_read_metafile(datafiles) if _is_metafile(datafiles)
+                     else [datafiles])
+    else:
+        datafiles = list(datafiles)
+    model = TemplateModel(modelfile, quiet=quiet)
+    # scattering baked into the template makes the portrait depend on
+    # the folding period (tau seconds -> bins) — such templates must
+    # not be shared across archives with different P
+    p_dependent = model.has_scattering()
+
+    def _loader(f):
+        return load_for_toas(f, tscrunch=tscrunch, quiet=True)
+
+    buckets = {}
+    results = {}
+    meta = []        # minimal per-archive record for TOA assembly
+    fit_duration = 0.0
+    nfit = 0
+    t_start = time.time()
+
+    for iarch, (datafile, d) in enumerate(
+            _iter_archives(datafiles, _loader, prefetch)):
+        if isinstance(d, Exception):
+            print(f"Skipping {datafile}: {d}")
+            continue
+        ok = np.asarray(d.ok_isubs, int)
+        if d.nsub == 0 or len(ok) == 0:
+            print(f"No subints to fit in {datafile}; skipping.")
+            continue
+        nchan, nbin = d.nchan, d.nbin
+        freqs0 = np.asarray(d.freqs[0], float)
+        P_mean = float(np.mean(d.Ps[ok]))
+        try:
+            modelx = model.portrait(freqs0, nbin, P=P_mean)
+        except ValueError as e:
+            print(f"Skipping {datafile}: {e}")
+            continue
+        base_key = (nchan, nbin, freqs0.tobytes())
+        if p_dependent:
+            base_key += (round(P_mean, 12),)
+
+        DM_stored = float(d.DM)
+        DM0_arch = DM_stored if DM0 is None else float(DM0)
+        DM_guess = DM_stored if DM_stored != 0.0 else DM0_arch
+        masks = np.asarray(d.weights[ok] > 0.0, float)
+        noise = np.asarray(d.noise_stds[ok, 0], float)
+        snrs_chan = np.asarray(d.SNRs[ok, 0], float) * masks
+        nu_fit_arr = snr_weighted_nu_fit(snrs_chan, freqs0)
+
+        # keep only what TOA assembly needs — NOT the data cube
+        meta.append(DataBunch(
+            datafile=datafile, iarch=iarch, ok=ok,
+            DM0_arch=DM0_arch, nbin=nbin, nchan=nchan,
+            epochs=[d.epochs[isub] for isub in ok],
+            Ps=[float(d.Ps[isub]) for isub in ok],
+            dfs=[float(d.doppler_factors[isub]) for isub in ok],
+            subtimes=[float(d.subtimes[isub]) for isub in ok],
+            backend_delay=d.backend_delay, backend=d.backend,
+            frontend=d.frontend, telescope=d.telescope,
+            telescope_code=d.telescope_code))
+        ports = np.asarray(d.subints[ok, 0], float)
+        nchx = masks.sum(axis=1).astype(int)
+        for j, isub in enumerate(ok):
+            # degenerate geometry: 1 usable channel -> phase-only
+            eff_flags = ((True, False, False, False, False)
+                         if nchx[j] <= 1
+                         else (True, bool(fit_DM), False, False, False))
+            key = base_key + (eff_flags,)
+            if key not in buckets:
+                buckets[key] = _Bucket(freqs0, nbin, modelx, eff_flags)
+            b = buckets[key]
+            th = np.zeros(5)
+            th[1] = DM_guess
+            b.ports.append(ports[j])
+            b.noise.append(noise[j])
+            b.masks.append(masks[j])
+            b.Ps.append(float(d.Ps[isub]))
+            b.nu_fits.append(float(nu_fit_arr[j]))
+            b.theta0.append(th)
+            b.owners.append((iarch, int(isub)))
+            if len(b) >= nsub_batch:
+                fit_duration += _flush(b, nu_ref_DM, max_iter,
+                                       nsub_batch, results)
+                nfit += 1
+
+    for b in buckets.values():
+        if len(b):
+            fit_duration += _flush(b, nu_ref_DM, max_iter, nsub_batch,
+                                   results)
+            nfit += 1
+
+    # ---- assemble TOAs + per-archive DeltaDM stats in archive order --
+    TOA_list = []
+    order, DeltaDM_means, DeltaDM_errs = [], [], []
+    for m in meta:
+        dDMs, dDM_errs = [], []
+        for j, isub in enumerate(m.ok):
+            r = results.get((m.iarch, int(isub)))
+            if r is None:
+                continue
+            P = m.Ps[j]
+            phi = float(r["phi"])
+            toa_mjd = m.epochs[j].add_seconds(phi * P + m.backend_delay)
+            df = m.dfs[j] if bary else 1.0
+            DM_j = float(r["DM"]) * (df if (bary and fit_DM) else 1.0)
+            flags = {
+                "be": m.backend, "fe": m.frontend,
+                "f": f"{m.frontend}_{m.backend}",
+                "nbin": int(m.nbin), "nch": int(m.nchan),
+                "subint": int(isub), "tobs": m.subtimes[j],
+                "tmplt": str(modelfile), "snr": float(r["snr"]),
+                "gof": float(r["chi2"] / max(float(r["dof"]), 1.0)),
+            }
+            flags.update(addtnl_toa_flags)
+            DM_out = DM_j if fit_DM else None
+            DM_err_out = float(r["DM_err"]) if fit_DM else None
+            TOA_list.append(TOA(
+                m.datafile, float(r["nu_DM"]), toa_mjd,
+                float(r["phi_err"]) * P * 1e6, m.telescope,
+                m.telescope_code, DM_out, DM_err_out, flags))
+            if fit_DM:
+                dDMs.append(DM_j - m.DM0_arch)
+                dDM_errs.append(DM_err_out)
+        order.append(m.datafile)
+        mean, err = delta_dm_stats(dDMs, dDM_errs)
+        DeltaDM_means.append(mean)
+        DeltaDM_errs.append(err)
+
+    if not quiet:
+        tot = time.time() - t_start
+        n = len(TOA_list)
+        print(f"streamed {n} TOAs from {len(order)} archives in "
+              f"{tot:.2f} s ({nfit} fused dispatches, "
+              f"{fit_duration:.2f} s fitting, "
+              f"{n / max(tot, 1e-9):.1f} TOAs/s end-to-end)")
+    return DataBunch(TOA_list=TOA_list, order=order,
+                     DeltaDM_means=DeltaDM_means,
+                     DeltaDM_errs=DeltaDM_errs,
+                     fit_duration=fit_duration, nfit=nfit)

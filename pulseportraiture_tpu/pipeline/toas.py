@@ -66,6 +66,49 @@ def scat_time_flags(tau_rot, tau_err_rot, seconds_per_rot, log10_tau):
     return flags
 
 
+def snr_weighted_nu_fit(snrs_chan, freqs0):
+    """Per-subint fit reference frequency: the S/N * nu^-2-weighted
+    center-of-mass frequency (reference guess_fit_freq,
+    pplib.py:2715-2729), with a mean-frequency fallback for empty
+    subints.  snrs_chan: (nsub, nchan) masked channel S/Ns."""
+    w = np.maximum(snrs_chan, 0.0) * freqs0 ** -2.0
+    denom = (w * freqs0 ** -2.0).sum(axis=1)
+    denom = np.where(denom > 0, denom, 1.0)
+    nu_fit = np.sqrt(w.sum(axis=1) / denom)
+    return np.where(np.isfinite(nu_fit) & (nu_fit > 0), nu_fit,
+                    freqs0.mean())
+
+
+def load_for_toas(f, tscrunch=False, quiet=True):
+    """The load_data configuration every TOA driver uses: dispersed
+    data (dedisperse later via the fit), pscrunched, no flux profile,
+    archive object dropped."""
+    return load_data(f, dedisperse=False, dededisperse=True,
+                     tscrunch=tscrunch, pscrunch=True, flux_prof=False,
+                     refresh_arch=False, return_arch=False, quiet=quiet)
+
+
+def delta_dm_stats(dDMs, dDM_errs):
+    """Per-archive offset-DM mean and inflated error (reference
+    pptoas.py:713-729): inverse-variance weights when every error is
+    positive, uniform otherwise; variance inflated by the weighted
+    scatter when more than one subint."""
+    dDMs = np.asarray(dDMs, float)
+    errs = np.asarray(dDM_errs, float)
+    n = len(dDMs)
+    if n == 0:
+        return np.nan, np.nan
+    if np.all(errs > 0):
+        w = errs ** -2.0
+    else:
+        w = np.ones(n)
+    mean = float(np.average(dDMs, weights=w))
+    var = 1.0 / w.sum()
+    if n > 1:
+        var *= float(((dDMs - mean) ** 2 * w).sum() / (n - 1))
+    return mean, float(np.sqrt(var))
+
+
 def _iter_archives(datafiles, loader, prefetch):
     """Yield (datafile, DataBunch-or-Exception).  With prefetch, a
     single worker thread loads archive i+1 while the caller fits
@@ -217,10 +260,7 @@ class GetTOAs:
         def _loader(f):
             t0 = time.time()
             try:
-                return load_data(f, dedisperse=False, dededisperse=True,
-                                 tscrunch=tscrunch, pscrunch=True,
-                                 flux_prof=False, refresh_arch=False,
-                                 return_arch=False, quiet=quiet)
+                return load_for_toas(f, tscrunch=tscrunch, quiet=quiet)
             finally:
                 load_times[f] = time.time() - t0
 
@@ -258,13 +298,7 @@ class GetTOAs:
             if nu_fits is not None:
                 nu_fit_arr = np.full(nok, float(nu_fits[0]))
             else:
-                w = np.maximum(snrs_chan, 0.0) * freqs0 ** -2.0
-                denom = (w * freqs0 ** -2.0).sum(axis=1)
-                denom = np.where(denom > 0, denom, 1.0)
-                nu_fit_arr = np.sqrt(w.sum(axis=1) / denom)
-                nu_fit_arr = np.where(np.isfinite(nu_fit_arr) &
-                                      (nu_fit_arr > 0),
-                                      nu_fit_arr, freqs0.mean())
+                nu_fit_arr = snr_weighted_nu_fit(snrs_chan, freqs0)
 
             # initial tau guess [rot at nu_fit]
             alpha0 = (self.model.gauss.alpha if self.model.is_gaussian
@@ -558,19 +592,8 @@ class GetTOAs:
                     DM_out, DM_err_out, toa_flags))
 
             # ---- per-archive DeltaDM statistics (pptoas.py:713-729) ------
-            DeltaDMs = DMs[ok] - DM0_arch
-            errs_ok = DM_errs[ok]
-            if np.all(errs_ok > 0):
-                DM_weights = errs_ok ** -2.0
-            else:
-                DM_weights = np.ones(nok)
-            DeltaDM_mean = float(np.average(DeltaDMs, weights=DM_weights))
-            DeltaDM_var = 1.0 / DM_weights.sum()
-            if nok > 1:
-                # inflate by the reduced chi-squared of the scatter
-                DeltaDM_var *= float(
-                    ((DeltaDMs - DeltaDM_mean) ** 2 * DM_weights).sum()
-                    / (nok - 1))
+            DeltaDM_mean, DeltaDM_err = delta_dm_stats(
+                DMs[ok] - DM0_arch, DM_errs[ok])
             self.order.append(datafile)
             self.obs.append(d.telescope_code)
             self.doppler_fs.append(np.asarray(d.doppler_factors))
@@ -589,7 +612,7 @@ class GetTOAs:
             self.DMs.append(DMs)
             self.DM_errs.append(DM_errs)
             self.DeltaDM_means.append(DeltaDM_mean)
-            self.DeltaDM_errs.append(float(DeltaDM_var ** 0.5))
+            self.DeltaDM_errs.append(DeltaDM_err)
             self.GMs.append(GMs)
             self.GM_errs.append(GM_errs)
             self.taus.append(taus)

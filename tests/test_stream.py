@@ -1,0 +1,111 @@
+"""Cross-archive streaming driver: pooled-bucket fits must reproduce
+GetTOAs' per-archive results, including with padding (bucket larger
+than the subint count) and mixed archive shapes."""
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.pipeline import GetTOAs, stream_wideband_TOAs
+from pulseportraiture_tpu.io import write_gmodel
+from pulseportraiture_tpu.synth import default_test_model, make_fake_pulsar
+from pulseportraiture_tpu.utils.mjd import MJD
+
+PAR = {"PSR": "J1744-1134", "RAJ": "17:44:29.4", "DECJ": "-11:34:54.6",
+       "P0": 0.004074, "PEPOCH": 55000.0, "DM": 3.139}
+DDMS = [2e-4, -3e-4, 4e-4, -1e-4]
+
+
+@pytest.fixture(scope="module")
+def campaign(tmp_path_factory):
+    root = tmp_path_factory.mktemp("stream")
+    model = default_test_model(1500.0)
+    gmodel = str(root / "model.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files = []
+    for i, dDM in enumerate(DDMS):
+        path = str(root / f"ep{i}.fits")
+        # one archive with a different channel count exercises the
+        # multi-bucket path
+        nchan = 24 if i == 2 else 32
+        make_fake_pulsar(model, PAR, outfile=path, nsub=3, nchan=nchan,
+                         nbin=256, nu0=1500.0, bw=800.0, tsub=60.0,
+                         phase=0.05 * i, dDM=dDM,
+                         start_MJD=MJD(55100 + 10 * i, 0.1),
+                         noise_stds=0.08, dedispersed=False, quiet=True,
+                         rng=200 + i)
+        files.append(path)
+    return files, gmodel
+
+
+def test_stream_matches_gettoas(campaign):
+    files, gmodel = campaign
+    res = stream_wideband_TOAs(files, gmodel, nsub_batch=8, quiet=True)
+    assert res.order == files
+    assert len(res.TOA_list) == 4 * 3
+    gt = GetTOAs(files, gmodel, quiet=True)
+    gt.get_TOAs(quiet=True, max_iter=25)
+    by_key = {}
+    for t in res.TOA_list:
+        by_key[(t.archive, t.flags["subint"])] = t
+    for i, f in enumerate(files):
+        # per-archive DeltaDM statistics agree
+        assert res.DeltaDM_means[i] == pytest.approx(
+            gt.DeltaDM_means[i], abs=1e-7)
+        for isub in gt.ok_isubs[i]:
+            t = by_key[(f, int(isub))]
+            # same TOA (phase + frequency reference) and DM
+            assert t.frequency == pytest.approx(
+                gt.nu_refs[i][isub][0], rel=1e-9)
+            assert t.DM == pytest.approx(gt.DMs[i][isub], abs=1e-9)
+            wb = gt.TOAs[i][isub]
+            dt_us = abs((wb.day - t.MJD.day) * 86400.0
+                        + (wb.frac - t.MJD.frac) * 86400.0) * 1e6
+            assert dt_us < 1e-3, (i, isub, dt_us)  # sub-nanosecond
+            assert t.TOA_error == pytest.approx(
+                gt.TOA_errs[i][isub], rel=1e-6)
+
+
+def test_stream_bucket_padding(campaign):
+    """nsub_batch much larger than the total subint count: everything
+    lands in one padded dispatch and results are unchanged."""
+    files, gmodel = campaign
+    a = stream_wideband_TOAs(files, gmodel, nsub_batch=8, quiet=True)
+    b = stream_wideband_TOAs(files, gmodel, nsub_batch=256, quiet=True)
+    assert len(a.TOA_list) == len(b.TOA_list)
+    assert b.nfit == 2  # one per shape bucket
+    for ta, tb in zip(a.TOA_list, b.TOA_list):
+        assert ta.archive == tb.archive
+        assert ta.DM == pytest.approx(tb.DM, abs=1e-12)
+        assert (ta.MJD.day, ta.MJD.frac) == (tb.MJD.day, tb.MJD.frac)
+
+
+def test_stream_skips_bad_archive(campaign, tmp_path):
+    files, gmodel = campaign
+    bad = str(tmp_path / "corrupt.fits")
+    with open(bad, "w") as f:
+        f.write("not a fits file")
+    res = stream_wideband_TOAs([files[0], bad, files[1]], gmodel,
+                               quiet=True)
+    assert res.order == [files[0], files[1]]
+    assert len(res.TOA_list) == 6
+
+
+def test_stream_degenerate_subint(campaign, tmp_path):
+    """A subint with one usable channel is demoted to a phase-only
+    bucket (no garbage two-parameter fit), matching GetTOAs."""
+    files, gmodel = campaign
+    model = default_test_model(1500.0)
+    w = np.ones((2, 32))
+    w[0, 1:] = 0.0
+    path = str(tmp_path / "degen.fits")
+    make_fake_pulsar(model, PAR, outfile=path, nsub=2, nchan=32, nbin=256,
+                     tsub=60.0, noise_stds=0.08, weights=w,
+                     dedispersed=False, quiet=True, rng=9)
+    res = stream_wideband_TOAs([path], gmodel, nsub_batch=8, quiet=True)
+    assert len(res.TOA_list) == 2
+    assert res.nfit == 2  # one full bucket + one phase-only bucket
+    for t in res.TOA_list:
+        assert np.isfinite(t.TOA_error)
+    # the degenerate subint reports the fixed header DM (phase-only)
+    t0 = [t for t in res.TOA_list if t.flags["subint"] == 0][0]
+    assert t0.DM == pytest.approx(PAR["DM"], abs=1e-9)
