@@ -26,7 +26,6 @@ import numpy as np  # noqa: E402
 
 
 def main(keep=False, nepoch=5):
-    from pulseportraiture_tpu.io import write_gmodel
     from pulseportraiture_tpu.io.tim import write_TOAs
     from pulseportraiture_tpu.pipeline import GetTOAs, align_archives
     from pulseportraiture_tpu.pipeline.gauss import GaussPortrait

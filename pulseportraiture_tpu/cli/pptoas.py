@@ -66,6 +66,9 @@ def build_parser():
                    help="Save per-subint fit plots next to the archives.")
     p.add_argument("--prefetch", action="store_true", default=False,
                    help="Overlap archive IO with fitting (long lists).")
+    p.add_argument("--stream", action="store_true", default=False,
+                   help="Cross-archive batched dispatches for large "
+                        "campaigns (wideband phi/DM fits only).")
     p.add_argument("--quiet", action="store_true", default=False)
     # accepted for reference-script compatibility; no-ops here:
     p.add_argument("--psrchive", action="store_true", default=False,
@@ -93,6 +96,38 @@ def main(argv=None):
     if args.flags:
         parts = args.flags.split(",")
         addtnl = dict(zip(parts[0::2], parts[1::2]))
+
+    if args.stream:
+        if (args.narrowband or args.psrchive or args.fit_GM
+                or args.fit_scat or args.one_DM or args.print_flux
+                or args.print_phase or args.print_parangle
+                or args.showplot):
+            raise SystemExit(
+                "--stream supports the wideband (phi, DM) campaign "
+                "configuration only (no narrowband/GM/scattering/"
+                "one_DM/flux/phase/parangle flags or plots)")
+        from ..pipeline.stream import stream_wideband_TOAs
+
+        res = stream_wideband_TOAs(
+            args.datafiles, args.modelfile, fit_DM=args.fit_DM,
+            nu_ref_DM=nu_ref_DM, DM0=args.DM0, bary=args.bary,
+            tscrunch=args.tscrunch, addtnl_toa_flags=addtnl,
+            quiet=args.quiet)
+        if args.format == "princeton":
+            dDMs = [toa.DM - res.DM0s[res.order.index(toa.archive)]
+                    if toa.DM is not None else 0.0
+                    for toa in res.TOA_list]
+            write_princeton_TOAs(res.TOA_list, outfile=args.outfile,
+                                 dDMs=dDMs)
+            if args.errfile:
+                with open(args.errfile, "a") as f:
+                    for toa in res.TOA_list:
+                        if toa.DM_error is not None:
+                            f.write(f"{toa.DM_error:.5e}\n")
+        else:
+            write_TOAs(res.TOA_list, SNR_cutoff=args.snr_cutoff,
+                       outfile=args.outfile, append=True)
+        return 0
 
     gt = GetTOAs(args.datafiles, args.modelfile, quiet=args.quiet)
     if args.narrowband or args.psrchive:

@@ -5,7 +5,6 @@ with a jittable Gauss-Newton, and fit_DM_to_freq_resids
 (pplib.py:1883-1919) with a closed-form weighted linear solve.
 """
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp

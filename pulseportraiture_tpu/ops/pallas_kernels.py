@@ -14,8 +14,6 @@ XLA path remains the reference implementation and the two are tested
 against each other (tests/test_pallas.py, interpret mode on CPU).
 """
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl

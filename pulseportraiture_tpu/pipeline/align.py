@@ -21,7 +21,7 @@ from ..io.psrfits import load_data, read_archive, unload_new_archive
 from ..models.gaussian import gen_gaussian_profile
 from ..ops.rotation import rotate_portrait
 from .portrait import normalize_portrait
-from .toas import _is_metafile, _read_metafile
+from .toas import _read_metafile
 
 
 def psradd_archives(datafiles, outfile=None, quiet=False):

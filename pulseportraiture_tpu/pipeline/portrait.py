@@ -17,7 +17,6 @@ from ..fit.phase_shift import fit_phase_shift
 from ..fit.powlaw import fit_powlaw
 from ..io.psrfits import load_data, noise_std_ps, unload_new_archive
 from ..ops.rotation import rotate_portrait
-from ..utils.bunch import DataBunch
 from ..utils.device import on_host
 from .toas import _is_metafile, _read_metafile
 

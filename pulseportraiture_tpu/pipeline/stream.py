@@ -117,6 +117,7 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
     Returns a DataBunch with:
       TOA_list        — TOA objects in archive order
       order           — archive paths measured
+      DM0s            — per-archive nominal DM (offset-DM reference)
       DeltaDM_means / DeltaDM_errs — per-archive offset-DM statistics
       fit_duration    — total seconds spent in fit dispatches
       nfit            — number of fused dispatches fired
@@ -215,7 +216,7 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
 
     # ---- assemble TOAs + per-archive DeltaDM stats in archive order --
     TOA_list = []
-    order, DeltaDM_means, DeltaDM_errs = [], [], []
+    order, DM0s, DeltaDM_means, DeltaDM_errs = [], [], [], []
     for m in meta:
         dDMs, dDM_errs = [], []
         for j, isub in enumerate(m.ok):
@@ -246,6 +247,7 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                 dDMs.append(DM_j - m.DM0_arch)
                 dDM_errs.append(DM_err_out)
         order.append(m.datafile)
+        DM0s.append(m.DM0_arch)
         mean, err = delta_dm_stats(dDMs, dDM_errs)
         DeltaDM_means.append(mean)
         DeltaDM_errs.append(err)
@@ -257,7 +259,7 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
               f"{tot:.2f} s ({nfit} fused dispatches, "
               f"{fit_duration:.2f} s fitting, "
               f"{n / max(tot, 1e-9):.1f} TOAs/s end-to-end)")
-    return DataBunch(TOA_list=TOA_list, order=order,
+    return DataBunch(TOA_list=TOA_list, order=order, DM0s=DM0s,
                      DeltaDM_means=DeltaDM_means,
                      DeltaDM_errs=DeltaDM_errs,
                      fit_duration=fit_duration, nfit=nfit)

@@ -30,7 +30,6 @@ from ..fit.portrait import (FitFlags, fit_portrait_batch,
 from ..io.psrfits import load_data
 from ..io.tim import TOA
 from ..ops.scattering import scattering_portrait_FT, scattering_times
-from ..utils.bunch import DataBunch
 from ..utils.device import on_host
 from .models import TemplateModel
 

@@ -126,3 +126,40 @@ def test_ppzap_cli(workspace, tmp_path):
     rc = ppzap.main(["-d", files[0], "-m", str(root / "avg.gmodel"),
                      "--quiet"])
     assert rc == 0
+
+
+def test_pptoas_cli_stream_matches(workspace, tmp_path):
+    """--stream produces the same TOA lines (up to float formatting) as
+    the per-archive path for a wideband phi/DM run."""
+    from pulseportraiture_tpu.io import write_gmodel
+
+    root, meta, files = workspace
+    gm = str(tmp_path / "truth.gmodel")
+    write_gmodel(default_test_model(1500.0), gm, quiet=True)
+    tim_a = tmp_path / "seq.tim"
+    tim_b = tmp_path / "str.tim"
+    assert pptoas.main(["-d", meta, "-m", gm, "-o", str(tim_a),
+                        "--quiet"]) == 0
+    assert pptoas.main(["-d", meta, "-m", gm, "-o", str(tim_b),
+                        "--stream", "--quiet"]) == 0
+    la = tim_a.read_text().strip().splitlines()
+    lb = tim_b.read_text().strip().splitlines()
+    assert len(la) == len(lb) == 6
+    for a, b in zip(la, lb):
+        fa, fb = a.split(), b.split()
+        assert fa[0] == fb[0]          # archive
+        assert abs(float(fa[1]) - float(fb[1])) < 1e-6  # freq
+        # MJD to f64 parse precision (~1e-11 day ~ 1 us), TOA error and
+        # -pp_dm/-pp_dme to ppm — catches dropped backend_delay, P
+        # scaling, or error-propagation bugs in the fused path
+        assert abs(float(fa[2]) - float(fb[2])) < 2e-11
+        assert float(fb[3]) == pytest.approx(float(fa[3]), rel=1e-5)
+        da = dict(zip(fa[5::2], fa[6::2]))
+        db = dict(zip(fb[5::2], fb[6::2]))
+        for key in ("-pp_dm", "-pp_dme"):
+            assert float(db[key]) == pytest.approx(float(da[key]),
+                                                   rel=1e-5, abs=1e-9)
+    # rejects unsupported configurations
+    with pytest.raises(SystemExit):
+        pptoas.main(["-d", meta, "-m", gm, "--stream", "--fit_scat",
+                     "--quiet"])

@@ -4,7 +4,6 @@ analytic FT identities, rotate∘unrotate = id, noise calibration."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from pulseportraiture_tpu.config import Dconst
 from pulseportraiture_tpu.ops import (
@@ -22,7 +21,6 @@ from pulseportraiture_tpu.ops import (
     rotate_portrait,
     rotate_profile,
     scattering_kernel_time,
-    scattering_portrait_FT,
     scattering_profile_FT,
     scattering_times,
 )

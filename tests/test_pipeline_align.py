@@ -9,8 +9,6 @@ channel; normalization methods have their defining properties.
 import numpy as np
 import pytest
 
-import jax.numpy as jnp
-
 from pulseportraiture_tpu.io import load_data
 from pulseportraiture_tpu.io.gmodel import gen_gmodel_portrait
 from pulseportraiture_tpu.pipeline import (

@@ -2,7 +2,6 @@
 wideband TOAs recover them (the reference's examples/example.py
 verification flow, SURVEY §4)."""
 
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

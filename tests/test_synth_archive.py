@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 from pulseportraiture_tpu.fit import fit_portrait
-from pulseportraiture_tpu.io import load_data, write_gmodel
+from pulseportraiture_tpu.io import load_data
 from pulseportraiture_tpu.io.gmodel import gen_gmodel_portrait
 from pulseportraiture_tpu.ops.phasor import phase_transform
 from pulseportraiture_tpu.synth import default_test_model, make_fake_pulsar
