@@ -17,6 +17,11 @@ Dconst = Dconst_trad
 # (reference pplib.py:70).
 scattering_alpha = -4.0
 
+# Vestigial fudge factor the reference kept in rotation signatures and
+# never varied (pplib.py:99); retained solely so scripts reading it
+# keep working.  Nothing in this package consumes it.
+binshift = 1.0
+
 # --- Noise estimation -----------------------------------------------------
 # 'PS' = mean power of the top quarter of the power spectrum
 # (reference pplib.py:74-78, 2312-2338).

@@ -13,7 +13,7 @@ from ..config import Dconst
 from ..utils.bunch import DataBunch
 
 __all__ = ["powlaw", "powlaw_integral", "powlaw_freqs", "fit_powlaw",
-           "fit_DM_to_freq_resids"]
+           "fit_powlaw_function", "fit_DM_to_freq_resids"]
 
 
 def powlaw(nu, nu_ref, A, alpha):
@@ -165,3 +165,15 @@ def fit_DM_to_freq_resids(freqs, frequency_residuals, errs):
         nu_ref=nu_ref, nu_ref_err=nu_ref_err, ab_cov=float(vab),
         residuals=resids, chi2=chi2, dof=dof, red_chi2=red,
     )
+
+
+def fit_powlaw_function(params, freqs, nu_ref, data, errs=None):
+    """Weighted residuals of a power-law model — the reference's
+    objective callable (fit_powlaw_function, pplib.py:1251-1264), kept
+    for API parity and as a finite-difference oracle for the
+    Gauss-Newton fit; params = (A, alpha)."""
+    A, alpha = params[0], params[1]
+    resid = data - powlaw(jnp.asarray(freqs), nu_ref, A, alpha)
+    if errs is not None:
+        resid = resid / jnp.asarray(errs)
+    return resid

@@ -110,3 +110,17 @@ def instrumental_response_port_FT(
         k = jnp.arange(nharm, dtype=freqs.dtype)
         out = out * jnp.sinc(k[None, :] * w[:, None])
     return out
+
+
+def gaussian_function(xs, loc, wid, norm=False):
+    """Plain (non-wrapped) Gaussian with FWHM ``wid`` evaluated at xs
+    (reference signature, pplib.py:782-798): peak 1 by default,
+    unit-area with norm=True.  The phase-wrapped profile version is
+    gaussian_profile."""
+    xs = jnp.asarray(xs)
+    sigma = wid * FWHM2SIGMA
+    z = (xs - loc) / sigma
+    y = jnp.exp(-0.5 * z ** 2.0)
+    if norm:
+        y = y / (sigma * jnp.sqrt(2.0 * jnp.pi))
+    return y

@@ -89,3 +89,21 @@ def add_DM_nu(port, phi, DM_coeffs, powers, P, freqs, nu_ref):
     pFT = jnp.fft.rfft(port, axis=-1)
     ph = phasor(delays, pFT.shape[-1])
     return jnp.fft.irfft(pFT * ph, n=nbin, axis=-1)
+
+
+def fft_rotate(arr, bins):
+    """Rotate a 1-D series LEFT by ``bins`` places (can be fractional):
+    y(n) = x(n + bins), i.e. np.roll(x, -bins) for integers — the
+    reference's PRESTO-style testing helper (pplib.py:2655-2669).
+
+    Implemented as its own phase ramp (not via rotate_profile), so it
+    serves as an independent oracle for the main rotation kernels:
+    fft_rotate(x, b) == rotate_profile(x, b/nbin).
+    """
+    arr = jnp.asarray(arr)
+    nbin = arr.shape[-1]
+    dt = jnp.result_type(arr.dtype, jnp.float32)
+    b = jnp.asarray(bins, dt)
+    k = jnp.arange(nbin // 2 + 1, dtype=dt)
+    ramp = jnp.exp(2.0j * jnp.pi * k * b / nbin)
+    return jnp.fft.irfft(jnp.fft.rfft(arr.astype(dt)) * ramp, n=nbin)
