@@ -166,8 +166,10 @@ def _chi2_prime_X(theta, X, M2, freqs, P, nu_fit, ir_FT, log10_tau):
 
 
 def use_pallas_moments(dtype):
-    """Pallas fused kernel only on TPU backends, f32 data, and when not
-    disabled via config (the XLA path is the reference)."""
+    """Whether the fused Pallas moment kernel should run: opt-in via
+    config.use_pallas (True = f32 data anywhere, 'auto' = TPU backends;
+    default False — the XLA path is the reference and measures faster
+    at production shapes)."""
     setting = getattr(config, "use_pallas", "auto")
     if setting is False:
         return False
