@@ -272,3 +272,31 @@ def test_fit_portrait_alpha_recovery(key):
     nu_tau = float(r.nu_tau[0])
     expect_rot = (3e-4 / P) * (nu_tau / 1500.0) ** -4.2
     assert float(r.tau[0]) == pytest.approx(expect_rot, rel=0.15)
+
+
+def test_fit_portrait_tau_error_calibration(key):
+    """Scattering-timescale pulls over noise realizations ~ N(0,1):
+    validates the log-tau error propagation through _finalize_fit."""
+    ntrial = 16
+    keys = jax.random.split(key, ntrial)
+    model = default_test_model(1500.0)
+    true_tau_s = 2e-4
+    zs = []
+    for k in keys:
+        d = fake_portrait(k, model, FREQS, NBIN, P, tau=true_tau_s,
+                          alpha=-4.0, noise_std=0.03)
+        th0 = np.zeros((1, 5))
+        th0[0, 3] = np.log10(0.5 / NBIN)
+        th0[0, 4] = -4.0
+        r = fit_portrait_batch(
+            d.port[None], d.model_port[None], d.noise_stds[None], FREQS,
+            P, 1500.0, fit_flags=FitFlags(True, True, False, True, False),
+            theta0=jnp.asarray(th0), log10_tau=True, max_iter=60)
+        nu_tau = float(r.nu_tau[0])
+        expect_rot = (true_tau_s / P) * (nu_tau / 1500.0) ** -4.0
+        zs.append((float(r.tau[0]) - expect_rot) / float(r.tau_err[0]))
+    z = np.asarray(zs)
+    # mean may carry a small discretization bias; the scatter must
+    # match the reported uncertainty
+    assert abs(z.mean()) < 1.5, z
+    assert 0.4 < z.std() < 2.5, z
