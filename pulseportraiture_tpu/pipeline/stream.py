@@ -30,7 +30,7 @@ import numpy as np
 
 from ..fit.portrait import (FitFlags, fit_portrait_batch,
                             fit_portrait_batch_fast, use_fast_fit_default)
-from ..io.tim import TOA
+from ..io.tim import TOA, write_TOAs
 from ..utils.bunch import DataBunch
 from .models import TemplateModel
 from .toas import (_is_metafile, _iter_archives, _read_metafile,
@@ -64,7 +64,7 @@ def _flush(bucket, nu_ref_DM, max_iter, nsub_batch, results):
     canonical (each distinct shape costs an XLA compile)."""
     n = len(bucket)
     if n == 0:
-        return 0.0
+        return 0.0, []
     pad = (-n) % nsub_batch
     idx0 = list(range(n)) + [0] * pad  # pad with copies of subint 0
     ports = np.stack([bucket.ports[i] for i in idx0])
@@ -96,6 +96,7 @@ def _flush(bucket, nu_ref_DM, max_iter, nsub_batch, results):
             max_iter=max_iter)
     out = {k: np.asarray(v) for k, v in r._asdict().items()}
     dt = time.time() - t0
+    resolved = list(bucket.owners)
     for i in range(n):  # padded lanes are discarded
         results[bucket.owners[i]] = {k: out[k][i] for k in
                                      ("phi", "phi_err", "DM", "DM_err",
@@ -104,15 +105,57 @@ def _flush(bucket, nu_ref_DM, max_iter, nsub_batch, results):
     bucket.ports.clear(); bucket.noise.clear(); bucket.masks.clear()
     bucket.Ps.clear(); bucket.nu_fits.clear(); bucket.theta0.clear()
     bucket.owners.clear()
-    return dt
+    return dt, resolved
+
+
+def _assemble_archive(m, results, modelfile, fit_DM, bary,
+                      addtnl_toa_flags):
+    """Build the TOA objects + DeltaDM stats for one archive from the
+    scattered fit results."""
+    toas, dDMs, dDM_errs = [], [], []
+    for j, isub in enumerate(m.ok):
+        r = results.get((m.iarch, int(isub)))
+        if r is None:
+            continue
+        P = m.Ps[j]
+        phi = float(r["phi"])
+        toa_mjd = m.epochs[j].add_seconds(phi * P + m.backend_delay)
+        df = m.dfs[j] if bary else 1.0
+        DM_j = float(r["DM"]) * (df if (bary and fit_DM) else 1.0)
+        flags = {
+            "be": m.backend, "fe": m.frontend,
+            "f": f"{m.frontend}_{m.backend}",
+            "nbin": int(m.nbin), "nch": int(m.nchan),
+            "subint": int(isub), "tobs": m.subtimes[j],
+            "tmplt": str(modelfile), "snr": float(r["snr"]),
+            "gof": float(r["chi2"] / max(float(r["dof"]), 1.0)),
+        }
+        flags.update(addtnl_toa_flags)
+        DM_out = DM_j if fit_DM else None
+        DM_err_out = float(r["DM_err"]) if fit_DM else None
+        toas.append(TOA(
+            m.datafile, float(r["nu_DM"]), toa_mjd,
+            float(r["phi_err"]) * P * 1e6, m.telescope,
+            m.telescope_code, DM_out, DM_err_out, flags))
+        if fit_DM:
+            dDMs.append(DM_j - m.DM0_arch)
+            dDM_errs.append(DM_err_out)
+    mean, err = delta_dm_stats(dDMs, dDM_errs)
+    return toas, mean, err
 
 
 def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                          fit_DM=True, nu_ref_DM=None, DM0=None, bary=True,
                          tscrunch=False, max_iter=25, prefetch=True,
-                         addtnl_toa_flags={}, quiet=False):
+                         addtnl_toa_flags={}, tim_out=None, quiet=False):
     """Measure wideband (phi[, DM]) TOAs for many archives with
     cross-archive batched dispatches.
+
+    tim_out: optional .tim path; each archive's TOA lines are APPENDED
+    as soon as all its subints are fitted, so a campaign interrupted
+    mid-run keeps every completed archive's results on disk (the
+    fault-tolerance analogue of the reference's write-the-model-every-
+    iteration habit, ppgauss.py:208-212).
 
     Returns a DataBunch with:
       TOA_list        — TOA objects in archive order
@@ -132,6 +175,10 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
     # the folding period (tau seconds -> bins) — such templates must
     # not be shared across archives with different P
     p_dependent = model.has_scattering()
+    if tim_out:
+        # fresh checkpoint file: a rerun must not append onto a
+        # previous campaign's lines
+        open(tim_out, "w").close()
 
     def _loader(f):
         return load_for_toas(f, tscrunch=tscrunch, quiet=True)
@@ -139,9 +186,37 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
     buckets = {}
     results = {}
     meta = []        # minimal per-archive record for TOA assembly
+    meta_by_iarch = {}
+    remaining = {}   # iarch -> subints not yet fitted
+    assembled = {}   # iarch -> (toas, DeltaDM_mean, DeltaDM_err)
     fit_duration = 0.0
     nfit = 0
     t_start = time.time()
+
+    def do_flush(b):
+        nonlocal fit_duration, nfit
+        dt, resolved = _flush(b, nu_ref_DM, max_iter, nsub_batch, results)
+        fit_duration += dt
+        nfit += 1
+        touched = set()
+        for iarch, _ in resolved:
+            remaining[iarch] -= 1
+            touched.add(iarch)
+        for ia in touched:
+            # emit completed archives immediately: an interrupted
+            # campaign keeps everything finished so far
+            if remaining[ia] == 0 and ia not in assembled:
+                m = meta_by_iarch[ia]
+                out = _assemble_archive(
+                    m, results, modelfile, fit_DM, bary,
+                    addtnl_toa_flags)
+                assembled[ia] = out
+                # the per-subint records are folded into the assembly;
+                # dropping them keeps host memory O(bucket)
+                for isub in m.ok:
+                    results.pop((ia, int(isub)), None)
+                if tim_out:
+                    write_TOAs(out[0], outfile=tim_out, append=True)
 
     for iarch, (datafile, d) in enumerate(
             _iter_archives(datafiles, _loader, prefetch)):
@@ -173,7 +248,7 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
         nu_fit_arr = snr_weighted_nu_fit(snrs_chan, freqs0)
 
         # keep only what TOA assembly needs — NOT the data cube
-        meta.append(DataBunch(
+        m = DataBunch(
             datafile=datafile, iarch=iarch, ok=ok,
             DM0_arch=DM0_arch, nbin=nbin, nchan=nchan,
             epochs=[d.epochs[isub] for isub in ok],
@@ -182,7 +257,10 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
             subtimes=[float(d.subtimes[isub]) for isub in ok],
             backend_delay=d.backend_delay, backend=d.backend,
             frontend=d.frontend, telescope=d.telescope,
-            telescope_code=d.telescope_code))
+            telescope_code=d.telescope_code)
+        meta.append(m)
+        meta_by_iarch[iarch] = m
+        remaining[iarch] = len(ok)
         ports = np.asarray(d.subints[ok, 0], float)
         nchx = masks.sum(axis=1).astype(int)
         for j, isub in enumerate(ok):
@@ -204,51 +282,21 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
             b.theta0.append(th)
             b.owners.append((iarch, int(isub)))
             if len(b) >= nsub_batch:
-                fit_duration += _flush(b, nu_ref_DM, max_iter,
-                                       nsub_batch, results)
-                nfit += 1
+                do_flush(b)
 
     for b in buckets.values():
         if len(b):
-            fit_duration += _flush(b, nu_ref_DM, max_iter, nsub_batch,
-                                   results)
-            nfit += 1
+            do_flush(b)
 
-    # ---- assemble TOAs + per-archive DeltaDM stats in archive order --
+    # ---- collect TOAs + per-archive DeltaDM stats in archive order --
     TOA_list = []
     order, DM0s, DeltaDM_means, DeltaDM_errs = [], [], [], []
     for m in meta:
-        dDMs, dDM_errs = [], []
-        for j, isub in enumerate(m.ok):
-            r = results.get((m.iarch, int(isub)))
-            if r is None:
-                continue
-            P = m.Ps[j]
-            phi = float(r["phi"])
-            toa_mjd = m.epochs[j].add_seconds(phi * P + m.backend_delay)
-            df = m.dfs[j] if bary else 1.0
-            DM_j = float(r["DM"]) * (df if (bary and fit_DM) else 1.0)
-            flags = {
-                "be": m.backend, "fe": m.frontend,
-                "f": f"{m.frontend}_{m.backend}",
-                "nbin": int(m.nbin), "nch": int(m.nchan),
-                "subint": int(isub), "tobs": m.subtimes[j],
-                "tmplt": str(modelfile), "snr": float(r["snr"]),
-                "gof": float(r["chi2"] / max(float(r["dof"]), 1.0)),
-            }
-            flags.update(addtnl_toa_flags)
-            DM_out = DM_j if fit_DM else None
-            DM_err_out = float(r["DM_err"]) if fit_DM else None
-            TOA_list.append(TOA(
-                m.datafile, float(r["nu_DM"]), toa_mjd,
-                float(r["phi_err"]) * P * 1e6, m.telescope,
-                m.telescope_code, DM_out, DM_err_out, flags))
-            if fit_DM:
-                dDMs.append(DM_j - m.DM0_arch)
-                dDM_errs.append(DM_err_out)
+        toas, mean, err = assembled.get(m.iarch) or _assemble_archive(
+            m, results, modelfile, fit_DM, bary, addtnl_toa_flags)
+        TOA_list.extend(toas)
         order.append(m.datafile)
         DM0s.append(m.DM0_arch)
-        mean, err = delta_dm_stats(dDMs, dDM_errs)
         DeltaDM_means.append(mean)
         DeltaDM_errs.append(err)
 
