@@ -54,9 +54,23 @@ use_fast_fit = "auto"
 
 # Matmul-DFT precision (ops/fourier.py) on accelerators:
 # 'highest' = 6-pass bf16 (f32-exact to ~1e-7), 'high' = 3-pass
-# (~1e-6 relative, ~20% faster end-to-end at bench shapes).  Both pass
-# the |dphi| < 1e-4 accuracy gate; f64 inputs are unaffected.
+# (~1e-6 relative, ~20% faster end-to-end at bench shapes), 'default' =
+# single-pass bf16 (~1e-3 relative per harmonic, ~40% faster end-to-end;
+# the quantization error averages down across harmonics x channels in
+# the fit's moments and measures BELOW 'high' on the |dphi| gate at
+# bench noise levels — but do not use it for very-high-S/N data where
+# ~1e-3 relative errors could rival the noise floor).  All three pass
+# the |dphi| < 1e-4 accuracy gate at bench configs; f64 inputs are
+# unaffected.
 dft_precision = "highest"
+
+# Storage dtype for the fit's precomputed cross-spectrum X = d*conj(m)*w
+# (fit/portrait.py fast path).  None = same as the input data (f32 on
+# TPU).  'bfloat16' halves the Newton loop's HBM read traffic (~15%
+# end-to-end at bench shapes); moments still accumulate in f32.  Same
+# caveat as dft_precision='default': validated against the |dphi| gate
+# at bench noise levels, avoid for extreme-S/N data.
+cross_spectrum_dtype = None
 
 # --- Model evolution codes ------------------------------------------------
 # Per-parameter evolution function code string for .gmodel files:

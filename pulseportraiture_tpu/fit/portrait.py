@@ -258,23 +258,61 @@ class _NewtonState(NamedTuple):
     f: jnp.ndarray
     g: jnp.ndarray
     H: jnp.ndarray
+    aux: object
     lam: jnp.ndarray
     it: jnp.ndarray
     nfev: jnp.ndarray
+    rej: jnp.ndarray
     code: jnp.ndarray
     done: jnp.ndarray
 
 
-def _newton_loop(cgh, theta0, flags_arr, max_iter, ftol, lam0=1.0e-3):
+def _with_no_aux(cgh):
+    """Adapt a (f, g, H) evaluator to the (f, g, H, aux) contract."""
+
+    def wrapped(theta):
+        f, g, H = cgh(theta)
+        return f, g, H, ()
+
+    return wrapped
+
+
+def _newton_loop(cgh, theta0, flags_arr, max_iter, ftol, lam0=1.0e-3,
+                 stall_max=4):
     """Levenberg-damped Newton minimization given a fused
-    (f, grad, hess) evaluator — exactly one cgh() call per iteration.
+    (f, grad, hess, aux) evaluator — exactly one cgh() call per
+    iteration.  aux is any pytree computed alongside (e.g. the
+    per-channel moments C); the state always carries the aux that
+    matches state.theta, so callers can package results without an
+    extra objective evaluation after the loop.
 
     Damping uses H + lam*diag(|H|) (scale-invariant, LM-style), so no
     per-parameter preconditioning is needed despite phi/DM/GM living on
     wildly different scales.  Convergence when the predicted quadratic
     improvement 0.5 g^T diag(H)^-1 g falls below ftol * (|f| + 1)
-    (dtype-aware default).  Return codes follow the reference's small
-    vocabulary (config.RCSTRINGS): 0 converged, 3 max-iterations.
+    (dtype-aware default).  A run of stall_max consecutive *flat*
+    rejections — f_new within machine noise of f, i.e. no improving
+    step exists and lam growth has shrunk the damped step to nothing —
+    also terminates: that is the floating-point optimum, and without
+    this exit a handful of such elements pins a whole vmapped batch at
+    max_iter (measured 26 vs 2 median evals at bench shapes).  Genuine
+    overshoots (f_new clearly above f, normal during early lam
+    adaptation from a distant seed) reset the flat counter and never
+    trigger the exit.  Return codes follow the reference's small
+    vocabulary (config.RCSTRINGS): 0 converged, 2 step-size underflow
+    (tolerated as success, like the reference's {1,2,4};
+    pptoaslib.py:1068), 3 max-iterations.
+
+    The initial objective is evaluated INSIDE the loop (a bootstrap
+    trip with a zero step from f=+inf, g=0, H=I), never before it.
+    XLA fuses an outside-the-loop cgh instance into the surrounding
+    program with a different reduction schedule than the loop body's
+    instance, and on TPU the two disagree by O(sqrt(N) eps |f|) —
+    larger than the whole first-step improvement of a near-perfectly
+    seeded element, which then gets every step spuriously rejected
+    (measured: 20/640 bench elements pinned at max_iter).  Keeping all
+    f comparisons between identically-scheduled evaluations costs one
+    loop trip and removes the failure mode.
     """
     nfix = 1.0 - flags_arr
     dt = theta0.dtype
@@ -285,7 +323,8 @@ def _newton_loop(cgh, theta0, flags_arr, max_iter, ftol, lam0=1.0e-3):
         return g, H
 
     def cond(s):
-        return jnp.logical_and(s.it < max_iter, jnp.logical_not(s.done))
+        # max_iter + 1: the bootstrap trip is not a Newton iteration
+        return jnp.logical_and(s.it < max_iter + 1, jnp.logical_not(s.done))
 
     def _pred(g, H):
         """Predicted quadratic improvement of a diagonal-Newton step —
@@ -298,45 +337,85 @@ def _newton_loop(cgh, theta0, flags_arr, max_iter, ftol, lam0=1.0e-3):
         g, H = mask_gH(s.g, s.H)
         pred_cur, dH = _pred(g, H)
         # converged at the incumbent point (handles warm starts at the
-        # optimum, where no strictly-improving step exists)
-        conv_now = pred_cur < ftol * (jnp.abs(s.f) + 1.0)
+        # optimum, where no strictly-improving step exists); the
+        # isfinite guard keeps the bootstrap trip (f = +inf) alive
+        conv_now = jnp.logical_and(
+            pred_cur < ftol * (jnp.abs(s.f) + 1.0), jnp.isfinite(s.f))
         A = H + s.lam * jnp.diag(dH)
         step = -jnp.linalg.solve(A, g)
         theta_new = s.theta + step * flags_arr
-        f_new, g_new, H_new = cgh(theta_new)
+        f_new, g_new, H_new, aux_new = cgh(theta_new)
         accept = jnp.logical_and(f_new < s.f, jnp.logical_not(conv_now))
         gm, _ = mask_gH(g_new, H_new)
         pred_new, _ = _pred(gm, H)
-        done = jnp.logical_or(
+        # the isfinite guard keeps the bootstrap trip (whose pred_new is
+        # judged against the placeholder identity Hessian, not real
+        # curvature) from ever declaring step-convergence at the seed
+        done_conv = jnp.logical_or(
             conv_now,
-            jnp.logical_and(accept, pred_new < ftol * (jnp.abs(f_new) + 1.0)),
+            jnp.logical_and(
+                jnp.logical_and(accept, jnp.isfinite(s.f)),
+                pred_new < ftol * (jnp.abs(f_new) + 1.0)),
         )
-        code = jnp.where(done, 0, s.code)
+        flat = jnp.logical_and(
+            jnp.logical_not(accept),
+            f_new <= s.f + 64.0 * jnp.finfo(dt).eps * (jnp.abs(s.f) + 1.0))
+        rej_new = jnp.where(flat, s.rej + 1, 0)
+        done_stall = jnp.logical_and(rej_new >= stall_max,
+                                     jnp.logical_not(done_conv))
+        done = jnp.logical_or(done_conv, done_stall)
+        code = jnp.where(done_conv, 0, jnp.where(done_stall, 2, s.code))
         return _NewtonState(
             theta=jnp.where(accept, theta_new, s.theta),
             f=jnp.where(accept, f_new, s.f),
             g=jnp.where(accept, g_new, s.g),
             H=jnp.where(accept, H_new, s.H),
+            aux=jax.tree_util.tree_map(
+                lambda a, b: jnp.where(accept, a, b), aux_new, s.aux),
             lam=jnp.where(accept, s.lam * 0.33, s.lam * 8.0).clip(1e-14, 1e14),
             it=s.it + 1,
             nfev=s.nfev + 1,
+            rej=rej_new,
             code=code,
             done=done,
         )
 
-    f0, g0, H0 = cgh(theta0)
+    # bootstrap state: f=+inf, g=0, H=I => the first trip proposes a
+    # zero step, evaluates cgh(theta0) in-loop, and always accepts it;
+    # aux shapes come from eval_shape (nothing executes here)
+    aux_shape = jax.eval_shape(cgh, theta0)[3]
+    aux0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros(a.shape, a.dtype), aux_shape)
     s0 = _NewtonState(
         theta=theta0,
-        f=f0,
-        g=g0,
-        H=H0,
-        lam=jnp.asarray(lam0, dt),
+        f=jnp.asarray(jnp.inf, dt),
+        g=jnp.zeros(5, dt),
+        H=jnp.eye(5, dtype=dt),
+        aux=aux0,
+        # the bootstrap acceptance multiplies by 0.33; pre-divide so the
+        # first Newton trip sees exactly lam0
+        lam=jnp.asarray(lam0 / 0.33, dt),
         it=jnp.asarray(0, jnp.int32),
-        nfev=jnp.asarray(1, jnp.int32),
+        nfev=jnp.asarray(0, jnp.int32),
+        rej=jnp.asarray(0, jnp.int32),
         code=jnp.asarray(3, jnp.int32),
         done=jnp.asarray(False),
     )
-    return jax.lax.while_loop(cond, body, s0)
+    s = jax.lax.while_loop(cond, body, s0)
+    # if no trip ever accepted (objective NaN on every evaluation, e.g.
+    # corrupted input data), the state still holds the bootstrap
+    # placeholders (H=I, aux=0).  Poison them so _finalize_fit reports
+    # NaN/inf errors and scales — matching the pre-bootstrap behavior
+    # the degenerate-fit guards downstream rely on — instead of
+    # plausible finite values.
+    bad = jnp.logical_not(jnp.isfinite(s.f))
+    nan = jnp.asarray(jnp.nan, dt)
+    return s._replace(
+        H=jnp.where(bad, nan, s.H),
+        aux=jax.tree_util.tree_map(
+            lambda a: jnp.where(bad, jnp.asarray(jnp.nan, a.dtype), a),
+            s.aux),
+    )
 
 
 @partial(
@@ -405,10 +484,10 @@ def _fit_portrait_core(
     else:
         theta0 = theta0.astype(dt)
 
-    s = _newton_loop(cgh, theta0, flags_arr, max_iter, ftol)
+    s = _newton_loop(_with_no_aux(cgh), theta0, flags_arr, max_iter, ftol)
     theta = s.theta
 
-    _, _, H = cgh(theta)
+    H = s.H
     M2s = (mFT.real**2 + mFT.imag**2) * w
     C, S = _CS_general(theta, X, M2s, freqs, P, nu_fit, ir, log10_tau)
     Sd = jnp.sum((dFT.real**2 + dFT.imag**2) * w)
@@ -540,21 +619,32 @@ def _finalize_fit(theta, s, H, C, S, Sd, nharm, flags_arr, fit_flags,
     )
 
 
-def _initial_phase_guess_real(Xr, Xi, cvec, DM0, oversamp=2):
+def _initial_phase_guess_real(Xr, Xi, cvec, DM0, oversamp=2,
+                              derotate=True):
     """_initial_phase_guess on split real/imag parts (complex-free):
     derotate by DM0, sum channels, dense CCF via the matmul inverse
-    DFT, argmax."""
+    DFT, argmax.
+
+    derotate=False (static) skips the per-channel trig entirely — valid
+    when the caller knows DM0 == 0, where the phasor is identity.  At
+    production shapes the derotation pass costs as much as a Newton
+    moment pass, so the zero-DM-guess case (every cold-start batch fit)
+    is worth the static branch."""
     from ..ops.fourier import irfft_mm
 
     nharm = Xr.shape[-1]
     nbin = 2 * (nharm - 1)
     dt = cvec.dtype
-    k = jnp.arange(nharm, dtype=dt)
-    ang = 2.0 * jnp.pi * (cvec * DM0)[:, None] * k
-    c = jnp.cos(ang)
-    s = jnp.sin(ang)
-    xr = jnp.sum(Xr * c - Xi * s, axis=0)
-    xi = jnp.sum(Xr * s + Xi * c, axis=0)
+    if derotate:
+        k = jnp.arange(nharm, dtype=dt)
+        ang = 2.0 * jnp.pi * (cvec * DM0)[:, None] * k
+        c = jnp.cos(ang)
+        s = jnp.sin(ang)
+        xr = jnp.sum(Xr * c - Xi * s, axis=0)
+        xi = jnp.sum(Xr * s + Xi * c, axis=0)
+    else:
+        xr = jnp.sum(Xr, axis=0)
+        xi = jnp.sum(Xi, axis=0)
     nlag = nbin * oversamp
     ccf = irfft_mm(xr, xi, n=nlag)
     j0 = jnp.argmax(ccf)
@@ -563,7 +653,8 @@ def _initial_phase_guess_real(Xr, Xi, cvec, DM0, oversamp=2):
 
 
 def prepare_portrait_fit_real(port, model, w, freqs, P, nu_fit, theta0,
-                              seed_phi=True):
+                              seed_phi=True, seed_derotate=True,
+                              x_dtype=None):
     """Everything before the Newton loop, in pure real arithmetic:
     matmul DFTs (ops/fourier.py — XLA's TPU FFT is ~2000x slower at
     these shapes), weighted cross-spectrum as a real pair, model/data
@@ -587,11 +678,16 @@ def prepare_portrait_fit_real(port, model, w, freqs, P, nu_fit, theta0,
     S0 = jnp.sum((mr**2 + mi**2) * w, axis=-1)
     Sd = jnp.sum((dr**2 + di**2) * w)
     if seed_phi:
-        phi0 = _initial_phase_guess_real(Xr, Xi, cvec, theta0[1])
+        phi0 = _initial_phase_guess_real(Xr, Xi, cvec, theta0[1],
+                                         derotate=seed_derotate)
         theta0 = jnp.where(jnp.arange(5) == 0, phi0, theta0).astype(dt)
     else:
         theta0 = theta0.astype(dt)
-    return Xr.astype(dt), Xi.astype(dt), S0, Sd, theta0
+    # optional narrow storage for the Newton loop's per-pass reads
+    # (config.cross_spectrum_dtype); the seed above always reads the
+    # full-precision values
+    xdt = x_dtype or dt
+    return Xr.astype(xdt), Xi.astype(xdt), S0, Sd, theta0
 
 
 @partial(
@@ -652,17 +748,15 @@ def _fit_portrait_core_real(
 
     def cgh(theta):
         C, C1, C2 = moments(theta)
-        return _cgh_tail(C, C1, C2, S0inv, cvec, gvec, dt)
+        f, g, H = _cgh_tail(C, C1, C2, S0inv, cvec, gvec, dt)
+        return f, g, H, C
 
     s = _newton_loop(cgh, theta0.astype(dt), flags_arr, max_iter, ftol)
-    theta = s.theta
 
-    # one moment pass at the solution serves both the final Hessian and
-    # the scales' C vector
-    C, C1, C2 = moments(theta)
-    _, _, H = _cgh_tail(C, C1, C2, S0inv, cvec, gvec, dt)
+    # the loop state carries the Hessian and moment vector C matching
+    # s.theta, so no extra moment pass is needed at the solution
     return _finalize_fit(
-        theta, s, H, C, S0, Sd, nharm, flags_arr, fit_flags,
+        s.theta, s, s.H, s.aux, S0, Sd, nharm, flags_arr, fit_flags,
         P, nu_fit, nu_out, False, dt)
 
 
@@ -706,31 +800,54 @@ def fit_portrait_batch_fast(
     nf_ax = 0 if nu_fit.ndim == 1 else None
     if theta0 is None:
         theta0 = jnp.zeros((nb, 5), dt)
+        seed_derotate = False
+    else:
+        # host-side check (theta0 is concrete here): an all-zero DM
+        # guess makes the seed's derotation phasor the identity, and
+        # skipping it saves a full pass over the cross-spectrum
+        import numpy as _np
+
+        theta0 = jnp.asarray(theta0)
+        seed_derotate = bool(_np.any(_np.asarray(theta0[..., 1]) != 0.0))
     nu_out_val = jnp.full((nb,), -1.0 if nu_out is None else nu_out, dt)
     if chan_masks is None:
         chan_masks = jnp.ones(ports.shape[:2], dt)
     if pallas is None:
         pallas = use_pallas_moments(dt)
 
+    x_bf16 = str(getattr(config, "cross_spectrum_dtype", None)) == "bfloat16"
     fit = _fast_batch_fn(
         FitFlags(*[bool(f) for f in fit_flags]), int(max_iter),
-        bool(pallas), m_ax, f_ax, p_ax, nf_ax)
+        bool(pallas), m_ax, f_ax, p_ax, nf_ax, seed_derotate, x_bf16)
     return fit(
         ports, models, jnp.asarray(noise_stds), chan_masks,
         freqs, P, nu_fit, nu_out_val, theta0)
 
 
 def fast_fit_one(port, model, noise_stds, chan_mask, freqs, P, nu_fit,
-                 nu_out, theta0, *, fit_flags, max_iter, pallas):
+                 nu_out, theta0, *, fit_flags, max_iter, pallas,
+                 seed_derotate=True, x_bf16=None):
     """One complex-free fast fit: weights, matmul DFTs + CCF seed, real
     Newton core — the per-element body shared by the vmapped batch
     (_fast_batch_fn) and the sharded scale-out path
-    (parallel.fit_portrait_sharded_fast)."""
+    (parallel.fit_portrait_sharded_fast).
+
+    x_bf16 None resolves config.cross_spectrum_dtype at trace time (so
+    the knob also reaches callers that don't thread it explicitly, like
+    the sharded path — with the usual caveat that an already-traced
+    program won't see later config changes)."""
+    if x_bf16 is None:
+        x_bf16 = str(getattr(config, "cross_spectrum_dtype", None)) \
+            == "bfloat16"
     nbin = port.shape[-1]
     w = make_weights(noise_stds, nbin, chan_mask, dtype=port.dtype)
+    # the Pallas moment kernel reads f32 tiles, so narrow storage only
+    # applies on the XLA moment path
+    x_dtype = jnp.bfloat16 if (x_bf16 and not pallas) else None
     Xr, Xi, S0, Sd, th0 = prepare_portrait_fit_real(
         port, model.astype(port.dtype), w, freqs, P, nu_fit, theta0,
-        seed_phi=bool(fit_flags[0]))
+        seed_phi=bool(fit_flags[0]), seed_derotate=seed_derotate,
+        x_dtype=x_dtype)
     return _fit_portrait_core_real.__wrapped__(
         Xr, Xi, S0, Sd, freqs, P, nu_fit, nu_out, th0,
         fit_flags=fit_flags, max_iter=max_iter, pallas=pallas)
@@ -758,13 +875,15 @@ def reject_fixed_tau_seed(theta0, caller):
 
 
 @lru_cache(maxsize=None)
-def _fast_batch_fn(fit_flags, max_iter, pallas, m_ax, f_ax, p_ax, nf_ax):
+def _fast_batch_fn(fit_flags, max_iter, pallas, m_ax, f_ax, p_ax, nf_ax,
+                   seed_derotate=True, x_bf16=False):
     """Cached jitted end-to-end fast fit — a fresh jit per call would
     recompile every invocation.  One program: matmul DFTs, real
     cross-spectrum, CCF seed, Newton loop (Pallas moments when
     enabled), finalize — no complex types anywhere."""
     one = partial(fast_fit_one, fit_flags=fit_flags, max_iter=max_iter,
-                  pallas=pallas)
+                  pallas=pallas, seed_derotate=seed_derotate,
+                  x_bf16=x_bf16)
     return jax.jit(jax.vmap(
         one, in_axes=(0, m_ax, 0, 0, f_ax, p_ax, nf_ax, 0, 0)))
 

@@ -300,3 +300,24 @@ def test_fit_portrait_tau_error_calibration(key):
     # match the reported uncertainty
     assert abs(z.mean()) < 1.5, z
     assert 0.4 < z.std() < 2.5, z
+
+
+def test_fit_portrait_nan_data_poisons_errors(key):
+    """Corrupted (NaN) data must yield non-finite phi_err / NaN scales
+    and a failure code, not plausible finite values: the Newton loop's
+    bootstrap placeholders (H=I, aux=0) are poisoned when no trip ever
+    accepts."""
+    from pulseportraiture_tpu.fit.portrait import fit_portrait_batch_fast
+
+    model = default_test_model(1500.0)
+    d = fake_portrait(key, model, FREQS, NBIN, P, phi=0.01, DM=1e-3,
+                      noise_std=0.05)
+    port = np.array(d.port)  # writable copy
+    port[3, 100] = np.nan
+    r = fit_portrait_batch_fast(
+        jnp.asarray(port)[None], d.model_port, d.noise_stds[None], FREQS,
+        P, 1500.0, max_iter=10)
+    assert int(r.return_code[0]) == 3
+    assert not np.isfinite(float(r.phi_err[0])) or \
+        np.isnan(float(r.phi_err[0]))
+    assert not np.all(np.isfinite(np.asarray(r.scales[0])))
