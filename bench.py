@@ -111,14 +111,23 @@ def main():
 
     # (b) pipelined throughput: enqueue K batches back-to-back, sync
     # once — steady-state rate when streaming a campaign (the per-batch
-    # round-trip amortizes away; results are small and pulled async)
+    # round-trip amortizes away; results are small and pulled async).
+    # Min of 3 runs: the tunneled TPU is shared and its effective
+    # throughput swings severalfold with external load.
     K = 8
-    t0 = time.perf_counter()
-    for _ in range(K):
-        res = run()
-    _ = np.asarray(res.phi)
-    tK = time.perf_counter() - t0
-    t_tpu = (tK - t_lat) / (K - 1)
+    tKs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(K):
+            res = run()
+        _ = np.asarray(res.phi)
+        tKs.append(time.perf_counter() - t0)
+    # t_lat and tKs come from different run populations under variable
+    # load, so the subtraction can go non-positive; fall back to the
+    # conservative tK/K (counts one round-trip against the K batches)
+    t_tpu = (min(tKs) - t_lat) / (K - 1)
+    if t_tpu <= 0:
+        t_tpu = min(tKs) / K
     toas_per_sec = NB / t_tpu
 
     # --- single-core NumPy baseline on a few portraits ------------------

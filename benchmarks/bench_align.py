@@ -1,0 +1,71 @@
+"""BASELINE.md config 4 (single-chip form): one ppalign-style iteration
+over 256 epochs at 512 chan x 2048 bin — batched (phi, DM) fits of every
+epoch against the current template, then a weighted rotate-and-stack.
+
+This is the in-memory math of pipeline/align.align_archives's inner
+loop (the file-level driver adds PSRFITS IO around exactly this); the
+multi-chip form shards the epoch axis (parallel/batch.py).
+
+Prints ONE JSON line like bench.py.
+"""
+
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import pulseportraiture_tpu  # noqa: F401
+    from pulseportraiture_tpu import config
+    config.dft_precision = "default"
+    config.cross_spectrum_dtype = "bfloat16"
+
+    from benchmarks.common import bench_model, devtime
+    from pulseportraiture_tpu.fit import fit_portrait_batch_fast
+    from pulseportraiture_tpu.ops.rotation import rotate_portrait
+
+    NE, NCHAN, NBIN = 256, 512, 2048
+    DT = jnp.float32
+    P, NU_FIT = 0.003, 1500.0
+    model, freqs = bench_model(NCHAN, NBIN)
+
+    @jax.jit
+    def synth(key):
+        k1, k2 = jax.random.split(key)
+        scales = 0.5 + jax.random.uniform(k1, (NE, 1, 1), DT)
+        return model[None] * scales + 0.05 * jax.random.normal(
+            k2, (NE, NCHAN, NBIN), DT)
+
+    ports = synth(jax.random.PRNGKey(0))
+    noise = jnp.full((NE, NCHAN), 0.05, DT)
+
+    @jax.jit
+    def stack(ports, phis, DMs, scales, noise_stds):
+        rot = jax.vmap(
+            lambda p, ph, dm: rotate_portrait(p, -ph, -dm, freqs, P, NU_FIT)
+        )(ports, phis, DMs)
+        wts = scales / noise_stds**2.0  # reference ppalign.py:236-242
+        num = jnp.einsum("enb,en->nb", rot, wts)
+        return num / jnp.maximum(jnp.sum(wts, 0), 1e-30)[:, None]
+
+    def iteration():
+        r = fit_portrait_batch_fast(ports, model, noise, freqs, P, NU_FIT,
+                                    max_iter=25)
+        return stack(ports, r.phi, r.DM, r.scales, noise)
+
+    slope, single = devtime(iteration, lambda t: t)
+    print(json.dumps({
+        "metric": "align iteration (fit+stack), 256 epochs x 512ch x 2048bin",
+        "value": round(NE / slope, 2),
+        "unit": "epochs/sec",
+        "iteration_latency_ms": round(single * 1e3, 1),
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

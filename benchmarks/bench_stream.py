@@ -1,0 +1,72 @@
+"""BASELINE.md config 5 (single-chip slice): streamed wideband TOAs for
+a batch of PSRFITS archives through the full pipeline — file IO, native
+SUBINT decode, shape-bucketed fused fit dispatches, .tim assembly.
+
+Archives are generated on the fly into a temp dir (16 archives x 16
+subints x 256 chan x 1024 bin by default — sized so generation stays a
+small fraction of the benchmark); the measured figure is end-to-end
+wall time of stream_wideband_TOAs including IO, which is the number an
+IPTA-scale campaign sees per chip.
+
+Prints ONE JSON line like bench.py.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    import pulseportraiture_tpu  # noqa: F401
+    from pulseportraiture_tpu import config
+    config.dft_precision = "default"
+    config.cross_spectrum_dtype = "bfloat16"
+
+    import jax
+
+    from pulseportraiture_tpu.io.gmodel import write_gmodel
+    from pulseportraiture_tpu.pipeline.stream import stream_wideband_TOAs
+    from pulseportraiture_tpu.synth import default_test_model
+    from pulseportraiture_tpu.synth.archive import make_fake_pulsar
+
+    NARCH, NSUB, NCHAN, NBIN = 16, 16, 256, 1024
+    PAR = {"PSR": "FAKE", "P0": 0.003, "DM": 50.0, "PEPOCH": 56000.0}
+
+    with tempfile.TemporaryDirectory() as td:
+        mpath = os.path.join(td, "model.gmodel")
+        write_gmodel(default_test_model(1500.0), mpath, quiet=True)
+        files = []
+        rng = 0
+        for i in range(NARCH):
+            path = os.path.join(td, f"a{i:03d}.fits")
+            make_fake_pulsar(mpath, PAR, outfile=path, nsub=NSUB,
+                             nchan=NCHAN, nbin=NBIN, nu0=1500.0, bw=600.0,
+                             phase=0.01 * i, dDM=1e-4 * i, noise_stds=0.05,
+                             quiet=True, rng=i)
+            files.append(path)
+
+        # warm (compile) on one archive, then measure the full campaign
+        stream_wideband_TOAs(files[:1], mpath, quiet=True)
+        t0 = time.perf_counter()
+        res = stream_wideband_TOAs(files, mpath, quiet=True)
+        wall = time.perf_counter() - t0
+
+    ntoa = len(res.TOA_list)
+    print(json.dumps({
+        "metric": f"streamed TOAs incl. PSRFITS IO, {NARCH} archives x "
+                  f"{NSUB}sub x {NCHAN}ch x {NBIN}bin",
+        "value": round(ntoa / wall, 2),
+        "unit": "TOAs/sec",
+        "wall_s": round(wall, 2),
+        "toas": ntoa,
+        "fit_fraction": round(float(res.fit_duration) / wall, 3),
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""Shared harness for the secondary benchmarks (BASELINE.md configs 3-5).
+
+Timing methodology matches bench.py: the tunneled TPU runtime's
+block_until_ready can return early and host transfers are slow, so every
+measurement enqueues K dispatches back-to-back, reduces to a scalar on
+device, and syncs once — slope = steady-state device time; a single
+synchronized rep gives the interactive latency.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _scl(x):
+    return jnp.sum(x)
+
+
+def devtime(fn, pick, K=4, warm=1, nrun=3):
+    """fn() -> result pytree; pick(result) -> array to reduce.
+    Returns (slope_s, single_s).
+
+    Takes the MIN over nrun separate measurements of both the single
+    synchronized rep and the K-rep pipelined run: the tunneled TPU is a
+    shared resource whose effective throughput swings by up to ~8x with
+    external load, and min-of-several is the standard way to estimate
+    the unloaded cost."""
+    for _ in range(warm):
+        _ = np.asarray(_scl(pick(fn())))
+
+    def single():
+        t0 = time.perf_counter()
+        _ = np.asarray(_scl(pick(fn())))
+        return time.perf_counter() - t0
+
+    def krun():
+        t0 = time.perf_counter()
+        for _ in range(K):
+            s = _scl(pick(fn()))
+        _ = np.asarray(s)
+        return time.perf_counter() - t0
+
+    t1 = min(single() for _ in range(nrun))
+    tK = min(krun() for _ in range(nrun))
+    slope = (tK - t1) / (K - 1)
+    if slope <= 0:
+        # different run populations under variable load; conservative
+        # fallback counts one round-trip against the K batches
+        slope = tK / K
+    return slope, t1
+
+
+def bench_model(nchan, nbin, dtype=jnp.float32, P=0.003, nu_fit=1500.0):
+    """Shared synthetic template at bench shapes."""
+    from pulseportraiture_tpu.models.gaussian import gen_gaussian_portrait
+    from pulseportraiture_tpu.synth import default_test_model
+
+    tm = default_test_model(nu_fit)
+    freqs = jnp.linspace(1300.0, 1899.0, nchan, dtype=dtype)
+    params = {k: jnp.asarray(v, dtype) for k, v in tm.params_pytree().items()}
+    model = gen_gaussian_portrait(params, freqs, tm.nu_ref, nbin, P=P,
+                                  code=tm.code, scattered=False).astype(dtype)
+    return model, freqs

@@ -61,8 +61,17 @@ use_fast_fit = "auto"
 # bench noise levels — but do not use it for very-high-S/N data where
 # ~1e-3 relative errors could rival the noise floor).  All three pass
 # the |dphi| < 1e-4 accuracy gate at bench configs; f64 inputs are
-# unaffected.
+# unaffected.  Scope: 'default' applies to the gate-validated portrait
+# fit (rfft_mm call sites); the complex-interface helpers rfft_c /
+# irfft_c used by rotation/scattering/CCF kernels clamp 'default' up to
+# 'high', so alignment math never silently degrades to 1e-3.
 dft_precision = "highest"
+
+# Route complex-interface DFTs (ops/fourier.rfft_c / irfft_c) through
+# the matmul weights instead of XLA's native FFT: 'auto' = on TPU
+# backends (native FFT lowering measures ~2000x slower there);
+# True/False force.  Precision follows dft_precision.
+use_matmul_dft = "auto"
 
 # Storage dtype for the fit's precomputed cross-spectrum X = d*conj(m)*w
 # (fit/portrait.py fast path).  None = same as the input data (f32 on

@@ -18,6 +18,8 @@ from ..config import F0_fact
 from ..ops.noise import fourier_noise, get_noise_PS
 from ..ops.phasor import cexp
 from ..utils.bunch import DataBunch
+from ..utils.device import on_host
+from ..ops.fourier import irfft_c, rfft_c
 
 
 def _ccf_terms(dFT, mFT, errs_F):
@@ -36,7 +38,7 @@ def _fit_phase_shift_core(dFT, mFT, errs_F, oversamp=8, newton_iters=5):
 
     # dense CCF over nbin*oversamp lags: C(phi_j) for phi_j = j/(nbin*ov)
     nlag = nbin * oversamp
-    ccf = jnp.fft.irfft(x, n=nlag) * nlag  # ~ C(phi_j), phi_j = j/nlag
+    ccf = irfft_c(x, n=nlag) * nlag  # ~ C(phi_j), phi_j = j/nlag
     j0 = jnp.argmax(ccf)
     phi0 = j0.astype(errs_F.dtype) / nlag
 
@@ -72,6 +74,7 @@ def _fit_phase_shift_core(dFT, mFT, errs_F, oversamp=8, newton_iters=5):
     return phi, phi_err, scale, scale_err, chi2, dof, snr
 
 
+@on_host
 def fit_phase_shift(data, model, noise_std=None, oversamp=8, newton_iters=5):
     """Fit the phase shift of ``data`` relative to ``model`` (both
     (nbin,) profiles).
@@ -80,6 +83,12 @@ def fit_phase_shift(data, model, noise_std=None, oversamp=8, newton_iters=5):
     red_chi2, snr) with the reference's field meanings
     (pplib.py:2136-2182): rotating ``data`` by ``phase`` aligns it
     with ``model``; ``scale * model`` matches the aligned data.
+
+    Host-pinned: this scalar 1-D fit is seeding/diagnostic machinery
+    (align guesses, template convergence checks) that callers routinely
+    feed f64 profiles — whose c128 FFT no TPU runtime will compile —
+    and at (nbin,) scale a host evaluation beats an accelerator
+    dispatch anyway.  The batched variant below stays on-device.
     """
     data = jnp.asarray(data)
     model = jnp.asarray(model)
@@ -87,8 +96,8 @@ def fit_phase_shift(data, model, noise_std=None, oversamp=8, newton_iters=5):
     if noise_std is None:
         noise_std = get_noise_PS(data)
     errs_F = fourier_noise(jnp.asarray(noise_std), nbin)
-    dFT = jnp.fft.rfft(data)
-    mFT = jnp.fft.rfft(model)
+    dFT = rfft_c(data)
+    mFT = rfft_c(model)
     phi, phi_err, scale, scale_err, chi2, dof, snr = _fit_phase_shift_core(
         dFT, mFT, errs_F * jnp.ones(()), oversamp=oversamp, newton_iters=newton_iters
     )
@@ -108,8 +117,8 @@ def fit_phase_shift_batch(data, model, noise_std, oversamp=8, newton_iters=5):
     """vmapped fit over leading batch dims of (…, nbin) data/model."""
     nbin = data.shape[-1]
     errs_F = fourier_noise(jnp.asarray(noise_std), nbin)
-    dFT = jnp.fft.rfft(data, axis=-1)
-    mFT = jnp.fft.rfft(model, axis=-1)
+    dFT = rfft_c(data)
+    mFT = rfft_c(model)
     core = partial(
         _fit_phase_shift_core, oversamp=oversamp, newton_iters=newton_iters
     )

@@ -55,6 +55,7 @@ from ..config import Dconst, F0_fact
 from ..ops.noise import fourier_noise
 from ..ops.phasor import cexp
 from ..ops.scattering import scattering_portrait_FT
+from ..ops.fourier import irfft_c, rfft_c
 
 
 def _tiny(dtype):
@@ -165,6 +166,13 @@ def _chi2_prime_X(theta, X, M2, freqs, P, nu_fit, ir_FT, log10_tau):
     return -jnp.sum(jnp.where(good, C**2.0 / S_safe, 0.0))
 
 
+def use_bf16_cross_spectrum():
+    """Whether the fast fit stores its precomputed cross-spectrum in
+    bfloat16 (config.cross_spectrum_dtype) — the single parse point for
+    the knob, shared by the batch and sharded entry paths."""
+    return str(getattr(config, "cross_spectrum_dtype", None)) == "bfloat16"
+
+
 def use_pallas_moments(dtype):
     """Whether the fused Pallas moment kernel should run: opt-in via
     config.use_pallas (True = f32 data anywhere, 'auto' = TPU backends;
@@ -247,7 +255,7 @@ def _initial_phase_guess(X, cvec, DM0, oversamp=2):
     ph = cexp(2.0 * jnp.pi * (cvec * DM0)[:, None] * k)
     x = jnp.sum(X * ph, axis=0)
     nlag = nbin * oversamp
-    ccf = jnp.fft.irfft(x, n=nlag)
+    ccf = irfft_c(x, n=nlag)
     j0 = jnp.argmax(ccf)
     phi0 = j0.astype(dt) / nlag
     return jnp.mod(phi0 + 0.5, 1.0) - 0.5
@@ -815,7 +823,7 @@ def fit_portrait_batch_fast(
     if pallas is None:
         pallas = use_pallas_moments(dt)
 
-    x_bf16 = str(getattr(config, "cross_spectrum_dtype", None)) == "bfloat16"
+    x_bf16 = use_bf16_cross_spectrum()
     fit = _fast_batch_fn(
         FitFlags(*[bool(f) for f in fit_flags]), int(max_iter),
         bool(pallas), m_ax, f_ax, p_ax, nf_ax, seed_derotate, x_bf16)
@@ -837,8 +845,7 @@ def fast_fit_one(port, model, noise_stds, chan_mask, freqs, P, nu_fit,
     the sharded path — with the usual caveat that an already-traced
     program won't see later config changes)."""
     if x_bf16 is None:
-        x_bf16 = str(getattr(config, "cross_spectrum_dtype", None)) \
-            == "bfloat16"
+        x_bf16 = use_bf16_cross_spectrum()
     nbin = port.shape[-1]
     w = make_weights(noise_stds, nbin, chan_mask, dtype=port.dtype)
     # the Pallas moment kernel reads f32 tiles, so narrow storage only
@@ -959,8 +966,8 @@ def fit_portrait(
     nbin = port.shape[-1]
     dtype = dtype or port.dtype
     w = make_weights(noise_stds, nbin, chan_mask, dtype=dtype)
-    dFT = jnp.fft.rfft(port.astype(dtype), axis=-1)
-    mFT = jnp.fft.rfft(model.astype(dtype), axis=-1)
+    dFT = rfft_c(port.astype(dtype))
+    mFT = rfft_c(model.astype(dtype))
     if nu_fit is None:
         nu_fit = guess_fit_freq(freqs)
     if alpha0 is None:
@@ -1022,8 +1029,8 @@ def fit_portrait_batch(
     if use_scatter is None:
         use_scatter = derive_use_scatter(fit_flags, log10_tau, theta0)
     w = make_weights(noise_stds, nbin, chan_masks, dtype=ports.dtype)
-    dFT = jnp.fft.rfft(ports, axis=-1)
-    mFT = jnp.fft.rfft(jnp.asarray(models).astype(ports.dtype), axis=-1)
+    dFT = rfft_c(ports)
+    mFT = rfft_c(jnp.asarray(models).astype(ports.dtype))
     freqs = jnp.asarray(freqs, w.dtype)
     f_ax = 0 if freqs.ndim == 2 else None
     P = jnp.asarray(P, w.dtype)

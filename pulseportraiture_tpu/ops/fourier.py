@@ -23,7 +23,7 @@ import numpy as np
 
 from .. import config
 
-__all__ = ["rfft_mm", "irfft_mm"]
+__all__ = ["rfft_mm", "irfft_mm", "rfft_c", "irfft_c", "use_matmul_dft"]
 
 
 def _default_precision():
@@ -101,3 +101,61 @@ def irfft_mm(Xr, Xi, n=None, precision=None):
         jnp.matmul(Xr, Vc, precision=precision)
         + jnp.matmul(Xi, Vs, precision=precision)
     )
+
+
+def use_matmul_dft():
+    """Whether complex-interface DFTs should route through the matmul
+    weights: config.use_matmul_dft (True/False force; 'auto' = TPU
+    backends, where XLA's native FFT lowering is ~2000x slower at this
+    workload's shapes).  Read at trace time."""
+    setting = getattr(config, "use_matmul_dft", "auto")
+    if setting is True or setting is False:
+        return setting
+    return jax.default_backend() == "tpu"
+
+
+def rfft_c(x, precision=None):
+    """numpy-convention rfft of the last axis returning a COMPLEX array,
+    backend-dispatched: matmul DFT on TPU (complex arithmetic compiles
+    fine there — only the FFT lowering and Pallas/complex mixing are
+    broken), jnp.fft.rfft elsewhere.  Use this instead of jnp.fft.rfft
+    in any code that must run on the accelerator (fit engines, rotation
+    kernels); offline host-pinned paths may keep jnp.fft.
+
+    f64 inputs always take the jnp.fft path: the matmul route would
+    produce complex128, which TPU rejects outright — whereas XLA's FFT
+    handles the f64-under-x64 host-side paths the pipelines run.
+    bf16 inputs upcast to f32 first (lax.complex has no bf16).
+
+    Unlike rfft_mm, the complex interface clamps config.dft_precision
+    'default' up to 'high': its consumers (rotation/alignment kernels,
+    scattering convolutions, CCF searches) have no end-to-end accuracy
+    gate, so the single-pass-bf16 setting — validated only for the
+    portrait fit — must not silently degrade them."""
+    x = jnp.asarray(x)
+    if use_matmul_dft() and x.dtype in (jnp.float32, jnp.bfloat16):
+        if x.dtype == jnp.bfloat16:
+            x = x.astype(jnp.float32)
+        Xr, Xi = rfft_mm(x, precision=_gated_precision(precision))
+        return jax.lax.complex(Xr, Xi)
+    return jnp.fft.rfft(x, axis=-1)
+
+
+def irfft_c(X, n=None, precision=None):
+    """Inverse of rfft_c: complex (..., nharm) -> real (..., n)."""
+    X = jnp.asarray(X)
+    if use_matmul_dft() and X.dtype == jnp.complex64:
+        return irfft_mm(jnp.real(X), jnp.imag(X), n=n,
+                        precision=_gated_precision(precision))
+    return jnp.fft.irfft(X, n=n, axis=-1)
+
+
+def _gated_precision(precision):
+    """Explicit precision wins; otherwise config.dft_precision with
+    'default' clamped to 'high' (see rfft_c docstring)."""
+    if precision is not None:
+        return precision
+    p = _default_precision()
+    if p == jax.lax.Precision.DEFAULT:
+        return jax.lax.Precision.HIGH
+    return p

@@ -5,6 +5,7 @@ get_noise_PS, get_SNR, get_scales).
 """
 
 import jax.numpy as jnp
+from .fourier import rfft_c
 
 
 def get_noise_PS(data, frac=0.25):
@@ -18,7 +19,7 @@ def get_noise_PS(data, frac=0.25):
     """
     data = jnp.asarray(data)
     nbin = data.shape[-1]
-    X = jnp.fft.rfft(data, axis=-1)
+    X = rfft_c(data)
     nharm = X.shape[-1]
     kc = int((1.0 - frac) * nharm)
     power = jnp.abs(X[..., kc:]) ** 2.0

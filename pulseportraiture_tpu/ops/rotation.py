@@ -14,16 +14,17 @@ rotating with the data's own DM dedisperses it.
 import jax.numpy as jnp
 
 from .phasor import cexp, phase_shifts, phasor
+from .fourier import irfft_c, rfft_c
 
 
 def fft_shift_bins(profile, shift_bins):
     """Shift a profile to earlier phase by ``shift_bins`` bins
     (non-integer allowed) via the FFT shift theorem."""
     nbin = profile.shape[-1]
-    pFT = jnp.fft.rfft(profile, axis=-1)
+    pFT = rfft_c(profile)
     k = jnp.arange(pFT.shape[-1], dtype=profile.dtype)
     pFT = pFT * cexp(2.0 * jnp.pi * k * (shift_bins / nbin))
-    return jnp.fft.irfft(pFT, n=nbin, axis=-1)
+    return irfft_c(pFT, n=nbin)
 
 
 def rotate_profile(profile, phi):
@@ -44,13 +45,13 @@ def rotate_portrait(port, phi, DM=0.0, P=None, freqs=None, nu_ref=jnp.inf):
     """
     port = jnp.asarray(port)
     nbin = port.shape[-1]
-    pFT = jnp.fft.rfft(port, axis=-1)
+    pFT = rfft_c(port)
     if freqs is None:
         delays = jnp.asarray(phi)[..., None] * jnp.ones(port.shape[-2], pFT.real.dtype)
     else:
         delays = phase_shifts(phi, DM, 0.0, freqs, P, nu_ref, 1.0)
     ph = phasor(delays, pFT.shape[-1])
-    return jnp.fft.irfft(pFT * ph, n=nbin, axis=-1)
+    return irfft_c(pFT * ph, n=nbin)
 
 
 def rotate_full(cube, phi, DM, Ps, freqs, nu_ref=jnp.inf):
@@ -61,11 +62,11 @@ def rotate_full(cube, phi, DM, Ps, freqs, nu_ref=jnp.inf):
     """
     cube = jnp.asarray(cube)
     nbin = cube.shape[-1]
-    cFT = jnp.fft.rfft(cube, axis=-1)
+    cFT = rfft_c(cube)
     # delays: (nsub, nchan) -> broadcast over npol
     delays = phase_shifts(phi, DM, 0.0, freqs, Ps[:, None], nu_ref, 1.0)
     ph = phasor(delays, cFT.shape[-1])  # (nsub, nchan, nharm)
-    return jnp.fft.irfft(cFT * ph[:, None, :, :], n=nbin, axis=-1)
+    return irfft_c(cFT * ph[:, None, :, :], n=nbin)
 
 
 def add_DM_nu(port, phi, DM_coeffs, powers, P, freqs, nu_ref):
@@ -86,9 +87,9 @@ def add_DM_nu(port, phi, DM_coeffs, powers, P, freqs, nu_ref):
         freqs[None, :] ** powers[:, None] - nu_ref ** powers[:, None]
     )
     delays = phi + (Dconst / P) * jnp.sum(terms, axis=0)
-    pFT = jnp.fft.rfft(port, axis=-1)
+    pFT = rfft_c(port)
     ph = phasor(delays, pFT.shape[-1])
-    return jnp.fft.irfft(pFT * ph, n=nbin, axis=-1)
+    return irfft_c(pFT * ph, n=nbin)
 
 
 def fft_rotate(arr, bins):
@@ -106,4 +107,4 @@ def fft_rotate(arr, bins):
     b = jnp.asarray(bins, dt)
     k = jnp.arange(nbin // 2 + 1, dtype=dt)
     ramp = jnp.exp(2.0j * jnp.pi * k * b / nbin)
-    return jnp.fft.irfft(jnp.fft.rfft(arr.astype(dt)) * ramp, n=nbin)
+    return irfft_c(rfft_c(arr.astype(dt)) * ramp, n=nbin)

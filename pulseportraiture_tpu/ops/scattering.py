@@ -17,6 +17,7 @@ The reference's hand-derived dH/dtau and d2H/dtau2 chains
 
 import jax
 import jax.numpy as jnp
+from .fourier import irfft_c, rfft_c
 
 
 def scattering_times(tau, alpha, freqs, nu_tau):
@@ -76,6 +77,6 @@ def add_scattering(port, taus, wrap=True):
     """
     port = jnp.asarray(port)
     nbin = port.shape[-1]
-    pFT = jnp.fft.rfft(port, axis=-1)
+    pFT = rfft_c(port)
     H = scattering_portrait_FT(jnp.asarray(taus), pFT.shape[-1])
-    return jnp.fft.irfft(pFT * H, n=nbin, axis=-1)
+    return irfft_c(pFT * H, n=nbin)

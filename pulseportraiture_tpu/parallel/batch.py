@@ -17,6 +17,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..fit.portrait import (FitFlags, _fit_portrait_core, derive_use_scatter,
                             fast_fit_one, make_weights)
 from .mesh import batch_sharding
+from ..ops.fourier import rfft_c
 
 
 def shard_batch(mesh, arrays, chan_axis=None):
@@ -58,8 +59,8 @@ def fit_portrait_sharded(
     ports = jnp.asarray(ports)
     nb, nchan, nbin = ports.shape
     w = make_weights(noise_stds, nbin, dtype=ports.dtype)
-    dFT = jnp.fft.rfft(ports, axis=-1)
-    mFT = jnp.fft.rfft(jnp.asarray(models).astype(ports.dtype), axis=-1)
+    dFT = rfft_c(ports)
+    mFT = rfft_c(jnp.asarray(models).astype(ports.dtype))
     dt = w.dtype
     freqs = jnp.asarray(freqs, dt)
     P_s = jnp.broadcast_to(jnp.asarray(P_s, dt), (nb,))

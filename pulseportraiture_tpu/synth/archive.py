@@ -19,6 +19,7 @@ import numpy as np
 from ..config import Dconst, scattering_alpha
 from ..io.gmodel import gen_gmodel_portrait, read_gmodel
 from ..io.psrfits import new_archive, parse_parfile, rotate_phase
+from ..utils.device import on_host
 from ..utils.mjd import MJD
 
 
@@ -59,6 +60,7 @@ def _dm_nu_delays(phase, dDM, P, freqs, xs, Cs, nu_DM):
     return delays
 
 
+@on_host
 def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
                      nsub=1, npol=1, nchan=512, nbin=2048, nu0=1500.0,
                      bw=800.0, tsub=300.0, phase=0.0, dDM=0.0,
