@@ -52,8 +52,13 @@ def main():
     ports = synth(jax.random.PRNGKey(0))
     noise = jnp.full((NB, NCHAN), 0.03, DT)
     models = jnp.broadcast_to(model, (NB, NCHAN, NBIN))
+    # data-driven tau seed (fit.portrait.estimate_tau_batch) — the
+    # pipeline's scat_guess="auto"; cuts Newton evals severalfold vs
+    # the neutral half-bin seed
+    from pulseportraiture_tpu.fit.portrait import estimate_tau_batch
+    tau_seed = np.asarray(estimate_tau_batch(ports, model, noise))
     th0 = np.zeros((NB, 5), np.float32)
-    th0[:, 3] = np.log10(0.5 / NBIN)
+    th0[:, 3] = np.log10(np.maximum(tau_seed, 1e-12))
     th0[:, 4] = -4.0
     th0 = jnp.asarray(th0)
 

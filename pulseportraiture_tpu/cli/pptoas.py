@@ -47,7 +47,9 @@ def build_parser():
     p.add_argument("--no_logscat", dest="log10_tau", action="store_false",
                    default=True, help="Fit tau linearly, not log10(tau).")
     p.add_argument("--scat_guess", default=None,
-                   help="'tau[s],freq[MHz],alpha' initial scattering guess.")
+                   help="'tau[s],freq[MHz],alpha' initial scattering "
+                        "guess, or 'auto' to estimate it per subint from "
+                        "the data's harmonic amplitude decay.")
     p.add_argument("--fix_alpha", action="store_true", default=False,
                    help="Hold the scattering index fixed (with --fit_scat).")
     p.add_argument("--nu_tau", dest="nu_ref_tau", default=None, type=float,
@@ -91,7 +93,8 @@ def main(argv=None):
         nu_refs = (nu_ref_DM, args.nu_ref_tau)
     scat_guess = None
     if args.scat_guess:
-        scat_guess = [float(x) for x in args.scat_guess.split(",")]
+        scat_guess = ("auto" if args.scat_guess.strip() == "auto"
+                      else [float(x) for x in args.scat_guess.split(",")])
     addtnl = {}
     if args.flags:
         parts = args.flags.split(",")

@@ -114,9 +114,17 @@ def fit_phase_shift(data, model, noise_std=None, oversamp=8, newton_iters=5):
 
 
 def fit_phase_shift_batch(data, model, noise_std, oversamp=8, newton_iters=5):
-    """vmapped fit over leading batch dims of (…, nbin) data/model."""
+    """vmapped fit over leading batch dims of (…, nbin) data/model.
+
+    f64 inputs are canonicalized to f32 on TPU backends (c128 spectra
+    do not compile there); the scalar fit_phase_shift above is
+    host-pinned instead."""
+    from .portrait import _canonical_real_dtype
+
+    data = _canonical_real_dtype(jnp.asarray(data))
+    model = jnp.asarray(model).astype(data.dtype)
     nbin = data.shape[-1]
-    errs_F = fourier_noise(jnp.asarray(noise_std), nbin)
+    errs_F = fourier_noise(jnp.asarray(noise_std, data.dtype), nbin)
     dFT = rfft_c(data)
     mFT = rfft_c(model)
     core = partial(

@@ -927,6 +927,90 @@ def make_weights(noise_stds, nbin, chan_mask=None, dtype=None):
     return w
 
 
+def _canonical_real_dtype(x):
+    """f64 -> f32 on TPU backends (c128 spectra do not compile there);
+    unchanged elsewhere."""
+    if x.dtype == jnp.float64 and jax.default_backend() == "tpu":
+        return x.astype(jnp.float32)
+    return x
+
+
+def estimate_tau(port, model, noise_stds, chan_mask=None):
+    """Seed-quality broadband scattering-timescale estimate [rotations]
+    by matching the weighted cross-spectrum amplitude ratio against the
+    scattering kernel's Lorentzian-amplitude shape.
+
+    A one-sided-exponential scattering kernel multiplies the data's
+    harmonic content by |B(k)| = (1 + (2 pi k tau)^2)^-1/2, which the
+    channel-summed ratio q(k) = sum_n w|d conj(m)| / sum_n w|m|^2
+    traces.  Phase shifts and per-channel amplitudes cancel in |X|, so
+    no alignment is needed first.  Unscattered data fits best at the
+    grid's bottom edge and returns the neutral half-bin seed.
+
+    The fit is a profiled-amplitude least-squares match of q(k) against
+    |B(k; tau)| on a fixed log grid of tau values (64 points spanning
+    sub-bin to half a turn), after subtracting the analytic Rice floor
+    of |X| under pure noise (E|X|_noise = sqrt(pi/2) sum_n sqrt(w)|m|)
+    in quadrature — without that subtraction the high-k noise shelf
+    biases large-tau estimates low.
+
+    This replaces a user-supplied scat_guess, not the fit: the estimate
+    is biased by model mismatch and residual noise rectification at the
+    ~tens of percent level, which the Newton loop then removes in a few
+    steps instead of the ~28 it needs from the neutral seed.  The
+    reference has no analogue (its pipeline requires --scat_guess or
+    starts neutral, pptoas.py:1497).
+    """
+    from ..ops.fourier import rfft_mm
+
+    port = jnp.asarray(port)
+    nbin = port.shape[-1]
+    nharm = nbin // 2 + 1
+    dt = port.dtype
+    w = make_weights(noise_stds, nbin, chan_mask, dtype=dt)
+    dr, di = rfft_mm(port)
+    mr, mi = rfft_mm(jnp.asarray(model).astype(dt))
+    mabs = jnp.sqrt(mr**2.0 + mi**2.0)
+    Xa = jnp.sqrt((dr * mr + di * mi) ** 2.0 + (di * mr - dr * mi) ** 2.0)
+    num = jnp.sum(w * Xa, axis=0)
+    den = jnp.sum(w * mabs**2.0, axis=0)
+    den_safe = jnp.maximum(den, _tiny(dt))
+    q = num / den_safe
+    # Rice floor of |X| under pure noise, subtracted in quadrature
+    floor = jnp.sqrt(jnp.pi / 2.0) * jnp.sum(jnp.sqrt(w) * mabs,
+                                             axis=0) / den_safe
+    q_sig = jnp.sqrt(jnp.maximum(q**2.0 - floor**2.0, 0.0))
+    # profiled-amplitude LS over a fixed log-tau grid, weighted by model
+    # power (den); harmonic 0 is F0_fact-zeroed via w already
+    k = jnp.arange(nharm, dtype=dt)
+    taus = jnp.logspace(jnp.log10(0.25 / nbin), jnp.log10(0.5), 64,
+                        dtype=dt)
+    b = (1.0 + (2.0 * jnp.pi * taus[:, None] * k) ** 2.0) ** -0.5
+    u = den
+    A = jnp.sum(u * q_sig * b, axis=1) / jnp.maximum(
+        jnp.sum(u * b**2.0, axis=1), _tiny(dt))
+    sse = jnp.sum(u * (q_sig - A[:, None] * b) ** 2.0, axis=1)
+    tau = taus[jnp.argmin(sse)]
+    neutral = 0.5 / nbin
+    # an unscattered portrait fits best at the grid's bottom edge; the
+    # neutral seed is the right answer there
+    return jnp.maximum(tau, neutral)
+
+
+def estimate_tau_batch(ports, models, noise_stds, chan_masks=None):
+    """vmapped estimate_tau over a leading batch dim; models may be
+    (nchan, nbin) shared or (nb, nchan, nbin)."""
+    ports = jnp.asarray(ports)
+    models = jnp.asarray(models)
+    m_ax = 0 if models.ndim == 3 else None
+    if chan_masks is None:
+        return jax.vmap(
+            lambda p, m, n: estimate_tau(p, m, n), in_axes=(0, m_ax, 0)
+        )(ports, models, jnp.asarray(noise_stds))
+    return jax.vmap(estimate_tau, in_axes=(0, m_ax, 0, 0))(
+        ports, models, jnp.asarray(noise_stds), jnp.asarray(chan_masks))
+
+
 def fit_portrait(
     port,
     model,
@@ -960,7 +1044,7 @@ def fit_portrait(
     from ..config import scattering_alpha
     from ..ops.phasor import guess_fit_freq
 
-    port = jnp.asarray(port)
+    port = _canonical_real_dtype(jnp.asarray(port))
     model = jnp.asarray(model)
     freqs = jnp.asarray(freqs)
     nbin = port.shape[-1]
@@ -1022,8 +1106,12 @@ def fit_portrait_batch(
     ir_FT: optional (nchan, nharm) instrumental-response FT shared by
     the whole batch (ops.instrumental_response_port_FT; reference
     convolves the model per subint at pptoas.py:428-434).
+
+    f64 inputs are canonicalized to f32 on TPU backends: the complex
+    engine follows the input dtype, and c128 spectra do not compile on
+    any TPU runtime.  Every pipeline call site inherits this guard.
     """
-    ports = jnp.asarray(ports)
+    ports = _canonical_real_dtype(jnp.asarray(ports))
     nb = ports.shape[0]
     nbin = ports.shape[-1]
     if use_scatter is None:
@@ -1039,6 +1127,8 @@ def fit_portrait_batch(
     nf_ax = 0 if nu_fit.ndim == 1 else None
     if theta0 is None:
         theta0 = jnp.zeros((nb, 5), w.dtype)
+    else:
+        theta0 = jnp.asarray(theta0, w.dtype)
     nu_out_val = jnp.full((nb,), -1.0 if nu_out is None else nu_out, w.dtype)
 
     use_ir = ir_FT is not None

@@ -21,6 +21,7 @@ statistics — re-architected TPU-first:
 
 import time
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -63,6 +64,24 @@ def scat_time_flags(tau_rot, tau_err_rot, seconds_per_rot, log10_tau):
     else:
         flags["scat_time_err"] = tau_err_rot * seconds_per_rot * 1e6
     return flags
+
+
+def _validate_scat_guess(scat_guess, fit_scat):
+    """Normalize/validate the scat_guess argument: a (tau_s, nu, alpha)
+    triple, the literal 'auto', or None.  Anything else raises instead
+    of being silently ignored."""
+    if isinstance(scat_guess, str):
+        s = scat_guess.strip().lower()
+        if s != "auto":
+            raise ValueError(
+                f"scat_guess string must be 'auto', got {scat_guess!r}")
+        if not fit_scat:
+            raise ValueError("scat_guess='auto' requires fit_scat=True")
+        return "auto"
+    if scat_guess is not None and len(tuple(scat_guess)) != 3:
+        raise ValueError(
+            "scat_guess must be (tau_s, nu_MHz, alpha), 'auto', or None")
+    return scat_guess
 
 
 def snr_weighted_nu_fit(snrs_chan, freqs0):
@@ -239,9 +258,13 @@ class GetTOAs:
         """Measure wideband TOAs (reference pptoas.py:161-792; same
         options minus the scipy `method`/`bounds` knobs, which have no
         analogue in the fused-Newton engine).  prefetch=True overlaps
-        the next archive's load with the current archive's fits."""
+        the next archive's load with the current archive's fits.
+        scat_guess: (tau_s, nu_MHz, alpha) like the reference, or
+        "auto" to estimate tau per subint from the data
+        (fit.portrait.estimate_tau — no reference analogue)."""
         if quiet is None:
             quiet = self.quiet
+        scat_guess = _validate_scat_guess(scat_guess, fit_scat)
         if not fit_scat:
             log10_tau = False
         self.fit_flags = [1, int(fit_DM), int(fit_GM), int(fit_scat),
@@ -302,10 +325,22 @@ class GetTOAs:
             # initial tau guess [rot at nu_fit]
             alpha0 = (self.model.gauss.alpha if self.model.is_gaussian
                       else scattering_alpha)
-            if scat_guess is not None:
+            if scat_guess is not None and not isinstance(scat_guess, str):
                 t_s, nu_s, a_s = scat_guess
                 tau0 = (t_s / P_mean) * (nu_fit_arr / nu_s) ** a_s
                 alpha0 = a_s
+            elif fit_scat and scat_guess == "auto":
+                # data-driven broadband estimate per subint (|X| is
+                # phase-invariant, so no alignment needed first); cuts
+                # the scattering fit's Newton evals severalfold vs the
+                # neutral seed
+                from ..fit.portrait import estimate_tau_batch
+
+                tau0 = np.asarray(estimate_tau_batch(
+                    jnp.asarray(ports, jnp.float32),
+                    jnp.asarray(modelx, jnp.float32),
+                    jnp.asarray(noise, jnp.float32),
+                    jnp.asarray(masks, jnp.float32)))
             elif fit_scat:
                 tau0 = np.full(nok, 0.5 / nbin)  # half a bin: neutral seed
             else:
@@ -395,6 +430,8 @@ class GetTOAs:
                         max_iter=max_iter,
                     )
                 else:
+                    # fit_portrait_batch canonicalizes f64 -> f32 on TPU
+                    # backends itself (c128 spectra do not compile there)
                     r = fit_portrait_batch(
                         jnp.asarray(ports[idx]),
                         jnp.asarray(np.broadcast_to(modelx,
@@ -658,10 +695,13 @@ class GetTOAs:
         the phase by running the 5-parameter engine on single-channel
         portraits with flags (phi, tau) — the capability the reference
         stubbed out ('NOT YET IMPLEMENTED', pptoas.py:1046-1049).
-        scat_guess: optional (tau [s], freq [MHz], alpha) seed, as in
-        get_TOAs.  The linear parameterization (log10_tau=False) only
-        converges from a realistic seed, so it requires scat_guess."""
+        scat_guess: optional (tau [s], freq [MHz], alpha) seed or
+        "auto", as in get_TOAs.  The linear parameterization
+        (log10_tau=False) only converges from a realistic seed, so it
+        requires scat_guess."""
         from ..fit.phase_shift import fit_phase_shift_batch
+
+        scat_guess = _validate_scat_guess(scat_guess, fit_scat)
 
         if quiet is None:
             quiet = self.quiet
@@ -705,7 +745,19 @@ class GetTOAs:
                 masks = jnp.asarray(
                     (d.weights[ok] > 0.0).reshape(nok * nchan, 1), float)
                 th0 = np.zeros((nok * nchan, 5))
-                if scat_guess is not None:
+                if scat_guess == "auto":
+                    # broadband estimate per subint, scaled to each
+                    # channel with the default scattering index
+                    from ..fit.portrait import estimate_tau_batch
+
+                    tau_sub = np.asarray(estimate_tau_batch(
+                        jnp.asarray(ports, jnp.float32),
+                        jnp.asarray(modelx, jnp.float32),
+                        jnp.asarray(noise, jnp.float32)))
+                    nu_mid = float(np.mean(freqs0))
+                    tau_seed = (tau_sub[:, None] * (freqs0[None, :] / nu_mid)
+                                ** scattering_alpha).reshape(nok * nchan)
+                elif scat_guess is not None:
                     t_s, nu_s, a_s = scat_guess
                     tau_seed = ((t_s / P_mean)
                                 * (np.asarray(flat_freqs[:, 0]) / nu_s)

@@ -321,3 +321,80 @@ def test_fit_portrait_nan_data_poisons_errors(key):
     assert not np.isfinite(float(r.phi_err[0])) or \
         np.isnan(float(r.phi_err[0]))
     assert not np.all(np.isfinite(np.asarray(r.scales[0])))
+
+
+def test_fast_path_error_calibration_bf16(key):
+    """phi/DM pulls stay ~ N(0,1) through the throughput settings the
+    TPU bench enables (single-pass-bf16 DFTs + bf16 cross-spectrum):
+    the narrowed arithmetic must not decalibrate reported uncertainties,
+    only add (sub-noise) quantization error."""
+    from pulseportraiture_tpu import config
+    from pulseportraiture_tpu.fit.portrait import fit_portrait_batch_fast
+
+    old_prec, old_x = config.dft_precision, config.cross_spectrum_dtype
+    config.dft_precision = "default"
+    config.cross_spectrum_dtype = "bfloat16"
+    try:
+        ntrial = 24
+        keys = jax.random.split(key, ntrial)
+        model = default_test_model(1500.0)
+        ports, noises = [], []
+        for k in keys:
+            d = fake_portrait(k, model, FREQS, NBIN, P, phi=0.013,
+                              DM=0.0007, noise_std=0.05)
+            ports.append(np.asarray(d.port, np.float32))
+            noises.append(np.asarray(d.noise_stds, np.float32))
+        r = fit_portrait_batch_fast(
+            jnp.asarray(np.stack(ports)), d.model_port.astype(jnp.float32),
+            jnp.asarray(np.stack(noises)), FREQS.astype(jnp.float32),
+            P, 1500.0, max_iter=25)
+        zs_phi, zs_dm = [], []
+        for i in range(ntrial):
+            true_at_nudm = float(phase_transform(
+                0.013, 0.0007, d.nu_ref, float(r.nu_DM[i]), P))
+            zs_phi.append((float(r.phi[i]) - true_at_nudm)
+                          / float(r.phi_err[i]))
+            zs_dm.append((float(r.DM[i]) - 0.0007) / float(r.DM_err[i]))
+        zp, zd = np.asarray(zs_phi), np.asarray(zs_dm)
+        assert abs(zp.mean()) < 0.7 and 0.4 < zp.std() < 2.0, (zp.mean(),
+                                                               zp.std())
+        assert abs(zd.mean()) < 0.7 and 0.4 < zd.std() < 2.0, (zd.mean(),
+                                                               zd.std())
+    finally:
+        config.dft_precision = old_prec
+        config.cross_spectrum_dtype = old_x
+
+
+@pytest.mark.parametrize("tau_s", [0.0, 5e-5, 5e-4])
+def test_estimate_tau_seed_quality(key, tau_s):
+    """The data-driven tau seed lands within a factor ~2 of the truth
+    across a 10x tau range, returns the neutral half-bin for
+    unscattered data, and cuts the scattering fit's Newton evals vs the
+    neutral seed."""
+    from pulseportraiture_tpu.fit.portrait import estimate_tau
+
+    model = default_test_model(1500.0)
+    d = fake_portrait(key, model, FREQS, NBIN, P, tau=tau_s, alpha=-4.0,
+                      noise_std=0.03)
+    est = float(estimate_tau(d.port, d.model_port, d.noise_stds))
+    if tau_s == 0.0:
+        assert est == pytest.approx(0.5 / NBIN)
+        return
+    true_rot = tau_s / P
+    assert 0.4 * true_rot < est < 2.5 * true_rot, (est, true_rot)
+
+    th_auto = np.zeros((1, 5)); th_auto[0, 3] = np.log10(est)
+    th_neut = np.zeros((1, 5)); th_neut[0, 3] = np.log10(0.5 / NBIN)
+    th_auto[0, 4] = th_neut[0, 4] = -4.0
+    kw = dict(fit_flags=FitFlags(True, True, False, True, True),
+              log10_tau=True, max_iter=60)
+    r_a = fit_portrait_batch(d.port[None], d.model_port[None],
+                             d.noise_stds[None], FREQS, P, 1500.0,
+                             theta0=jnp.asarray(th_auto), **kw)
+    r_n = fit_portrait_batch(d.port[None], d.model_port[None],
+                             d.noise_stds[None], FREQS, P, 1500.0,
+                             theta0=jnp.asarray(th_neut), **kw)
+    # both converge to the same tau...
+    assert float(r_a.tau[0]) == pytest.approx(float(r_n.tau[0]), rel=0.05)
+    # ...but the seeded fit needs fewer evaluations
+    assert int(r_a.nfeval[0]) <= int(r_n.nfeval[0])
