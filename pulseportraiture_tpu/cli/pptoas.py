@@ -102,19 +102,21 @@ def main(argv=None):
 
     if args.stream:
         if (args.narrowband or args.psrchive or args.fit_GM
-                or args.fit_scat or args.one_DM or args.print_flux
+                or args.one_DM or args.print_flux
                 or args.print_phase or args.print_parangle
                 or args.showplot):
             raise SystemExit(
-                "--stream supports the wideband (phi, DM) campaign "
-                "configuration only (no narrowband/GM/scattering/"
+                "--stream supports the wideband (phi, DM[, scattering]) "
+                "campaign configuration only (no narrowband/GM/"
                 "one_DM/flux/phase/parangle flags or plots)")
         from ..pipeline.stream import stream_wideband_TOAs
 
         res = stream_wideband_TOAs(
             args.datafiles, args.modelfile, fit_DM=args.fit_DM,
             nu_ref_DM=nu_ref_DM, DM0=args.DM0, bary=args.bary,
-            tscrunch=args.tscrunch, addtnl_toa_flags=addtnl,
+            tscrunch=args.tscrunch, fit_scat=args.fit_scat,
+            log10_tau=args.log10_tau, scat_guess=scat_guess,
+            fix_alpha=args.fix_alpha, addtnl_toa_flags=addtnl,
             quiet=args.quiet)
         if args.format == "princeton":
             dDMs = [toa.DM - res.DM0s[res.order.index(toa.archive)]

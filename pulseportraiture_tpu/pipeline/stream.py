@@ -12,10 +12,11 @@ uses.  Results are scattered back to their archives and returned in
 archive order; only the few per-subint fields needed for TOA assembly
 are retained, so host memory stays O(bucket), not O(campaign).
 
-Scope: the common campaign configuration — wideband (phi[, DM]) fits,
-no scattering / GM / instrumental response / flux.  For those, use
-GetTOAs.  The fit engine is chosen by config.use_fast_fit exactly as
-in GetTOAs (complex-free f32 fast path on TPU backends), and subints
+Scope: campaign configurations — wideband (phi[, DM]) fits, plus
+scattering (fit_scat/log10_tau/scat_guess/fix_alpha as in GetTOAs).
+GM / instrumental response / flux remain GetTOAs-only.  No-scattering
+buckets take the complex-free f32 fast path on TPU backends
+(config.use_fast_fit), scattering buckets the complex engine; subints
 with a single usable channel are demoted to phase-only buckets (the
 degenerate-geometry fallback, pptoas.py:519-527).
 
@@ -28,13 +29,15 @@ import time
 import jax.numpy as jnp
 import numpy as np
 
+from ..config import scattering_alpha
 from ..fit.portrait import (FitFlags, fit_portrait_batch,
                             fit_portrait_batch_fast, use_fast_fit_default)
 from ..io.tim import TOA, write_TOAs
 from ..utils.bunch import DataBunch
 from .models import TemplateModel
 from .toas import (_is_metafile, _iter_archives, _read_metafile,
-                   delta_dm_stats, load_for_toas, snr_weighted_nu_fit)
+                   _validate_scat_guess, delta_dm_stats, load_for_toas,
+                   scat_time_flags, snr_weighted_nu_fit)
 
 
 class _Bucket:
@@ -57,7 +60,8 @@ class _Bucket:
         return len(self.ports)
 
 
-def _flush(bucket, nu_ref_DM, max_iter, nsub_batch, results):
+def _flush(bucket, nu_ref_DM, max_iter, nsub_batch, results,
+           log10_tau=False):
     """Fit every pending subint of a bucket in ONE dispatch and scatter
     the results into per-(archive, subint) records.  The batch is
     always padded to a multiple of nsub_batch so dispatch shapes stay
@@ -75,8 +79,12 @@ def _flush(bucket, nu_ref_DM, max_iter, nsub_batch, results):
     theta0 = np.stack([bucket.theta0[i] for i in idx0])
     flags = FitFlags(*bucket.flags)
 
+    # scattering (fitted, or a fixed nonzero/log10 tau seed in a
+    # degenerate lane of a scattering run) requires the complex engine
+    scat = (flags[3] or flags[4] or log10_tau
+            or bool(np.any(theta0[:, 3] != 0.0)))
     t0 = time.time()
-    if use_fast_fit_default():
+    if not scat and use_fast_fit_default():
         ft = jnp.float32
         r = fit_portrait_batch_fast(
             jnp.asarray(ports, ft), jnp.asarray(bucket.modelx, ft),
@@ -93,15 +101,16 @@ def _flush(bucket, nu_ref_DM, max_iter, nsub_batch, results):
             jnp.asarray(Ps), jnp.asarray(nu_fit),
             nu_out=nu_ref_DM, theta0=jnp.asarray(theta0),
             fit_flags=flags, chan_masks=jnp.asarray(masks),
-            max_iter=max_iter)
+            log10_tau=log10_tau, max_iter=max_iter)
     out = {k: np.asarray(v) for k, v in r._asdict().items()}
     dt = time.time() - t0
     resolved = list(bucket.owners)
+    keys = ("phi", "phi_err", "DM", "DM_err", "nu_DM", "snr", "chi2",
+            "dof", "nfeval", "return_code")
+    if flags[3]:
+        keys += ("tau", "tau_err", "alpha", "alpha_err", "nu_tau")
     for i in range(n):  # padded lanes are discarded
-        results[bucket.owners[i]] = {k: out[k][i] for k in
-                                     ("phi", "phi_err", "DM", "DM_err",
-                                      "nu_DM", "snr", "chi2", "dof",
-                                      "nfeval", "return_code")}
+        results[bucket.owners[i]] = {k: out[k][i] for k in keys}
     bucket.ports.clear(); bucket.noise.clear(); bucket.masks.clear()
     bucket.Ps.clear(); bucket.nu_fits.clear(); bucket.theta0.clear()
     bucket.owners.clear()
@@ -109,7 +118,8 @@ def _flush(bucket, nu_ref_DM, max_iter, nsub_batch, results):
 
 
 def _assemble_archive(m, results, modelfile, fit_DM, bary,
-                      addtnl_toa_flags):
+                      addtnl_toa_flags, log10_tau=False,
+                      alpha_fitted=False):
     """Build the TOA objects + DeltaDM stats for one archive from the
     scattered fit results."""
     toas, dDMs, dDM_errs = [], [], []
@@ -122,14 +132,24 @@ def _assemble_archive(m, results, modelfile, fit_DM, bary,
         toa_mjd = m.epochs[j].add_seconds(phi * P + m.backend_delay)
         df = m.dfs[j] if bary else 1.0
         DM_j = float(r["DM"]) * (df if (bary and fit_DM) else 1.0)
-        flags = {
+        flags = {}
+        if "tau" in r:
+            # same flag set as GetTOAs (scat_time in us, Doppler-
+            # corrected like the wideband pipeline)
+            flags.update(scat_time_flags(
+                float(r["tau"]), float(r["tau_err"]), P / df, log10_tau))
+            flags["scat_ref_freq"] = float(r["nu_tau"]) * df
+            flags["scat_ind"] = float(r["alpha"])
+            if alpha_fitted:
+                flags["scat_ind_err"] = float(r["alpha_err"])
+        flags.update({
             "be": m.backend, "fe": m.frontend,
             "f": f"{m.frontend}_{m.backend}",
             "nbin": int(m.nbin), "nch": int(m.nchan),
             "subint": int(isub), "tobs": m.subtimes[j],
             "tmplt": str(modelfile), "snr": float(r["snr"]),
             "gof": float(r["chi2"] / max(float(r["dof"]), 1.0)),
-        }
+        })
         flags.update(addtnl_toa_flags)
         DM_out = DM_j if fit_DM else None
         DM_err_out = float(r["DM_err"]) if fit_DM else None
@@ -146,10 +166,17 @@ def _assemble_archive(m, results, modelfile, fit_DM, bary,
 
 def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                          fit_DM=True, nu_ref_DM=None, DM0=None, bary=True,
-                         tscrunch=False, max_iter=25, prefetch=True,
-                         addtnl_toa_flags={}, tim_out=None, quiet=False):
-    """Measure wideband (phi[, DM]) TOAs for many archives with
-    cross-archive batched dispatches.
+                         tscrunch=False, fit_scat=False, log10_tau=True,
+                         scat_guess=None, fix_alpha=False, max_iter=25,
+                         prefetch=True, addtnl_toa_flags={}, tim_out=None,
+                         quiet=False):
+    """Measure wideband (phi[, DM[, tau, alpha]]) TOAs for many
+    archives with cross-archive batched dispatches.
+
+    fit_scat/log10_tau/scat_guess/fix_alpha follow GetTOAs.get_TOAs
+    (scat_guess may be (tau_s, nu, alpha), "auto" for the data-driven
+    seed, or None for the neutral half-bin); scattering buckets run the
+    complex engine, no-scattering buckets keep the fast path.
 
     tim_out: optional .tim path; each archive's TOA lines are APPENDED
     as soon as all its subints are fitted, so a campaign interrupted
@@ -170,6 +197,9 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                      else [datafiles])
     else:
         datafiles = list(datafiles)
+    scat_guess = _validate_scat_guess(scat_guess, fit_scat)
+    if not fit_scat:
+        log10_tau = False
     model = TemplateModel(modelfile, quiet=quiet)
     # scattering baked into the template makes the portrait depend on
     # the folding period (tau seconds -> bins) — such templates must
@@ -195,7 +225,8 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
 
     def do_flush(b):
         nonlocal fit_duration, nfit
-        dt, resolved = _flush(b, nu_ref_DM, max_iter, nsub_batch, results)
+        dt, resolved = _flush(b, nu_ref_DM, max_iter, nsub_batch, results,
+                              log10_tau=log10_tau)
         fit_duration += dt
         nfit += 1
         touched = set()
@@ -209,7 +240,8 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                 m = meta_by_iarch[ia]
                 out = _assemble_archive(
                     m, results, modelfile, fit_DM, bary,
-                    addtnl_toa_flags)
+                    addtnl_toa_flags, log10_tau=log10_tau,
+                    alpha_fitted=fit_scat and not fix_alpha)
                 assembled[ia] = out
                 # the per-subint records are folded into the assembly;
                 # dropping them keeps host memory O(bucket)
@@ -263,17 +295,42 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
         remaining[iarch] = len(ok)
         ports = np.asarray(d.subints[ok, 0], float)
         nchx = masks.sum(axis=1).astype(int)
+
+        # tau/alpha seeds (mirrors GetTOAs.get_TOAs)
+        alpha0 = (model.gauss.alpha if model.is_gaussian
+                  else scattering_alpha)
+        if scat_guess is not None and not isinstance(scat_guess, str):
+            t_s, nu_s, a_s = scat_guess
+            tau0 = (t_s / P_mean) * (nu_fit_arr / nu_s) ** a_s
+            alpha0 = a_s
+        elif fit_scat and scat_guess == "auto":
+            from ..fit.portrait import estimate_tau_batch
+
+            tau0 = np.asarray(estimate_tau_batch(
+                jnp.asarray(ports, jnp.float32),
+                jnp.asarray(modelx, jnp.float32),
+                jnp.asarray(noise, jnp.float32),
+                jnp.asarray(masks, jnp.float32)))
+        elif fit_scat:
+            tau0 = np.full(len(ok), 0.5 / nbin)
+        else:
+            tau0 = np.zeros(len(ok))
+
+        base_flags = (True, bool(fit_DM), False, bool(fit_scat),
+                      bool(fit_scat and not fix_alpha))
         for j, isub in enumerate(ok):
             # degenerate geometry: 1 usable channel -> phase-only
             eff_flags = ((True, False, False, False, False)
-                         if nchx[j] <= 1
-                         else (True, bool(fit_DM), False, False, False))
+                         if nchx[j] <= 1 else base_flags)
             key = base_key + (eff_flags,)
             if key not in buckets:
                 buckets[key] = _Bucket(freqs0, nbin, modelx, eff_flags)
             b = buckets[key]
             th = np.zeros(5)
             th[1] = DM_guess
+            th[3] = (np.log10(max(tau0[j], 1e-12)) if log10_tau
+                     else tau0[j])
+            th[4] = alpha0
             b.ports.append(ports[j])
             b.noise.append(noise[j])
             b.masks.append(masks[j])
@@ -293,7 +350,8 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
     order, DM0s, DeltaDM_means, DeltaDM_errs = [], [], [], []
     for m in meta:
         toas, mean, err = assembled.get(m.iarch) or _assemble_archive(
-            m, results, modelfile, fit_DM, bary, addtnl_toa_flags)
+            m, results, modelfile, fit_DM, bary, addtnl_toa_flags,
+            log10_tau=log10_tau, alpha_fitted=fit_scat and not fix_alpha)
         TOA_list.extend(toas)
         order.append(m.datafile)
         DM0s.append(m.DM0_arch)

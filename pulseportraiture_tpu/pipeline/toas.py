@@ -84,6 +84,44 @@ def _validate_scat_guess(scat_guess, fit_scat):
     return scat_guess
 
 
+def scat_seed_tau0(scat_guess, fit_scat, nok, nbin, P_mean, nu_fit_arr,
+                   default_alpha, ports=None, modelx=None, noise=None,
+                   masks=None):
+    """(tau0 array [rot], alpha0) seeding shared by GetTOAs and the
+    streaming driver.  scat_guess: (tau_s, nu, alpha) triple, "auto"
+    (data-driven estimate — requires ports/modelx/noise), or None
+    (neutral half-bin when fit_scat, zeros otherwise)."""
+    alpha0 = default_alpha
+    if scat_guess is not None and not isinstance(scat_guess, str):
+        t_s, nu_s, a_s = scat_guess
+        tau0 = (t_s / P_mean) * (np.asarray(nu_fit_arr) / nu_s) ** a_s
+        alpha0 = a_s
+    elif fit_scat and scat_guess == "auto":
+        from ..fit.portrait import estimate_tau_batch
+
+        tau0 = np.asarray(estimate_tau_batch(
+            jnp.asarray(ports, jnp.float32),
+            jnp.asarray(modelx, jnp.float32),
+            jnp.asarray(noise, jnp.float32),
+            None if masks is None else jnp.asarray(masks, jnp.float32)))
+    elif fit_scat:
+        tau0 = np.full(nok, 0.5 / nbin)  # half a bin: neutral seed
+    else:
+        tau0 = np.zeros(nok)
+    return tau0, alpha0
+
+
+def reref_tau(tau, tau_err, nu_from, nu_to, alpha):
+    """Re-reference a scattering timescale (and its error) between
+    frequencies via its own power law (reference pptoaslib.py:1107-1113
+    semantics: tau' = tau (nu'/nu)^alpha, error scaled by the same
+    factor; the alpha-covariance cross term is neglected, as in the
+    reference's output path)."""
+    r = (np.asarray(nu_to, float) / np.asarray(nu_from, float)) \
+        ** np.asarray(alpha, float)
+    return tau * r, tau_err * np.abs(r)
+
+
 def snr_weighted_nu_fit(snrs_chan, freqs0):
     """Per-subint fit reference frequency: the S/N * nu^-2-weighted
     center-of-mass frequency (reference guess_fit_freq,
@@ -322,29 +360,15 @@ class GetTOAs:
             else:
                 nu_fit_arr = snr_weighted_nu_fit(snrs_chan, freqs0)
 
-            # initial tau guess [rot at nu_fit]
-            alpha0 = (self.model.gauss.alpha if self.model.is_gaussian
-                      else scattering_alpha)
-            if scat_guess is not None and not isinstance(scat_guess, str):
-                t_s, nu_s, a_s = scat_guess
-                tau0 = (t_s / P_mean) * (nu_fit_arr / nu_s) ** a_s
-                alpha0 = a_s
-            elif fit_scat and scat_guess == "auto":
-                # data-driven broadband estimate per subint (|X| is
-                # phase-invariant, so no alignment needed first); cuts
-                # the scattering fit's Newton evals severalfold vs the
-                # neutral seed
-                from ..fit.portrait import estimate_tau_batch
-
-                tau0 = np.asarray(estimate_tau_batch(
-                    jnp.asarray(ports, jnp.float32),
-                    jnp.asarray(modelx, jnp.float32),
-                    jnp.asarray(noise, jnp.float32),
-                    jnp.asarray(masks, jnp.float32)))
-            elif fit_scat:
-                tau0 = np.full(nok, 0.5 / nbin)  # half a bin: neutral seed
-            else:
-                tau0 = np.zeros(nok)
+            # initial tau guess [rot at nu_fit]; "auto" = data-driven
+            # broadband estimate per subint (|X| is phase-invariant, so
+            # no alignment needed first) — cuts the scattering fit's
+            # Newton evals severalfold vs the neutral seed
+            tau0, alpha0 = scat_seed_tau0(
+                scat_guess, fit_scat, nok, nbin, P_mean, nu_fit_arr,
+                self.model.gauss.alpha if self.model.is_gaussian
+                else scattering_alpha,
+                ports=ports, modelx=modelx, noise=noise, masks=masks)
 
             theta0 = np.zeros((nok, 5))
             theta0[:, 1] = DM_guess
@@ -471,6 +495,15 @@ class GetTOAs:
                 scale_errs_arr[idx] = r["scale_errs"] * masks[idx]
                 channel_snrs_arr[idx] = r["channel_snrs"] * masks[idx]
                 covs[idx] = r["covariance"]
+
+            # user-requested tau output reference (reference -nu_tau;
+            # None keeps each fit's zero-covariance frequency)
+            if fit_scat and nu_ref_tau is not None:
+                tau_r, tau_err_r = reref_tau(
+                    res_arrays["tau"], res_arrays["tau_err"],
+                    res_arrays["nu_tau"], nu_ref_tau, res_arrays["alpha"])
+                res_arrays["tau"], res_arrays["tau_err"] = tau_r, tau_err_r
+                res_arrays["nu_tau"] = np.full(nok, float(nu_ref_tau))
 
             # ---- per-subint host post-processing --------------------------
             phis = np.full(nsub, np.nan)

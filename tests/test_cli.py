@@ -159,7 +159,13 @@ def test_pptoas_cli_stream_matches(workspace, tmp_path):
         for key in ("-pp_dm", "-pp_dme"):
             assert float(db[key]) == pytest.approx(float(da[key]),
                                                    rel=1e-5, abs=1e-9)
-    # rejects unsupported configurations
+    # scattering IS streamable (fit_scat + auto seed run through the
+    # bucketed complex engine); GM remains a rejected configuration
+    tim_c = tmp_path / "str_scat.tim"
+    assert pptoas.main(["-d", meta, "-m", gm, "-o", str(tim_c),
+                        "--stream", "--fit_scat", "--scat_guess", "auto",
+                        "--quiet"]) == 0
+    assert "-scat_time" in tim_c.read_text()
     with pytest.raises(SystemExit):
-        pptoas.main(["-d", meta, "-m", gm, "--stream", "--fit_scat",
+        pptoas.main(["-d", meta, "-m", gm, "--stream", "--fit_GM",
                      "--quiet"])

@@ -128,3 +128,41 @@ def test_stream_incremental_tim(campaign, tmp_path):
     # completion order), but the line SET must match exactly
     assert sorted(li) == sorted(lr)
     assert len(li) == len(res.TOA_list)
+
+
+def test_stream_scattering_matches_gettoas(tmp_path):
+    """Streamed scattering fits (fit_scat + auto seed) must reproduce
+    GetTOAs' scattering results and emit the same TOA flag set."""
+    model = default_test_model(1500.0)
+    gmodel = str(tmp_path / "model.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files = []
+    for i in range(2):
+        path = str(tmp_path / f"sc{i}.fits")
+        make_fake_pulsar(model, PAR, outfile=path, nsub=2, nchan=32,
+                         nbin=256, nu0=1500.0, bw=800.0, tsub=60.0,
+                         phase=0.02 * i, dDM=1e-4 * i, t_scat=3e-4,
+                         alpha=-4.0, start_MJD=MJD(55200 + 10 * i, 0.1),
+                         noise_stds=0.02, dedispersed=False, quiet=True,
+                         rng=300 + i)
+        files.append(path)
+    res = stream_wideband_TOAs(files, gmodel, nsub_batch=4, fit_scat=True,
+                               scat_guess="auto", quiet=True)
+    gt = GetTOAs(files, gmodel, quiet=True)
+    gt.get_TOAs(fit_scat=True, scat_guess="auto", quiet=True, max_iter=25)
+    assert len(res.TOA_list) == 4
+    by_key = {(t.archive, t.flags["subint"]): t for t in res.TOA_list}
+    for i, f in enumerate(files):
+        for j, t_ref in enumerate(gt.TOA_list[i * 2:(i + 1) * 2]):
+            t = by_key[(f, t_ref.flags["subint"])]
+            for key in ("scat_time", "log10_scat_time", "scat_ref_freq",
+                        "scat_ind", "scat_ind_err"):
+                assert key in t.flags, key
+                assert t.flags[key] == pytest.approx(
+                    t_ref.flags[key], rel=0.05, abs=1e-3), key
+            # injected tau is 3e-4 s; scat_time flag is microseconds at
+            # scat_ref_freq with index alpha
+            expect_us = 3e-4 * 1e6 * (t.flags["scat_ref_freq"]
+                                      / 1500.0) ** t.flags["scat_ind"]
+            assert t.flags["scat_time"] == pytest.approx(expect_us,
+                                                         rel=0.15)
