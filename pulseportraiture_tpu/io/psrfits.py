@@ -145,6 +145,7 @@ class Archive:
         self.periods = np.asarray(periods, np.float64)
         self.psrparam = list(psrparam) if psrparam else []
         self.polyco = polyco
+        self._par_angs_from_file = par_angs is not None
         self.par_angs = (np.asarray(par_angs, np.float64)
                          if par_angs is not None
                          else np.zeros(len(self.amps)))
@@ -223,11 +224,76 @@ class Archive:
                 [1.0 / polyco_phase_freq(self.polyco, e)[1] for e in eps])
         return self.periods.copy()
 
+    def _source_coords(self):
+        """(RA, DEC) [deg] from primary cards or PSRPARAM, else None."""
+        from ..utils import ephem
+
+        ra = self.primary.get("RA")
+        dec = self.primary.get("DEC")
+        if not ra or not dec:
+            ra = _coord_param(self.psrparam, "RAJ")
+            dec = _coord_param(self.psrparam, "DECJ")
+        if not ra or not dec:
+            return None
+        try:
+            return ephem.parse_ra(ra), ephem.parse_dec(dec)
+        except ValueError:
+            return None
+
+    def _site_itrf(self):
+        """Observatory ITRF (x, y, z) [m]: ANT_X/Y/Z primary cards when
+        present, else the telescope-name lookup table; None if unknown
+        or the 'telescope' is the barycentre."""
+        from ..utils import ephem
+
+        try:
+            xyz = [float(self.primary[k]) for k in
+                   ("ANT_X", "ANT_Y", "ANT_Z")]
+            if any(v != 0.0 for v in xyz):
+                return np.asarray(xyz, np.float64)
+        except (KeyError, TypeError, ValueError):
+            pass
+        return ephem.telescope_itrf(self.get_telescope())
+
     def doppler_factors(self):
-        """nu_source/nu_observed per subint.  PSRFITS stores no doppler
-        column; without an ephemeris engine this is 1.0 (synthetic and
-        barycentred archives), matching make_fake_pulsar's assumption."""
-        return np.ones(self.nsub)
+        """nu_source/nu_observed per subint (reference pplib.py:2795-
+        2805, PSRCHIVE ephemeris convention: > 1 for increasing
+        distance).  Computed from the analytic barycentric Earth-
+        velocity model in utils/ephem.py when source coordinates are
+        known; 1.0 for explicitly barycentred archives (PPTBARY card,
+        written by the synthetic-archive generator), barycentre 'site'
+        codes, or archives with no coordinates."""
+        from ..utils import ephem
+
+        if self.primary.get("PPTBARY"):
+            return np.ones(self.nsub)
+        # any barycentre alias (SSB, BAT, BARYCENTER, '@', ...) — the
+        # site-code table canonicalizes them all to tempo code '@'
+        tel = str(self.get_telescope())
+        if tel.upper() in ("@", "BAT") or telescope_code(tel) == "@":
+            return np.ones(self.nsub)
+        coords = self._source_coords()
+        if coords is None:
+            return np.ones(self.nsub)
+        mjds = np.array([e.to_float() for e in self.epochs()])
+        return ephem.doppler_factors(mjds, coords[0], coords[1],
+                                     self._site_itrf())
+
+    def parallactic_angles(self):
+        """Per-subint parallactic angle [deg]: the PAR_ANG SUBINT
+        column when the file carries one, else computed from the site
+        geometry (reference pplib.py:2806-2808 via PSRCHIVE 'fix
+        pointing'), else zeros."""
+        from ..utils import ephem
+
+        if self._par_angs_from_file:
+            return self.par_angs.copy()
+        coords = self._source_coords()
+        site = self._site_itrf()
+        if coords is None or site is None:
+            return np.zeros(self.nsub)
+        mjds = np.array([e.to_float() for e in self.epochs()])
+        return ephem.parallactic_angles(mjds, coords[0], coords[1], site)
 
     def get_weights(self):
         return self.weights.copy()
@@ -344,6 +410,7 @@ class Archive:
             psrparam=list(self.psrparam),
             polyco=copy.deepcopy(self.polyco),
             par_angs=self.par_angs.copy(), filename=self.filename)
+        arch._par_angs_from_file = self._par_angs_from_file
         return arch
 
     def unload(self, path):
@@ -477,6 +544,26 @@ def _param_value(lines, key):
     return None
 
 
+def _coord_param(lines, key):
+    """RAJ/DECJ string from PSRPARAM lines: a single 'hh:mm:ss.s' (or
+    decimal) token, or space-separated sexagesimal 'hh mm ss.s' (three
+    tokens, distinguished from a trailing fit-flag/error by the first
+    two being integers)."""
+    for line in lines:
+        parts = line.split()
+        if parts and parts[0] == key and len(parts) > 1:
+            if (len(parts) >= 4 and ":" not in parts[1]
+                    and parts[1].lstrip("+-").isdigit()
+                    and parts[2].isdigit()):
+                try:
+                    float(parts[3])
+                    return " ".join(parts[1:4])
+                except ValueError:
+                    pass
+            return parts[1]
+    return None
+
+
 def parse_parfile(path_or_lines):
     """Parse a tempo-style parfile into {PARAM: string value}."""
     if isinstance(path_or_lines, (list, tuple)):
@@ -512,7 +599,10 @@ def write_archive_file(path, arch):
     cols["TSUBINT"] = arch.tsubints.astype(">f8")
     cols["OFFS_SUB"] = arch.offs_subs.astype(">f8")
     cols["PERIOD"] = arch.periods.astype(">f8")
-    cols["PAR_ANG"] = arch.par_angs.astype(">f8")
+    if arch.par_angs.any() or arch._par_angs_from_file:
+        # an all-zero placeholder column would shadow the geometric
+        # computation in Archive.parallactic_angles() on re-read
+        cols["PAR_ANG"] = arch.par_angs.astype(">f8")
     cols["DAT_FREQ"] = arch.freqs_table.astype(">f8")
     cols["DAT_WTS"] = arch.weights.astype(">f4")
     cols["DAT_OFFS"] = offs.reshape(nsub, npol * nchan).astype(">f4")
@@ -676,7 +766,7 @@ def load_data(filename, state=None, dedisperse=False, dededisperse=False,
     nsub = arch.nsub
     integration_length = arch.integration_length()
     doppler_factors = arch.doppler_factors()
-    parallactic_angles = arch.par_angs.copy()
+    parallactic_angles = arch.parallactic_angles()
     if pscrunch:
         arch.pscrunch()
     state = arch.get_state()

@@ -95,6 +95,12 @@ def _from_tempo2():
                     name, code = parts[3], parts[4]
                     canonical[name.upper()] = (code, name.lower())
                     table[name.upper()] = (code, name.lower())
+                    try:
+                        _TEMPO2_ITRF[name.lower()] = (
+                            float(parts[0]), float(parts[1]),
+                            float(parts[2]))
+                    except ValueError:
+                        pass
         if os.path.isfile(alias_path):
             with open(alias_path) as f:
                 for line in f:
@@ -109,6 +115,10 @@ def _from_tempo2():
     return table
 
 
+# canonical name (lower) -> ITRF (x, y, z) [m], filled from a TEMPO2
+# runtime's observatories.dat columns 1-3 when $TEMPO2 is set
+_TEMPO2_ITRF = {}
+
 telescope_code_dict = {**_BUILTIN, **_from_tempo2()}
 
 
@@ -119,3 +129,18 @@ def telescope_code(name):
         return telescope_code_dict[str(name).upper()][0]
     except KeyError:
         return str(name)
+
+
+def canonical_name(name):
+    """Canonical tempo2 site name for a telescope name/alias, or None."""
+    try:
+        return telescope_code_dict[str(name).upper()][1]
+    except KeyError:
+        return None
+
+
+def tempo2_itrf(name):
+    """ITRF (x, y, z) [m] from a TEMPO2 runtime's observatory table,
+    or None when $TEMPO2 is unset or the site is unknown."""
+    canon = canonical_name(name)
+    return _TEMPO2_ITRF.get(canon or str(name).lower())

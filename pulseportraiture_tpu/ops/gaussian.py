@@ -10,11 +10,16 @@ pptoaslib.py:22-58 (gaussian_profile_FT), pptoaslib.py:124-192
 (instrumental response).
 """
 
+import math
+
 import jax.numpy as jnp
 
 from .phasor import cexp
 
-FWHM2SIGMA = 1.0 / (8.0 * jnp.log(2.0)) ** 0.5  # sigma = FWHM * this
+# host math, NOT jnp: a module-level jnp computation would initialize
+# the default (TPU) backend at import time, before callers can force a
+# CPU platform (e.g. the driver's dryrun_multichip)
+FWHM2SIGMA = 1.0 / (8.0 * math.log(2.0)) ** 0.5  # sigma = FWHM * this
 
 
 def gaussian_profile(nbin, loc, wid, amp=1.0, dtype=jnp.float64):
@@ -26,7 +31,7 @@ def gaussian_profile(nbin, loc, wid, amp=1.0, dtype=jnp.float64):
     its |z|<20 cutoff (XLA computes the exp everywhere; underflow to 0
     is the same result).
     """
-    phases = (jnp.arange(nbin, dtype=dtype) + 0.5 * 0.0) / nbin
+    phases = jnp.arange(nbin, dtype=dtype) / nbin
     d = phases - loc
     d = jnp.mod(d + 0.5, 1.0) - 0.5
     wid = jnp.maximum(jnp.abs(wid), jnp.finfo(dtype).tiny ** 0.5)

@@ -68,7 +68,7 @@ def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
                      scales=1.0, dedispersed=False, t_scat=0.0,
                      alpha=scattering_alpha, scint=False, xs=None, Cs=None,
                      nu_DM=np.inf, state="Stokes", telescope="GBT",
-                     quiet=False, rng=None):
+                     quiet=False, rng=None, barycentred=True):
     """Generate a fake fold-mode PSRFITS archive with known injected
     parameters and write it to ``outfile``.  Returns the Archive.
 
@@ -153,6 +153,12 @@ def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
         arch.primary["RA"] = str(par["RAJ"])
     if "DECJ" in par:
         arch.primary["DEC"] = str(par["DECJ"])
+    if barycentred:
+        # the injected data carry no topocentric Doppler signature, so
+        # mark the archive barycentred: Archive.doppler_factors() then
+        # returns 1.0 instead of ephemeris-computed factors.  Pass
+        # barycentred=False to test the Doppler-correction path.
+        arch.primary["PPTBARY"] = True
     if not dedispersed:
         arch.dededisperse()
     arch.unload(outfile)
