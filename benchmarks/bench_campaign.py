@@ -1,0 +1,83 @@
+"""Campaign-scale streaming benchmark (BASELINE.md config 5 shape):
+NARCH archives x NSUB subints of NCHAN x NBIN through
+stream_wideband_TOAs, end-to-end (PSRFITS IO -> raw int16 h2d ->
+on-device decode/stats/fit -> .tim assembly).
+
+The synthetic dataset is generated once into a cache directory (env
+PPT_CAMPAIGN_CACHE, default /tmp/ppt_campaign) and reused across runs —
+generation is host-bound and would otherwise dominate.
+
+Knobs via env: PPT_NARCH (default 200), PPT_NSUB (64), PPT_NCHAN (256),
+PPT_NBIN (1024).  Prints ONE JSON line like bench.py.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    import pulseportraiture_tpu  # noqa: F401
+    from pulseportraiture_tpu import config
+    config.dft_precision = "default"
+    config.cross_spectrum_dtype = "bfloat16"
+
+    import jax
+
+    from pulseportraiture_tpu.io.gmodel import write_gmodel
+    from pulseportraiture_tpu.pipeline.stream import stream_wideband_TOAs
+    from pulseportraiture_tpu.synth import default_test_model
+    from pulseportraiture_tpu.synth.archive import make_fake_pulsar
+
+    NARCH = int(os.environ.get("PPT_NARCH", 200))
+    NSUB = int(os.environ.get("PPT_NSUB", 64))
+    NCHAN = int(os.environ.get("PPT_NCHAN", 256))
+    NBIN = int(os.environ.get("PPT_NBIN", 1024))
+    PAR = {"PSR": "FAKE", "P0": 0.003, "DM": 50.0, "PEPOCH": 56000.0}
+    cache = os.environ.get("PPT_CAMPAIGN_CACHE", "/tmp/ppt_campaign")
+    tag = f"{NARCH}x{NSUB}x{NCHAN}x{NBIN}"
+    root = os.path.join(cache, tag)
+    os.makedirs(root, exist_ok=True)
+
+    mpath = os.path.join(root, "model.gmodel")
+    if not os.path.exists(mpath):
+        write_gmodel(default_test_model(1500.0), mpath, quiet=True)
+    files = []
+    t_gen = time.perf_counter()
+    for i in range(NARCH):
+        path = os.path.join(root, f"a{i:04d}.fits")
+        if not os.path.exists(path):
+            make_fake_pulsar(mpath, PAR, outfile=path, nsub=NSUB,
+                             nchan=NCHAN, nbin=NBIN, nu0=1500.0, bw=600.0,
+                             phase=0.01 * (i % 50), dDM=1e-4 * (i % 40),
+                             noise_stds=0.05, quiet=True, rng=i)
+        files.append(path)
+    t_gen = time.perf_counter() - t_gen
+
+    # warm (compile) on one archive, then measure the full campaign
+    stream_wideband_TOAs(files[:1], mpath, nsub_batch=64, quiet=True)
+    t0 = time.perf_counter()
+    res = stream_wideband_TOAs(files, mpath, nsub_batch=64, quiet=True)
+    wall = time.perf_counter() - t0
+
+    ntoa = len(res.TOA_list)
+    print(json.dumps({
+        "metric": f"streamed campaign TOAs incl. PSRFITS IO, {NARCH} "
+                  f"archives x {NSUB}sub x {NCHAN}ch x {NBIN}bin",
+        "value": round(ntoa / wall, 2),
+        "unit": "TOAs/sec",
+        "wall_s": round(wall, 2),
+        "gen_s": round(t_gen, 2),
+        "toas": ntoa,
+        "dispatches": int(res.nfit),
+        "blocked_on_device_fraction": round(float(res.fit_duration) / wall,
+                                            3),
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -51,7 +51,7 @@ def main():
 
     ports = synth(jax.random.PRNGKey(0))
     noise = jnp.full((NB, NCHAN), 0.03, DT)
-    models = jnp.broadcast_to(model, (NB, NCHAN, NBIN))
+    models = model  # shared 2-D template: one model DFT for the batch
     # data-driven tau seed (fit.portrait.estimate_tau_batch) — the
     # pipeline's scat_guess="auto"; cuts Newton evals severalfold vs
     # the neutral half-bin seed

@@ -49,10 +49,12 @@ def main():
                              quiet=True, rng=i)
             files.append(path)
 
+        # nsub_batch 64: buckets fill (and their h2d copies start, on
+        # the dispatch thread) while later archives are still loading
         # warm (compile) on one archive, then measure the full campaign
-        stream_wideband_TOAs(files[:1], mpath, quiet=True)
+        stream_wideband_TOAs(files[:1], mpath, nsub_batch=64, quiet=True)
         t0 = time.perf_counter()
-        res = stream_wideband_TOAs(files, mpath, quiet=True)
+        res = stream_wideband_TOAs(files, mpath, nsub_batch=64, quiet=True)
         wall = time.perf_counter() - t0
 
     ntoa = len(res.TOA_list)

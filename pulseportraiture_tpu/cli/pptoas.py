@@ -113,7 +113,8 @@ def main(argv=None):
 
         res = stream_wideband_TOAs(
             args.datafiles, args.modelfile, fit_DM=args.fit_DM,
-            nu_ref_DM=nu_ref_DM, DM0=args.DM0, bary=args.bary,
+            nu_ref_DM=nu_ref_DM, nu_ref_tau=args.nu_ref_tau,
+            DM0=args.DM0, bary=args.bary,
             tscrunch=args.tscrunch, fit_scat=args.fit_scat,
             log10_tau=args.log10_tau, scat_guess=scat_guess,
             fix_alpha=args.fix_alpha, addtnl_toa_flags=addtnl,

@@ -244,6 +244,135 @@ def _cgh_fast(theta, X, S0inv, cvec, gvec):
     return _cgh_tail(C, C1, C2, S0inv, cvec, gvec, dt)
 
 
+def _cgh_scatter(theta, X, M2, freqs, nu_fit, cvec, gvec, log10_tau):
+    """(f, grad5, hess5) of chi2' with the scattering kernel active, in
+    ONE fused pass over the cross-spectrum — the analytic replacement
+    for value_and_grad + jax.hessian re-evaluation (which re-read X
+    ~10x per Newton step).
+
+    Chain structure (reference pptoaslib.py:231-561, re-derived):
+      t_n   = phi + c_n DM + g_n GM            (phasor path)
+      tau_n = T(theta3) (nu_n/nu_fit)^alpha    (kernel path)
+      B_k   = 1/(1 + 2 pi i tau_n k),  dB/dtau = -2 pi i k B^2
+              (equivalently the reference's B(B-1)/tau,
+               pptoaslib.py:344-356),  d2B/dtau2 = -8 pi^2 k^2 B^3
+      C_n   = sum_k Re[X conj(B) e^{2 pi i t k}],  S_n = sum_k M2 |B|^2
+      chi2' = -sum_n C_n^2 / S_n
+
+    Nine k-reductions per channel feed exact 5x5 curvature; X/M2 must
+    already include any instrumental response (X' = X conj(ir),
+    M2' = M2 |ir|^2 — the response factors out of every derivative).
+    """
+    dt = M2.dtype
+    nharm = X.shape[-1]
+    k = jnp.arange(nharm, dtype=dt)
+    twopi = 2.0 * jnp.pi
+
+    # kernel path
+    r = (freqs / nu_fit).astype(dt)
+    logr = jnp.log(r)
+    if log10_tau:
+        T = 10.0 ** theta[3]
+        tau_n = T * r ** theta[4]
+        ln10 = jnp.log(10.0).astype(dt)
+        s1 = ln10 * tau_n
+        s11 = ln10 ** 2.0 * tau_n
+        s12 = ln10 * tau_n * logr
+    else:
+        T = theta[3]
+        ra = r ** theta[4]
+        tau_n = T * ra
+        s1 = ra
+        s11 = jnp.zeros_like(ra)
+        s12 = ra * logr
+    s2 = tau_n * logr
+    s22 = tau_n * logr ** 2.0
+
+    # phasor path
+    t_n = theta[0] + cvec * theta[1] + gvec * theta[2]
+
+    beta = twopi * tau_n  # (nchan,)
+    bk = beta[:, None] * k  # (nchan, nharm)
+    q = 1.0 / (1.0 + bk * bk)  # |B|^2
+    # conj(B) = (1 + i bk) q
+    cBr = q
+    cBi = bk * q
+    ang = twopi * t_n[:, None] * k
+    c = jnp.cos(ang)
+    s = jnp.sin(ang)
+    er = X.real * c - X.imag * s  # Re[X e]
+    ei = X.real * s + X.imag * c  # Im[X e]
+    # U = X conj(B) e
+    Ur = er * cBr - ei * cBi
+    Ui = er * cBi + ei * cBr
+    # U conj(B)
+    UBr = Ur * cBr - Ui * cBi
+    UBi = Ur * cBi + Ui * cBr
+    # U conj(B)^2 (real part only needed)
+    UB2r = UBr * cBr - UBi * cBi
+
+    k2 = k * k
+    C = jnp.sum(Ur, axis=-1)
+    C_t = -twopi * jnp.sum(k * Ui, axis=-1)
+    C_tt = -(twopi ** 2.0) * jnp.sum(k2 * Ur, axis=-1)
+    C_tau = -twopi * jnp.sum(k * UBi, axis=-1)
+    C_taut = -(twopi ** 2.0) * jnp.sum(k2 * UBr, axis=-1)
+    C_tautau = -2.0 * twopi ** 2.0 * jnp.sum(k2 * UB2r, axis=-1)
+
+    M2q = M2 * q
+    S = jnp.sum(M2q, axis=-1)
+    Sk2q2 = jnp.sum(M2q * q * k2, axis=-1)
+    Sk4q3 = jnp.sum(M2q * (q * k2) ** 2.0, axis=-1)
+    S_tau = -2.0 * twopi ** 2.0 * tau_n * Sk2q2
+    S_tautau = (-2.0 * twopi ** 2.0 * Sk2q2
+                + 8.0 * twopi ** 4.0 * tau_n ** 2.0 * Sk4q3)
+
+    # chain to (phi, DM, GM, theta3, alpha)
+    ones = jnp.ones_like(cvec)
+    zeros = jnp.zeros_like(cvec)
+    Jt = jnp.stack([ones, cvec, gvec, zeros, zeros])   # (5, nchan)
+    Jtau = jnp.stack([zeros, zeros, zeros, s1, s2])    # (5, nchan)
+    Cp = Jt * C_t + Jtau * C_tau                       # (5, nchan)
+    Sp = Jtau * S_tau
+
+    good = S > 0.0
+    Sinv = jnp.where(good, 1.0 / jnp.where(good, S, 1.0), 0.0)
+    CS = C * Sinv
+    f = -jnp.sum(C * CS)
+
+    g = -2.0 * (Cp @ CS) + (Sp @ CS ** 2.0)
+
+    # Hessian: per-channel scalar weights contracted with the Jacobian
+    # outer products (einsum keeps it one (5,5,nchan)-free assembly)
+    w_tt = -2.0 * (C * C_tt) * Sinv
+    w_taut = -2.0 * (C * C_taut) * Sinv
+    w_tautau = -2.0 * (C * C_tautau) * Sinv
+    H = (
+        jnp.einsum("n,in,jn->ij", w_tt, Jt, Jt)
+        + jnp.einsum("n,in,jn->ij", w_taut, Jt, Jtau)
+        + jnp.einsum("n,in,jn->ij", w_taut, Jtau, Jt)
+        + jnp.einsum("n,in,jn->ij", w_tautau, Jtau, Jtau)
+    )
+    # -2 C_p C_q / S
+    H = H - 2.0 * jnp.einsum("in,n,jn->ij", Cp, Sinv, Cp)
+    # + 2 C (C_p S_q + C_q S_p) / S^2
+    CpSq = jnp.einsum("in,n,jn->ij", Cp, 2.0 * CS * Sinv, Sp)
+    H = H + CpSq + CpSq.T
+    # + C^2 S_pq / S^2 - 2 C^2 S_p S_q / S^3
+    w_sp = CS ** 2.0
+    H = H + jnp.einsum("n,in,jn->ij", w_sp * S_tautau, Jtau, Jtau)
+    H = H - 2.0 * jnp.einsum("in,n,jn->ij", Sp, w_sp * Sinv, Sp)
+    # second-derivative terms of the tau(theta3, alpha) chain itself:
+    # dC/dtau_n * d2tau_n/(dp dq) and dS/dtau_n * d2tau_n/(dp dq)
+    chain_C = -2.0 * CS * C_tau + w_sp * S_tau
+    h33 = jnp.sum(chain_C * s11)
+    h34 = jnp.sum(chain_C * s12)
+    h44 = jnp.sum(chain_C * s22)
+    H = H.at[3, 3].add(h33).at[3, 4].add(h34).at[4, 3].add(h34) \
+         .at[4, 4].add(h44)
+    return f, g, H
+
+
 def _initial_phase_guess(X, cvec, DM0, oversamp=2):
     """Dense-CCF phase guess of the frequency-summed, DM0-derotated
     data against the frequency-summed model (the reference's
@@ -467,14 +596,18 @@ def _fit_portrait_core(
 
     if scatter:
         M2 = (mFT.real**2 + mFT.imag**2) * w
+        # the instrumental response factors out of every tau/phase
+        # derivative, so fold it into the spectra once (X' = X conj(ir),
+        # M2' = M2 |ir|^2) and run the pure-scattering chain
+        if ir is not None:
+            Xs = X * jnp.conj(ir)
+            M2s_ = M2 * (ir.real**2.0 + ir.imag**2.0)
+        else:
+            Xs, M2s_ = X, M2
 
         def cgh(theta):
-            obj = lambda th: _chi2_prime_X(
-                th, X, M2, freqs, P, nu_fit, ir, log10_tau
-            )
-            f, g = jax.value_and_grad(obj)(theta)
-            H = jax.hessian(obj)(theta)
-            return f, g, H
+            return _cgh_scatter(theta, Xs, M2s_, freqs, nu_fit, cvec,
+                                gvec, log10_tau)
 
     else:
         S0 = jnp.sum((mFT.real**2 + mFT.imag**2) * w, axis=-1)
@@ -810,13 +943,19 @@ def fit_portrait_batch_fast(
         theta0 = jnp.zeros((nb, 5), dt)
         seed_derotate = False
     else:
-        # host-side check (theta0 is concrete here): an all-zero DM
-        # guess makes the seed's derotation phasor the identity, and
-        # skipping it saves a full pass over the cross-spectrum
-        import numpy as _np
-
         theta0 = jnp.asarray(theta0)
-        seed_derotate = bool(_np.any(_np.asarray(theta0[..., 1]) != 0.0))
+        if isinstance(theta0, jax.core.Tracer):
+            # traced caller: can't inspect values without forcing a
+            # sync/abstract-value error; keep the derotation pass
+            seed_derotate = True
+        else:
+            # host-side check on the concrete seed: an all-zero DM
+            # guess makes the seed's derotation phasor the identity,
+            # and skipping it saves a pass over the cross-spectrum
+            import numpy as _np
+
+            seed_derotate = bool(
+                _np.any(_np.asarray(theta0[..., 1]) != 0.0))
     nu_out_val = jnp.full((nb,), -1.0 if nu_out is None else nu_out, dt)
     if chan_masks is None:
         chan_masks = jnp.ones(ports.shape[:2], dt)
@@ -1110,38 +1249,69 @@ def fit_portrait_batch(
     f64 inputs are canonicalized to f32 on TPU backends: the complex
     engine follows the input dtype, and c128 spectra do not compile on
     any TPU runtime.  Every pipeline call site inherits this guard.
+
+    The whole preamble (weights, DFTs, casts) compiles into ONE program
+    with the vmapped core: eager per-op dispatch costs ~25 ms per op on
+    tunneled runtimes, which at ~25 wrapper ops used to dwarf the fit
+    itself.
     """
     ports = _canonical_real_dtype(jnp.asarray(ports))
     nb = ports.shape[0]
-    nbin = ports.shape[-1]
     if use_scatter is None:
         use_scatter = derive_use_scatter(fit_flags, log10_tau, theta0)
-    w = make_weights(noise_stds, nbin, chan_masks, dtype=ports.dtype)
-    dFT = rfft_c(ports)
-    mFT = rfft_c(jnp.asarray(models).astype(ports.dtype))
-    freqs = jnp.asarray(freqs, w.dtype)
+    models = jnp.asarray(models)
+    m_ax = 0 if models.ndim == 3 else None
+    freqs = jnp.asarray(freqs)
     f_ax = 0 if freqs.ndim == 2 else None
-    P = jnp.asarray(P, w.dtype)
+    P = jnp.asarray(P)
     p_ax = 0 if P.ndim == 1 else None
-    nu_fit = jnp.asarray(nu_fit, w.dtype)
+    nu_fit = jnp.asarray(nu_fit)
     nf_ax = 0 if nu_fit.ndim == 1 else None
     if theta0 is None:
-        theta0 = jnp.zeros((nb, 5), w.dtype)
-    else:
-        theta0 = jnp.asarray(theta0, w.dtype)
-    nu_out_val = jnp.full((nb,), -1.0 if nu_out is None else nu_out, w.dtype)
-
+        theta0 = jnp.zeros((nb, 5), ports.dtype)
+    nu_out_val = -1.0 if nu_out is None else nu_out
     use_ir = ir_FT is not None
-    core = jax.vmap(
-        partial(
-            _fit_portrait_core,
-            fit_flags=FitFlags(*[bool(f) for f in fit_flags]),
-            log10_tau=log10_tau,
-            max_iter=max_iter,
-            use_ir=use_ir,
-            use_scatter=use_scatter,
-        ),
-        in_axes=(0, 0, 0, f_ax, p_ax, nf_ax, 0, 0, None),
-    )
-    ir_arg = jnp.asarray(ir_FT, w.dtype) if use_ir else None
-    return core(dFT, mFT, w, freqs, P, nu_fit, nu_out_val, theta0, ir_arg)
+    fn = _complex_batch_fn(
+        FitFlags(*[bool(f) for f in fit_flags]), bool(log10_tau),
+        int(max_iter), bool(use_scatter), use_ir, m_ax, f_ax, p_ax,
+        nf_ax)
+    ir_arg = ir_FT if use_ir else None
+    nu_out_arr = jnp.broadcast_to(
+        jnp.asarray(nu_out_val, ports.dtype), (nb,))
+    return fn(ports, models, jnp.asarray(noise_stds),
+              None if chan_masks is None else jnp.asarray(chan_masks),
+              freqs, P, nu_fit, nu_out_arr, jnp.asarray(theta0), ir_arg)
+
+
+@lru_cache(maxsize=None)
+def _complex_batch_fn(fit_flags, log10_tau, max_iter, use_scatter,
+                      use_ir, m_ax, f_ax, p_ax, nf_ax):
+    """Cached single-program complex-engine batch fit: weights + DFTs +
+    vmapped _fit_portrait_core compiled together."""
+
+    def run(ports, models, noise_stds, chan_masks, freqs, P, nu_fit,
+            nu_out_arr, theta0, ir_FT):
+        nbin = ports.shape[-1]
+        dt = ports.dtype
+        w = make_weights(noise_stds, nbin, chan_masks, dtype=dt)
+        dFT = rfft_c(ports)
+        mFT = rfft_c(models.astype(dt))
+        core = jax.vmap(
+            partial(
+                _fit_portrait_core,
+                fit_flags=fit_flags,
+                log10_tau=log10_tau,
+                max_iter=max_iter,
+                use_ir=use_ir,
+                use_scatter=use_scatter,
+            ),
+            in_axes=(0, m_ax, 0, f_ax, p_ax, nf_ax, 0, 0, None),
+        )
+        ir_arg = ir_FT.astype(jnp.complex64 if dt == jnp.float32
+                              else jnp.complex128) if use_ir else None
+        return core(dFT, mFT, w,
+                    jnp.asarray(freqs, dt), jnp.asarray(P, dt),
+                    jnp.asarray(nu_fit, dt), nu_out_arr.astype(dt),
+                    theta0.astype(dt), ir_arg)
+
+    return jax.jit(run, static_argnames=())

@@ -30,10 +30,17 @@ SECPERDAY = 86400.0
 # numpy kernels used at load time (device-free mirrors of ops/)
 # --------------------------------------------------------------------------
 
+def _as_float(x):
+    """float64 view/cast, except float32 input stays float32 (the
+    streaming loader's reduced-precision mode)."""
+    x = np.asarray(x)
+    return x if x.dtype == np.float32 else np.asarray(x, np.float64)
+
+
 def noise_std_ps(data, frac=0.25):
     """Off-pulse noise std from the top-``frac`` power spectrum (numpy
     mirror of ops.noise.get_noise_PS; reference pplib.py:2312-2338)."""
-    data = np.asarray(data, np.float64)
+    data = _as_float(data)
     nbin = data.shape[-1]
     X = np.fft.rfft(data, axis=-1)
     kc = int((1.0 - frac) * X.shape[-1])
@@ -44,14 +51,17 @@ def noise_std_ps(data, frac=0.25):
 def profile_snr(profile, noise=None, fudge=3.25):
     """Equivalent-width S/N (numpy mirror of ops.noise.get_SNR;
     reference pplib.py:2376-2395)."""
-    p = np.asarray(profile, np.float64)
+    p = _as_float(profile)
     p = p - np.median(p, axis=-1, keepdims=True)
     if noise is None:
         noise = noise_std_ps(p)
     noise = np.maximum(np.asarray(noise, np.float64), 1e-30)
+    # f64-accumulated sums: the equivalent-width ratio is a difference
+    # of large sums even in the f32 loader mode
+    psum = np.abs(p.sum(axis=-1, dtype=np.float64))
     peak = np.maximum(np.abs(p).max(axis=-1), 1e-30)
-    weq = np.maximum(np.abs(p.sum(axis=-1)) / peak, 1.0)
-    return np.abs(p.sum(axis=-1)) / (noise * np.sqrt(weq)) / fudge
+    weq = np.maximum(psum / peak, 1.0)
+    return psum / (noise * np.sqrt(weq)) / fudge
 
 
 def rotate_phase(data, turns):
@@ -74,22 +84,40 @@ def dm_delays(DM, P, freqs, nu_ref):
     return Dconst * DM * (freqs ** -2.0 - float(nu_ref) ** -2.0) / P
 
 
-def baseline_window_stats(profiles, frac=0.15):
+def baseline_window_stats(profiles, frac=0.15, need_var=True):
     """(mean, var) of the quietest duty-cycle window of each profile —
     the PSRCHIVE 'minimum window' baseline estimator used by
-    remove_baseline / baseline_stats."""
-    p = np.asarray(profiles, np.float64)
+    remove_baseline / baseline_stats.
+
+    Circular rolling windows via f64-accumulated cumulative sums (one
+    O(nbin) pass for means, one more for squares when need_var) —
+    equivalent to an FFT-correlation formulation but much cheaper on
+    host, which matters because this runs per archive load in
+    streaming campaigns.  need_var=False (remove_baseline) skips the
+    squares pass and returns var=None."""
+    p = _as_float(profiles)
     nbin = p.shape[-1]
     w = max(1, int(round(frac * nbin)))
-    kern = np.zeros(nbin)
-    kern[:w] = 1.0 / w
-    kern_FT = np.fft.rfft(kern)
-    means = np.fft.irfft(np.fft.rfft(p, axis=-1) * np.conj(kern_FT),
-                         n=nbin, axis=-1)
-    sq_means = np.fft.irfft(np.fft.rfft(p ** 2, axis=-1) * np.conj(kern_FT),
-                            n=nbin, axis=-1)
+
+    def _windowed_means(x):
+        # circular window sums from one cumsum: the first nbin-w+1
+        # windows are direct differences; wrapped windows add the total
+        cs = np.cumsum(x, axis=-1, dtype=np.float64)
+        total = cs[..., -1:]
+        out = np.empty_like(cs)
+        out[..., 0] = cs[..., w - 1]
+        out[..., 1:nbin - w + 1] = (cs[..., w:] - cs[..., :nbin - w])
+        i = np.arange(nbin - w + 1, nbin)
+        out[..., nbin - w + 1:] = (total - cs[..., i - 1]
+                                   + cs[..., i + w - 1 - nbin])
+        return out / w  # mean of window starting at bin i (circular)
+
+    means = _windowed_means(p)
     imin = means.argmin(axis=-1)
     mean = np.take_along_axis(means, imin[..., None], axis=-1)[..., 0]
+    if not need_var:
+        return mean, None
+    sq_means = _windowed_means(p * p)
     var = np.take_along_axis(sq_means, imin[..., None], axis=-1)[..., 0] \
         - mean ** 2
     return mean, np.maximum(var, 0.0)
@@ -137,7 +165,8 @@ class Archive:
                  par_angs=None, filename=""):
         self.primary = primary
         self.subint_header = subint_header
-        self.amps = np.asarray(amps, np.float64)
+        # float64 canonical; float32 preserved (streaming loader mode)
+        self.amps = _as_float(amps)
         self.weights = np.asarray(weights, np.float64)
         self.freqs_table = np.asarray(freqs, np.float64)  # (nsub, nchan)
         self.tsubints = np.asarray(tsubints, np.float64)
@@ -348,8 +377,8 @@ class Archive:
             self.amps[isub] = rotate_phase(self.amps[isub], sign * -delays)
 
     def remove_baseline(self):
-        mean, _ = baseline_window_stats(self.amps)
-        self.amps = self.amps - mean[..., None]
+        mean, _ = baseline_window_stats(self.amps, need_var=False)
+        self.amps -= mean.astype(self.amps.dtype)[..., None]
 
     def baseline_stats(self):
         return baseline_window_stats(self.amps)
@@ -389,7 +418,7 @@ class Archive:
         return self.amps.copy()
 
     def set_data(self, amps):
-        amps = np.asarray(amps, np.float64)
+        amps = _as_float(amps)
         if amps.ndim != 4:
             raise ValueError("amps must be [nsub, npol, nchan, nbin]")
         self.amps = amps.copy()
@@ -427,16 +456,30 @@ class Archive:
 # Reading
 # --------------------------------------------------------------------------
 
-def read_archive(path):
+def read_archive(path, dtype=np.float64, decode=True):
     """Parse a PSRFITS fold-mode file into an Archive (scales, offsets
     applied; weights kept separate).
+
+    dtype: float64 (canonical) or float32 — the streaming campaign
+    loader decodes straight to f32, halving host memory traffic for
+    data that feeds the f32 fast fit anyway.
+
+    decode=False (raw streaming mode): requires an int16 DATA column;
+    the Archive's ``amps`` becomes a read-only zero placeholder and the
+    undecoded samples are attached as ``raw_data`` (nsub, npol, nchan,
+    nbin) native-endian int16 with ``raw_scl``/``raw_offs`` (nsub,
+    npol, nchan) float32 — the streaming driver ships these to the
+    accelerator and decodes there, halving host->device bytes.  Raises
+    ValueError for non-int16 layouts (caller falls back to decoding).
 
     When the native decoder (io/native.py) is available, the DATA
     column is decoded straight from the wire bytes with DAT_SCL /
     DAT_OFFS fused in (one threaded pass, no float64 intermediates);
     otherwise the pure-numpy path below is the reference behavior."""
-    use_native = native.available()
-    hdus = fitsio.read_fits(path, defer=("DATA",) if use_native else ())
+    dtype = np.dtype(dtype).type
+    use_native = native.available() and decode
+    defer = ("DATA",) if (use_native or not decode) else ()
+    hdus = fitsio.read_fits(path, defer=defer)
     primary = hdus[0].header
     try:
         subint = fitsio.get_hdu(hdus, "SUBINT")
@@ -454,7 +497,24 @@ def read_archive(path):
                                np.zeros((nsub, npol * nchan))),
                       np.float64).reshape(nsub, npol, nchan)
     _SAMP_BYTES = {"I": 2, "B": 1, "E": 4}
-    if use_native:
+    raw_data = None
+    if not decode:
+        col_off, code, repeat = subint.layout["DATA"]
+        nbin = int(hdr.get("NBIN", 0)) or repeat // (npol * nchan)
+        if (code != "I" or npol * nchan * nbin != repeat
+                or col_off + repeat * 2 > subint.row_stride
+                or len(subint.raw) < nsub * subint.row_stride):
+            raise ValueError(
+                f"{path}: raw streaming mode needs a consistent int16 "
+                "DATA column")
+        rows = np.frombuffer(subint.raw, np.uint8)[
+            : nsub * subint.row_stride].reshape(nsub, subint.row_stride)
+        col = np.ascontiguousarray(rows[:, col_off:col_off + repeat * 2])
+        # one byteswap/memcpy pass; no float decode anywhere on host
+        raw_data = col.view(">i2").astype(np.int16).reshape(
+            nsub, npol, nchan, nbin)
+        amps = np.broadcast_to(np.float32(0.0), raw_data.shape)
+    elif use_native:
         col_off, code, repeat = subint.layout["DATA"]
         nbin = int(hdr.get("NBIN", 0)) or repeat // (npol * nchan)
         samp = _SAMP_BYTES.get(code)
@@ -471,7 +531,7 @@ def read_archive(path):
         amps = native.decode_fused(
             subint.raw, nsub, subint.row_stride, col_off, code,
             npol, nchan, nbin, scl=scl, offs=offs,
-            dtype=np.float64) if consistent else None
+            dtype=dtype) if consistent else None
     else:
         amps = None
     if amps is None:  # pure-numpy reference path
@@ -486,11 +546,12 @@ def read_archive(path):
                 : nsub * subint.row_stride].reshape(nsub, subint.row_stride)
             col = np.ascontiguousarray(
                 rows[:, col_off:col_off + width]).view(samp_dt)
-            cols["DATA"] = col.astype(np.float64)
+            cols["DATA"] = col.astype(dtype)
         nbin = int(hdr.get("NBIN", 0)) or cols["DATA"].shape[-1]
-        raw = np.asarray(cols["DATA"], np.float64).reshape(
+        raw = np.asarray(cols["DATA"], dtype).reshape(
             nsub, npol, nchan, nbin)
-        amps = raw * scl[..., None] + offs[..., None]
+        amps = raw * scl[..., None].astype(dtype) \
+            + offs[..., None].astype(dtype)
     weights = np.asarray(cols.get("DAT_WTS", np.ones((nsub, nchan))),
                          np.float64).reshape(nsub, nchan)
     freqs = np.asarray(cols["DAT_FREQ"], np.float64).reshape(nsub, nchan)
@@ -528,6 +589,10 @@ def read_archive(path):
     arch = Archive(primary, hdr, amps, weights, freqs, tsub, offs_sub,
                    periods, psrparam=psrparam, polyco=polyco,
                    par_angs=par_ang, filename=str(path))
+    if raw_data is not None:
+        arch.raw_data = raw_data
+        arch.raw_scl = scl.astype(np.float32)
+        arch.raw_offs = offs.astype(np.float32)
     if polyco is not None and "PERIOD" not in cols:
         arch.periods = arch.folding_periods()
     return arch
@@ -737,12 +802,13 @@ def unload_new_archive(amps, arch, path, DM=None, dmc=0, weights=None,
 def load_data(filename, state=None, dedisperse=False, dededisperse=False,
               tscrunch=False, pscrunch=False, fscrunch=False,
               rm_baseline=True, flux_prof=False, refresh_arch=False,
-              return_arch=True, quiet=False):
+              return_arch=True, quiet=False, dtype=np.float64):
     """Load a PSRFITS archive into the 36-key DataBunch the whole
     framework consumes.  Same signature, keys, and semantics as the
     reference's load_data (pplib.py:2749-2915), implemented without
-    PSRCHIVE."""
-    arch = read_archive(filename)
+    PSRCHIVE.  dtype float32 decodes/processes the data cube in single
+    precision (streaming campaign mode)."""
+    arch = read_archive(filename, dtype=dtype)
     source = arch.get_source()
     if not quiet:
         print(f"\nReading data from {filename} on source {source}...")
@@ -791,8 +857,10 @@ def load_data(filename, state=None, dedisperse=False, dededisperse=False,
     ok_ichans = [np.compress(weights_norm[isub],
                              np.arange(nchan)).astype(int)
                  for isub in range(nsub)]
-    masks = np.einsum("ij,k->ijk", weights_norm, np.ones(nbin))
-    masks = np.einsum("j,ikl->ijkl", np.ones(npol), masks)
+    # read-only broadcast view — materializing this (nsub, npol, nchan,
+    # nbin) cube would copy ~100 MB per campaign archive for a 0/1 mask
+    masks = np.broadcast_to(weights_norm[:, None, :, None],
+                            (nsub, npol, nchan, nbin))
     SNRs = profile_snr(subints, noise_stds)
     # the rest ignores npol (reference behavior: pscrunch for summaries)
     summary = arch.clone()

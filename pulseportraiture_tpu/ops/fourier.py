@@ -111,6 +111,12 @@ def use_matmul_dft():
     setting = getattr(config, "use_matmul_dft", "auto")
     if setting is True or setting is False:
         return setting
+    if setting != "auto":
+        # strict like _default_precision: a typo ('true', 'ture', ...)
+        # must not silently mean 'auto'
+        raise ValueError(
+            f"config.use_matmul_dft must be True, False, or 'auto'; "
+            f"got {setting!r}")
     return jax.default_backend() == "tpu"
 
 

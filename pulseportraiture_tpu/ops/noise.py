@@ -26,6 +26,41 @@ def get_noise_PS(data, frac=0.25):
     return jnp.sqrt(jnp.mean(power, axis=-1) / nbin)
 
 
+def min_window_baseline(profiles, frac=0.15):
+    """Mean of the quietest circular duty-cycle window of each (...,
+    nbin) profile — the device mirror of the PSRCHIVE-style 'minimum
+    window' estimator in io/psrfits.py:baseline_window_stats, used by
+    the streaming driver's on-device prepare stage so raw archive bytes
+    never need a host decode pass.
+
+    Same algorithm: cumulative sums -> all nbin circular window means
+    -> the minimum one.  Accumulates in the input dtype: f64 on the
+    CPU-parity path, f32 on TPU (relative window-mean error ~nbin*eps
+    ~ 6e-5 of the data scale — far below any noise floor).
+
+    On TPU the cumsum is a matmul against a device-built triangular
+    mask: XLA lowers jnp.cumsum to a scan that costs ~5 s at campaign
+    shapes, while the MXU does the O(nbin^2) triangular product in
+    ~1 ms."""
+    import jax
+
+    p = jnp.asarray(profiles)
+    nbin = p.shape[-1]
+    w = max(1, int(round(frac * nbin)))
+    if jax.default_backend() == "tpu":
+        iota = jnp.arange(nbin)
+        tri = (iota[:, None] <= iota[None, :]).astype(p.dtype)
+        cs = jnp.matmul(p, tri, precision="highest")
+    else:
+        cs = jnp.cumsum(p, axis=-1)
+    total = cs[..., -1:]
+    first = cs[..., w - 1:w]
+    direct = cs[..., w:] - cs[..., :nbin - w]
+    wrapped = total - cs[..., nbin - w:nbin - 1] + cs[..., :w - 1]
+    means = jnp.concatenate([first, direct, wrapped], axis=-1) / w
+    return jnp.min(means, axis=-1).astype(p.dtype)
+
+
 def get_noise(data, method="PS", **kwargs):
     """Dispatch noise estimator: 'PS' (power-spectrum tail, jax, hot
     path) or 'fit' (noise-floor-cutoff fit, host-side numpy, offline).

@@ -6,11 +6,24 @@ with modest per-archive subint counts is dispatch-bound, not
 compute-bound.  This driver instead POOLS ok subints across archives
 into shape buckets — keyed by (nchan, nbin, channel-frequency layout,
 effective fit flags, and the template period when the template depends
-on P) — and fires one large fused fit per full bucket, overlapping
-archive IO with device compute via the same prefetch loader GetTOAs
-uses.  Results are scattered back to their archives and returned in
-archive order; only the few per-subint fields needed for TOA assembly
-are retained, so host memory stays O(bucket), not O(campaign).
+on P) — and fires one large fused dispatch per full bucket.  Three
+levels of overlap keep every resource busy:
+
+- archive IO runs ahead of the consumer on prefetch threads;
+- dispatches are ASYNCHRONOUS — up to ``max_inflight`` launched
+  batches may be pending on the device while the host keeps loading
+  and bucketing (the host only blocks in _collect);
+- in raw mode the host never decodes the data at all: the int16 DATA
+  column ships to the accelerator as-is (half the bytes of f32 —
+  host->device bandwidth is the campaign bottleneck) and ONE jitted
+  program does decode -> baseline -> noise -> S/N -> nu_fit -> fit,
+  returning a single packed per-subint result array (one small
+  device->host pull per bucket).
+
+Raw mode needs an int16 DATA column, npol == 1, dispersed-on-disk
+data, and no tscrunch; anything else falls back to the decoded
+(host-side load_data) lane per archive, bit-compatible with round-1
+behavior.
 
 Scope: campaign configurations — wideband (phi[, DM]) fits, plus
 scattering (fit_scat/log10_tau/scat_guess/fix_alpha as in GetTOAs).
@@ -25,101 +38,278 @@ pptoas.py:258); this is new capability enabled by the batched engine.
 """
 
 import time
+from functools import lru_cache
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..config import scattering_alpha
-from ..fit.portrait import (FitFlags, fit_portrait_batch,
-                            fit_portrait_batch_fast, use_fast_fit_default)
+from ..fit.portrait import (FitFlags, _fast_batch_fn, estimate_tau_batch,
+                            fit_portrait_batch, fit_portrait_batch_fast,
+                            use_bf16_cross_spectrum, use_fast_fit_default,
+                            use_pallas_moments)
+from ..io.psrfits import read_archive
 from ..io.tim import TOA, write_TOAs
+from ..ops.noise import get_SNR, get_noise_PS, min_window_baseline
 from ..utils.bunch import DataBunch
 from .models import TemplateModel
 from .toas import (_is_metafile, _iter_archives, _read_metafile,
                    _validate_scat_guess, delta_dm_stats, load_for_toas,
-                   scat_time_flags, snr_weighted_nu_fit)
+                   reref_tau, scat_seed_tau0, scat_time_flags,
+                   snr_weighted_nu_fit)
 
 
 class _Bucket:
-    """Pending subints sharing one (layout, flags) key."""
+    """Pending subints sharing one (layout, flags, kind) key.
 
-    def __init__(self, freqs, nbin, modelx, flags):
+    kind 'dec': rows are decoded float ports; noise/nu_fit/theta0 are
+    computed on host (round-1 lane).  kind 'raw': rows are undecoded
+    int16 with per-channel scl/offs; everything downstream happens in
+    the fused device program."""
+
+    def __init__(self, freqs, nbin, modelx, flags, kind="dec"):
         self.freqs = freqs          # (nchan,)
         self.nbin = int(nbin)
         self.modelx = modelx        # (nchan, nbin) template
         self.flags = flags          # effective FitFlags tuple
-        self.ports = []             # each (nchan, nbin)
-        self.noise = []             # each (nchan,)
+        self.kind = kind
+        self.ports = []             # 'dec': (nchan, nbin) float
+        self.raw = []               # 'raw': (nchan, nbin) int16
+        self.scl = []               # 'raw': (nchan,) f32
+        self.offs = []              # 'raw': (nchan,) f32
+        self.noise = []             # 'dec': (nchan,)
         self.masks = []             # each (nchan,)
         self.Ps = []
-        self.nu_fits = []
-        self.theta0 = []            # each (5,)
+        self.nu_fits = []           # 'dec' only
+        self.theta0 = []            # 'dec': each (5,)
+        self.DM_guess = []          # 'raw': scalar per subint
         self.owners = []            # (archive_index, isub)
 
     def __len__(self):
-        return len(self.ports)
+        return len(self.owners)
+
+    def clear(self):
+        for lst in (self.ports, self.raw, self.scl, self.offs, self.noise,
+                    self.masks, self.Ps, self.nu_fits, self.theta0,
+                    self.DM_guess, self.owners):
+            lst.clear()
 
 
-def _flush(bucket, nu_ref_DM, max_iter, nsub_batch, results,
-           log10_tau=False):
-    """Fit every pending subint of a bucket in ONE dispatch and scatter
-    the results into per-(archive, subint) records.  The batch is
-    always padded to a multiple of nsub_batch so dispatch shapes stay
-    canonical (each distinct shape costs an XLA compile)."""
+def _load_raw(f):
+    """Raw streaming load: undecoded int16 samples + the small per-
+    archive metadata TOA assembly needs.  Raises ValueError when raw
+    mode cannot represent the archive (non-int16 DATA, npol > 1, or
+    dedispersed on disk — the decoded lane handles those)."""
+    arch = read_archive(f, decode=False)
+    if arch.npol != 1:
+        raise ValueError("raw streaming mode needs npol == 1")
+    if arch.get_dedispersed():
+        raise ValueError("raw streaming mode needs dispersed-on-disk data")
+    weights = arch.get_weights()
+    weights_norm = np.where(weights == 0.0, 0.0, 1.0)
+    nsub = arch.nsub
+    ok_isubs = np.compress(weights_norm.mean(axis=1),
+                           np.arange(nsub)).astype(int)
+    from ..io.telescopes import telescope_code
+
+    return DataBunch(
+        raw_mode=True, raw=arch.raw_data[:, 0], scl=arch.raw_scl[:, 0],
+        offs=arch.raw_offs[:, 0], weights=weights, ok_isubs=ok_isubs,
+        nsub=nsub, nchan=arch.nchan, nbin=arch.nbin,
+        freqs=arch.freqs_table, Ps=arch.folding_periods(),
+        epochs=arch.epochs(), subtimes=list(arch.tsubints),
+        doppler_factors=arch.doppler_factors(),
+        DM=arch.get_dispersion_measure(),
+        backend=arch.get_backend_name(),
+        frontend=arch.get_receiver_name(),
+        backend_delay=arch.get_backend_delay(),
+        telescope=arch.get_telescope(),
+        telescope_code=telescope_code(arch.get_telescope()))
+
+
+@lru_cache(maxsize=None)
+def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
+                use_fast, ftname, pallas, x_bf16):
+    """ONE jitted program for a raw bucket: int16 decode (scl/offs),
+    min-window baseline subtraction, power-spectrum noise, S/N,
+    nu_fit seeding, the batched fit, and result packing into a single
+    (nfield, nb) array — so a bucket costs one h2d of int16 bytes, one
+    dispatch, and one small d2h pull.
+
+    tau_mode: 'none' (no scattering anywhere), 'neutral' (half-bin
+    seed), 'explicit' ((tau_s, nu, alpha) runtime args), 'auto'
+    (device-side estimate_tau_batch).  Any mode but 'none' routes
+    through the complex engine even for degenerate phi-only lanes
+    (their fixed tau seed still scatters the model)."""
+    ft = {"float32": jnp.float32, "float64": jnp.float64}[ftname]
+    scat_engine = (flags[3] or flags[4] or log10_tau
+                   or tau_mode != "none")
+    tiny = float(np.finfo(ftname).tiny)
+
+    def run(raw, scl, offs, cmask, modelx, freqs, Ps, DMg, nu_out,
+            tau_s, tau_nu, tau_a, alpha0):
+        x = raw.astype(ft) * scl[..., None] + offs[..., None]
+        x = x - min_window_baseline(x)[..., None]
+        noise = jnp.maximum(get_noise_PS(x), tiny)
+        snr = get_SNR(x, noise) * cmask
+        # S/N * nu^-2-weighted center-of-mass frequency (host mirror:
+        # pipeline.toas.snr_weighted_nu_fit; reference pplib.py:2715)
+        w_nf = jnp.maximum(snr, 0.0) * freqs[None, :] ** -2.0
+        den = jnp.sum(w_nf * freqs[None, :] ** -2.0, axis=1)
+        nu_fit = jnp.sqrt(jnp.sum(w_nf, axis=1)
+                          / jnp.where(den > 0, den, 1.0))
+        nu_fit = jnp.where(jnp.isfinite(nu_fit) & (nu_fit > 0),
+                           nu_fit, jnp.mean(freqs)).astype(ft)
+        nb = x.shape[0]
+        if tau_mode == "none":
+            tau0 = jnp.zeros(nb, ft)
+        elif tau_mode == "neutral":
+            tau0 = jnp.full(nb, 0.5 / nbin, ft)
+        elif tau_mode == "explicit":
+            tau0 = ((tau_s / Ps) * (nu_fit / tau_nu) ** tau_a).astype(ft)
+        else:  # auto
+            tau0 = estimate_tau_batch(x, modelx, noise, cmask).astype(ft)
+        th3 = jnp.log10(jnp.maximum(tau0, 1e-12)) if log10_tau else tau0
+        zeros = jnp.zeros(nb, ft)
+        theta0 = jnp.stack(
+            [zeros, DMg.astype(ft), zeros, th3,
+             jnp.broadcast_to(jnp.asarray(alpha0, ft), (nb,))], axis=1)
+        nu_out_arr = jnp.broadcast_to(jnp.asarray(nu_out, ft), (nb,))
+        if use_fast and not scat_engine:
+            fit = _fast_batch_fn(FitFlags(*flags), max_iter, pallas,
+                                 None, None, 0, 0, seed_derotate=True,
+                                 x_bf16=x_bf16)
+            r = fit(x, modelx, noise, cmask, freqs, Ps, nu_fit,
+                    nu_out_arr, theta0)
+        else:
+            r = fit_portrait_batch(
+                x, modelx, noise, freqs, Ps,
+                nu_fit, nu_out=nu_out_arr, theta0=theta0,
+                fit_flags=FitFlags(*flags), chan_masks=cmask,
+                log10_tau=log10_tau, max_iter=max_iter,
+                use_scatter=scat_engine)
+        fields = [r.phi, r.phi_err, r.DM, r.DM_err, r.nu_DM, r.snr,
+                  r.chi2, r.dof, r.nfeval, r.return_code]
+        if flags[3]:
+            fields += [r.tau, r.tau_err, r.alpha, r.alpha_err, r.nu_tau]
+        return jnp.stack([jnp.asarray(f).astype(ft) for f in fields])
+
+    return jax.jit(run)
+
+
+_RESULT_KEYS = ("phi", "phi_err", "DM", "DM_err", "nu_DM", "snr",
+                "chi2", "dof", "nfeval", "return_code")
+_SCAT_KEYS = _RESULT_KEYS + ("tau", "tau_err", "alpha", "alpha_err",
+                             "nu_tau")
+
+
+def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
+            tau_mode="none", tau_args=(0.0, 1.0, 0.0), alpha0=0.0,
+            executor=None):
+    """Launch ONE fused dispatch for a bucket's pending subints and
+    return an in-flight record — WITHOUT waiting for the device.  The
+    host->device copy (jnp.asarray) can be SYNCHRONOUS and is the
+    campaign bottleneck on tunneled runtimes, so when an ``executor``
+    is given the copy+dispatch runs on its worker thread (device_put
+    releases the GIL) and the record carries a Future — the caller
+    keeps loading and bucketing archives while the bytes move.  The
+    batch is always padded to a multiple of nsub_batch so dispatch
+    shapes stay canonical (each distinct shape costs an XLA compile)."""
     n = len(bucket)
     if n == 0:
-        return 0.0, []
+        return None
     pad = (-n) % nsub_batch
     idx0 = list(range(n)) + [0] * pad  # pad with copies of subint 0
-    ports = np.stack([bucket.ports[i] for i in idx0])
-    noise = np.stack([bucket.noise[i] for i in idx0])
     masks = np.stack([bucket.masks[i] for i in idx0])
     Ps = np.asarray([bucket.Ps[i] for i in idx0])
-    nu_fit = np.asarray([bucket.nu_fits[i] for i in idx0])
-    theta0 = np.stack([bucket.theta0[i] for i in idx0])
     flags = FitFlags(*bucket.flags)
+    keys = _SCAT_KEYS if flags[3] else _RESULT_KEYS
+    nu_out = -1.0 if nu_ref_DM is None else float(nu_ref_DM)
+    use_fast = use_fast_fit_default()
 
-    # scattering (fitted, or a fixed nonzero/log10 tau seed in a
-    # degenerate lane of a scattering run) requires the complex engine
-    scat = (flags[3] or flags[4] or log10_tau
-            or bool(np.any(theta0[:, 3] != 0.0)))
-    t0 = time.time()
-    if not scat and use_fast_fit_default():
-        ft = jnp.float32
-        r = fit_portrait_batch_fast(
-            jnp.asarray(ports, ft), jnp.asarray(bucket.modelx, ft),
-            jnp.asarray(noise, ft), jnp.asarray(bucket.freqs, ft),
-            jnp.asarray(Ps, ft), jnp.asarray(nu_fit, ft),
-            nu_out=nu_ref_DM, theta0=jnp.asarray(theta0, ft),
-            fit_flags=flags, chan_masks=jnp.asarray(masks, ft),
-            max_iter=max_iter)
+    if bucket.kind == "raw":
+        raw = np.stack([bucket.raw[i] for i in idx0])
+        scl = np.stack([bucket.scl[i] for i in idx0])
+        offs = np.stack([bucket.offs[i] for i in idx0])
+        DMg = np.asarray([bucket.DM_guess[i] for i in idx0])
+        ftname = "float32" if use_fast else "float64"
+        # pallas/bf16 config read per call (cache-key args, mirroring
+        # _fast_batch_fn): mid-process config toggles take effect
+        fn = _raw_fit_fn(int(raw.shape[1]), bucket.nbin,
+                         tuple(bool(f) for f in bucket.flags),
+                         int(max_iter), bool(log10_tau), tau_mode,
+                         use_fast, ftname,
+                         use_pallas_moments(np.dtype(ftname)),
+                         use_bf16_cross_spectrum())
+        ft = jnp.float32 if use_fast else jnp.float64
+        t_s, t_nu, t_a = tau_args
+        modelx, freqs = bucket.modelx, bucket.freqs
+
+        def dispatch():
+            return fn(jnp.asarray(raw), jnp.asarray(scl, ft),
+                      jnp.asarray(offs, ft), jnp.asarray(masks, ft),
+                      jnp.asarray(modelx, ft),
+                      jnp.asarray(freqs, ft), jnp.asarray(Ps, ft),
+                      jnp.asarray(DMg, ft), ft(nu_out),
+                      ft(t_s), ft(t_nu), ft(t_a), ft(alpha0))
     else:
-        r = fit_portrait_batch(
-            jnp.asarray(ports),
-            jnp.broadcast_to(jnp.asarray(bucket.modelx), ports.shape),
-            jnp.asarray(noise), jnp.asarray(bucket.freqs),
-            jnp.asarray(Ps), jnp.asarray(nu_fit),
-            nu_out=nu_ref_DM, theta0=jnp.asarray(theta0),
-            fit_flags=flags, chan_masks=jnp.asarray(masks),
-            log10_tau=log10_tau, max_iter=max_iter)
-    out = {k: np.asarray(v) for k, v in r._asdict().items()}
-    dt = time.time() - t0
-    resolved = list(bucket.owners)
-    keys = ("phi", "phi_err", "DM", "DM_err", "nu_DM", "snr", "chi2",
-            "dof", "nfeval", "return_code")
-    if flags[3]:
-        keys += ("tau", "tau_err", "alpha", "alpha_err", "nu_tau")
-    for i in range(n):  # padded lanes are discarded
-        results[bucket.owners[i]] = {k: out[k][i] for k in keys}
-    bucket.ports.clear(); bucket.noise.clear(); bucket.masks.clear()
-    bucket.Ps.clear(); bucket.nu_fits.clear(); bucket.theta0.clear()
-    bucket.owners.clear()
-    return dt, resolved
+        ports = np.stack([bucket.ports[i] for i in idx0])
+        noise = np.stack([bucket.noise[i] for i in idx0])
+        nu_fit = np.asarray([bucket.nu_fits[i] for i in idx0])
+        theta0 = np.stack([bucket.theta0[i] for i in idx0])
+        # scattering (fitted, or a fixed nonzero/log10 tau seed in a
+        # degenerate lane of a scattering run) needs the complex engine
+        scat = (flags[3] or flags[4] or log10_tau
+                or bool(np.any(theta0[:, 3] != 0.0)))
+        modelx, freqs = bucket.modelx, bucket.freqs
+
+        def dispatch():
+            if not scat and use_fast:
+                ft = jnp.float32
+                r = fit_portrait_batch_fast(
+                    jnp.asarray(ports, ft), jnp.asarray(modelx, ft),
+                    jnp.asarray(noise, ft), jnp.asarray(freqs, ft),
+                    jnp.asarray(Ps, ft), jnp.asarray(nu_fit, ft),
+                    nu_out=nu_ref_DM, theta0=jnp.asarray(theta0, ft),
+                    fit_flags=flags, chan_masks=jnp.asarray(masks, ft),
+                    max_iter=max_iter)
+            else:
+                r = fit_portrait_batch(
+                    jnp.asarray(ports),
+                    jnp.asarray(modelx),  # shared 2-D: one model DFT
+                    jnp.asarray(noise), jnp.asarray(freqs),
+                    jnp.asarray(Ps), jnp.asarray(nu_fit),
+                    nu_out=nu_ref_DM, theta0=jnp.asarray(theta0),
+                    fit_flags=flags, chan_masks=jnp.asarray(masks),
+                    log10_tau=log10_tau, max_iter=max_iter)
+            # pack into one array so _collect costs a single d2h pull
+            # (~100 ms round-trip each on tunneled runtimes)
+            return jnp.stack([jnp.asarray(getattr(r, k)).astype(r.phi.dtype)
+                              for k in keys])
+
+    handle = executor.submit(dispatch) if executor is not None \
+        else dispatch()
+    rec = (handle, list(bucket.owners), keys)
+    bucket.clear()
+    return rec
+
+
+def _collect(rec, results):
+    """Materialize one in-flight dispatch (blocks until the device is
+    done; ONE small device->host pull) and scatter its rows into
+    per-(archive, subint) records.  Returns the resolved owner list."""
+    handle, owners, keys = rec
+    packed = handle.result() if hasattr(handle, "result") else handle
+    out = np.asarray(packed)
+    for i, owner in enumerate(owners):  # padded lanes are discarded
+        results[owner] = {k: out[j, i] for j, k in enumerate(keys)}
+    return owners
 
 
 def _assemble_archive(m, results, modelfile, fit_DM, bary,
                       addtnl_toa_flags, log10_tau=False,
-                      alpha_fitted=False):
+                      alpha_fitted=False, nu_ref_tau=None):
     """Build the TOA objects + DeltaDM stats for one archive from the
     scattered fit results."""
     toas, dDMs, dDM_errs = [], [], []
@@ -136,9 +326,18 @@ def _assemble_archive(m, results, modelfile, fit_DM, bary,
         if "tau" in r:
             # same flag set as GetTOAs (scat_time in us, Doppler-
             # corrected like the wideband pipeline)
+            tau_j, tau_err_j = float(r["tau"]), float(r["tau_err"])
+            nu_tau_j = float(r["nu_tau"])
+            if nu_ref_tau is not None:
+                # user-requested tau output reference (-nu_tau), as
+                # get_TOAs does via reref_tau before flag assembly
+                tau_j, tau_err_j = reref_tau(
+                    tau_j, tau_err_j, nu_tau_j, nu_ref_tau,
+                    float(r["alpha"]))
+                nu_tau_j = float(nu_ref_tau)
             flags.update(scat_time_flags(
-                float(r["tau"]), float(r["tau_err"]), P / df, log10_tau))
-            flags["scat_ref_freq"] = float(r["nu_tau"]) * df
+                tau_j, tau_err_j, P / df, log10_tau))
+            flags["scat_ref_freq"] = nu_tau_j * df
             flags["scat_ind"] = float(r["alpha"])
             if alpha_fitted:
                 flags["scat_ind_err"] = float(r["alpha_err"])
@@ -165,24 +364,33 @@ def _assemble_archive(m, results, modelfile, fit_DM, bary,
 
 
 def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
-                         fit_DM=True, nu_ref_DM=None, DM0=None, bary=True,
+                         fit_DM=True, nu_ref_DM=None, nu_ref_tau=None,
+                         DM0=None, bary=True,
                          tscrunch=False, fit_scat=False, log10_tau=True,
                          scat_guess=None, fix_alpha=False, max_iter=25,
-                         prefetch=True, addtnl_toa_flags={}, tim_out=None,
+                         prefetch=True, max_inflight=4,
+                         addtnl_toa_flags={}, tim_out=None,
                          quiet=False):
     """Measure wideband (phi[, DM[, tau, alpha]]) TOAs for many
     archives with cross-archive batched dispatches.
 
     fit_scat/log10_tau/scat_guess/fix_alpha follow GetTOAs.get_TOAs
     (scat_guess may be (tau_s, nu, alpha), "auto" for the data-driven
-    seed, or None for the neutral half-bin); scattering buckets run the
-    complex engine, no-scattering buckets keep the fast path.
+    seed, or None for the neutral half-bin); nu_ref_tau re-references
+    the reported tau to a fixed frequency, as get_TOAs does; scattering
+    buckets run the complex engine, no-scattering buckets keep the fast
+    path.
 
     tim_out: optional .tim path; each archive's TOA lines are APPENDED
     as soon as all its subints are fitted, so a campaign interrupted
     mid-run keeps every completed archive's results on disk (the
     fault-tolerance analogue of the reference's write-the-model-every-
     iteration habit, ppgauss.py:208-212).
+
+    max_inflight: how many fused dispatches may be pending on the
+    device before the host blocks on the oldest — dispatch latency,
+    archive IO (see prefetch), and device compute all overlap, which
+    is what makes campaign-scale throughput dispatch-latency-immune.
 
     Returns a DataBunch with:
       TOA_list        — TOA objects in archive order
@@ -210,25 +418,61 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
         # previous campaign's lines
         open(tim_out, "w").close()
 
-    def _loader(f):
-        return load_for_toas(f, tscrunch=tscrunch, quiet=True)
+    # f32 load on fast-fit backends: the data feeds the f32 engine
+    # anyway, and single precision halves per-archive host time — on
+    # CPU (tests/parity) keep f64 so results bit-match GetTOAs
+    load_dtype = np.float32 if use_fast_fit_default() else None
 
+    def _loader(f):
+        if not tscrunch:
+            try:
+                # raw lane: int16 straight to the accelerator, decode
+                # and statistics on device
+                return _load_raw(f)
+            except (ValueError, KeyError):
+                pass
+        return load_for_toas(f, tscrunch=tscrunch, quiet=True,
+                             dtype=load_dtype)
+
+    # tau seeding mode, resolved once (both lanes)
+    default_alpha = (model.gauss.alpha if model.is_gaussian
+                     else scattering_alpha)
+    if scat_guess is not None and not isinstance(scat_guess, str):
+        tau_mode = "explicit"
+        tau_args = tuple(float(v) for v in scat_guess)
+        alpha0_run = tau_args[2]
+    elif fit_scat and scat_guess == "auto":
+        tau_mode, tau_args, alpha0_run = "auto", (0.0, 1.0, 0.0), \
+            float(default_alpha)
+    elif fit_scat:
+        tau_mode, tau_args, alpha0_run = "neutral", (0.0, 1.0, 0.0), \
+            float(default_alpha)
+    else:
+        tau_mode, tau_args, alpha0_run = "none", (0.0, 1.0, 0.0), \
+            float(default_alpha)
+
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    # one worker: h2d copies serialize on the link anyway, and a single
+    # thread keeps dispatch order deterministic
+    dispatch_ex = ThreadPoolExecutor(max_workers=1)
     buckets = {}
     results = {}
     meta = []        # minimal per-archive record for TOA assembly
     meta_by_iarch = {}
     remaining = {}   # iarch -> subints not yet fitted
     assembled = {}   # iarch -> (toas, DeltaDM_mean, DeltaDM_err)
-    fit_duration = 0.0
+    in_flight = deque()  # launched-but-uncollected dispatch records
+    fit_duration = 0.0   # host time BLOCKED on the device (sync waits)
     nfit = 0
     t_start = time.time()
 
-    def do_flush(b):
-        nonlocal fit_duration, nfit
-        dt, resolved = _flush(b, nu_ref_DM, max_iter, nsub_batch, results,
-                              log10_tau=log10_tau)
-        fit_duration += dt
-        nfit += 1
+    def drain_one():
+        nonlocal fit_duration
+        t0 = time.time()
+        resolved = _collect(in_flight.popleft(), results)
+        fit_duration += time.time() - t0
         touched = set()
         for iarch, _ in resolved:
             remaining[iarch] -= 1
@@ -241,7 +485,8 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                 out = _assemble_archive(
                     m, results, modelfile, fit_DM, bary,
                     addtnl_toa_flags, log10_tau=log10_tau,
-                    alpha_fitted=fit_scat and not fix_alpha)
+                    alpha_fitted=fit_scat and not fix_alpha,
+                    nu_ref_tau=nu_ref_tau)
                 assembled[ia] = out
                 # the per-subint records are folded into the assembly;
                 # dropping them keeps host memory O(bucket)
@@ -250,100 +495,119 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                 if tim_out:
                     write_TOAs(out[0], outfile=tim_out, append=True)
 
-    for iarch, (datafile, d) in enumerate(
-            _iter_archives(datafiles, _loader, prefetch)):
-        if isinstance(d, Exception):
-            print(f"Skipping {datafile}: {d}")
-            continue
-        ok = np.asarray(d.ok_isubs, int)
-        if d.nsub == 0 or len(ok) == 0:
-            print(f"No subints to fit in {datafile}; skipping.")
-            continue
-        nchan, nbin = d.nchan, d.nbin
-        freqs0 = np.asarray(d.freqs[0], float)
-        P_mean = float(np.mean(d.Ps[ok]))
-        try:
-            modelx = model.portrait(freqs0, nbin, P=P_mean)
-        except ValueError as e:
-            print(f"Skipping {datafile}: {e}")
-            continue
-        base_key = (nchan, nbin, freqs0.tobytes())
-        if p_dependent:
-            base_key += (round(P_mean, 12),)
+    def do_flush(b):
+        nonlocal nfit
+        rec = _launch(b, nu_ref_DM, max_iter, nsub_batch,
+                      log10_tau=log10_tau, tau_mode=tau_mode,
+                      tau_args=tau_args, alpha0=alpha0_run,
+                      executor=dispatch_ex)
+        if rec is None:
+            return
+        nfit += 1
+        in_flight.append(rec)
+        while len(in_flight) > max_inflight:
+            drain_one()
 
-        DM_stored = float(d.DM)
-        DM0_arch = DM_stored if DM0 is None else float(DM0)
-        DM_guess = DM_stored if DM_stored != 0.0 else DM0_arch
-        masks = np.asarray(d.weights[ok] > 0.0, float)
-        noise = np.asarray(d.noise_stds[ok, 0], float)
-        snrs_chan = np.asarray(d.SNRs[ok, 0], float) * masks
-        nu_fit_arr = snr_weighted_nu_fit(snrs_chan, freqs0)
+    # a failed dispatch/assembly must not leave the worker thread
+    # grinding through queued h2d copies (each holding a full stacked
+    # batch) while the exception propagates: cancel + bail on error
+    try:
+        for iarch, (datafile, d) in enumerate(
+                _iter_archives(datafiles, _loader, prefetch)):
+            if isinstance(d, Exception):
+                print(f"Skipping {datafile}: {d}")
+                continue
+            ok = np.asarray(d.ok_isubs, int)
+            if d.nsub == 0 or len(ok) == 0:
+                print(f"No subints to fit in {datafile}; skipping.")
+                continue
+            nchan, nbin = d.nchan, d.nbin
+            freqs0 = np.asarray(d.freqs[0], float)
+            P_mean = float(np.mean(d.Ps[ok]))
+            try:
+                modelx = model.portrait(freqs0, nbin, P=P_mean)
+            except ValueError as e:
+                print(f"Skipping {datafile}: {e}")
+                continue
+            base_key = (nchan, nbin, freqs0.tobytes())
+            if p_dependent:
+                base_key += (round(P_mean, 12),)
 
-        # keep only what TOA assembly needs — NOT the data cube
-        m = DataBunch(
-            datafile=datafile, iarch=iarch, ok=ok,
-            DM0_arch=DM0_arch, nbin=nbin, nchan=nchan,
-            epochs=[d.epochs[isub] for isub in ok],
-            Ps=[float(d.Ps[isub]) for isub in ok],
-            dfs=[float(d.doppler_factors[isub]) for isub in ok],
-            subtimes=[float(d.subtimes[isub]) for isub in ok],
-            backend_delay=d.backend_delay, backend=d.backend,
-            frontend=d.frontend, telescope=d.telescope,
-            telescope_code=d.telescope_code)
-        meta.append(m)
-        meta_by_iarch[iarch] = m
-        remaining[iarch] = len(ok)
-        ports = np.asarray(d.subints[ok, 0], float)
-        nchx = masks.sum(axis=1).astype(int)
+            DM_stored = float(d.DM)
+            DM0_arch = DM_stored if DM0 is None else float(DM0)
+            DM_guess = DM_stored if DM_stored != 0.0 else DM0_arch
+            masks = np.asarray(d.weights[ok] > 0.0, float)
+            raw_mode = bool(d.get("raw_mode", False))
 
-        # tau/alpha seeds (mirrors GetTOAs.get_TOAs)
-        alpha0 = (model.gauss.alpha if model.is_gaussian
-                  else scattering_alpha)
-        if scat_guess is not None and not isinstance(scat_guess, str):
-            t_s, nu_s, a_s = scat_guess
-            tau0 = (t_s / P_mean) * (nu_fit_arr / nu_s) ** a_s
-            alpha0 = a_s
-        elif fit_scat and scat_guess == "auto":
-            from ..fit.portrait import estimate_tau_batch
+            # keep only what TOA assembly needs — NOT the data cube
+            m = DataBunch(
+                datafile=datafile, iarch=iarch, ok=ok,
+                DM0_arch=DM0_arch, nbin=nbin, nchan=nchan,
+                epochs=[d.epochs[isub] for isub in ok],
+                Ps=[float(d.Ps[isub]) for isub in ok],
+                dfs=[float(d.doppler_factors[isub]) for isub in ok],
+                subtimes=[float(d.subtimes[isub]) for isub in ok],
+                backend_delay=d.backend_delay, backend=d.backend,
+                frontend=d.frontend, telescope=d.telescope,
+                telescope_code=d.telescope_code)
+            meta.append(m)
+            meta_by_iarch[iarch] = m
+            remaining[iarch] = len(ok)
+            nchx = masks.sum(axis=1).astype(int)
 
-            tau0 = np.asarray(estimate_tau_batch(
-                jnp.asarray(ports, jnp.float32),
-                jnp.asarray(modelx, jnp.float32),
-                jnp.asarray(noise, jnp.float32),
-                jnp.asarray(masks, jnp.float32)))
-        elif fit_scat:
-            tau0 = np.full(len(ok), 0.5 / nbin)
-        else:
-            tau0 = np.zeros(len(ok))
+            if not raw_mode:
+                ports = np.asarray(d.subints[ok, 0])  # dtype preserved
+                noise = np.asarray(d.noise_stds[ok, 0], float)
+                snrs_chan = np.asarray(d.SNRs[ok, 0], float) * masks
+                nu_fit_arr = snr_weighted_nu_fit(snrs_chan, freqs0)
+                # tau/alpha seeds (the helper shared with GetTOAs.get_TOAs)
+                tau0, alpha0 = scat_seed_tau0(
+                    scat_guess, fit_scat, len(ok), nbin, P_mean, nu_fit_arr,
+                    default_alpha,
+                    ports=ports, modelx=modelx, noise=noise, masks=masks)
 
-        base_flags = (True, bool(fit_DM), False, bool(fit_scat),
-                      bool(fit_scat and not fix_alpha))
-        for j, isub in enumerate(ok):
-            # degenerate geometry: 1 usable channel -> phase-only
-            eff_flags = ((True, False, False, False, False)
-                         if nchx[j] <= 1 else base_flags)
-            key = base_key + (eff_flags,)
-            if key not in buckets:
-                buckets[key] = _Bucket(freqs0, nbin, modelx, eff_flags)
-            b = buckets[key]
-            th = np.zeros(5)
-            th[1] = DM_guess
-            th[3] = (np.log10(max(tau0[j], 1e-12)) if log10_tau
-                     else tau0[j])
-            th[4] = alpha0
-            b.ports.append(ports[j])
-            b.noise.append(noise[j])
-            b.masks.append(masks[j])
-            b.Ps.append(float(d.Ps[isub]))
-            b.nu_fits.append(float(nu_fit_arr[j]))
-            b.theta0.append(th)
-            b.owners.append((iarch, int(isub)))
-            if len(b) >= nsub_batch:
+            base_flags = (True, bool(fit_DM), False, bool(fit_scat),
+                          bool(fit_scat and not fix_alpha))
+            kind = "raw" if raw_mode else "dec"
+            for j, isub in enumerate(ok):
+                # degenerate geometry: 1 usable channel -> phase-only
+                eff_flags = ((True, False, False, False, False)
+                             if nchx[j] <= 1 else base_flags)
+                key = base_key + (eff_flags, kind)
+                if key not in buckets:
+                    buckets[key] = _Bucket(freqs0, nbin, modelx, eff_flags,
+                                           kind=kind)
+                b = buckets[key]
+                if raw_mode:
+                    b.raw.append(d.raw[isub])
+                    b.scl.append(d.scl[isub])
+                    b.offs.append(d.offs[isub])
+                    b.DM_guess.append(DM_guess)
+                else:
+                    th = np.zeros(5)
+                    th[1] = DM_guess
+                    th[3] = (np.log10(max(tau0[j], 1e-12)) if log10_tau
+                             else tau0[j])
+                    th[4] = alpha0
+                    b.ports.append(ports[j])
+                    b.noise.append(noise[j])
+                    b.nu_fits.append(float(nu_fit_arr[j]))
+                    b.theta0.append(th)
+                b.masks.append(masks[j])
+                b.Ps.append(float(d.Ps[isub]))
+                b.owners.append((iarch, int(isub)))
+                if len(b) >= nsub_batch:
+                    do_flush(b)
+
+        for b in buckets.values():
+            if len(b):
                 do_flush(b)
-
-    for b in buckets.values():
-        if len(b):
-            do_flush(b)
+        while in_flight:
+            drain_one()
+    except BaseException:
+        dispatch_ex.shutdown(wait=False, cancel_futures=True)
+        raise
+    dispatch_ex.shutdown(wait=True)
 
     # ---- collect TOAs + per-archive DeltaDM stats in archive order --
     TOA_list = []
@@ -351,7 +615,8 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
     for m in meta:
         toas, mean, err = assembled.get(m.iarch) or _assemble_archive(
             m, results, modelfile, fit_DM, bary, addtnl_toa_flags,
-            log10_tau=log10_tau, alpha_fitted=fit_scat and not fix_alpha)
+            log10_tau=log10_tau, alpha_fitted=fit_scat and not fix_alpha,
+            nu_ref_tau=nu_ref_tau)
         TOA_list.extend(toas)
         order.append(m.datafile)
         DM0s.append(m.DM0_arch)
@@ -363,7 +628,7 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
         n = len(TOA_list)
         print(f"streamed {n} TOAs from {len(order)} archives in "
               f"{tot:.2f} s ({nfit} fused dispatches, "
-              f"{fit_duration:.2f} s fitting, "
+              f"{fit_duration:.2f} s blocked on device, "
               f"{n / max(tot, 1e-9):.1f} TOAs/s end-to-end)")
     return DataBunch(TOA_list=TOA_list, order=order, DM0s=DM0s,
                      DeltaDM_means=DeltaDM_means,

@@ -135,13 +135,17 @@ def snr_weighted_nu_fit(snrs_chan, freqs0):
                     freqs0.mean())
 
 
-def load_for_toas(f, tscrunch=False, quiet=True):
+def load_for_toas(f, tscrunch=False, quiet=True, dtype=None):
     """The load_data configuration every TOA driver uses: dispersed
     data (dedisperse later via the fit), pscrunched, no flux profile,
-    archive object dropped."""
+    archive object dropped.  dtype None = float64; the streaming
+    campaign driver passes float32 on fast-fit backends."""
+    import numpy as _np
+
     return load_data(f, dedisperse=False, dededisperse=True,
                      tscrunch=tscrunch, pscrunch=True, flux_prof=False,
-                     refresh_arch=False, return_arch=False, quiet=quiet)
+                     refresh_arch=False, return_arch=False, quiet=quiet,
+                     dtype=_np.float64 if dtype is None else dtype)
 
 
 def delta_dm_stats(dDMs, dDM_errs):
@@ -166,10 +170,12 @@ def delta_dm_stats(dDMs, dDM_errs):
 
 
 def _iter_archives(datafiles, loader, prefetch):
-    """Yield (datafile, DataBunch-or-Exception).  With prefetch, a
-    single worker thread loads archive i+1 while the caller fits
-    archive i — IO/compute overlap for long archive lists (the
-    reference loads and fits strictly sequentially, pptoas.py:258)."""
+    """Yield (datafile, DataBunch-or-Exception).  With prefetch, worker
+    threads load archives ahead of the consumer — IO/compute overlap
+    for long archive lists (the reference loads and fits strictly
+    sequentially, pptoas.py:258).  prefetch: False/0 disables, True
+    uses the default depth (4), an int sets the window depth (number of
+    archives decoded ahead)."""
     if not prefetch or len(datafiles) <= 1:
         for f in datafiles:
             try:
@@ -177,7 +183,10 @@ def _iter_archives(datafiles, loader, prefetch):
             except Exception as e:
                 yield f, e
         return
+    from collections import deque
     from concurrent.futures import ThreadPoolExecutor
+
+    depth = 4 if prefetch is True else max(1, int(prefetch))
 
     def safe(f):
         try:
@@ -185,13 +194,18 @@ def _iter_archives(datafiles, loader, prefetch):
         except Exception as e:
             return e
 
-    with ThreadPoolExecutor(max_workers=1) as ex:
-        fut = ex.submit(safe, datafiles[0])
-        for i, f in enumerate(datafiles):
-            d = fut.result()
-            if i + 1 < len(datafiles):
-                fut = ex.submit(safe, datafiles[i + 1])
-            yield f, d
+    with ThreadPoolExecutor(max_workers=min(depth, 4)) as ex:
+        futs = deque()
+        it = iter(datafiles)
+        for f in datafiles[:depth]:
+            next(it)
+            futs.append((f, ex.submit(safe, f)))
+        while futs:
+            f, fut = futs.popleft()
+            nxt = next(it, None)
+            if nxt is not None:
+                futs.append((nxt, ex.submit(safe, nxt)))
+            yield f, fut.result()
 
 
 def _read_metafile(path):

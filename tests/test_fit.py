@@ -398,3 +398,52 @@ def test_estimate_tau_seed_quality(key, tau_s):
     assert float(r_a.tau[0]) == pytest.approx(float(r_n.tau[0]), rel=0.05)
     # ...but the seeded fit needs fewer evaluations
     assert int(r_a.nfeval[0]) <= int(r_n.nfeval[0])
+
+
+def test_cgh_scatter_matches_autodiff():
+    """The fused analytic (f, grad, hess) of the scattering objective
+    (_cgh_scatter, one pass over X) must match autodiff of the plain
+    objective — both tau parameterizations, with and without an
+    instrumental response folded in."""
+    import numpy as np
+
+    from pulseportraiture_tpu.fit.portrait import (_cgh_scatter,
+                                                   _chi2_prime_X,
+                                                   _t_coeffs)
+
+    rng = np.random.default_rng(7)
+    nchan, nharm = 10, 33
+    P, nu_fit = 0.003, 1450.0
+    freqs = jnp.asarray(np.linspace(1200.0, 1700.0, nchan))
+    X = jnp.asarray(rng.standard_normal((nchan, nharm))
+                    + 1j * rng.standard_normal((nchan, nharm)))
+    M2 = jnp.asarray(np.abs(rng.standard_normal((nchan, nharm))) + 0.1)
+    ir = jnp.asarray(rng.standard_normal((nchan, nharm))
+                     + 1j * 0.3 * rng.standard_normal((nchan, nharm)))
+    cvec, gvec = _t_coeffs(freqs, P, nu_fit)
+    cvec = cvec.astype(jnp.float64)
+    gvec = gvec.astype(jnp.float64)
+    for log10_tau in (False, True):
+        for use_ir in (False, True):
+            th = jnp.asarray([0.03, 0.002, 1e-7,
+                              -2.5 if log10_tau else 0.004, -3.7])
+            ir_arg = ir if use_ir else None
+
+            def obj(t):
+                return _chi2_prime_X(t, X, M2, freqs, P, nu_fit,
+                                     ir_arg, log10_tau)
+
+            f0, g0 = jax.value_and_grad(obj)(th)
+            H0 = jax.hessian(obj)(th)
+            if use_ir:
+                Xs = X * jnp.conj(ir)
+                M2s = M2 * (ir.real ** 2.0 + ir.imag ** 2.0)
+            else:
+                Xs, M2s = X, M2
+            f1, g1, H1 = _cgh_scatter(th, Xs, M2s, freqs, nu_fit,
+                                      cvec, gvec, log10_tau)
+            assert float(jnp.abs(f1 - f0)) < 1e-9 * abs(float(f0))
+            assert float(jnp.abs(g1 - g0).max()) < \
+                1e-10 * float(jnp.abs(g0).max())
+            assert float(jnp.abs(H1 - H0).max()) < \
+                1e-9 * float(jnp.abs(H0).max()), (log10_tau, use_ir)

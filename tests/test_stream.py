@@ -166,3 +166,16 @@ def test_stream_scattering_matches_gettoas(tmp_path):
                                       / 1500.0) ** t.flags["scat_ind"]
             assert t.flags["scat_time"] == pytest.approx(expect_us,
                                                          rel=0.15)
+
+    # nu_ref_tau re-references the reported tau like get_TOAs' -nu_tau
+    res_r = stream_wideband_TOAs(files, gmodel, nsub_batch=4,
+                                 fit_scat=True, scat_guess="auto",
+                                 nu_ref_tau=1400.0, quiet=True)
+    by_key_r = {(t.archive, t.flags["subint"]): t for t in res_r.TOA_list}
+    for key, t in by_key.items():
+        t_r = by_key_r[key]
+        assert t_r.flags["scat_ref_freq"] == pytest.approx(1400.0)
+        expect = (t.flags["scat_time"]
+                  * (1400.0 / t.flags["scat_ref_freq"])
+                  ** t.flags["scat_ind"])
+        assert t_r.flags["scat_time"] == pytest.approx(expect, rel=1e-6)
