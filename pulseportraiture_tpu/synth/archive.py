@@ -68,13 +68,25 @@ def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
                      scales=1.0, dedispersed=False, t_scat=0.0,
                      alpha=scattering_alpha, scint=False, xs=None, Cs=None,
                      nu_DM=np.inf, state="Stokes", telescope="GBT",
-                     quiet=False, rng=None, barycentred=True):
+                     quiet=False, rng=None, barycentred=True,
+                     spin_coherent=False):
     """Generate a fake fold-mode PSRFITS archive with known injected
     parameters and write it to ``outfile``.  Returns the Archive.
 
     Signature parity with the reference (pplib.py:3302); start_MJD may
     be a utils.mjd.MJD or a float MJD; ``rng`` (numpy Generator or
     seed) makes the noise/scint draws reproducible.
+
+    spin_coherent=True ties the absolute pulse phase of every subint to
+    the spin ephemeris — each subint is additionally rotated by
+    -frac(F0 (epoch - PEPOCH)), computed in exact rational arithmetic
+    (the product is ~1e9 turns, beyond f64) — which is what
+    polyco-driven folding (PSRCHIVE; reference write_archive installs
+    polycos via set_ephemeris, pplib.py:3274-3281) produces on real
+    archives.  With it, measured TOAs from different epochs phase-
+    connect: a timing fit (timing.wideband_gls_fit) yields white
+    residuals.  Default False preserves the simpler grid-aligned
+    behavior (each archive's absolute phase arbitrary).
     """
     rng = np.random.default_rng(rng)
     model = read_gmodel(modelfile, quiet=True) \
@@ -127,9 +139,33 @@ def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
         rotmodel = np.fft.irfft(np.fft.rfft(rotmodel, axis=-1) * B,
                                 n=nbin, axis=-1)
 
+    spin_fracs = np.zeros(nsub)
+    if spin_coherent:
+        # frac(F0 * (epoch - PEPOCH)) per subint, exactly: the product
+        # is ~1e9 turns, so f64 would alias the fractional turn; use
+        # rational arithmetic on the parfile strings and the (int day,
+        # f64 frac) epoch representation
+        from decimal import Decimal
+        from fractions import Fraction
+
+        def _rat(v):
+            return Fraction(Decimal(
+                str(v).replace("D", "E").replace("d", "e")))
+
+        F0r = _rat(par["F0"]) if "F0" in par else 1 / _rat(par["P0"])
+        PEPOCHr = _rat(par.get("PEPOCH", PEPOCH))
+        for isub, e in enumerate(epochs):
+            dt_sec = (Fraction(e.day) - PEPOCHr) * 86400 \
+                + Fraction(e.frac) * 86400
+            spin_fracs[isub] = float((F0r * dt_sec) % 1)
+
     amps = np.zeros((nsub, npol, nchan, nbin))
     for isub in range(nsub):
         port = rotmodel
+        if spin_coherent and spin_fracs[isub] != 0.0:
+            # pulse earlier by the ephemeris phase at this epoch, so
+            # epoch + phi*P phase-connects across the campaign
+            port = rotate_phase(port, spin_fracs[isub])
         if scint is not False:
             if scint is True:
                 port = add_scintillation(port, random=True, nsin=3,

@@ -1,0 +1,14 @@
+"""Minimal wideband timing: .tim reading and a NumPy GLS fitter.
+
+Closes the loop the reference's example notebook closes with an
+external ``tempo`` GLS run on the produced .tim with DMDATA 1
+(examples/example_make_model_and_TOAs.ipynb cells 43-56) — here with
+no external binaries: read the wideband TOAs (+ -pp_dm DM
+measurements) back, fit a linearized timing model jointly to arrival
+times and DMs, and report white(ned) residuals.
+"""
+
+from .gls import WidebandGLSResult, wideband_gls_fit
+from .tim import TimTOA, read_tim
+
+__all__ = ["read_tim", "TimTOA", "wideband_gls_fit", "WidebandGLSResult"]

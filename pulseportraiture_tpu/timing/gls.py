@@ -1,0 +1,191 @@
+"""Wideband generalized-least-squares timing fit (NumPy, float64).
+
+The DMDATA-1 likelihood the reference validates with an external tempo
+run (examples/example_make_model_and_TOAs.ipynb cells 43-56): arrival
+times AND the per-TOA wideband DM measurements enter one weighted
+least-squares system,
+
+    chi^2 = sum_i ((t_res_i - A_t_i @ x) / sigma_t_i)^2
+          + sum_i ((DM_i - DM_model(t_i) - A_d_i @ x) / sigma_DM_i)^2
+
+linearized about a simple barycentric spin ephemeris (F0 [, F1] at
+PEPOCH) plus a piecewise-constant DM model (DMX per observing epoch —
+exactly the structure make_fake_pulsar injects).  White noise only; no
+binary/astrometric terms — the synthetic archives this validates are
+generated barycentric from the same parfile.
+
+This is an offline validation step over a handful of TOAs — host
+NumPy f64 is the right tool (timing needs ~1e-13 day precision; the
+accelerator adds nothing at this size).
+"""
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..config import Dconst
+
+__all__ = ["wideband_gls_fit", "WidebandGLSResult"]
+
+SECPERDAY = 86400.0
+
+
+@dataclass
+class WidebandGLSResult:
+    params: dict              # name -> fitted offset value
+    param_errs: dict
+    time_resids_us: np.ndarray   # post-fit [us]
+    prefit_resids_us: np.ndarray
+    dm_resids: np.ndarray        # post-fit DM residuals [pc cm^-3]
+    toa_errs_us: np.ndarray
+    dm_errs: np.ndarray
+    epochs: np.ndarray           # epoch index per TOA
+    dmx: np.ndarray              # fitted DMX per epoch [pc cm^-3]
+    dmx_errs: np.ndarray
+    chi2: float
+    dof: int
+    wrms_us: float
+
+    @property
+    def red_chi2(self):
+        return self.chi2 / max(self.dof, 1)
+
+
+def _group_epochs(mjds, gap_days=0.5):
+    """Epoch index per TOA: a new epoch wherever the (sorted) MJDs jump
+    by more than gap_days."""
+    order = np.argsort(mjds)
+    out = np.zeros(len(mjds), int)
+    cur = 0
+    prev = None
+    for j in order:
+        if prev is not None and mjds[j] - prev > gap_days:
+            cur += 1
+        out[j] = cur
+        prev = mjds[j]
+    return out
+
+
+def wideband_gls_fit(toas, par, fit_f0=True, fit_f1=False,
+                     epoch_gap_days=0.5):
+    """Fit (phase offset[, dF0[, dF1]], DMX per epoch) to wideband TOAs.
+
+    toas: list of timing.tim.TimTOA (needs frequency, mjd, error_us,
+    dm, dm_err).  par: dict-like with F0 or P0, PEPOCH, DM (the
+    parse_parfile output is fine — string values are converted).
+
+    Returns WidebandGLSResult; DM measurements and arrival times are
+    fit jointly (DMDATA-1 style), with the model DM at each TOA =
+    par DM + DMX[epoch]."""
+    def fget(key, default=None):
+        v = par.get(key, default)
+        return float(str(v).replace("D", "E")) if v is not None else None
+
+    F0 = fget("F0") or 1.0 / fget("P0")
+    PEPOCH = fget("PEPOCH")
+    DM0 = fget("DM", 0.0)
+
+    toas = [t for t in toas if t.dm is not None and t.dm_err]
+    n = len(toas)
+    if n < 2:
+        raise ValueError("wideband GLS needs >= 2 TOAs with -pp_dm")
+    freqs = np.array([t.frequency for t in toas])
+    errs_us = np.array([t.error_us for t in toas])
+    dms = np.array([t.dm for t in toas])
+    dm_errs = np.array([t.dm_err for t in toas])
+    mjd_i = np.array([t.mjd_int for t in toas], np.int64)
+    mjd_f = np.array([t.mjd_frac for t in toas])
+    mjds = mjd_i + mjd_f
+
+    epochs = _group_epochs(mjds, epoch_gap_days)
+    nep = epochs.max() + 1
+
+    # infinite-frequency arrival time: subtract the MODEL dispersion
+    # delay (par DM; the DMX corrections are fitted linearly below) at
+    # the TOA's reference frequency.  Using the measured DMs here would
+    # leak their noise into the arrival times and double-count the DMX
+    # columns.
+    disp_s = np.where(np.isfinite(freqs),
+                      Dconst * DM0 * freqs ** -2.0, 0.0)
+    # seconds since PEPOCH (f64: used only for design columns, where
+    # ns precision is irrelevant)
+    dt_s = ((mjd_i - int(PEPOCH)) * SECPERDAY
+            + (mjd_f - (PEPOCH - int(PEPOCH))) * SECPERDAY
+            - disp_s)
+
+    # prefit phase residuals (nearest-turn wrap).  F0 * dt is ~1e9
+    # turns for an MSP campaign — one f64 product would cost ns-level
+    # rounding — so the integer-day part is reduced modulo 1 in exact
+    # rational arithmetic (mirroring synth/archive.py's spin_coherent
+    # phasing) and only the < half-day remainder (~1e7 turns, ~0.01 ns
+    # f64 error) is a float product.
+    from fractions import Fraction
+
+    F0r = Fraction(F0)
+    pep_i = int(PEPOCH)
+    phase_day = np.array(
+        [float((F0r * ((int(di) - pep_i) * 86400)) % 1) for di in mjd_i])
+    phase_rem = F0 * ((mjd_f - (PEPOCH - pep_i)) * SECPERDAY - disp_s)
+    phase = phase_day + phase_rem
+    dphase = phase - np.round(phase)
+    r_t = dphase / F0  # seconds
+
+    # design matrix, time rows: d(model delay)/d(param) in seconds
+    cols = {}
+    cols["OFFSET"] = np.ones(n)
+    # spin columns carry tempo's sign convention: the fitted value is
+    # the CORRECTION TO ADD to the par parameter (residuals shrink when
+    # the par moves toward truth)
+    if fit_f0:
+        cols["F0"] = -dt_s / F0
+    if fit_f1:
+        cols["F1"] = -0.5 * dt_s ** 2.0 / F0
+    # DMX columns affect BOTH the time rows (through the dispersion
+    # delay at the TOA frequency) and the DM rows
+    names = list(cols)
+    A_t = np.stack([cols[k] for k in names], axis=1)
+    dmx_t = np.zeros((n, nep))
+    finite = np.isfinite(freqs)
+    for j in range(nep):
+        sel = (epochs == j) & finite
+        dmx_t[sel, j] = Dconst * freqs[sel] ** -2.0
+    A_t = np.concatenate([A_t, dmx_t], axis=1)
+
+    # DM rows: residual = DM_i - (DM0 + DMX[epoch])
+    r_d = dms - DM0
+    A_d = np.zeros((n, A_t.shape[1]))
+    for j in range(nep):
+        A_d[epochs == j, len(names) + j] = 1.0
+
+    # stack and whiten
+    sig_t = errs_us * 1e-6
+    A = np.concatenate([A_t / sig_t[:, None], A_d / dm_errs[:, None]])
+    r = np.concatenate([r_t / sig_t, r_d / dm_errs])
+
+    # column-normalize: the raw design spans ~12 decades (seconds-per-Hz
+    # vs seconds-per-DM columns), which wrecks both lstsq conditioning
+    # and pinv's singular-value threshold for the covariance
+    col = np.sqrt((A ** 2.0).sum(axis=0))
+    col = np.where(col > 0, col, 1.0)
+    An = A / col
+    xn, *_ = np.linalg.lstsq(An, r, rcond=None)
+    x = xn / col
+    cov = (np.linalg.pinv(An.T @ An) / col[:, None]) / col[None, :]
+    perr = np.sqrt(np.maximum(np.diag(cov), 0.0))
+
+    post_t = r_t - A_t @ x
+    post_d = r_d - A_d @ x
+    chi2 = float(((post_t / sig_t) ** 2.0).sum()
+                 + ((post_d / dm_errs) ** 2.0).sum())
+    dof = 2 * n - A.shape[1]
+    w = sig_t ** -2.0
+    wrms = np.sqrt((post_t ** 2.0 * w).sum() / w.sum()) * 1e6
+
+    params = dict(zip(names, x[:len(names)]))
+    param_errs = dict(zip(names, perr[:len(names)]))
+    return WidebandGLSResult(
+        params=params, param_errs=param_errs,
+        time_resids_us=post_t * 1e6, prefit_resids_us=r_t * 1e6,
+        dm_resids=post_d, toa_errs_us=errs_us, dm_errs=dm_errs,
+        epochs=epochs, dmx=x[len(names):], dmx_errs=perr[len(names):],
+        chi2=chi2, dof=dof, wrms_us=float(wrms))

@@ -1,0 +1,95 @@
+"""Close the timing loop: synthetic archives with injected per-epoch
+dDMs -> wideband TOAs -> .tim -> in-repo NumPy wideband GLS -> white
+residuals and recovered DMX.
+
+This is the reference notebook's final tempo GLS validation
+(examples/example_make_model_and_TOAs.ipynb cells 43-56, DMDATA 1)
+without the tempo binary."""
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.io import write_gmodel
+from pulseportraiture_tpu.io.psrfits import parse_parfile
+from pulseportraiture_tpu.io.tim import write_TOAs
+from pulseportraiture_tpu.pipeline import GetTOAs
+from pulseportraiture_tpu.synth import default_test_model, make_fake_pulsar
+from pulseportraiture_tpu.timing import read_tim, wideband_gls_fit
+from pulseportraiture_tpu.utils.mjd import MJD
+
+PAR = {"PSR": "J1744-1134", "RAJ": "17:44:29.4", "DECJ": "-11:34:54.6",
+       "P0": 0.004074, "PEPOCH": 55150.0, "DM": 3.139}
+DDMS = [3e-4, -2e-4, 5e-4, -4e-4]
+PHASES = [0.017, 0.017, 0.017, 0.017]  # common achromatic offset
+
+
+@pytest.fixture(scope="module")
+def tim_path(tmp_path_factory):
+    root = tmp_path_factory.mktemp("timing")
+    model = default_test_model(1500.0)
+    gmodel = str(root / "model.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files = []
+    for i, dDM in enumerate(DDMS):
+        path = str(root / f"ep{i}.fits")
+        make_fake_pulsar(model, PAR, outfile=path, nsub=3, nchan=32,
+                         nbin=256, nu0=1500.0, bw=800.0, tsub=120.0,
+                         phase=PHASES[i], dDM=dDM,
+                         start_MJD=MJD(55100 + 30 * i, 0.2),
+                         noise_stds=0.05, dedispersed=False, quiet=True,
+                         rng=500 + i, spin_coherent=True)
+        files.append(path)
+    gt = GetTOAs(files, gmodel, quiet=True)
+    gt.get_TOAs(quiet=True)
+    out = str(root / "epochs.tim")
+    write_TOAs(gt.TOA_list, outfile=out)
+    return out
+
+
+def test_read_tim_roundtrip(tim_path):
+    toas = read_tim(tim_path)
+    assert len(toas) == 4 * 3
+    t = toas[0]
+    assert t.dm is not None and t.dm_err > 0
+    assert 55099 < t.mjd < 55200
+    assert t.error_us < 10
+    assert np.isfinite(t.frequency)
+    # digit-exact MJD split
+    assert 0.0 <= t.mjd_frac < 1.0
+
+
+def test_wideband_gls_whitens_and_recovers_dmx(tim_path):
+    toas = read_tim(tim_path)
+    par = parse_parfile([f"{k} {v}" for k, v in PAR.items()])
+    res = wideband_gls_fit(toas, par, fit_f0=True)
+    # four observing epochs found
+    assert len(res.dmx) == 4
+    # post-fit arrival-time residuals are white at the TOA errors:
+    # reduced chi^2 near 1 and per-TOA residuals within ~4 sigma
+    assert 0.3 < res.red_chi2 < 3.0, res.red_chi2
+    assert np.all(np.abs(res.time_resids_us)
+                  < 5.0 * res.toa_errs_us), res.time_resids_us
+    # the fit actually improved things (prefit carries the dDM signal)
+    assert res.wrms_us < np.sqrt(np.mean(res.prefit_resids_us ** 2.0))
+    # recovered per-epoch DMX match the injected dDMs
+    for j, dDM in enumerate(DDMS):
+        assert res.dmx[j] == pytest.approx(
+            dDM, abs=max(4.0 * res.dmx_errs[j], 3e-5)), (j, dDM)
+    # DM residuals consistent with their errors
+    assert np.all(np.abs(res.dm_resids) < 5.0 * res.dm_errs)
+
+
+def test_gls_detects_injected_spin_offset(tim_path):
+    """A deliberate F0 perturbation in the par must be absorbed by the
+    fitted dF0 and still produce white residuals."""
+    toas = read_tim(tim_path)
+    par = dict(PAR)
+    f0 = 1.0 / PAR["P0"]
+    par.pop("P0")
+    par["F0"] = f0 * (1.0 + 3e-12)  # ~ 0.7 ns/day drift
+    res = wideband_gls_fit(toas, par, fit_f0=True)
+    # 1% recovery: the formal error (~0.06%) undershoots because the
+    # F0/DMX/offset covariance leaves a few-ns systematic floor from
+    # the TOA measurement itself; the injected drift is recovered to
+    # 0.3% in practice
+    assert res.params["F0"] == pytest.approx(-f0 * 3e-12, rel=0.01)
