@@ -141,23 +141,15 @@ def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
 
     spin_fracs = np.zeros(nsub)
     if spin_coherent:
-        # frac(F0 * (epoch - PEPOCH)) per subint, exactly: the product
-        # is ~1e9 turns, so f64 would alias the fractional turn; use
-        # rational arithmetic on the parfile strings and the (int day,
-        # f64 frac) epoch representation
-        from decimal import Decimal
-        from fractions import Fraction
+        # frac(F0 * (epoch - PEPOCH)) per subint, exactly (~1e9 turns,
+        # beyond f64) — shared rational helper so the timing fit
+        # reduces with the identical F0 representation
+        from ..utils.spin import spin_F0, spin_phase_frac
 
-        def _rat(v):
-            return Fraction(Decimal(
-                str(v).replace("D", "E").replace("d", "e")))
-
-        F0r = _rat(par["F0"]) if "F0" in par else 1 / _rat(par["P0"])
-        PEPOCHr = _rat(par.get("PEPOCH", PEPOCH))
+        F0r = spin_F0(par)
+        pep = par.get("PEPOCH", PEPOCH)
         for isub, e in enumerate(epochs):
-            dt_sec = (Fraction(e.day) - PEPOCHr) * 86400 \
-                + Fraction(e.frac) * 86400
-            spin_fracs[isub] = float((F0r * dt_sec) % 1)
+            spin_fracs[isub] = spin_phase_frac(F0r, pep, e.day, e.frac)
 
     amps = np.zeros((nsub, npol, nchan, nbin))
     for isub in range(nsub):

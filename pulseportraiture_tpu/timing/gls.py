@@ -81,7 +81,6 @@ def wideband_gls_fit(toas, par, fit_f0=True, fit_f1=False,
         v = par.get(key, default)
         return float(str(v).replace("D", "E")) if v is not None else None
 
-    F0 = fget("F0") or 1.0 / fget("P0")
     PEPOCH = fget("PEPOCH")
     DM0 = fget("DM", 0.0)
 
@@ -116,15 +115,18 @@ def wideband_gls_fit(toas, par, fit_f0=True, fit_f1=False,
     # prefit phase residuals (nearest-turn wrap).  F0 * dt is ~1e9
     # turns for an MSP campaign — one f64 product would cost ns-level
     # rounding — so the integer-day part is reduced modulo 1 in exact
-    # rational arithmetic (mirroring synth/archive.py's spin_coherent
-    # phasing) and only the < half-day remainder (~1e7 turns, ~0.01 ns
-    # f64 error) is a float product.
-    from fractions import Fraction
+    # rational arithmetic via the SAME helper/representation the
+    # spin-coherent synth uses (utils/spin.py; a float-rounded F0 here
+    # would fake a ~1 ns/100 days residual slope against it), and only
+    # the < half-day remainder (~1e7 turns, ~0.01 ns f64 error) is a
+    # float product.
+    from ..utils.spin import day_phase_frac, spin_F0
 
-    F0r = Fraction(F0)
+    F0r = spin_F0(par)
+    F0 = float(F0r)  # design/conversion value, consistent with F0r
     pep_i = int(PEPOCH)
     phase_day = np.array(
-        [float((F0r * ((int(di) - pep_i) * 86400)) % 1) for di in mjd_i])
+        [day_phase_frac(F0r, pep_i, di) for di in mjd_i])
     phase_rem = F0 * ((mjd_f - (PEPOCH - pep_i)) * SECPERDAY - disp_s)
     phase = phase_day + phase_rem
     dphase = phase - np.round(phase)

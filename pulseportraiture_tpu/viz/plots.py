@@ -124,9 +124,9 @@ def show_residual_plot(port, model, phases=None, freqs=None,
     extent = [phases[0], phases[-1], freqs[0], freqs[-1]]
     fig, axes = plt.subplots(2, 2, figsize=(9, 7))
     panels = [(port, "Data"), (model, "Model"), (resid, "Residuals")]
-    for ax, (img, name) in zip(axes.flat, panels):
+    for i, (ax, (img, name)) in enumerate(zip(axes.flat, panels)):
         ax.imshow(img, aspect="auto", origin="lower", extent=extent)
-        ax.set_title(titles[panels.index((img, name))] if titles else name)
+        ax.set_title(titles[i] if titles else name)
         ax.set_xlabel("Phase [rot]")
         ax.set_ylabel("Frequency [MHz]")
     ax = axes.flat[3]
