@@ -144,10 +144,10 @@ def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
         # frac(F0 * (epoch - PEPOCH)) per subint, exactly (~1e9 turns,
         # beyond f64) — shared rational helper so the timing fit
         # reduces with the identical F0 representation
-        from ..utils.spin import spin_F0, spin_phase_frac
+        from ..utils.spin import rational, spin_F0, spin_phase_frac
 
         F0r = spin_F0(par)
-        pep = par.get("PEPOCH", PEPOCH)
+        pep = rational(par.get("PEPOCH", PEPOCH))  # parsed once
         for isub, e in enumerate(epochs):
             spin_fracs[isub] = spin_phase_frac(F0r, pep, e.day, e.frac)
 

@@ -17,7 +17,10 @@ __all__ = ["rational", "spin_F0", "spin_phase_frac", "day_phase_frac"]
 
 def rational(v):
     """Exact Fraction from a parfile-style number: string (FORTRAN
-    D-exponents included), float (exact binary value), or int."""
+    D-exponents included), float (exact binary value), int, or an
+    already-converted Fraction (passed through)."""
+    if isinstance(v, Fraction):
+        return v
     if isinstance(v, float):
         return Fraction(v)
     return Fraction(Decimal(str(v).replace("D", "E").replace("d", "e")))
