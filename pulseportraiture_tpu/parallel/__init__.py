@@ -1,6 +1,8 @@
 from .mesh import make_mesh, batch_sharding, replicated
 from .batch import (fit_portrait_sharded, fit_portrait_sharded_fast,
                     shard_batch)
+from .multihost import (global_mesh, init_multihost, process_allgather,
+                        process_count, process_index, shard_files)
 
 __all__ = [
     "make_mesh",
@@ -9,4 +11,10 @@ __all__ = [
     "fit_portrait_sharded",
     "fit_portrait_sharded_fast",
     "shard_batch",
+    "init_multihost",
+    "process_count",
+    "process_index",
+    "shard_files",
+    "global_mesh",
+    "process_allgather",
 ]

@@ -1,0 +1,107 @@
+"""Multi-host (multi-process) scale-out over DCN.
+
+The workload's distributed structure (SURVEY.md §2.9): per-(archive,
+subint) fits are independent, so the campaign axis parallelizes across
+HOSTS with no inter-host communication at all — each process measures
+its own archive shard and only small TOA summaries ever cross the
+data-center network.  Within a host/slice, the ('data', 'chan') mesh
+of parallel/mesh.py handles the chips (ICI); a GLOBAL mesh over all
+processes' devices is only needed when one enormous fit must span
+hosts (possible — the chi^2 reduction becomes a psum over DCN — but
+never required at realistic portrait sizes).
+
+Recipe (one process per host, standard JAX distributed bootstrap):
+
+    from pulseportraiture_tpu import parallel
+    parallel.init_multihost(coordinator_address="host0:1234",
+                            num_processes=N, process_id=i)
+    mine = parallel.shard_files(datafiles)         # this host's slice
+    res = stream_wideband_TOAs(mine, model, tim_out=f"part{i}.tim")
+    # .tim parts concatenate; or gather summaries in-process (returns
+    # one array per process; ragged shard lengths are handled):
+    per_host_dms = parallel.process_allgather(res.DeltaDM_means)
+
+Everything degrades to a no-op single-process path, which is how the
+test suite exercises it (the driver's dryrun and the 8-virtual-device
+tests cover the intra-host mesh; true multi-host needs real hosts).
+"""
+
+import jax
+import numpy as np
+
+from .mesh import make_mesh
+
+__all__ = ["init_multihost", "process_count", "process_index",
+           "shard_files", "global_mesh", "process_allgather"]
+
+
+def init_multihost(coordinator_address=None, num_processes=None,
+                   process_id=None, **kwargs):
+    """Initialize JAX's distributed runtime (multi-host).
+
+    With explicit arguments, failures raise.  With none, defer to
+    JAX's own cluster auto-detection (SLURM, GCE TPU pods, the
+    JAX_COORDINATOR_ADDRESS env family): if a cluster is detected the
+    runtime initializes and True is returned; on a plain single
+    machine the detection error is swallowed and False is returned, so
+    the single-process path stays safe on laptops and CI."""
+    if (coordinator_address is None and num_processes is None
+            and process_id is None and not kwargs):
+        try:
+            jax.distributed.initialize()
+            return True
+        except (ValueError, RuntimeError):
+            return False  # no cluster detected: single process
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id, **kwargs)
+    return True
+
+
+def process_count():
+    return jax.process_count()
+
+
+def process_index():
+    return jax.process_index()
+
+
+def shard_files(datafiles, index=None, count=None):
+    """This process's round-robin slice of a campaign file list.
+
+    Round-robin (not contiguous blocks) so heterogeneous archive sizes
+    balance across hosts without knowing them in advance."""
+    index = jax.process_index() if index is None else int(index)
+    count = jax.process_count() if count is None else int(count)
+    return list(datafiles)[index::count]
+
+
+def global_mesh(n_chan=1):
+    """A ('data', 'chan') mesh over ALL processes' devices (DCN+ICI).
+    Under a single process this is exactly make_mesh().  Sharding a
+    single fit's channel axis across hosts turns the chi^2 reduction
+    into a psum over DCN — legal, but prefer host-sharded campaigns
+    (shard_files) whenever fits fit on one host."""
+    return make_mesh(n_chan=n_chan, devices=list(jax.devices()))
+
+
+def process_allgather(x):
+    """Gather a small per-process 1-D array to every process (host
+    numpy in; returns a LIST of per-process arrays, which may have
+    different lengths — round-robin campaign shards are ragged
+    whenever the process count does not divide the file count, and the
+    underlying collective needs uniform shapes, so lengths are
+    exchanged first and the payload NaN-padded to the max).
+    Single-process: [x]."""
+    x = np.atleast_1d(np.asarray(x, np.float64))
+    if jax.process_count() == 1:
+        return [x]
+    from jax.experimental import multihost_utils
+
+    lens = np.asarray(multihost_utils.process_allgather(
+        np.asarray(len(x), np.int64)))
+    n_max = int(lens.max())
+    pad = np.full(n_max, np.nan)
+    pad[: len(x)] = x
+    g = np.asarray(multihost_utils.process_allgather(pad))
+    return [g[i, : int(lens[i])] for i in range(len(lens))]

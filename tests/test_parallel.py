@@ -97,3 +97,77 @@ def test_sharded_fast_matches_batch(batch):
         fit_portrait_sharded_fast(
             make_mesh(n_data=8, n_chan=1), ports, models, stds, FREQS, P,
             nu_fit, theta0=bad)
+
+
+class TestMultihost:
+    """Multi-host helpers on the single-process path (true multi-host
+    needs real hosts; the campaign sharding logic and global-mesh
+    construction are what can and must be exercised here)."""
+
+    def test_init_is_noop_without_config(self, monkeypatch):
+        from pulseportraiture_tpu import parallel
+
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        assert parallel.init_multihost() is False
+
+    def test_shard_files_round_robin(self):
+        from pulseportraiture_tpu import parallel
+
+        files = [f"a{i}.fits" for i in range(10)]
+        parts = [parallel.shard_files(files, index=i, count=3)
+                 for i in range(3)]
+        # disjoint, complete, round-robin balanced
+        assert sorted(sum(parts, [])) == sorted(files)
+        assert [len(p) for p in parts] == [4, 3, 3]
+        assert parts[0] == ["a0.fits", "a3.fits", "a6.fits", "a9.fits"]
+        # defaults: single process owns everything
+        assert parallel.shard_files(files) == files
+        assert parallel.process_count() == 1
+        assert parallel.process_index() == 0
+
+    def test_global_mesh_and_allgather(self):
+        from pulseportraiture_tpu import parallel
+
+        mesh = parallel.global_mesh(n_chan=2)
+        assert mesh.axis_names == ("data", "chan")
+        assert mesh.devices.shape == (4, 2)  # 8 virtual devices
+        g = parallel.process_allgather(np.arange(3.0))
+        assert len(g) == 1 and g[0].shape == (3,)
+
+    def test_sharded_campaign_partition_runs(self, tmp_path):
+        """Each 'host' slice of a campaign streams independently and
+        the concatenated results equal the single-process run."""
+        from pulseportraiture_tpu import parallel
+        from pulseportraiture_tpu.io import write_gmodel
+        from pulseportraiture_tpu.pipeline.stream import stream_wideband_TOAs
+        from pulseportraiture_tpu.synth import (default_test_model,
+                                                make_fake_pulsar)
+        from pulseportraiture_tpu.utils.mjd import MJD
+
+        model = default_test_model(1500.0)
+        gmodel = str(tmp_path / "m.gmodel")
+        write_gmodel(model, gmodel, quiet=True)
+        files = []
+        for i in range(4):
+            p = str(tmp_path / f"c{i}.fits")
+            make_fake_pulsar(model, {"PSR": "F", "P0": 0.003, "DM": 10.0,
+                                     "PEPOCH": 55000.0},
+                             outfile=p, nsub=2, nchan=16, nbin=128,
+                             dDM=1e-4 * i, start_MJD=MJD(55100 + i, 0.1),
+                             noise_stds=0.05, dedispersed=False,
+                             quiet=True, rng=i)
+            files.append(p)
+        whole = stream_wideband_TOAs(files, gmodel, nsub_batch=4,
+                                     quiet=True)
+        parts = []
+        for i in range(2):
+            mine = parallel.shard_files(files, index=i, count=2)
+            parts.append(stream_wideband_TOAs(mine, gmodel, nsub_batch=4,
+                                              quiet=True))
+        got = {(t.archive, t.flags["subint"]): t.MJD
+               for r in parts for t in r.TOA_list}
+        want = {(t.archive, t.flags["subint"]): t.MJD
+                for t in whole.TOA_list}
+        assert got.keys() == want.keys()
+        for k in want:
+            assert abs((got[k] - want[k]) * 86400.0) < 1e-12
