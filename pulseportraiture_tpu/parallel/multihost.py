@@ -50,8 +50,16 @@ def init_multihost(coordinator_address=None, num_processes=None,
         try:
             jax.distributed.initialize()
             return True
-        except (ValueError, RuntimeError):
-            return False  # no cluster detected: single process
+        except (ValueError, RuntimeError) as e:
+            # only the detection failure is a legitimate single-process
+            # signal ("coordinator_address should be defined"); a
+            # DETECTED cluster whose bootstrap failed (unreachable
+            # coordinator, double initialization) must surface — a
+            # swallowed error would make every task run the full
+            # campaign as process 0 of 1
+            if "coordinator_address" in str(e):
+                return False  # no cluster detected: single process
+            raise
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes, process_id=process_id, **kwargs)

@@ -20,10 +20,11 @@ levels of overlap keep every resource busy:
   returning a single packed per-subint result array (one small
   device->host pull per bucket).
 
-Raw mode needs an int16 DATA column, npol == 1, dispersed-on-disk
-data, and no tscrunch; anything else falls back to the decoded
-(host-side load_data) lane per archive, bit-compatible with round-1
-behavior.
+Raw mode needs an int16 DATA column and either npol == 1 or an IQUV
+state (Stokes I = pol 0, sliced with no extra bytes); dedispersed-on-
+disk archives are re-dispersed ON DEVICE by the stored DM (host-wrapped
+f64 turns, matmul-DFT rotation).  AA+BB multi-pol or tscrunch fall
+back to the decoded (host-side load_data) lane per archive.
 
 Scope: campaign configurations — wideband (phi[, DM]) fits, plus
 scattering (fit_scat/log10_tau/scat_guess/fix_alpha as in GetTOAs).
@@ -44,7 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import scattering_alpha
+from ..config import Dconst, scattering_alpha
 from ..fit.portrait import (FitFlags, _fast_batch_fn, estimate_tau_batch,
                             fit_portrait_batch, fit_portrait_batch_fast,
                             use_bf16_cross_spectrum, use_fast_fit_default,
@@ -78,6 +79,7 @@ class _Bucket:
         self.raw = []               # 'raw': (nchan, nbin) int16
         self.scl = []               # 'raw': (nchan,) f32
         self.offs = []              # 'raw': (nchan,) f32
+        self.dedisp = []            # 'raw': (DM, nu0) to re-disperse by
         self.noise = []             # 'dec': (nchan,)
         self.masks = []             # each (nchan,)
         self.Ps = []
@@ -90,22 +92,27 @@ class _Bucket:
         return len(self.owners)
 
     def clear(self):
-        for lst in (self.ports, self.raw, self.scl, self.offs, self.noise,
-                    self.masks, self.Ps, self.nu_fits, self.theta0,
-                    self.DM_guess, self.owners):
+        for lst in (self.ports, self.raw, self.scl, self.offs, self.dedisp,
+                    self.noise, self.masks, self.Ps, self.nu_fits,
+                    self.theta0, self.DM_guess, self.owners):
             lst.clear()
 
 
 def _load_raw(f):
     """Raw streaming load: undecoded int16 samples + the small per-
-    archive metadata TOA assembly needs.  Raises ValueError when raw
-    mode cannot represent the archive (non-int16 DATA, npol > 1, or
-    dedispersed on disk — the decoded lane handles those)."""
+    archive metadata TOA assembly needs.
+
+    npol > 1 is supported for IQUV states (Stokes I is pol 0 — sliced
+    with no extra bytes shipped); AA+BB needs a host pscrunch, so it
+    falls back.  Dedispersed-on-disk archives are supported: the device
+    program re-disperses them (matmul-DFT rotation by the stored DM)
+    before fitting, mirroring load_data's dededisperse-on-load.
+    Raises ValueError when raw mode cannot represent the archive
+    (non-int16 DATA, non-IQUV multi-pol)."""
     arch = read_archive(f, decode=False)
-    if arch.npol != 1:
-        raise ValueError("raw streaming mode needs npol == 1")
-    if arch.get_dedispersed():
-        raise ValueError("raw streaming mode needs dispersed-on-disk data")
+    if arch.npol != 1 and arch.get_state() != "Stokes":
+        raise ValueError(
+            "raw streaming mode needs npol == 1 or an IQUV state")
     weights = arch.get_weights()
     weights_norm = np.where(weights == 0.0, 0.0, 1.0)
     nsub = arch.nsub
@@ -121,6 +128,8 @@ def _load_raw(f):
         epochs=arch.epochs(), subtimes=list(arch.tsubints),
         doppler_factors=arch.doppler_factors(),
         DM=arch.get_dispersion_measure(),
+        dmc=bool(arch.get_dedispersed()),
+        nu0=arch.get_centre_frequency(),
         backend=arch.get_backend_name(),
         frontend=arch.get_receiver_name(),
         backend_delay=arch.get_backend_delay(),
@@ -130,7 +139,7 @@ def _load_raw(f):
 
 @lru_cache(maxsize=None)
 def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
-                use_fast, ftname, pallas, x_bf16):
+                use_fast, ftname, pallas, x_bf16, redisp=False):
     """ONE jitted program for a raw bucket: int16 decode (scl/offs),
     min-window baseline subtraction, power-spectrum noise, S/N,
     nu_fit seeding, the batched fit, and result packing into a single
@@ -148,9 +157,24 @@ def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
     tiny = float(np.finfo(ftname).tiny)
 
     def run(raw, scl, offs, cmask, modelx, freqs, Ps, DMg, nu_out,
-            tau_s, tau_nu, tau_a, alpha0):
+            tau_s, tau_nu, tau_a, alpha0, redisp_turns):
         x = raw.astype(ft) * scl[..., None] + offs[..., None]
         x = x - min_window_baseline(x)[..., None]
+        if redisp:
+            # dedispersed-on-disk archives: restore the dispersion
+            # delays of the stored DM (load_data's dededisperse, here
+            # as a matmul-DFT phasor rotation on device).  The turns
+            # arrive from host pre-wrapped mod 1 in f64 — raw delays
+            # reach hundreds of turns, beyond f32.  Convention matches
+            # io/psrfits.rotate_phase(amps, -delays) (psrfits.py:377):
+            # phasor exp(-2 i pi k delays).
+            from ..ops.fourier import irfft_mm, rfft_mm
+
+            k = jnp.arange(nbin // 2 + 1, dtype=ft)
+            ang = -2.0 * jnp.pi * redisp_turns.astype(ft)[..., None] * k
+            c, s = jnp.cos(ang), jnp.sin(ang)
+            Xr, Xi = rfft_mm(x)
+            x = irfft_mm(Xr * c - Xi * s, Xr * s + Xi * c, nbin)
         noise = jnp.maximum(get_noise_PS(x), tiny)
         snr = get_SNR(x, noise) * cmask
         # S/N * nu^-2-weighted center-of-mass frequency (host mirror:
@@ -233,6 +257,17 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
         scl = np.stack([bucket.scl[i] for i in idx0])
         offs = np.stack([bucket.offs[i] for i in idx0])
         DMg = np.asarray([bucket.DM_guess[i] for i in idx0])
+        dedisp = np.asarray([bucket.dedisp[i] for i in idx0])  # (n, 2)
+        redisp = bool(np.any(dedisp[:, 0] != 0.0))
+        if redisp:
+            # f64 on host, wrapped to [-0.5, 0.5) turns before the f32
+            # device trig (raw delays reach 100s of turns)
+            freqs_h = np.asarray(bucket.freqs, np.float64)
+            turns = (Dconst * dedisp[:, :1] / Ps[:, None]) * (
+                freqs_h[None, :] ** -2.0 - dedisp[:, 1:] ** -2.0)
+            turns = (turns + 0.5) % 1.0 - 0.5
+        else:
+            turns = np.zeros((len(idx0), 1))
         ftname = "float32" if use_fast else "float64"
         # pallas/bf16 config read per call (cache-key args, mirroring
         # _fast_batch_fn): mid-process config toggles take effect
@@ -241,7 +276,7 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                          int(max_iter), bool(log10_tau), tau_mode,
                          use_fast, ftname,
                          use_pallas_moments(np.dtype(ftname)),
-                         use_bf16_cross_spectrum())
+                         use_bf16_cross_spectrum(), redisp=redisp)
         ft = jnp.float32 if use_fast else jnp.float64
         t_s, t_nu, t_a = tau_args
         modelx, freqs = bucket.modelx, bucket.freqs
@@ -252,7 +287,8 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                       jnp.asarray(modelx, ft),
                       jnp.asarray(freqs, ft), jnp.asarray(Ps, ft),
                       jnp.asarray(DMg, ft), ft(nu_out),
-                      ft(t_s), ft(t_nu), ft(t_a), ft(alpha0))
+                      ft(t_s), ft(t_nu), ft(t_a), ft(alpha0),
+                      jnp.asarray(turns, ft))
     else:
         ports = np.stack([bucket.ports[i] for i in idx0])
         noise = np.stack([bucket.noise[i] for i in idx0])
@@ -583,6 +619,10 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                     b.scl.append(d.scl[isub])
                     b.offs.append(d.offs[isub])
                     b.DM_guess.append(DM_guess)
+                    # dedispersed-on-disk: the device program restores
+                    # the stored DM's delays before fitting
+                    b.dedisp.append((DM_stored if d.get("dmc") else 0.0,
+                                     float(d.get("nu0", 0.0) or 0.0)))
                 else:
                     th = np.zeros(5)
                     th[1] = DM_guess

@@ -105,10 +105,32 @@ class TestMultihost:
     construction are what can and must be exercised here)."""
 
     def test_init_is_noop_without_config(self, monkeypatch):
+        import jax
+
         from pulseportraiture_tpu import parallel
 
-        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        # isolate from the CI host: SLURM/OMPI/TPU env families would
+        # make bare initialize() auto-detect a cluster and block
+        def no_cluster():
+            raise ValueError("coordinator_address should be defined.")
+
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda *a, **k: no_cluster())
         assert parallel.init_multihost() is False
+
+    def test_init_raises_on_detected_cluster_failure(self, monkeypatch):
+        import jax
+
+        import pytest
+
+        from pulseportraiture_tpu import parallel
+
+        def broken(*a, **k):
+            raise RuntimeError("coordinator unreachable: host0:1234")
+
+        monkeypatch.setattr(jax.distributed, "initialize", broken)
+        with pytest.raises(RuntimeError, match="unreachable"):
+            parallel.init_multihost()
 
     def test_shard_files_round_robin(self):
         from pulseportraiture_tpu import parallel

@@ -179,3 +179,45 @@ def test_stream_scattering_matches_gettoas(tmp_path):
                   * (1400.0 / t.flags["scat_ref_freq"])
                   ** t.flags["scat_ind"])
         assert t_r.flags["scat_time"] == pytest.approx(expect, rel=1e-6)
+
+
+def test_stream_raw_lane_dedispersed_and_iquv(tmp_path):
+    """The raw lane covers dedispersed-on-disk archives (device-side
+    re-dispersion by the stored DM) and IQUV multi-pol archives
+    (Stokes I = pol 0, no host pscrunch) — results must match GetTOAs,
+    which handles both on host."""
+    from pulseportraiture_tpu.pipeline.stream import _load_raw
+
+    model = default_test_model(1500.0)
+    gmodel = str(tmp_path / "m.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files = []
+    for i, (dedisp, npol) in enumerate([(True, 1), (False, 4),
+                                        (True, 4)]):
+        p = str(tmp_path / f"v{i}.fits")
+        make_fake_pulsar(model, PAR, outfile=p, nsub=2, nchan=32,
+                         nbin=256, nu0=1500.0, bw=800.0, tsub=60.0,
+                         phase=0.01 * i, dDM=2e-4, npol=npol,
+                         state="Stokes",
+                         start_MJD=MJD(55300 + i, 0.2), noise_stds=0.05,
+                         dedispersed=dedisp, quiet=True, rng=700 + i)
+        files.append(p)
+    # all three land in the raw lane
+    for f in files:
+        d = _load_raw(f)
+        assert d.raw_mode and d.raw.dtype == np.dtype(np.int16)
+    assert _load_raw(files[0]).dmc is True
+    assert _load_raw(files[1]).dmc is False
+
+    res = stream_wideband_TOAs(files, gmodel, nsub_batch=4, quiet=True)
+    gt = GetTOAs(files, gmodel, quiet=True)
+    gt.get_TOAs(quiet=True, max_iter=25)
+    assert len(res.TOA_list) == len(gt.TOA_list) == 6
+    by_key = {(t.archive, t.flags["subint"]): t for t in res.TOA_list}
+    for t_ref in gt.TOA_list:
+        t = by_key[(t_ref.archive, t_ref.flags["subint"])]
+        # device re-dispersion (matmul DFT f64 on CPU) vs host pocketfft
+        # agree to float precision; phases to sub-ns
+        dt_us = abs((t.MJD - t_ref.MJD) * 86400.0 * 1e6)
+        assert dt_us < 1e-3, (t_ref.archive, dt_us)
+        assert t.DM == pytest.approx(t_ref.DM, abs=1e-7)
