@@ -50,13 +50,16 @@ def init_multihost(coordinator_address=None, num_processes=None,
         try:
             jax.distributed.initialize()
             return True
-        except (ValueError, RuntimeError) as e:
+        except ValueError as e:
             # only the detection failure is a legitimate single-process
-            # signal ("coordinator_address should be defined"); a
+            # signal — jax raises ValueError("coordinator_address
+            # should be defined.") when no cluster env is present.  A
             # DETECTED cluster whose bootstrap failed (unreachable
-            # coordinator, double initialization) must surface — a
-            # swallowed error would make every task run the full
-            # campaign as process 0 of 1
+            # coordinator, double initialization — RuntimeError in
+            # jax) must surface: a swallowed error would make every
+            # task run the full campaign as process 0 of 1.  The
+            # message match is asserted by tests so a jax rewording
+            # fails loudly there, not silently here.
             if "coordinator_address" in str(e):
                 return False  # no cluster detected: single process
             raise

@@ -26,9 +26,9 @@ disk archives are re-dispersed ON DEVICE by the stored DM (host-wrapped
 f64 turns, matmul-DFT rotation).  AA+BB multi-pol or tscrunch fall
 back to the decoded (host-side load_data) lane per archive.
 
-Scope: campaign configurations — wideband (phi[, DM]) fits, plus
+Scope: campaign configurations — wideband (phi[, DM[, GM]]) fits, plus
 scattering (fit_scat/log10_tau/scat_guess/fix_alpha as in GetTOAs).
-GM / instrumental response / flux remain GetTOAs-only.  No-scattering
+Instrumental response / flux remain GetTOAs-only.  No-scattering
 buckets take the complex-free f32 fast path on TPU backends
 (config.use_fast_fit), scattering buckets the complex engine; subints
 with a single usable channel are demoted to phase-only buckets (the
@@ -213,10 +213,7 @@ def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
                 fit_flags=FitFlags(*flags), chan_masks=cmask,
                 log10_tau=log10_tau, max_iter=max_iter,
                 use_scatter=scat_engine)
-        fields = [r.phi, r.phi_err, r.DM, r.DM_err, r.nu_DM, r.snr,
-                  r.chi2, r.dof, r.nfeval, r.return_code]
-        if flags[3]:
-            fields += [r.tau, r.tau_err, r.alpha, r.alpha_err, r.nu_tau]
+        fields = [getattr(r, k) for k in _result_keys(flags)]
         return jnp.stack([jnp.asarray(f).astype(ft) for f in fields])
 
     return jax.jit(run)
@@ -224,8 +221,19 @@ def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
 
 _RESULT_KEYS = ("phi", "phi_err", "DM", "DM_err", "nu_DM", "snr",
                 "chi2", "dof", "nfeval", "return_code")
-_SCAT_KEYS = _RESULT_KEYS + ("tau", "tau_err", "alpha", "alpha_err",
-                             "nu_tau")
+
+
+def _result_keys(flags):
+    """Per-subint result fields to pull for a bucket's flag set."""
+    keys = _RESULT_KEYS
+    if flags[2]:
+        # no nu_GM: the stream lane has no nu_refs output and the TOA
+        # flags don't carry it (matching get_TOAs' .tim emission), so
+        # pulling it would be a dead d2h row per dispatch
+        keys = keys + ("GM", "GM_err")
+    if flags[3]:
+        keys = keys + ("tau", "tau_err", "alpha", "alpha_err", "nu_tau")
+    return keys
 
 
 def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
@@ -248,7 +256,7 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
     masks = np.stack([bucket.masks[i] for i in idx0])
     Ps = np.asarray([bucket.Ps[i] for i in idx0])
     flags = FitFlags(*bucket.flags)
-    keys = _SCAT_KEYS if flags[3] else _RESULT_KEYS
+    keys = _result_keys(flags)
     nu_out = -1.0 if nu_ref_DM is None else float(nu_ref_DM)
     use_fast = use_fast_fit_default()
 
@@ -345,7 +353,8 @@ def _collect(rec, results):
 
 def _assemble_archive(m, results, modelfile, fit_DM, bary,
                       addtnl_toa_flags, log10_tau=False,
-                      alpha_fitted=False, nu_ref_tau=None):
+                      alpha_fitted=False, nu_ref_tau=None,
+                      fit_GM=False):
     """Build the TOA objects + DeltaDM stats for one archive from the
     scattered fit results."""
     toas, dDMs, dDM_errs = [], [], []
@@ -359,6 +368,14 @@ def _assemble_archive(m, results, modelfile, fit_DM, bary,
         df = m.dfs[j] if bary else 1.0
         DM_j = float(r["DM"]) * (df if (bary and fit_DM) else 1.0)
         flags = {}
+        if fit_GM:
+            # GM *= df^3 under bary, like the wideband pipeline
+            # (pptoas.py:583-591); flag emission follows the RUN's
+            # fit_GM like get_TOAs (a degenerate-geometry subint whose
+            # GM was dropped still reports gm 0.0, pptoas.py:629-631)
+            flags["gm"] = float(r.get("GM", 0.0)) * \
+                (df ** 3 if (bary and "GM" in r) else 1.0)
+            flags["gm_err"] = float(r.get("GM_err", 0.0))
         if "tau" in r:
             # same flag set as GetTOAs (scat_time in us, Doppler-
             # corrected like the wideband pipeline)
@@ -400,8 +417,8 @@ def _assemble_archive(m, results, modelfile, fit_DM, bary,
 
 
 def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
-                         fit_DM=True, nu_ref_DM=None, nu_ref_tau=None,
-                         DM0=None, bary=True,
+                         fit_DM=True, fit_GM=False, nu_ref_DM=None,
+                         nu_ref_tau=None, DM0=None, bary=True,
                          tscrunch=False, fit_scat=False, log10_tau=True,
                          scat_guess=None, fix_alpha=False, max_iter=25,
                          prefetch=True, max_inflight=4,
@@ -522,7 +539,7 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                     m, results, modelfile, fit_DM, bary,
                     addtnl_toa_flags, log10_tau=log10_tau,
                     alpha_fitted=fit_scat and not fix_alpha,
-                    nu_ref_tau=nu_ref_tau)
+                    nu_ref_tau=nu_ref_tau, fit_GM=fit_GM)
                 assembled[ia] = out
                 # the per-subint records are folded into the assembly;
                 # dropping them keeps host memory O(bucket)
@@ -602,13 +619,20 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                     default_alpha,
                     ports=ports, modelx=modelx, noise=noise, masks=masks)
 
-            base_flags = (True, bool(fit_DM), False, bool(fit_scat),
+            base_flags = (True, bool(fit_DM), bool(fit_GM),
+                          bool(fit_scat),
                           bool(fit_scat and not fix_alpha))
             kind = "raw" if raw_mode else "dec"
             for j, isub in enumerate(ok):
-                # degenerate geometry: 1 usable channel -> phase-only
-                eff_flags = ((True, False, False, False, False)
-                             if nchx[j] <= 1 else base_flags)
+                # degenerate geometry (pptoas.py:519-527, mirrored from
+                # GetTOAs): 1 usable channel -> phase-only; 2 -> no GM
+                if nchx[j] <= 1:
+                    eff_flags = (True, False, False, False, False)
+                elif nchx[j] == 2 and base_flags[2]:
+                    eff_flags = (True, base_flags[1], False,
+                                 base_flags[3], base_flags[4])
+                else:
+                    eff_flags = base_flags
                 key = base_key + (eff_flags, kind)
                 if key not in buckets:
                     buckets[key] = _Bucket(freqs0, nbin, modelx, eff_flags,
@@ -656,7 +680,7 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
         toas, mean, err = assembled.get(m.iarch) or _assemble_archive(
             m, results, modelfile, fit_DM, bary, addtnl_toa_flags,
             log10_tau=log10_tau, alpha_fitted=fit_scat and not fix_alpha,
-            nu_ref_tau=nu_ref_tau)
+            nu_ref_tau=nu_ref_tau, fit_GM=fit_GM)
         TOA_list.extend(toas)
         order.append(m.datafile)
         DM0s.append(m.DM0_arch)

@@ -193,3 +193,33 @@ class TestMultihost:
         assert got.keys() == want.keys()
         for k in want:
             assert abs((got[k] - want[k]) * 86400.0) < 1e-12
+
+    def test_jax_no_cluster_error_contract(self):
+        """Pins the jax no-cluster error message that init_multihost's
+        single-process fallback matches on — a jax rewording must fail
+        HERE, not silently crash laptops in production.  Runs in a
+        fresh subprocess: in-suite the backend is already initialized
+        and jax raises a different (RuntimeError) guard first."""
+        import os
+        import subprocess
+        import sys
+
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("SLURM", "OMPI_", "TPU_",
+                                    "JAX_COORD", "CLOUD_TPU"))}
+        env["JAX_PLATFORMS"] = "cpu"
+        code = (
+            "import jax\n"
+            "try:\n"
+            "    jax.distributed.initialize()\n"
+            "except ValueError as e:\n"
+            "    assert 'coordinator_address' in str(e), str(e)\n"
+            "    print('CONTRACT-OK')\n"
+            "else:\n"
+            "    print('CLUSTER-DETECTED')\n"
+        )
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=120)
+        assert ("CONTRACT-OK" in out.stdout
+                or "CLUSTER-DETECTED" in out.stdout), (out.stdout,
+                                                       out.stderr)

@@ -221,3 +221,43 @@ def test_stream_raw_lane_dedispersed_and_iquv(tmp_path):
         dt_us = abs((t.MJD - t_ref.MJD) * 86400.0 * 1e6)
         assert dt_us < 1e-3, (t_ref.archive, dt_us)
         assert t.DM == pytest.approx(t_ref.DM, abs=1e-7)
+
+
+def test_stream_gm_matches_gettoas(tmp_path):
+    """Streamed (phi, DM, GM) fits reproduce GetTOAs' GM results and
+    flags, including the 2-usable-channel no-GM demotion."""
+    model = default_test_model(1500.0)
+    gmodel = str(tmp_path / "m.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    w = np.ones((2, 32))
+    w[1, 2:] = 0.0  # subint 1: two usable channels -> GM dropped
+    path = str(tmp_path / "gm.fits")
+    make_fake_pulsar(model, PAR, outfile=path, nsub=2, nchan=32,
+                     nbin=256, nu0=1500.0, bw=800.0, tsub=60.0,
+                     dDM=1e-4, weights=w, start_MJD=MJD(55400, 0.2),
+                     noise_stds=0.03, dedispersed=False, quiet=True,
+                     rng=42)
+    res = stream_wideband_TOAs([path], gmodel, nsub_batch=4,
+                               fit_GM=True, quiet=True)
+    gt = GetTOAs(path, gmodel, quiet=True)
+    gt.get_TOAs(fit_GM=True, quiet=True, max_iter=25)
+    assert len(res.TOA_list) == 2
+    by_key = {t.flags["subint"]: t for t in res.TOA_list}
+    # the demoted 2-channel subint reports gm == 0.0 on both sides
+    # (GetTOAs emits the flag for every subint of a fit_GM run)
+    assert by_key[1].flags["gm"] == 0.0
+    assert gt.TOA_list[1].flags["gm"] == 0.0
+    for t_ref in gt.TOA_list:
+        t = by_key[t_ref.flags["subint"]]
+        if "gm" in t_ref.flags:
+            assert "gm" in t.flags
+            assert t.flags["gm"] == pytest.approx(t_ref.flags["gm"],
+                                                  abs=1e-9)
+            if t_ref.flags["gm_err"]:
+                assert t.flags["gm_err"] == pytest.approx(
+                    t_ref.flags["gm_err"], rel=1e-6)
+        else:  # pragma: no cover - gm is emitted for every subint
+            raise AssertionError("GetTOAs should emit gm for all subints")
+        assert t.DM == pytest.approx(t_ref.DM, abs=1e-9)
+        dt_us = abs((t.MJD - t_ref.MJD) * 86400.0 * 1e6)
+        assert dt_us < 1e-3
