@@ -26,9 +26,10 @@ disk archives are re-dispersed ON DEVICE by the stored DM (host-wrapped
 f64 turns, matmul-DFT rotation).  AA+BB multi-pol or tscrunch fall
 back to the decoded (host-side load_data) lane per archive.
 
-Scope: campaign configurations — wideband (phi[, DM[, GM]]) fits, plus
-scattering (fit_scat/log10_tau/scat_guess/fix_alpha as in GetTOAs).
-Instrumental response / flux remain GetTOAs-only.  No-scattering
+Scope: campaign configurations — wideband (phi[, DM[, GM]]) fits,
+scattering (fit_scat/log10_tau/scat_guess/fix_alpha as in GetTOAs), and
+flux estimates (print_flux).  Instrumental response and narrowband
+remain GetTOAs-only.  No-scattering
 buckets take the complex-free f32 fast path on TPU backends
 (config.use_fast_fit), scattering buckets the complex engine; subints
 with a single usable channel are demoted to phase-only buckets (the
@@ -139,7 +140,8 @@ def _load_raw(f):
 
 @lru_cache(maxsize=None)
 def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
-                use_fast, ftname, pallas, x_bf16, redisp=False):
+                use_fast, ftname, pallas, x_bf16, redisp=False,
+                want_flux=False):
     """ONE jitted program for a raw bucket: int16 decode (scl/offs),
     min-window baseline subtraction, power-spectrum noise, S/N,
     nu_fit seeding, the batched fit, and result packing into a single
@@ -214,6 +216,13 @@ def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
                 log10_tau=log10_tau, max_iter=max_iter,
                 use_scatter=scat_engine)
         fields = [getattr(r, k) for k in _result_keys(flags)]
+        if want_flux:
+            # flux reduces to 3 scalars per subint ON DEVICE: pulling
+            # the (nb, nchan) scales instead would break the
+            # one-small-pull design
+            fields += list(_flux_rows(r.scales, r.scale_errs,
+                                      jnp.mean(modelx, axis=-1),
+                                      cmask, freqs))
         return jnp.stack([jnp.asarray(f).astype(ft) for f in fields])
 
     return jax.jit(run)
@@ -238,7 +247,7 @@ def _result_keys(flags):
 
 def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
             tau_mode="none", tau_args=(0.0, 1.0, 0.0), alpha0=0.0,
-            executor=None):
+            executor=None, want_flux=False):
     """Launch ONE fused dispatch for a bucket's pending subints and
     return an in-flight record — WITHOUT waiting for the device.  The
     host->device copy (jnp.asarray) can be SYNCHRONOUS and is the
@@ -257,6 +266,8 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
     Ps = np.asarray([bucket.Ps[i] for i in idx0])
     flags = FitFlags(*bucket.flags)
     keys = _result_keys(flags)
+    if want_flux:
+        keys = keys + ("flux", "flux_err", "flux_ref_freq")
     nu_out = -1.0 if nu_ref_DM is None else float(nu_ref_DM)
     use_fast = use_fast_fit_default()
 
@@ -284,7 +295,8 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                          int(max_iter), bool(log10_tau), tau_mode,
                          use_fast, ftname,
                          use_pallas_moments(np.dtype(ftname)),
-                         use_bf16_cross_spectrum(), redisp=redisp)
+                         use_bf16_cross_spectrum(), redisp=redisp,
+                         want_flux=want_flux)
         ft = jnp.float32 if use_fast else jnp.float64
         t_s, t_nu, t_a = tau_args
         modelx, freqs = bucket.modelx, bucket.freqs
@@ -328,9 +340,16 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                     fit_flags=flags, chan_masks=jnp.asarray(masks),
                     log10_tau=log10_tau, max_iter=max_iter)
             # pack into one array so _collect costs a single d2h pull
-            # (~100 ms round-trip each on tunneled runtimes)
-            return jnp.stack([jnp.asarray(getattr(r, k)).astype(r.phi.dtype)
-                              for k in keys])
+            # (~100 ms round-trip each on tunneled runtimes); flux
+            # reduces to 3 per-subint rows on device (_flux_rows)
+            fields = [jnp.asarray(getattr(r, k)).astype(r.phi.dtype)
+                      for k in _result_keys(flags)]
+            if want_flux:
+                fields += [f.astype(r.phi.dtype) for f in _flux_rows(
+                    r.scales, r.scale_errs,
+                    jnp.mean(jnp.asarray(modelx), axis=-1),
+                    jnp.asarray(masks), jnp.asarray(freqs))]
+            return jnp.stack(fields)
 
     handle = executor.submit(dispatch) if executor is not None \
         else dispatch()
@@ -351,10 +370,41 @@ def _collect(rec, results):
     return owners
 
 
+def _flux_rows(scales, scale_errs, means, cmask, freqs):
+    """(flux, flux_err, flux_ref_freq) per subint, on device — the
+    streaming twin of the per-subint flux estimate (reference
+    pptoas.py:595-624, mirrored in pipeline/toas.py:594-621); parity
+    guarded by tests/test_stream.py::test_stream_flux_matches_gettoas.
+
+    The scattered-model branch of the reference is omitted on purpose:
+    the one-sided-exponential kernel has unit DC gain (B_0 = 1), so the
+    model CHANNEL MEANS — the only model quantity flux uses — are
+    unchanged by any fitted tau.
+
+    scales/scale_errs: (nb, nchan); means: (nchan,) model channel
+    means; cmask: (nb, nchan) 0/1; freqs: (nchan,)."""
+    fx = means[None, :] * scales
+    fe = jnp.abs(means)[None, :] * scale_errs
+    good = (fe > 0.0) & (cmask > 0.0)
+    w = jnp.where(good, 1.0 / jnp.where(good, fe, 1.0) ** 2.0, 0.0)
+    wsum = w.sum(axis=1)
+    ok = wsum > 0.0
+    wsafe = jnp.where(ok, wsum, 1.0)
+    nmask = jnp.maximum(cmask.sum(axis=1), 1.0)
+    # weighted_mean semantics (pipeline/toas.py:40-50): plain mean and
+    # infinite error when no positive-error channel exists
+    flux = jnp.where(ok, (fx * w).sum(axis=1) / wsafe,
+                     (fx * cmask).sum(axis=1) / nmask)
+    flux_err = jnp.where(ok, wsafe ** -0.5, jnp.inf)
+    ffreq = jnp.where(ok, (freqs[None, :] * w).sum(axis=1) / wsafe,
+                      (freqs[None, :] * cmask).sum(axis=1) / nmask)
+    return flux, flux_err, ffreq
+
+
 def _assemble_archive(m, results, modelfile, fit_DM, bary,
                       addtnl_toa_flags, log10_tau=False,
                       alpha_fitted=False, nu_ref_tau=None,
-                      fit_GM=False):
+                      fit_GM=False, print_flux=False):
     """Build the TOA objects + DeltaDM stats for one archive from the
     scattered fit results."""
     toas, dDMs, dDM_errs = [], [], []
@@ -402,6 +452,10 @@ def _assemble_archive(m, results, modelfile, fit_DM, bary,
             "tmplt": str(modelfile), "snr": float(r["snr"]),
             "gof": float(r["chi2"] / max(float(r["dof"]), 1.0)),
         })
+        if print_flux:
+            flags["flux"] = float(r["flux"])
+            flags["flux_err"] = float(r["flux_err"])
+            flags["flux_ref_freq"] = float(r["flux_ref_freq"])
         flags.update(addtnl_toa_flags)
         DM_out = DM_j if fit_DM else None
         DM_err_out = float(r["DM_err"]) if fit_DM else None
@@ -422,6 +476,7 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                          tscrunch=False, fit_scat=False, log10_tau=True,
                          scat_guess=None, fix_alpha=False, max_iter=25,
                          prefetch=True, max_inflight=4,
+                         print_flux=False,
                          addtnl_toa_flags={}, tim_out=None,
                          quiet=False):
     """Measure wideband (phi[, DM[, tau, alpha]]) TOAs for many
@@ -539,7 +594,8 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                     m, results, modelfile, fit_DM, bary,
                     addtnl_toa_flags, log10_tau=log10_tau,
                     alpha_fitted=fit_scat and not fix_alpha,
-                    nu_ref_tau=nu_ref_tau, fit_GM=fit_GM)
+                    nu_ref_tau=nu_ref_tau, fit_GM=fit_GM,
+                    print_flux=print_flux)
                 assembled[ia] = out
                 # the per-subint records are folded into the assembly;
                 # dropping them keeps host memory O(bucket)
@@ -553,7 +609,7 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
         rec = _launch(b, nu_ref_DM, max_iter, nsub_batch,
                       log10_tau=log10_tau, tau_mode=tau_mode,
                       tau_args=tau_args, alpha0=alpha0_run,
-                      executor=dispatch_ex)
+                      executor=dispatch_ex, want_flux=print_flux)
         if rec is None:
             return
         nfit += 1
@@ -680,7 +736,7 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
         toas, mean, err = assembled.get(m.iarch) or _assemble_archive(
             m, results, modelfile, fit_DM, bary, addtnl_toa_flags,
             log10_tau=log10_tau, alpha_fitted=fit_scat and not fix_alpha,
-            nu_ref_tau=nu_ref_tau, fit_GM=fit_GM)
+            nu_ref_tau=nu_ref_tau, fit_GM=fit_GM, print_flux=print_flux)
         TOA_list.extend(toas)
         order.append(m.datafile)
         DM0s.append(m.DM0_arch)

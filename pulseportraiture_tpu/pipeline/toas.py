@@ -590,24 +590,15 @@ class GetTOAs:
                 covariances[isub] = covs[j]
                 MJDs[isub] = toa_mjd.to_float()
 
-                # flux estimate (pptoas.py:595-624)
+                # flux estimate (pptoas.py:595-624).  The reference
+                # rebuilds the scattered model here, but the one-sided
+                # exponential kernel has unit DC gain (B_0 = 1), so the
+                # model CHANNEL MEANS — the only model quantity flux
+                # uses — are unchanged by any fitted tau; the rebuild
+                # was pure waste (one FFT round-trip per subint).
                 if print_flux:
                     okc = np.asarray(d.ok_ichans[isub], int)
-                    # FitResult.tau is linear rotations regardless of
-                    # the log10 parameterization (fit/portrait.py)
-                    tau_r = res_arrays["tau"][j]
-                    if tau_r and np.isfinite(tau_r) and tau_r > 0:
-                        tt = np.asarray(scattering_times(
-                            tau_r, res_arrays["alpha"][j], freqs0,
-                            res_arrays["nu_tau"][j]))
-                        B = np.asarray(scattering_portrait_FT(
-                            jnp.asarray(tt), nbin // 2 + 1))
-                        scat_model = np.fft.irfft(
-                            B * np.fft.rfft(modelx, axis=-1), n=nbin,
-                            axis=-1)
-                    else:
-                        scat_model = modelx
-                    means = scat_model.mean(axis=1)
+                    means = modelx.mean(axis=1)
                     profile_fluxes[isub, okc] = means[okc] * \
                         scales_full[isub, okc]
                     profile_flux_errs[isub, okc] = np.abs(means[okc]) * \
