@@ -27,9 +27,10 @@ f64 turns, matmul-DFT rotation).  AA+BB multi-pol or tscrunch fall
 back to the decoded (host-side load_data) lane per archive.
 
 Scope: campaign configurations — wideband (phi[, DM[, GM]]) fits,
-scattering (fit_scat/log10_tau/scat_guess/fix_alpha as in GetTOAs), and
-flux estimates (print_flux).  Instrumental response and narrowband
-remain GetTOAs-only.  No-scattering
+scattering (fit_scat/log10_tau/scat_guess/fix_alpha as in GetTOAs),
+flux estimates (print_flux), and instrumental-response kernels
+(instrumental_response_dict, incl. per-archive DM smearing).  The
+narrowband per-channel mode remains GetTOAs-only.  No-scattering
 buckets take the complex-free f32 fast path on TPU backends
 (config.use_fast_fit), scattering buckets the complex engine; subints
 with a single usable channel are demoted to phase-only buckets (the
@@ -70,12 +71,14 @@ class _Bucket:
     int16 with per-channel scl/offs; everything downstream happens in
     the fused device program."""
 
-    def __init__(self, freqs, nbin, modelx, flags, kind="dec"):
+    def __init__(self, freqs, nbin, modelx, flags, kind="dec",
+                 ir_FT=None):
         self.freqs = freqs          # (nchan,)
         self.nbin = int(nbin)
         self.modelx = modelx        # (nchan, nbin) template
         self.flags = flags          # effective FitFlags tuple
         self.kind = kind
+        self.ir_FT = ir_FT          # (nchan, nharm) complex or None
         self.ports = []             # 'dec': (nchan, nbin) float
         self.raw = []               # 'raw': (nchan, nbin) int16
         self.scl = []               # 'raw': (nchan,) f32
@@ -130,7 +133,7 @@ def _load_raw(f):
         doppler_factors=arch.doppler_factors(),
         DM=arch.get_dispersion_measure(),
         dmc=bool(arch.get_dedispersed()),
-        nu0=arch.get_centre_frequency(),
+        nu0=arch.get_centre_frequency(), bw=arch.get_bandwidth(),
         backend=arch.get_backend_name(),
         frontend=arch.get_receiver_name(),
         backend_delay=arch.get_backend_delay(),
@@ -141,7 +144,7 @@ def _load_raw(f):
 @lru_cache(maxsize=None)
 def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
                 use_fast, ftname, pallas, x_bf16, redisp=False,
-                want_flux=False):
+                want_flux=False, use_ir=False):
     """ONE jitted program for a raw bucket: int16 decode (scl/offs),
     min-window baseline subtraction, power-spectrum noise, S/N,
     nu_fit seeding, the batched fit, and result packing into a single
@@ -155,11 +158,11 @@ def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
     (their fixed tau seed still scatters the model)."""
     ft = {"float32": jnp.float32, "float64": jnp.float64}[ftname]
     scat_engine = (flags[3] or flags[4] or log10_tau
-                   or tau_mode != "none")
+                   or tau_mode != "none" or use_ir)
     tiny = float(np.finfo(ftname).tiny)
 
     def run(raw, scl, offs, cmask, modelx, freqs, Ps, DMg, nu_out,
-            tau_s, tau_nu, tau_a, alpha0, redisp_turns):
+            tau_s, tau_nu, tau_a, alpha0, redisp_turns, ir_FT):
         x = raw.astype(ft) * scl[..., None] + offs[..., None]
         x = x - min_window_baseline(x)[..., None]
         if redisp:
@@ -214,7 +217,8 @@ def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
                 nu_fit, nu_out=nu_out_arr, theta0=theta0,
                 fit_flags=FitFlags(*flags), chan_masks=cmask,
                 log10_tau=log10_tau, max_iter=max_iter,
-                use_scatter=scat_engine)
+                use_scatter=scat_engine,
+                ir_FT=ir_FT if use_ir else None)
         fields = [getattr(r, k) for k in _result_keys(flags)]
         if want_flux:
             # flux reduces to 3 scalars per subint ON DEVICE: pulling
@@ -290,16 +294,20 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
         ftname = "float32" if use_fast else "float64"
         # pallas/bf16 config read per call (cache-key args, mirroring
         # _fast_batch_fn): mid-process config toggles take effect
+        use_ir = bucket.ir_FT is not None
         fn = _raw_fit_fn(int(raw.shape[1]), bucket.nbin,
                          tuple(bool(f) for f in bucket.flags),
                          int(max_iter), bool(log10_tau), tau_mode,
                          use_fast, ftname,
                          use_pallas_moments(np.dtype(ftname)),
                          use_bf16_cross_spectrum(), redisp=redisp,
-                         want_flux=want_flux)
+                         want_flux=want_flux, use_ir=use_ir)
         ft = jnp.float32 if use_fast else jnp.float64
+        ct = jnp.complex64 if use_fast else jnp.complex128
         t_s, t_nu, t_a = tau_args
         modelx, freqs = bucket.modelx, bucket.freqs
+        ir_arg = (jnp.asarray(bucket.ir_FT, ct) if use_ir
+                  else jnp.zeros((1,), ct))
 
         def dispatch():
             return fn(jnp.asarray(raw), jnp.asarray(scl, ft),
@@ -308,7 +316,7 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                       jnp.asarray(freqs, ft), jnp.asarray(Ps, ft),
                       jnp.asarray(DMg, ft), ft(nu_out),
                       ft(t_s), ft(t_nu), ft(t_a), ft(alpha0),
-                      jnp.asarray(turns, ft))
+                      jnp.asarray(turns, ft), ir_arg)
     else:
         ports = np.stack([bucket.ports[i] for i in idx0])
         noise = np.stack([bucket.noise[i] for i in idx0])
@@ -317,7 +325,8 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
         # scattering (fitted, or a fixed nonzero/log10 tau seed in a
         # degenerate lane of a scattering run) needs the complex engine
         scat = (flags[3] or flags[4] or log10_tau
-                or bool(np.any(theta0[:, 3] != 0.0)))
+                or bool(np.any(theta0[:, 3] != 0.0))
+                or bucket.ir_FT is not None)
         modelx, freqs = bucket.modelx, bucket.freqs
 
         def dispatch():
@@ -338,7 +347,8 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                     jnp.asarray(Ps), jnp.asarray(nu_fit),
                     nu_out=nu_ref_DM, theta0=jnp.asarray(theta0),
                     fit_flags=flags, chan_masks=jnp.asarray(masks),
-                    log10_tau=log10_tau, max_iter=max_iter)
+                    log10_tau=log10_tau, max_iter=max_iter,
+                    ir_FT=bucket.ir_FT)
             # pack into one array so _collect costs a single d2h pull
             # (~100 ms round-trip each on tunneled runtimes); flux
             # reduces to 3 per-subint rows on device (_flux_rows)
@@ -477,6 +487,7 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                          scat_guess=None, fix_alpha=False, max_iter=25,
                          prefetch=True, max_inflight=4,
                          print_flux=False,
+                         instrumental_response_dict=None,
                          addtnl_toa_flags={}, tim_out=None,
                          quiet=False):
     """Measure wideband (phi[, DM[, tau, alpha]]) TOAs for many
@@ -513,6 +524,18 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                      else [datafiles])
     else:
         datafiles = list(datafiles)
+    from .toas import DEFAULT_IR_DICT, build_instrumental_response_FT
+
+    ird = {**DEFAULT_IR_DICT, **(instrumental_response_dict or {})}
+    if len(ird["wids"]) != len(ird["irf_types"]):
+        raise ValueError(
+            "instrumental_response_dict: wids and irf_types must pair "
+            f"up (got {len(ird['wids'])} widths, "
+            f"{len(ird['irf_types'])} kinds)")
+    use_ir = bool(ird["wids"] or ird["DM-smear"])
+    ir_cache = {}  # ir signature -> (nchan, nharm) kernel (one build
+    # per distinct layout, not per archive — eager device ops are not
+    # free on tunneled runtimes)
     scat_guess = _validate_scat_guess(scat_guess, fit_scat)
     if not fit_scat:
         log10_tau = False
@@ -645,6 +668,24 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
             DM_stored = float(d.DM)
             DM0_arch = DM_stored if DM0 is None else float(DM0)
             DM_guess = DM_stored if DM_stored != 0.0 else DM0_arch
+
+            # instrumental-response FT for this archive's layout (same
+            # construction as GetTOAs, pptoas.py:428-434).  DM-smearing
+            # makes the kernel archive-specific, so it joins the bucket
+            # key; pure achromatic kernels share across same layouts.
+            if use_ir:
+                ir_sig = ((nchan, nbin, freqs0.tobytes(),
+                           tuple(ird["wids"]), tuple(ird["irf_types"]))
+                          + ((round(DM_guess, 9), round(P_mean, 12))
+                             if ird["DM-smear"] else ()))
+                if ir_sig not in ir_cache:
+                    ir_cache[ir_sig] = build_instrumental_response_FT(
+                        ird, freqs0, nbin, DM_guess, P_mean,
+                        bw=d.get("bw", 0.0))
+                ir_FT = ir_cache[ir_sig]
+                base_key += (ir_sig[3:],)
+            else:
+                ir_FT = None
             masks = np.asarray(d.weights[ok] > 0.0, float)
             raw_mode = bool(d.get("raw_mode", False))
 
@@ -692,7 +733,7 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                 key = base_key + (eff_flags, kind)
                 if key not in buckets:
                     buckets[key] = _Bucket(freqs0, nbin, modelx, eff_flags,
-                                           kind=kind)
+                                           kind=kind, ir_FT=ir_FT)
                 b = buckets[key]
                 if raw_mode:
                     b.raw.append(d.raw[isub])

@@ -122,6 +122,38 @@ def reref_tau(tau, tau_err, nu_from, nu_to, alpha):
     return tau * r, tau_err * np.abs(r)
 
 
+DEFAULT_IR_DICT = {"DM-smear": False, "wids": [], "irf_types": []}
+
+
+def build_instrumental_response_FT(ird, freqs0, nbin, DM_guess, P_mean,
+                                   bw=0.0):
+    """(nchan, nharm) instrumental-response FT for one archive layout,
+    or None when the config requests nothing — the construction shared
+    by GetTOAs and the streaming driver (reference pptoas.py:428-434).
+
+    ird: {"DM-smear": bool, "wids": [...], "irf_types": [...]} (missing
+    keys default off/empty); raises ValueError on unpaired wids/kinds."""
+    ird = {**DEFAULT_IR_DICT, **(ird or {})}
+    if len(ird["wids"]) != len(ird["irf_types"]):
+        raise ValueError(
+            "instrumental_response_dict: wids and irf_types must pair "
+            f"up (got {len(ird['wids'])} widths, "
+            f"{len(ird['irf_types'])} kinds)")
+    if not (ird["wids"] or ird["DM-smear"]):
+        return None
+    from ..ops.gaussian import instrumental_response_port_FT
+
+    freqs0 = np.asarray(freqs0, float)
+    nchan = len(freqs0)
+    chan_bw = float(np.abs(np.median(np.diff(freqs0)))) if nchan > 1 \
+        else float(bw) / max(nchan, 1)
+    return instrumental_response_port_FT(
+        nbin // 2 + 1, jnp.asarray(freqs0),
+        widths=tuple(ird["wids"]), kinds=tuple(ird["irf_types"]),
+        DM_smear=DM_guess if ird["DM-smear"] else None,
+        chan_bw=chan_bw, P=P_mean)
+
+
 def snr_weighted_nu_fit(snrs_chan, freqs0):
     """Per-subint fit reference frequency: the S/N * nu^-2-weighted
     center-of-mass frequency (reference guess_fit_freq,
@@ -408,25 +440,9 @@ class GetTOAs:
             # instrumental-response FT for this archive's layout
             # (pptoas.py:428-434): product of configured achromatic
             # kernels and, optionally, per-channel DM-smearing sincs
-            ird = self.instrumental_response_dict
-            if len(ird["wids"]) != len(ird["irf_types"]):
-                raise ValueError(
-                    "instrumental_response_dict: wids and irf_types must "
-                    f"pair up (got {len(ird['wids'])} widths, "
-                    f"{len(ird['irf_types'])} kinds)")
-            if ird["wids"] or ird["DM-smear"]:
-                from ..ops.gaussian import instrumental_response_port_FT
-
-                chan_bw = float(np.abs(np.median(np.diff(freqs0)))) \
-                    if nchan > 1 else float(d.bw) / max(nchan, 1)
-                ir_FT = instrumental_response_port_FT(
-                    nbin // 2 + 1, jnp.asarray(freqs0),
-                    widths=tuple(ird["wids"]),
-                    kinds=tuple(ird["irf_types"]),
-                    DM_smear=DM_guess if ird["DM-smear"] else None,
-                    chan_bw=chan_bw, P=P_mean)
-            else:
-                ir_FT = None
+            ir_FT = build_instrumental_response_FT(
+                self.instrumental_response_dict, freqs0, nbin,
+                DM_guess, P_mean, bw=d.bw)
 
             fit_duration = 0.0
             res_arrays = {k: np.full(nok, np.nan) for k in

@@ -292,3 +292,37 @@ def test_stream_flux_matches_gettoas(tmp_path):
         # estimate must be in the right ballpark
         assert t.flags["flux"] == pytest.approx(
             2.5 * float(np.mean(np.asarray(model.amps))), rel=1.0)
+
+
+def test_stream_instrumental_response_matches_gettoas(tmp_path):
+    """Streamed fits with an instrumental-response kernel (achromatic
+    Gaussian + DM smearing) reproduce GetTOAs' results."""
+    model = default_test_model(1500.0)
+    gmodel = str(tmp_path / "m.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    path = str(tmp_path / "ir.fits")
+    make_fake_pulsar(model, PAR, outfile=path, nsub=2, nchan=32,
+                     nbin=256, nu0=1500.0, bw=800.0, tsub=60.0,
+                     dDM=1e-4, start_MJD=MJD(55600, 0.2),
+                     noise_stds=0.03, dedispersed=False, quiet=True,
+                     rng=13)
+    ird = {"DM-smear": True, "wids": [0.002], "irf_types": ["gauss"]}
+    res = stream_wideband_TOAs([path], gmodel, nsub_batch=4,
+                               instrumental_response_dict=ird,
+                               quiet=True)
+    gt = GetTOAs(path, gmodel, quiet=True)
+    gt.instrumental_response_dict.update(ird)
+    gt.get_TOAs(quiet=True, max_iter=25)
+    by_key = {t.flags["subint"]: t for t in res.TOA_list}
+    for t_ref in gt.TOA_list:
+        t = by_key[t_ref.flags["subint"]]
+        assert t.DM == pytest.approx(t_ref.DM, abs=1e-9)
+        dt_us = abs((t.MJD - t_ref.MJD) * 86400.0 * 1e6)
+        assert dt_us < 1e-3, dt_us
+        assert t.TOA_error == pytest.approx(t_ref.TOA_error, rel=1e-6)
+    # mismatched config still raises
+    with pytest.raises(ValueError, match="pair up"):
+        stream_wideband_TOAs([path], gmodel,
+                             instrumental_response_dict={
+                                 "DM-smear": False, "wids": [0.1],
+                                 "irf_types": []}, quiet=True)
