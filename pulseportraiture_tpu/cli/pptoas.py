@@ -100,15 +100,38 @@ def main(argv=None):
         parts = args.flags.split(",")
         addtnl = dict(zip(parts[0::2], parts[1::2]))
 
+    if args.stream and args.narrowband:
+        if (args.psrchive or args.one_DM or args.print_flux
+                or args.print_parangle or args.fit_GM or args.showplot):
+            raise SystemExit(
+                "--stream --narrowband supports per-channel (phi[, "
+                "tau]) fits only (no psrchive/one_DM/flux/parangle/GM "
+                "flags or plots)")
+        from ..pipeline.stream import stream_narrowband_TOAs
+
+        res = stream_narrowband_TOAs(
+            args.datafiles, args.modelfile, fit_scat=args.fit_scat,
+            log10_tau=args.log10_tau, scat_guess=scat_guess,
+            tscrunch=args.tscrunch,
+            print_phase=args.print_phase, addtnl_toa_flags=addtnl,
+            quiet=args.quiet)
+        if args.format == "princeton":
+            write_princeton_TOAs(res.TOA_list, outfile=args.outfile,
+                                 dDMs=[0.0] * len(res.TOA_list))
+        else:
+            write_TOAs(res.TOA_list, SNR_cutoff=args.snr_cutoff,
+                       outfile=args.outfile, append=True)
+        return 0
+
     if args.stream:
-        if (args.narrowband or args.psrchive
+        if (args.psrchive
                 or args.one_DM
                 or args.print_phase or args.print_parangle
                 or args.showplot):
             raise SystemExit(
                 "--stream supports the wideband (phi, DM[, GM, "
                 "scattering], flux) campaign configuration only (no "
-                "narrowband/one_DM/phase/parangle flags or plots)")
+                "one_DM/phase/parangle flags or plots)")
         from ..pipeline.stream import stream_wideband_TOAs
 
         res = stream_wideband_TOAs(

@@ -10,6 +10,7 @@ from .align import (  # noqa: F401
 )
 from .models import TemplateModel, sniff_model_type  # noqa: F401
 from .portrait import DataPortrait, normalize_portrait  # noqa: F401
-from .stream import stream_wideband_TOAs  # noqa: F401
+from .stream import (stream_narrowband_TOAs,  # noqa: F401
+                     stream_wideband_TOAs)
 from .toas import GetTOAs  # noqa: F401
 from .zap import apply_zaps, get_zap_channels, print_paz_cmds  # noqa: F401

@@ -29,8 +29,9 @@ back to the decoded (host-side load_data) lane per archive.
 Scope: campaign configurations — wideband (phi[, DM[, GM]]) fits,
 scattering (fit_scat/log10_tau/scat_guess/fix_alpha as in GetTOAs),
 flux estimates (print_flux), and instrumental-response kernels
-(instrumental_response_dict, incl. per-archive DM smearing).  The
-narrowband per-channel mode remains GetTOAs-only.  No-scattering
+(instrumental_response_dict, incl. per-archive DM smearing); the
+narrowband per-channel mode streams via stream_narrowband_TOAs
+(pptoas --stream --narrowband).  No-scattering
 buckets take the complex-free f32 fast path on TPU backends
 (config.use_fast_fit), scattering buckets the complex engine; subints
 with a single usable channel are demoted to phase-only buckets (the
@@ -794,4 +795,374 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
     return DataBunch(TOA_list=TOA_list, order=order, DM0s=DM0s,
                      DeltaDM_means=DeltaDM_means,
                      DeltaDM_errs=DeltaDM_errs,
+                     fit_duration=fit_duration, nfit=nfit)
+
+
+# --------------------------------------------------------------------------
+# Narrowband streaming (per-channel 1-D fits at campaign scale)
+# --------------------------------------------------------------------------
+
+_NB_KEYS = ("phase", "phase_err", "snr", "gof")
+_NB_SCAT_KEYS = _NB_KEYS + ("tau", "tau_err")
+
+
+def _nb_fit_fields(x, modelx, noise, cmask, freqs, Ps, ft, nbin,
+                   fit_scat, log10_tau, tau_mode, max_iter,
+                   tau_s=0.0, tau_nu=1.0, tau_a=0.0):
+    """Per-channel 1-D fit fields for one narrowband batch (traceable;
+    shared by the raw device program and the decoded-fallback dispatch
+    so the two lanes cannot drift): fit_phase_shift_batch without
+    scattering, else the 5-param engine on flattened single-channel
+    portraits with (phi, tau) free (get_narrowband_TOAs' path,
+    pipeline/toas.py:786-835)."""
+    from ..fit.phase_shift import fit_phase_shift_batch
+
+    nb, nchan = x.shape[0], x.shape[1]
+    if not fit_scat:
+        r = fit_phase_shift_batch(
+            x, jnp.broadcast_to(modelx, x.shape), noise)
+        return (r.phase, r.phase_err, r.snr, r.red_chi2)
+    flat_x = x.reshape(nb * nchan, 1, nbin)
+    flat_m = jnp.broadcast_to(modelx, x.shape).reshape(nb * nchan, 1, nbin)
+    flat_noise = noise.reshape(nb * nchan, 1)
+    flat_freqs = jnp.broadcast_to(
+        freqs, (nb, nchan)).reshape(nb * nchan, 1)
+    flat_P = jnp.repeat(Ps, nchan)
+    flat_mask = cmask.reshape(nb * nchan, 1)
+    if tau_mode == "auto":
+        # broadband estimate per subint, scaled per channel with the
+        # default index (pipeline/toas.py:802-813)
+        tau_sub = estimate_tau_batch(x, modelx, noise, cmask)
+        nu_mid = jnp.mean(freqs)
+        tau_seed = (tau_sub[:, None] * (freqs[None, :] / nu_mid)
+                    ** scattering_alpha).reshape(nb * nchan)
+    elif tau_mode == "explicit":
+        tau_seed = ((tau_s / flat_P)
+                    * (flat_freqs[:, 0] / tau_nu) ** tau_a)
+    else:
+        tau_seed = jnp.full(nb * nchan, 0.5 / nbin, ft)
+    th0 = jnp.zeros((nb * nchan, 5), ft).at[:, 3].set(
+        jnp.log10(jnp.maximum(tau_seed, 1e-12)).astype(ft)
+        if log10_tau else tau_seed.astype(ft))
+    r = fit_portrait_batch(
+        flat_x, flat_m, flat_noise, flat_freqs, flat_P,
+        flat_freqs[:, 0],
+        fit_flags=FitFlags(True, False, False, True, False),
+        theta0=th0, chan_masks=flat_mask,
+        log10_tau=log10_tau, max_iter=max_iter)
+    dof = jnp.maximum(r.dof, 1.0)
+    return (r.phi.reshape(nb, nchan), r.phi_err.reshape(nb, nchan),
+            r.snr.reshape(nb, nchan), (r.chi2 / dof).reshape(nb, nchan),
+            r.tau.reshape(nb, nchan), r.tau_err.reshape(nb, nchan))
+
+
+@lru_cache(maxsize=None)
+def _raw_nb_fn(nchan, nbin, fit_scat, log10_tau, tau_mode, max_iter,
+               ftname, redisp):
+    """ONE jitted program for a narrowband raw bucket: decode,
+    baseline, optional re-dispersion, then per-channel 1-D fits —
+    fit_phase_shift_batch (no scattering) or the 5-param engine with
+    (phi, tau) per single-channel portrait (get_narrowband_TOAs'
+    flattened path, pipeline/toas.py:786-835).  Returns a packed
+    (nfield, nb, nchan) array."""
+    from ..fit.phase_shift import fit_phase_shift_batch
+
+    ft = {"float32": jnp.float32, "float64": jnp.float64}[ftname]
+    tiny = float(np.finfo(ftname).tiny)
+
+    def run(raw, scl, offs, cmask, modelx, freqs, Ps,
+            tau_s, tau_nu, tau_a, redisp_turns):
+        x = raw.astype(ft) * scl[..., None] + offs[..., None]
+        x = x - min_window_baseline(x)[..., None]
+        if redisp:
+            from ..ops.fourier import irfft_mm, rfft_mm
+
+            k = jnp.arange(nbin // 2 + 1, dtype=ft)
+            ang = -2.0 * jnp.pi * redisp_turns.astype(ft)[..., None] * k
+            c, s = jnp.cos(ang), jnp.sin(ang)
+            Xr, Xi = rfft_mm(x)
+            x = irfft_mm(Xr * c - Xi * s, Xr * s + Xi * c, nbin)
+        noise = jnp.maximum(get_noise_PS(x), tiny)
+        fields = _nb_fit_fields(x, modelx, noise, cmask, freqs, Ps,
+                                ft, nbin, fit_scat, log10_tau, tau_mode,
+                                max_iter, tau_s, tau_nu, tau_a)
+        return jnp.stack([jnp.asarray(f).astype(ft) for f in fields])
+
+    return jax.jit(run)
+
+
+def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
+                           fit_scat=False, log10_tau=True,
+                           scat_guess=None, tscrunch=False, max_iter=25,
+                           prefetch=True,
+                           max_inflight=4, print_phase=False,
+                           addtnl_toa_flags={}, tim_out=None,
+                           quiet=False):
+    """Campaign-scale narrowband TOAs: per-channel 1-D fits with the
+    same raw-int16 device pipeline, bucketing, and asynchronous
+    dispatch as stream_wideband_TOAs — one TOA per unzapped channel
+    (get_narrowband_TOAs semantics; the reference left the narrowband
+    scattering fit "NOT YET IMPLEMENTED", pptoas.py:1046-1049).
+
+    Non-raw-compatible archives (AA+BB multi-pol, float DATA) fall
+    back to a host-decoded dispatch of the same device fits.  Returns
+    a DataBunch(TOA_list, order, fit_duration, nfit)."""
+    if isinstance(datafiles, str):
+        datafiles = (_read_metafile(datafiles) if _is_metafile(datafiles)
+                     else [datafiles])
+    else:
+        datafiles = list(datafiles)
+    scat_guess = _validate_scat_guess(scat_guess, fit_scat)
+    if fit_scat and not log10_tau and scat_guess is None:
+        raise ValueError(
+            "stream_narrowband_TOAs: log10_tau=False needs scat_guess")
+    if not fit_scat:
+        log10_tau = False
+    model = TemplateModel(modelfile, quiet=quiet)
+    p_dependent = model.has_scattering()
+    if tim_out:
+        open(tim_out, "w").close()
+
+    if scat_guess is not None and not isinstance(scat_guess, str):
+        tau_mode = "explicit"
+        tau_args = tuple(float(v) for v in scat_guess)
+    elif fit_scat and scat_guess == "auto":
+        tau_mode, tau_args = "auto", (0.0, 1.0, 0.0)
+    elif fit_scat:
+        tau_mode, tau_args = "neutral", (0.0, 1.0, 0.0)
+    else:
+        tau_mode, tau_args = "none", (0.0, 1.0, 0.0)
+
+    load_dtype = np.float32 if use_fast_fit_default() else None
+
+    def _loader(f):
+        if not tscrunch:  # raw lane cannot time-scrunch on host
+            try:
+                return _load_raw(f)
+            except (ValueError, KeyError):
+                pass
+        return load_for_toas(f, tscrunch=tscrunch, quiet=True,
+                             dtype=load_dtype)
+
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    dispatch_ex = ThreadPoolExecutor(max_workers=1)
+    buckets = {}
+    results = {}
+    meta = []
+    meta_by_iarch = {}
+    remaining = {}
+    in_flight = deque()
+    fit_duration = 0.0
+    nfit = 0
+    t_start = time.time()
+    keys = _NB_SCAT_KEYS if fit_scat else _NB_KEYS
+    ftname = "float32" if use_fast_fit_default() else "float64"
+    ft = jnp.float32 if use_fast_fit_default() else jnp.float64
+
+    def assemble(m):
+        """Per-channel TOA objects for one archive."""
+        toas = []
+        for j, isub in enumerate(m.ok):
+            r = results.get((m.iarch, int(isub)))
+            if r is None:
+                continue
+            vals = dict(zip(keys, r))
+            P = m.Ps[j]
+            for ichan in m.okc[j]:
+                toa_mjd = m.epochs[j].add_seconds(
+                    float(vals["phase"][ichan]) * P + m.backend_delay)
+                flags = {
+                    "be": m.backend, "fe": m.frontend,
+                    "f": f"{m.frontend}_{m.backend}",
+                    "nbin": int(m.nbin), "subint": int(isub),
+                    "chan": int(ichan), "tobs": m.subtimes[j],
+                    "tmplt": str(modelfile),
+                    "snr": float(vals["snr"][ichan]),
+                    "gof": float(vals["gof"][ichan]),
+                }
+                if fit_scat:
+                    flags.update(scat_time_flags(
+                        float(vals["tau"][ichan]),
+                        float(vals["tau_err"][ichan]), P, log10_tau))
+                    flags["scat_ref_freq"] = float(m.freqs0[ichan])
+                if print_phase:
+                    flags["phs"] = float(vals["phase"][ichan])
+                    flags["phs_err"] = float(vals["phase_err"][ichan])
+                flags.update(addtnl_toa_flags)
+                toas.append(TOA(
+                    m.datafile, float(m.freqs0[ichan]), toa_mjd,
+                    float(vals["phase_err"][ichan]) * P * 1e6,
+                    m.telescope, m.telescope_code, None, None, flags))
+        return toas
+
+    assembled = {}
+
+    def drain_one():
+        nonlocal fit_duration
+        t0 = time.time()
+        handle, owners = in_flight.popleft()
+        out = np.asarray(handle.result()
+                         if hasattr(handle, "result") else handle)
+        for i, owner in enumerate(owners):
+            results[owner] = out[:, i]  # (nfield, nchan)
+        fit_duration += time.time() - t0
+        # incremental per-archive checkpoint, like the wideband driver:
+        # an interrupted campaign keeps every completed archive on disk
+        for iarch, _ in owners:
+            if iarch in remaining:
+                remaining[iarch] -= 1
+        for iarch, _ in owners:
+            if remaining.get(iarch) == 0 and iarch not in assembled:
+                m = meta_by_iarch[iarch]
+                assembled[iarch] = assemble(m)
+                for isub in m.ok:
+                    results.pop((iarch, int(isub)), None)
+                if tim_out:
+                    write_TOAs(assembled[iarch], outfile=tim_out,
+                               append=True)
+
+    def do_flush(b):
+        nonlocal nfit
+        n = len(b)
+        if n == 0:
+            return
+        pad = (-n) % nsub_batch
+        idx0 = list(range(n)) + [0] * pad
+        masks = np.stack([b.masks[i] for i in idx0])
+        Ps = np.asarray([b.Ps[i] for i in idx0])
+        t_s, t_nu, t_a = tau_args
+        if b.kind == "raw":
+            raw = np.stack([b.raw[i] for i in idx0])
+            scl = np.stack([b.scl[i] for i in idx0])
+            offs = np.stack([b.offs[i] for i in idx0])
+            dedisp = np.asarray([b.dedisp[i] for i in idx0])
+            redisp = bool(np.any(dedisp[:, 0] != 0.0))
+            if redisp:
+                freqs_h = np.asarray(b.freqs, np.float64)
+                turns = (Dconst * dedisp[:, :1] / Ps[:, None]) * (
+                    freqs_h[None, :] ** -2.0 - dedisp[:, 1:] ** -2.0)
+                turns = (turns + 0.5) % 1.0 - 0.5
+            else:
+                turns = np.zeros((len(idx0), 1))
+            fn = _raw_nb_fn(int(raw.shape[1]), b.nbin, bool(fit_scat),
+                            bool(log10_tau), tau_mode, int(max_iter),
+                            ftname, redisp)
+            modelx, freqs = b.modelx, b.freqs
+
+            def dispatch():
+                return fn(jnp.asarray(raw), jnp.asarray(scl, ft),
+                          jnp.asarray(offs, ft), jnp.asarray(masks, ft),
+                          jnp.asarray(modelx, ft), jnp.asarray(freqs, ft),
+                          jnp.asarray(Ps, ft), ft(t_s), ft(t_nu),
+                          ft(t_a), jnp.asarray(turns, ft))
+        else:
+            ports = np.stack([b.ports[i] for i in idx0])
+            noise = np.stack([b.noise[i] for i in idx0])
+            modelx, freqs = b.modelx, b.freqs
+
+            def dispatch():
+                return jnp.stack([
+                    jnp.asarray(f).astype(ft) for f in _nb_fit_fields(
+                        jnp.asarray(ports, ft), jnp.asarray(modelx, ft),
+                        jnp.asarray(noise, ft), jnp.asarray(masks, ft),
+                        jnp.asarray(freqs, ft), jnp.asarray(Ps, ft),
+                        ft, b.nbin, fit_scat, log10_tau, tau_mode,
+                        max_iter, t_s, t_nu, t_a)])
+
+        in_flight.append((dispatch_ex.submit(dispatch),
+                          list(b.owners)))
+        nfit += 1
+        b.clear()
+        while len(in_flight) > max_inflight:
+            drain_one()
+
+    try:
+        for iarch, (datafile, d) in enumerate(
+                _iter_archives(datafiles, _loader, prefetch)):
+            if isinstance(d, Exception):
+                print(f"Skipping {datafile}: {d}")
+                continue
+            ok = np.asarray(d.ok_isubs, int)
+            if d.nsub == 0 or len(ok) == 0:
+                print(f"No subints to fit in {datafile}; skipping.")
+                continue
+            nchan, nbin = d.nchan, d.nbin
+            freqs0 = np.asarray(d.freqs[0], float)
+            P_mean = float(np.mean(d.Ps[ok]))
+            try:
+                modelx = model.portrait(freqs0, nbin, P=P_mean)
+            except ValueError as e:
+                print(f"Skipping {datafile}: {e}")
+                continue
+            raw_mode = bool(d.get("raw_mode", False))
+            masks = np.asarray(d.weights[ok] > 0.0, float)
+            key = (nchan, nbin, freqs0.tobytes(),
+                   "raw" if raw_mode else "dec") + (
+                       (round(P_mean, 12),) if p_dependent else ())
+            if key not in buckets:
+                buckets[key] = _Bucket(freqs0, nbin, modelx, (),
+                                       kind="raw" if raw_mode else "dec")
+            b = buckets[key]
+            m = DataBunch(
+                datafile=datafile, iarch=iarch, ok=ok, nbin=nbin,
+                freqs0=freqs0,
+                okc=[np.flatnonzero(np.asarray(d.weights[isub] > 0.0))
+                     for isub in ok],
+                epochs=[d.epochs[isub] for isub in ok],
+                Ps=[float(d.Ps[isub]) for isub in ok],
+                subtimes=[float(d.subtimes[isub]) for isub in ok],
+                backend_delay=d.backend_delay, backend=d.backend,
+                frontend=d.frontend, telescope=d.telescope,
+                telescope_code=d.telescope_code)
+            meta.append(m)
+            meta_by_iarch[iarch] = m
+            remaining[iarch] = len(ok)
+            DM_stored = float(d.DM)
+            for j, isub in enumerate(ok):
+                if raw_mode:
+                    b.raw.append(d.raw[isub])
+                    b.scl.append(d.scl[isub])
+                    b.offs.append(d.offs[isub])
+                    b.dedisp.append((DM_stored if d.get("dmc") else 0.0,
+                                     float(d.get("nu0", 0.0) or 0.0)))
+                else:
+                    b.ports.append(np.asarray(d.subints[isub, 0]))
+                    b.noise.append(np.asarray(d.noise_stds[isub, 0],
+                                              float))
+                b.masks.append(masks[j])
+                b.Ps.append(float(d.Ps[isub]))
+                b.owners.append((iarch, int(isub)))
+                if len(b) >= nsub_batch:
+                    do_flush(b)
+        for b in buckets.values():
+            if len(b):
+                do_flush(b)
+        while in_flight:
+            drain_one()
+    except BaseException:
+        dispatch_ex.shutdown(wait=False, cancel_futures=True)
+        raise
+    dispatch_ex.shutdown(wait=True)
+
+    # ---- collect per-archive TOAs in archive order -------------------
+    TOA_list, order = [], []
+    for m in meta:
+        toas = assembled.get(m.iarch)
+        if toas is None:
+            toas = assemble(m)
+            if tim_out:
+                write_TOAs(toas, outfile=tim_out, append=True)
+        TOA_list.extend(toas)
+        order.append(m.datafile)
+
+    if not quiet:
+        tot = time.time() - t_start
+        n = len(TOA_list)
+        print(f"streamed {n} narrowband TOAs from {len(order)} archives "
+              f"in {tot:.2f} s ({nfit} fused dispatches, "
+              f"{fit_duration:.2f} s blocked on device, "
+              f"{n / max(tot, 1e-9):.1f} TOAs/s end-to-end)")
+    return DataBunch(TOA_list=TOA_list, order=order,
                      fit_duration=fit_duration, nfit=nfit)
