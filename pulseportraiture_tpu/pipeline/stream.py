@@ -307,8 +307,10 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
         ct = jnp.complex64 if use_fast else jnp.complex128
         t_s, t_nu, t_a = tau_args
         modelx, freqs = bucket.modelx, bucket.freqs
-        ir_arg = (jnp.asarray(bucket.ir_FT, ct) if use_ir
-                  else jnp.zeros((1,), ct))
+        # None (empty pytree) when IR is off — an eager complex64
+        # placeholder would be created on the default device, and some
+        # tunneled runtimes cannot transfer complex buffers at all
+        ir_arg = jnp.asarray(bucket.ir_FT, ct) if use_ir else None
 
         def dispatch():
             return fn(jnp.asarray(raw), jnp.asarray(scl, ft),
