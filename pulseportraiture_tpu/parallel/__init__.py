@@ -1,6 +1,6 @@
 from .mesh import make_mesh, batch_sharding, replicated
-from .batch import (fit_portrait_sharded, fit_portrait_sharded_fast,
-                    shard_batch)
+from .batch import (align_iteration_sharded, fit_portrait_sharded,
+                    fit_portrait_sharded_fast, shard_batch)
 from .multihost import (global_mesh, init_multihost, process_allgather,
                         process_count, process_index, shard_files)
 
@@ -8,6 +8,7 @@ __all__ = [
     "make_mesh",
     "batch_sharding",
     "replicated",
+    "align_iteration_sharded",
     "fit_portrait_sharded",
     "fit_portrait_sharded_fast",
     "shard_batch",

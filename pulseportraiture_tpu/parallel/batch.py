@@ -165,6 +165,104 @@ def fit_portrait_sharded_fast(
                   nu_fit, nu_out_val, theta0)
 
 
+def align_iteration_sharded(mesh, ports, model, noise_stds, chan_masks,
+                            freqs, P_s, fit_dm=True, max_iter=20,
+                            shard_channels=False):
+    """ONE ppalign iteration on the device mesh: the batched
+    (phi[, DM]) fit of every (archive, subint) against the shared
+    template AND the template update — back-rotation plus
+    scales/sigma^2-weighted accumulation (reference ppalign.py:220-248)
+    — in a single sharded program.  The batch-axis reduction of the
+    accumulate lowers to a psum over 'data' (the cross-chip collective
+    of the align workload); everything stays complex-free (matmul DFT
+    rotation), so the same program shape runs on TPU runtimes.
+
+    ports: (nb, nchan, nbin); model: shared (nchan, nbin) template;
+    noise_stds/chan_masks: (nb, nchan); freqs: (nchan,); P_s: (nb,).
+    Returns (new_template (nchan, nbin) replicated jax.Array,
+    FitResult) — the template is fully reduced (replicated
+    out-sharding); np.asarray it for host use or feed it to the next
+    iteration as-is.
+    """
+    ports = jnp.asarray(ports)
+    nb, nchan, nbin = ports.shape
+    dt = ports.dtype
+    model = jnp.asarray(model, dt)
+    freqs = jnp.asarray(freqs, dt)
+    P_s = jnp.broadcast_to(jnp.asarray(P_s, dt), (nb,))
+    noise_stds = jnp.asarray(noise_stds, dt)
+    chan_masks = jnp.asarray(chan_masks, dt)
+    flags = FitFlags(True, bool(fit_dm), False, False, False)
+
+    jitted = _sharded_align_fn(mesh, flags, int(max_iter),
+                               bool(shard_channels))
+    sh3 = batch_sharding(mesh, 3, 1 if shard_channels else None)
+    sh2c = batch_sharding(mesh, 2, 1 if shard_channels else None)
+    ports = jax.device_put(ports, sh3)
+    noise_stds = jax.device_put(noise_stds, sh2c)
+    chan_masks = jax.device_put(chan_masks, sh2c)
+    new_template, res = jitted(ports, model, noise_stds, chan_masks,
+                               freqs, P_s)
+    return new_template, res
+
+
+@lru_cache(maxsize=None)
+def _sharded_align_fn(mesh, flags, max_iter, shard_channels):
+    """Cached sharded jit of one align iteration (fit + rotate +
+    weighted template reduction)."""
+    from ..ops.fourier import irfft_mm, rfft_mm
+    from ..ops.phasor import phase_shifts
+
+    def rotate_real(port, t_n):
+        """Rotate each channel to earlier phase by t_n [rot] via the
+        matmul DFT (same convention as ops.rotation.rotate_portrait:
+        phasor exp(+2 pi i k t))."""
+        nbin = port.shape[-1]
+        k = jnp.arange(nbin // 2 + 1, dtype=port.dtype)
+        ang = 2.0 * jnp.pi * t_n[:, None] * k
+        c, s = jnp.cos(ang), jnp.sin(ang)
+        Xr, Xi = rfft_mm(port)
+        return irfft_mm(Xr * c - Xi * s, Xr * s + Xi * c, nbin)
+
+    def run(ports, model, noise_stds, chan_masks, freqs, P_s):
+        dt = ports.dtype
+        nu0 = jnp.mean(freqs)
+        nb = ports.shape[0]
+        one = partial(fast_fit_one, fit_flags=flags, max_iter=max_iter,
+                      pallas=False)
+        res = jax.vmap(one, in_axes=(0, None, 0, 0, None, 0, None, None,
+                                     0))(
+            ports, model, noise_stds, chan_masks, freqs, P_s, nu0,
+            nu0, jnp.zeros((nb, 5), dt))
+        t_n = jax.vmap(
+            lambda ph, dm, p: phase_shifts(ph, dm, 0.0, freqs, p, nu0,
+                                           nu0)
+        )(res.phi, res.DM, P_s)
+        rot = jax.vmap(rotate_real)(ports, t_n)
+        good = noise_stds > 0.0
+        inv = jnp.where(good, 1.0 / jnp.where(good, noise_stds, 1.0) ** 2,
+                        0.0)
+        w = chan_masks * jnp.maximum(res.scales, 0.0) * inv  # (nb, nchan)
+        # the cross-device collective: reductions over the sharded
+        # batch axis (psum over 'data')
+        aligned = jnp.sum(rot * w[:, :, None], axis=0)
+        wsum = jnp.sum(w, axis=0)
+        new_template = aligned / jnp.maximum(wsum, _ALIGN_TINY)[:, None]
+        return new_template, res
+
+    sh3 = batch_sharding(mesh, 3, 1 if shard_channels else None)
+    sh2c = batch_sharding(mesh, 2, 1 if shard_channels else None)
+    sh1 = batch_sharding(mesh, 1)
+    rep = NamedSharding(mesh, P())
+    shm = NamedSharding(mesh, P("chan", None) if shard_channels else P())
+    shf = NamedSharding(mesh, P("chan") if shard_channels else P())
+    return jax.jit(run, in_shardings=(sh3, shm, sh2c, sh2c, shf, sh1),
+                   out_shardings=(rep, None))
+
+
+_ALIGN_TINY = 1e-30
+
+
 @lru_cache(maxsize=None)
 def _sharded_fast_fn(mesh, flags, max_iter, pallas, m_ax, f_ax,
                      shard_channels):

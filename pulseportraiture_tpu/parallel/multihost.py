@@ -35,6 +35,20 @@ __all__ = ["init_multihost", "process_count", "process_index",
            "shard_files", "global_mesh", "process_allgather"]
 
 
+def _cluster_env_detected():
+    """Whether jax's cluster auto-detection would find a distributed
+    environment (SLURM, GCE TPU pods, the JAX_COORDINATOR_ADDRESS env
+    family): True / False when the registry is inspectable, None when
+    the private API moved (callers then fall back to probing
+    jax.distributed.initialize itself)."""
+    try:
+        from jax._src.clusters import ClusterEnv
+
+        return any(c.is_env_present() for c in ClusterEnv._cluster_types)
+    except Exception:
+        return None
+
+
 def init_multihost(coordinator_address=None, num_processes=None,
                    process_id=None, **kwargs):
     """Initialize JAX's distributed runtime (multi-host).
@@ -47,26 +61,47 @@ def init_multihost(coordinator_address=None, num_processes=None,
     the single-process path stays safe on laptops and CI."""
     if (coordinator_address is None and num_processes is None
             and process_id is None and not kwargs):
+        detected = _cluster_env_detected()
+        if detected is False:
+            # structural signal: no cluster environment present — skip
+            # the bootstrap entirely instead of catching its error.
+            # A DETECTED cluster whose bootstrap fails (unreachable
+            # coordinator, double initialization) surfaces below: a
+            # swallowed error would make every task run the full
+            # campaign as process 0 of 1.
+            return False
+        _enable_cpu_collectives()
         try:
             jax.distributed.initialize()
             return True
         except ValueError as e:
-            # only the detection failure is a legitimate single-process
-            # signal — jax raises ValueError("coordinator_address
-            # should be defined.") when no cluster env is present.  A
-            # DETECTED cluster whose bootstrap failed (unreachable
-            # coordinator, double initialization — RuntimeError in
-            # jax) must surface: a swallowed error would make every
-            # task run the full campaign as process 0 of 1.  The
-            # message match is asserted by tests so a jax rewording
-            # fails loudly there, not silently here.
-            if "coordinator_address" in str(e):
-                return False  # no cluster detected: single process
+            # detection result unknown (private jax API unavailable):
+            # fall back to the no-cluster error jax raises on a plain
+            # machine — ValueError("coordinator_address should be
+            # defined.").  Real bootstrap failures are RuntimeError.
+            if detected is None and "coordinator_address" in str(e):
+                return False
             raise
+    _enable_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes, process_id=process_id, **kwargs)
     return True
+
+
+def _enable_cpu_collectives():
+    """Multi-process CPU backends need a cross-process collectives
+    implementation (gloo) configured BEFORE the client is created —
+    without it every process builds an isolated 1-process client and
+    jax.process_count() silently stays 1.  No-op for TPU backends
+    (their ICI/DCN collectives are built in)."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, KeyError, ValueError):
+        # config key moved/renamed in a future jax: TPU pods are
+        # unaffected; CPU multi-process then needs the caller to set
+        # the equivalent knob
+        pass
 
 
 def process_count():

@@ -82,6 +82,14 @@ def wideband_gls_fit(toas, par, fit_f0=True, fit_f1=False,
         return float(str(v).replace("D", "E")) if v is not None else None
 
     PEPOCH = fget("PEPOCH")
+    if PEPOCH is None:
+        raise ValueError(
+            "wideband_gls_fit: parfile is missing PEPOCH (the spin "
+            "reference epoch); add a 'PEPOCH <mjd>' line")
+    if fget("F0") is None and fget("P0") is None:
+        raise ValueError(
+            "wideband_gls_fit: parfile has neither F0 nor P0; one spin "
+            "parameter is required")
     DM0 = fget("DM", 0.0)
 
     toas = [t for t in toas if t.dm is not None and t.dm_err]

@@ -124,12 +124,40 @@ class TestMultihost:
         import pytest
 
         from pulseportraiture_tpu import parallel
+        from pulseportraiture_tpu.parallel import multihost
 
         def broken(*a, **k):
             raise RuntimeError("coordinator unreachable: host0:1234")
 
+        # a cluster IS detected but its bootstrap fails: must surface
+        monkeypatch.setattr(multihost, "_cluster_env_detected",
+                            lambda: True)
         monkeypatch.setattr(jax.distributed, "initialize", broken)
         with pytest.raises(RuntimeError, match="unreachable"):
+            parallel.init_multihost()
+
+    def test_init_fallback_when_detection_unavailable(self, monkeypatch):
+        """Private-API drift (detection returns None): the no-cluster
+        ValueError fallback still returns False; anything else raises."""
+        import jax
+
+        import pytest
+
+        from pulseportraiture_tpu import parallel
+        from pulseportraiture_tpu.parallel import multihost
+
+        monkeypatch.setattr(multihost, "_cluster_env_detected",
+                            lambda: None)
+        monkeypatch.setattr(
+            jax.distributed, "initialize",
+            lambda *a, **k: (_ for _ in ()).throw(
+                ValueError("coordinator_address should be defined.")))
+        assert parallel.init_multihost() is False
+        monkeypatch.setattr(
+            jax.distributed, "initialize",
+            lambda *a, **k: (_ for _ in ()).throw(
+                ValueError("some genuinely different failure")))
+        with pytest.raises(ValueError, match="different"):
             parallel.init_multihost()
 
     def test_shard_files_round_robin(self):
@@ -223,3 +251,32 @@ class TestMultihost:
         assert ("CONTRACT-OK" in out.stdout
                 or "CLUSTER-DETECTED" in out.stdout), (out.stdout,
                                                        out.stderr)
+
+
+def test_sharded_align_iteration(batch):
+    """One sharded ppalign iteration: the fused fit + rotate + psum
+    template update recovers the clean template from phase/DM-scattered
+    subints, on both mesh shapes."""
+    from pulseportraiture_tpu.parallel import align_iteration_sharded
+
+    ports, models, stds = batch
+    clean = np.asarray(models[0])
+    masks = jnp.ones((NB, NCHAN))
+    for mesh, shard_ch in ((make_mesh(n_data=8, n_chan=1), False),
+                           (make_mesh(n_data=4, n_chan=2), True)):
+        new_t, res = align_iteration_sharded(
+            mesh, ports, models[0], stds, masks, FREQS, P,
+            shard_channels=shard_ch)
+        new_t = np.asarray(new_t)
+        assert new_t.shape == (NCHAN, NBIN)
+        assert np.all(np.isfinite(new_t))
+        # each subint was injected with a different (phi, DM); after
+        # back-rotation by the fits the stack must align with the clean
+        # template to ~noise/sqrt(NB) while the UNALIGNED mean does not
+        scale = np.abs(clean).max()
+        err_aligned = np.abs(new_t - clean).max() / scale
+        err_unaligned = np.abs(
+            np.asarray(ports.mean(axis=0)) - clean).max() / scale
+        assert err_aligned < 0.05, err_aligned
+        assert err_unaligned > 5 * err_aligned
+        assert np.asarray(res.phi).shape == (NB,)

@@ -93,3 +93,13 @@ def test_gls_detects_injected_spin_offset(tim_path):
     # the TOA measurement itself; the injected drift is recovered to
     # 0.3% in practice
     assert res.params["F0"] == pytest.approx(-f0 * 3e-12, rel=0.01)
+
+
+def test_gls_rejects_malformed_parfile(tim_path):
+    from pulseportraiture_tpu.timing import read_tim, wideband_gls_fit
+
+    toas = read_tim(tim_path)
+    with pytest.raises(ValueError, match="PEPOCH"):
+        wideband_gls_fit(toas, {"F0": 333.0, "DM": 10.0})
+    with pytest.raises(ValueError, match="F0"):
+        wideband_gls_fit(toas, {"PEPOCH": 55000.0, "DM": 10.0})
