@@ -81,6 +81,16 @@ use_matmul_dft = "auto"
 # at bench noise levels, avoid for extreme-S/N data.
 cross_spectrum_dtype = None
 
+# Compensated (Dot2: FMA residue capture + df64 pairwise summation)
+# accumulation for the scattering fit's nine harmonic reductions
+# (fit/portrait._cgh_scatter).  Cuts the f32 accumulation error from
+# ~n*eps to ~sqrt(n)*eps so extreme-S/N tau fits resolve the chi^2
+# valley to the sigma_tau limit instead of an f32 floor; costs ~2x the
+# reduction traffic of the scattering Newton step.  False (default):
+# plain f32 sums — right for ordinary S/N, where the noise floor is
+# orders of magnitude above the f32 valley.
+scatter_compensated = False
+
 # --- Model evolution codes ------------------------------------------------
 # Per-parameter evolution function code string for .gmodel files:
 # one digit each for (loc, wid, amp); '0' = power law, '1' = linear

@@ -117,23 +117,31 @@ def fit_portrait_sharded_fast(
     max_iter=40,
     shard_channels=False,
     pallas=False,
+    log10_tau=False,
+    compensated=None,
 ):
     """fit_portrait_sharded through the complex-free real-arithmetic
-    core (fit/portrait.py _fit_portrait_core_real): matmul DFTs, CCF
-    seed, and the Newton loop in one sharded program — the scale-out
-    path for TPU runtimes that cannot compile complex FFTs.
+    cores: matmul DFTs, CCF seed, and the Newton loop in one sharded
+    program — the scale-out path for TPU runtimes that cannot compile
+    complex FFTs.  No-scattering fits run _fit_portrait_core_real's
+    3-moment pass; scattering fits (tau/alpha flags, log10_tau, or a
+    fixed nonzero tau seed) the fused analytic _cgh_scatter lane
+    (fast_scatter_fit_one) — both complex-free end to end.
 
     models may be (nb, nchan, nbin) or a shared (nchan, nbin) template.
-    No-scattering fits only.  pallas stays opt-in here: the fused
-    kernel is not auto-partitionable, so with channel sharding XLA
-    would replicate it; the XLA real path shards cleanly (psum over
-    'chan' for the channel reductions).
+    pallas stays opt-in here: the fused kernel is not
+    auto-partitionable, so with channel sharding XLA would replicate
+    it; the XLA real path shards cleanly (psum over 'chan' for the
+    channel reductions).
     """
-    from ..fit.portrait import reject_fixed_tau_seed
+    from .. import config
+    from ..fit.portrait import derive_use_scatter, reject_fixed_tau_seed
 
-    if fit_flags[3] or fit_flags[4]:
-        raise ValueError("fit_portrait_sharded_fast: no-scattering only")
-    reject_fixed_tau_seed(theta0, "fit_portrait_sharded_fast")
+    use_scatter = derive_use_scatter(fit_flags, log10_tau, theta0)
+    if not use_scatter:
+        reject_fixed_tau_seed(theta0, "fit_portrait_sharded_fast")
+    if compensated is None:
+        compensated = bool(getattr(config, "scatter_compensated", False))
     ports = jnp.asarray(ports)
     nb, nchan, nbin = ports.shape
     dt = ports.dtype
@@ -155,7 +163,8 @@ def fit_portrait_sharded_fast(
 
     jitted, shardings = _sharded_fast_fn(
         mesh, flags, int(max_iter), bool(pallas), m_ax, f_ax,
-        bool(shard_channels))
+        bool(shard_channels), use_scatter=bool(use_scatter),
+        log10_tau=bool(log10_tau), compensated=bool(compensated))
     sh3, shm, sh2c, _, _, _ = shardings
     ports = jax.device_put(ports, sh3)
     models = jax.device_put(models, shm)
@@ -265,13 +274,22 @@ _ALIGN_TINY = 1e-30
 
 @lru_cache(maxsize=None)
 def _sharded_fast_fn(mesh, flags, max_iter, pallas, m_ax, f_ax,
-                     shard_channels):
+                     shard_channels, use_scatter=False, log10_tau=False,
+                     compensated=False):
     """Cached sharded jit of the shared per-element fast fit
-    (fit.portrait.fast_fit_one) — a fresh jit per call would recompile
+    (fit.portrait.fast_fit_one, or fast_scatter_fit_one when the
+    scattering kernel is active) — a fresh jit per call would recompile
     the full sharded program every invocation.  Mesh is hashable, so it
     keys the cache."""
-    one = partial(fast_fit_one, fit_flags=flags, max_iter=max_iter,
-                  pallas=pallas)
+    if use_scatter:
+        from ..fit.portrait import fast_scatter_fit_one
+
+        one = partial(fast_scatter_fit_one, fit_flags=flags,
+                      log10_tau=log10_tau, max_iter=max_iter,
+                      compensated=compensated)
+    else:
+        one = partial(fast_fit_one, fit_flags=flags, max_iter=max_iter,
+                      pallas=pallas)
     core = jax.vmap(one, in_axes=(0, m_ax, 0, 0, f_ax, 0, 0, 0, 0))
 
     chan_axis = 1 if shard_channels else None

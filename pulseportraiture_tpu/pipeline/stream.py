@@ -31,11 +31,14 @@ scattering (fit_scat/log10_tau/scat_guess/fix_alpha as in GetTOAs),
 flux estimates (print_flux), and instrumental-response kernels
 (instrumental_response_dict, incl. per-archive DM smearing); the
 narrowband per-channel mode streams via stream_narrowband_TOAs
-(pptoas --stream --narrowband).  No-scattering
-buckets take the complex-free f32 fast path on TPU backends
-(config.use_fast_fit), scattering buckets the complex engine; subints
-with a single usable channel are demoted to phase-only buckets (the
-degenerate-geometry fallback, pptoas.py:519-527).
+(pptoas --stream --narrowband).  On fast backends
+(config.use_fast_fit — TPU default) EVERY bucket is complex-free:
+no-scattering buckets run the 3-moment fast path, scattering buckets
+the fused analytic _cgh_scatter lane, sharing the matmul-DFT front end;
+instrumental-response kernels ship as split real arrays (complex
+buffers cannot cross some tunneled transports).  Subints with a single
+usable channel are demoted to phase-only buckets (the degenerate-
+geometry fallback, pptoas.py:519-527).
 
 The reference has no analogue (strictly sequential archive loop,
 pptoas.py:258); this is new capability enabled by the batched engine.
@@ -145,7 +148,7 @@ def _load_raw(f):
 @lru_cache(maxsize=None)
 def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
                 use_fast, ftname, pallas, x_bf16, redisp=False,
-                want_flux=False, use_ir=False):
+                want_flux=False, use_ir=False, compensated=False):
     """ONE jitted program for a raw bucket: int16 decode (scl/offs),
     min-window baseline subtraction, power-spectrum noise, S/N,
     nu_fit seeding, the batched fit, and result packing into a single
@@ -163,7 +166,7 @@ def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
     tiny = float(np.finfo(ftname).tiny)
 
     def run(raw, scl, offs, cmask, modelx, freqs, Ps, DMg, nu_out,
-            tau_s, tau_nu, tau_a, alpha0, redisp_turns, ir_FT):
+            tau_s, tau_nu, tau_a, alpha0, redisp_turns, ir_r, ir_i):
         x = raw.astype(ft) * scl[..., None] + offs[..., None]
         x = x - min_window_baseline(x)[..., None]
         if redisp:
@@ -212,14 +215,34 @@ def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
                                  x_bf16=x_bf16)
             r = fit(x, modelx, noise, cmask, freqs, Ps, nu_fit,
                     nu_out_arr, theta0)
+        elif use_fast:
+            # complex-free scattering lane: the fused analytic
+            # _cgh_scatter Newton loop shares the matmul-DFT front end
+            # (no complex types in the whole program)
+            from functools import partial as _partial
+
+            from ..fit.portrait import fast_scatter_fit_one
+
+            one = _partial(
+                fast_scatter_fit_one, fit_flags=FitFlags(*flags),
+                log10_tau=log10_tau, max_iter=max_iter,
+                compensated=compensated, x_bf16=x_bf16)
+            r = jax.vmap(one, in_axes=(0, None, 0, 0, None, 0, 0, 0, 0,
+                                       None, None))(
+                x, modelx, noise, cmask, freqs, Ps, nu_fit,
+                nu_out_arr, theta0, ir_r if use_ir else None,
+                ir_i if use_ir else None)
         else:
+            # ir as complex only INSIDE the program (some tunneled
+            # transports cannot move complex buffers at all)
+            ir_FT = (jax.lax.complex(ir_r, ir_i) if use_ir else None)
             r = fit_portrait_batch(
                 x, modelx, noise, freqs, Ps,
                 nu_fit, nu_out=nu_out_arr, theta0=theta0,
                 fit_flags=FitFlags(*flags), chan_masks=cmask,
                 log10_tau=log10_tau, max_iter=max_iter,
                 use_scatter=scat_engine,
-                ir_FT=ir_FT if use_ir else None)
+                ir_FT=ir_FT)
         fields = [getattr(r, k) for k in _result_keys(flags)]
         if want_flux:
             # flux reduces to 3 scalars per subint ON DEVICE: pulling
@@ -296,21 +319,29 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
         # pallas/bf16 config read per call (cache-key args, mirroring
         # _fast_batch_fn): mid-process config toggles take effect
         use_ir = bucket.ir_FT is not None
+        from .. import config as _config
+
         fn = _raw_fit_fn(int(raw.shape[1]), bucket.nbin,
                          tuple(bool(f) for f in bucket.flags),
                          int(max_iter), bool(log10_tau), tau_mode,
                          use_fast, ftname,
                          use_pallas_moments(np.dtype(ftname)),
                          use_bf16_cross_spectrum(), redisp=redisp,
-                         want_flux=want_flux, use_ir=use_ir)
+                         want_flux=want_flux, use_ir=use_ir,
+                         compensated=bool(getattr(
+                             _config, "scatter_compensated", False)))
         ft = jnp.float32 if use_fast else jnp.float64
-        ct = jnp.complex64 if use_fast else jnp.complex128
         t_s, t_nu, t_a = tau_args
         modelx, freqs = bucket.modelx, bucket.freqs
-        # None (empty pytree) when IR is off — an eager complex64
-        # placeholder would be created on the default device, and some
-        # tunneled runtimes cannot transfer complex buffers at all
-        ir_arg = jnp.asarray(bucket.ir_FT, ct) if use_ir else None
+        # the response ships as TWO REAL arrays (complex buffers cannot
+        # cross some tunneled-runtime transports at all); the complex
+        # engine reassembles them device-side inside the program
+        if use_ir:
+            ir_h = np.asarray(bucket.ir_FT)
+            ir_r = jnp.asarray(ir_h.real, ft)
+            ir_i = jnp.asarray(ir_h.imag, ft)
+        else:
+            ir_r = ir_i = None
 
         def dispatch():
             return fn(jnp.asarray(raw), jnp.asarray(scl, ft),
@@ -319,21 +350,25 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                       jnp.asarray(freqs, ft), jnp.asarray(Ps, ft),
                       jnp.asarray(DMg, ft), ft(nu_out),
                       ft(t_s), ft(t_nu), ft(t_a), ft(alpha0),
-                      jnp.asarray(turns, ft), ir_arg)
+                      jnp.asarray(turns, ft), ir_r, ir_i)
     else:
         ports = np.stack([bucket.ports[i] for i in idx0])
         noise = np.stack([bucket.noise[i] for i in idx0])
         nu_fit = np.asarray([bucket.nu_fits[i] for i in idx0])
         theta0 = np.stack([bucket.theta0[i] for i in idx0])
         # scattering (fitted, or a fixed nonzero/log10 tau seed in a
-        # degenerate lane of a scattering run) needs the complex engine
+        # degenerate lane of a scattering run, or an IR kernel) routes
+        # to the scatter-shaped engine — complex-free on fast backends
         scat = (flags[3] or flags[4] or log10_tau
                 or bool(np.any(theta0[:, 3] != 0.0))
                 or bucket.ir_FT is not None)
         modelx, freqs = bucket.modelx, bucket.freqs
 
         def dispatch():
-            if not scat and use_fast:
+            if use_fast:
+                # both regimes share the complex-free matmul-DFT lane;
+                # scattering buckets route to the fused analytic
+                # _cgh_scatter Newton loop inside
                 ft = jnp.float32
                 r = fit_portrait_batch_fast(
                     jnp.asarray(ports, ft), jnp.asarray(modelx, ft),
@@ -341,7 +376,8 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                     jnp.asarray(Ps, ft), jnp.asarray(nu_fit, ft),
                     nu_out=nu_ref_DM, theta0=jnp.asarray(theta0, ft),
                     fit_flags=flags, chan_masks=jnp.asarray(masks, ft),
-                    max_iter=max_iter)
+                    max_iter=max_iter, log10_tau=log10_tau,
+                    ir_FT=bucket.ir_FT, use_scatter=scat)
             else:
                 r = fit_portrait_batch(
                     jnp.asarray(ports),

@@ -381,3 +381,48 @@ def test_stream_narrowband_matches_gettoas(tmp_path):
         assert dt_us < 1e-2, dt_us
         assert t.flags["log10_scat_time"] == pytest.approx(
             t_ref.flags["log10_scat_time"], abs=1e-3)
+
+
+def test_stream_fast_lane_scattering_parity(tmp_path):
+    """With config.use_fast_fit forced on (the TPU setting), scattering
+    buckets route through the complex-free _cgh_scatter lane in f32 —
+    results must match the f64 complex-engine run to f32 tolerances,
+    with an instrumental-response kernel folded in."""
+    from pulseportraiture_tpu import config
+
+    model = default_test_model(1500.0)
+    gmodel = str(tmp_path / "model.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files = []
+    for i in range(2):
+        path = str(tmp_path / f"fs{i}.fits")
+        make_fake_pulsar(model, PAR, outfile=path, nsub=2, nchan=32,
+                         nbin=256, nu0=1500.0, bw=800.0, tsub=60.0,
+                         phase=0.02 * i, dDM=1e-4 * i, t_scat=3e-4,
+                         alpha=-4.0, start_MJD=MJD(55300 + 10 * i, 0.1),
+                         noise_stds=0.02, dedispersed=False, quiet=True,
+                         rng=700 + i)
+        files.append(path)
+    ird = {"wids": [0.2e-3], "irf_types": ["rect"]}
+    kw = dict(nsub_batch=4, fit_scat=True, scat_guess="auto",
+              instrumental_response_dict=ird, quiet=True)
+    ref = stream_wideband_TOAs(files, gmodel, **kw)
+    assert config.use_fast_fit == "auto"
+    config.use_fast_fit = True
+    try:
+        fast = stream_wideband_TOAs(files, gmodel, **kw)
+    finally:
+        config.use_fast_fit = "auto"
+    assert len(fast.TOA_list) == len(ref.TOA_list) == 4
+    by_key = {(t.archive, t.flags["subint"]): t for t in fast.TOA_list}
+    for t_ref in ref.TOA_list:
+        t = by_key[(t_ref.archive, t_ref.flags["subint"])]
+        # arrival times agree to ~1e-7 s (f32 phase resolution x P)
+        assert abs((t.MJD - t_ref.MJD) * 86400.0) < 5e-7
+        assert t.DM == pytest.approx(t_ref.DM, abs=5e-4)
+        assert t.flags["scat_time"] == pytest.approx(
+            t_ref.flags["scat_time"], rel=0.02)
+        assert t.flags["scat_ind"] == pytest.approx(
+            t_ref.flags["scat_ind"], abs=0.05)
+        assert t.flags["snr"] == pytest.approx(t_ref.flags["snr"],
+                                               rel=0.01)
