@@ -29,9 +29,14 @@ def main():
     # end-to-end than 3-pass, and the per-harmonic quantization error
     # averages down across harmonics x channels — the |dphi| gate below
     # measures BETTER than at 'high' at these noise levels (must be set
-    # before the first jit trace — the program caches it)
+    # before the first jit trace — the program caches it).
+    # PPT_XSPEC=float32 reverts the cross-spectrum storage for A/B runs.
+    import os as _os
+
     config.dft_precision = "default"
-    config.cross_spectrum_dtype = "bfloat16"
+    config.cross_spectrum_dtype = (
+        None if _os.environ.get("PPT_XSPEC", "").lower() == "float32"
+        else "bfloat16")
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
@@ -157,6 +162,28 @@ def main():
         for i in range(n_base)
     )
 
+    # --- MFU accounting (analytic FLOP count / measured device time) ----
+    # The fit's MXU work is the matmul DFT of the data batch: two
+    # (NCHAN, NBIN) x (NBIN, NHARM) matmuls (cos + sin weights) per
+    # element at 2 flops/MAC; 'default' precision is a single bf16
+    # pass, so the arithmetic count equals the analytic count.  The
+    # CCF-seed inverse DFT adds one (NHARM,) x (NHARM, 2*NBIN) pair per
+    # element.  Everything else (cross-spectrum assembly, ~2-3 moment
+    # passes) is VPU elementwise/transcendental work with no meaningful
+    # peak to normalize against, so it is EXCLUDED — mfu here is
+    # "fraction of MXU peak spent on the DFTs", a lower bound on how
+    # far from roofline the whole fit runs (the moment passes keep the
+    # chip busy between matmuls).
+    nharm = NBIN // 2 + 1
+    dft_flops = NB * 2 * (2.0 * NCHAN * NBIN * nharm)
+    ccf_flops = NB * 2 * (2.0 * nharm * 2 * NBIN)
+    mxu_flops = dft_flops + ccf_flops
+    tflops = mxu_flops / t_tpu / 1e12
+    # bf16 MXU peak per chip: v5e 197 TFLOPS, v4 275, v5p 459
+    peaks = {"v5 lite": 197.0, "v4": 275.0, "v5p": 459.0, "v6": 918.0}
+    peak = next((v for k, v in peaks.items() if k in str(dev).lower()),
+                None)
+
     out = {
         "metric": "wideband (phi,DM) portrait fits, 512ch x 2048bin",
         "value": round(toas_per_sec, 2),
@@ -167,8 +194,11 @@ def main():
         "batch_latency_ms": round(t_lat * 1e3, 1),
         "device": str(dev),
         "dtype": "float32" if on_tpu else str(np.dtype("float32")),
+        "cross_spectrum_dtype": str(config.cross_spectrum_dtype),
         "max_dphi_vs_numpy": float(f"{dphi:.2e}"),
         "accuracy_gate_1e-4": bool(dphi < 1e-4),
+        "dft_tflops": round(tflops, 1),
+        "mfu": round(tflops / peak, 3) if peak else None,
     }
     print(json.dumps(out))
 

@@ -1,0 +1,94 @@
+"""Device-resident campaign ceiling (link-free config 5).
+
+BENCHMARKS.md round 2 claimed "a real host would stream thousands of
+TOAs/s" because the tunneled link eats ~90% of campaign wall — but the
+number was extrapolated.  This bench RECORDS it: the streaming driver's
+fused raw-bucket program (pipeline/stream._raw_fit_fn — int16 decode,
+min-window baseline, power-spectrum noise, S/N, nu_fit seeding, batched
+fit, result packing) runs on DEVICE-RESIDENT data, K dispatches
+back-to-back with one scalar pull, slope-timed.  That is the per-chip
+compute ceiling a locally-attached host sees once IO keeps up
+(prefetch threads + the raw int16 lane at ~2x effective link bytes).
+
+Knobs via env: PPT_NSUBB (bucket size, default 256), PPT_NCHAN (256),
+PPT_NBIN (1024).  Prints ONE JSON line like bench.py.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import pulseportraiture_tpu  # noqa: F401
+    from pulseportraiture_tpu import config
+    config.dft_precision = "default"
+    config.cross_spectrum_dtype = "bfloat16"
+
+    from benchmarks.common import bench_model, devtime
+    from pulseportraiture_tpu.pipeline.stream import _raw_fit_fn
+
+    NSUBB = int(os.environ.get("PPT_NSUBB", 256))
+    NCHAN = int(os.environ.get("PPT_NCHAN", 256))
+    NBIN = int(os.environ.get("PPT_NBIN", 1024))
+    P, NU0 = 0.003, 1500.0
+    DT = jnp.float32
+
+    model, freqs = bench_model(NCHAN, NBIN)
+
+    # raw int16 bucket, host-built once, device-resident thereafter
+    rng = np.random.default_rng(0)
+    clean = np.asarray(model, np.float32)
+    ports = clean[None] * (1.0 + 0.1 * rng.standard_normal(
+        (NSUBB, 1, 1)).astype(np.float32))
+    ports = ports + 0.05 * rng.standard_normal(ports.shape).astype(
+        np.float32)
+    lo, hi = ports.min(axis=-1), ports.max(axis=-1)
+    scl = np.maximum((hi - lo) / 65000.0, 1e-12).astype(np.float32)
+    offs = ((hi + lo) / 2.0).astype(np.float32)
+    raw = np.clip(np.round((ports - offs[..., None]) / scl[..., None]),
+                  -32767, 32767).astype(np.int16)
+
+    flags = (True, True, False, False, False)
+    fn = _raw_fit_fn(NCHAN, NBIN, flags, 25, False, "none", True,
+                     "float32", False, True)
+    d = {
+        "raw": jnp.asarray(raw), "scl": jnp.asarray(scl, DT),
+        "offs": jnp.asarray(offs, DT),
+        "cmask": jnp.ones((NSUBB, NCHAN), DT),
+        "model": jnp.asarray(clean, DT), "freqs": jnp.asarray(freqs, DT),
+        "Ps": jnp.full((NSUBB,), P, DT),
+        "DMg": jnp.zeros((NSUBB,), DT),
+        "turns": jnp.zeros((NSUBB, 1), DT),
+    }
+    jax.block_until_ready(d["raw"])
+
+    def run():
+        return fn(d["raw"], d["scl"], d["offs"], d["cmask"], d["model"],
+                  d["freqs"], d["Ps"], d["DMg"], DT(-1.0), DT(0.0),
+                  DT(1.0), DT(0.0), DT(0.0), d["turns"], None, None)
+
+    r = run()
+    phi = np.asarray(r)[0]
+    assert np.all(np.isfinite(phi)), "non-finite phases"
+    slope, single = devtime(run, lambda rr: rr)
+    print(json.dumps({
+        "metric": f"device-resident raw campaign buckets, {NSUBB}sub x "
+                  f"{NCHAN}ch x {NBIN}bin (decode+stats+fit+pack)",
+        "value": round(NSUBB / slope, 1),
+        "unit": "TOAs/sec",
+        "bucket_latency_ms": round(single * 1e3, 1),
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+"""BASELINE.md config 5 (multi-pulsar form): a scaled-down IPTA
+campaign — 5 pulsars x 40 archives, each with its own template/period/
+DM, streamed through pipeline/ipta.stream_ipta_campaign (per-pulsar
+buckets, per-pulsar .tim outputs).
+
+The full config is 45 pulsars x ~1000 archives over a pod; this bench
+measures the single-process/one-chip slice end-to-end (file IO, raw
+int16 decode on device, fused dispatches, .tim assembly) — multi-host
+scaling is archive-parallel with no cross-host communication, so the
+pod number is this value x hosts (validated with real processes by
+tests/test_multihost_spawn.py).
+
+Prints ONE JSON line like bench.py.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    import pulseportraiture_tpu  # noqa: F401
+    from pulseportraiture_tpu import config
+    config.dft_precision = "default"
+    config.cross_spectrum_dtype = "bfloat16"
+
+    import jax
+
+    from pulseportraiture_tpu.io.gmodel import write_gmodel
+    from pulseportraiture_tpu.pipeline import IPTAJob, stream_ipta_campaign
+    from pulseportraiture_tpu.synth import default_test_model
+    from pulseportraiture_tpu.synth.archive import make_fake_pulsar
+
+    NPSR, NARCH, NSUB, NCHAN, NBIN = 5, 40, 4, 256, 1024
+
+    with tempfile.TemporaryDirectory() as td:
+        jobs = []
+        for k in range(NPSR):
+            psr = f"J{k:02d}00+{k:02d}"
+            nu_ref = 1400.0 + 50.0 * k
+            mpath = os.path.join(td, f"{psr}.gmodel")
+            write_gmodel(default_test_model(nu_ref), mpath, quiet=True)
+            par = {"PSR": psr, "P0": 0.002 + 5e-4 * k,
+                   "DM": 10.0 + 15.0 * k, "PEPOCH": 56000.0}
+            files = []
+            for i in range(NARCH):
+                path = os.path.join(td, f"{psr}_a{i:03d}.fits")
+                make_fake_pulsar(mpath, par, outfile=path, nsub=NSUB,
+                                 nchan=NCHAN, nbin=NBIN, nu0=nu_ref,
+                                 bw=600.0, phase=0.01 * i, dDM=1e-4 * i,
+                                 noise_stds=0.05, quiet=True,
+                                 rng=100 * k + i)
+                files.append(path)
+            jobs.append(IPTAJob(psr, files, mpath))
+
+        outdir = os.path.join(td, "tims")
+        # warm (compile) on a 1-archive slice of each layout, then the
+        # full campaign
+        stream_ipta_campaign(
+            [IPTAJob(j.pulsar, j.datafiles[:1], j.modelfile)
+             for j in jobs], nsub_batch=64, quiet=True)
+        t0 = time.perf_counter()
+        res = stream_ipta_campaign(jobs, outdir=outdir, nsub_batch=64,
+                                   quiet=True)
+        wall = time.perf_counter() - t0
+        ntim = len(os.listdir(outdir))
+
+    ntoa = len(res.TOA_list)
+    print(json.dumps({
+        "metric": f"IPTA campaign: {NPSR} pulsars x {NARCH} archives x "
+                  f"{NSUB}sub x {NCHAN}ch x {NBIN}bin, per-pulsar "
+                  "models + .tim outputs",
+        "value": round(ntoa / wall, 2),
+        "unit": "TOAs/sec",
+        "wall_s": round(wall, 2),
+        "toas": ntoa,
+        "pulsars": NPSR,
+        "tim_files": ntim,
+        "fit_fraction": round(float(res.fit_duration) / max(wall, 1e-9),
+                              3),
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

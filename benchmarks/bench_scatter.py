@@ -1,10 +1,11 @@
 """BASELINE.md config 3: full (phi, DM, GM, tau, alpha) scattering fit,
 64 subints x 512 chan x 2048 bin, jitted inner optimizer, one TPU chip.
 
-The complex engine's DFTs route through ops/fourier.rfft_c (matmul
-weights on TPU — XLA's native FFT lowering is unusable there), so this
-path runs at MXU speed; the Newton loop evaluates the scattering
-objective's autodiff gradient/Hessian once per iteration.
+Default engine is the round-3 complex-free fast lane
+(fit_portrait_batch_fast -> fast_scatter_fit_one): matmul DFTs + the
+fused analytic _cgh_scatter Newton loop in one real-arithmetic program.
+`--engine complex` benches the round-2 complex engine for comparison;
+`--compensated` turns on the Dot2 reductions.
 
 Prints ONE JSON line like bench.py.
 """
@@ -24,9 +25,15 @@ def main():
     import pulseportraiture_tpu  # noqa: F401
     from pulseportraiture_tpu import config
     config.dft_precision = "default"
+    engine = "complex" if "--engine=complex" in sys.argv[1:] or \
+        ("--engine" in sys.argv[1:] and "complex" in sys.argv[1:]) \
+        else "fast"
+    if "--compensated" in sys.argv[1:]:
+        config.scatter_compensated = True
 
     from benchmarks.common import bench_model, devtime
     from pulseportraiture_tpu.fit import FitFlags, fit_portrait_batch
+    from pulseportraiture_tpu.fit.portrait import fit_portrait_batch_fast
     from pulseportraiture_tpu.ops.fourier import irfft_c, rfft_c
     from pulseportraiture_tpu.ops.scattering import (scattering_portrait_FT,
                                                      scattering_times)
@@ -62,11 +69,16 @@ def main():
     th0[:, 4] = -4.0
     th0 = jnp.asarray(th0)
 
+    flags = FitFlags(True, True, False, True, True)
+
     def run():
+        if engine == "fast":
+            return fit_portrait_batch_fast(
+                ports, models, noise, freqs, P, NU_FIT,
+                fit_flags=flags, theta0=th0, log10_tau=True, max_iter=40)
         return fit_portrait_batch(
             ports, models, noise, freqs, P, NU_FIT,
-            fit_flags=FitFlags(True, True, False, True, True),
-            theta0=th0, log10_tau=True, max_iter=40)
+            fit_flags=flags, theta0=th0, log10_tau=True, max_iter=40)
 
     r = run()
     exp = (TAU_S / P) * (np.asarray(r.nu_tau) / NU_FIT) ** np.asarray(r.alpha)
@@ -76,6 +88,8 @@ def main():
         "metric": "5-param scattering fits, 64sub x 512ch x 2048bin",
         "value": round(NB / slope, 2),
         "unit": "TOAs/sec",
+        "engine": engine,
+        "compensated": bool(config.scatter_compensated),
         "batch_latency_ms": round(single * 1e3, 1),
         "device": str(jax.devices()[0]),
         "tau_rel_err_median": float(f"{np.median(rel):.3g}"),

@@ -74,12 +74,18 @@ dft_precision = "highest"
 use_matmul_dft = "auto"
 
 # Storage dtype for the fit's precomputed cross-spectrum X = d*conj(m)*w
-# (fit/portrait.py fast path).  None = same as the input data (f32 on
-# TPU).  'bfloat16' halves the Newton loop's HBM read traffic (~15%
-# end-to-end at bench shapes); moments still accumulate in f32.  Same
-# caveat as dft_precision='default': validated against the |dphi| gate
-# at bench noise levels, avoid for extreme-S/N data.
-cross_spectrum_dtype = None
+# (fit/portrait.py fast lanes).  'bfloat16' (default since round 3)
+# halves the Newton loop's HBM read traffic (~15% end-to-end on the
+# no-scatter bench, +18% on the scattering bench); moments still
+# accumulate in f32, pulls stay calibrated
+# (tests/test_fit.py::test_fast_path_error_calibration_bf16), and the
+# |dphi|-vs-NumPy gate measures BETTER than f32 storage at bench noise
+# (quantization averages down across ~5e5 harmonic-channel terms).
+# Applies ONLY when the working dtype is f32 — f64 runs (CPU parity /
+# oracle paths) never narrow.  Set to None for f32 storage on
+# extreme-S/N data where ~1e-3 per-term quantization could rival the
+# noise floor.
+cross_spectrum_dtype = "bfloat16"
 
 # Compensated (Dot2: FMA residue capture + df64 pairwise summation)
 # accumulation for the scattering fit's nine harmonic reductions

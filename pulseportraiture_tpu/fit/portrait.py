@@ -173,6 +173,27 @@ def use_bf16_cross_spectrum():
     return str(getattr(config, "cross_spectrum_dtype", None)) == "bfloat16"
 
 
+def use_scatter_compensated():
+    """Whether scattering fits run the Dot2-compensated reductions
+    (config.scatter_compensated) — the single parse point, shared by
+    the batch, sharded, and streaming entry paths."""
+    return bool(getattr(config, "scatter_compensated", False))
+
+
+def split_ir_host(ir_FT, dt):
+    """Split a HOST complex instrumental-response FT into two real
+    device arrays.  Complex buffers cannot cross some tunneled-runtime
+    transports at all, so the response always ships as (ir_r, ir_i)
+    and is reassembled (or consumed split) device-side.  None -> (None,
+    None)."""
+    if ir_FT is None:
+        return None, None
+    import numpy as _np
+
+    ir_h = _np.asarray(ir_FT)
+    return jnp.asarray(ir_h.real, dt), jnp.asarray(ir_h.imag, dt)
+
+
 def use_pallas_moments(dtype):
     """Whether the fused Pallas moment kernel should run: opt-in via
     config.use_pallas (True = f32 data anywhere, 'auto' = TPU backends;
@@ -264,18 +285,21 @@ def _pair_sum_df64(x, lo=None):
     inputs; combined with FMA product-error capture at the call sites
     this is the Ogita-Rump-Oishi Dot2 structure, giving as-if-2x-
     precision reductions on hardware with no f64 (TPU)."""
-    n = x.shape[-1]
-    pad = (-n) % 2
-    hi = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)]) if pad else x
-    lo = (jnp.zeros_like(hi) if lo is None
-          else (jnp.pad(lo, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
-                if pad else lo))
+    hi = x
+    lo = jnp.zeros_like(x) if lo is None else lo
+    # combine contiguous HALVES at each level (same reduction tree as
+    # adjacent pairs — TwoSum is exact for any operands — but the
+    # slices stay contiguous along the lane dimension, which measures
+    # ~10x faster than stride-2 gathers on TPU)
     while hi.shape[-1] > 1:
-        if hi.shape[-1] % 2:
+        n = hi.shape[-1]
+        if n % 2:
             hi = jnp.pad(hi, [(0, 0)] * (hi.ndim - 1) + [(0, 1)])
             lo = jnp.pad(lo, [(0, 0)] * (lo.ndim - 1) + [(0, 1)])
-        hi, lo = _two_sum(hi[..., 0::2], lo[..., 0::2],
-                          hi[..., 1::2], lo[..., 1::2])
+            n += 1
+        half = n // 2
+        hi, lo = _two_sum(hi[..., :half], lo[..., :half],
+                          hi[..., half:], lo[..., half:])
     return hi[..., 0] + lo[..., 0]
 
 
@@ -463,6 +487,19 @@ def _cgh_scatter(theta, Xr, Xi, M2, freqs, nu_fit, cvec, gvec,
     return f, g, H, (C, S)
 
 
+# Initial Levenberg damping for SCATTERING fits.  The generic 1e-3
+# perturbs the well-seeded Newton trajectory enough that a tail of
+# batch elements needs ~23 trips (the vmapped while_loop pays for the
+# MAX, not the median); 1e-5 measured on TPU at bench config 3:
+# nfev max 23 -> 16, every element rc=0, +37% throughput, tau accuracy
+# unchanged.  Poor seeds stay safe: rejections still grow lam 8x/trip.
+_SCATTER_LAM0 = 1e-5
+
+# Per-iteration step bound for (phi, DM, GM, theta3, alpha) — see the
+# trust-bound comment in _newton_loop's body.
+_STEP_CAP = (float("inf"), float("inf"), float("inf"), 1.0, 2.0)
+
+
 def _scatter_ftol(dt, compensated=False):
     """Convergence threshold for SCATTERING fits.  The generic
     50*eps(|f|+1) is loose enough that an f32 tau fit stops a
@@ -585,11 +622,45 @@ def _newton_loop(cgh, theta0, flags_arr, max_iter, ftol, lam0=1.0e-3,
             pred_cur < ftol * (jnp.abs(s.f) + 1.0), jnp.isfinite(s.f))
         A = H + s.lam * jnp.diag(dH)
         step = -jnp.linalg.solve(A, g)
+        # per-step trust bound on the scattering-kernel parameters:
+        # along the soft tau-alpha valley a near-singular H makes the
+        # Newton step arbitrarily large, and at extreme tau the
+        # objective has a spurious descent path (every channel
+        # collapses onto its lowest surviving harmonic, where C^2/S
+        # stays finite as B -> 0).  One decade of log10-tau (or one
+        # rotation) and 2 units of alpha per ITERATION is generous for
+        # any legitimate trajectory while making the pathological
+        # region unreachable within max_iter from any sane seed.
+        # phi/DM/GM enter the phasor linearly and need no cap.
+        cap = jnp.asarray(_STEP_CAP, dt)
+        step = jnp.clip(step, -cap, cap)
         theta_new = s.theta + step * flags_arr
         f_new, g_new, H_new, aux_new = cgh(theta_new)
-        accept = jnp.logical_and(f_new < s.f, jnp.logical_not(conv_now))
+        accept_f = jnp.logical_and(f_new < s.f, jnp.logical_not(conv_now))
         gm, _ = mask_gH(g_new, H_new)
         pred_new, _ = _pred(gm, H)
+        # f-flat step: f_new within machine noise of f — near the
+        # optimum true improvements sink below the f-evaluation noise
+        # (~sqrt(N) eps |f|), so f comparisons go blind there
+        f_flat = f_new <= s.f + 64.0 * jnp.finfo(dt).eps * (
+            jnp.abs(s.f) + 1.0)
+        # gradient-guided acceptance through the flat zone: the analytic
+        # gradient keeps resolving descent long after f differences
+        # drown (measured: cuts the extreme-S/N f32 tau floor ~5x).
+        # A DECISIVE decrease (4x in the predicted improvement) is
+        # required — accepting any fluctuation would random-walk along
+        # soft Hessian directions (the tau-alpha degeneracy) where
+        # near-singular H makes steps large at noise-level gradients;
+        # the 4x floor makes the accepted sequence strictly contracting
+        # in pred, so it must terminate at the conv threshold.  Guarded
+        # by isfinite so the bootstrap trip can't take it.
+        accept_g = jnp.logical_and(
+            jnp.logical_and(f_flat, jnp.logical_not(accept_f)),
+            jnp.logical_and(
+                pred_new < 0.25 * pred_cur,
+                jnp.logical_and(jnp.isfinite(s.f),
+                                jnp.logical_not(conv_now))))
+        accept = jnp.logical_or(accept_f, accept_g)
         # the isfinite guard keeps the bootstrap trip (whose pred_new is
         # judged against the placeholder identity Hessian, not real
         # curvature) from ever declaring step-convergence at the seed
@@ -599,9 +670,7 @@ def _newton_loop(cgh, theta0, flags_arr, max_iter, ftol, lam0=1.0e-3,
                 jnp.logical_and(accept, jnp.isfinite(s.f)),
                 pred_new < ftol * (jnp.abs(f_new) + 1.0)),
         )
-        flat = jnp.logical_and(
-            jnp.logical_not(accept),
-            f_new <= s.f + 64.0 * jnp.finfo(dt).eps * (jnp.abs(s.f) + 1.0))
+        flat = jnp.logical_and(jnp.logical_not(accept), f_flat)
         rej_new = jnp.where(flat, s.rej + 1, 0)
         done_stall = jnp.logical_and(rej_new >= stall_max,
                                      jnp.logical_not(done_conv))
@@ -614,7 +683,13 @@ def _newton_loop(cgh, theta0, flags_arr, max_iter, ftol, lam0=1.0e-3,
             H=jnp.where(accept, H_new, s.H),
             aux=jax.tree_util.tree_map(
                 lambda a, b: jnp.where(accept, a, b), aux_new, s.aux),
-            lam=jnp.where(accept, s.lam * 0.33, s.lam * 8.0).clip(1e-14, 1e14),
+            # flat (gradient-guided) accepts keep lam: decaying it there
+            # would let later steps grow unboundedly along soft
+            # directions where f can no longer arbitrate
+            lam=jnp.where(
+                accept_f, s.lam * 0.33,
+                jnp.where(accept_g, s.lam, s.lam * 8.0),
+            ).clip(1e-14, 1e14),
             it=s.it + 1,
             nfev=s.nfev + 1,
             rej=rej_new,
@@ -663,7 +738,7 @@ def _newton_loop(cgh, theta0, flags_arr, max_iter, ftol, lam0=1.0e-3,
 @partial(
     jax.jit,
     static_argnames=("fit_flags", "log10_tau", "max_iter", "use_ir",
-                     "use_scatter", "auto_seed"),
+                     "use_scatter", "auto_seed", "compensated"),
 )
 def _fit_portrait_core(
     dFT,
@@ -682,6 +757,7 @@ def _fit_portrait_core(
     use_ir=False,
     use_scatter=False,
     auto_seed=True,
+    compensated=False,
 ):
     dt = w.dtype
     flags_arr = FitFlags(*fit_flags).as_array(dt)
@@ -714,7 +790,7 @@ def _fit_portrait_core(
         def cgh(theta):
             f, g, H, _aux = _cgh_scatter(theta, Xs.real, Xs.imag, M2s_,
                                          freqs, nu_fit, cvec, gvec,
-                                         log10_tau)
+                                         log10_tau, compensated)
             return f, g, H
 
     else:
@@ -733,7 +809,8 @@ def _fit_portrait_core(
     else:
         theta0 = theta0.astype(dt)
 
-    s = _newton_loop(_with_no_aux(cgh), theta0, flags_arr, max_iter, ftol)
+    s = _newton_loop(_with_no_aux(cgh), theta0, flags_arr, max_iter, ftol,
+                     lam0=_SCATTER_LAM0 if scatter else 1.0e-3)
     theta = s.theta
 
     H = s.H
@@ -1055,7 +1132,8 @@ def _fit_portrait_core_real_scatter(
         return _cgh_scatter(theta, Xr, Xi, M2w, freqs, nu_fit, cvec,
                             gvec, log10_tau, compensated)
 
-    s = _newton_loop(cgh, theta0.astype(dt), flags_arr, max_iter, ftol)
+    s = _newton_loop(cgh, theta0.astype(dt), flags_arr, max_iter, ftol,
+                     lam0=_SCATTER_LAM0)
     C, S = s.aux
     return _finalize_fit(
         s.theta, s, s.H, C, S, Sd, nharm, flags_arr, fit_flags,
@@ -1079,13 +1157,18 @@ def fast_scatter_fit_one(port, model, noise_stds, chan_mask, freqs, P,
     engine."""
     if x_bf16 is None:
         x_bf16 = use_bf16_cross_spectrum()
-    from ..ops.fourier import rfft_mm
+    from ..ops.fourier import _gated_precision, rfft_mm
 
+    # clamp dft_precision 'default' up to 'high' like the complex
+    # interface (rfft_c): the bench-validated single-pass-bf16 setting
+    # would floor tau accuracy at ~2.5e-4, defeating the tightened
+    # scatter ftol; the DFT is a once-per-fit cost, not per-Newton-step
+    prec = _gated_precision(None)
     nbin = port.shape[-1]
     dt = port.dtype
     w = make_weights(noise_stds, nbin, chan_mask, dtype=dt)
-    dr, di = rfft_mm(port)
-    mr, mi = rfft_mm(model.astype(dt))
+    dr, di = rfft_mm(port, precision=prec)
+    mr, mi = rfft_mm(model.astype(dt), precision=prec)
     Xr = (dr * mr + di * mi) * w
     Xi = (di * mr - dr * mi) * w
     M2w = (mr**2 + mi**2) * w
@@ -1101,7 +1184,7 @@ def fast_scatter_fit_one(port, model, noise_stds, chan_mask, freqs, P,
         theta0 = jnp.where(jnp.arange(5) == 0, phi0, theta0).astype(dt)
     else:
         theta0 = theta0.astype(dt)
-    xdt = jnp.bfloat16 if x_bf16 else dt
+    xdt = jnp.bfloat16 if (x_bf16 and dt == jnp.float32) else dt
     return _fit_portrait_core_real_scatter.__wrapped__(
         Xr.astype(xdt), Xi.astype(xdt), M2w, Sd, freqs, P, nu_fit,
         nu_out, theta0, fit_flags=fit_flags, log10_tau=log10_tau,
@@ -1219,8 +1302,11 @@ def fast_fit_one(port, model, noise_stds, chan_mask, freqs, P, nu_fit,
     nbin = port.shape[-1]
     w = make_weights(noise_stds, nbin, chan_mask, dtype=port.dtype)
     # the Pallas moment kernel reads f32 tiles, so narrow storage only
-    # applies on the XLA moment path
-    x_dtype = jnp.bfloat16 if (x_bf16 and not pallas) else None
+    # applies on the XLA moment path; f64 runs (CPU parity/oracle paths)
+    # never narrow — bf16 storage is an f32-throughput optimization
+    x_dtype = (jnp.bfloat16
+               if (x_bf16 and not pallas and port.dtype == jnp.float32)
+               else None)
     Xr, Xi, S0, Sd, th0 = prepare_portrait_fit_real(
         port, model.astype(port.dtype), w, freqs, P, nu_fit, theta0,
         seed_phi=bool(fit_flags[0]), seed_derotate=seed_derotate,
@@ -1290,18 +1376,9 @@ def _fit_batch_fast_scatter(ports, models, noise_stds, freqs, P, nu_fit,
     if chan_masks is None:
         chan_masks = jnp.ones(ports.shape[:2], dt)
     if compensated is None:
-        compensated = bool(getattr(config, "scatter_compensated", False))
+        compensated = use_scatter_compensated()
     use_ir = ir_FT is not None
-    if use_ir:
-        # split on HOST: complex buffers cannot cross some tunneled
-        # transports (keep ir_FT host-side numpy at call sites)
-        import numpy as _np
-
-        ir_h = _np.asarray(ir_FT)
-        ir_r = jnp.asarray(ir_h.real, dt)
-        ir_i = jnp.asarray(ir_h.imag, dt)
-    else:
-        ir_r = ir_i = None
+    ir_r, ir_i = split_ir_host(ir_FT, dt)
     fit = _fast_scatter_batch_fn(
         FitFlags(*[bool(f) for f in fit_flags]), bool(log10_tau),
         int(max_iter), bool(compensated), use_bf16_cross_spectrum(),
@@ -1507,6 +1584,7 @@ def fit_portrait(
         use_ir=ir_FT is not None,
         use_scatter=use_scatter,
         auto_seed=phi0 is None,
+        compensated=use_scatter_compensated(),
     )
 
 
@@ -1564,7 +1642,7 @@ def fit_portrait_batch(
     fn = _complex_batch_fn(
         FitFlags(*[bool(f) for f in fit_flags]), bool(log10_tau),
         int(max_iter), bool(use_scatter), use_ir, m_ax, f_ax, p_ax,
-        nf_ax)
+        nf_ax, use_scatter_compensated())
     ir_arg = ir_FT if use_ir else None
     nu_out_arr = jnp.broadcast_to(
         jnp.asarray(nu_out_val, ports.dtype), (nb,))
@@ -1575,7 +1653,8 @@ def fit_portrait_batch(
 
 @lru_cache(maxsize=None)
 def _complex_batch_fn(fit_flags, log10_tau, max_iter, use_scatter,
-                      use_ir, m_ax, f_ax, p_ax, nf_ax):
+                      use_ir, m_ax, f_ax, p_ax, nf_ax,
+                      compensated=False):
     """Cached single-program complex-engine batch fit: weights + DFTs +
     vmapped _fit_portrait_core compiled together."""
 
@@ -1594,6 +1673,7 @@ def _complex_batch_fn(fit_flags, log10_tau, max_iter, use_scatter,
                 max_iter=max_iter,
                 use_ir=use_ir,
                 use_scatter=use_scatter,
+                compensated=compensated,
             ),
             in_axes=(0, m_ax, 0, f_ax, p_ax, nf_ax, 0, 0, None),
         )

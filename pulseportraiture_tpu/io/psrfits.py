@@ -232,6 +232,7 @@ class Archive:
     def get_state(self):
         pol = str(self.subint_header.get("POL_TYPE", "AA+BB")).strip()
         return {"IQUV": "Stokes", "AA+BB": "PPQQ",
+                "AABBCRCI": "Coherence",
                 "INTEN": "Intensity"}.get(pol, pol)
 
     def start_time(self):
@@ -332,12 +333,44 @@ class Archive:
 
     # -- state transforms (in-place, PSRCHIVE verbs) -----------------------
     def convert_state(self, state):
+        """In-place polarization state conversion (reference load_data's
+        convert_state option, pplib.py:2782-2814, where PSRCHIVE does
+        the work).  Supported: anything -> Intensity (pscrunch), and
+        Coherence (AABBCRCI) -> Stokes via the van Straten (2004)
+        relations in the feed basis named by the FD_POLN primary card:
+
+          linear  (X, Y): I = AA+BB, Q = AA-BB, U = 2 CR, V = 2 CI
+          circular(L, R): I = AA+BB, V = AA-BB, Q = 2 CR, U = 2 CI
+
+        PPQQ (AA+BB with no cross terms) cannot reach full Stokes —
+        the cross-hand information does not exist in the file."""
         if state == self.get_state():
             return
         if state == "Intensity":
             self.pscrunch()
-        else:
-            raise ValueError(f"unsupported state conversion to {state!r}")
+            return
+        if state == "Stokes" and self.get_state() == "Coherence":
+            if self.npol != 4:
+                raise ValueError(
+                    f"Coherence state with npol={self.npol}; need 4 "
+                    "(AA, BB, CR, CI)")
+            aa, bb, cr, ci = (self.amps[:, i] for i in range(4))
+            basis = str(self.primary.get("FD_POLN", "LIN")).strip().upper()
+            I = aa + bb
+            if basis.startswith("CIRC"):
+                V = aa - bb
+                Q = 2.0 * cr
+                U = 2.0 * ci
+            else:  # LIN (default, like PSRCHIVE for missing FD_POLN)
+                Q = aa - bb
+                U = 2.0 * cr
+                V = 2.0 * ci
+            self.amps = np.stack([I, Q, U, V], axis=1)
+            self.subint_header["POL_TYPE"] = "IQUV"
+            return
+        raise ValueError(
+            f"unsupported state conversion {self.get_state()!r} -> "
+            f"{state!r}")
 
     def pscrunch(self):
         if self.npol == 1:

@@ -134,14 +134,15 @@ def fit_portrait_sharded_fast(
     it; the XLA real path shards cleanly (psum over 'chan' for the
     channel reductions).
     """
-    from .. import config
-    from ..fit.portrait import derive_use_scatter, reject_fixed_tau_seed
+    from ..fit.portrait import (derive_use_scatter,
+                                reject_fixed_tau_seed,
+                                use_scatter_compensated)
 
     use_scatter = derive_use_scatter(fit_flags, log10_tau, theta0)
     if not use_scatter:
         reject_fixed_tau_seed(theta0, "fit_portrait_sharded_fast")
     if compensated is None:
-        compensated = bool(getattr(config, "scatter_compensated", False))
+        compensated = use_scatter_compensated()
     ports = jnp.asarray(ports)
     nb, nchan, nbin = ports.shape
     dt = ports.dtype

@@ -8,6 +8,7 @@ from .align import (  # noqa: F401
     psradd_archives,
     psrsmooth_archive,
 )
+from .ipta import IPTAJob, stream_ipta_campaign  # noqa: F401
 from .models import TemplateModel, sniff_model_type  # noqa: F401
 from .portrait import DataPortrait, normalize_portrait  # noqa: F401
 from .stream import (stream_narrowband_TOAs,  # noqa: F401

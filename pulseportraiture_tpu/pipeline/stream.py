@@ -157,9 +157,11 @@ def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
 
     tau_mode: 'none' (no scattering anywhere), 'neutral' (half-bin
     seed), 'explicit' ((tau_s, nu, alpha) runtime args), 'auto'
-    (device-side estimate_tau_batch).  Any mode but 'none' routes
-    through the complex engine even for degenerate phi-only lanes
-    (their fixed tau seed still scatters the model)."""
+    (device-side estimate_tau_batch).  Any mode but 'none' routes to
+    the scatter-shaped engine even for degenerate phi-only lanes
+    (their fixed tau seed still scatters the model) — the complex-free
+    fast_scatter_fit_one lane on fast backends, the complex engine
+    otherwise."""
     ft = {"float32": jnp.float32, "float64": jnp.float64}[ftname]
     scat_engine = (flags[3] or flags[4] or log10_tau
                    or tau_mode != "none" or use_ir)
@@ -319,7 +321,7 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
         # pallas/bf16 config read per call (cache-key args, mirroring
         # _fast_batch_fn): mid-process config toggles take effect
         use_ir = bucket.ir_FT is not None
-        from .. import config as _config
+        from ..fit.portrait import use_scatter_compensated
 
         fn = _raw_fit_fn(int(raw.shape[1]), bucket.nbin,
                          tuple(bool(f) for f in bucket.flags),
@@ -328,20 +330,16 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                          use_pallas_moments(np.dtype(ftname)),
                          use_bf16_cross_spectrum(), redisp=redisp,
                          want_flux=want_flux, use_ir=use_ir,
-                         compensated=bool(getattr(
-                             _config, "scatter_compensated", False)))
+                         compensated=use_scatter_compensated())
         ft = jnp.float32 if use_fast else jnp.float64
         t_s, t_nu, t_a = tau_args
         modelx, freqs = bucket.modelx, bucket.freqs
-        # the response ships as TWO REAL arrays (complex buffers cannot
-        # cross some tunneled-runtime transports at all); the complex
-        # engine reassembles them device-side inside the program
-        if use_ir:
-            ir_h = np.asarray(bucket.ir_FT)
-            ir_r = jnp.asarray(ir_h.real, ft)
-            ir_i = jnp.asarray(ir_h.imag, ft)
-        else:
-            ir_r = ir_i = None
+        # the response ships as TWO REAL arrays (fit.portrait.
+        # split_ir_host); the complex engine reassembles them
+        # device-side inside the program
+        from ..fit.portrait import split_ir_host
+
+        ir_r, ir_i = split_ir_host(bucket.ir_FT, ft)
 
         def dispatch():
             return fn(jnp.asarray(raw), jnp.asarray(scl, ft),

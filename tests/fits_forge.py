@@ -1,0 +1,222 @@
+"""A from-scratch PSRFITS *forge* for golden-file loader tests.
+
+Deliberately shares NO code with pulseportraiture_tpu.io — every card,
+table descriptor, and byte here is written by hand so that loader tests
+built on it do not round-trip through the repo's own writer (the
+closed-loop blind spot VERDICT round 2 flagged).  It also produces
+layouts the repo's writer never emits: absent DAT_WTS/DAT_SCL/DAT_OFFS
+columns, unsigned-byte / float32 DATA, alien TDIM spellings, ragged
+per-subint DAT_FREQ, multi-row POLYCO tables, 4-pol Coherence data.
+
+Only what the tests need is implemented; formats follow the FITS 4.0
+standard directly (2880-byte blocks, 80-char cards, big-endian binary
+tables).
+"""
+
+import numpy as np
+
+BLOCK = 2880
+
+
+def _card(key, value=None, comment=""):
+    if value is None:
+        s = key.ljust(8) + ("  " + comment if comment else "")
+        return s[:80].ljust(80)
+    if isinstance(value, bool):
+        v = ("T" if value else "F").rjust(20)
+    elif isinstance(value, (int, np.integer)):
+        v = str(int(value)).rjust(20)
+    elif isinstance(value, (float, np.floating)):
+        v = f"{float(value):.14G}".rjust(20)
+    else:
+        v = ("'" + str(value).replace("'", "''").ljust(8) + "'").ljust(20)
+    s = key.ljust(8) + "= " + v
+    if comment:
+        s += " / " + comment
+    return s[:80].ljust(80)
+
+
+def _header_bytes(cards):
+    out = "".join(cards) + "END".ljust(80)
+    pad = (-len(out)) % BLOCK
+    return (out + " " * pad).encode("ascii")
+
+
+def primary_hdu(extra_cards=()):
+    cards = [_card("SIMPLE", True), _card("BITPIX", 8),
+             _card("NAXIS", 0), _card("EXTEND", True)]
+    cards += [_card(*c) for c in extra_cards]
+    return _header_bytes(cards)
+
+
+_CODE = {np.dtype("u1"): "B", np.dtype(">i2"): "I", np.dtype(">i4"): "J",
+         np.dtype(">f4"): "E", np.dtype(">f8"): "D"}
+
+
+def bintable_hdu(extname, columns, extra_cards=(), tdim_overrides=None):
+    """columns: list of (name, big-endian ndarray shaped (nrows, ...)).
+    tdim_overrides: {name: literal TDIM string} to test alien
+    spellings; by default no TDIM card is written (readers must fall
+    back to the header NCHAN/NPOL/NBIN geometry)."""
+    tdim_overrides = tdim_overrides or {}
+    nrows = len(columns[0][1])
+    cards = []
+    fields = []
+    stride = 0
+    for i, (name, arr) in enumerate(columns, 1):
+        arr = np.ascontiguousarray(arr)
+        if arr.dtype.kind == "S":
+            code = f"{arr.dtype.itemsize}A"
+            nel = 1
+            width = arr.dtype.itemsize
+        else:
+            be = arr.dtype.newbyteorder(">")
+            nel = int(np.prod(arr.shape[1:], dtype=int)) if arr.ndim > 1 \
+                else 1
+            code = f"{nel}{_CODE[be]}"
+            width = nel * be.itemsize
+        cards.append(_card(f"TTYPE{i}", name))
+        cards.append(_card(f"TFORM{i}", code))
+        if name in tdim_overrides:
+            cards.append(_card(f"TDIM{i}", tdim_overrides[name]))
+        fields.append((name, arr))
+        stride += width
+    head = [_card("XTENSION", "BINTABLE"), _card("BITPIX", 8),
+            _card("NAXIS", 2), _card("NAXIS1", stride),
+            _card("NAXIS2", nrows), _card("PCOUNT", 0),
+            _card("GCOUNT", 1), _card("TFIELDS", len(columns)),
+            _card("EXTNAME", extname)]
+    head += cards + [_card(*c) for c in extra_cards]
+    body = bytearray()
+    for r in range(nrows):
+        for name, arr in fields:
+            a = arr[r]
+            if arr.dtype.kind == "S":
+                body += bytes(a)
+            else:
+                body += np.ascontiguousarray(
+                    a, arr.dtype.newbyteorder(">")).tobytes()
+    pad = (-len(body)) % BLOCK
+    body += b"\x00" * pad
+    return _header_bytes(head) + bytes(body)
+
+
+def gaussian_portrait(nchan, nbin, amp=5.0, loc=0.3, wid=0.04):
+    """A simple unscattered Gaussian portrait with a linear amplitude
+    gradient across channels — analytic, so tests can recompute the
+    expected loaded values independently."""
+    x = (np.arange(nbin) + 0.5) / nbin
+    prof = amp * np.exp(-0.5 * ((x - loc) / wid) ** 2)
+    scales = 1.0 + 0.5 * np.linspace(-1, 1, nchan)
+    return scales[:, None] * prof[None, :]
+
+
+def forge_archive(path, nsub=2, nchan=8, nbin=64, npol=1,
+                  pol_type="INTEN", fd_poln=None, data_maker=None,
+                  data_dtype=">i2", with_wts=True, with_scl_offs=True,
+                  tdim_style=None, ragged_freqs=False, freq0=1400.0,
+                  chan_bw=25.0, period=0.005, dm=12.5,
+                  polyco_rows=0, extra_primary=(), src="FORGE"):
+    """Write a hand-forged PSRFITS fold-mode archive and return the
+    float64 data cube a correct loader should produce (after DAT_SCL /
+    DAT_OFFS application, before any baseline removal).
+
+    data_maker(isub, ipol) -> (nchan, nbin) float array of TRUE values.
+    data_dtype: '>i2' (scaled int16), 'u1' (scaled unsigned byte), or
+    '>f4' (float samples, unit scale).
+    """
+    rng = np.random.default_rng(7)
+    if data_maker is None:
+        base = gaussian_portrait(nchan, nbin)
+
+        def data_maker(isub, ipol):  # noqa: F811
+            return base * (1.0 + 0.1 * ipol) + 0.1 * isub
+
+    true = np.empty((nsub, npol, nchan, nbin))
+    for s in range(nsub):
+        for p in range(npol):
+            true[s, p] = data_maker(s, p)
+
+    dt = np.dtype(data_dtype)
+    data = np.empty((nsub, npol, nchan, nbin), dt)
+    scl = np.ones((nsub, npol, nchan), ">f4")
+    offs = np.zeros((nsub, npol, nchan), ">f4")
+    if dt.kind == "f":
+        data[:] = true.astype(dt)
+        stored = data.astype(np.float64)
+    else:
+        lo = true.min(axis=-1)
+        hi = true.max(axis=-1)
+        span = {1: 250.0, 2: 65000.0}[dt.itemsize]
+        zero = {1: 125.0, 2: 0.0}[dt.itemsize]  # u1 is offset-binary
+        s_ = np.maximum((hi - lo) / span, 1e-12)
+        o_ = (hi + lo) / 2.0
+        q = np.round((true - o_[..., None]) / s_[..., None] + zero)
+        data[:] = q.astype(dt)
+        scl[:] = s_.astype(">f4")
+        offs[:] = (o_ - zero * s_).astype(">f4")
+        stored = q.astype(np.float64) * s_[..., None] + \
+            (o_ - zero * s_)[..., None]
+    if not with_scl_offs and dt.kind != "f":
+        raise ValueError("integer DATA without DAT_SCL makes no sense")
+
+    freqs = freq0 + chan_bw * np.arange(nchan)
+    dat_freq = np.tile(freqs, (nsub, 1)).astype(">f8")
+    if ragged_freqs:
+        # each subint slides by a quarter channel (Doppler tracking)
+        for s in range(nsub):
+            dat_freq[s] += 0.25 * chan_bw * s
+
+    cols = [("TSUBINT", np.full(nsub, 10.0, ">f8")),
+            ("OFFS_SUB", (np.arange(nsub) * 10.0 + 5.0).astype(">f8")),
+            ("PERIOD", np.full(nsub, period, ">f8")),
+            ("DAT_FREQ", dat_freq)]
+    if with_wts:
+        wts = np.ones((nsub, nchan), ">f4")
+        wts[:, 0] = 0.0  # one zapped channel, so weights are visible
+        cols.append(("DAT_WTS", wts))
+    if with_scl_offs and dt.kind != "f":
+        cols.append(("DAT_SCL", scl.reshape(nsub, npol * nchan)))
+        cols.append(("DAT_OFFS", offs.reshape(nsub, npol * nchan)))
+    cols.append(("DATA", data.reshape(nsub, npol * nchan * nbin)))
+
+    tdims = {}
+    if tdim_style == "spaced":
+        tdims["DATA"] = f"( {nbin} , {nchan} , {npol} )"
+    elif tdim_style == "plain":
+        tdims["DATA"] = f"({nbin},{nchan},{npol})"
+
+    sub_cards = [("NCHAN", nchan), ("NPOL", npol), ("NBIN", nbin),
+                 ("POL_TYPE", pol_type), ("DM", dm),
+                 ("CHAN_BW", chan_bw), ("DEDISP", 0),
+                 ("TBIN", period / nbin)]
+    prim = [("TELESCOP", "GBT"), ("SRC_NAME", src),
+            ("OBSFREQ", float(freqs.mean())),
+            ("OBSBW", chan_bw * nchan), ("FRONTEND", "RCVR"),
+            ("BACKEND", "FORGE"),
+            ("STT_IMJD", 55000), ("STT_SMJD", 3600),
+            ("STT_OFFS", 0.0), ("OBS_MODE", "PSR")]
+    if fd_poln:
+        prim.append(("FD_POLN", fd_poln))
+    prim += list(extra_primary)
+
+    blobs = [primary_hdu(prim),
+             bintable_hdu("SUBINT", cols, extra_cards=sub_cards,
+                          tdim_overrides=tdims)]
+    if polyco_rows:
+        # multi-row POLYCO: blocks at successive epochs, constant spin
+        f0 = 1.0 / period
+        ncoef = 3
+        pc = [("NSPAN", np.full(polyco_rows, 60.0, ">f8")),
+              ("NCOEF", np.full(polyco_rows, ncoef, ">i2")),
+              ("REF_MJD", (55000.0 + 0.04 + 0.04 * np.arange(
+                  polyco_rows)).astype(">f8")),
+              ("REF_PHS", np.zeros(polyco_rows, ">f8")),
+              ("REF_F0", np.full(polyco_rows, f0, ">f8")),
+              ("COEFF", np.zeros((polyco_rows, ncoef), ">f8"))]
+        blobs.append(bintable_hdu("POLYCO", pc))
+
+    with open(path, "wb") as f:
+        for b in blobs:
+            f.write(b)
+    return stored, freqs

@@ -147,30 +147,22 @@ def test_fast_batch_masked_channels(key):
     assert np.allclose(a.DM, b.DM, atol=1e-10)
 
 
-def test_fast_batch_rejects_scattering_flags():
+def test_fast_batch_routes_scattering_to_real_lane():
+    """Since round 3 fit_portrait_batch_fast no longer rejects
+    scattering work: tau/alpha flags and fixed nonzero tau seeds route
+    to the complex-free _cgh_scatter lane (and an IR kernel with
+    use_scatter=False explicitly forced off still raises)."""
     from pulseportraiture_tpu.fit import FitFlags
 
-    with pytest.raises(ValueError):
-        fit_portrait_batch_fast(
-            jnp.zeros((1, 4, 64)),
-            jnp.zeros((1, 4, 64)),
-            jnp.ones((1, 4)),
-            jnp.linspace(1000.0, 1100.0, 4),
-            P,
-            1050.0,
-            fit_flags=FitFlags(True, True, False, True, False),
-        )
-
-
-def test_fast_batch_rejects_fixed_tau_seed():
+    args = (jnp.zeros((1, 4, 64)), jnp.zeros((1, 4, 64)),
+            jnp.ones((1, 4)), jnp.linspace(1000.0, 1100.0, 4), P, 1050.0)
+    r = fit_portrait_batch_fast(
+        *args, fit_flags=FitFlags(True, True, False, True, False))
+    assert r.phi.shape == (1,)
     theta0 = jnp.zeros((1, 5)).at[0, 3].set(1.0e-4)
-    with pytest.raises(ValueError):
+    r2 = fit_portrait_batch_fast(*args, theta0=theta0)
+    assert r2.phi.shape == (1,)
+    with pytest.raises(ValueError, match="instrumental response"):
         fit_portrait_batch_fast(
-            jnp.zeros((1, 4, 64)),
-            jnp.zeros((1, 4, 64)),
-            jnp.ones((1, 4)),
-            jnp.linspace(1000.0, 1100.0, 4),
-            P,
-            1050.0,
-            theta0=theta0,
-        )
+            *args, use_scatter=False,
+            ir_FT=np.ones((4, 33), complex))

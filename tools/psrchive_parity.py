@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Cross-validate this framework's PSRFITS loader against PSRCHIVE.
+
+The reference package is implicitly validated by PSRCHIVE itself (its
+loader IS the C++ library, reference pplib.py:51).  This framework
+carries its own codec, so where a PSRCHIVE installation exists, run
+
+    python tools/psrchive_parity.py archive1.fits [archive2.fits ...]
+
+and every comparable quantity is checked side by side:
+
+  - geometry (nsub/npol/nchan/nbin), source/telescope metadata
+  - DAT_FREQ table, weights
+  - folding periods and mid-subint epochs
+  - the decoded data cube (DAT_SCL/DAT_OFFS applied), compared after
+    each side's own baseline removal and per-profile normalization
+  - dedispersion: rotate_phase vs arch.dedisperse() (the reference's
+    own oracle, pplib.py:2526-2527)
+
+Exit code 0 = all archives match within tolerance; each failure prints
+the quantity, archive, and max deviation.  Requires the `psrchive`
+python bindings on PYTHONPATH (this script is a no-op in environments
+without them — e.g. this repo's CI — and is excluded from the test
+suite on purpose: its value is in the field, against real files this
+codebase did not write).
+"""
+
+import sys
+
+import numpy as np
+
+
+def _fail(msg):
+    print(f"  FAIL {msg}")
+    return 1
+
+
+def compare(path, pr):
+    import pulseportraiture_tpu  # noqa: F401
+    from pulseportraiture_tpu.io.psrfits import load_data, read_archive
+
+    print(f"== {path}")
+    nbad = 0
+
+    a_pr = pr.Archive_load(path)
+    arch = read_archive(path)
+
+    # --- geometry / metadata -----------------------------------------
+    geom_pr = (a_pr.get_nsubint(), a_pr.get_npol(), a_pr.get_nchan(),
+               a_pr.get_nbin())
+    geom = (arch.nsub, arch.npol, arch.nchan, arch.nbin)
+    if geom != geom_pr:
+        nbad += _fail(f"geometry: {geom} vs psrchive {geom_pr}")
+    if arch.get_source() != a_pr.get_source():
+        nbad += _fail(f"source: {arch.get_source()!r} vs "
+                      f"{a_pr.get_source()!r}")
+    if abs(arch.get_dispersion_measure()
+           - a_pr.get_dispersion_measure()) > 1e-6:
+        nbad += _fail("DM mismatch")
+
+    # --- frequencies & weights ---------------------------------------
+    nsub, npol, nchan, nbin = geom
+    fr_pr = np.array([[a_pr.get_Integration(s).get_centre_frequency(c)
+                       for c in range(nchan)] for s in range(nsub)])
+    if not np.allclose(arch.freqs_table, fr_pr, atol=1e-6):
+        nbad += _fail(
+            f"freqs: max d = {np.abs(arch.freqs_table - fr_pr).max()}")
+    w_pr = a_pr.get_weights()
+    if not np.allclose(arch.get_weights(), w_pr, rtol=1e-6):
+        nbad += _fail("weights differ")
+
+    # --- periods / epochs --------------------------------------------
+    p_pr = np.array([a_pr.get_Integration(s).get_folding_period()
+                     for s in range(nsub)])
+    if not np.allclose(arch.folding_periods(), p_pr, rtol=1e-10):
+        nbad += _fail(
+            f"periods: max rel d = "
+            f"{np.abs(arch.folding_periods() / p_pr - 1).max():.3g}")
+    e_pr = np.array([a_pr.get_Integration(s).get_epoch().in_days()
+                     for s in range(nsub)])
+    e = np.array([x.to_float() for x in arch.epochs()])
+    if not np.allclose(e, e_pr, atol=1e-9):  # ~0.1 ms
+        nbad += _fail(f"epochs: max d = {np.abs(e - e_pr).max():.3g} d")
+
+    # --- data cube (after both sides' baseline removal) ---------------
+    d = load_data(path, rm_baseline=True, quiet=True)
+    b = a_pr.clone()
+    b.remove_baseline()
+    cube_pr = b.get_data()
+    cube = np.asarray(d.subints)
+    if cube.shape != cube_pr.shape:
+        nbad += _fail(f"cube shape {cube.shape} vs {cube_pr.shape}")
+    else:
+        # per-profile scale-free comparison (the two baseline
+        # algorithms may differ by a constant in low-S/N channels)
+        x = cube.reshape(-1, nbin)
+        y = cube_pr.reshape(-1, nbin)
+        keep = (np.ptp(y, axis=1) > 0) & (np.ptp(x, axis=1) > 0)
+        cc = np.array([np.corrcoef(xi, yi)[0, 1]
+                       for xi, yi in zip(x[keep], y[keep])])
+        if len(cc) and cc.min() < 0.999:
+            nbad += _fail(f"data: min profile corrcoef {cc.min():.6f}")
+        resid = np.abs(x[keep] - y[keep]).max() if keep.any() else 0.0
+        scale = np.abs(y[keep]).max() or 1.0
+        if resid / scale > 1e-3:
+            nbad += _fail(f"data: max rel resid {resid / scale:.3g}")
+
+    # --- dedispersion oracle (reference pplib.py:2526-2527) ----------
+    c = a_pr.clone()
+    c.dedisperse()
+    ded_pr = c.get_data()
+    arch2 = read_archive(path)
+    arch2.dedisperse()
+    ded = np.asarray(arch2.amps)
+    x = ded.reshape(-1, nbin)
+    y = ded_pr.reshape(-1, nbin)
+    keep = (np.ptp(y, axis=1) > 0) & (np.ptp(x, axis=1) > 0)
+    cc = np.array([np.corrcoef(xi, yi)[0, 1]
+                   for xi, yi in zip(x[keep], y[keep])])
+    if len(cc) and cc.min() < 0.999:
+        nbad += _fail(f"dedisperse: min corrcoef {cc.min():.6f}")
+
+    print("  OK" if nbad == 0 else f"  {nbad} check(s) failed")
+    return nbad
+
+
+def main(argv):
+    if not argv:
+        print(__doc__)
+        return 2
+    try:
+        import psrchive as pr
+    except ImportError:
+        print("psrchive python bindings not found; nothing to compare. "
+              "Run this where PSRCHIVE is installed.")
+        return 2
+    bad = 0
+    for path in argv:
+        bad += compare(path, pr)
+    print(f"{'ALL OK' if bad == 0 else f'{bad} total failures'} "
+          f"across {len(argv)} archive(s)")
+    return 0 if bad == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
