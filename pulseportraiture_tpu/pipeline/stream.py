@@ -62,9 +62,10 @@ from ..ops.noise import get_SNR, get_noise_PS, min_window_baseline
 from ..utils.bunch import DataBunch
 from .models import TemplateModel
 from .toas import (_is_metafile, _iter_archives, _read_metafile,
-                   _validate_scat_guess, delta_dm_stats, load_for_toas,
-                   reref_tau, scat_seed_tau0, scat_time_flags,
-                   snr_weighted_nu_fit)
+                   _validate_scat_guess, delta_dm_stats,
+                   doppler_corrected_DM_GM, effective_fit_flags,
+                   load_for_toas, scat_seed_tau0, scat_time_flags,
+                   scattering_toa_flags, snr_weighted_nu_fit)
 
 
 class _Bucket:
@@ -463,34 +464,24 @@ def _assemble_archive(m, results, modelfile, fit_DM, bary,
         phi = float(r["phi"])
         toa_mjd = m.epochs[j].add_seconds(phi * P + m.backend_delay)
         df = m.dfs[j] if bary else 1.0
-        DM_j = float(r["DM"]) * (df if (bary and fit_DM) else 1.0)
+        # flag emission follows the RUN's fit_GM like get_TOAs (a
+        # degenerate-geometry subint whose GM was dropped still
+        # reports gm 0.0, pptoas.py:629-631)
+        DM_j, GM_j = doppler_corrected_DM_GM(
+            float(r["DM"]), float(r.get("GM", 0.0)), df,
+            fit_DM, "GM" in r, bary)
         flags = {}
         if fit_GM:
-            # GM *= df^3 under bary, like the wideband pipeline
-            # (pptoas.py:583-591); flag emission follows the RUN's
-            # fit_GM like get_TOAs (a degenerate-geometry subint whose
-            # GM was dropped still reports gm 0.0, pptoas.py:629-631)
-            flags["gm"] = float(r.get("GM", 0.0)) * \
-                (df ** 3 if (bary and "GM" in r) else 1.0)
+            flags["gm"] = GM_j
             flags["gm_err"] = float(r.get("GM_err", 0.0))
         if "tau" in r:
-            # same flag set as GetTOAs (scat_time in us, Doppler-
-            # corrected like the wideband pipeline)
-            tau_j, tau_err_j = float(r["tau"]), float(r["tau_err"])
-            nu_tau_j = float(r["nu_tau"])
-            if nu_ref_tau is not None:
-                # user-requested tau output reference (-nu_tau), as
-                # get_TOAs does via reref_tau before flag assembly
-                tau_j, tau_err_j = reref_tau(
-                    tau_j, tau_err_j, nu_tau_j, nu_ref_tau,
-                    float(r["alpha"]))
-                nu_tau_j = float(nu_ref_tau)
-            flags.update(scat_time_flags(
-                tau_j, tau_err_j, P / df, log10_tau))
-            flags["scat_ref_freq"] = nu_tau_j * df
-            flags["scat_ind"] = float(r["alpha"])
-            if alpha_fitted:
-                flags["scat_ind_err"] = float(r["alpha_err"])
+            # same flag assembly as GetTOAs (pipeline/toas.py
+            # scattering_toa_flags), incl. the -nu_tau re-reference
+            flags.update(scattering_toa_flags(
+                float(r["tau"]), float(r["tau_err"]),
+                float(r["nu_tau"]), float(r["alpha"]),
+                float(r.get("alpha_err", 0.0)), P, df, log10_tau,
+                alpha_fitted, nu_ref_tau=nu_ref_tau))
         flags.update({
             "be": m.backend, "fe": m.frontend,
             "f": f"{m.frontend}_{m.backend}",
@@ -758,15 +749,10 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                           bool(fit_scat and not fix_alpha))
             kind = "raw" if raw_mode else "dec"
             for j, isub in enumerate(ok):
-                # degenerate geometry (pptoas.py:519-527, mirrored from
-                # GetTOAs): 1 usable channel -> phase-only; 2 -> no GM
-                if nchx[j] <= 1:
-                    eff_flags = (True, False, False, False, False)
-                elif nchx[j] == 2 and base_flags[2]:
-                    eff_flags = (True, base_flags[1], False,
-                                 base_flags[3], base_flags[4])
-                else:
-                    eff_flags = base_flags
+                # degenerate-geometry demotion — the SAME helper
+                # GetTOAs' flag groups use (pipeline/toas.py
+                # effective_fit_flags; reference pptoas.py:519-527)
+                eff_flags = effective_fit_flags(nchx[j], base_flags)
                 key = base_key + (eff_flags, kind)
                 if key not in buckets:
                     buckets[key] = _Bucket(freqs0, nbin, modelx, eff_flags,

@@ -111,6 +111,50 @@ def scat_seed_tau0(scat_guess, fit_scat, nok, nbin, P_mean, nu_fit_arr,
     return tau0, alpha0
 
 
+def effective_fit_flags(nchx_i, base):
+    """Degenerate-geometry flag demotion (reference pptoas.py:519-527),
+    the SINGLE source for both GetTOAs' flag groups and the streaming
+    driver's bucket keys: one usable channel -> phase-only; two
+    channels with GM requested -> drop GM."""
+    if nchx_i <= 1:
+        return (True, False, False, False, False)
+    if nchx_i == 2 and base[2]:
+        return (True, base[1], False, base[3], base[4])
+    return base
+
+
+def doppler_corrected_DM_GM(DM, GM, df, fit_DM, fit_GM, bary):
+    """(DM, GM) with the PSRCHIVE barycentric convention applied:
+    DM *= df, GM *= df^3 under bary for FITTED parameters (reference
+    pptoas.py:583-591; the Pennucci+2014 paper printed it reversed).
+    Shared by GetTOAs and the streaming assembly."""
+    if bary:
+        if fit_DM:
+            DM = DM * df
+        if fit_GM:
+            GM = GM * df ** 3
+    return DM, GM
+
+
+def scattering_toa_flags(tau, tau_err, nu_tau, alpha, alpha_err, P, df,
+                         log10_tau, alpha_fitted, nu_ref_tau=None):
+    """The scat_* TOA flag set for one fitted subint (scat_time [us],
+    optional log10 form, Doppler-corrected reference frequency, index
+    and its error when fitted) — the single assembly for GetTOAs and
+    the streaming driver.  nu_ref_tau re-references tau first (the CLI
+    -nu_tau behavior); pass None when the caller already re-referenced.
+    """
+    if nu_ref_tau is not None:
+        tau, tau_err = reref_tau(tau, tau_err, nu_tau, nu_ref_tau, alpha)
+        nu_tau = float(nu_ref_tau)
+    flags = scat_time_flags(tau, tau_err, P / df, log10_tau)
+    flags["scat_ref_freq"] = nu_tau * df
+    flags["scat_ind"] = alpha
+    if alpha_fitted:
+        flags["scat_ind_err"] = alpha_err
+    return flags
+
+
 def reref_tau(tau, tau_err, nu_from, nu_to, alpha):
     """Re-reference a scattering timescale (and its error) between
     frequencies via its own power law (reference pptoaslib.py:1107-1113
@@ -429,13 +473,8 @@ class GetTOAs:
                     bool(fit_scat and not fix_alpha))
             groups = {}
             for i in range(nok):
-                if nchx[i] <= 1:
-                    flags = (True, False, False, False, False)
-                elif nchx[i] == 2 and base[2]:
-                    flags = (True, base[1], False, base[3], base[4])
-                else:
-                    flags = base
-                groups.setdefault(flags, []).append(i)
+                groups.setdefault(
+                    effective_fit_flags(nchx[i], base), []).append(i)
 
             # instrumental-response FT for this archive's layout
             # (pptoas.py:428-434): product of configured achromatic
@@ -570,15 +609,9 @@ class GetTOAs:
                 epoch = d.epochs[isub]
                 toa_mjd = epoch.add_seconds(phi * P + d.backend_delay)
                 df = float(d.doppler_factors[isub]) if bary else 1.0
-                DM_j = float(res_arrays["DM"][j])
-                GM_j = float(res_arrays["GM"][j])
-                if bary:
-                    # barycentric Doppler correction (pptoas.py:583-591;
-                    # the Pennucci+2014 paper printed it reversed)
-                    if self.fit_flags[1]:
-                        DM_j *= df
-                    if self.fit_flags[2]:
-                        GM_j *= df ** 3
+                DM_j, GM_j = doppler_corrected_DM_GM(
+                    float(res_arrays["DM"][j]), float(res_arrays["GM"][j]),
+                    df, self.fit_flags[1], self.fit_flags[2], bary)
 
                 phis[isub] = phi
                 phi_errs[isub] = res_arrays["phi_err"][j]
@@ -637,16 +670,15 @@ class GetTOAs:
                     toa_flags["gm"] = GM_j
                     toa_flags["gm_err"] = float(GM_errs[isub])
                 if self.fit_flags[3]:
-                    toa_flags.update(scat_time_flags(
+                    # nu_ref_tau=None: the array-level reref above
+                    # already applied any user-requested reference
+                    toa_flags.update(scattering_toa_flags(
                         float(res_arrays["tau"][j]),
-                        float(res_arrays["tau_err"][j]), P / df,
-                        log10_tau))
-                    toa_flags["scat_ref_freq"] = \
-                        float(res_arrays["nu_tau"][j]) * df
-                    toa_flags["scat_ind"] = float(res_arrays["alpha"][j])
-                if self.fit_flags[4]:
-                    toa_flags["scat_ind_err"] = \
-                        float(res_arrays["alpha_err"][j])
+                        float(res_arrays["tau_err"][j]),
+                        float(res_arrays["nu_tau"][j]),
+                        float(res_arrays["alpha"][j]),
+                        float(res_arrays["alpha_err"][j]), P, df,
+                        log10_tau, bool(self.fit_flags[4])))
                 toa_flags["be"] = d.backend
                 toa_flags["fe"] = d.frontend
                 toa_flags["f"] = f"{d.frontend}_{d.backend}"
