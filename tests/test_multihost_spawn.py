@@ -65,6 +65,15 @@ res = stream_wideband_TOAs(mine, f"{outdir}/m.gmodel", nsub_batch=4,
                            tim_out=f"{outdir}/part{pid}.tim", quiet=True)
 gathered = parallel.process_allgather(res.DeltaDM_means)
 
+
+# --- the multi-pulsar IPTA campaign across REAL processes -----------
+from pulseportraiture_tpu.pipeline import IPTAJob, stream_ipta_campaign
+
+jobs = [IPTAJob("PSRA", files[:2], f"{outdir}/m.gmodel"),
+        IPTAJob("PSRB", files[2:], f"{outdir}/m.gmodel")]
+ires = stream_ipta_campaign(jobs, outdir=f"{outdir}/ipta",
+                            nsub_batch=4, quiet=True)
+
 out = {
     "pid": pid,
     "process_count": jax.process_count(),
@@ -73,6 +82,10 @@ out = {
     "gathered": [np.asarray(g).tolist() for g in gathered],
     "toas": {f"{t.archive}|{t.flags['subint']}":
              [t.MJD.tim_string(), t.TOA_error] for t in res.TOA_list},
+    "ipta_ntoa": len(ires.TOA_list),
+    "ipta_pulsars": sorted(ires.per_pulsar),
+    "ipta_summary": {k: sorted(np.round(v[0], 12).tolist())
+                     for k, v in ires.DeltaDM_summary.items()},
 }
 with open(f"{outdir}/out{pid}.json", "w") as fh:
     json.dump(out, fh)
@@ -167,3 +180,19 @@ def test_two_real_processes_run_a_sharded_campaign(tmp_path):
     # and the per-process incremental .tim checkpoints exist on disk
     for i in range(n):
         assert (tmp_path / f"part{i}.tim").read_text().count("\n") >= 4
+
+    # --- the IPTA campaign really ran across the two processes -------
+    for r in results:
+        # round-robin grid sharding: every host works on BOTH pulsars,
+        # 2 archives each -> 4 TOAs per host
+        assert r["ipta_pulsars"] == ["PSRA", "PSRB"]
+        assert r["ipta_ntoa"] == 4
+    # the ALLGATHERED per-pulsar summaries are identical on both hosts
+    # and cover every archive of each pulsar (2 each)
+    assert results[0]["ipta_summary"] == results[1]["ipta_summary"]
+    for psr in ("PSRA", "PSRB"):
+        assert len(results[0]["ipta_summary"][psr]) == 2
+    # per-pulsar per-process .tim shards on disk
+    names = sorted(p.name for p in (tmp_path / "ipta").iterdir())
+    assert names == ["PSRA.p0.tim", "PSRA.p1.tim",
+                     "PSRB.p0.tim", "PSRB.p1.tim"]
