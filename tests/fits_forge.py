@@ -115,7 +115,7 @@ def forge_archive(path, nsub=2, nchan=8, nbin=64, npol=1,
                   pol_type="INTEN", fd_poln=None, data_maker=None,
                   data_dtype=">i2", with_wts=True, with_scl_offs=True,
                   tdim_style=None, ragged_freqs=False, freq0=1400.0,
-                  chan_bw=25.0, period=0.005, dm=12.5,
+                  chan_bw=25.0, period=0.005, dm=12.5, dedisp=0,
                   polyco_rows=0, extra_primary=(), src="FORGE"):
     """Write a hand-forged PSRFITS fold-mode archive and return the
     float64 data cube a correct loader should produce (after DAT_SCL /
@@ -188,7 +188,7 @@ def forge_archive(path, nsub=2, nchan=8, nbin=64, npol=1,
 
     sub_cards = [("NCHAN", nchan), ("NPOL", npol), ("NBIN", nbin),
                  ("POL_TYPE", pol_type), ("DM", dm),
-                 ("CHAN_BW", chan_bw), ("DEDISP", 0),
+                 ("CHAN_BW", chan_bw), ("DEDISP", dedisp),
                  ("TBIN", period / nbin)]
     prim = [("TELESCOP", "GBT"), ("SRC_NAME", src),
             ("OBSFREQ", float(freqs.mean())),
