@@ -126,17 +126,18 @@ def main(argv=None):
     if args.stream:
         if (args.psrchive
                 or args.one_DM
-                or args.print_phase or args.print_parangle
+                or args.print_parangle
                 or args.showplot):
             raise SystemExit(
                 "--stream supports the wideband (phi, DM[, GM, "
-                "scattering], flux) campaign configuration only (no "
-                "one_DM/phase/parangle flags or plots)")
+                "scattering], flux, phase) campaign configuration only "
+                "(no one_DM/parangle flags or plots)")
         from ..pipeline.stream import stream_wideband_TOAs
 
         res = stream_wideband_TOAs(
             args.datafiles, args.modelfile, fit_DM=args.fit_DM,
             fit_GM=args.fit_GM, print_flux=args.print_flux,
+            print_phase=args.print_phase,
             nu_ref_DM=nu_ref_DM, nu_ref_tau=args.nu_ref_tau,
             DM0=args.DM0, bary=args.bary,
             tscrunch=args.tscrunch, fit_scat=args.fit_scat,

@@ -452,7 +452,8 @@ def _flux_rows(scales, scale_errs, means, cmask, freqs):
 def _assemble_archive(m, results, modelfile, fit_DM, bary,
                       addtnl_toa_flags, log10_tau=False,
                       alpha_fitted=False, nu_ref_tau=None,
-                      fit_GM=False, print_flux=False):
+                      fit_GM=False, print_flux=False,
+                      print_phase=False):
     """Build the TOA objects + DeltaDM stats for one archive from the
     scattered fit results."""
     toas, dDMs, dDM_errs = [], [], []
@@ -490,6 +491,9 @@ def _assemble_archive(m, results, modelfile, fit_DM, bary,
             "tmplt": str(modelfile), "snr": float(r["snr"]),
             "gof": float(r["chi2"] / max(float(r["dof"]), 1.0)),
         })
+        if print_phase:
+            flags["phs"] = phi
+            flags["phs_err"] = float(r["phi_err"])
         if print_flux:
             flags["flux"] = float(r["flux"])
             flags["flux_err"] = float(r["flux_err"])
@@ -514,7 +518,7 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                          tscrunch=False, fit_scat=False, log10_tau=True,
                          scat_guess=None, fix_alpha=False, max_iter=25,
                          prefetch=True, max_inflight=4,
-                         print_flux=False,
+                         print_flux=False, print_phase=False,
                          instrumental_response_dict=None,
                          addtnl_toa_flags={}, tim_out=None,
                          quiet=False):
@@ -646,7 +650,7 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                     addtnl_toa_flags, log10_tau=log10_tau,
                     alpha_fitted=fit_scat and not fix_alpha,
                     nu_ref_tau=nu_ref_tau, fit_GM=fit_GM,
-                    print_flux=print_flux)
+                    print_flux=print_flux, print_phase=print_phase)
                 assembled[ia] = out
                 # the per-subint records are folded into the assembly;
                 # dropping them keeps host memory O(bucket)
@@ -800,7 +804,8 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
         toas, mean, err = assembled.get(m.iarch) or _assemble_archive(
             m, results, modelfile, fit_DM, bary, addtnl_toa_flags,
             log10_tau=log10_tau, alpha_fitted=fit_scat and not fix_alpha,
-            nu_ref_tau=nu_ref_tau, fit_GM=fit_GM, print_flux=print_flux)
+            nu_ref_tau=nu_ref_tau, fit_GM=fit_GM, print_flux=print_flux,
+            print_phase=print_phase)
         TOA_list.extend(toas)
         order.append(m.datafile)
         DM0s.append(m.DM0_arch)

@@ -426,3 +426,19 @@ def test_stream_fast_lane_scattering_parity(tmp_path):
             t_ref.flags["scat_ind"], abs=0.05)
         assert t.flags["snr"] == pytest.approx(t_ref.flags["snr"],
                                                rel=0.01)
+
+
+def test_stream_print_phase_flags(campaign):
+    """print_phase emits the phs/phs_err flags exactly like GetTOAs."""
+    files, gmodel = campaign
+    res = stream_wideband_TOAs(files[:1], gmodel, nsub_batch=4,
+                               print_phase=True, quiet=True)
+    gt = GetTOAs(files[:1], gmodel, quiet=True)
+    gt.get_TOAs(print_phase=True, quiet=True, max_iter=25)
+    by_key = {t.flags["subint"]: t for t in res.TOA_list}
+    for t_ref in gt.TOA_list:
+        t = by_key[t_ref.flags["subint"]]
+        assert t.flags["phs"] == pytest.approx(t_ref.flags["phs"],
+                                               abs=1e-9)
+        assert t.flags["phs_err"] == pytest.approx(
+            t_ref.flags["phs_err"], rel=1e-6)
