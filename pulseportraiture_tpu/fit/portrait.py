@@ -308,7 +308,10 @@ def _dot2(a, b):
     exact two-product (Dekker/Veltkamp split — no FMA primitive exists
     in jax) and the summation done df64-pairwise (Dot2): error ~eps
     instead of ~n*eps — the compensated path for the scattering
-    moments."""
+    moments.  (A blocked f32-within-chunks variant measured the SAME
+    throughput and tau floor on TPU — the cost is the elementwise
+    two-product work, not the tree — so the simpler exact tree stays.)
+    """
     p, e = _two_product(a, b)
     return _pair_sum_df64(p, e)
 
