@@ -581,7 +581,26 @@ def read_archive(path, dtype=np.float64, decode=True):
                 rows[:, col_off:col_off + width]).view(samp_dt)
             cols["DATA"] = col.astype(dtype)
         nbin = int(hdr.get("NBIN", 0)) or cols["DATA"].shape[-1]
-        raw = np.asarray(cols["DATA"], dtype).reshape(
+        data_col = np.asarray(cols["DATA"])
+        nbit = int(hdr.get("NBIT", 8) or 8)
+        if nbit in (1, 2, 4):
+            # sub-byte packed samples (search-era backends; PSRFITS
+            # packs MSB-first within each byte, each ROW padded to
+            # whole bytes) — unpack to unsigned sample values and trim
+            # the row pad; DAT_SCL/DAT_OFFS restore the physics
+            row_samp = npol * nchan * nbin
+            per = 8 // nbit
+            row_bytes = (row_samp + per - 1) // per
+            if data_col.size != nsub * row_bytes:
+                raise ValueError(
+                    f"NBIT={nbit} DATA column holds {data_col.size} "
+                    f"bytes; expected {nsub} rows x {row_bytes}")
+            b = data_col.reshape(nsub, row_bytes).astype(np.uint8)
+            mask = (1 << nbit) - 1
+            shifts = np.arange(per - 1, -1, -1, dtype=np.uint8) * nbit
+            samples = (b[:, :, None] >> shifts[None, None, :]) & mask
+            data_col = samples.reshape(nsub, row_bytes * per)[:, :row_samp]
+        raw = np.asarray(data_col, dtype).reshape(
             nsub, npol, nchan, nbin)
         amps = raw * scl[..., None].astype(dtype) \
             + offs[..., None].astype(dtype)

@@ -122,8 +122,9 @@ def forge_archive(path, nsub=2, nchan=8, nbin=64, npol=1,
     DAT_OFFS application, before any baseline removal).
 
     data_maker(isub, ipol) -> (nchan, nbin) float array of TRUE values.
-    data_dtype: '>i2' (scaled int16), 'u1' (scaled unsigned byte), or
-    '>f4' (float samples, unit scale).
+    data_dtype: '>i2' (scaled int16), 'u1' (scaled unsigned byte),
+    '>f4' (float samples, unit scale), or 'nbit1'/'nbit2'/'nbit4'
+    (sub-byte packed unsigned samples, MSB-first, NBIT card written).
     """
     rng = np.random.default_rng(7)
     if data_maker is None:
@@ -137,11 +138,38 @@ def forge_archive(path, nsub=2, nchan=8, nbin=64, npol=1,
         for p in range(npol):
             true[s, p] = data_maker(s, p)
 
+    nbit = None
+    if str(data_dtype).startswith("nbit"):
+        nbit = int(str(data_dtype)[4:])
+        data_dtype = "u1"
     dt = np.dtype(data_dtype)
     data = np.empty((nsub, npol, nchan, nbin), dt)
     scl = np.ones((nsub, npol, nchan), ">f4")
     offs = np.zeros((nsub, npol, nchan), ">f4")
-    if dt.kind == "f":
+    if nbit:
+        lo = true.min(axis=-1)
+        hi = true.max(axis=-1)
+        span = float(2 ** nbit - 1)
+        s_ = np.maximum((hi - lo) / span, 1e-12)
+        q = np.clip(np.round((true - lo[..., None]) / s_[..., None]),
+                    0, span)
+        scl[:] = s_.astype(">f4")
+        offs[:] = lo.astype(">f4")
+        stored = q * s_[..., None] + lo[..., None]
+        # pack MSB-first, each ROW padded to whole bytes (the PSRFITS
+        # convention the reader must trim)
+        per = 8 // nbit
+        row_samp = npol * nchan * nbin
+        row_bytes = (row_samp + per - 1) // per
+        flat = q.astype(np.uint8).reshape(nsub, row_samp)
+        padded = np.zeros((nsub, row_bytes * per), np.uint8)
+        padded[:, :row_samp] = flat
+        grp = padded.reshape(nsub, row_bytes, per)
+        shifts = np.arange(per - 1, -1, -1, dtype=np.uint8) * nbit
+        data = np.zeros((nsub, row_bytes), np.uint8)
+        for j in range(per):
+            data |= (grp[:, :, j] & ((1 << nbit) - 1)) << shifts[j]
+    elif dt.kind == "f":
         data[:] = true.astype(dt)
         stored = data.astype(np.float64)
     else:
@@ -178,7 +206,8 @@ def forge_archive(path, nsub=2, nchan=8, nbin=64, npol=1,
     if with_scl_offs and dt.kind != "f":
         cols.append(("DAT_SCL", scl.reshape(nsub, npol * nchan)))
         cols.append(("DAT_OFFS", offs.reshape(nsub, npol * nchan)))
-    cols.append(("DATA", data.reshape(nsub, npol * nchan * nbin)))
+    cols.append(("DATA", data if nbit
+                 else data.reshape(nsub, npol * nchan * nbin)))
 
     tdims = {}
     if tdim_style == "spaced":
@@ -190,6 +219,8 @@ def forge_archive(path, nsub=2, nchan=8, nbin=64, npol=1,
                  ("POL_TYPE", pol_type), ("DM", dm),
                  ("CHAN_BW", chan_bw), ("DEDISP", dedisp),
                  ("TBIN", period / nbin)]
+    if nbit:
+        sub_cards.append(("NBIT", nbit))
     prim = [("TELESCOP", "GBT"), ("SRC_NAME", src),
             ("OBSFREQ", float(freqs.mean())),
             ("OBSBW", chan_bw * nchan), ("FRONTEND", "RCVR"),
