@@ -94,7 +94,9 @@ cross_spectrum_dtype = "bfloat16"
 # valley to the sigma_tau limit instead of an f32 floor; costs ~2x the
 # reduction traffic of the scattering Newton step.  False (default):
 # plain f32 sums — right for ordinary S/N, where the noise floor is
-# orders of magnitude above the f32 valley.
+# orders of magnitude above the f32 valley.  When True, the fast lane
+# forces full-precision X storage regardless of cross_spectrum_dtype
+# (bf16 per-term quantization would dominate what Dot2 removes).
 scatter_compensated = False
 
 # --- Model evolution codes ------------------------------------------------

@@ -770,7 +770,7 @@ def _fit_portrait_core(
     scatter = (use_scatter or use_ir or fit_flags[3] or fit_flags[4]
                or log10_tau)
     if ftol is None:
-        ftol = (_scatter_ftol(dt) if scatter
+        ftol = (_scatter_ftol(dt, compensated) if scatter
                 else 50.0 * float(jnp.finfo(dt).eps))
 
     # --- precompute: everything the optimizer reads per step ----------
@@ -1187,7 +1187,12 @@ def fast_scatter_fit_one(port, model, noise_stds, chan_mask, freqs, P,
         theta0 = jnp.where(jnp.arange(5) == 0, phi0, theta0).astype(dt)
     else:
         theta0 = theta0.astype(dt)
-    xdt = jnp.bfloat16 if (x_bf16 and dt == jnp.float32) else dt
+    # compensated mode exists to push the accumulation error below the
+    # f32 noise floor — bf16 X storage would reintroduce ~4e-3 per-term
+    # quantization that dominates what Dot2 removes, so force full-
+    # precision X whenever the compensated reductions are on
+    xdt = (dt if compensated
+           else jnp.bfloat16 if (x_bf16 and dt == jnp.float32) else dt)
     return _fit_portrait_core_real_scatter.__wrapped__(
         Xr.astype(xdt), Xi.astype(xdt), M2w, Sd, freqs, P, nu_fit,
         nu_out, theta0, fit_flags=fit_flags, log10_tau=log10_tau,
@@ -1382,9 +1387,13 @@ def _fit_batch_fast_scatter(ports, models, noise_stds, freqs, P, nu_fit,
         compensated = use_scatter_compensated()
     use_ir = ir_FT is not None
     ir_r, ir_i = split_ir_host(ir_FT, dt)
+    # compensated mode forces f32 X inside fast_scatter_fit_one, so
+    # fold x_bf16 into the cache key here to avoid recompiling a
+    # bit-identical program when the bf16 knob flips under it
     fit = _fast_scatter_batch_fn(
         FitFlags(*[bool(f) for f in fit_flags]), bool(log10_tau),
-        int(max_iter), bool(compensated), use_bf16_cross_spectrum(),
+        int(max_iter), bool(compensated),
+        use_bf16_cross_spectrum() and not compensated,
         m_ax, f_ax, p_ax, nf_ax, use_ir)
     return fit(ports, models, jnp.asarray(noise_stds),
                jnp.asarray(chan_masks, dt), freqs, P, nu_fit,
@@ -1606,6 +1615,7 @@ def fit_portrait_batch(
     max_iter=40,
     use_scatter=None,
     ir_FT=None,
+    compensated=None,
 ):
     """vmapped portrait fit over a leading batch dimension.
 
@@ -1616,6 +1626,9 @@ def fit_portrait_batch(
     ir_FT: optional (nchan, nharm) instrumental-response FT shared by
     the whole batch (ops.instrumental_response_port_FT; reference
     convolves the model per subint at pptoas.py:428-434).
+    compensated: None -> config.scatter_compensated (Dot2 reductions
+    for f64-quality tau resolution on f32 hardware; same knob as
+    fit_portrait_batch_fast).
 
     f64 inputs are canonicalized to f32 on TPU backends: the complex
     engine follows the input dtype, and c128 spectra do not compile on
@@ -1642,10 +1655,12 @@ def fit_portrait_batch(
         theta0 = jnp.zeros((nb, 5), ports.dtype)
     nu_out_val = -1.0 if nu_out is None else nu_out
     use_ir = ir_FT is not None
+    if compensated is None:
+        compensated = use_scatter_compensated()
     fn = _complex_batch_fn(
         FitFlags(*[bool(f) for f in fit_flags]), bool(log10_tau),
         int(max_iter), bool(use_scatter), use_ir, m_ax, f_ax, p_ax,
-        nf_ax, use_scatter_compensated())
+        nf_ax, bool(compensated))
     ir_arg = ir_FT if use_ir else None
     nu_out_arr = jnp.broadcast_to(
         jnp.asarray(nu_out_val, ports.dtype), (nb,))

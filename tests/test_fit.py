@@ -595,3 +595,58 @@ def test_f32_scatter_tau_resolution_high_snr(key):
             rels.append((float(r.tau[0]) - expect) / expect)
         rels = np.asarray(rels)
         assert np.abs(rels).max() < gate, (comp, rels)
+
+
+def test_compensated_forces_f32_cross_spectrum(key):
+    """scatter_compensated=True must not be silently degraded by the
+    bf16 cross-spectrum default: the fast lane forces full-precision X
+    storage whenever the Dot2 reductions are on, so the result is
+    bit-identical whether or not the bf16 knob is set (ADVICE r3)."""
+    from pulseportraiture_tpu.fit.portrait import fast_scatter_fit_one
+
+    model = default_test_model(1500.0)
+    d = fake_portrait(key, model, FREQS, NBIN, P, tau=2e-4, alpha=-4.0,
+                      noise_std=1e-4, dtype=jnp.float32)
+    th0 = np.zeros(5, np.float32)
+    th0[3] = np.log10(0.5 / NBIN)
+    th0[4] = -4.0
+    flags = FitFlags(True, True, False, True, False)
+    mask = jnp.ones(NCHAN, bool)
+    kw = dict(fit_flags=flags, log10_tau=True, max_iter=40,
+              compensated=True)
+    args = (d.port, d.model_port, d.noise_stds, mask,
+            FREQS.astype(jnp.float32), P, 1500.0,
+            jnp.asarray(-1.0, jnp.float32), jnp.asarray(th0))
+    r_bf16 = jax.jit(
+        lambda *a: fast_scatter_fit_one(*a, x_bf16=True, **kw))(*args)
+    r_f32 = jax.jit(
+        lambda *a: fast_scatter_fit_one(*a, x_bf16=False, **kw))(*args)
+    assert float(r_bf16.tau) == float(r_f32.tau)
+    assert float(r_bf16.phi) == float(r_f32.phi)
+
+
+def test_complex_engine_compensated_ftol(key):
+    """The complex engine forwards `compensated` into the scatter ftol
+    (ADVICE r3: it used to stop at the plain 1e-8 threshold, leaving a
+    ~1e-4 bias the Dot2 mode exists to remove): a compensated
+    high-S/N complex-engine fit must reach the same ~1.6e-4 tau floor
+    as the real lane."""
+    model = default_test_model(1500.0)
+    true_tau = 2e-4
+    rels = []
+    for k in jax.random.split(key, 4):
+        d = fake_portrait(k, model, FREQS, NBIN, P, tau=true_tau,
+                          alpha=-4.0, noise_std=1e-4, dtype=jnp.float32)
+        th0 = np.zeros((1, 5), np.float32)
+        th0[0, 3] = np.log10(0.5 / NBIN)
+        th0[0, 4] = -4.0
+        r = fit_portrait_batch(
+            d.port[None], d.model_port[None], d.noise_stds[None],
+            FREQS.astype(jnp.float32), P, 1500.0,
+            fit_flags=FitFlags(True, True, False, True, False),
+            theta0=jnp.asarray(th0), log10_tau=True, max_iter=80,
+            compensated=True)
+        nu_tau = float(r.nu_tau[0])
+        expect = (true_tau / P) * (nu_tau / 1500.0) ** -4.0
+        rels.append((float(r.tau[0]) - expect) / expect)
+    assert np.abs(np.asarray(rels)).max() < 1.6e-4, rels
