@@ -91,8 +91,10 @@ cross_spectrum_dtype = "bfloat16"
 # accumulation for the scattering fit's nine harmonic reductions
 # (fit/portrait._cgh_scatter).  Cuts the f32 accumulation error from
 # ~n*eps to ~sqrt(n)*eps so extreme-S/N tau fits resolve the chi^2
-# valley to the sigma_tau limit instead of an f32 floor; costs ~2x the
-# reduction traffic of the scattering Newton step.  False (default):
+# valley to the sigma_tau limit instead of an f32 floor.  Hybrid: the
+# plain loop converges first, then 2-3 compensated polish trips run
+# (fit/portrait._hybrid_scatter_loop), so the whole fit costs ~2x the
+# plain lane rather than paying Dot2 on every eval.  False (default):
 # plain f32 sums — right for ordinary S/N, where the noise floor is
 # orders of magnitude above the f32 valley.  When True, the fast lane
 # forces full-precision X storage regardless of cross_spectrum_dtype

@@ -146,10 +146,31 @@ def _load_raw(f):
         telescope_code=telescope_code(arch.get_telescope()))
 
 
-@lru_cache(maxsize=None)
 def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
                 use_fast, ftname, pallas, x_bf16, redisp=False,
                 want_flux=False, use_ir=False, compensated=False):
+    """Cache-key normalizing front for _raw_fit_fn_cached: dead knob
+    combinations collapse onto one compiled program — compensated is
+    meaningless without the scatter engine, and under compensated mode
+    the bf16 cross-spectrum knob is dead (fast_scatter_fit_one forces
+    f32 X; fit.portrait.effective_x_bf16) — so flipping either under
+    the other never recompiles a bit-identical bucket program."""
+    scat_engine = (flags[3] or flags[4] or log10_tau
+                   or tau_mode != "none" or use_ir)
+    if not scat_engine:
+        compensated = False
+    if compensated:
+        x_bf16 = False
+    return _raw_fit_fn_cached(
+        nchan, nbin, flags, max_iter, log10_tau, tau_mode, use_fast,
+        ftname, pallas, x_bf16, redisp, want_flux, use_ir, compensated)
+
+
+@lru_cache(maxsize=None)
+def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
+                       tau_mode, use_fast, ftname, pallas, x_bf16,
+                       redisp=False, want_flux=False, use_ir=False,
+                       compensated=False):
     """ONE jitted program for a raw bucket: int16 decode (scl/offs),
     min-window baseline subtraction, power-spectrum noise, S/N,
     nu_fit seeding, the batched fit, and result packing into a single
