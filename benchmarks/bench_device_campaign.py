@@ -58,8 +58,11 @@ def main():
                   -32767, 32767).astype(np.int16)
 
     flags = (True, True, False, False, False)
+    from pulseportraiture_tpu.fit.portrait import resolve_harmonic_window
+
+    hwin = resolve_harmonic_window(None, clean, NBIN)
     fn = _raw_fit_fn(NCHAN, NBIN, flags, 25, False, "none", True,
-                     "float32", False, True)
+                     "float32", x_bf16=True, nharm_eff=hwin)
     d = {
         "raw": jnp.asarray(raw), "scl": jnp.asarray(scl, DT),
         "offs": jnp.asarray(offs, DT),

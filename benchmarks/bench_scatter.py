@@ -70,12 +70,18 @@ def main():
     th0 = jnp.asarray(th0)
 
     flags = FitFlags(True, True, False, True, True)
+    # harmonic window from the UNSCATTERED template's support (the
+    # scattering kernel only narrows the spectrum; production templates
+    # are host numpy so pipelines derive this automatically)
+    from pulseportraiture_tpu.fit.portrait import model_harmonic_window
+    hwin = model_harmonic_window(np.asarray(model), NBIN)
 
     def run():
         if engine == "fast":
             return fit_portrait_batch_fast(
                 ports, models, noise, freqs, P, NU_FIT,
-                fit_flags=flags, theta0=th0, log10_tau=True, max_iter=40)
+                fit_flags=flags, theta0=th0, log10_tau=True, max_iter=40,
+                harmonic_window=hwin if hwin is not None else False)
         return fit_portrait_batch(
             ports, models, noise, freqs, P, NU_FIT,
             fit_flags=flags, theta0=th0, log10_tau=True, max_iter=40)

@@ -1,0 +1,158 @@
+"""Cooley-Tukey matmul rFFT experiment (round 4, VERDICT #1 follow-on).
+
+prof result: the bench fit's whale is the DFT front end (31 ms of 54 at
+640x512x2048), not the moment passes (2 x 11.5 ms, already the minimal
+count).  A two-stage CT factorization n = n1*n2 cuts the MXU FLOPs ~7x
+(0.40 vs 2.75 TFLOP at 2048->1025) at the price of a harmonic-order
+permutation, which the fit can absorb by permuting the k-weight vectors
+instead of the data (moments/CCF/S-sums are all either k-weighted
+reductions or order-free).
+
+Variants measured (fused cross-spectrum program: DFT + X assembly to
+bf16 + Sd reduction, matching prepare_portrait_fit_real's shape):
+  direct      rfft_mm at 'default' (single-pass bf16) — production
+  ct_A_B      stage1 contracts n1=A, stage2 contracts n2=B, permuted
+              output, f32 intermediates
+Accuracy: assembled X vs an f64 numpy oracle on a small slice.
+"""
+
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import pulseportraiture_tpu  # noqa: F401
+    from pulseportraiture_tpu import config
+
+    config.dft_precision = "default"
+
+    from benchmarks.common import devtime
+    from pulseportraiture_tpu.ops.fourier import rfft_mm
+
+    NB, NCHAN, NBIN = 640, 512, 2048
+    NHARM = NBIN // 2 + 1
+    DT = jnp.float32
+
+    key = jax.random.PRNGKey(0)
+    ports = jax.block_until_ready(jax.jit(
+        lambda k: jax.random.normal(k, (NB, NCHAN, NBIN), DT))(key))
+    model = jax.block_until_ready(jax.jit(
+        lambda k: jax.random.normal(k, (NCHAN, NBIN), DT))(
+            jax.random.PRNGKey(1)))
+    w = jnp.ones((1, 1, 1), DT)
+
+    # model spectrum at high precision (tiny, shared)
+    mr, mi = rfft_mm(model, precision="highest")
+    mr = jax.block_until_ready(mr)
+
+    def direct(p, s):
+        dr, di = rfft_mm(p * (1.0 + s))
+        Xr = ((dr * mr + di * mi) * w).astype(jnp.bfloat16)
+        Xi = ((di * mr - dr * mi) * w).astype(jnp.bfloat16)
+        Sd = jnp.sum((dr**2 + di**2) * w, axis=(-1, -2))
+        return Xr, Xi, Sd
+
+    def ct_plan(n1, n2, n, nharm, dtype):
+        """Host-side constants for X[q*n1+r] = sum_b (Y[r,b] T[r,b])
+        W_n2^{qb}, Y[r,b] = sum_a x[a*n2+b] W_n1^{ar}; q in [0, nq).
+        Returns numpy weights + the permutation pos->k."""
+        nq = (nharm - 1) // n1 + 1  # smallest q count covering nharm
+        a = np.arange(n1)
+        r = np.arange(n1)
+        W1 = np.exp(-2j * np.pi * np.outer(a, r) / n1)  # (a, r)
+        b = np.arange(n2)
+        T = np.exp(-2j * np.pi * np.outer(r, b) / n)    # (r, b)
+        q = np.arange(nq)
+        W2 = np.exp(-2j * np.pi * np.outer(b, q) / n2)  # (b, q)
+        # permuted positions: pos = r*nq + q  ->  k = q*n1 + r
+        kk = (q[None, :] * n1 + r[:, None]).reshape(-1)  # (n1*nq,)
+        return (W1.real.astype(dtype), W1.imag.astype(dtype),
+                T.real.astype(dtype), T.imag.astype(dtype),
+                W2.real.astype(dtype), W2.imag.astype(dtype), kk)
+
+    def make_ct(n1, n2):
+        n = n1 * n2
+        W1r, W1i, Tr, Ti, W2r, W2i, kk = ct_plan(n1, n2, n, NHARM, "float32")
+        # mask out mirror harmonics (k > nharm-1) and permute the model
+        # conj-spectrum and weights into position order on the host
+        valid = kk <= NHARM - 1
+        kk_c = np.where(valid, kk, 0)
+        m_h = (np.asarray(mr) + 1j * np.asarray(mi))  # (nchan, nharm)
+        mprr = np.where(valid, m_h.real[:, kk_c], 0.0).astype(np.float32)
+        mpri = np.where(valid, m_h.imag[:, kk_c], 0.0).astype(np.float32)
+        mpr = jnp.asarray(mprr)
+        mpi = jnp.asarray(mpri)
+
+        def ct(p, s):
+            x = (p * (1.0 + s)).reshape(p.shape[0], p.shape[1], n1, n2)
+            # stage 1: contract a (axis -2)
+            Yr = jnp.einsum("...ab,ar->...rb", x, jnp.asarray(W1r))
+            Yi = jnp.einsum("...ab,ar->...rb", x, jnp.asarray(W1i))
+            # twiddle (elementwise, fused)
+            Zr = Yr * Tr - Yi * Ti
+            Zi = Yr * Ti + Yi * Tr
+            # stage 2: contract b (axis -1)
+            Fr = (jnp.einsum("...rb,bq->...rq", Zr, jnp.asarray(W2r))
+                  - jnp.einsum("...rb,bq->...rq", Zi, jnp.asarray(W2i)))
+            Fi = (jnp.einsum("...rb,bq->...rq", Zr, jnp.asarray(W2i))
+                  + jnp.einsum("...rb,bq->...rq", Zi, jnp.asarray(W2r)))
+            Fr = Fr.reshape(p.shape[0], p.shape[1], -1)  # position order
+            Fi = Fi.reshape(p.shape[0], p.shape[1], -1)
+            Xr = ((Fr * mpr + Fi * mpi) * w).astype(jnp.bfloat16)
+            Xi = ((Fi * mpr - Fr * mpi) * w).astype(jnp.bfloat16)
+            Sd = jnp.sum((Fr**2 + Fi**2) * (w * valid), axis=(-1, -2))
+            return Xr, Xi, Sd
+
+        return ct, kk, valid
+
+    # --- accuracy: one batch row vs f64 numpy oracle ----------------
+    ph = np.asarray(ports[:1]).astype(np.float64)
+    F64 = np.fft.rfft(ph, axis=-1)
+    m64 = (np.asarray(mr) + 1j * np.asarray(mi)).astype(np.complex128)
+    X64 = (F64 * np.conj(m64))[0]
+    scale = np.abs(X64).max()
+
+    def acc(fn, kk=None, valid=None):
+        Xr, Xi, _ = jax.jit(fn)(ports[:1], jnp.float32(0.0))
+        Xc = (np.asarray(Xr, np.float64)
+              + 1j * np.asarray(Xi, np.float64))[0]
+        if kk is None:
+            got = Xc
+        else:
+            got = np.zeros((NCHAN, NHARM), complex)
+            got[:, kk[valid]] = Xc[:, valid]
+        return float(np.abs(got - X64).max() / scale)
+
+    jobs = [("direct", direct, None, None)]
+    for n1, n2 in ((128, 16), (16, 128), (64, 32), (32, 64)):
+        fn, kk, valid = make_ct(n1, n2)
+        jobs.append((f"ct_{n1}_{n2}", fn, kk, valid))
+
+    counter = [0]
+    for name, fn, kk, valid in jobs:
+        err = acc(fn, kk, valid)
+        jfn = jax.jit(fn)
+
+        def call(jfn=jfn):
+            counter[0] += 1
+            return jfn(ports, jnp.float32(counter[0] * 1e-7))
+
+        slope, single = devtime(
+            call,
+            lambda r: (r[0].astype(jnp.float32).sum()
+                       + r[1].astype(jnp.float32).sum() + r[2].sum()),
+            K=6, warm=2)
+        print(json.dumps({"variant": name,
+                          "slope_ms": round(slope * 1e3, 2),
+                          "single_ms": round(single * 1e3, 1),
+                          "max_rel_err": f"{err:.2e}"}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -180,6 +180,89 @@ def use_scatter_compensated():
     return bool(getattr(config, "scatter_compensated", False))
 
 
+def model_harmonic_window(model, nbin, tail=None):
+    """Static harmonic count K for the fast fit's band-limited lane,
+    derived from a HOST model portrait (numpy (nchan, nbin) or
+    (nb, nchan, nbin)): the smallest K such that every channel keeps
+    all but `tail` (config.harmonic_window_tail) of its spectral power
+    below K, plus one 128-harmonic guard block, rounded up to a
+    multiple of 128 (MXU/VPU tile width).  Returns None when no
+    truncation is worthwhile (K would reach the full spectrum) — e.g.
+    noise-dominated or unresolved templates.
+
+    Every fit statistic is model-weighted (X = d conj(m) w, S ~ |m|^2
+    w), so harmonics with ~zero model power contribute ~zero to the
+    fit; chi2/Sd are NOT truncated (time-domain Parseval term in
+    prepare_portrait_fit_real).  The reference evaluates all harmonics
+    unconditionally (pptoaslib.py:564-614); on TPU the window cuts the
+    two dominant fit costs (MXU DFT, VPU moment trig) by ~the same
+    factor."""
+    import numpy as _np
+
+    if tail is None:
+        tail = float(getattr(config, "harmonic_window_tail", 1e-12))
+    nharm = nbin // 2 + 1
+    # chunk over channels: a batched 3-D model at campaign shapes is
+    # gigabytes, and the derivation only needs a per-channel max — the
+    # spectrum is computed in f32 (numpy rfft of f32 -> complex64, half
+    # the memory) with the tail accumulation in f64 per chunk
+    m = _np.asarray(model).reshape(-1, nbin)
+    if m.dtype not in (_np.float32, _np.float64):
+        m = m.astype(_np.float32)
+    K = 0
+    any_good = False
+    for lo in range(0, m.shape[0], 256):
+        spec = _np.abs(_np.fft.rfft(m[lo:lo + 256], axis=-1)) ** 2.0
+        spec = spec.astype(_np.float64)
+        tot = spec.sum(axis=-1)
+        good = tot > 0.0
+        if not _np.any(good):
+            continue
+        any_good = True
+        # per-channel tail power fraction above each k (frac[k] is the
+        # power at harmonics >= k)
+        rev_cum = spec[good, ::-1].cumsum(axis=-1)[:, ::-1]
+        frac = rev_cum / tot[good, None]
+        K = max(K, int((frac > tail).sum(axis=-1).max()))
+    if not any_good:
+        return None
+    K = (K + 128 + 127) // 128 * 128  # +1 guard block, tile-rounded
+    if K >= nharm:
+        return None
+    return K
+
+
+def resolve_harmonic_window(harmonic_window, models, nbin):
+    """The fast batch entry points' shared parse of the harmonic-window
+    knob: explicit int wins (tile-rounded); None -> config
+    (fit_harmonic_window); True or 'auto' derives from the model ONLY
+    when it is host-resident (numpy) — deriving from a device array
+    would cost a silent device->host pull mid-pipeline.  Unknown
+    strings raise (strict like use_matmul_dft: a typo must not silently
+    mean full-spectrum, and True must not mean K=128)."""
+    import numpy as _np
+
+    if harmonic_window is None:
+        harmonic_window = getattr(config, "fit_harmonic_window", None)
+    if harmonic_window is None or harmonic_window is False:
+        return None
+    if harmonic_window is True or harmonic_window == "auto":
+        if isinstance(models, _np.ndarray):
+            return model_harmonic_window(models, nbin)
+        return None
+    if isinstance(harmonic_window, str):
+        raise ValueError(
+            f"fit_harmonic_window must be 'auto', True/False/None, or "
+            f"a positive int; got {harmonic_window!r}")
+    K = int(harmonic_window)
+    if K <= 0:
+        raise ValueError(
+            f"fit_harmonic_window must be positive (got {K}); use "
+            f"None or False to disable the window")
+    K = (K + 127) // 128 * 128
+    return K if K < nbin // 2 + 1 else None
+
+
 def effective_x_bf16(compensated, x_bf16=None):
     """The bf16 cross-spectrum storage flag *actually in effect* for a
     scattering program: compensated mode forces f32 X, so the bf16 knob
@@ -204,18 +287,6 @@ def split_ir_host(ir_FT, dt):
 
     ir_h = _np.asarray(ir_FT)
     return jnp.asarray(ir_h.real, dt), jnp.asarray(ir_h.imag, dt)
-
-
-def use_pallas_moments(dtype):
-    """Whether the fused Pallas moment kernel should run: opt-in via
-    config.use_pallas (True = f32 data anywhere, 'auto' = TPU backends;
-    default False — the XLA path is the reference and measures faster
-    at production shapes)."""
-    setting = getattr(config, "use_pallas", "auto")
-    if setting is False:
-        return False
-    on_tpu = jax.default_backend() == "tpu"
-    return (setting is True or on_tpu) and jnp.dtype(dtype) == jnp.float32
 
 
 def _moments_xla(t_n, X):
@@ -1011,7 +1082,7 @@ def _finalize_fit(theta, s, H, C, S, Sd, nharm, flags_arr, fit_flags,
 
 
 def _initial_phase_guess_real(Xr, Xi, cvec, DM0, oversamp=2,
-                              derotate=True):
+                              derotate=True, nbin=None):
     """_initial_phase_guess on split real/imag parts (complex-free):
     derotate by DM0, sum channels, dense CCF via the matmul inverse
     DFT, argmax.
@@ -1020,11 +1091,16 @@ def _initial_phase_guess_real(Xr, Xi, cvec, DM0, oversamp=2,
     when the caller knows DM0 == 0, where the phasor is identity.  At
     production shapes the derotation pass costs as much as a Newton
     moment pass, so the zero-DM-guess case (every cold-start batch fit)
-    is worth the static branch."""
+    is worth the static branch.
+
+    nbin: the true profile length — must be passed when Xr/Xi are
+    band-limited (harmonic window) so the CCF lag grid keeps its full
+    resolution."""
     from ..ops.fourier import irfft_mm
 
     nharm = Xr.shape[-1]
-    nbin = 2 * (nharm - 1)
+    if nbin is None:
+        nbin = 2 * (nharm - 1)
     dt = cvec.dtype
     if derotate:
         k = jnp.arange(nharm, dtype=dt)
@@ -1043,34 +1119,78 @@ def _initial_phase_guess_real(Xr, Xi, cvec, DM0, oversamp=2,
     return jnp.mod(phi0 + 0.5, 1.0) - 0.5
 
 
+def _parseval_Sd(port, w_full):
+    """Weighted one-sided data power over ALL harmonics, computed from
+    the TIME domain — the full-spectrum Sd that chi2 needs when the
+    spectra themselves are band-limited (harmonic window).  Exact
+    Parseval forms (DC handled per F0_fact):
+      even n: sum_{k=1}^{n/2}   |X_k|^2 = (n sum x^2 - X_0^2
+                                           + X_{n/2}^2)/2
+      odd n (no Nyquist bin):   (n sum x^2 - X_0^2)/2
+    w_full: the untruncated make_weights array — per-channel constant
+    for k >= 1 (column 1), F0_fact-scaled at k = 0.
+
+    The DC-free power uses the algebraically identical mean-removed
+    form n*sum((x - mean)^2) rather than n*sum(x^2) - X_0^2: for data
+    riding a baseline offset mu >> sigma the subtraction cancels
+    catastrophically in f32 (measured 3x-wrong power at mu/sigma =
+    5000), while the mean-removed form matches f64 to ~7 digits at the
+    same cost."""
+    dt = w_full.dtype
+    nbin = port.shape[-1]
+    x0 = jnp.sum(port, axis=-1)
+    mu = x0 / nbin
+    pwr = nbin * jnp.sum((port - mu[..., None]) ** 2, axis=-1)
+    if nbin % 2 == 0:
+        sgn = jnp.asarray((-1.0) ** jnp.arange(nbin), dt)
+        xn = jnp.sum(port * sgn, axis=-1)
+        pwr = pwr + xn**2
+    Sd = jnp.sum(w_full[..., 1] * (0.5 * pwr))
+    if float(F0_fact) != 0.0:
+        Sd = Sd + jnp.sum(w_full[..., 0] * x0**2)
+    return Sd
+
+
 def prepare_portrait_fit_real(port, model, w, freqs, P, nu_fit, theta0,
                               seed_phi=True, seed_derotate=True,
-                              x_dtype=None):
+                              x_dtype=None, nharm_eff=None):
     """Everything before the Newton loop, in pure real arithmetic:
     matmul DFTs (ops/fourier.py — XLA's TPU FFT is ~2000x slower at
     these shapes), weighted cross-spectrum as a real pair, model/data
     powers, and the CCF phase seed.
 
-    Being complex-free end to end lets the whole fit live in ONE
-    program together with the Pallas moment kernel (the runtime cannot
-    compile complex values and Mosaic kernels into the same program).
+    nharm_eff (static): band-limit the whole fit to the model's
+    harmonic window (model_harmonic_window) — the DFTs emit only the
+    first nharm_eff harmonics and Sd (the data power that chi2 needs
+    over the FULL spectrum) switches to an exact time-domain Parseval
+    form, so chi2/dof match the untruncated fit to rounding.
+
+    Being complex-free end to end keeps the whole fit compilable on
+    TPU runtimes whose transports and FFT lowerings cannot handle
+    complex types at all (ops/fourier.py).
     Returns (Xr, Xi, S0, Sd, theta0_seeded).
     """
     from ..ops.fourier import rfft_mm
 
     dt = w.dtype
-    dr, di = rfft_mm(port)
-    mr, mi = rfft_mm(model)
+    dr, di = rfft_mm(port, nharm=nharm_eff)
+    mr, mi = rfft_mm(model, nharm=nharm_eff)
+    if nharm_eff is not None:
+        w_full, w = w, w[..., :nharm_eff]
     # X = dFT * conj(mFT) * w, split into parts
     Xr = (dr * mr + di * mi) * w
     Xi = (di * mr - dr * mi) * w
     cvec, _ = _t_coeffs(freqs, P, nu_fit)
     cvec = cvec.astype(dt)
     S0 = jnp.sum((mr**2 + mi**2) * w, axis=-1)
-    Sd = jnp.sum((dr**2 + di**2) * w)
+    if nharm_eff is None:
+        Sd = jnp.sum((dr**2 + di**2) * w)
+    else:
+        Sd = _parseval_Sd(port, w_full)
     if seed_phi:
         phi0 = _initial_phase_guess_real(Xr, Xi, cvec, theta0[1],
-                                         derotate=seed_derotate)
+                                         derotate=seed_derotate,
+                                         nbin=port.shape[-1])
         theta0 = jnp.where(jnp.arange(5) == 0, phi0, theta0).astype(dt)
     else:
         theta0 = theta0.astype(dt)
@@ -1083,7 +1203,7 @@ def prepare_portrait_fit_real(port, model, w, freqs, P, nu_fit, theta0,
 
 @partial(
     jax.jit,
-    static_argnames=("fit_flags", "max_iter", "pallas"),
+    static_argnames=("fit_flags", "max_iter", "nharm_total"),
 )
 def _fit_portrait_core_real(
     Xr,
@@ -1098,20 +1218,27 @@ def _fit_portrait_core_real(
     fit_flags=FitFlags(),
     max_iter=40,
     ftol=None,
-    pallas=False,
+    nharm_total=None,
 ):
     """Stage 2 of the split fit: the (phi, DM, GM) Newton loop and
     result packaging in pure real arithmetic.
 
     Only valid for fits with no active scattering parameters (the
-    _cgh_fast regime).  With pallas=True the harmonic moments run in
-    the fused TPU kernel; otherwise through equivalent real XLA ops —
-    results match _fit_portrait_core to round-off either way.
+    _cgh_fast regime).  The harmonic moments run through the fused XLA
+    reductions (_moments_real_xla) — results match _fit_portrait_core
+    to round-off.  (A hand-written Pallas moment kernel existed through
+    round 4 and was deleted: measured per-pass on v5e at 640x512x2048,
+    XLA 10.9/9.9 ms f32/bf16 vs Pallas 31.3/21.5 direct and 24.2/14.6
+    with a factorized phasor — benchmarks/BENCHMARKS.md round 4.)
+
+    nharm_total: the FULL spectrum's harmonic count when Xr/Xi are
+    band-limited (model_harmonic_window) — dof counts every data
+    harmonic, not just the windowed ones.
     """
     assert not (fit_flags[3] or fit_flags[4]), (
         "real core handles the no-scattering path only")
     dt = S0.dtype
-    nharm = Xr.shape[-1]
+    nharm = nharm_total if nharm_total is not None else Xr.shape[-1]
     flags_arr = FitFlags(*fit_flags).as_array(dt)
     if ftol is None:
         ftol = 50.0 * float(jnp.finfo(dt).eps)
@@ -1121,24 +1248,9 @@ def _fit_portrait_core_real(
     cvec = cvec.astype(dt)
     gvec = gvec.astype(dt)
 
-    if pallas:
-        # pad the harmonic axis for the kernel ONCE, outside the Newton
-        # loop (zero columns contribute nothing to the moments; padding
-        # inside the loop would copy the cross-spectrum every iteration)
-        hp = -nharm % 128
-        Xr = jnp.pad(Xr, ((0, 0), (0, hp)))
-        Xi = jnp.pad(Xi, ((0, 0), (0, hp)))
-
-    def moments(theta):
-        t_n = theta[0] + cvec * theta[1] + gvec * theta[2]
-        if pallas:
-            from ..ops.pallas_kernels import harmonic_moments_real
-
-            return harmonic_moments_real(Xr, Xi, t_n)
-        return _moments_real_xla(t_n, Xr, Xi)
-
     def cgh(theta):
-        C, C1, C2 = moments(theta)
+        t_n = theta[0] + cvec * theta[1] + gvec * theta[2]
+        C, C1, C2 = _moments_real_xla(t_n, Xr, Xi)
         f, g, H = _cgh_tail(C, C1, C2, S0inv, cvec, gvec, dt)
         return f, g, H, C
 
@@ -1153,7 +1265,8 @@ def _fit_portrait_core_real(
 
 @partial(
     jax.jit,
-    static_argnames=("fit_flags", "log10_tau", "max_iter", "compensated"),
+    static_argnames=("fit_flags", "log10_tau", "max_iter", "compensated",
+                     "nharm_total"),
 )
 def _fit_portrait_core_real_scatter(
     Xr,
@@ -1170,6 +1283,7 @@ def _fit_portrait_core_real_scatter(
     max_iter=40,
     ftol=None,
     compensated=False,
+    nharm_total=None,
 ):
     """Stage 2 of the split SCATTERING fit: the (phi, DM, GM, tau,
     alpha) Newton loop on the fused analytic _cgh_scatter evaluator and
@@ -1183,9 +1297,14 @@ def _fit_portrait_core_real_scatter(
     |m|^2 w (|ir|^2 folded in).  The (C, S) pair rides the Newton state
     as aux, so no extra pass over the spectra is needed at the
     solution.
+
+    nharm_total: the full spectrum's harmonic count when the spectra
+    are band-limited (model_harmonic_window; the scattering kernel
+    only multiplies the template spectrum — it never widens it — so
+    the unscattered template's window stays valid for every tau).
     """
     dt = M2w.dtype
-    nharm = Xr.shape[-1]
+    nharm = nharm_total if nharm_total is not None else Xr.shape[-1]
     flags_arr = FitFlags(*fit_flags).as_array(dt)
     if ftol is None:
         ftol = _scatter_ftol(dt, compensated)
@@ -1215,7 +1334,7 @@ def _fit_portrait_core_real_scatter(
 def fast_scatter_fit_one(port, model, noise_stds, chan_mask, freqs, P,
                          nu_fit, nu_out, theta0, ir_r=None, ir_i=None, *,
                          fit_flags, log10_tau, max_iter,
-                         compensated=False, x_bf16=None):
+                         compensated=False, x_bf16=None, nharm_eff=None):
     """One complex-free SCATTERING fit: weights, matmul DFTs + CCF
     seed, the real _cgh_scatter Newton loop — the per-element body for
     scattering batches on TPU runtimes (vmapped by _fast_batch_fn,
@@ -1224,9 +1343,14 @@ def fast_scatter_fit_one(port, model, noise_stds, chan_mask, freqs, P,
     ir_r/ir_i: optional instrumental-response FT split into real parts
     (complex buffers cannot cross some tunneled-runtime transports, so
     the response ships as two real arrays and is folded into the
-    spectra here: X' = X conj(ir), M2' = M2 |ir|^2).  The tau/alpha
+    spectra here: X' = X conj(ir), M2' = M2 |ir|^2); when nharm_eff is
+    set they must already be sliced to the window.  The tau/alpha
     seeds arrive via theta0 (cols 3, 4), exactly like the complex
-    engine."""
+    engine.
+
+    nharm_eff (static): the UNSCATTERED template's harmonic window —
+    valid for every tau, because the scattering kernel and the
+    response only multiply the template spectrum, never widen it."""
     if x_bf16 is None:
         x_bf16 = use_bf16_cross_spectrum()
     from ..ops.fourier import _gated_precision, rfft_mm
@@ -1239,12 +1363,17 @@ def fast_scatter_fit_one(port, model, noise_stds, chan_mask, freqs, P,
     nbin = port.shape[-1]
     dt = port.dtype
     w = make_weights(noise_stds, nbin, chan_mask, dtype=dt)
-    dr, di = rfft_mm(port, precision=prec)
-    mr, mi = rfft_mm(model.astype(dt), precision=prec)
+    dr, di = rfft_mm(port, precision=prec, nharm=nharm_eff)
+    mr, mi = rfft_mm(model.astype(dt), precision=prec, nharm=nharm_eff)
+    if nharm_eff is not None:
+        w_full, w = w, w[..., :nharm_eff]
     Xr = (dr * mr + di * mi) * w
     Xi = (di * mr - dr * mi) * w
     M2w = (mr**2 + mi**2) * w
-    Sd = jnp.sum((dr**2 + di**2) * w)
+    if nharm_eff is None:
+        Sd = jnp.sum((dr**2 + di**2) * w)
+    else:
+        Sd = _parseval_Sd(port, w_full)
     if ir_r is not None:
         # X' = X conj(ir) with X = Xr + i Xi, ir = ir_r + i ir_i
         Xr, Xi = Xr * ir_r + Xi * ir_i, Xi * ir_r - Xr * ir_i
@@ -1252,7 +1381,7 @@ def fast_scatter_fit_one(port, model, noise_stds, chan_mask, freqs, P,
     cvec, _ = _t_coeffs(freqs, P, nu_fit)
     if fit_flags[0]:
         phi0 = _initial_phase_guess_real(Xr, Xi, cvec.astype(dt),
-                                         theta0[1])
+                                         theta0[1], nbin=nbin)
         theta0 = jnp.where(jnp.arange(5) == 0, phi0, theta0).astype(dt)
     else:
         theta0 = theta0.astype(dt)
@@ -1265,7 +1394,8 @@ def fast_scatter_fit_one(port, model, noise_stds, chan_mask, freqs, P,
     return _fit_portrait_core_real_scatter.__wrapped__(
         Xr.astype(xdt), Xi.astype(xdt), M2w, Sd, freqs, P, nu_fit,
         nu_out, theta0, fit_flags=fit_flags, log10_tau=log10_tau,
-        max_iter=max_iter, compensated=compensated)
+        max_iter=max_iter, compensated=compensated,
+        nharm_total=nbin // 2 + 1 if nharm_eff is not None else None)
 
 
 def fit_portrait_batch_fast(
@@ -1280,18 +1410,17 @@ def fit_portrait_batch_fast(
     fit_flags=FitFlags(),
     chan_masks=None,
     max_iter=40,
-    pallas=None,
     log10_tau=False,
     ir_FT=None,
     use_scatter=None,
     compensated=None,
+    harmonic_window=None,
 ):
     """Batched fit through the split real-arithmetic path: matmul DFTs,
     CCF seed, and a complex-free Newton loop in one program — the TPU
     throughput path (bench.py) for BOTH regimes:
 
-    - no scattering: the 3-moment fused pass (optionally the Pallas
-      kernel), exactly as before;
+    - no scattering: the 3-moment fused pass, exactly as before;
     - scattering active (tau/alpha fitted, log10_tau, or a fixed
       nonzero tau seed): the real _cgh_scatter lane (fast_scatter_fit
       _one) — same matmul-DFT front end, the fused analytic 9-reduction
@@ -1304,7 +1433,10 @@ def fit_portrait_batch_fast(
 
     models may be (nb, nchan, nbin) or a shared (nchan, nbin) template
     (vmapped with in_axes=None — no batch materialization).
-    pallas: None -> use the fused kernel on TPU f32 (use_pallas_moments).
+    harmonic_window: None -> config.fit_harmonic_window; int = explicit
+    harmonic count; band-limits the fit to the model's spectral support
+    (model_harmonic_window — chi2/dof stay full-spectrum).  'auto'
+    derives from the model only when `models` is a host numpy array.
     """
     if use_scatter is None:
         use_scatter = derive_use_scatter(fit_flags, log10_tau, theta0) \
@@ -1319,11 +1451,13 @@ def fit_portrait_batch_fast(
             ports, models, noise_stds, freqs, P, nu_fit, nu_out=nu_out,
             theta0=theta0, fit_flags=fit_flags, chan_masks=chan_masks,
             max_iter=max_iter, log10_tau=log10_tau, ir_FT=ir_FT,
-            compensated=compensated)
+            compensated=compensated, harmonic_window=harmonic_window)
     reject_fixed_tau_seed(theta0, "fit_portrait_batch_fast")
     ports = jnp.asarray(ports)
     nb = ports.shape[0]
     dt = ports.dtype
+    nharm_eff = resolve_harmonic_window(harmonic_window, models,
+                                        ports.shape[-1])
     models = jnp.asarray(models)
     m_ax = 0 if models.ndim == 3 else None  # 2-D = shared template
     freqs = jnp.asarray(freqs, dt)
@@ -1352,21 +1486,20 @@ def fit_portrait_batch_fast(
     nu_out_val = jnp.full((nb,), -1.0 if nu_out is None else nu_out, dt)
     if chan_masks is None:
         chan_masks = jnp.ones(ports.shape[:2], dt)
-    if pallas is None:
-        pallas = use_pallas_moments(dt)
 
     x_bf16 = use_bf16_cross_spectrum()
     fit = _fast_batch_fn(
         FitFlags(*[bool(f) for f in fit_flags]), int(max_iter),
-        bool(pallas), m_ax, f_ax, p_ax, nf_ax, seed_derotate, x_bf16)
+        m_ax, f_ax, p_ax, nf_ax, seed_derotate, x_bf16,
+        nharm_eff)
     return fit(
         ports, models, jnp.asarray(noise_stds), chan_masks,
         freqs, P, nu_fit, nu_out_val, theta0)
 
 
 def fast_fit_one(port, model, noise_stds, chan_mask, freqs, P, nu_fit,
-                 nu_out, theta0, *, fit_flags, max_iter, pallas,
-                 seed_derotate=True, x_bf16=None):
+                 nu_out, theta0, *, fit_flags, max_iter,
+                 seed_derotate=True, x_bf16=None, nharm_eff=None):
     """One complex-free fast fit: weights, matmul DFTs + CCF seed, real
     Newton core — the per-element body shared by the vmapped batch
     (_fast_batch_fn) and the sharded scale-out path
@@ -1375,24 +1508,28 @@ def fast_fit_one(port, model, noise_stds, chan_mask, freqs, P, nu_fit,
     x_bf16 None resolves config.cross_spectrum_dtype at trace time (so
     the knob also reaches callers that don't thread it explicitly, like
     the sharded path — with the usual caveat that an already-traced
-    program won't see later config changes)."""
+    program won't see later config changes).
+
+    nharm_eff (static): the model's harmonic window
+    (model_harmonic_window) — band-limits the DFTs and moment passes;
+    chi2/dof stay full-spectrum (Parseval Sd, nharm_total)."""
     if x_bf16 is None:
         x_bf16 = use_bf16_cross_spectrum()
     nbin = port.shape[-1]
     w = make_weights(noise_stds, nbin, chan_mask, dtype=port.dtype)
-    # the Pallas moment kernel reads f32 tiles, so narrow storage only
-    # applies on the XLA moment path; f64 runs (CPU parity/oracle paths)
-    # never narrow — bf16 storage is an f32-throughput optimization
+    # f64 runs (CPU parity/oracle paths) never narrow — bf16 storage is
+    # an f32-throughput optimization
     x_dtype = (jnp.bfloat16
-               if (x_bf16 and not pallas and port.dtype == jnp.float32)
+               if (x_bf16 and port.dtype == jnp.float32)
                else None)
     Xr, Xi, S0, Sd, th0 = prepare_portrait_fit_real(
         port, model.astype(port.dtype), w, freqs, P, nu_fit, theta0,
         seed_phi=bool(fit_flags[0]), seed_derotate=seed_derotate,
-        x_dtype=x_dtype)
+        x_dtype=x_dtype, nharm_eff=nharm_eff)
     return _fit_portrait_core_real.__wrapped__(
         Xr, Xi, S0, Sd, freqs, P, nu_fit, nu_out, th0,
-        fit_flags=fit_flags, max_iter=max_iter, pallas=pallas)
+        fit_flags=fit_flags, max_iter=max_iter,
+        nharm_total=nbin // 2 + 1 if nharm_eff is not None else None)
 
 
 def use_fast_fit_default():
@@ -1417,15 +1554,15 @@ def reject_fixed_tau_seed(theta0, caller):
 
 
 @lru_cache(maxsize=None)
-def _fast_batch_fn(fit_flags, max_iter, pallas, m_ax, f_ax, p_ax, nf_ax,
-                   seed_derotate=True, x_bf16=False):
+def _fast_batch_fn(fit_flags, max_iter, m_ax, f_ax, p_ax, nf_ax,
+                   seed_derotate=True, x_bf16=False, nharm_eff=None):
     """Cached jitted end-to-end fast fit — a fresh jit per call would
     recompile every invocation.  One program: matmul DFTs, real
-    cross-spectrum, CCF seed, Newton loop (Pallas moments when
-    enabled), finalize — no complex types anywhere."""
+    cross-spectrum, CCF seed, Newton loop, finalize — no complex types
+    anywhere."""
     one = partial(fast_fit_one, fit_flags=fit_flags, max_iter=max_iter,
-                  pallas=pallas, seed_derotate=seed_derotate,
-                  x_bf16=x_bf16)
+                  seed_derotate=seed_derotate,
+                  x_bf16=x_bf16, nharm_eff=nharm_eff)
     return jax.jit(jax.vmap(
         one, in_axes=(0, m_ax, 0, 0, f_ax, p_ax, nf_ax, 0, 0)))
 
@@ -1434,12 +1571,14 @@ def _fit_batch_fast_scatter(ports, models, noise_stds, freqs, P, nu_fit,
                             nu_out=None, theta0=None,
                             fit_flags=FitFlags(), chan_masks=None,
                             max_iter=40, log10_tau=False, ir_FT=None,
-                            compensated=None):
+                            compensated=None, harmonic_window=None):
     """Batch wrapper for the complex-free scattering lane (see
     fit_portrait_batch_fast, which routes here)."""
     ports = jnp.asarray(ports)
     nb = ports.shape[0]
     dt = ports.dtype
+    nharm_eff = resolve_harmonic_window(harmonic_window, models,
+                                        ports.shape[-1])
     models = jnp.asarray(models)
     m_ax = 0 if models.ndim == 3 else None
     freqs = jnp.asarray(freqs, dt)
@@ -1457,12 +1596,16 @@ def _fit_batch_fast_scatter(ports, models, noise_stds, freqs, P, nu_fit,
     if compensated is None:
         compensated = use_scatter_compensated()
     use_ir = ir_FT is not None
+    if ir_FT is not None and nharm_eff is not None:
+        import numpy as _np
+
+        ir_FT = _np.asarray(ir_FT)[..., :nharm_eff]
     ir_r, ir_i = split_ir_host(ir_FT, dt)
     fit = _fast_scatter_batch_fn(
         FitFlags(*[bool(f) for f in fit_flags]), bool(log10_tau),
         int(max_iter), bool(compensated),
         effective_x_bf16(compensated),
-        m_ax, f_ax, p_ax, nf_ax, use_ir)
+        m_ax, f_ax, p_ax, nf_ax, use_ir, nharm_eff)
     return fit(ports, models, jnp.asarray(noise_stds),
                jnp.asarray(chan_masks, dt), freqs, P, nu_fit,
                nu_out_arr, jnp.asarray(theta0), ir_r, ir_i)
@@ -1470,11 +1613,13 @@ def _fit_batch_fast_scatter(ports, models, noise_stds, freqs, P, nu_fit,
 
 @lru_cache(maxsize=None)
 def _fast_scatter_batch_fn(fit_flags, log10_tau, max_iter, compensated,
-                           x_bf16, m_ax, f_ax, p_ax, nf_ax, use_ir):
+                           x_bf16, m_ax, f_ax, p_ax, nf_ax, use_ir,
+                           nharm_eff=None):
     """Cached jitted end-to-end complex-free scattering batch fit."""
     one = partial(fast_scatter_fit_one, fit_flags=fit_flags,
                   log10_tau=log10_tau, max_iter=max_iter,
-                  compensated=compensated, x_bf16=x_bf16)
+                  compensated=compensated, x_bf16=x_bf16,
+                  nharm_eff=nharm_eff)
     ir_ax = None  # shared response across the batch
     return jax.jit(jax.vmap(
         one,

@@ -45,9 +45,14 @@ def _default_precision():
 # weight caches hold HOST numpy arrays: a jnp array materialized during
 # a jit trace is a tracer, and caching one leaks it across traces
 @lru_cache(maxsize=None)
-def _rfft_weights(n, dtype_str):
-    """(Wc, Ws): x @ Wc = Re rfft(x), x @ Ws = Im rfft(x)."""
-    k = np.arange(n // 2 + 1)
+def _rfft_weights(n, dtype_str, nharm=None):
+    """(Wc, Ws): x @ Wc = Re rfft(x), x @ Ws = Im rfft(x).
+
+    nharm truncates the output to the first nharm harmonics (a
+    band-limited DFT: exact for any consumer that only reads k <
+    nharm, at nharm/(n/2+1) of the matmul cost — the fit's harmonic
+    window, fit/portrait.model_harmonic_window)."""
+    k = np.arange(n // 2 + 1 if nharm is None else nharm)
     j = np.arange(n)
     ang = 2.0 * np.pi * np.outer(j, k) / n
     Wc = np.cos(ang)
@@ -74,15 +79,16 @@ def _irfft_weights(nharm, n, dtype_str):
     return (Vc.astype(dtype_str), Vs.astype(dtype_str))
 
 
-def rfft_mm(x, precision=None):
-    """Real DFT of the last axis via matmul: (..., n) -> two (..., n//2+1)
-    real arrays (Re, Im).  precision None -> config.dft_precision
-    ('highest' keeps f32 accuracy at the 1e-7 level; 'high' ~1e-6 and
-    ~20% faster end-to-end; bf16 single-pass would cost ~1e-3)."""
+def rfft_mm(x, precision=None, nharm=None):
+    """Real DFT of the last axis via matmul: (..., n) -> two (..., nharm)
+    real arrays (Re, Im); nharm defaults to the full n//2+1.  precision
+    None -> config.dft_precision ('highest' keeps f32 accuracy at the
+    1e-7 level; 'high' ~1e-6 and ~20% faster end-to-end; bf16
+    single-pass would cost ~1e-3)."""
     if precision is None:
         precision = _default_precision()
     n = x.shape[-1]
-    Wc, Ws = _rfft_weights(n, str(x.dtype))
+    Wc, Ws = _rfft_weights(n, str(x.dtype), nharm)
     return (
         jnp.matmul(x, Wc, precision=precision),
         jnp.matmul(x, Ws, precision=precision),

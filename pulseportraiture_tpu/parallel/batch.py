@@ -116,9 +116,9 @@ def fit_portrait_sharded_fast(
     chan_masks=None,
     max_iter=40,
     shard_channels=False,
-    pallas=False,
     log10_tau=False,
     compensated=None,
+    harmonic_window=None,
 ):
     """fit_portrait_sharded through the complex-free real-arithmetic
     cores: matmul DFTs, CCF seed, and the Newton loop in one sharded
@@ -129,13 +129,15 @@ def fit_portrait_sharded_fast(
     (fast_scatter_fit_one) — both complex-free end to end.
 
     models may be (nb, nchan, nbin) or a shared (nchan, nbin) template.
-    pallas stays opt-in here: the fused kernel is not
-    auto-partitionable, so with channel sharding XLA would replicate
-    it; the XLA real path shards cleanly (psum over 'chan' for the
-    channel reductions).
+    The moment passes run the fused XLA reductions, which shard cleanly
+    (psum over 'chan' for the channel reductions).
+    harmonic_window: as fit_portrait_batch_fast — band-limits both fast
+    lanes to the template's spectral support ('auto' needs a host
+    numpy model).
     """
     from ..fit.portrait import (derive_use_scatter,
                                 reject_fixed_tau_seed,
+                                resolve_harmonic_window,
                                 use_scatter_compensated)
 
     use_scatter = derive_use_scatter(fit_flags, log10_tau, theta0)
@@ -146,6 +148,7 @@ def fit_portrait_sharded_fast(
     ports = jnp.asarray(ports)
     nb, nchan, nbin = ports.shape
     dt = ports.dtype
+    nharm_eff = resolve_harmonic_window(harmonic_window, models, nbin)
     models = jnp.asarray(models, dt)
     m_ax = 0 if models.ndim == 3 else None
     freqs = jnp.asarray(freqs, dt)
@@ -163,9 +166,10 @@ def fit_portrait_sharded_fast(
     flags = FitFlags(*[bool(f) for f in fit_flags])
 
     jitted, shardings = _sharded_fast_fn(
-        mesh, flags, int(max_iter), bool(pallas), m_ax, f_ax,
+        mesh, flags, int(max_iter), m_ax, f_ax,
         bool(shard_channels), use_scatter=bool(use_scatter),
-        log10_tau=bool(log10_tau), compensated=bool(compensated))
+        log10_tau=bool(log10_tau), compensated=bool(compensated),
+        nharm_eff=nharm_eff)
     sh3, shm, sh2c, _, _, _ = shardings
     ports = jax.device_put(ports, sh3)
     models = jax.device_put(models, shm)
@@ -238,8 +242,7 @@ def _sharded_align_fn(mesh, flags, max_iter, shard_channels):
         dt = ports.dtype
         nu0 = jnp.mean(freqs)
         nb = ports.shape[0]
-        one = partial(fast_fit_one, fit_flags=flags, max_iter=max_iter,
-                      pallas=False)
+        one = partial(fast_fit_one, fit_flags=flags, max_iter=max_iter)
         res = jax.vmap(one, in_axes=(0, None, 0, 0, None, 0, None, None,
                                      0))(
             ports, model, noise_stds, chan_masks, freqs, P_s, nu0,
@@ -274,9 +277,9 @@ _ALIGN_TINY = 1e-30
 
 
 @lru_cache(maxsize=None)
-def _sharded_fast_fn(mesh, flags, max_iter, pallas, m_ax, f_ax,
+def _sharded_fast_fn(mesh, flags, max_iter, m_ax, f_ax,
                      shard_channels, use_scatter=False, log10_tau=False,
-                     compensated=False):
+                     compensated=False, nharm_eff=None):
     """Cached sharded jit of the shared per-element fast fit
     (fit.portrait.fast_fit_one, or fast_scatter_fit_one when the
     scattering kernel is active) — a fresh jit per call would recompile
@@ -287,10 +290,10 @@ def _sharded_fast_fn(mesh, flags, max_iter, pallas, m_ax, f_ax,
 
         one = partial(fast_scatter_fit_one, fit_flags=flags,
                       log10_tau=log10_tau, max_iter=max_iter,
-                      compensated=compensated)
+                      compensated=compensated, nharm_eff=nharm_eff)
     else:
         one = partial(fast_fit_one, fit_flags=flags, max_iter=max_iter,
-                      pallas=pallas)
+                      nharm_eff=nharm_eff)
     core = jax.vmap(one, in_axes=(0, m_ax, 0, 0, f_ax, 0, 0, 0, 0))
 
     chan_axis = 1 if shard_channels else None

@@ -54,8 +54,7 @@ import numpy as np
 from ..config import Dconst, scattering_alpha
 from ..fit.portrait import (FitFlags, _fast_batch_fn, estimate_tau_batch,
                             fit_portrait_batch, fit_portrait_batch_fast,
-                            use_bf16_cross_spectrum, use_fast_fit_default,
-                            use_pallas_moments)
+                            use_bf16_cross_spectrum, use_fast_fit_default)
 from ..io.psrfits import read_archive
 from ..io.tim import TOA, write_TOAs
 from ..ops.noise import get_SNR, get_noise_PS, min_window_baseline
@@ -147,8 +146,9 @@ def _load_raw(f):
 
 
 def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
-                use_fast, ftname, pallas, x_bf16, redisp=False,
-                want_flux=False, use_ir=False, compensated=False):
+                use_fast, ftname, x_bf16, redisp=False,
+                want_flux=False, use_ir=False, compensated=False,
+                nharm_eff=None):
     """Cache-key normalizing front for _raw_fit_fn_cached: dead knob
     combinations collapse onto one compiled program — compensated is
     meaningless without the scatter engine, and under compensated mode
@@ -161,16 +161,19 @@ def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
         compensated = False
     if compensated:
         x_bf16 = False
+    if not use_fast:
+        nharm_eff = None  # the complex engine is never band-limited
     return _raw_fit_fn_cached(
         nchan, nbin, flags, max_iter, log10_tau, tau_mode, use_fast,
-        ftname, pallas, x_bf16, redisp, want_flux, use_ir, compensated)
+        ftname, x_bf16, redisp, want_flux, use_ir, compensated,
+        nharm_eff)
 
 
 @lru_cache(maxsize=None)
 def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
-                       tau_mode, use_fast, ftname, pallas, x_bf16,
+                       tau_mode, use_fast, ftname, x_bf16,
                        redisp=False, want_flux=False, use_ir=False,
-                       compensated=False):
+                       compensated=False, nharm_eff=None):
     """ONE jitted program for a raw bucket: int16 decode (scl/offs),
     min-window baseline subtraction, power-spectrum noise, S/N,
     nu_fit seeding, the batched fit, and result packing into a single
@@ -234,9 +237,9 @@ def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
              jnp.broadcast_to(jnp.asarray(alpha0, ft), (nb,))], axis=1)
         nu_out_arr = jnp.broadcast_to(jnp.asarray(nu_out, ft), (nb,))
         if use_fast and not scat_engine:
-            fit = _fast_batch_fn(FitFlags(*flags), max_iter, pallas,
+            fit = _fast_batch_fn(FitFlags(*flags), max_iter,
                                  None, None, 0, 0, seed_derotate=True,
-                                 x_bf16=x_bf16)
+                                 x_bf16=x_bf16, nharm_eff=nharm_eff)
             r = fit(x, modelx, noise, cmask, freqs, Ps, nu_fit,
                     nu_out_arr, theta0)
         elif use_fast:
@@ -250,7 +253,8 @@ def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
             one = _partial(
                 fast_scatter_fit_one, fit_flags=FitFlags(*flags),
                 log10_tau=log10_tau, max_iter=max_iter,
-                compensated=compensated, x_bf16=x_bf16)
+                compensated=compensated, x_bf16=x_bf16,
+                nharm_eff=nharm_eff)
             r = jax.vmap(one, in_axes=(0, None, 0, 0, None, 0, 0, 0, 0,
                                        None, None))(
                 x, modelx, noise, cmask, freqs, Ps, nu_fit,
@@ -340,28 +344,38 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
         else:
             turns = np.zeros((len(idx0), 1))
         ftname = "float32" if use_fast else "float64"
-        # pallas/bf16 config read per call (cache-key args, mirroring
-        # _fast_batch_fn): mid-process config toggles take effect
+        # bf16/compensated config read per call (cache-key args,
+        # mirroring _fast_batch_fn): mid-process toggles take effect
         use_ir = bucket.ir_FT is not None
-        from ..fit.portrait import use_scatter_compensated
+        from ..fit.portrait import (resolve_harmonic_window,
+                                    use_scatter_compensated)
 
+        # the bucket template is host numpy, so the 'auto' harmonic
+        # window derives per bucket layout (fit.portrait) — only the
+        # fast lanes band-limit; the complex engine never does
+        hwin = (resolve_harmonic_window(None, bucket.modelx, bucket.nbin)
+                if use_fast else None)
         fn = _raw_fit_fn(int(raw.shape[1]), bucket.nbin,
                          tuple(bool(f) for f in bucket.flags),
                          int(max_iter), bool(log10_tau), tau_mode,
                          use_fast, ftname,
-                         use_pallas_moments(np.dtype(ftname)),
                          use_bf16_cross_spectrum(), redisp=redisp,
                          want_flux=want_flux, use_ir=use_ir,
-                         compensated=use_scatter_compensated())
+                         compensated=use_scatter_compensated(),
+                         nharm_eff=hwin)
         ft = jnp.float32 if use_fast else jnp.float64
         t_s, t_nu, t_a = tau_args
         modelx, freqs = bucket.modelx, bucket.freqs
         # the response ships as TWO REAL arrays (fit.portrait.
         # split_ir_host); the complex engine reassembles them
-        # device-side inside the program
+        # device-side inside the program.  A band-limited bucket slices
+        # the kernel to the window on the host first.
         from ..fit.portrait import split_ir_host
 
-        ir_r, ir_i = split_ir_host(bucket.ir_FT, ft)
+        ir_src = bucket.ir_FT
+        if use_ir and hwin is not None:
+            ir_src = np.asarray(ir_src)[..., :hwin]
+        ir_r, ir_i = split_ir_host(ir_src, ft)
 
         def dispatch():
             return fn(jnp.asarray(raw), jnp.asarray(scl, ft),
@@ -383,6 +397,10 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                 or bool(np.any(theta0[:, 3] != 0.0))
                 or bucket.ir_FT is not None)
         modelx, freqs = bucket.modelx, bucket.freqs
+        from ..fit.portrait import resolve_harmonic_window
+
+        hwin = (resolve_harmonic_window(None, bucket.modelx, bucket.nbin)
+                if use_fast else None)
 
         def dispatch():
             if use_fast:
@@ -397,7 +415,8 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                     nu_out=nu_ref_DM, theta0=jnp.asarray(theta0, ft),
                     fit_flags=flags, chan_masks=jnp.asarray(masks, ft),
                     max_iter=max_iter, log10_tau=log10_tau,
-                    ir_FT=bucket.ir_FT, use_scatter=scat)
+                    ir_FT=bucket.ir_FT, use_scatter=scat,
+                    harmonic_window=hwin if hwin is not None else False)
             else:
                 r = fit_portrait_batch(
                     jnp.asarray(ports),

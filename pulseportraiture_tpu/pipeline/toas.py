@@ -511,7 +511,10 @@ class GetTOAs:
                 if use_fast:
                     r = fit_portrait_batch_fast(
                         jnp.asarray(ports[idx], jnp.float32),
-                        jnp.asarray(modelx, jnp.float32),
+                        # host numpy template: lets the harmonic-window
+                        # 'auto' derivation see the model's spectrum
+                        # (fit.portrait.resolve_harmonic_window)
+                        np.asarray(modelx, np.float32),
                         jnp.asarray(noise[idx], jnp.float32),
                         jnp.asarray(freqs0, jnp.float32),
                         jnp.asarray(d.Ps[ok][idx], jnp.float32),

@@ -1,7 +1,9 @@
 """Fast-path validation: matmul real DFT parity with numpy's FFT, the
-Pallas harmonic-moment kernel (interpret mode on CPU) against the XLA
-reference forms, and end-to-end fit_portrait_batch_fast parity with the
-complex-arithmetic fit_portrait_batch."""
+XLA harmonic-moment forms against each other, and end-to-end
+fit_portrait_batch_fast parity with the complex-arithmetic
+fit_portrait_batch.  (The Pallas moment kernel this file once covered
+was deleted in round 4 — it measured slower than XLA's fused
+reductions; see benchmarks/BENCHMARKS.md.)"""
 
 import jax
 import jax.numpy as jnp
@@ -11,7 +13,6 @@ import pytest
 from pulseportraiture_tpu.fit import fit_portrait_batch, fit_portrait_batch_fast
 from pulseportraiture_tpu.fit.portrait import _moments_real_xla, _moments_xla
 from pulseportraiture_tpu.ops.fourier import irfft_mm, rfft_mm
-from pulseportraiture_tpu.ops.pallas_kernels import harmonic_moments_real
 from pulseportraiture_tpu.synth import default_test_model, fake_portrait
 
 P = 0.003
@@ -39,37 +40,7 @@ def test_irfft_mm_roundtrip(rng, n):
     assert np.allclose(back, x, atol=1e-11 * n)
 
 
-# --- Pallas moment kernel (interpret mode on CPU) ------------------------
-
-
-@pytest.mark.parametrize("nchan,nharm", [(8, 33), (130, 257), (64, 128)])
-def test_harmonic_moments_match_xla(rng, nchan, nharm):
-    Xr = jnp.asarray(rng.normal(size=(nchan, nharm)), jnp.float32)
-    Xi = jnp.asarray(rng.normal(size=(nchan, nharm)), jnp.float32)
-    t = jnp.asarray(rng.uniform(-0.5, 0.5, nchan), jnp.float32)
-    C, C1, C2 = harmonic_moments_real(Xr, Xi, t)
-    Cx, C1x, C2x = _moments_real_xla(t, Xr, Xi)
-    # identical math, different schedule: f32 sin/cos of large angles
-    # (up to 2 pi t k ~ 1e3 rad) reduce differently between the two,
-    # bounding agreement at ~1e-3 relative; the f64 end-to-end parity
-    # test below pins the math itself
-    for a, b in ((C, Cx), (C1, C1x), (C2, C2x)):
-        tol = 2e-3 * max(1.0, float(jnp.abs(b).max()))
-        assert np.allclose(a, b, atol=tol)
-
-
-def test_harmonic_moments_vmap_flattens(rng):
-    """The custom vmap rule must equal a python loop over the batch."""
-    nb, nchan, nharm = 3, 16, 65
-    Xr = jnp.asarray(rng.normal(size=(nb, nchan, nharm)), jnp.float32)
-    Xi = jnp.asarray(rng.normal(size=(nb, nchan, nharm)), jnp.float32)
-    t = jnp.asarray(rng.uniform(-0.5, 0.5, (nb, nchan)), jnp.float32)
-    Cb, C1b, C2b = jax.vmap(harmonic_moments_real)(Xr, Xi, t)
-    for i in range(nb):
-        C, C1, C2 = harmonic_moments_real(Xr[i], Xi[i], t[i])
-        assert np.allclose(Cb[i], C, rtol=1e-6, atol=1e-4)
-        assert np.allclose(C1b[i], C1, rtol=1e-6, atol=1e-2)
-        assert np.allclose(C2b[i], C2, rtol=1e-6, atol=1.0)
+# --- XLA moment forms ----------------------------------------------------
 
 
 def test_moments_real_vs_complex(rng):
@@ -101,13 +72,10 @@ def _batch(key, nb=4):
     return (jnp.stack(ports), jnp.stack(models), jnp.stack(stds)), phis, dms
 
 
-@pytest.mark.parametrize("pallas", [False, True])
-def test_fast_batch_matches_reference(key, pallas):
+def test_fast_batch_matches_reference(key):
     (ports, models, stds), phis, dms = _batch(key)
     a = fit_portrait_batch(ports, models, stds, FREQS, P, 1500.0)
-    b = fit_portrait_batch_fast(
-        ports, models, stds, FREQS, P, 1500.0, pallas=pallas
-    )
+    b = fit_portrait_batch_fast(ports, models, stds, FREQS, P, 1500.0)
     assert np.allclose(a.phi, b.phi, atol=1e-10)
     assert np.allclose(a.DM, b.DM, atol=1e-10)
     assert np.allclose(a.phi_err, b.phi_err, rtol=1e-6)

@@ -1,0 +1,190 @@
+"""Harmonic-window (band-limited fast fit) validation: the window
+derivation, the knob resolution rules, and parity of the truncated fit
+against the full-spectrum fit (chi2/dof stay full-spectrum via the
+Parseval Sd).  Round-4 feature; reference evaluates all harmonics
+unconditionally (pptoaslib.py:564-614)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.fit import FitFlags
+from pulseportraiture_tpu.fit.portrait import (
+    fit_portrait_batch_fast,
+    model_harmonic_window,
+    resolve_harmonic_window,
+)
+from pulseportraiture_tpu.synth import default_test_model, fake_portrait
+
+P = 0.003
+NCHAN, NBIN = 64, 2048
+FREQS = jnp.asarray(np.linspace(1200.0, 1999.0, NCHAN) + 0.5, jnp.float32)
+
+
+def _data(key, **kw):
+    model = default_test_model(1500.0)
+    kw.setdefault("noise_std", 0.05)
+    kw.setdefault("dtype", jnp.float32)
+    return fake_portrait(key, model, FREQS, NBIN, P, **kw)
+
+
+def test_window_derivation(key):
+    d = _data(key)
+    K = model_harmonic_window(np.asarray(d.model_port), NBIN)
+    assert K is not None and K % 128 == 0
+    assert 128 <= K <= 512  # smooth Gaussian template: narrow support
+    # the window must actually cover the model power to the config tail
+    spec = np.abs(np.fft.rfft(np.asarray(d.model_port), axis=-1)) ** 2
+    tail = spec[:, K:].sum(axis=-1) / spec.sum(axis=-1)
+    assert tail.max() < 1e-12
+
+
+def test_window_white_template_stays_full(rng):
+    white = rng.standard_normal((8, NBIN))
+    assert model_harmonic_window(white, NBIN) is None
+
+
+def test_resolve_rejects_nonpositive_and_bad_strings(key):
+    d = _data(key)
+    mp = np.asarray(d.model_port)
+    with pytest.raises(ValueError):
+        resolve_harmonic_window(0, mp, NBIN)
+    with pytest.raises(ValueError):
+        resolve_harmonic_window(-5, mp, NBIN)
+    with pytest.raises(ValueError):
+        resolve_harmonic_window("Auto", mp, NBIN)
+    # True means 'auto' (enable), never int(True) = K=128
+    assert resolve_harmonic_window(True, mp, NBIN) \
+        == resolve_harmonic_window("auto", mp, NBIN)
+
+
+def test_parseval_sd_survives_baseline_offset(key):
+    """The Parseval Sd uses the mean-removed power form: the naive
+    n*sum(x^2) - X_0^2 cancels catastrophically in f32 at offset >>
+    sigma (3x-wrong power at mu/sigma ~ 5e3), while the mean-removed
+    form tracks the f64 truth.  (At such offsets the FULL-spectrum
+    lane's own f32 spectral Sd degrades too — every dr_k matmul
+    cancels the offset — so the oracle here is f64, not the full
+    lane.)"""
+    from pulseportraiture_tpu.fit.portrait import (_parseval_Sd,
+                                                   make_weights)
+
+    d = _data(key)
+    port = jnp.asarray(np.asarray(d.port) + 500.0, jnp.float32)
+    w = make_weights(d.noise_stds, NBIN, dtype=jnp.float32)
+    got = float(_parseval_Sd(port, w))
+    # f64 truth: one-sided spectral power, DC excluded, same weights
+    spec = np.abs(np.fft.rfft(np.asarray(port, np.float64), axis=-1))**2
+    want = float((np.asarray(w, np.float64)[..., 1:]
+                  * spec[..., 1:]).sum())
+    assert abs(got - want) < 1e-5 * want, (got, want)
+
+
+def test_truncated_fit_parity_with_moderate_offset(key):
+    """Fit-level chi2 parity with a baseline offset within the full
+    lane's own f32 accuracy envelope (~100x the noise)."""
+    d = _data(key, phi=0.04, DM=0.003)
+    port = d.port + 5.0
+    args = (port[None], d.model_port[None], d.noise_stds[None],
+            FREQS, P, 1500.0)
+    rf = fit_portrait_batch_fast(*args, harmonic_window=False)
+    rt = fit_portrait_batch_fast(*args, harmonic_window=256)
+    assert abs(float(rf.phi[0]) - float(rt.phi[0])) < 5e-7
+    assert np.allclose(rf.chi2, rt.chi2, rtol=2e-3), \
+        (float(rf.chi2[0]), float(rt.chi2[0]))
+
+
+def test_window_derivation_batched_model_chunks(key):
+    """3-D batched models derive the same window as their 2-D slices
+    (the chunked host path)."""
+    d = _data(key)
+    mp = np.asarray(d.model_port, np.float32)
+    batched = np.stack([mp] * 5)
+    assert model_harmonic_window(batched, NBIN) \
+        == model_harmonic_window(mp, NBIN)
+
+
+def test_resolve_rules(key):
+    d = _data(key)
+    mp = np.asarray(d.model_port)
+    # config default 'auto': host model derives, device model does not
+    assert resolve_harmonic_window(None, mp, NBIN) is not None
+    assert resolve_harmonic_window(None, d.model_port, NBIN) is None
+    # explicit int is tile-rounded; full-width requests collapse to None
+    assert resolve_harmonic_window(200, None, NBIN) == 256
+    assert resolve_harmonic_window(NBIN // 2 + 1, None, NBIN) is None
+    assert resolve_harmonic_window(False, mp, NBIN) is None
+
+
+def test_truncated_fit_parity(key):
+    """Band-limited fit == full fit to rounding: the estimator is
+    model-weighted, so harmonics beyond the model's support contribute
+    nothing; chi2/dof must still count the full spectrum."""
+    d = _data(key, phi=0.123, DM=0.004)
+    K = model_harmonic_window(np.asarray(d.model_port), NBIN)
+    args = (d.port[None], d.model_port[None], d.noise_stds[None],
+            FREQS, P, 1500.0)
+    rf = fit_portrait_batch_fast(*args, harmonic_window=False)
+    rt = fit_portrait_batch_fast(*args, harmonic_window=K)
+    assert abs(float(rf.phi[0]) - float(rt.phi[0])) < 2e-7
+    assert abs(float(rf.DM[0]) - float(rt.DM[0])) < 1e-7
+    assert np.allclose(rf.phi_err, rt.phi_err, rtol=1e-4)
+    assert np.allclose(rf.DM_err, rt.DM_err, rtol=1e-4)
+    assert np.allclose(rf.snr, rt.snr, rtol=1e-5)
+    # chi2: spectral sum vs time-domain Parseval — same value, both
+    # f32-rounded over ~1e5 terms
+    assert np.allclose(rf.chi2, rt.chi2, rtol=1e-3)
+    assert int(rf.dof[0]) == int(rt.dof[0])
+    # the fit must still recover the injection exactly as well
+    assert abs(float(rt.phi[0]) - 0.123) < 1e-3
+
+
+def test_truncated_scatter_fit_parity(key):
+    """The scattering lane honors the window too (the scattering
+    kernel only multiplies the template spectrum — never widens it —
+    so the unscattered template's window is valid for every tau)."""
+    model = default_test_model(1500.0)
+    true_tau = 2e-4
+    d = fake_portrait(key, model, FREQS, NBIN, P, tau=true_tau,
+                      alpha=-4.0, noise_std=2e-3, dtype=jnp.float32)
+    th0 = np.zeros((1, 5), np.float32)
+    th0[0, 3] = np.log10(0.5 / NBIN)
+    th0[0, 4] = -4.0
+    flags = FitFlags(True, True, False, True, False)
+    kw = dict(fit_flags=flags, theta0=jnp.asarray(th0), log10_tau=True,
+              max_iter=60)
+    args = (d.port[None], d.model_port[None], d.noise_stds[None],
+            FREQS, P, 1500.0)
+    rf = fit_portrait_batch_fast(*args, harmonic_window=False, **kw)
+    rt = fit_portrait_batch_fast(*args, harmonic_window=384, **kw)
+    assert abs(float(rf.tau[0]) - float(rt.tau[0])) \
+        < 2e-4 * float(rf.tau[0])
+    assert abs(float(rf.phi[0]) - float(rt.phi[0])) < 1e-6
+    # chi2 = Sd + f cancels catastrophically in f32 at this extreme
+    # S/N (both lanes report the same noise-dominated value; the tight
+    # chi2 parity check lives in test_truncated_fit_parity at sane
+    # S/N) — only require agreement at the f32 cancellation scale
+    assert np.allclose(rf.chi2, rt.chi2, rtol=2e-2)
+    assert int(rf.dof[0]) == int(rt.dof[0])
+    # recovery against the injection through the windowed lane
+    expect = (true_tau / P) * (float(rt.nu_tau[0]) / 1500.0) ** -4.0
+    assert abs(float(rt.tau[0]) - expect) / expect < 3e-3
+
+
+@pytest.mark.parametrize("masked", [False, True])
+def test_truncated_fit_masked_channels(key, masked):
+    d = _data(key, phi=-0.07, DM=0.002)
+    mask = jnp.ones((1, NCHAN), jnp.float32)
+    if masked:
+        mask = mask.at[:, ::4].set(0.0)
+    args = dict(chan_masks=mask)
+    rf = fit_portrait_batch_fast(
+        d.port[None], d.model_port[None], d.noise_stds[None], FREQS, P,
+        1500.0, harmonic_window=False, **args)
+    rt = fit_portrait_batch_fast(
+        d.port[None], d.model_port[None], d.noise_stds[None], FREQS, P,
+        1500.0, harmonic_window=256, **args)
+    assert abs(float(rf.phi[0]) - float(rt.phi[0])) < 5e-7
+    assert np.allclose(rf.chi2, rt.chi2, rtol=1e-3)
+    assert int(rf.dof[0]) == int(rt.dof[0])
