@@ -81,14 +81,20 @@ class HDU:
     `layout` maps column name -> (byte_offset, tform_code, repeat) so
     callers (the native SUBINT fast path) can decode columns straight
     from the wire bytes; columns listed in a reader's `defer` set are
-    left as None in `data` and must be fetched through these."""
+    left as None in `data` and must be fetched through these.
+    `col_scaling` maps column name -> (TSCAL, TZERO) for every numeric
+    column carrying a nontrivial FITS scaling (e.g. the signed-byte
+    convention 'B' + TZERO=-128); decoded columns have it applied
+    already, deferred columns must apply it themselves."""
 
-    def __init__(self, header, data=None, name="", raw=None, layout=None):
+    def __init__(self, header, data=None, name="", raw=None, layout=None,
+                 col_scaling=None):
         self.header = header
         self.data = data
         self.name = name or header.get("EXTNAME", "")
         self.raw = raw
         self.layout = layout or {}
+        self.col_scaling = col_scaling or {}
 
     @property
     def row_stride(self):
@@ -234,6 +240,17 @@ def _table_dtype(header):
     return names, np.dtype(fields)
 
 
+def apply_column_scaling(col, tscal, tzero):
+    """Physical values TZERO + TSCAL*stored.  Integer columns with an
+    integral pure offset stay integral (the FITS signed/unsigned
+    conventions: 'B'+TZERO=-128 -> signed byte, 'I'+TZERO=32768 ->
+    unsigned 16-bit); anything else promotes to float64."""
+    if col.dtype.kind in "iu" and tscal == 1.0 \
+            and float(tzero).is_integer():
+        return col.astype(np.int64) + int(tzero)
+    return col.astype(np.float64) * tscal + tzero
+
+
 def _data_size(header):
     naxis = header.get("NAXIS", 0)
     if naxis == 0:
@@ -260,10 +277,16 @@ def _read_hdu(buf, off, defer=()):
         rec = np.frombuffer(raw, dtype=dt, count=nrows)
         data = OrderedDict()
         layout = {}
+        col_scaling = {}
         for i, name in enumerate(names):
             fname = f"f{i + 1}"
             repeat, code, _ = parse_tform(str(header[f"TFORM{i + 1}"]))
             layout[name] = (int(dt.fields[fname][1]), code, repeat)
+            tscal = float(header.get(f"TSCAL{i + 1}", 1.0) or 1.0)
+            tzero = float(header.get(f"TZERO{i + 1}", 0.0) or 0.0)
+            scaled = (tscal != 1.0 or tzero != 0.0) and code not in "AX"
+            if scaled:
+                col_scaling[name] = (tscal, tzero)
             if name in defer:
                 data[name] = None
                 continue
@@ -274,8 +297,11 @@ def _read_hdu(buf, off, defer=()):
                 col = col.reshape((nrows,) + shape[::-1])
             if col.dtype.kind in "iufc":
                 col = col.astype(col.dtype.newbyteorder("="))
+            if scaled:
+                col = apply_column_scaling(col, tscal, tzero)
             data[name] = col
-        return HDU(header, data, raw=raw, layout=layout), off
+        return HDU(header, data, raw=raw, layout=layout,
+                   col_scaling=col_scaling), off
     if size and header.get("NAXIS", 0) > 0:
         bitpix = header["BITPIX"]
         dt = {8: "u1", 16: ">i2", 32: ">i4", 64: ">i8",

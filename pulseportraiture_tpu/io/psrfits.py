@@ -221,7 +221,39 @@ class Archive:
                                       * self.nchan))
 
     def get_dispersion_measure(self):
-        return float(self.subint_header.get("DM", 0.0) or 0.0)
+        """Pulsar DM [pc cm^-3]: the SUBINT 'DM' card, falling back to
+        the PSRPARAM ephemeris DM and last to 'CHAN_DM' (a file from a
+        coherent-dedispersion backend may carry only that; note the
+        standard SUBINT template writes CHAN_DM=0.0 unconditionally,
+        so a zero CHAN_DM must never shadow the ephemeris)."""
+        dm = self.subint_header.get("DM")
+        if dm in (None, 0.0, 0, "*"):
+            dm = _param_value(self.psrparam, "DM")
+        if dm in (None, 0.0, 0, "*"):
+            dm = self.subint_header.get("CHAN_DM")
+        try:
+            return float(dm or 0.0)
+        except (TypeError, ValueError):
+            return 0.0
+
+    def get_chan_dm(self):
+        """The 'CHAN_DM' SUBINT card: the DM of the backend's
+        within-channel (coherent) dedispersion — NOT the inter-channel
+        subint rotation that DEDISP records (0 when absent)."""
+        try:
+            return float(self.subint_header.get("CHAN_DM", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            return 0.0
+
+    def dedispersion_ref_freq(self):
+        """Reference frequency of the on-disk inter-channel
+        dedispersion delays: the SUBINT 'REF_FREQ' card when present,
+        else the centre frequency."""
+        try:
+            rf = float(self.subint_header.get("REF_FREQ", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            rf = 0.0
+        return rf if rf > 0.0 else self.get_centre_frequency()
 
     def set_dispersion_measure(self, DM):
         self.subint_header["DM"] = float(DM)
@@ -241,7 +273,21 @@ class Archive:
                     + float(self.primary.get("STT_OFFS", 0.0))) / SECPERDAY)
 
     def epochs(self):
-        """Mid-subint epochs as MJD objects."""
+        """Mid-subint epochs as MJD objects: STT_* + OFFS_SUB.
+
+        The SUBINT 'EPOCHS' convention card is honored: for every
+        convention PSRCHIVE writes ('MIDTIME', 'VALID', 'STT_MJD')
+        OFFS_SUB is the offset of the subint centre from the file
+        start, so the arithmetic is shared — the card records the
+        *phase-alignment* guarantee (whether the polyco was evaluated
+        at these epochs), not a different time base.  An unrecognized
+        convention raises rather than silently misdating TOAs."""
+        conv = str(self.subint_header.get("EPOCHS",
+                                          "MIDTIME")).strip().upper()
+        if conv not in ("", "MIDTIME", "VALID", "STT_MJD"):
+            raise ValueError(
+                f"{self.filename}: unrecognized SUBINT EPOCHS "
+                f"convention {conv!r} (known: MIDTIME, VALID, STT_MJD)")
         t0 = self.start_time()
         return [t0.add_seconds(float(s)) for s in self.offs_subs]
 
@@ -388,6 +434,10 @@ class Archive:
         if not self.get_dedispersed():
             self._rotate_dm(-1.0)
             self.subint_header["DEDISP"] = True
+            # record the reference so dededisperse undoes exactly this
+            # rotation (CHAN_DM is NOT touched — it records the
+            # backend's coherent dedispersion, a different operation)
+            self.subint_header["REF_FREQ"] = self.get_centre_frequency()
 
     def dededisperse(self):
         if self.get_dedispersed():
@@ -397,11 +447,18 @@ class Archive:
     def _rotate_dm(self, sign):
         """sign=-1 removes dispersion delays (dedisperse), +1 restores
         them; reference semantics: rotate_portrait is 'virtually
-        identical to arch.dedisperse()' (reference pplib.py:2526)."""
+        identical to arch.dedisperse()' (reference pplib.py:2526).
+
+        Undoing an on-disk dedispersion (sign=+1) honors the REF_FREQ
+        card (the reference the delays were computed against); the DM
+        is the archive DM in both directions — CHAN_DM records the
+        backend's within-channel coherent dedispersion, a different
+        operation that subint rotation must not conflate."""
         DM = self.get_dispersion_measure()
+        nu0 = (self.dedispersion_ref_freq() if sign > 0
+               else self.get_centre_frequency())
         if DM == 0.0:
             return
-        nu0 = self.get_centre_frequency()
         Ps = self.folding_periods()
         for isub in range(self.nsub):
             delays = dm_delays(DM, Ps[isub], self.freqs_table[isub], nu0)
@@ -514,6 +571,15 @@ def read_archive(path, dtype=np.float64, decode=True):
     defer = ("DATA",) if (use_native or not decode) else ()
     hdus = fitsio.read_fits(path, defer=defer)
     primary = hdus[0].header
+    obs_mode = str(primary.get("OBS_MODE", "PSR")).strip().upper()
+    if obs_mode in ("SEARCH", "SRCH"):
+        # a SEARCH-mode SUBINT table holds unfolded filterbank samples
+        # (NSBLK time samples per row, no PERIOD) — silently misparsing
+        # it as folded profiles would produce garbage TOAs
+        raise ValueError(
+            f"{path}: OBS_MODE={obs_mode} is a search-mode PSRFITS "
+            "file (unfolded time samples); fold it first (e.g. with "
+            "dspsr) — only fold-mode archives carry profiles to time")
     try:
         subint = fitsio.get_hdu(hdus, "SUBINT")
     except KeyError:
@@ -530,16 +596,21 @@ def read_archive(path, dtype=np.float64, decode=True):
                                np.zeros((nsub, npol * nchan))),
                       np.float64).reshape(nsub, npol, nchan)
     _SAMP_BYTES = {"I": 2, "B": 1, "E": 4}
+    # a FITS-scaled DATA column (TSCAL/TZERO — e.g. the signed-byte
+    # convention) must go through the scaling-aware numpy path: the
+    # raw int16 transport and the native kernel read stored values
+    data_scaling = subint.col_scaling.get("DATA")
     raw_data = None
     if not decode:
         col_off, code, repeat = subint.layout["DATA"]
         nbin = int(hdr.get("NBIN", 0)) or repeat // (npol * nchan)
         if (code != "I" or npol * nchan * nbin != repeat
+                or data_scaling is not None
                 or col_off + repeat * 2 > subint.row_stride
                 or len(subint.raw) < nsub * subint.row_stride):
             raise ValueError(
-                f"{path}: raw streaming mode needs a consistent int16 "
-                "DATA column")
+                f"{path}: raw streaming mode needs a consistent "
+                "unscaled int16 DATA column")
         rows = np.frombuffer(subint.raw, np.uint8)[
             : nsub * subint.row_stride].reshape(nsub, subint.row_stride)
         col = np.ascontiguousarray(rows[:, col_off:col_off + repeat * 2])
@@ -557,6 +628,7 @@ def read_archive(path, dtype=np.float64, decode=True):
         # reshape does, not read past the column)
         consistent = (
             samp is not None
+            and data_scaling is None
             and npol * nchan * nbin == repeat
             and col_off + repeat * samp <= subint.row_stride
             and len(subint.raw) >= nsub * subint.row_stride
@@ -579,6 +651,8 @@ def read_archive(path, dtype=np.float64, decode=True):
                 : nsub * subint.row_stride].reshape(nsub, subint.row_stride)
             col = np.ascontiguousarray(
                 rows[:, col_off:col_off + width]).view(samp_dt)
+            if data_scaling is not None:
+                col = fitsio.apply_column_scaling(col, *data_scaling)
             cols["DATA"] = col.astype(dtype)
         nbin = int(hdr.get("NBIN", 0)) or cols["DATA"].shape[-1]
         data_col = np.asarray(cols["DATA"])

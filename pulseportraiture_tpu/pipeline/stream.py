@@ -77,12 +77,17 @@ class _Bucket:
 
     def __init__(self, freqs, nbin, modelx, flags, kind="dec",
                  ir_FT=None):
+        from ..fit.portrait import resolve_harmonic_window
+
         self.freqs = freqs          # (nchan,)
         self.nbin = int(nbin)
         self.modelx = modelx        # (nchan, nbin) template
         self.flags = flags          # effective FitFlags tuple
         self.kind = kind
         self.ir_FT = ir_FT          # (nchan, nharm) complex or None
+        # derived once per bucket (a host rfft of the template costs
+        # ~10 ms — not per-dispatch work); fast lanes only
+        self.hwin = resolve_harmonic_window(None, modelx, self.nbin)
         self.ports = []             # 'dec': (nchan, nbin) float
         self.raw = []               # 'raw': (nchan, nbin) int16
         self.scl = []               # 'raw': (nchan,) f32
@@ -137,6 +142,7 @@ def _load_raw(f):
         doppler_factors=arch.doppler_factors(),
         DM=arch.get_dispersion_measure(),
         dmc=bool(arch.get_dedispersed()),
+        dedisp_nu=arch.dedispersion_ref_freq(),
         nu0=arch.get_centre_frequency(), bw=arch.get_bandwidth(),
         backend=arch.get_backend_name(),
         frontend=arch.get_receiver_name(),
@@ -347,14 +353,11 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
         # bf16/compensated config read per call (cache-key args,
         # mirroring _fast_batch_fn): mid-process toggles take effect
         use_ir = bucket.ir_FT is not None
-        from ..fit.portrait import (resolve_harmonic_window,
-                                    use_scatter_compensated)
+        from ..fit.portrait import use_scatter_compensated
 
-        # the bucket template is host numpy, so the 'auto' harmonic
-        # window derives per bucket layout (fit.portrait) — only the
-        # fast lanes band-limit; the complex engine never does
-        hwin = (resolve_harmonic_window(None, bucket.modelx, bucket.nbin)
-                if use_fast else None)
+        # per-bucket cached window (fit.portrait) — only the fast
+        # lanes band-limit; the complex engine never does
+        hwin = bucket.hwin if use_fast else None
         fn = _raw_fit_fn(int(raw.shape[1]), bucket.nbin,
                          tuple(bool(f) for f in bucket.flags),
                          int(max_iter), bool(log10_tau), tau_mode,
@@ -397,10 +400,7 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                 or bool(np.any(theta0[:, 3] != 0.0))
                 or bucket.ir_FT is not None)
         modelx, freqs = bucket.modelx, bucket.freqs
-        from ..fit.portrait import resolve_harmonic_window
-
-        hwin = (resolve_harmonic_window(None, bucket.modelx, bucket.nbin)
-                if use_fast else None)
+        hwin = bucket.hwin if use_fast else None
 
         def dispatch():
             if use_fast:
@@ -809,8 +809,11 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                     b.DM_guess.append(DM_guess)
                     # dedispersed-on-disk: the device program restores
                     # the stored DM's delays before fitting
-                    b.dedisp.append((DM_stored if d.get("dmc") else 0.0,
-                                     float(d.get("nu0", 0.0) or 0.0)))
+                    # reference frequency honors the REF_FREQ card
+                    b.dedisp.append(
+                        (DM_stored if d.get("dmc") else 0.0,
+                         float(d.get("dedisp_nu")
+                               or d.get("nu0", 0.0) or 0.0)))
                 else:
                     th = np.zeros(5)
                     th[1] = DM_guess
@@ -1192,8 +1195,11 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
                     b.raw.append(d.raw[isub])
                     b.scl.append(d.scl[isub])
                     b.offs.append(d.offs[isub])
-                    b.dedisp.append((DM_stored if d.get("dmc") else 0.0,
-                                     float(d.get("nu0", 0.0) or 0.0)))
+                    # reference frequency honors the REF_FREQ card
+                    b.dedisp.append(
+                        (DM_stored if d.get("dmc") else 0.0,
+                         float(d.get("dedisp_nu")
+                               or d.get("nu0", 0.0) or 0.0)))
                 else:
                     b.ports.append(np.asarray(d.subints[isub, 0]))
                     b.noise.append(np.asarray(d.noise_stds[isub, 0],

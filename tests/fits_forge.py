@@ -53,12 +53,17 @@ _CODE = {np.dtype("u1"): "B", np.dtype(">i2"): "I", np.dtype(">i4"): "J",
          np.dtype(">f4"): "E", np.dtype(">f8"): "D"}
 
 
-def bintable_hdu(extname, columns, extra_cards=(), tdim_overrides=None):
+def bintable_hdu(extname, columns, extra_cards=(), tdim_overrides=None,
+                 col_cards=None):
     """columns: list of (name, big-endian ndarray shaped (nrows, ...)).
     tdim_overrides: {name: literal TDIM string} to test alien
     spellings; by default no TDIM card is written (readers must fall
-    back to the header NCHAN/NPOL/NBIN geometry)."""
+    back to the header NCHAN/NPOL/NBIN geometry).
+    col_cards: {name: {cardbase: value}} writes per-column indexed
+    cards, e.g. {'DATA': {'TZERO': -128.0}} -> TZEROn (the FITS
+    signed-byte convention)."""
     tdim_overrides = tdim_overrides or {}
+    col_cards = col_cards or {}
     nrows = len(columns[0][1])
     cards = []
     fields = []
@@ -79,6 +84,8 @@ def bintable_hdu(extname, columns, extra_cards=(), tdim_overrides=None):
         cards.append(_card(f"TFORM{i}", code))
         if name in tdim_overrides:
             cards.append(_card(f"TDIM{i}", tdim_overrides[name]))
+        for base, val in col_cards.get(name, {}).items():
+            cards.append(_card(f"{base}{i}", val))
         fields.append((name, arr))
         stride += width
     head = [_card("XTENSION", "BINTABLE"), _card("BITPIX", 8),
@@ -116,15 +123,22 @@ def forge_archive(path, nsub=2, nchan=8, nbin=64, npol=1,
                   data_dtype=">i2", with_wts=True, with_scl_offs=True,
                   tdim_style=None, ragged_freqs=False, freq0=1400.0,
                   chan_bw=25.0, period=0.005, dm=12.5, dedisp=0,
-                  polyco_rows=0, extra_primary=(), src="FORGE"):
+                  polyco_rows=0, extra_primary=(), src="FORGE",
+                  extra_subint_cards=(), omit_dm_card=False):
     """Write a hand-forged PSRFITS fold-mode archive and return the
     float64 data cube a correct loader should produce (after DAT_SCL /
     DAT_OFFS application, before any baseline removal).
 
     data_maker(isub, ipol) -> (nchan, nbin) float array of TRUE values.
     data_dtype: '>i2' (scaled int16), 'u1' (scaled unsigned byte),
-    '>f4' (float samples, unit scale), or 'nbit1'/'nbit2'/'nbit4'
-    (sub-byte packed unsigned samples, MSB-first, NBIT card written).
+    'i1' (SIGNED byte via the FITS TZERO=-128 convention — stored
+    unsigned, physical = stored - 128), '>f4' (float samples, unit
+    scale), or 'nbit1'/'nbit2'/'nbit4' (sub-byte packed unsigned
+    samples, MSB-first, NBIT card written).
+    extra_subint_cards: appended to the SUBINT header (CHAN_DM,
+    REF_FREQ, EPOCHS, ...).  omit_dm_card drops the SUBINT DM card so
+    fallback chains (CHAN_DM, PSRPARAM) are exercised.
+    chan_bw < 0 forges a descending-frequency band (OBSBW negative).
     """
     rng = np.random.default_rng(7)
     if data_maker is None:
@@ -139,6 +153,9 @@ def forge_archive(path, nsub=2, nchan=8, nbin=64, npol=1,
             true[s, p] = data_maker(s, p)
 
     nbit = None
+    signed_byte = str(data_dtype) == "i1"
+    if signed_byte:
+        data_dtype = "u1"  # stored unsigned; TZERO=-128 restores sign
     if str(data_dtype).startswith("nbit"):
         nbit = int(str(data_dtype)[4:])
         data_dtype = "u1"
@@ -172,6 +189,18 @@ def forge_archive(path, nsub=2, nchan=8, nbin=64, npol=1,
     elif dt.kind == "f":
         data[:] = true.astype(dt)
         stored = data.astype(np.float64)
+    elif signed_byte:
+        # physical sample values span [-120, 120]; stored = phys + 128
+        lo = true.min(axis=-1)
+        hi = true.max(axis=-1)
+        s_ = np.maximum((hi - lo) / 240.0, 1e-12)
+        o_ = (hi + lo) / 2.0
+        q = np.clip(np.round((true - o_[..., None]) / s_[..., None]),
+                    -120, 120)
+        data[:] = (q + 128).astype(dt)
+        scl[:] = s_.astype(">f4")
+        offs[:] = o_.astype(">f4")
+        stored = q.astype(np.float64) * s_[..., None] + o_[..., None]
     else:
         lo = true.min(axis=-1)
         hi = true.max(axis=-1)
@@ -216,9 +245,12 @@ def forge_archive(path, nsub=2, nchan=8, nbin=64, npol=1,
         tdims["DATA"] = f"({nbin},{nchan},{npol})"
 
     sub_cards = [("NCHAN", nchan), ("NPOL", npol), ("NBIN", nbin),
-                 ("POL_TYPE", pol_type), ("DM", dm),
+                 ("POL_TYPE", pol_type),
                  ("CHAN_BW", chan_bw), ("DEDISP", dedisp),
                  ("TBIN", period / nbin)]
+    if not omit_dm_card:
+        sub_cards.insert(4, ("DM", dm))
+    sub_cards += list(extra_subint_cards)
     if nbit:
         sub_cards.append(("NBIT", nbit))
     prim = [("TELESCOP", "GBT"), ("SRC_NAME", src),
@@ -231,9 +263,10 @@ def forge_archive(path, nsub=2, nchan=8, nbin=64, npol=1,
         prim.append(("FD_POLN", fd_poln))
     prim += list(extra_primary)
 
+    ccards = {"DATA": {"TZERO": -128.0}} if signed_byte else None
     blobs = [primary_hdu(prim),
              bintable_hdu("SUBINT", cols, extra_cards=sub_cards,
-                          tdim_overrides=tdims)]
+                          tdim_overrides=tdims, col_cards=ccards)]
     if polyco_rows:
         # multi-row POLYCO: blocks at successive epochs, constant spin
         f0 = 1.0 / period
@@ -251,3 +284,27 @@ def forge_archive(path, nsub=2, nchan=8, nbin=64, npol=1,
         for b in blobs:
             f.write(b)
     return stored, freqs
+
+
+def forge_search_mode(path, nchan=8, nsblk=128):
+    """A minimal SEARCH-mode PSRFITS file: OBS_MODE=SEARCH, a SUBINT
+    table of unfolded filterbank sample blocks (NSBLK time samples per
+    row, TBIN sampling, no PERIOD/NBIN fold structure).  Loaders must
+    REFUSE it with a clear error, not misparse the samples as folded
+    profiles."""
+    nrows = 2
+    data = np.zeros((nrows, nsblk * nchan), "u1")
+    cols = [("TSUBINT", np.full(nrows, nsblk * 64e-6, ">f8")),
+            ("OFFS_SUB", np.arange(nrows).astype(">f8")),
+            ("DAT_FREQ", np.tile(1400.0 + 25.0 * np.arange(nchan),
+                                 (nrows, 1)).astype(">f8")),
+            ("DATA", data)]
+    sub = [("NCHAN", nchan), ("NPOL", 1), ("NBIT", 8),
+           ("NSBLK", nsblk), ("TBIN", 64e-6), ("CHAN_BW", 25.0)]
+    prim = [("TELESCOP", "GBT"), ("SRC_NAME", "FORGE"),
+            ("OBS_MODE", "SEARCH"), ("OBSFREQ", 1487.5),
+            ("OBSBW", 200.0), ("STT_IMJD", 55000), ("STT_SMJD", 0),
+            ("STT_OFFS", 0.0)]
+    with open(path, "wb") as f:
+        f.write(primary_hdu(prim))
+        f.write(bintable_hdu("SUBINT", cols, extra_cards=sub))
