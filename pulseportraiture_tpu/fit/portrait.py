@@ -263,6 +263,35 @@ def resolve_harmonic_window(harmonic_window, models, nbin):
     return K if K < nbin // 2 + 1 else None
 
 
+# Calibrated channel-S/N envelope of the bf16 cross-spectrum default:
+# the |dphi| gate and the error-calibration tests hold at bench noise
+# (channel S/N ~ 1.4e3); above ~2x that the ~4e-3 per-term bf16
+# quantization can rival the noise floor (benchmarks/BENCHMARKS.md).
+BF16_CALIBRATED_CHANNEL_SNR = 3.0e3
+_bf16_snr_warned = [False]
+
+
+def warn_bf16_high_snr(max_channel_snr, quiet=False):
+    """One-line, once-per-process warning when the bf16 cross-spectrum
+    storage default is active and a fit's channel S/N exceeds the
+    regime the calibration tests cover — the knob's failure mode is
+    documented (GUIDE.md), but users who never read it deserve a
+    runtime signal.  Returns True when the warning fired."""
+    import math
+
+    if (_bf16_snr_warned[0] or not use_bf16_cross_spectrum()
+            or not math.isfinite(max_channel_snr)
+            or max_channel_snr <= BF16_CALIBRATED_CHANNEL_SNR):
+        return False
+    _bf16_snr_warned[0] = True
+    if not quiet:
+        print(f"Warning: channel S/N {max_channel_snr:.0f} exceeds the "
+              f"bf16 cross-spectrum calibrated regime "
+              f"(~{BF16_CALIBRATED_CHANNEL_SNR:.0f}); consider "
+              "config.cross_spectrum_dtype = None for this data")
+    return True
+
+
 def effective_x_bf16(compensated, x_bf16=None):
     """The bf16 cross-spectrum storage flag *actually in effect* for a
     scattering program: compensated mode forces f32 X, so the bf16 knob

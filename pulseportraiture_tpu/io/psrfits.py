@@ -226,6 +226,9 @@ class Archive:
         coherent-dedispersion backend may carry only that; note the
         standard SUBINT template writes CHAN_DM=0.0 unconditionally,
         so a zero CHAN_DM must never shadow the ephemeris)."""
+        dm = getattr(self, "_dm_override", None)
+        if dm is not None:
+            return dm
         dm = self.subint_header.get("DM")
         if dm in (None, 0.0, 0, "*"):
             dm = _param_value(self.psrparam, "DM")
@@ -256,6 +259,11 @@ class Archive:
         return rf if rf > 0.0 else self.get_centre_frequency()
 
     def set_dispersion_measure(self, DM):
+        # the in-memory override makes set(0.0)/get round-trip exactly
+        # (a 0.0 DM *card* alone is ambiguous on real files — the
+        # standard template writes it unset-as-zero — so the card
+        # fallback chain above treats it as missing)
+        self._dm_override = float(DM)
         self.subint_header["DM"] = float(DM)
 
     def get_dedispersed(self):

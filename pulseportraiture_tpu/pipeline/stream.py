@@ -77,17 +77,14 @@ class _Bucket:
 
     def __init__(self, freqs, nbin, modelx, flags, kind="dec",
                  ir_FT=None):
-        from ..fit.portrait import resolve_harmonic_window
-
         self.freqs = freqs          # (nchan,)
         self.nbin = int(nbin)
         self.modelx = modelx        # (nchan, nbin) template
         self.flags = flags          # effective FitFlags tuple
         self.kind = kind
         self.ir_FT = ir_FT          # (nchan, nharm) complex or None
-        # derived once per bucket (a host rfft of the template costs
-        # ~10 ms — not per-dispatch work); fast lanes only
-        self.hwin = resolve_harmonic_window(None, modelx, self.nbin)
+        self._hwin = None
+        self._hwin_key = object()   # never equals a config value
         self.ports = []             # 'dec': (nchan, nbin) float
         self.raw = []               # 'raw': (nchan, nbin) int16
         self.scl = []               # 'raw': (nchan,) f32
@@ -100,6 +97,22 @@ class _Bucket:
         self.theta0 = []            # 'dec': each (5,)
         self.DM_guess = []          # 'raw': scalar per subint
         self.owners = []            # (archive_index, isub)
+
+    def harmonic_window(self):
+        """Per-bucket memoized harmonic window: the ~10 ms host rfft
+        of the template runs once per bucket per knob value — not per
+        dispatch, and not at all for complex-engine-only runs (only
+        the fast lanes call this) — while mid-run config toggles still
+        take effect (the memo keys on the knob)."""
+        from .. import config
+        from ..fit.portrait import resolve_harmonic_window
+
+        key = getattr(config, "fit_harmonic_window", None)
+        if key != self._hwin_key:
+            self._hwin = resolve_harmonic_window(None, self.modelx,
+                                                 self.nbin)
+            self._hwin_key = key
+        return self._hwin
 
     def __len__(self):
         return len(self.owners)
@@ -355,9 +368,9 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
         use_ir = bucket.ir_FT is not None
         from ..fit.portrait import use_scatter_compensated
 
-        # per-bucket cached window (fit.portrait) — only the fast
+        # per-bucket memoized window (fit.portrait) — only the fast
         # lanes band-limit; the complex engine never does
-        hwin = bucket.hwin if use_fast else None
+        hwin = bucket.harmonic_window() if use_fast else None
         fn = _raw_fit_fn(int(raw.shape[1]), bucket.nbin,
                          tuple(bool(f) for f in bucket.flags),
                          int(max_iter), bool(log10_tau), tau_mode,
@@ -400,7 +413,7 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                 or bool(np.any(theta0[:, 3] != 0.0))
                 or bucket.ir_FT is not None)
         modelx, freqs = bucket.modelx, bucket.freqs
-        hwin = bucket.hwin if use_fast else None
+        hwin = bucket.harmonic_window() if use_fast else None
 
         def dispatch():
             if use_fast:
@@ -493,7 +506,7 @@ def _assemble_archive(m, results, modelfile, fit_DM, bary,
                       addtnl_toa_flags, log10_tau=False,
                       alpha_fitted=False, nu_ref_tau=None,
                       fit_GM=False, print_flux=False,
-                      print_phase=False):
+                      print_phase=False, quiet=False):
     """Build the TOA objects + DeltaDM stats for one archive from the
     scattered fit results."""
     toas, dDMs, dDM_errs = [], [], []
@@ -531,6 +544,12 @@ def _assemble_archive(m, results, modelfile, fit_DM, bary,
             "tmplt": str(modelfile), "snr": float(r["snr"]),
             "gof": float(r["chi2"] / max(float(r["dof"]), 1.0)),
         })
+        # bf16 guard rail: the packed result carries only the total
+        # S/N, so estimate per-channel as snr/sqrt(nchan) (an
+        # underestimate — never a false warning)
+        from ..fit.portrait import warn_bf16_high_snr
+        warn_bf16_high_snr(float(r["snr"]) / max(m.nchan, 1) ** 0.5,
+                           quiet=quiet)
         if print_phase:
             flags["phs"] = phi
             flags["phs_err"] = float(r["phi_err"])
@@ -690,7 +709,8 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                     addtnl_toa_flags, log10_tau=log10_tau,
                     alpha_fitted=fit_scat and not fix_alpha,
                     nu_ref_tau=nu_ref_tau, fit_GM=fit_GM,
-                    print_flux=print_flux, print_phase=print_phase)
+                    print_flux=print_flux, print_phase=print_phase,
+                    quiet=quiet)
                 assembled[ia] = out
                 # the per-subint records are folded into the assembly;
                 # dropping them keeps host memory O(bucket)
@@ -848,7 +868,7 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
             m, results, modelfile, fit_DM, bary, addtnl_toa_flags,
             log10_tau=log10_tau, alpha_fitted=fit_scat and not fix_alpha,
             nu_ref_tau=nu_ref_tau, fit_GM=fit_GM, print_flux=print_flux,
-            print_phase=print_phase)
+            print_phase=print_phase, quiet=quiet)
         TOA_list.extend(toas)
         order.append(m.datafile)
         DM0s.append(m.DM0_arch)

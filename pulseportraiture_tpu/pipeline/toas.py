@@ -568,6 +568,14 @@ class GetTOAs:
                 channel_snrs_arr[idx] = r["channel_snrs"] * masks[idx]
                 covs[idx] = r["covariance"]
 
+            # guard rail for the bf16 cross-spectrum default: warn
+            # (once per process) when this archive's channel S/N
+            # leaves the calibrated regime
+            from ..fit.portrait import warn_bf16_high_snr
+            with np.errstate(invalid="ignore"):
+                warn_bf16_high_snr(float(np.nanmax(
+                    channel_snrs_arr, initial=0.0)), quiet=quiet)
+
             # user-requested tau output reference (reference -nu_tau;
             # None keeps each fit's zero-covariance frequency)
             if fit_scat and nu_ref_tau is not None:

@@ -45,6 +45,7 @@ class WidebandGLSResult:
     chi2: float
     dof: int
     wrms_us: float
+    n_dropped_no_dm: int = 0     # input TOAs without -pp_dm/-pp_dme
 
     @property
     def red_chi2(self):
@@ -67,7 +68,7 @@ def _group_epochs(mjds, gap_days=0.5):
 
 
 def wideband_gls_fit(toas, par, fit_f0=True, fit_f1=False,
-                     epoch_gap_days=0.5):
+                     epoch_gap_days=0.5, allow_wraps=False):
     """Fit (phase offset[, dF0[, dF1]], DMX per epoch) to wideband TOAs.
 
     toas: list of timing.tim.TimTOA (needs frequency, mjd, error_us,
@@ -76,7 +77,20 @@ def wideband_gls_fit(toas, par, fit_f0=True, fit_f1=False,
 
     Returns WidebandGLSResult; DM measurements and arrival times are
     fit jointly (DMDATA-1 style), with the model DM at each TOA =
-    par DM + DMX[epoch]."""
+    par DM + DMX[epoch].
+
+    TOAs lacking wideband DM measurements cannot enter the DMDATA
+    system; they are dropped with a warning and counted in the
+    result's n_dropped_no_dm (they used to vanish silently).
+
+    Phase connection is validated: each prefit residual is wrapped to
+    the nearest turn independently, which is only meaningful when the
+    ephemeris predicts phase to well under half a turn across the
+    campaign.  If the wrapped residuals of time-adjacent TOAs jump by
+    more than half a turn, the pulse numbering is ambiguous and the
+    fit would silently time a wrapped alias — that raises unless
+    allow_wraps=True (for callers who accept per-TOA wrapping, e.g.
+    offset-only fits on scrambled data)."""
     def fget(key, default=None):
         v = par.get(key, default)
         return float(str(v).replace("D", "E")) if v is not None else None
@@ -92,8 +106,17 @@ def wideband_gls_fit(toas, par, fit_f0=True, fit_f1=False,
             "parameter is required")
     DM0 = fget("DM", 0.0)
 
+    n_in = len(toas)
     toas = [t for t in toas if t.dm is not None and t.dm_err]
     n = len(toas)
+    n_dropped = n_in - n
+    if n_dropped:
+        import warnings
+
+        warnings.warn(
+            f"wideband_gls_fit: dropped {n_dropped} of {n_in} TOAs "
+            "without -pp_dm/-pp_dme wideband DM flags (they cannot "
+            "enter the DMDATA system)", stacklevel=2)
     if n < 2:
         raise ValueError("wideband GLS needs >= 2 TOAs with -pp_dm")
     freqs = np.array([t.frequency for t in toas])
@@ -138,6 +161,29 @@ def wideband_gls_fit(toas, par, fit_f0=True, fit_f1=False,
     phase_rem = F0 * ((mjd_f - (PEPOCH - pep_i)) * SECPERDAY - disp_s)
     phase = phase_day + phase_rem
     dphase = phase - np.round(phase)
+    # phase-connection validation.  Nearest-turn wrapping is only valid
+    # when every TRUE residual phase sits inside a +-0.5-turn window
+    # around a common offset (the OFFSET parameter absorbs the mean).
+    # The observable, rotation-invariant symptom of lost connection is
+    # the OCCUPIED CIRCULAR ARC of the prefit residuals: residuals of
+    # a connected campaign cluster (any cluster position is fine —
+    # a constant offset at the +-0.5 boundary must NOT false-fire),
+    # while a drifting-F0 campaign smears them over the circle.  When
+    # more than half the circle is occupied no single wrap window can
+    # contain the data and the fit would silently time wrapped
+    # aliases.
+    if not allow_wraps and n > 1:
+        s = np.sort(dphase)
+        largest_gap = max(float(np.diff(s).max(initial=0.0)),
+                          1.0 - float(s[-1] - s[0]))
+        occupied = 1.0 - largest_gap
+        if occupied > 0.5:
+            raise ValueError(
+                "wideband_gls_fit: prefit phase residuals occupy "
+                f"{occupied:.2f} turns of the phase circle — phase "
+                "connection is lost and the nearest-turn wrap would "
+                "silently time wrapped aliases.  Improve F0/F1 (or "
+                "pass allow_wraps=True to accept per-TOA wrapping).")
     r_t = dphase / F0  # seconds
 
     # design matrix, time rows: d(model delay)/d(param) in seconds
@@ -198,4 +244,5 @@ def wideband_gls_fit(toas, par, fit_f0=True, fit_f1=False,
         time_resids_us=post_t * 1e6, prefit_resids_us=r_t * 1e6,
         dm_resids=post_d, toa_errs_us=errs_us, dm_errs=dm_errs,
         epochs=epochs, dmx=x[len(names):], dmx_errs=perr[len(names):],
-        chi2=chi2, dof=dof, wrms_us=float(wrms))
+        chi2=chi2, dof=dof, wrms_us=float(wrms),
+        n_dropped_no_dm=n_dropped)

@@ -650,3 +650,37 @@ def test_complex_engine_compensated_ftol(key):
         expect = (true_tau / P) * (nu_tau / 1500.0) ** -4.0
         rels.append((float(r.tau[0]) - expect) / expect)
     assert np.abs(np.asarray(rels)).max() < 1.6e-4, rels
+
+
+def test_bf16_snr_guard_rail(capsys):
+    """The bf16 cross-spectrum default warns (once) when a fit's
+    channel S/N leaves the calibrated regime, and stays silent inside
+    it or when bf16 storage is off (VERDICT r3 weak #5)."""
+    from pulseportraiture_tpu import config
+    from pulseportraiture_tpu.fit.portrait import (
+        BF16_CALIBRATED_CHANNEL_SNR, _bf16_snr_warned,
+        warn_bf16_high_snr)
+
+    old = config.cross_spectrum_dtype
+    try:
+        config.cross_spectrum_dtype = "bfloat16"
+        _bf16_snr_warned[0] = False
+        # inside the calibrated regime: silent
+        assert not warn_bf16_high_snr(0.5 * BF16_CALIBRATED_CHANNEL_SNR)
+        # outside: fires once, prints the knob to flip
+        assert warn_bf16_high_snr(10 * BF16_CALIBRATED_CHANNEL_SNR)
+        assert "cross_spectrum_dtype" in capsys.readouterr().out
+        # latched: no repeat spam
+        assert not warn_bf16_high_snr(10 * BF16_CALIBRATED_CHANNEL_SNR)
+        # quiet mode fires (returns True) without printing
+        _bf16_snr_warned[0] = False
+        assert warn_bf16_high_snr(10 * BF16_CALIBRATED_CHANNEL_SNR,
+                                  quiet=True)
+        assert capsys.readouterr().out == ""
+        # bf16 off: never fires
+        _bf16_snr_warned[0] = False
+        config.cross_spectrum_dtype = None
+        assert not warn_bf16_high_snr(10 * BF16_CALIBRATED_CHANNEL_SNR)
+    finally:
+        config.cross_spectrum_dtype = old
+        _bf16_snr_warned[0] = False

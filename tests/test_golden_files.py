@@ -365,3 +365,18 @@ def test_search_mode_rejected(tmp_path):
         read_archive(p)
     with pytest.raises(ValueError, match="fold"):
         load_data(p, quiet=True)
+
+
+def test_set_dispersion_measure_zero_round_trips(tmp_path):
+    """set_dispersion_measure(0.0) must stick on the live object even
+    when a PSRPARAM/CHAN_DM fallback exists — dedisperse() after
+    zeroing stays a no-op."""
+    p = str(tmp_path / "dm0.fits")
+    forge_archive(p, dm=12.5)
+    arch = read_archive(p)
+    assert arch.get_dispersion_measure() == pytest.approx(12.5)
+    before = np.asarray(arch.amps).copy()
+    arch.set_dispersion_measure(0.0)
+    assert arch.get_dispersion_measure() == 0.0
+    arch.dedisperse()
+    np.testing.assert_array_equal(np.asarray(arch.amps), before)
