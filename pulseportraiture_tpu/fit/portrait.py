@@ -283,12 +283,15 @@ def warn_bf16_high_snr(max_channel_snr, quiet=False):
             or not math.isfinite(max_channel_snr)
             or max_channel_snr <= BF16_CALIBRATED_CHANNEL_SNR):
         return False
+    if quiet:
+        # a quiet caller must not consume the single warning: a later
+        # non-quiet run on the same hot data still deserves it
+        return True
     _bf16_snr_warned[0] = True
-    if not quiet:
-        print(f"Warning: channel S/N {max_channel_snr:.0f} exceeds the "
-              f"bf16 cross-spectrum calibrated regime "
-              f"(~{BF16_CALIBRATED_CHANNEL_SNR:.0f}); consider "
-              "config.cross_spectrum_dtype = None for this data")
+    print(f"Warning: channel S/N {max_channel_snr:.0f} exceeds the "
+          f"bf16 cross-spectrum calibrated regime "
+          f"(~{BF16_CALIBRATED_CHANNEL_SNR:.0f}); consider "
+          "config.cross_spectrum_dtype = None for this data")
     return True
 
 

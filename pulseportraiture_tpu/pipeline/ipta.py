@@ -65,7 +65,7 @@ class IPTAJob:
 
 
 def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
-                         quiet=False, **stream_kwargs):
+                         quiet=False, resume=False, **stream_kwargs):
     """Measure wideband TOAs for a multi-pulsar campaign.
 
     jobs: sequence of IPTAJob (or (pulsar, datafiles, modelfile)
@@ -76,6 +76,17 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
     single process it is a no-op.  stream_kwargs: campaign-wide
     defaults forwarded to every stream_wideband_TOAs call (per-job
     kwargs override them).
+
+    resume=True makes the campaign ELASTIC: every existing checkpoint
+    shard for a pulsar (``<pulsar>*.tim`` in outdir, from any previous
+    process layout — a killed worker's shard included) is scanned for
+    per-archive completion sentinels; partial tails are dropped
+    (process 0 sanitizes shards no current process owns, each process
+    its own) and only archives not yet recorded complete ANYWHERE are
+    measured.  Re-entering after a worker death — with any process
+    count — therefore finishes exactly the missing archives, and the
+    union of the .tim shards equals an uninterrupted run's lines.
+    Requires outdir.
 
     Returns a DataBunch with:
       pulsars     — job order (all jobs, even if this host's shard of
@@ -94,6 +105,9 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
     names = [j.pulsar for j in jobs]
     if len(set(names)) != len(names):
         raise ValueError(f"duplicate pulsar names in jobs: {names}")
+    if resume and not outdir:
+        raise ValueError("stream_ipta_campaign: resume=True needs "
+                         "outdir (the checkpoints live there)")
     if outdir:
         os.makedirs(outdir, exist_ok=True)
 
@@ -105,6 +119,39 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
     for psr, f in mine:
         by_psr.setdefault(psr, []).append(f)
 
+    def _tim_name(pulsar, p=None):
+        suffix = f".p{p if p is not None else pid}" \
+            if (shard and nproc > 1) else ""
+        return os.path.join(outdir, f"{pulsar}{suffix}.tim")
+
+    completed = {}
+    if resume:
+        import glob as _glob
+
+        from .stream import checkpoint_completed, sanitize_checkpoint
+
+        current_outputs = {os.path.abspath(_tim_name(j.pulsar, p))
+                           for j in jobs for p in range(nproc)}
+        for job in jobs:
+            done = set()
+            for path in sorted(_glob.glob(
+                    os.path.join(outdir, f"{job.pulsar}*.tim"))):
+                ap = os.path.abspath(path)
+                if ap in current_outputs:
+                    # this run's own shards: each process sanitizes
+                    # the one it will write (stream resume=True);
+                    # peers' live shards are left alone
+                    done |= checkpoint_completed(path)
+                elif pid == 0:
+                    # orphaned shard from a previous process layout
+                    # (e.g. a killed worker): no current process
+                    # writes it, so process 0 may drop its partial
+                    # tail safely
+                    done |= sanitize_checkpoint(path)
+                else:
+                    done |= checkpoint_completed(path)
+            completed[job.pulsar] = done
+
     t0 = time.time()
     per_pulsar = {}
     TOA_list = []
@@ -114,14 +161,12 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
         files = by_psr.get(job.pulsar, [])
         if not files:
             continue
-        tim_out = None
-        if outdir:
-            suffix = f".p{pid}" if (shard and nproc > 1) else ""
-            tim_out = os.path.join(outdir, f"{job.pulsar}{suffix}.tim")
+        tim_out = _tim_name(job.pulsar) if outdir else None
         kw = {**stream_kwargs, **job.kwargs}
         res = stream_wideband_TOAs(
             files, job.modelfile, nsub_batch=nsub_batch,
-            tim_out=tim_out, quiet=True, **kw)
+            tim_out=tim_out, quiet=True, resume=resume,
+            skip_archives=completed.get(job.pulsar), **kw)
         per_pulsar[job.pulsar] = res
         TOA_list.extend(res.TOA_list)
         nfit += res.nfit

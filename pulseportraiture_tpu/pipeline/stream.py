@@ -67,6 +67,54 @@ from .toas import (_is_metafile, _iter_archives, _read_metafile,
                    scattering_toa_flags, snr_weighted_nu_fit)
 
 
+# Per-archive completion sentinel in incremental .tim checkpoints: a
+# comment line (readers skip 'C ' lines) appended AFTER an archive's
+# TOA lines, so "last sentinel" marks the last durably-complete
+# archive — everything after it is a partial tail from an interrupted
+# writer and is dropped on resume.
+_DONE_PREFIX = "C ppt-done "
+
+
+def checkpoint_completed(path):
+    """Archive paths (absolute) recorded complete in a .tim checkpoint
+    (empty set for a missing file)."""
+    import os
+
+    if not path or not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        return {os.path.abspath(line[len(_DONE_PREFIX):].strip())
+                for line in f if line.startswith(_DONE_PREFIX)}
+
+
+def sanitize_checkpoint(path):
+    """Truncate a .tim checkpoint after its last completion sentinel,
+    dropping the partial tail an interrupted (or killed) writer left.
+    The rewrite is ATOMIC (temp file + os.replace): resume runs are by
+    definition crash-prone, and an in-place truncate-then-write would
+    lose every completed archive to a second kill — or show a
+    concurrent reader an empty file mid-rewrite.  Returns the
+    completed-archive set (absolute paths)."""
+    import os
+
+    if not path or not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        lines = f.readlines()
+    last = -1
+    done = set()
+    for i, line in enumerate(lines):
+        if line.startswith(_DONE_PREFIX):
+            last = i
+            done.add(os.path.abspath(line[len(_DONE_PREFIX):].strip()))
+    if last + 1 < len(lines):
+        tmp = path + ".ppt-sanitize"
+        with open(tmp, "w") as f:
+            f.writelines(lines[:last + 1])
+        os.replace(tmp, path)
+    return done
+
+
 class _Bucket:
     """Pending subints sharing one (layout, flags, kind) key.
 
@@ -580,7 +628,8 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                          print_flux=False, print_phase=False,
                          instrumental_response_dict=None,
                          addtnl_toa_flags={}, tim_out=None,
-                         quiet=False):
+                         quiet=False, resume=False,
+                         skip_archives=None):
     """Measure wideband (phi[, DM[, tau, alpha]]) TOAs for many
     archives with cross-archive batched dispatches.
 
@@ -592,10 +641,21 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
     path.
 
     tim_out: optional .tim path; each archive's TOA lines are APPENDED
-    as soon as all its subints are fitted, so a campaign interrupted
-    mid-run keeps every completed archive's results on disk (the
-    fault-tolerance analogue of the reference's write-the-model-every-
-    iteration habit, ppgauss.py:208-212).
+    as soon as all its subints are fitted, followed by a completion
+    sentinel comment line, so a campaign interrupted mid-run keeps
+    every completed archive's results on disk (the fault-tolerance
+    analogue of the reference's write-the-model-every-iteration habit,
+    ppgauss.py:208-212).
+
+    resume=True RE-ENTERS an interrupted campaign: the checkpoint is
+    truncated after its last completion sentinel (dropping the partial
+    tail a killed writer left) and archives already recorded complete
+    are skipped — only the missing ones are measured, and the final
+    .tim holds exactly the uninterrupted run's lines.  skip_archives:
+    additional completed set to skip (e.g. archives another worker's
+    checkpoint shard already covers, pipeline/ipta.py).  The returned
+    summaries cover only the archives measured THIS run; the .tim set
+    is the durable cross-run artifact.
 
     max_inflight: how many fused dispatches may be pending on the
     device before the host blocks on the oldest — dispatch latency,
@@ -635,10 +695,27 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
     # the folding period (tau seconds -> bins) — such templates must
     # not be shared across archives with different P
     p_dependent = model.has_scattering()
+    import os as _os
+
+    done = {_os.path.abspath(f) for f in (skip_archives or ())}
     if tim_out:
-        # fresh checkpoint file: a rerun must not append onto a
-        # previous campaign's lines
-        open(tim_out, "w").close()
+        if resume:
+            # drop the interrupted tail, collect completed archives
+            done |= sanitize_checkpoint(tim_out)
+        else:
+            # fresh checkpoint file: a rerun must not append onto a
+            # previous campaign's lines
+            open(tim_out, "w").close()
+    if done:
+        # compare normalized paths: a resume run launched from another
+        # cwd (or with absolute instead of relative paths) must still
+        # recognize completed archives
+        skipped = [f for f in datafiles if _os.path.abspath(f) in done]
+        datafiles = [f for f in datafiles
+                     if _os.path.abspath(f) not in done]
+        if skipped and not quiet:
+            print(f"Resuming: {len(skipped)} archive(s) already "
+                  f"complete in checkpoints, {len(datafiles)} to go")
 
     # f32 load on fast-fit backends: the data feeds the f32 engine
     # anyway, and single precision halves per-archive host time — on
@@ -717,7 +794,12 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                 for isub in m.ok:
                     results.pop((ia, int(isub)), None)
                 if tim_out:
+                    import os as _os
+
                     write_TOAs(out[0], outfile=tim_out, append=True)
+                    with open(tim_out, "a") as fh:
+                        fh.write(_DONE_PREFIX
+                                 + _os.path.abspath(m.datafile) + "\n")
 
     def do_flush(b):
         nonlocal nfit

@@ -196,3 +196,239 @@ def test_two_real_processes_run_a_sharded_campaign(tmp_path):
     names = sorted(p.name for p in (tmp_path / "ipta").iterdir())
     assert names == ["PSRA.p0.tim", "PSRA.p1.tim",
                      "PSRB.p0.tim", "PSRB.p1.tim"]
+
+
+SLIM_WORKER = r"""
+import json, sys
+import numpy as np
+port, pid, n, outdir = sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+from pulseportraiture_tpu import parallel
+assert parallel.init_multihost(
+    coordinator_address=f"localhost:{port}", num_processes=n,
+    process_id=pid) is True
+assert jax.process_count() == n
+files = json.load(open(f"{outdir}/files.json"))
+mine = parallel.shard_files(files)
+from pulseportraiture_tpu.pipeline.stream import stream_wideband_TOAs
+res = stream_wideband_TOAs(mine, f"{outdir}/m.gmodel", nsub_batch=4,
+                           tim_out=f"{outdir}/part{pid}.tim", quiet=True)
+gathered = parallel.process_allgather(res.DeltaDM_means)
+out = {"pid": pid, "my_files": mine,
+       "gathered": [np.asarray(g).tolist() for g in gathered],
+       "toas": {f"{t.archive}|{t.flags['subint']}":
+                [t.MJD.tim_string(), t.TOA_error] for t in res.TOA_list}}
+with open(f"{outdir}/out{pid}.json", "w") as fh:
+    json.dump(out, fh)
+"""
+
+
+def _spawn_env(tmp_path):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    import pulseportraiture_tpu
+
+    repo = os.path.dirname(os.path.dirname(pulseportraiture_tpu.__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return env, repo
+
+
+def _forge_campaign(tmp_path, nfiles, nsub=1):
+    from pulseportraiture_tpu.io import write_gmodel
+    from pulseportraiture_tpu.synth import (default_test_model,
+                                            make_fake_pulsar)
+    from pulseportraiture_tpu.utils.mjd import MJD
+
+    model = default_test_model(1500.0)
+    gmodel = str(tmp_path / "m.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files = []
+    for i in range(nfiles):
+        p = str(tmp_path / f"mh{i}.fits")
+        make_fake_pulsar(model, {"PSR": "MH", "P0": 0.003, "DM": 10.0,
+                                 "PEPOCH": 55000.0},
+                         outfile=p, nsub=nsub, nchan=16, nbin=128,
+                         dDM=2e-4 * i, start_MJD=MJD(55100 + i, 0.1),
+                         noise_stds=0.05, dedispersed=False,
+                         quiet=True, rng=i)
+        files.append(p)
+    json.dump(files, open(tmp_path / "files.json", "w"))
+    return gmodel, files
+
+
+def test_four_processes_uneven_shards(tmp_path):
+    """4 real processes over 6 archives: the round-robin shard
+    arithmetic under uneven counts (2,2,1,1) — the >2-way coverage
+    VERDICT r3 missing #4 asked for — plus cross-process allgather and
+    digit-exact union vs a single-process run."""
+    from pulseportraiture_tpu.pipeline.stream import stream_wideband_TOAs
+
+    n = 4
+    gmodel, files = _forge_campaign(tmp_path, 6)
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(SLIM_WORKER)
+    env, repo = _spawn_env(tmp_path)
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker_py), str(port), str(i), str(n),
+             str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=repo)
+        for i in range(n)
+    ]
+    outs = [p.communicate(timeout=900) for p in procs]
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{so}\n{se}"
+    results = [json.load(open(tmp_path / f"out{i}.json"))
+               for i in range(n)]
+    shards = [r["my_files"] for r in results]
+    # uneven round-robin: 6 files over 4 procs -> 2,2,1,1; disjoint;
+    # complete
+    assert [len(s) for s in shards] == [2, 2, 1, 1]
+    flat = [f for s in shards for f in s]
+    assert sorted(flat) == sorted(files) and len(set(flat)) == 6
+    # every process gathers every shard's stats, same values everywhere
+    for r in results:
+        assert [len(g) for g in r["gathered"]] == [2, 2, 1, 1]
+        for g0, g in zip(results[0]["gathered"], r["gathered"]):
+            assert np.allclose(g0, g)
+    # digit-exact union vs one process doing the whole campaign
+    whole = stream_wideband_TOAs(files, gmodel, nsub_batch=4, quiet=True)
+    want = {f"{t.archive}|{t.flags['subint']}":
+            [t.MJD.tim_string(), t.TOA_error] for t in whole.TOA_list}
+    got = {}
+    for r in results:
+        got.update(r["toas"])
+    assert got.keys() == want.keys()
+    for k in want:
+        assert got[k][0] == want[k][0]
+        assert got[k][1] == pytest.approx(want[k][1], rel=1e-9)
+
+
+DYING_WORKER = r"""
+import json, os, sys, threading, time
+port, pid, n, outdir = sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+from pulseportraiture_tpu import parallel
+assert parallel.init_multihost(
+    coordinator_address=f"localhost:{port}", num_processes=n,
+    process_id=pid) is True
+files = json.load(open(f"{outdir}/files.json"))
+
+# hard-kill this worker once its PSRA shard has >= 1 complete archive,
+# leaving a torn partial line after the last sentinel (what a real
+# mid-append death leaves on disk)
+mytim = f"{outdir}/ipta/PSRA.p{pid}.tim"
+
+
+def killer():
+    while True:
+        time.sleep(0.1)
+        try:
+            done = sum(1 for l in open(mytim)
+                       if l.startswith("C ppt-done"))
+        except FileNotFoundError:
+            continue
+        if done >= 1:
+            with open(mytim, "a") as fh:
+                fh.write("torn_archive 1400.0 55100.12")  # torn line
+            os._exit(9)
+
+
+threading.Thread(target=killer, daemon=True).start()
+from pulseportraiture_tpu.pipeline import IPTAJob, stream_ipta_campaign
+
+jobs = [IPTAJob("PSRA", files[:4], f"{outdir}/m.gmodel"),
+        IPTAJob("PSRB", files[4:], f"{outdir}/m.gmodel")]
+stream_ipta_campaign(jobs, outdir=f"{outdir}/ipta", nsub_batch=2,
+                     quiet=True)
+os._exit(7)  # campaign outlived the killer: test setup failed
+"""
+
+
+def test_worker_death_and_resume(tmp_path):
+    """SURVEY S5 elastic recovery at campaign scale: two workers die
+    mid-IPTA-campaign (each leaving a torn checkpoint tail after its
+    last completion sentinel); the campaign is re-entered with a
+    DIFFERENT process layout (one process, resume=True) and finishes
+    only the missing archives — the union of all .tim shards is
+    digit-exact against an uninterrupted run."""
+    from pulseportraiture_tpu.pipeline import (IPTAJob,
+                                               stream_ipta_campaign)
+
+    n = 2
+    gmodel, files = _forge_campaign(tmp_path, 8, nsub=2)
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(DYING_WORKER)
+    env, repo = _spawn_env(tmp_path)
+    (tmp_path / "ipta").mkdir()
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker_py), str(port), str(i), str(n),
+             str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=repo)
+        for i in range(n)
+    ]
+    outs = [p.communicate(timeout=900) for p in procs]
+    rcs = [p.returncode for p in procs]
+    # 9 = self-killed mid-campaign; 1 = taken down by the jax
+    # distributed runtime when its peer (the coordinator) vanished —
+    # both are genuine worker deaths.  7 would mean the killer never
+    # fired; 0 would mean the campaign survived.
+    assert all(rc in (9, 1) for rc in rcs), (rcs, outs)
+    assert 9 in rcs, (rcs, outs)
+    # at least one torn checkpoint tail is really on disk
+    torn = 0
+    for i in range(n):
+        f = tmp_path / "ipta" / f"PSRA.p{i}.tim"
+        if f.exists():
+            torn += f.read_text().rstrip("\n").endswith("55100.12")
+    assert torn >= 1
+
+    # ---- re-enter with ONE process, resume=True ---------------------
+    jobs = [IPTAJob("PSRA", files[:4], gmodel),
+            IPTAJob("PSRB", files[4:], gmodel)]
+    stream_ipta_campaign(jobs, outdir=str(tmp_path / "ipta"),
+                         nsub_batch=2, quiet=True, resume=True)
+
+    # ---- union of shards == uninterrupted run, digit-exact ----------
+    from pulseportraiture_tpu.timing import read_tim
+
+    fresh = tmp_path / "fresh"
+    fresh.mkdir()
+    stream_ipta_campaign(jobs, outdir=str(fresh), nsub_batch=2,
+                         quiet=True)
+    import glob as _glob
+
+    def lineset(paths):
+        out = {}
+        for f in paths:
+            for t in read_tim(f):
+                out[f"{t.archive}|{t.flags.get('subint')}"] = (
+                    t.mjd_int, t.mjd_frac, t.error_us)
+        return out
+
+    got = lineset(_glob.glob(str(tmp_path / "ipta" / "*.tim")))
+    want = lineset(_glob.glob(str(fresh / "*.tim")))
+    assert got.keys() == want.keys()
+    for k in want:
+        assert got[k][0] == want[k][0]
+        assert got[k][1] == pytest.approx(want[k][1], abs=0.0)
+        assert got[k][2] == pytest.approx(want[k][2], rel=1e-12)
+    # no torn/duplicate lines survived anywhere
+    for f in _glob.glob(str(tmp_path / "ipta" / "*.tim")):
+        text = open(f).read()
+        assert "torn_archive" not in text
+    all_keys = []
+    for f in _glob.glob(str(tmp_path / "ipta" / "*.tim")):
+        for t in read_tim(f):
+            all_keys.append(f"{t.archive}|{t.flags.get('subint')}")
+    assert len(all_keys) == len(set(all_keys))

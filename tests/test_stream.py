@@ -122,7 +122,12 @@ def test_stream_incremental_tim(campaign, tmp_path):
                                tim_out=str(tim_inc), quiet=True)
     tim_ref = tmp_path / "ref.tim"
     write_TOAs(res.TOA_list, outfile=str(tim_ref), append=False)
-    li = tim_inc.read_text().strip().splitlines()
+    raw = tim_inc.read_text().strip().splitlines()
+    # the checkpoint interleaves per-archive completion sentinels
+    # (comment lines readers skip) — one per archive
+    sentinels = [l for l in raw if l.startswith("C ppt-done ")]
+    assert len(sentinels) == len(files)
+    li = [l for l in raw if not l.startswith("C ")]
     lr = tim_ref.read_text().strip().splitlines()
     # incremental emission may reorder across archives (bucket
     # completion order), but the line SET must match exactly
@@ -442,3 +447,35 @@ def test_stream_print_phase_flags(campaign):
                                                abs=1e-9)
         assert t.flags["phs_err"] == pytest.approx(
             t_ref.flags["phs_err"], rel=1e-6)
+
+
+def test_stream_resume_skips_completed_and_drops_torn_tail(campaign,
+                                                           tmp_path):
+    """resume=True re-enters an interrupted checkpoint: the torn tail
+    after the last completion sentinel is dropped, completed archives
+    are skipped, and the final file is line-set-identical to an
+    uninterrupted run."""
+    files, gmodel = campaign
+    tim_full = tmp_path / "full.tim"
+    stream_wideband_TOAs(files, gmodel, nsub_batch=8,
+                         tim_out=str(tim_full), quiet=True)
+    full_lines = sorted(l for l in tim_full.read_text().splitlines()
+                        if l.strip())
+
+    # forge an interrupted checkpoint: keep the first archive's block
+    # (through its sentinel), then a torn partial line
+    lines = tim_full.read_text().splitlines(keepends=True)
+    first_done = next(i for i, l in enumerate(lines)
+                      if l.startswith("C ppt-done "))
+    tim_part = tmp_path / "part.tim"
+    tim_part.write_text("".join(lines[:first_done + 1])
+                        + "torn 1400.0 55100.12")
+    done_arch = lines[first_done].split("C ppt-done ", 1)[1].strip()
+
+    res = stream_wideband_TOAs(files, gmodel, nsub_batch=8,
+                               tim_out=str(tim_part), quiet=True,
+                               resume=True)
+    # the completed archive was skipped, not re-measured
+    assert done_arch not in [t.archive for t in res.TOA_list]
+    assert sorted(l for l in tim_part.read_text().splitlines()
+                  if l.strip()) == full_lines
