@@ -230,6 +230,16 @@ class Archive:
         if dm is not None:
             return dm
         dm = self.subint_header.get("DM")
+        # a 0.0 DM card is AUTHORITATIVE on a dedispersed file with no
+        # coherent-dedispersion record (e.g. an averaged template
+        # archive: "fully dedispersed, zero residual DM") but means
+        # unset-as-zero on raw data (the standard SUBINT template
+        # writes DM unconditionally) and on coherent-backend files
+        # (nonzero CHAN_DM: the applied DM is recorded there) — those
+        # fall through to the ephemeris/CHAN_DM chain
+        if dm in (0.0, 0) and self.get_dedispersed() \
+                and self.get_chan_dm() == 0.0:
+            return 0.0
         if dm in (None, 0.0, 0, "*"):
             dm = _param_value(self.psrparam, "DM")
         if dm in (None, 0.0, 0, "*"):

@@ -134,13 +134,20 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
                            for j in jobs for p in range(nproc)}
         for job in jobs:
             done = set()
+            own = os.path.abspath(_tim_name(job.pulsar))
+            will_stream = bool(by_psr.get(job.pulsar))
             for path in sorted(_glob.glob(
                     os.path.join(outdir, f"{job.pulsar}*.tim"))):
                 ap = os.path.abspath(path)
-                if ap in current_outputs:
-                    # this run's own shards: each process sanitizes
-                    # the one it will write (stream resume=True);
-                    # peers' live shards are left alone
+                if ap == own and not will_stream:
+                    # this process owns the filename but has no files
+                    # for the pulsar this run (reshuffled grid), so no
+                    # stream call will sanitize it — drop its torn
+                    # tail here, or it pollutes the shard union
+                    done |= sanitize_checkpoint(path)
+                elif ap in current_outputs:
+                    # a live shard: its owner sanitizes it (stream
+                    # resume=True, or the branch above); only read
                     done |= checkpoint_completed(path)
                 elif pid == 0:
                     # orphaned shard from a previous process layout
@@ -151,6 +158,10 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
                 else:
                     done |= checkpoint_completed(path)
             completed[job.pulsar] = done
+        if not quiet:
+            ntot = sum(len(v) for v in completed.values())
+            print(f"IPTA resume: {ntot} archive(s) recorded complete "
+                  "across existing checkpoint shards will be skipped")
 
     t0 = time.time()
     per_pulsar = {}

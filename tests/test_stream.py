@@ -479,3 +479,26 @@ def test_stream_resume_skips_completed_and_drops_torn_tail(campaign,
     assert done_arch not in [t.archive for t in res.TOA_list]
     assert sorted(l for l in tim_part.read_text().splitlines()
                   if l.strip()) == full_lines
+
+
+def test_stream_narrowband_midrun_flush_no_duplicates(campaign,
+                                                      tmp_path):
+    """A narrowband bucket that fills MID-campaign (nsub_batch smaller
+    than the total) must be cleared at launch: regression for the
+    executor refactor dropping launch_nb's bucket clear, which would
+    re-dispatch every prior subint on each flush and stamp premature
+    completion sentinels."""
+    from pulseportraiture_tpu.pipeline.stream import (
+        stream_narrowband_TOAs)
+
+    files, gmodel = campaign
+    a = stream_narrowband_TOAs(files, gmodel, nsub_batch=2, quiet=True,
+                               tim_out=str(tmp_path / "nb2.tim"))
+    b = stream_narrowband_TOAs(files, gmodel, nsub_batch=64, quiet=True)
+    keys_a = [(t.archive, t.flags["subint"], t.flags["chan"])
+              for t in a.TOA_list]
+    keys_b = [(t.archive, t.flags["subint"], t.flags["chan"])
+              for t in b.TOA_list]
+    assert len(keys_a) == len(set(keys_a))  # no duplicates
+    assert sorted(keys_a) == sorted(keys_b)
+    assert a.nfit > b.nfit  # the small batch really flushed mid-run
