@@ -33,58 +33,142 @@ def _finish(fig, show, savefig):
 
 
 def show_portrait(port, phases=None, freqs=None, title=None, prof=True,
-                  fluxprof=True, show=True, savefig=None):
-    """Portrait image with optional average-profile and flux side
-    panels (reference pplib.py:3652-3757)."""
+                  fluxprof=True, rvrsd=False, colorbar=True, show=True,
+                  savefig=None, aspect="auto", interpolation="none",
+                  origin="lower", extent=None, **kwargs):
+    """Portrait image with average-profile (top) and phase-averaged-
+    spectrum (left) side panels (reference pplib.py:3652-3757: same
+    panel geometry, zero-weight channels compressed out of both side
+    panels, rvrsd frequency flip, colorbar, extent override, and
+    imshow passthrough kwargs e.g. vmin/vmax)."""
     port = np.asarray(port)
     nchan, nbin = port.shape
-    phases = np.asarray(phases) if phases is not None else \
-        (np.arange(nbin) + 0.5) / nbin
-    freqs = np.asarray(freqs) if freqs is not None else np.arange(nchan)
-    grid = (2 if prof else 1, 2 if fluxprof else 1)
-    fig = plt.figure(figsize=(7, 6))
-    gs = fig.add_gridspec(grid[0], grid[1],
-                          width_ratios=[3, 1][: grid[1]],
+    if phases is None:
+        phases = np.arange(nbin)
+        xlabel = "Bin Number"
+    else:
+        phases = np.asarray(phases)
+        xlabel = "Phase [rot]"
+    if freqs is None:
+        freqs = np.arange(nchan)
+        ylabel = "Channel Number"
+    else:
+        freqs = np.asarray(freqs)
+        ylabel = "Frequency [MHz]"
+    if rvrsd:
+        freqs = freqs[::-1]
+        port = port[::-1]
+    if extent is None:
+        extent = (phases[0], phases[-1], freqs[0], freqs[-1])
+    # zero-weight (zapped) channels carry no flux: compress them out
+    # of the side panels exactly like the reference (weights = channel
+    # means; np.compress)
+    weights = port.mean(axis=1)
+    portx = np.compress(weights, port, axis=0)
+    fluxx = np.compress(weights, weights)
+    freqsx = np.compress(weights, freqs)
+    if portx.size == 0:  # fully zapped: fall back to raw panels
+        portx, fluxx, freqsx = port, weights, freqs
+
+    fig = plt.figure(figsize=(7.5, 6))
+    gs = fig.add_gridspec(2 if prof else 1, 2 if fluxprof else 1,
+                          width_ratios=([1, 3] if fluxprof else [1]),
                           height_ratios=([1, 3] if prof else [1]),
                           hspace=0.05, wspace=0.05)
-    ax_im = fig.add_subplot(gs[-1, 0])
-    extent = [phases[0], phases[-1], freqs[0], freqs[-1]]
-    ax_im.imshow(port, aspect="auto", origin="lower", extent=extent)
-    ax_im.set_xlabel("Phase [rot]")
-    ax_im.set_ylabel("Frequency [MHz]")
+    ax_im = fig.add_subplot(gs[-1, -1])
+    im = ax_im.imshow(port, aspect=aspect, origin=origin, extent=extent,
+                      interpolation=interpolation, **kwargs)
+    if colorbar:
+        fig.colorbar(im, ax=ax_im, pad=0.01)
+    ax_im.set_xlabel(xlabel)
+    if fluxprof:
+        ax_im.tick_params(labelleft=False)
+    else:
+        ax_im.set_ylabel(ylabel)
     if prof:
-        ax_p = fig.add_subplot(gs[0, 0], sharex=ax_im)
-        ax_p.plot(phases, port.mean(axis=0), "k-", lw=1)
+        ax_p = fig.add_subplot(gs[0, -1], sharex=ax_im)
+        avg = portx.mean(axis=0)
+        ax_p.plot(phases, avg, "k-", lw=1)
         ax_p.tick_params(labelbottom=False)
-        ax_p.set_ylabel("Flux")
+        rng = avg.max() - avg.min()
+        if rng > 0:  # a flat (fully-zapped) profile keeps auto limits
+            ax_p.set_ylim(avg.min() - 0.03 * rng,
+                          avg.max() + 0.05 * rng)
+        ax_p.set_ylabel("Flux Units")
         if title:
             ax_p.set_title(title)
     elif title:
         ax_im.set_title(title)
     if fluxprof:
-        ax_f = fig.add_subplot(gs[-1, 1], sharey=ax_im)
-        ax_f.plot(port.mean(axis=1), freqs, "k-", lw=1)
-        ax_f.tick_params(labelleft=False)
-        ax_f.set_xlabel("Flux")
+        ax_f = fig.add_subplot(gs[-1, 0], sharey=ax_im)
+        # phase-averaged spectrum as markers, flux increasing LEFTWARD
+        # (the reference's inverted x-axis, pplib.py:3741-3746)
+        ax_f.plot(fluxx, freqsx, "kx", ms=4)
+        rng = fluxx.max() - fluxx.min()
+        if rng > 0:
+            ax_f.set_xlim(fluxx.max() + 0.03 * rng,
+                          min(fluxx.min(), 0.0) - 0.01 * rng)
+        else:
+            ax_f.invert_xaxis()
+        ax_f.set_xlabel("Flux Units")
+        ax_f.set_ylabel(ylabel)
     return _finish(fig, show, savefig)
 
 
-def show_stacked_profiles(port, freqs=None, spacing=None, show=True,
+def show_stacked_profiles(port, freqs=None, *, model_profiles=None,
+                          phases=None, rvrsd=False, fit=False,
+                          spacing=None, fact=0.25, show=True,
                           savefig=None, title=None):
-    """Vertically offset per-channel profiles (reference
-    pplib.py:3760-3824)."""
+    """Vertically offset per-channel profiles with optional overlaid
+    model profiles (reference pplib.py:3760-3824: dashed model under
+    solid data in matching colors; fit=True aligns/scales each model
+    to its data profile via fit_phase_shift first; frequency tick
+    labels every 10 channels; rvrsd flips the stack)."""
     port = np.asarray(port)
     nchan, nbin = port.shape
+    models = None if model_profiles is None else \
+        np.asarray(model_profiles)
+    if phases is None:
+        phases = np.arange(nbin)
+        xlabel = "Bin Number"
+    else:
+        phases = np.asarray(phases)
+        xlabel = "Phase [rot]"
+    if freqs is None:
+        freqs = np.arange(nchan)
+        ylabel = "Approx. Channel Number"
+    else:
+        freqs = np.asarray(freqs)
+        ylabel = "Approx. Frequency [MHz]"
+    if rvrsd:
+        freqs = freqs[::-1]
+        port = port[::-1]
+        if models is not None:
+            models = models[::-1]
     if spacing is None:
-        spacing = 1.1 * np.abs(port).max()
+        spacing = (port.max() - port.min()) * fact
     fig, ax = plt.subplots(figsize=(5, 8))
-    phases = (np.arange(nbin) + 0.5) / nbin
     for i in range(nchan):
-        ax.plot(phases, port[i] + i * spacing, "k-", lw=0.6)
-    ax.set_xlabel("Phase [rot]")
-    ax.set_yticks([])
-    if freqs is not None:
-        ax.set_ylabel(f"{freqs[0]:.0f}..{freqs[-1]:.0f} MHz (stacked)")
+        base = i * spacing
+        if models is not None:
+            mprof = models[i]
+            if fit and np.any(port[i] - mprof):
+                from ..fit import fit_phase_shift
+                from ..ops import rotate_profile
+
+                r = fit_phase_shift(port[i], mprof)
+                mprof = float(r.scale) * np.asarray(
+                    rotate_profile(mprof, -float(r.phase)))
+            m, = ax.plot(phases, mprof + base, lw=1.2, ls="dashed")
+            ax.plot(phases, port[i] + base, lw=0.8, ls="solid",
+                    color=m.get_color())
+        else:
+            ax.plot(phases, port[i] + base, "k-", lw=0.6)
+    ax.set_xlabel(xlabel)
+    step = max(1, nchan // 10)
+    ax.set_yticks(np.arange(nchan)[::step] * spacing)
+    ax.set_yticklabels([str(int(round(f))) for f in freqs[::step]])
+    ax.set_ylabel(ylabel)
     if title:
         ax.set_title(title)
     return _finish(fig, show, savefig)
