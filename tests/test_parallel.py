@@ -320,3 +320,13 @@ def test_sharded_fast_scatter_matches_batch(key=None):
                                rtol=1e-5)
     np.testing.assert_allclose(np.asarray(res.tau_err),
                                np.asarray(ref.tau_err), rtol=1e-4)
+
+
+def test_cluster_env_private_api_is_inspectable():
+    """Canary for the private jax._src.clusters registry that
+    _cluster_env_detected leans on (pinned against jax 0.9.x): its
+    silent None fallback is sound, but an upgrade that moves the API
+    must fail HERE visibly, not degrade cluster detection quietly."""
+    from pulseportraiture_tpu.parallel import multihost
+
+    assert multihost._cluster_env_detected() in (True, False)
