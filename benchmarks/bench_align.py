@@ -52,9 +52,19 @@ def main():
         num = jnp.einsum("enb,en->nb", rot, wts)
         return num / jnp.maximum(jnp.sum(wts, 0), 1e-30)[:, None]
 
+    # the production align_archives derives the harmonic window from
+    # its host template each iteration (noisy averages resolve to full
+    # spectrum); mirror that here from the one-time host pull
+    import numpy as np
+
+    from pulseportraiture_tpu.fit.portrait import resolve_harmonic_window
+
+    hwin = resolve_harmonic_window(None, np.asarray(model), NBIN)
+
     def iteration():
-        r = fit_portrait_batch_fast(ports, model, noise, freqs, P, NU_FIT,
-                                    max_iter=25)
+        r = fit_portrait_batch_fast(
+            ports, model, noise, freqs, P, NU_FIT, max_iter=25,
+            harmonic_window=hwin if hwin is not None else False)
         return stack(ports, r.phi, r.DM, r.scales, noise)
 
     slope, single = devtime(iteration, lambda t: t)

@@ -15,7 +15,9 @@ import jax.numpy as jnp
 
 from ..fit.phase_shift import fit_phase_shift
 from ..fit.portrait import (FitFlags, fit_portrait_batch,
-                            fit_portrait_batch_fast, use_fast_fit_default)
+                            fit_portrait_batch_fast,
+                            resolve_harmonic_window,
+                            use_fast_fit_default)
 from ..utils.device import host_compute
 from ..io.psrfits import load_data, read_archive, unload_new_archive
 from ..models.gaussian import gen_gaussian_profile
@@ -132,8 +134,13 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
         use_fast = use_fast_fit_default()
         if use_fast:
             # hoisted: one H2D transfer of the shared template per
-            # iteration, not one per archive
+            # iteration, not one per archive.  The harmonic window
+            # derives per iteration from the HOST template: a noisy
+            # early-iteration average has a flat spectral floor and
+            # resolves to None (full spectrum) automatically; smooth
+            # templates band-limit the fits (fit.portrait).
             model_f32 = jnp.asarray(model_port, jnp.float32)
+            hwin = resolve_harmonic_window(None, model_port, nbin)
         mean_model = model_port.mean(axis=0)
         for path in datafiles:
             if path in skip_these:
@@ -185,9 +192,12 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
                 if use_fast:
                     fitter, ft = fit_portrait_batch_fast, jnp.float32
                     model_arg = model_f32  # shared 2-D
+                    kw = {"harmonic_window":
+                          hwin if hwin is not None else False}
                 else:
                     fitter, ft = fit_portrait_batch, None
                     model_arg = jnp.broadcast_to(model_j, ports.shape)
+                    kw = {}
                 res = fitter(
                     jnp.asarray(ports, ft),
                     model_arg,
@@ -198,7 +208,7 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
                     theta0=jnp.asarray(theta0, ft),
                     fit_flags=FitFlags(True, bool(fit_dm), False, False,
                                        False),
-                    chan_masks=jnp.asarray(masks, ft))
+                    chan_masks=jnp.asarray(masks, ft), **kw)
                 phis = np.asarray(res.phi)
                 DMs = np.asarray(res.DM)
                 scales = np.asarray(res.scales) * masks
