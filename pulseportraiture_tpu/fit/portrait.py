@@ -214,6 +214,11 @@ def model_harmonic_window(model, nbin, tail=None):
     for lo in range(0, m.shape[0], 256):
         spec = _np.abs(_np.fft.rfft(m[lo:lo + 256], axis=-1)) ** 2.0
         spec = spec.astype(_np.float64)
+        # DC-free power: the fit zeroes harmonic 0 (F0_fact = 0,
+        # reference pplib.py:82), so a template's baseline offset must
+        # not inflate the denominator — a large (n*mu)^2 there would
+        # loosen the tail criterion and truncate real AC support
+        spec[:, 0] = 0.0
         tot = spec.sum(axis=-1)
         good = tot > 0.0
         if not _np.any(good):

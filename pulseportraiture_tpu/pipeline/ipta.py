@@ -32,7 +32,9 @@ tiny per-pulsar shards, at the cost of per-element template DFTs in
 every dispatch.
 """
 
+import glob
 import os
+import re
 import time
 
 import numpy as np
@@ -42,6 +44,18 @@ from .stream import stream_wideband_TOAs
 from .toas import _is_metafile, _read_metafile
 
 __all__ = ["IPTAJob", "stream_ipta_campaign"]
+
+
+def _shard_checkpoints(outdir, pulsar):
+    """Existing checkpoint shards belonging to `pulsar`, anchored to
+    the shard naming scheme ({pulsar}.tim and {pulsar}.pN.tim).  A bare
+    prefix glob would absorb another pulsar whose name extends this
+    one (e.g. 'J1713' reading 'J1713+0747.p0.tim') and wrongly mark
+    its archives complete."""
+    shard_re = re.compile(re.escape(pulsar) + r"(\.p\d+)?\.tim$")
+    return sorted(
+        p for p in glob.glob(os.path.join(outdir, f"{pulsar}*.tim"))
+        if shard_re.fullmatch(os.path.basename(p)))
 
 
 class IPTAJob:
@@ -126,8 +140,6 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
 
     completed = {}
     if resume:
-        import glob as _glob
-
         from .stream import checkpoint_completed, sanitize_checkpoint
 
         current_outputs = {os.path.abspath(_tim_name(j.pulsar, p))
@@ -136,8 +148,7 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
             done = set()
             own = os.path.abspath(_tim_name(job.pulsar))
             will_stream = bool(by_psr.get(job.pulsar))
-            for path in sorted(_glob.glob(
-                    os.path.join(outdir, f"{job.pulsar}*.tim"))):
+            for path in _shard_checkpoints(outdir, job.pulsar):
                 ap = os.path.abspath(path)
                 if ap == own and not will_stream:
                     # this process owns the filename but has no files

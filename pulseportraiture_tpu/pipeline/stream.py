@@ -78,12 +78,16 @@ _DONE_PREFIX = "C ppt-done "
 
 def checkpoint_completed(path):
     """Archive paths (absolute) recorded complete in a .tim checkpoint
-    (empty set for a missing file)."""
+    (empty set for a missing file).  A sentinel only counts when its
+    line is newline-terminated: a writer killed mid-sentinel leaves a
+    truncated final line whose path could still prefix-match — it is
+    part of the torn tail, not a durable completion record."""
     if not path or not os.path.exists(path):
         return set()
     with open(path) as f:
         return {os.path.abspath(line[len(_DONE_PREFIX):].strip())
-                for line in f if line.startswith(_DONE_PREFIX)}
+                for line in f
+                if line.startswith(_DONE_PREFIX) and line.endswith("\n")}
 
 
 def sanitize_checkpoint(path):
@@ -101,7 +105,9 @@ def sanitize_checkpoint(path):
     last = -1
     done = set()
     for i, line in enumerate(lines):
-        if line.startswith(_DONE_PREFIX):
+        # same newline rule as checkpoint_completed: an unterminated
+        # final "sentinel" is a torn write and belongs to the tail
+        if line.startswith(_DONE_PREFIX) and line.endswith("\n"):
             last = i
             done.add(os.path.abspath(line[len(_DONE_PREFIX):].strip()))
     if last + 1 < len(lines):

@@ -45,6 +45,19 @@ def test_window_white_template_stays_full(rng):
     assert model_harmonic_window(white, NBIN) is None
 
 
+def test_window_ignores_dc_offset(key):
+    """The tail criterion is DC-free (the fit zeroes harmonic 0, so a
+    baseline offset carries no fit weight): a huge constant offset must
+    not change the derived window.  Pre-fix, (n*mu)^2 inflated the
+    denominator and loosened the criterion by ~1e6 here, silently
+    truncating real model support."""
+    d = _data(key)
+    mp = np.asarray(d.model_port, np.float64)
+    K0 = model_harmonic_window(mp, NBIN)
+    K_off = model_harmonic_window(mp + 300.0, NBIN)
+    assert K0 is not None and K_off == K0
+
+
 def test_resolve_rejects_nonpositive_and_bad_strings(key):
     d = _data(key)
     mp = np.asarray(d.model_port)

@@ -502,3 +502,47 @@ def test_stream_narrowband_midrun_flush_no_duplicates(campaign,
     assert len(keys_a) == len(set(keys_a))  # no duplicates
     assert sorted(keys_a) == sorted(keys_b)
     assert a.nfit > b.nfit  # the small batch really flushed mid-run
+
+
+def test_checkpoint_sentinel_requires_newline(tmp_path):
+    """A sentinel line without a trailing newline is a torn write (the
+    writer died mid-sentinel): neither helper may count it, and
+    sanitize must drop it with the tail so resume re-measures that
+    archive exactly once instead of duplicating its TOA lines."""
+    from pulseportraiture_tpu.pipeline.stream import (
+        checkpoint_completed, sanitize_checkpoint)
+
+    ck = tmp_path / "ck.tim"
+    body = ("arch1 1400.0 55100.1 1.0 gbt\n"
+            "C ppt-done /data/a1.fits\n"
+            "arch2 1400.0 55100.2 1.0 gbt\n"
+            "C ppt-done /data/a2.fi")  # torn mid-path, no newline
+    ck.write_text(body)
+    assert checkpoint_completed(str(ck)) == {"/data/a1.fits"}
+    done = sanitize_checkpoint(str(ck))
+    assert done == {"/data/a1.fits"}
+    # everything after the last TERMINATED sentinel is gone
+    assert ck.read_text() == ("arch1 1400.0 55100.1 1.0 gbt\n"
+                              "C ppt-done /data/a1.fits\n")
+
+
+def test_ipta_resume_scan_ignores_prefix_pulsar_shards(tmp_path):
+    """The elastic-resume shard scan is anchored to the shard naming
+    scheme: pulsar 'J1713' must not absorb 'J1713+0747''s checkpoint
+    sentinels (its name is a prefix), or a shared archive path would be
+    wrongly skipped for the wrong pulsar."""
+    import os
+
+    from pulseportraiture_tpu.pipeline.ipta import _shard_checkpoints
+
+    names = ["J1713.tim", "J1713.p0.tim", "J1713.p12.tim",
+             "J1713+0747.tim", "J1713+0747.p0.tim", "J1713x.tim",
+             "J1713.p1.extra.tim"]
+    for n in names:
+        (tmp_path / n).touch()
+    got = [os.path.basename(p)
+           for p in _shard_checkpoints(str(tmp_path), "J1713")]
+    assert got == ["J1713.p0.tim", "J1713.p12.tim", "J1713.tim"]
+    got = [os.path.basename(p)
+           for p in _shard_checkpoints(str(tmp_path), "J1713+0747")]
+    assert got == ["J1713+0747.p0.tim", "J1713+0747.tim"]
