@@ -1,0 +1,119 @@
+"""A/B benchmark: the harmonic window on a DATA-BUILT (noisy) template.
+
+Round 4's headline speedup derived the window from a clean analytic
+template; production templates come out of ppspline/ppgauss with a white
+Fourier noise floor ~1e-6..1e-4 of total power, which pins the absolute
+tail criterion at full spectrum.  This measures the round-5 noise-floor-
+aware criterion (fit/portrait.model_harmonic_window) on such a template:
+same batched fit, windowed vs full spectrum, plus the window each
+criterion derives.  Template noise level via PPT_TEMPLATE_NOISE
+(default 1e-2 of peak — the unsmoothed-spline regime measured in
+tests/test_harmonic_window.py).
+
+Prints ONE JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import pulseportraiture_tpu  # noqa: F401
+    from pulseportraiture_tpu import config
+
+    config.dft_precision = "default"
+    config.cross_spectrum_dtype = "bfloat16"
+
+    from benchmarks.common import bench_model, devtime
+    from pulseportraiture_tpu.fit import fit_portrait_batch_fast
+    from pulseportraiture_tpu.fit.portrait import model_harmonic_window
+    from pulseportraiture_tpu.ops.fourier import irfft_mm, rfft_mm
+    from pulseportraiture_tpu.ops.phasor import phase_shifts
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    NB, NCHAN, NBIN = (640 if on_tpu else 128), 512, 2048
+    DTYPE = jnp.float32
+    P, NU_FIT = 0.003, 1500.0
+    s_tmpl = float(os.environ.get("PPT_TEMPLATE_NOISE", 1e-2))
+
+    model_clean, freqs = bench_model(NCHAN, NBIN)
+    # the data-built template: clean + white noise at the unsmoothed-
+    # spline floor level (same structure the pipeline measurement in
+    # test_window_engages_on_pipeline_built_spline_model exhibits)
+    rng = np.random.default_rng(7)
+    model_noisy = jnp.asarray(
+        np.asarray(model_clean, np.float64)
+        + rng.standard_normal((NCHAN, NBIN)) * s_tmpl, DTYPE)
+
+    NB_SYNTH = 128
+
+    @jax.jit
+    def synth(key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        phis = 0.1 * jax.random.uniform(k1, (NB_SYNTH,), DTYPE)
+        dms = 0.003 * jax.random.uniform(k2, (NB_SYNTH,), DTYPE)
+        delays = jax.vmap(
+            lambda ph, dm: phase_shifts(ph, dm, 0.0, freqs, P, NU_FIT,
+                                        NU_FIT))(phis, dms)
+        Xr, Xi = rfft_mm(model_clean)
+        k = jnp.arange(Xr.shape[-1], dtype=DTYPE)
+        ang = -2.0 * jnp.pi * delays[..., None] * k
+        c, s = jnp.cos(ang), jnp.sin(ang)
+        rot = irfft_mm(Xr * c - Xi * s, Xr * s + Xi * c, NBIN)
+        return rot + 0.05 * jax.random.normal(k3, rot.shape, DTYPE)
+
+    ports = jnp.tile(synth(jax.random.PRNGKey(0)), (NB // NB_SYNTH, 1, 1))
+    noise = jnp.full((NB, NCHAN), 0.05, DTYPE)
+    Ps = jnp.full((NB,), P, DTYPE)
+    nus = jnp.full((NB,), NU_FIT, DTYPE)
+    jax.block_until_ready(ports)
+
+    mp_host = np.asarray(model_noisy)
+    K_abs = model_harmonic_window(mp_host, NBIN, floor_sigma=0)
+    K = model_harmonic_window(mp_host, NBIN)
+
+    def run(hw):
+        return fit_portrait_batch_fast(ports, model_noisy, noise, freqs,
+                                       Ps, nus, max_iter=25,
+                                       harmonic_window=hw)
+
+    slope_full, lat_full = devtime(lambda: run(False), lambda r: r.phi)
+    slope_win, lat_win = devtime(
+        lambda: run(K if K is not None else False), lambda r: r.phi)
+
+    # accuracy: windowed vs full on the same portraits
+    rf, rt = run(False), run(K if K is not None else False)
+    dphi = float(jnp.max(jnp.abs(rf.phi - rt.phi)))
+
+    out = {
+        "metric": "windowed-vs-full fit on noisy (data-built) template, "
+                  "512ch x 2048bin",
+        "value": round(NB / slope_win, 2),
+        "unit": "TOAs/sec",
+        "vs_baseline": round(slope_full / slope_win, 2),
+        "full_toas_per_sec": round(NB / slope_full, 2),
+        "template_noise": s_tmpl,
+        "window_floor_aware": K,
+        "window_absolute_criterion": K_abs,
+        "batch": NB,
+        "batch_ms_windowed": round(slope_win * 1e3, 2),
+        "batch_ms_full": round(slope_full * 1e3, 2),
+        "max_dphi_windowed_vs_full": float(f"{dphi:.2e}"),
+        "accuracy_gate_1e-4": bool(dphi < 1e-4),
+        "device": str(dev),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

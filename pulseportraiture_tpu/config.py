@@ -113,6 +113,19 @@ fit_harmonic_window = "auto"
 # worst case).  1e-12 sits ~6 orders below f32's own rounding floor;
 # one extra 128-harmonic block of margin is always added on top.
 harmonic_window_tail = 1e-12
+# Data-built templates (ppspline/ppgauss from real archives) carry a
+# white Fourier noise floor ~1e-6..1e-4 of total power — far above
+# harmonic_window_tail — which would pin the absolute criterion at
+# full spectrum.  Harmonics at the template's own floor carry no
+# matched-filter information, so the window derivation estimates each
+# channel's floor from its top-quarter spectral plateau, subtracts the
+# expected pure-noise tail, and requires the excess to clear this many
+# sigma of the tail-sum fluctuation (std = sqrt(m)*mu for m tail
+# harmonics) before a harmonic counts as needed.  Clean templates
+# (floor ~ 0) reduce exactly to the absolute criterion; a floor
+# holding >10% of total power is treated as signal (no subtraction).
+# None or 0 disables floor awareness (round-4 behavior).
+harmonic_window_floor_sigma = 20.0
 
 # --- Model evolution codes ------------------------------------------------
 # Per-parameter evolution function code string for .gmodel files:

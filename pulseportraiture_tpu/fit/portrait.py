@@ -180,7 +180,7 @@ def use_scatter_compensated():
     return bool(getattr(config, "scatter_compensated", False))
 
 
-def model_harmonic_window(model, nbin, tail=None):
+def model_harmonic_window(model, nbin, tail=None, floor_sigma=None):
     """Static harmonic count K for the fast fit's band-limited lane,
     derived from a HOST model portrait (numpy (nchan, nbin) or
     (nb, nchan, nbin)): the smallest K such that every channel keeps
@@ -196,11 +196,36 @@ def model_harmonic_window(model, nbin, tail=None):
     prepare_portrait_fit_real).  The reference evaluates all harmonics
     unconditionally (pptoaslib.py:564-614); on TPU the window cuts the
     two dominant fit costs (MXU DFT, VPU moment trig) by ~the same
-    factor."""
+    factor.
+
+    DATA-BUILT templates (ppspline/ppgauss output from real archives)
+    carry a white noise floor far above `tail` — measured ~1e-6..1e-4
+    of total power for unsmoothed spline models — which would keep the
+    absolute criterion at full spectrum and silently forfeit the whole
+    win on the workload the framework targets.  Harmonics at the
+    template's own noise floor carry no matched-filter information
+    (their model "power" is noise, contributing variance but no
+    signal), so the criterion is noise-floor-aware: per channel the
+    white floor mu is estimated from the top-quarter spectral plateau
+    (robust median / ln 2 for exponentially-distributed chi^2_2
+    power), the expected pure-noise tail mu*(nharm-k) is subtracted
+    from the reverse-cumulative power, and a harmonic only counts as
+    needed when the excess clears BOTH the relative-tail criterion and
+    a `floor_sigma`*sqrt(nharm-k)*mu fluctuation budget (the tail sum
+    of m exponentials has std sqrt(m)*mu; 20 sigma keeps the
+    false-trigger probability negligible across ~1e5 channels).  A
+    clean template has mu ~ 0 and reduces exactly to the absolute
+    criterion; an apparent "floor" holding >10% of total power is
+    treated as signal (no subtraction), which keeps pure-noise
+    templates — and pathological flat-spectrum templates — at full
+    spectrum."""
     import numpy as _np
 
     if tail is None:
         tail = float(getattr(config, "harmonic_window_tail", 1e-12))
+    if floor_sigma is None:
+        floor_sigma = getattr(config, "harmonic_window_floor_sigma", 20.0)
+    floor_sigma = 0.0 if floor_sigma is None else float(floor_sigma)
     nharm = nbin // 2 + 1
     # chunk over channels: a batched 3-D model at campaign shapes is
     # gigabytes, and the derivation only needs a per-channel max — the
@@ -209,6 +234,9 @@ def model_harmonic_window(model, nbin, tail=None):
     m = _np.asarray(model).reshape(-1, nbin)
     if m.dtype not in (_np.float32, _np.float64):
         m = m.astype(_np.float32)
+    # number of tail harmonics at-or-above each k (DC never counts)
+    ntail = _np.maximum(nharm - _np.arange(nharm), 0).astype(_np.float64)
+    ntail[0] = nharm - 1.0
     K = 0
     any_good = False
     for lo in range(0, m.shape[0], 256):
@@ -224,11 +252,29 @@ def model_harmonic_window(model, nbin, tail=None):
         if not _np.any(good):
             continue
         any_good = True
-        # per-channel tail power fraction above each k (frac[k] is the
-        # power at harmonics >= k)
-        rev_cum = spec[good, ::-1].cumsum(axis=-1)[:, ::-1]
-        frac = rev_cum / tot[good, None]
-        K = max(K, int((frac > tail).sum(axis=-1).max()))
+        spec = spec[good]
+        tot = tot[good]
+        if floor_sigma > 0.0 and nharm >= 8:
+            q = nharm // 4
+            mu = _np.median(spec[:, -q:], axis=-1) / _np.log(2.0)
+            # an apparent floor holding >10% of the power is signal
+            # (or the template is pure noise): don't subtract it
+            mu = _np.where(mu * (nharm - 1) > 0.1 * tot, 0.0, mu)
+        else:
+            mu = _np.zeros(spec.shape[0])
+        # per-channel tail power above each k (rev_cum[k] is the power
+        # at harmonics >= k), minus the expected pure-noise tail
+        rev_cum = spec[:, ::-1].cumsum(axis=-1)[:, ::-1]
+        excess = rev_cum - mu[:, None] * ntail
+        budget = floor_sigma * _np.sqrt(ntail) * mu[:, None]
+        tot_sig = _np.maximum(tot - mu * (nharm - 1), tot * 1e-30)
+        needed = (excess > tail * tot_sig[:, None]) & (excess > budget)
+        # K covers the LAST needed harmonic (the floor-subtracted mask
+        # need not be monotone in k, so a True count would undercount)
+        any_needed = needed.any(axis=-1)
+        if any_needed.any():
+            last = nharm - 1 - needed[:, ::-1].argmax(axis=-1)
+            K = max(K, int((last[any_needed] + 1).max()))
     if not any_good:
         return None
     K = (K + 128 + 127) // 128 * 128  # +1 guard block, tile-rounded
