@@ -1,0 +1,191 @@
+"""Slope-timed composition of the windowed fast fit (VERDICT r4 #2).
+
+Round 4 attributed only ~65% of the windowed fit's 640-batch slope
+(DFT ~10 ms + 2 moment passes ~6.3 ms of ~25 ms); this decomposes the
+rest by timing nested prefixes of the real program plus isolated
+pieces, all at the bench shape (640 x 512 x 2048, K=256, bf16 X,
+shared template), each via benchmarks/common.devtime slope timing.
+
+Pieces (cumulative prefixes of fast_fit_one):
+  dft        data+model matmul DFTs alone (windowed)
+  xasm       + weights, X assembly, S0, Parseval Sd  (prepare, no seed)
+  seed       + CCF phase seed                        (prepare, seed on)
+  full       + Newton loop + finalize                (the whole fit)
+Isolated:
+  parseval   the full-spectrum time-domain Sd reduction alone
+  moment     ONE harmonic moment pass over the windowed bf16 X
+  loopfin    core_real on precomputed X (loop + finalize, no DFT/seed)
+
+Prints one JSON line with all slopes (ms per 640-batch) and the
+attribution ledger.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import pulseportraiture_tpu  # noqa: F401
+    from pulseportraiture_tpu import config
+
+    config.dft_precision = "default"
+    config.cross_spectrum_dtype = "bfloat16"
+
+    from benchmarks.common import bench_model, devtime
+    from pulseportraiture_tpu.fit import fit_portrait_batch_fast
+    from pulseportraiture_tpu.fit.portrait import (
+        FitFlags, _fit_portrait_core_real, _moments_real_xla,
+        _parseval_Sd, _t_coeffs, make_weights, prepare_portrait_fit_real)
+    from pulseportraiture_tpu.ops.fourier import irfft_mm, rfft_mm
+    from pulseportraiture_tpu.ops.phasor import phase_shifts
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    NB, NCHAN, NBIN = (640 if on_tpu else 64), 512, 2048
+    K = int(os.environ.get("PPT_K", 256))
+    DTYPE = jnp.float32
+    P, NU_FIT = 0.003, 1500.0
+    MAX_ITER = 25
+
+    model, freqs = bench_model(NCHAN, NBIN)
+    NB_SYNTH = 64
+
+    @jax.jit
+    def synth(key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        phis = 0.1 * jax.random.uniform(k1, (NB_SYNTH,), DTYPE)
+        dms = 0.003 * jax.random.uniform(k2, (NB_SYNTH,), DTYPE)
+        delays = jax.vmap(
+            lambda ph, dm: phase_shifts(ph, dm, 0.0, freqs, P, NU_FIT,
+                                        NU_FIT))(phis, dms)
+        Xr, Xi = rfft_mm(model)
+        k = jnp.arange(Xr.shape[-1], dtype=DTYPE)
+        ang = -2.0 * jnp.pi * delays[..., None] * k
+        c, s = jnp.cos(ang), jnp.sin(ang)
+        rot = irfft_mm(Xr * c - Xi * s, Xr * s + Xi * c, NBIN)
+        return rot + 0.05 * jax.random.normal(k3, rot.shape, DTYPE)
+
+    ports = jnp.tile(synth(jax.random.PRNGKey(0)), (NB // NB_SYNTH, 1, 1))
+    noise = jnp.full((NB, NCHAN), 0.05, DTYPE)
+    Ps = jnp.full((NB,), P, DTYPE)
+    nus = jnp.full((NB,), NU_FIT, DTYPE)
+    jax.block_until_ready(ports)
+
+    # --- full fit --------------------------------------------------------
+    def full():
+        return fit_portrait_batch_fast(ports, model, noise, freqs, Ps,
+                                       nus, max_iter=MAX_ITER,
+                                       harmonic_window=K)
+
+    t_full, _ = devtime(full, lambda r: r.phi)
+    res = full()
+    nfev = int(np.max(np.asarray(res.nfeval)))
+    nfev_med = float(np.median(np.asarray(res.nfeval)))
+
+    # --- prefix programs -------------------------------------------------
+    @jax.jit
+    def dft_only(ports):
+        dr, di = jax.vmap(lambda p: rfft_mm(p, nharm=K))(ports)
+        mr, mi = rfft_mm(model, nharm=K)
+        return (jnp.sum(dr) + jnp.sum(di) + jnp.sum(mr) + jnp.sum(mi))
+
+    def _prepare(port, ns, seed):
+        w = make_weights(ns, NBIN, dtype=DTYPE)
+        th0 = jnp.zeros(5, DTYPE)
+        Xr, Xi, S0, Sd, th = prepare_portrait_fit_real(
+            port, model, w, freqs, P, NU_FIT, th0, seed_phi=seed,
+            seed_derotate=False, x_dtype=jnp.bfloat16, nharm_eff=K)
+        return (jnp.sum(Xr.astype(jnp.float32)) + jnp.sum(S0) + Sd
+                + jnp.sum(th))
+
+    xasm = jax.jit(jax.vmap(lambda p, n: _prepare(p, n, False)))
+    seed = jax.jit(jax.vmap(lambda p, n: _prepare(p, n, True)))
+
+    @jax.jit
+    def parseval(ports, noise):
+        def one(p, ns):
+            w = make_weights(ns, NBIN, dtype=DTYPE)
+            return _parseval_Sd(p, w)
+        return jnp.sum(jax.vmap(one)(ports, noise))
+
+    # --- precomputed-X pieces -------------------------------------------
+    @jax.jit
+    def prep_out(ports, noise):
+        def one(p, ns):
+            w = make_weights(ns, NBIN, dtype=DTYPE)
+            return prepare_portrait_fit_real(
+                p, model, w, freqs, P, NU_FIT, jnp.zeros(5, DTYPE),
+                seed_phi=True, seed_derotate=False,
+                x_dtype=jnp.bfloat16, nharm_eff=K)
+        return jax.vmap(one)(ports, noise)
+
+    Xr, Xi, S0, Sd, th0 = jax.block_until_ready(prep_out(ports, noise))
+
+    # X ships as arguments, not closed-over constants — a closure
+    # would embed ~170 MB into the program and blow the tunneled
+    # compile server's request-size limit
+    core = jax.jit(jax.vmap(
+        lambda xr, xi, s0, sd, t0: _fit_portrait_core_real.__wrapped__(
+            xr, xi, s0, sd, freqs, P, NU_FIT, -1.0, t0,
+            fit_flags=FitFlags(), max_iter=MAX_ITER,
+            nharm_total=NBIN // 2 + 1)))
+    loopfin = lambda: core(Xr, Xi, S0, Sd, th0)
+
+    cvec, _ = _t_coeffs(freqs, P, NU_FIT)
+    cvec = cvec.astype(DTYPE)
+    thetas = jnp.asarray(np.asarray(res.phi), DTYPE)
+
+    @jax.jit
+    def moment(thetas, Xr, Xi):
+        def one(th, xr, xi):
+            t_n = th + cvec * 0.0
+            C, C1, C2 = _moments_real_xla(t_n, xr, xi)
+            return jnp.sum(C) + jnp.sum(C1) + jnp.sum(C2)
+        return jnp.sum(jax.vmap(one)(thetas, Xr, Xi))
+
+    t_dft, _ = devtime(lambda: dft_only(ports), lambda r: r)
+    t_xasm, _ = devtime(lambda: xasm(ports, noise), lambda r: r)
+    t_seed, _ = devtime(lambda: seed(ports, noise), lambda r: r)
+    t_pars, _ = devtime(lambda: parseval(ports, noise), lambda r: r)
+    t_loopfin, _ = devtime(loopfin, lambda r: r[0])
+    t_mom, _ = devtime(lambda: moment(thetas, Xr, Xi), lambda r: r)
+
+    ms = lambda t: round(t * 1e3, 2)
+    out = {
+        "metric": "windowed fast-fit slope breakdown, 640x512x2048 K=%d" % K,
+        "batch": NB,
+        "device": str(dev),
+        "nfev_max": nfev,
+        "nfev_median": nfev_med,
+        "full_ms": ms(t_full),
+        "dft_ms": ms(t_dft),
+        "xasm_ms": ms(t_xasm),
+        "seed_ms": ms(t_seed),
+        "parseval_ms": ms(t_pars),
+        "loopfin_precomputedX_ms": ms(t_loopfin),
+        "one_moment_pass_ms": ms(t_mom),
+        "attrib": {
+            "dft": ms(t_dft),
+            "xasm_minus_dft": ms(t_xasm - t_dft),
+            "seed_minus_xasm": ms(t_seed - t_xasm),
+            "full_minus_seed(loop+finalize)": ms(t_full - t_seed),
+            "loop_est(nfev_med*moment)": ms(nfev_med * t_mom),
+        },
+        # built ONLY from independently measured pieces (prepare prefix
+        # + loop/finalize on precomputed X) — never from differences
+        # that include t_full, which would telescope to 1.0
+        "attributed_frac": round((t_seed + t_loopfin) / t_full, 3),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

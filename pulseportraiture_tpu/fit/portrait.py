@@ -254,12 +254,24 @@ def model_harmonic_window(model, nbin, tail=None, floor_sigma=None):
         any_good = True
         spec = spec[good]
         tot = tot[good]
-        if floor_sigma > 0.0 and nharm >= 8:
+        if floor_sigma > 0.0 and nharm >= 64:
             q = nharm // 4
             mu = _np.median(spec[:, -q:], axis=-1) / _np.log(2.0)
+            # a white floor is FLAT: the top eighth and the eighth
+            # below it agree to fluctuation level (median of ~nharm/8
+            # exponentials is stable to ~1/sqrt(m)).  A clean template
+            # whose genuine spectrum is still decaying through the top
+            # quarter (sharp/narrow profiles at high nbin) fails this
+            # 2x-each-way flatness test and gets NO subtraction — the
+            # absolute criterion must stay exact for clean templates
+            q8 = nharm // 8
+            med_hi = _np.median(spec[:, -q8:], axis=-1)
+            med_lo = _np.median(spec[:, -2 * q8:-q8], axis=-1)
+            flat = (med_lo <= 2.0 * med_hi) & (med_hi <= 2.0 * med_lo)
             # an apparent floor holding >10% of the power is signal
             # (or the template is pure noise): don't subtract it
-            mu = _np.where(mu * (nharm - 1) > 0.1 * tot, 0.0, mu)
+            mu = _np.where(flat & (mu * (nharm - 1) <= 0.1 * tot),
+                           mu, 0.0)
         else:
             mu = _np.zeros(spec.shape[0])
         # per-channel tail power above each k (rev_cum[k] is the power

@@ -274,6 +274,13 @@ def _read_hdu(buf, off, defer=()):
     if xt == "BINTABLE":
         names, dt = _table_dtype(header)
         nrows = header["NAXIS2"]
+        # the row stride must equal the summed field widths — decoding
+        # a table whose NAXIS1 disagrees would read every row after
+        # the first from the wrong offset (silent misparse), so refuse
+        if int(header["NAXIS1"]) != dt.itemsize:
+            raise ValueError(
+                f"BINTABLE NAXIS1={header['NAXIS1']} != "
+                f"{dt.itemsize} bytes implied by the TFORM columns")
         rec = np.frombuffer(raw, dtype=dt, count=nrows)
         data = OrderedDict()
         layout = {}

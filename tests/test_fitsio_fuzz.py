@@ -1,0 +1,257 @@
+"""Adversarial fuzz of the dependency-free FITS codec (VERDICT r4 #4).
+
+The golden-file corpus is builder-authored on both sides (forge writes,
+codec reads), so a shared misconception passes silently.  This sweep is
+the independent pressure available without PSRCHIVE/astropy: every case
+is forged byte-by-byte by tests/fits_forge.py (which shares NO code
+with pulseportraiture_tpu.io) under a seeded RNG — randomized column
+types/orders/repeats, TDIM spellings, TSCAL/TZERO conventions, header
+value spellings — and the decode is compared field-by-field against
+the arrays the forge wrote.  Deliberately malformed files must refuse
+with a clear error (ValueError/KeyError), NEVER silently misparse.
+
+Reference envelope: /root/reference/pplib.py:2749-2915 (the reference
+inherits these conventions from PSRCHIVE; this codec must earn them).
+"""
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.io.fitsio import (_parse_card, parse_tform,
+                                            read_fits)
+
+from fits_forge import BLOCK, bintable_hdu, primary_hdu
+
+# column dtype pool: (numpy big-endian dtype, TFORM letter)
+_DTYPES = [("u1", "B"), (">i2", "I"), (">i4", "J"),
+           (">f4", "E"), (">f8", "D")]
+
+
+def _random_table(rng, ncols=None, nrows=None):
+    """Forge-side random table: returns (columns, col_cards,
+    tdim_overrides, expected) where expected maps name -> the
+    physical-value array the codec must produce."""
+    nrows = nrows or int(rng.integers(1, 6))
+    ncols = ncols or int(rng.integers(1, 6))
+    columns, col_cards, tdims, expected = [], {}, {}, {}
+    for c in range(ncols):
+        name = f"COL{c}"
+        if rng.random() < 0.15:
+            width = int(rng.integers(1, 12))
+            vals = np.array(
+                ["".join(chr(rng.integers(65, 90)) for _ in range(width))
+                 .encode() for _ in range(nrows)], dtype=f"S{width}")
+            columns.append((name, vals))
+            expected[name] = vals
+            continue
+        dts, code = _DTYPES[int(rng.integers(len(_DTYPES)))]
+        dt = np.dtype(dts)
+        repeat = int(rng.integers(1, 9))
+        shape = (nrows, repeat) if repeat > 1 else (nrows,)
+        if dt.kind == "f":
+            arr = rng.standard_normal(shape).astype(dt)
+        else:
+            info = np.iinfo(dt)
+            arr = rng.integers(info.min, info.max + 1, shape).astype(dt)
+        columns.append((name, arr))
+        exp = arr.astype(arr.dtype.newbyteorder("="))
+        # FITS scaling conventions, chosen per column
+        r = rng.random()
+        if code == "B" and r < 0.3:
+            col_cards[name] = {"TZERO": -128.0}
+            exp = exp.astype(np.int64) - 128
+        elif code == "I" and r < 0.3:
+            col_cards[name] = {"TZERO": 32768.0}
+            exp = exp.astype(np.int64) + 32768
+        elif r < 0.45:
+            tscal, tzero = 0.5, 3.0  # exactly representable
+            col_cards[name] = {"TSCAL": tscal, "TZERO": tzero}
+            exp = exp.astype(np.float64) * tscal + tzero
+        elif r < 0.55:
+            # trivial scaling cards present: must be a no-op
+            col_cards[name] = {"TSCAL": 1.0, "TZERO": 0.0}
+        # TDIM on multi-element columns, sometimes with alien spacing
+        if repeat > 1 and rng.random() < 0.4:
+            a = int(rng.integers(1, repeat + 1))
+            while repeat % a:
+                a -= 1
+            b = repeat // a
+            sp = " " if rng.random() < 0.5 else ""
+            tdims[name] = f"({sp}{a},{sp}{b}{sp})"
+            exp = exp.reshape((nrows, b, a))
+        expected[name] = exp
+    return columns, col_cards, tdims, expected
+
+
+@pytest.mark.parametrize("seed", range(64))
+def test_fuzz_bintable_roundtrip(seed, tmp_path):
+    """Randomized table layouts decode EXACTLY (values, shapes, dtypes
+    of the physical data) through the codec."""
+    rng = np.random.default_rng(1000 + seed)
+    columns, col_cards, tdims, expected = _random_table(rng)
+    # random junk header cards that must not disturb decoding
+    extra = []
+    if rng.random() < 0.5:
+        extra.append(("OBSERVER", "o'brien"))
+    if rng.random() < 0.5:
+        extra.append(("JUNKF", float(rng.standard_normal())))
+    blob = primary_hdu() + bintable_hdu(
+        "FUZZ", columns, extra_cards=extra, tdim_overrides=tdims,
+        col_cards=col_cards)
+    path = tmp_path / "fuzz.fits"
+    path.write_bytes(blob)
+
+    hdus = read_fits(str(path))
+    assert len(hdus) == 2
+    tbl = hdus[1]
+    assert tbl.name == "FUZZ"
+    assert list(tbl.data.keys()) == [n for n, _ in columns]
+    for name, _ in columns:
+        got, want = tbl.data[name], expected[name]
+        assert got.shape == want.shape, name
+        if want.dtype.kind == "S":
+            assert list(got) == list(want), name
+        else:
+            # exact: integer conventions stay integral, scalings are
+            # exactly-representable factors
+            assert got.dtype.kind == want.dtype.kind, name
+            np.testing.assert_array_equal(got, want, err_msg=name)
+
+
+@pytest.mark.parametrize("seed", range(24))
+def test_fuzz_multi_hdu_and_row_padding(seed, tmp_path):
+    """Two tables back-to-back (block padding between) decode
+    independently; trailing block padding never leaks into data."""
+    rng = np.random.default_rng(5000 + seed)
+    cols1, cc1, td1, exp1 = _random_table(rng)
+    cols2, cc2, td2, exp2 = _random_table(rng)
+    blob = (primary_hdu()
+            + bintable_hdu("T1", cols1, tdim_overrides=td1, col_cards=cc1)
+            + bintable_hdu("T2", cols2, tdim_overrides=td2, col_cards=cc2))
+    path = tmp_path / "two.fits"
+    path.write_bytes(blob)
+    hdus = read_fits(str(path))
+    assert [h.name for h in hdus[1:]] == ["T1", "T2"]
+    for hdu, exp, cols in ((hdus[1], exp1, cols1), (hdus[2], exp2, cols2)):
+        for name, _ in cols:
+            want = exp[name]
+            got = hdu.data[name]
+            if want.dtype.kind == "S":
+                assert list(got) == list(want)
+            else:
+                np.testing.assert_array_equal(got, want, err_msg=name)
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_fuzz_header_card_spellings(seed):
+    """Randomized legal header card spellings parse to the right
+    value: quote escaping, '/' inside strings vs comment delimiters,
+    FORTRAN D exponents, spaced integers/floats, booleans."""
+    rng = np.random.default_rng(9000 + seed)
+    kind = int(rng.integers(5))
+    key = "FUZZKEY"
+    if kind == 0:  # string with escaped quotes and a slash
+        s = "it''s a/test"
+        card = f"{key:8s}= '{s}'            / comment /x"
+        want = "it's a/test"
+    elif kind == 1:  # integer, random width
+        v = int(rng.integers(-10**9, 10**9))
+        card = f"{key:8s}= {str(v).rjust(int(rng.integers(1, 21)))} / c"
+        want = v
+    elif kind == 2:  # float with D exponent (FORTRAN spelling)
+        mant = round(float(rng.uniform(-9, 9)), 6)
+        exp = int(rng.integers(-10, 11))
+        card = f"{key:8s}= {mant}D{exp:+03d}"
+        want = float(f"{mant}E{exp:+03d}")
+    elif kind == 3:  # boolean
+        want = bool(rng.integers(2))
+        card = f"{key:8s}= {'T' if want else 'F':>20s} / bool"
+    else:  # float plain
+        want = round(float(rng.uniform(-1e6, 1e6)), 6)
+        card = f"{key:8s}= {want:>20} / f"
+    k, v, _ = _parse_card(card.ljust(80))
+    assert k == key
+    if isinstance(want, float):
+        assert isinstance(v, float) and v == pytest.approx(want, rel=0,
+                                                           abs=0)
+    else:
+        assert v == want and type(v) is type(want)
+
+
+def _forge_valid(rng, tmp_path):
+    cols, cc, td, _ = _random_table(rng, ncols=3, nrows=3)
+    blob = primary_hdu() + bintable_hdu("T", cols, tdim_overrides=td,
+                                        col_cards=cc)
+    path = tmp_path / "m.fits"
+    return blob, path
+
+
+def _patch_card(blob, key, newcard):
+    """Replace the 80-char header card starting with `key` in raw HDU
+    bytes (byte-level, no codec involvement)."""
+    pat = key.ljust(8).encode()
+    i = blob.find(pat)
+    assert i >= 0 and i % 80 == 0
+    return blob[:i] + newcard.ljust(80).encode("ascii") + blob[i + 80:]
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("kind", [
+    "truncated_header", "truncated_data", "bad_tform", "tdim_mismatch",
+    "naxis1_mismatch", "missing_end", "missing_ttype"])
+def test_fuzz_malformed_refuses_cleanly(kind, seed, tmp_path):
+    """Deliberately broken files raise ValueError/KeyError — the codec
+    must never return silently-misparsed arrays."""
+    rng = np.random.default_rng(seed)
+    blob, path = _forge_valid(rng, tmp_path)
+    if kind == "truncated_header":
+        cut = int(rng.integers(1, BLOCK))
+        blob = blob[:cut]
+    elif kind == "truncated_data":
+        # find the table HDU's data start (second END card) and cut
+        # inside the data
+        first_end = blob.find(b"END" + b" " * 77)
+        second_end = blob.find(b"END" + b" " * 77, first_end + 80)
+        data_start = ((second_end + 80 + BLOCK - 1) // BLOCK) * BLOCK
+        assert len(blob) > data_start + 1
+        blob = blob[:data_start + 1]
+    elif kind == "bad_tform":
+        blob = _patch_card(blob, "TFORM2", "TFORM2  = 'Z       '")
+    elif kind == "tdim_mismatch":
+        # a well-formed table whose only defect is a TDIM that does not
+        # factor its column's repeat count: must refuse at the reshape,
+        # not return a silently mis-shaped array
+        cols = [("A", np.arange(3, dtype=">i2")),
+                ("B", rng.standard_normal((3, 8)).astype(">f4"))]
+        blob = primary_hdu() + bintable_hdu(
+            "T", cols, tdim_overrides={"B": "(3,5)"})
+    elif kind == "naxis1_mismatch":
+        hdr_off = blob.find(b"XTENSION")
+        i = blob.find(b"NAXIS1", hdr_off)
+        width = int(blob[i + 10:i + 30].decode())
+        blob = _patch_card(blob, "NAXIS1",
+                           f"NAXIS1  = {width + 7:>20d}")
+    elif kind == "missing_end":
+        blob = blob.replace(b"END" + b" " * 77, b"        " + b" " * 72)
+    elif kind == "missing_ttype":
+        blob = _patch_card(blob, "TTYPE2", "TXXXX2  = 'GONE    '")
+    path.write_bytes(blob)
+    with pytest.raises((ValueError, KeyError)):
+        read_fits(str(path))
+
+
+def test_fuzz_random_bytes_refuse(tmp_path):
+    """Pure garbage never decodes."""
+    rng = np.random.default_rng(0)
+    for n in (10, 2879, 2880, 5000):
+        p = tmp_path / f"junk{n}.fits"
+        p.write_bytes(rng.integers(0, 256, n, dtype=np.uint8).tobytes())
+        with pytest.raises((ValueError, KeyError)):
+            read_fits(str(p))
+
+
+def test_parse_tform_variants():
+    assert parse_tform("2048E") == (2048, "E", "")
+    assert parse_tform(" 1J ") == (1, "J", "")
+    assert parse_tform("D") == (1, "D", "")
+    assert parse_tform("16X") == (16, "X", "")

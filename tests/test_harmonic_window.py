@@ -218,6 +218,20 @@ def test_window_noise_floor_engages_on_noisy_template(key, rng):
         assert K is not None and K <= 512, (s, K)
 
 
+def test_window_clean_narrow_template_not_mistaken_for_floor():
+    """A clean ultra-narrow template's spectrum is still DECAYING
+    through the top quarter — genuine signal, not a white floor.  The
+    flatness test (top two eighths within 2x) must refuse the
+    subtraction so the floor-aware criterion reduces exactly to the
+    absolute one (which keeps the full spectrum here: real power at
+    1e-4 relative lives near Nyquist, 8 orders above the tail)."""
+    x = (np.arange(NBIN) + 0.5) / NBIN
+    narrow = np.exp(-0.5 * ((x - 0.3) / 0.0005) ** 2)
+    narrow = np.repeat(narrow[None, :], 8, axis=0)
+    assert model_harmonic_window(narrow, NBIN) \
+        == model_harmonic_window(narrow, NBIN, floor_sigma=0) is None
+
+
 def test_window_flat_spectrum_template_stays_full(rng):
     """A genuinely flat-spectrum template (delta pulse) must NOT be
     mistaken for a noise floor: its 'plateau' holds ~all the power, so
