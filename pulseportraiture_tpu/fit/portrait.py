@@ -1755,10 +1755,16 @@ def make_weights(noise_stds, nbin, chan_mask=None, dtype=None):
 
 def _canonical_real_dtype(x):
     """f64 -> f32 on TPU backends (c128 spectra do not compile there);
-    unchanged elsewhere."""
-    if x.dtype == jnp.float64 and jax.default_backend() == "tpu":
-        return x.astype(jnp.float32)
-    return x
+    unchanged elsewhere — including under a host_compute() context on a
+    TPU session (jax.default_device pinned to a CPU device), where the
+    ops execute on host and c128 is fine: callers like align's batched
+    phase-guess rely on keeping f64 there."""
+    if x.dtype != jnp.float64 or jax.default_backend() != "tpu":
+        return x
+    dd = getattr(jax.config, "jax_default_device", None)
+    if dd is not None and getattr(dd, "platform", None) == "cpu":
+        return x
+    return x.astype(jnp.float32)
 
 
 def estimate_tau(port, model, noise_stds, chan_mask=None):

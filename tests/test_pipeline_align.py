@@ -198,3 +198,98 @@ def test_align_fast_routing_matches(epochs_files, tmp_path):
     assert corr > 0.99999, corr
     scale = np.abs(avg_a).max()
     assert np.abs(avg_a - avg_b).max() < 0.02 * scale
+
+
+def test_align_batched_accumulate_matches_loop_reference(epochs_files,
+                                                         tmp_path):
+    """Round-5 batched the two per-subint host loops (phase-guess and
+    weighted accumulate; reference ppalign.py:214-242).  The batched
+    harmonic-domain accumulate (one irfft per iteration) must match a
+    straightforward per-subint rotate-and-stack loop at f64 round-off.
+    The loop reference here re-implements round 4's exact per-subint
+    path over the SAME fit outputs."""
+    import jax.numpy as jnp
+
+    from pulseportraiture_tpu.fit.phase_shift import fit_phase_shift
+    from pulseportraiture_tpu.fit.portrait import (FitFlags,
+                                                   fit_portrait_batch)
+    from pulseportraiture_tpu.ops.rotation import rotate_portrait
+
+    meta, files, model = epochs_files
+    out = str(tmp_path / "avg_b.fits")
+    avg = align_archives(meta, files[0], outfile=out, niter=1, quiet=True)
+
+    # loop reference: identical math, per-subint eager ops
+    md = load_data(files[0], state="Intensity", dedisperse=True,
+                   tscrunch=True, pscrunch=True, quiet=True)
+    model_port = np.asarray(md.masks[0, 0] * md.subints[0, 0])
+    mean_model = model_port.mean(axis=0)
+    aligned = np.zeros((1, 24, 256))
+    total_w = np.zeros((24, 256))
+    for path in files:
+        d = load_data(path, state="Intensity", dedisperse=False,
+                      dededisperse=True, pscrunch=True, quiet=True)
+        ok = np.asarray(d.ok_isubs, int)
+        freqs0 = np.asarray(d.freqs[0], float)
+        Ps_ok = np.asarray(d.Ps[ok], float)
+        masks = np.asarray(d.weights[ok] > 0.0, float)
+        ports = np.asarray(d.subints[ok, 0], float)
+        noise = np.asarray(d.noise_stds[ok, 0], float)
+        DM_guess = 0.0 if d.dmc else float(d.DM)
+        theta0 = np.zeros((len(ok), 5))
+        theta0[:, 1] = DM_guess
+        for j in range(len(ok)):
+            rot = np.asarray(rotate_portrait(
+                jnp.asarray(ports[j]), 0.0, DM_guess, float(Ps_ok[j]),
+                jnp.asarray(freqs0), np.inf))
+            r = fit_phase_shift(rot.mean(axis=0), mean_model,
+                                np.median(noise[j]))
+            theta0[j, 0] = float(r.phase)
+        res = fit_portrait_batch(
+            jnp.asarray(ports), jnp.broadcast_to(
+                jnp.asarray(model_port), ports.shape),
+            jnp.asarray(noise), jnp.asarray(freqs0), jnp.asarray(Ps_ok),
+            jnp.asarray(np.full(len(ok), freqs0.mean())),
+            nu_out=freqs0.mean(), theta0=jnp.asarray(theta0),
+            fit_flags=FitFlags(True, True, False, False, False),
+            chan_masks=jnp.asarray(masks))
+        phis, DMs = np.asarray(res.phi), np.asarray(res.DM)
+        scales = np.asarray(res.scales) * masks
+        nu_ref_fit = np.asarray(res.nu_DM)
+        sub_cube = np.asarray(d.subints[ok], float)
+        for j in range(len(ok)):
+            rotated = np.asarray(rotate_portrait(
+                jnp.asarray(sub_cube[j]), float(phis[j]), float(DMs[j]),
+                float(Ps_ok[j]), jnp.asarray(freqs0),
+                float(nu_ref_fit[j])))
+            noise_j = np.where(noise[j] > 0, noise[j], np.inf)
+            w_j = masks[j] * np.maximum(scales[j], 0.0) / noise_j ** 2
+            aligned += rotated * w_j[None, :, None]
+            total_w += w_j[:, None]
+    aligned /= np.maximum(total_w, 1e-30)[None]
+
+    # f64 round-off agreement (sum order differs: harmonic-domain
+    # accumulate + one irfft vs per-subint irfft + sequential adds)
+    scale = np.abs(aligned).max()
+    assert np.abs(avg - aligned).max() < 1e-10 * scale
+
+
+def test_canonical_real_dtype_keeps_f64_under_host_compute(monkeypatch):
+    """On a TPU session, _canonical_real_dtype downcasts f64 (c128
+    spectra do not compile there) — but NOT inside host_compute(),
+    where ops run on the pinned CPU device: align's batched
+    phase-guess relies on keeping f64 on host (review finding r5)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pulseportraiture_tpu.fit import portrait as pmod
+    from pulseportraiture_tpu.utils.device import host_compute
+
+    monkeypatch.setattr(pmod.jax, "default_backend", lambda: "tpu")
+    x = jnp.asarray(np.arange(4.0), jnp.float64)
+    assert pmod._canonical_real_dtype(x).dtype == jnp.float32
+    with host_compute():
+        # CPU session: host_compute is a nullcontext and default_device
+        # stays unset -> emulate the TPU session's pinned-CPU state
+        with jax.default_device(jax.devices("cpu")[0]):
+            assert pmod._canonical_real_dtype(x).dtype == jnp.float64
