@@ -1,8 +1,9 @@
 """pptoas — measure wideband TOAs and DMs.
 
 Flag parity: reference pptoas.py:1479-1687 (same dests/defaults; the
-scipy `method`/`bounds` knobs have no analogue in the fused-Newton
-engine and are accepted-but-ignored for script compatibility).
+scipy `method` knob has no analogue in the fused-Newton engine and is
+accepted-but-ignored for script compatibility; the TNC `bounds`
+capability is exposed as --bound).
 """
 
 import argparse
@@ -71,12 +72,49 @@ def build_parser():
     p.add_argument("--stream", action="store_true", default=False,
                    help="Cross-archive batched dispatches for large "
                         "campaigns (wideband phi/DM fits only).")
+    p.add_argument("--bound", action="append", default=[],
+                   metavar="PARAM:LO,HI",
+                   help="Box bound on a fit parameter (repeatable): "
+                        "PARAM in {phi,dm,gm,tau,alpha}; LO/HI are "
+                        "floats or 'None' (open).  tau bounds are in "
+                        "log10(rotations) under the default log-tau "
+                        "parameterization.  The reference's TNC "
+                        "`bounds` capability; a fit converging on a "
+                        "bound reports return code 0 (LOCALMINIMUM).")
     p.add_argument("--quiet", action="store_true", default=False)
     # accepted for reference-script compatibility; no-ops here:
     p.add_argument("--psrchive", action="store_true", default=False,
                    help=argparse.SUPPRESS)
     p.add_argument("--method", default=None, help=argparse.SUPPRESS)
     return p
+
+
+_BOUND_PARAMS = {"phi": 0, "dm": 1, "gm": 2, "tau": 3, "alpha": 4}
+
+
+def parse_bounds(specs):
+    """--bound PARAM:LO,HI strings -> (5, 2) array or None."""
+    if not specs:
+        return None
+    bounds = np.full((5, 2), (-np.inf, np.inf))
+    for spec in specs:
+        try:
+            name, rng = spec.split(":")
+            lo, hi = rng.split(",")
+            idx = _BOUND_PARAMS[name.strip().lower()]
+            lo_v = (-np.inf if lo.strip().lower() in ("none", "")
+                    else float(lo))
+            hi_v = (np.inf if hi.strip().lower() in ("none", "")
+                    else float(hi))
+        except (ValueError, KeyError):
+            raise SystemExit(
+                f"--bound: expected PARAM:LO,HI with PARAM in "
+                f"{sorted(_BOUND_PARAMS)}; got {spec!r}")
+        if lo_v > hi_v:
+            raise SystemExit(
+                f"--bound: lower bound exceeds upper in {spec!r}")
+        bounds[idx] = (lo_v, hi_v)
+    return bounds
 
 
 def main(argv=None):
@@ -99,6 +137,11 @@ def main(argv=None):
     if args.flags:
         parts = args.flags.split(",")
         addtnl = dict(zip(parts[0::2], parts[1::2]))
+    bounds = parse_bounds(args.bound)
+    if bounds is not None and (args.stream or args.narrowband
+                               or args.psrchive):
+        raise SystemExit("--bound applies to the standard wideband "
+                         "GetTOAs path (no --stream/--narrowband)")
 
     if args.stream and args.narrowband:
         if (args.psrchive or args.one_DM or args.print_flux
@@ -177,7 +220,7 @@ def main(argv=None):
                     print_flux=args.print_flux,
                     print_parangle=args.print_parangle,
                     addtnl_toa_flags=addtnl, prefetch=args.prefetch,
-                    quiet=args.quiet)
+                    quiet=args.quiet, bounds=bounds)
         if args.one_DM:
             gt.apply_one_DM()
     if args.format == "princeton":

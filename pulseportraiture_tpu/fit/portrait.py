@@ -704,7 +704,8 @@ _POLISH_MAX_ITER = 6
 
 
 def _hybrid_scatter_loop(cgh_plain, cgh_comp, theta0, flags_arr,
-                         max_iter, ftol_comp, dt, lam0=_SCATTER_LAM0):
+                         max_iter, ftol_comp, dt, lam0=_SCATTER_LAM0,
+                         bounds=None):
     """Two-stage scattering Newton: plain f32 accumulation to its own
     convergence floor, then a short compensated (Dot2) polish from the
     converged point.  The first ~14 trips of a compensated fit never
@@ -728,9 +729,9 @@ def _hybrid_scatter_loop(cgh_plain, cgh_comp, theta0, flags_arr,
     cap is refined, not failed, and must not be demoted below what the
     plain lane would have reported."""
     s1 = _newton_loop(cgh_plain, theta0, flags_arr, max_iter,
-                      _scatter_ftol(dt, False), lam0=lam0)
+                      _scatter_ftol(dt, False), lam0=lam0, bounds=bounds)
     s2 = _newton_loop(cgh_comp, s1.theta, flags_arr, _POLISH_MAX_ITER,
-                      ftol_comp, lam0=lam0)
+                      ftol_comp, lam0=lam0, bounds=bounds)
     code = jnp.where(jnp.logical_and(s2.code == 3, s1.code != 3),
                      s1.code, s2.code)
     return s2._replace(nfev=s1.nfev + s2.nfev, it=s1.it + s2.it,
@@ -779,7 +780,7 @@ def _with_no_aux(cgh):
 
 
 def _newton_loop(cgh, theta0, flags_arr, max_iter, ftol, lam0=1.0e-3,
-                 stall_max=4):
+                 stall_max=4, bounds=None):
     """Levenberg-damped Newton minimization given a fused
     (f, grad, hess, aux) evaluator — exactly one cgh() call per
     iteration.  aux is any pytree computed alongside (e.g. the
@@ -804,6 +805,18 @@ def _newton_loop(cgh, theta0, flags_arr, max_iter, ftol, lam0=1.0e-3,
     (tolerated as success, like the reference's {1,2,4};
     pptoaslib.py:1068), 3 max-iterations.
 
+    bounds: optional (5, 2) [lo, hi] box (+-inf = open), the
+    user-facing analogue of the reference's TNC bounds
+    (pptoaslib.py:1039-1060): steps are PROJECTED onto the box
+    (clipped damped Newton — TNC's active-set behavior for a box), an
+    infeasible seed is projected in, and the exit code follows TNC's
+    vocabulary in bounds mode: a converged fit with an ACTIVE bound on
+    a fitted parameter reports 0 (LOCALMINIMUM: |projected g| ~= 0 —
+    the constrained-optimum stop), interior convergence reports 1
+    (CONVERGED); stall/max-iteration codes are unchanged.  With
+    bounds=None the vocabulary is exactly the historical one (0
+    converged, 2 stall, 3 max-iter).
+
     The initial objective is evaluated INSIDE the loop (a bootstrap
     trip with a zero step from f=+inf, g=0, H=I), never before it.
     XLA fuses an outside-the-loop cgh instance into the surrounding
@@ -817,11 +830,37 @@ def _newton_loop(cgh, theta0, flags_arr, max_iter, ftol, lam0=1.0e-3,
     """
     nfix = 1.0 - flags_arr
     dt = theta0.dtype
+    if bounds is not None:
+        blo = jnp.asarray(bounds, dt)[..., 0]
+        bhi = jnp.asarray(bounds, dt)[..., 1]
+        # project an infeasible seed into the box (TNC does the same) —
+        # FITTED parameters only: a fixed parameter's held value is
+        # part of the model, and clipping it would silently corrupt
+        # the fit (reference TNC only bounds fitted parameters)
+        theta0 = jnp.where(flags_arr > 0.0,
+                           jnp.clip(theta0, blo, bhi), theta0)
 
     def mask_gH(g, H):
         g = g * flags_arr
         H = H * jnp.outer(flags_arr, flags_arr) + jnp.diag(nfix)
         return g, H
+
+    def project_active(theta, g, H):
+        """Active-set projection at the box: a parameter pinned at a
+        bound with the gradient pushing OUTWARD is treated like a
+        fixed parameter (g zeroed, identity Hessian row/col), so the
+        convergence measure becomes the PROJECTED gradient — without
+        this, a bound-limited fit never satisfies the interior
+        criterion and burns max_iter re-clipping the same step.  A
+        bound-touching parameter whose gradient points inward stays
+        free (it can leave the bound)."""
+        if bounds is None:
+            return g, H
+        out = ((jnp.isfinite(blo) & (theta <= blo) & (g > 0.0))
+               | (jnp.isfinite(bhi) & (theta >= bhi) & (g < 0.0)))
+        free = 1.0 - out.astype(dt)
+        return g * free, H * jnp.outer(free, free) + jnp.diag(
+            1.0 - free)
 
     def cond(s):
         # max_iter + 1: the bootstrap trip is not a Newton iteration
@@ -836,6 +875,7 @@ def _newton_loop(cgh, theta0, flags_arr, max_iter, ftol, lam0=1.0e-3,
 
     def body(s):
         g, H = mask_gH(s.g, s.H)
+        g, H = project_active(s.theta, g, H)
         pred_cur, dH = _pred(g, H)
         # converged at the incumbent point (handles warm starts at the
         # optimum, where no strictly-improving step exists); the
@@ -857,9 +897,14 @@ def _newton_loop(cgh, theta0, flags_arr, max_iter, ftol, lam0=1.0e-3,
         cap = jnp.asarray(_STEP_CAP, dt)
         step = jnp.clip(step, -cap, cap)
         theta_new = s.theta + step * flags_arr
+        if bounds is not None:
+            theta_new = jnp.where(flags_arr > 0.0,
+                                  jnp.clip(theta_new, blo, bhi),
+                                  theta_new)
         f_new, g_new, H_new, aux_new = cgh(theta_new)
         accept_f = jnp.logical_and(f_new < s.f, jnp.logical_not(conv_now))
-        gm, _ = mask_gH(g_new, H_new)
+        gm, Hm = mask_gH(g_new, H_new)
+        gm, _ = project_active(theta_new, gm, Hm)
         pred_new, _ = _pred(gm, H)
         # f-flat step: f_new within machine noise of f — near the
         # optimum true improvements sink below the f-evaluation noise
@@ -941,6 +986,17 @@ def _newton_loop(cgh, theta0, flags_arr, max_iter, ftol, lam0=1.0e-3,
         done=jnp.asarray(False),
     )
     s = jax.lax.while_loop(cond, body, s0)
+    if bounds is not None:
+        # TNC-vocabulary exit codes in bounds mode: the projection
+        # lands a bound-limited parameter EXACTLY on the clip value,
+        # so activity is an equality test, masked to fitted params
+        # with a finite bound on the touched side
+        at_b = jnp.any(
+            (flags_arr > 0.0)
+            & ((jnp.isfinite(blo) & (s.theta <= blo))
+               | (jnp.isfinite(bhi) & (s.theta >= bhi))))
+        s = s._replace(code=jnp.where(
+            s.code == 0, jnp.where(at_b, 0, 1), s.code))
     # if no trip ever accepted (objective NaN on every evaluation, e.g.
     # corrupted input data), the state still holds the bootstrap
     # placeholders (H=I, aux=0).  Poison them so _finalize_fit reports
@@ -980,6 +1036,7 @@ def _fit_portrait_core(
     use_scatter=False,
     auto_seed=True,
     compensated=False,
+    bounds=None,
 ):
     dt = w.dtype
     flags_arr = FitFlags(*fit_flags).as_array(dt)
@@ -1038,10 +1095,11 @@ def _fit_portrait_core(
     if scatter and compensated:
         s = _hybrid_scatter_loop(
             _with_no_aux(cgh), _with_no_aux(mk_cgh(True)),
-            theta0, flags_arr, max_iter, ftol, dt)
+            theta0, flags_arr, max_iter, ftol, dt, bounds=bounds)
     else:
         s = _newton_loop(_with_no_aux(cgh), theta0, flags_arr, max_iter,
-                         ftol, lam0=_SCATTER_LAM0 if scatter else 1.0e-3)
+                         ftol, lam0=_SCATTER_LAM0 if scatter else 1.0e-3,
+                         bounds=bounds)
     theta = s.theta
 
     H = s.H
@@ -1314,6 +1372,7 @@ def _fit_portrait_core_real(
     max_iter=40,
     ftol=None,
     nharm_total=None,
+    bounds=None,
 ):
     """Stage 2 of the split fit: the (phi, DM, GM) Newton loop and
     result packaging in pure real arithmetic.
@@ -1349,7 +1408,8 @@ def _fit_portrait_core_real(
         f, g, H = _cgh_tail(C, C1, C2, S0inv, cvec, gvec, dt)
         return f, g, H, C
 
-    s = _newton_loop(cgh, theta0.astype(dt), flags_arr, max_iter, ftol)
+    s = _newton_loop(cgh, theta0.astype(dt), flags_arr, max_iter, ftol,
+                     bounds=bounds)
 
     # the loop state carries the Hessian and moment vector C matching
     # s.theta, so no extra moment pass is needed at the solution
@@ -1379,6 +1439,7 @@ def _fit_portrait_core_real_scatter(
     ftol=None,
     compensated=False,
     nharm_total=None,
+    bounds=None,
 ):
     """Stage 2 of the split SCATTERING fit: the (phi, DM, GM, tau,
     alpha) Newton loop on the fused analytic _cgh_scatter evaluator and
@@ -1416,10 +1477,11 @@ def _fit_portrait_core_real_scatter(
     if compensated:
         s = _hybrid_scatter_loop(mk_cgh(False), mk_cgh(True),
                                  theta0.astype(dt), flags_arr,
-                                 max_iter, ftol, dt)
+                                 max_iter, ftol, dt, bounds=bounds)
     else:
         s = _newton_loop(mk_cgh(False), theta0.astype(dt), flags_arr,
-                         max_iter, ftol, lam0=_SCATTER_LAM0)
+                         max_iter, ftol, lam0=_SCATTER_LAM0,
+                         bounds=bounds)
     C, S = s.aux
     return _finalize_fit(
         s.theta, s, s.H, C, S, Sd, nharm, flags_arr, fit_flags,
@@ -1427,8 +1489,8 @@ def _fit_portrait_core_real_scatter(
 
 
 def fast_scatter_fit_one(port, model, noise_stds, chan_mask, freqs, P,
-                         nu_fit, nu_out, theta0, ir_r=None, ir_i=None, *,
-                         fit_flags, log10_tau, max_iter,
+                         nu_fit, nu_out, theta0, ir_r=None, ir_i=None,
+                         bounds=None, *, fit_flags, log10_tau, max_iter,
                          compensated=False, x_bf16=None, nharm_eff=None):
     """One complex-free SCATTERING fit: weights, matmul DFTs + CCF
     seed, the real _cgh_scatter Newton loop — the per-element body for
@@ -1490,7 +1552,8 @@ def fast_scatter_fit_one(port, model, noise_stds, chan_mask, freqs, P,
         Xr.astype(xdt), Xi.astype(xdt), M2w, Sd, freqs, P, nu_fit,
         nu_out, theta0, fit_flags=fit_flags, log10_tau=log10_tau,
         max_iter=max_iter, compensated=compensated,
-        nharm_total=nbin // 2 + 1 if nharm_eff is not None else None)
+        nharm_total=nbin // 2 + 1 if nharm_eff is not None else None,
+        bounds=bounds)
 
 
 def fit_portrait_batch_fast(
@@ -1510,6 +1573,7 @@ def fit_portrait_batch_fast(
     use_scatter=None,
     compensated=None,
     harmonic_window=None,
+    bounds=None,
 ):
     """Batched fit through the split real-arithmetic path: matmul DFTs,
     CCF seed, and a complex-free Newton loop in one program — the TPU
@@ -1532,6 +1596,10 @@ def fit_portrait_batch_fast(
     harmonic count; band-limits the fit to the model's spectral support
     (model_harmonic_window — chi2/dof stay full-spectrum).  'auto'
     derives from the model only when `models` is a host numpy array.
+    bounds: optional (5, 2) [lo, hi] box shared across the batch, or
+    (nb, 5, 2) per-element — the reference's TNC `bounds`
+    (pptoaslib.py:1039-1060); see _newton_loop for the projection and
+    return-code semantics.
     """
     if use_scatter is None:
         use_scatter = derive_use_scatter(fit_flags, log10_tau, theta0) \
@@ -1546,7 +1614,8 @@ def fit_portrait_batch_fast(
             ports, models, noise_stds, freqs, P, nu_fit, nu_out=nu_out,
             theta0=theta0, fit_flags=fit_flags, chan_masks=chan_masks,
             max_iter=max_iter, log10_tau=log10_tau, ir_FT=ir_FT,
-            compensated=compensated, harmonic_window=harmonic_window)
+            compensated=compensated, harmonic_window=harmonic_window,
+            bounds=bounds)
     reject_fixed_tau_seed(theta0, "fit_portrait_batch_fast")
     ports = jnp.asarray(ports)
     nb = ports.shape[0]
@@ -1583,17 +1652,24 @@ def fit_portrait_batch_fast(
         chan_masks = jnp.ones(ports.shape[:2], dt)
 
     x_bf16 = use_bf16_cross_spectrum()
+    if bounds is None:
+        b_ax = "off"
+    else:
+        bounds = jnp.asarray(bounds, dt)
+        b_ax = 0 if bounds.ndim == 3 else None
     fit = _fast_batch_fn(
         FitFlags(*[bool(f) for f in fit_flags]), int(max_iter),
         m_ax, f_ax, p_ax, nf_ax, seed_derotate, x_bf16,
-        nharm_eff)
-    return fit(
-        ports, models, jnp.asarray(noise_stds), chan_masks,
-        freqs, P, nu_fit, nu_out_val, theta0)
+        nharm_eff, b_ax)
+    args = (ports, models, jnp.asarray(noise_stds), chan_masks,
+            freqs, P, nu_fit, nu_out_val, theta0)
+    if b_ax != "off":
+        args = args + (bounds,)
+    return fit(*args)
 
 
 def fast_fit_one(port, model, noise_stds, chan_mask, freqs, P, nu_fit,
-                 nu_out, theta0, *, fit_flags, max_iter,
+                 nu_out, theta0, bounds=None, *, fit_flags, max_iter,
                  seed_derotate=True, x_bf16=None, nharm_eff=None):
     """One complex-free fast fit: weights, matmul DFTs + CCF seed, real
     Newton core — the per-element body shared by the vmapped batch
@@ -1624,7 +1700,8 @@ def fast_fit_one(port, model, noise_stds, chan_mask, freqs, P, nu_fit,
     return _fit_portrait_core_real.__wrapped__(
         Xr, Xi, S0, Sd, freqs, P, nu_fit, nu_out, th0,
         fit_flags=fit_flags, max_iter=max_iter,
-        nharm_total=nbin // 2 + 1 if nharm_eff is not None else None)
+        nharm_total=nbin // 2 + 1 if nharm_eff is not None else None,
+        bounds=bounds)
 
 
 def use_fast_fit_default():
@@ -1650,7 +1727,8 @@ def reject_fixed_tau_seed(theta0, caller):
 
 @lru_cache(maxsize=None)
 def _fast_batch_fn(fit_flags, max_iter, m_ax, f_ax, p_ax, nf_ax,
-                   seed_derotate=True, x_bf16=False, nharm_eff=None):
+                   seed_derotate=True, x_bf16=False, nharm_eff=None,
+                   b_ax="off"):
     """Cached jitted end-to-end fast fit — a fresh jit per call would
     recompile every invocation.  One program: matmul DFTs, real
     cross-spectrum, CCF seed, Newton loop, finalize — no complex types
@@ -1658,15 +1736,22 @@ def _fast_batch_fn(fit_flags, max_iter, m_ax, f_ax, p_ax, nf_ax,
     one = partial(fast_fit_one, fit_flags=fit_flags, max_iter=max_iter,
                   seed_derotate=seed_derotate,
                   x_bf16=x_bf16, nharm_eff=nharm_eff)
-    return jax.jit(jax.vmap(
-        one, in_axes=(0, m_ax, 0, 0, f_ax, p_ax, nf_ax, 0, 0)))
+    # "off" (a string, NOT False) marks no-bounds: False == 0 in
+    # Python, so a boolean sentinel would collide with per-element
+    # bounds (b_ax=0) in the lru_cache key and return the wrong
+    # cached program
+    axes = (0, m_ax, 0, 0, f_ax, p_ax, nf_ax, 0, 0)
+    if b_ax != "off":
+        axes = axes + (b_ax,)
+    return jax.jit(jax.vmap(one, in_axes=axes))
 
 
 def _fit_batch_fast_scatter(ports, models, noise_stds, freqs, P, nu_fit,
                             nu_out=None, theta0=None,
                             fit_flags=FitFlags(), chan_masks=None,
                             max_iter=40, log10_tau=False, ir_FT=None,
-                            compensated=None, harmonic_window=None):
+                            compensated=None, harmonic_window=None,
+                            bounds=None):
     """Batch wrapper for the complex-free scattering lane (see
     fit_portrait_batch_fast, which routes here)."""
     ports = jnp.asarray(ports)
@@ -1696,29 +1781,38 @@ def _fit_batch_fast_scatter(ports, models, noise_stds, freqs, P, nu_fit,
 
         ir_FT = _np.asarray(ir_FT)[..., :nharm_eff]
     ir_r, ir_i = split_ir_host(ir_FT, dt)
+    if bounds is None:
+        b_ax = "off"
+    else:
+        bounds = jnp.asarray(bounds, dt)
+        b_ax = 0 if bounds.ndim == 3 else None
     fit = _fast_scatter_batch_fn(
         FitFlags(*[bool(f) for f in fit_flags]), bool(log10_tau),
         int(max_iter), bool(compensated),
         effective_x_bf16(compensated),
-        m_ax, f_ax, p_ax, nf_ax, use_ir, nharm_eff)
-    return fit(ports, models, jnp.asarray(noise_stds),
-               jnp.asarray(chan_masks, dt), freqs, P, nu_fit,
-               nu_out_arr, jnp.asarray(theta0), ir_r, ir_i)
+        m_ax, f_ax, p_ax, nf_ax, use_ir, nharm_eff, b_ax)
+    args = (ports, models, jnp.asarray(noise_stds),
+            jnp.asarray(chan_masks, dt), freqs, P, nu_fit,
+            nu_out_arr, jnp.asarray(theta0), ir_r, ir_i)
+    if b_ax != "off":
+        args = args + (bounds,)
+    return fit(*args)
 
 
 @lru_cache(maxsize=None)
 def _fast_scatter_batch_fn(fit_flags, log10_tau, max_iter, compensated,
                            x_bf16, m_ax, f_ax, p_ax, nf_ax, use_ir,
-                           nharm_eff=None):
+                           nharm_eff=None, b_ax="off"):
     """Cached jitted end-to-end complex-free scattering batch fit."""
     one = partial(fast_scatter_fit_one, fit_flags=fit_flags,
                   log10_tau=log10_tau, max_iter=max_iter,
                   compensated=compensated, x_bf16=x_bf16,
                   nharm_eff=nharm_eff)
     ir_ax = None  # shared response across the batch
-    return jax.jit(jax.vmap(
-        one,
-        in_axes=(0, m_ax, 0, 0, f_ax, p_ax, nf_ax, 0, 0, ir_ax, ir_ax)))
+    axes = (0, m_ax, 0, 0, f_ax, p_ax, nf_ax, 0, 0, ir_ax, ir_ax)
+    if b_ax != "off":
+        axes = axes + (b_ax,)
+    return jax.jit(jax.vmap(one, in_axes=axes))
 
 
 def derive_use_scatter(fit_flags, log10_tau, theta0):
@@ -1862,6 +1956,7 @@ def fit_portrait(
     log10_tau=False,
     max_iter=40,
     dtype=None,
+    bounds=None,
 ):
     """Fit (phi, DM[, GM, tau, alpha]) of a (nchan, nbin) data portrait
     against a model portrait.  Host-friendly wrapper around the jitted
@@ -1911,6 +2006,7 @@ def fit_portrait(
         use_scatter=use_scatter,
         auto_seed=phi0 is None,
         compensated=use_scatter_compensated(),
+        bounds=None if bounds is None else jnp.asarray(bounds, w.dtype),
     )
 
 
@@ -1930,6 +2026,7 @@ def fit_portrait_batch(
     use_scatter=None,
     ir_FT=None,
     compensated=None,
+    bounds=None,
 ):
     """vmapped portrait fit over a leading batch dimension.
 
@@ -1972,49 +2069,60 @@ def fit_portrait_batch(
     use_ir = ir_FT is not None
     if compensated is None:
         compensated = use_scatter_compensated()
+    if bounds is None:
+        b_ax = "off"
+    else:
+        bounds = jnp.asarray(bounds)
+        b_ax = 0 if bounds.ndim == 3 else None
     fn = _complex_batch_fn(
         FitFlags(*[bool(f) for f in fit_flags]), bool(log10_tau),
         int(max_iter), bool(use_scatter), use_ir, m_ax, f_ax, p_ax,
-        nf_ax, bool(compensated))
+        nf_ax, bool(compensated), b_ax)
     ir_arg = ir_FT if use_ir else None
     nu_out_arr = jnp.broadcast_to(
         jnp.asarray(nu_out_val, ports.dtype), (nb,))
     return fn(ports, models, jnp.asarray(noise_stds),
               None if chan_masks is None else jnp.asarray(chan_masks),
-              freqs, P, nu_fit, nu_out_arr, jnp.asarray(theta0), ir_arg)
+              freqs, P, nu_fit, nu_out_arr, jnp.asarray(theta0), ir_arg,
+              *(() if b_ax == "off" else (bounds,)))
 
 
 @lru_cache(maxsize=None)
 def _complex_batch_fn(fit_flags, log10_tau, max_iter, use_scatter,
                       use_ir, m_ax, f_ax, p_ax, nf_ax,
-                      compensated=False):
+                      compensated=False, b_ax="off"):
     """Cached single-program complex-engine batch fit: weights + DFTs +
     vmapped _fit_portrait_core compiled together."""
 
     def run(ports, models, noise_stds, chan_masks, freqs, P, nu_fit,
-            nu_out_arr, theta0, ir_FT):
+            nu_out_arr, theta0, ir_FT, bounds=None):
         nbin = ports.shape[-1]
         dt = ports.dtype
         w = make_weights(noise_stds, nbin, chan_masks, dtype=dt)
         dFT = rfft_c(ports)
         mFT = rfft_c(models.astype(dt))
-        core = jax.vmap(
-            partial(
-                _fit_portrait_core,
-                fit_flags=fit_flags,
-                log10_tau=log10_tau,
-                max_iter=max_iter,
-                use_ir=use_ir,
-                use_scatter=use_scatter,
-                compensated=compensated,
-            ),
-            in_axes=(0, m_ax, 0, f_ax, p_ax, nf_ax, 0, 0, None),
-        )
+        axes = (0, m_ax, 0, f_ax, p_ax, nf_ax, 0, 0, None)
+
+        def core_one(dFT1, mFT1, w1, fr, P1, nf1, no1, th1, ir1,
+                     bnd=None):
+            return _fit_portrait_core(
+                dFT1, mFT1, w1, fr, P1, nf1, no1, th1, ir1,
+                fit_flags=fit_flags, log10_tau=log10_tau,
+                max_iter=max_iter, use_ir=use_ir,
+                use_scatter=use_scatter, compensated=compensated,
+                bounds=bnd)
+
+        if b_ax != "off":
+            axes = axes + (b_ax,)
+        core = jax.vmap(core_one, in_axes=axes)
         ir_arg = ir_FT.astype(jnp.complex64 if dt == jnp.float32
                               else jnp.complex128) if use_ir else None
-        return core(dFT, mFT, w,
-                    jnp.asarray(freqs, dt), jnp.asarray(P, dt),
-                    jnp.asarray(nu_fit, dt), nu_out_arr.astype(dt),
-                    theta0.astype(dt), ir_arg)
+        args = (dFT, mFT, w,
+                jnp.asarray(freqs, dt), jnp.asarray(P, dt),
+                jnp.asarray(nu_fit, dt), nu_out_arr.astype(dt),
+                theta0.astype(dt), ir_arg)
+        if b_ax != "off":
+            args = args + (jnp.asarray(bounds, dt),)
+        return core(*args)
 
     return jax.jit(run, static_argnames=())

@@ -187,12 +187,18 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
             theta0 = np.zeros((len(ok), 5))
             theta0[:, 1] = DM_guess
             with host_compute():
-                rot = np.asarray(rotate_full(
-                    jnp.asarray(ports)[:, None], 0.0, DM_guess,
-                    jnp.asarray(Ps_ok),
-                    jnp.asarray(np.broadcast_to(
-                        freqs0, (len(ok), nchan))), np.inf))
-                profs = rot[:, 0].mean(axis=1)
+                # chunked like the accumulate below: an un-chunked
+                # rotate of a 64x512x2048 f64 archive materializes
+                # ~1 GB of transient c128 spectra on host
+                profs = np.empty((len(ok), nbin))
+                for lo in range(0, len(ok), 16):
+                    sl = slice(lo, lo + 16)
+                    rot = np.asarray(rotate_full(
+                        jnp.asarray(ports[sl])[:, None], 0.0, DM_guess,
+                        jnp.asarray(Ps_ok[sl]),
+                        jnp.asarray(np.broadcast_to(
+                            freqs0, (len(ports[sl]), nchan))), np.inf))
+                    profs[sl] = rot[:, 0].mean(axis=1)
                 r = fit_phase_shift_batch(
                     profs, np.broadcast_to(mean_model, profs.shape),
                     np.median(noise, axis=1))

@@ -382,16 +382,32 @@ class GetTOAs:
                  fit_scat=False, log10_tau=True, scat_guess=None,
                  fix_alpha=False, print_phase=False, print_flux=False,
                  print_parangle=False, addtnl_toa_flags={},
-                 nu_fits=None, max_iter=40, prefetch=False, quiet=None):
+                 nu_fits=None, max_iter=40, prefetch=False, quiet=None,
+                 bounds=None):
         """Measure wideband TOAs (reference pptoas.py:161-792; same
-        options minus the scipy `method`/`bounds` knobs, which have no
-        analogue in the fused-Newton engine).  prefetch=True overlaps
+        options minus the scipy `method` knob, which has no analogue
+        in the fused-Newton engine).  prefetch=True overlaps
         the next archive's load with the current archive's fits.
         scat_guess: (tau_s, nu_MHz, alpha) like the reference, or
         "auto" to estimate tau per subint from the data
-        (fit.portrait.estimate_tau — no reference analogue)."""
+        (fit.portrait.estimate_tau — no reference analogue).
+        bounds: optional (5, 2) [lo, hi] box on (phi, DM, GM,
+        tau-or-log10tau, alpha) — the reference's TNC `bounds`
+        (pptoaslib.py:1039-1060): parameters are clipped to the box and
+        a fit converging ON a bound reports return code 0
+        (LOCALMINIMUM, |projected g| ~= 0); use None entries as +-inf
+        via np.inf."""
         if quiet is None:
             quiet = self.quiet
+        if bounds is not None:
+            bounds = np.asarray(bounds, float)
+            if bounds.shape != (5, 2):
+                raise ValueError(
+                    f"bounds must be (5, 2) [lo, hi] rows for (phi, DM,"
+                    f" GM, tau, alpha); got shape {bounds.shape}")
+            if np.any(bounds[:, 0] > bounds[:, 1]):
+                raise ValueError("bounds: a lower bound exceeds its "
+                                 "upper bound")
         scat_guess = _validate_scat_guess(scat_guess, fit_scat)
         if not fit_scat:
             log10_tau = False
@@ -524,6 +540,7 @@ class GetTOAs:
                         fit_flags=FitFlags(*flags),
                         chan_masks=jnp.asarray(masks[idx], jnp.float32),
                         max_iter=max_iter,
+                        bounds=bounds,
                     )
                 else:
                     # fit_portrait_batch canonicalizes f64 -> f32 on TPU
@@ -548,6 +565,7 @@ class GetTOAs:
                         log10_tau=log10_tau,
                         max_iter=max_iter,
                         ir_FT=ir_FT,
+                        bounds=bounds,
                     )
                 r = {k: np.asarray(v) for k, v in r._asdict().items()}
                 fit_duration += time.time() - tfit
