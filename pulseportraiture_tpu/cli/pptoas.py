@@ -110,6 +110,8 @@ def parse_bounds(specs):
             raise SystemExit(
                 f"--bound: expected PARAM:LO,HI with PARAM in "
                 f"{sorted(_BOUND_PARAMS)}; got {spec!r}")
+        if np.isnan(lo_v) or np.isnan(hi_v):
+            raise SystemExit(f"--bound: NaN bound in {spec!r}")
         if lo_v > hi_v:
             raise SystemExit(
                 f"--bound: lower bound exceeds upper in {spec!r}")

@@ -1652,11 +1652,7 @@ def fit_portrait_batch_fast(
         chan_masks = jnp.ones(ports.shape[:2], dt)
 
     x_bf16 = use_bf16_cross_spectrum()
-    if bounds is None:
-        b_ax = "off"
-    else:
-        bounds = jnp.asarray(bounds, dt)
-        b_ax = 0 if bounds.ndim == 3 else None
+    bounds, b_ax = _resolve_bounds_axis(bounds, dt)
     fit = _fast_batch_fn(
         FitFlags(*[bool(f) for f in fit_flags]), int(max_iter),
         m_ax, f_ax, p_ax, nf_ax, seed_derotate, x_bf16,
@@ -1781,11 +1777,7 @@ def _fit_batch_fast_scatter(ports, models, noise_stds, freqs, P, nu_fit,
 
         ir_FT = _np.asarray(ir_FT)[..., :nharm_eff]
     ir_r, ir_i = split_ir_host(ir_FT, dt)
-    if bounds is None:
-        b_ax = "off"
-    else:
-        bounds = jnp.asarray(bounds, dt)
-        b_ax = 0 if bounds.ndim == 3 else None
+    bounds, b_ax = _resolve_bounds_axis(bounds, dt)
     fit = _fast_scatter_batch_fn(
         FitFlags(*[bool(f) for f in fit_flags]), bool(log10_tau),
         int(max_iter), bool(compensated),
@@ -1813,6 +1805,22 @@ def _fast_scatter_batch_fn(fit_flags, log10_tau, max_iter, compensated,
     if b_ax != "off":
         axes = axes + (b_ax,)
     return jax.jit(jax.vmap(one, in_axes=axes))
+
+
+def _resolve_bounds_axis(bounds, dt=None):
+    """Shared batch-wrapper parse of the bounds argument: returns
+    (bounds_array_or_None, b_ax) where b_ax is the vmap axis — "off"
+    (a string, NOT False: False == 0 would collide with per-element
+    axis 0 in the lru_cache keys), None for a shared (5, 2) box, or 0
+    for per-element (nb, 5, 2)."""
+    if bounds is None:
+        return None, "off"
+    bounds = jnp.asarray(bounds) if dt is None \
+        else jnp.asarray(bounds, dt)
+    if bounds.shape[-2:] != (5, 2) or bounds.ndim not in (2, 3):
+        raise ValueError(
+            f"bounds must be (5, 2) or (nb, 5, 2); got {bounds.shape}")
+    return bounds, (0 if bounds.ndim == 3 else None)
 
 
 def derive_use_scatter(fit_flags, log10_tau, theta0):
@@ -2069,11 +2077,7 @@ def fit_portrait_batch(
     use_ir = ir_FT is not None
     if compensated is None:
         compensated = use_scatter_compensated()
-    if bounds is None:
-        b_ax = "off"
-    else:
-        bounds = jnp.asarray(bounds)
-        b_ax = 0 if bounds.ndim == 3 else None
+    bounds, b_ax = _resolve_bounds_axis(bounds)
     fn = _complex_batch_fn(
         FitFlags(*[bool(f) for f in fit_flags]), bool(log10_tau),
         int(max_iter), bool(use_scatter), use_ir, m_ax, f_ax, p_ax,

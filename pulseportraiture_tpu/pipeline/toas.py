@@ -405,6 +405,12 @@ class GetTOAs:
                 raise ValueError(
                     f"bounds must be (5, 2) [lo, hi] rows for (phi, DM,"
                     f" GM, tau, alpha); got shape {bounds.shape}")
+            if np.any(np.isnan(bounds)):
+                # NaN would sail through the ordering check (nan > hi
+                # is False) and silently poison every fit via the
+                # seed projection's clip
+                raise ValueError("bounds: NaN entries (use +-np.inf "
+                                 "for open bounds)")
             if np.any(bounds[:, 0] > bounds[:, 1]):
                 raise ValueError("bounds: a lower bound exceeds its "
                                  "upper bound")
