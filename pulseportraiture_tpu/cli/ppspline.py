@@ -57,8 +57,10 @@ def main(argv=None):
         dp.show_eigenprofiles(show=False,
                               savefig=outfile + ".eigen.png")
         if dp.ncomp:
-            dp.show_spline_curve_projections(
-                show=False, savefig=outfile + ".proj.png")
+            # writes <outfile>.proj.png and <outfile>.freq.png
+            # (reference ppspline savefig-substring convention)
+            dp.show_spline_curve_projections(show=False,
+                                             savefig=outfile)
     return 0
 
 

@@ -146,6 +146,8 @@ class SplinePortrait(_BasePortrait):
     def show_spline_curve_projections(self, **kwargs):
         from ..viz.plots import show_spline_curve_projections
 
+        snrs = np.asarray(self.SNRsxs[0], float)
+        kwargs.setdefault("weights", snrs / snrs.sum())
         show_spline_curve_projections(self.proj_port, self.freqsxs[0],
                                       tck=self.tck, **kwargs)
 

@@ -193,36 +193,105 @@ def show_profiles(profiles, labels=None, show=True, savefig=None,
     return _finish(fig, show, savefig)
 
 
+# composite red-chi2 histogram bin edges (reference pplib.py:3955-3957):
+# fine [0, 2], coarser decades above, an overflow bin at the end
+_RCHI2_BINS = np.concatenate([
+    np.linspace(0.0, 2.0, 21), np.linspace(3.0, 10.0, 8),
+    np.linspace(20.0, 100.0, 9), np.linspace(200.0, 1000.0, 9),
+    [np.inf]])
+
+
 def show_residual_plot(port, model, phases=None, freqs=None,
                        noise_stds=None, weights=None, titles=None,
-                       show=True, savefig=None):
+                       resids=None, nfit=0, rvrsd=False, colorbar=True,
+                       show=True, savefig=None, **imshow_kwargs):
     """Data / model / residual triptych with a per-channel reduced-chi2
-    histogram (reference pplib.py:3853-3974)."""
+    histogram (reference pplib.py:3853-3974; same behaviors):
+
+    - the model panel shares the DATA panel's color limits, so over-
+      and under-fitting are visible at a glance;
+    - per-panel colorbars (colorbar=False to drop), rvrsd frequency
+      flip, imshow passthrough kwargs (vmin/vmax/cmap/...);
+    - axis labels fall back to bin/channel NUMBERS when phases/freqs
+      are not given;
+    - the histogram uses the reference's composite bins (fine to 2,
+      decade blocks above, overflow at inf), a step outline, log x
+      when the channel spread exceeds two decades, x-limits hugging
+      [0.9 min, 1.1 max], and counts only unzapped channels
+      ("# chans. (total = N)"); dof = nbin - nfit per channel.
+    resids: precomputed residuals (default port - model); noise_stds:
+    per-channel sigmas (None -> power-spectrum estimate); weights:
+    channels with weight <= 0 are excluded from the histogram (the
+    reference compresses on the row means)."""
+    from ..ops.noise import get_noise_PS
+
     port = np.asarray(port)
     model = np.asarray(model)
-    resid = port - model
+    resid = np.asarray(resids) if resids is not None else port - model
     nchan, nbin = port.shape
-    phases = np.asarray(phases) if phases is not None else \
-        (np.arange(nbin) + 0.5) / nbin
-    freqs = np.asarray(freqs) if freqs is not None else np.arange(nchan)
+    if phases is None:
+        phases = np.arange(nbin)
+        xlabel = "Bin Number"
+    else:
+        phases = np.asarray(phases)
+        xlabel = "Phase [rot]"
+    if freqs is None:
+        freqs = np.arange(nchan)
+        ylabel = "Channel Number"
+    else:
+        freqs = np.asarray(freqs)
+        ylabel = "Frequency [MHz]"
+    if noise_stds is not None:
+        noise_stds = np.asarray(noise_stds)
+    if rvrsd:
+        freqs = freqs[::-1]
+        port, model, resid = port[::-1], model[::-1], resid[::-1]
+        if noise_stds is not None:
+            noise_stds = noise_stds[::-1]
+        if weights is not None:
+            weights = np.asarray(weights)[::-1]
     extent = [phases[0], phases[-1], freqs[0], freqs[-1]]
-    fig, axes = plt.subplots(2, 2, figsize=(9, 7))
+    fig, axes = plt.subplots(2, 2, figsize=(8.5, 6.67))
+    im0 = None
     panels = [(port, "Data"), (model, "Model"), (resid, "Residuals")]
     for i, (ax, (img, name)) in enumerate(zip(axes.flat, panels)):
-        ax.imshow(img, aspect="auto", origin="lower", extent=extent)
+        kw = dict(imshow_kwargs)
+        if i == 1 and im0 is not None and "vmin" not in kw \
+                and "norm" not in kw:
+            # reference: the model panel inherits the data panel's clim
+            # (skipped when the caller controls scaling via vmin/norm —
+            # imshow rejects a norm combined with vmin/vmax)
+            kw["vmin"], kw["vmax"] = im0.get_clim()
+        im = ax.imshow(img, aspect="auto", origin="lower", extent=extent,
+                       interpolation="none", **kw)
+        if i == 0:
+            im0 = im
+        if colorbar:
+            fig.colorbar(im, ax=ax)
         ax.set_title(titles[i] if titles else name)
-        ax.set_xlabel("Phase [rot]")
-        ax.set_ylabel("Frequency [MHz]")
+        ax.set_xlabel(xlabel)
+        ax.set_ylabel(ylabel)
+
     ax = axes.flat[3]
-    if noise_stds is not None:
-        sig = np.where(np.asarray(noise_stds) > 0, noise_stds, np.inf)
-        rchi2 = (resid ** 2).sum(axis=1) / sig ** 2 / max(nbin - 1, 1)
-        if weights is not None:
-            rchi2 = rchi2[np.asarray(weights) > 0]
-        ax.hist(rchi2[np.isfinite(rchi2)], bins=min(30, max(5, nchan // 4)),
-                color="0.3")
-        ax.set_xlabel(r"Channel red-$\chi^2$")
-        ax.set_ylabel("Count")
+    ok = np.asarray(weights) > 0 if weights is not None \
+        else np.abs(port).mean(axis=1) > 0
+    if noise_stds is None:
+        sig = np.asarray(get_noise_PS(port))  # vectorized over rows
+    else:
+        sig = noise_stds
+    sig = np.where(sig > 0, sig, np.inf)
+    dof = max(nbin - nfit, 1)
+    rchi2 = (resid ** 2).sum(axis=1) / sig ** 2 / dof
+    rchi2 = rchi2[ok & np.isfinite(rchi2)]
+    if len(rchi2):
+        ax.hist(rchi2, bins=_RCHI2_BINS, histtype="step", color="k")
+        lo, hi = rchi2.min(), rchi2.max()
+        if lo > 0 and np.log10(hi) - np.log10(lo) > 2:
+            ax.semilogx()
+        ax.set_xlim(0.9 * lo, 1.1 * hi)
+        ax.set_xlabel(r"Red. $\chi^2$")
+        ax.set_ylabel(f"# chans. (total = {len(rchi2)})")
+        ax.set_title(r"Channel Reduced $\chi^2$")
     else:
         ax.axis("off")
     fig.tight_layout()
@@ -248,32 +317,61 @@ def plot_flux_profile(freqs, fluxes, flux_errs, fit_result, nu_ref,
 
 def show_eigenprofiles(eigvec, smooth_eigvec=None, mean_prof=None,
                        smooth_mean_prof=None, show=True, savefig=None,
-                       title=None):
+                       title=None, xlim=(0.0, 1.0), show_snrs=False):
     """Mean profile + significant eigenprofiles, raw and smoothed
-    (reference pplib.py:4126-4207)."""
+    (reference pplib.py:4126-4207; same behaviors): one shared-phase
+    column — mean panel first (raw as a faint dotted line under the
+    heavy smoothed curve), then one panel per eigenprofile labelled
+    1-indexed; x is PHASE in rotations (bin centers), clipped to
+    `xlim`; show_snrs annotates each smoothed eigenprofile with the
+    Fourier-domain S/N used by the significance veto
+    (find_significant_eigvec: spectral power of the smoothed vector
+    over the raw vector's scaled noise).
+
+    eigvec / smooth_eigvec: (nbin, ncomp) columns (this framework's
+    PCA layout; the reference passes (ncomp, nbin) rows)."""
+    from ..ops.noise import get_noise_PS
+
     eigvec = np.asarray(eigvec)
     ncomp = eigvec.shape[1] if eigvec.ndim == 2 else 0
-    nrows = ncomp + (1 if mean_prof is not None else 0)
-    fig, axes = plt.subplots(max(nrows, 1), 1,
-                             figsize=(6, 2 * max(nrows, 1)),
+    nrows = max(ncomp + (1 if mean_prof is not None else 0), 1)
+    fig, axes = plt.subplots(nrows, 1, figsize=(7, 2.2 * nrows),
                              sharex=True, squeeze=False)
     irow = 0
     if mean_prof is not None:
+        mean_prof = np.asarray(mean_prof)
+        ph = (np.arange(len(mean_prof)) + 0.5) / len(mean_prof)
         ax = axes[irow, 0]
-        ax.plot(mean_prof, "k-", lw=0.8, label="mean")
+        ax.plot(ph, mean_prof, "k:", alpha=0.5)
         if smooth_mean_prof is not None:
-            ax.plot(smooth_mean_prof, "r-", lw=1, label="smoothed")
-        ax.legend(loc="upper right", fontsize=7)
+            ax.plot(ph, np.asarray(smooth_mean_prof), "k-", lw=2)
+        ax.set_ylabel("Mean profile")
+        ax.yaxis.set_label_coords(-0.1, 0.5)
         irow += 1
     for icomp in range(ncomp):
         ax = axes[irow, 0]
-        ax.plot(eigvec[:, icomp], "k-", lw=0.8,
-                label=f"eigvec {icomp}")
+        ph = (np.arange(eigvec.shape[0]) + 0.5) / eigvec.shape[0]
+        ax.plot(ph, eigvec[:, icomp], "k:", alpha=0.5)
         if smooth_eigvec is not None:
-            ax.plot(np.asarray(smooth_eigvec)[:, icomp], "r-", lw=1)
-        ax.legend(loc="upper right", fontsize=7)
+            sm = np.asarray(smooth_eigvec)[:, icomp]
+            ax.plot(ph, sm, "k-", lw=2)
+            if show_snrs:
+                # the significance veto's Fourier S/N: smoothed
+                # spectral power (DC excluded) over the raw vector's
+                # Fourier-scaled noise (reference pplib.py:4168-4174)
+                noise = float(get_noise_PS(eigvec[:, icomp])) \
+                    * np.sqrt(len(sm) / 2.0)
+                if noise > 0.0:  # same guard as the significance veto
+                    snr = np.sum(np.abs(np.fft.rfft(sm)[1:]) ** 2) \
+                        / noise
+                    ax.text(0.9, 0.9, f"S/N = {snr:.0f}", ha="center",
+                            va="center", transform=ax.transAxes)
+        ax.set_ylabel(f"Eigenprofile {icomp + 1}")
+        ax.yaxis.set_label_coords(-0.1, 0.5)
         irow += 1
-    axes[-1, 0].set_xlabel("Phase bin")
+    for ax in axes[:, 0]:
+        ax.set_xlim(xlim)
+    axes[-1, 0].set_xlabel("Phase [rot]")
     if title:
         axes[0, 0].set_title(title)
     fig.tight_layout()
@@ -281,44 +379,123 @@ def show_eigenprofiles(eigvec, smooth_eigvec=None, mean_prof=None,
 
 
 def show_spline_curve_projections(proj, freqs, tck=None, ncoord=None,
-                                  show=True, savefig=None, title=None):
-    """Pairwise projected-coordinate plots + coordinate-vs-frequency
-    with spline curves and knots (reference pplib.py:3977-4123)."""
+                                  show=True, savefig=None, title=None,
+                                  weights=None, icoord=None):
+    """Projections of the fitted B-spline evolution curve (reference
+    pplib.py:3977-4123; same behaviors, two figures):
+
+    - a PAIRWISE grid over every coordinate pair (upper triangle of an
+      (ncoord-1) x (ncoord-1) layout, shared "Coordinate" master
+      labels), and a coordinate-vs-FREQUENCY column with a shared
+      frequency axis;
+    - per-channel points carry the fit's structure: marker size maps
+      the spline-fit weights onto [5, 15] pt, opacity ramps 0.25 -> 1
+      along the channel order, a thin black line connects the data in
+      order, the 10x-oversampled spline curve is drawn in green, and
+      the knot locations are starred;
+    - descending-frequency (negative-bandwidth) data flips the curve
+      overlays so they draw in plot order;
+    - icoord selects ONE coordinate-vs-frequency panel (no pair grid);
+      ncoord limits how many leading coordinates are shown;
+    - savefig writes <base>.proj.png and <base>.freq.png like the
+      reference.
+
+    Returns (pair_fig_or_None, freq_fig)."""
+    from matplotlib.colors import to_rgba
+
     from ..models.spline import bspline_eval
 
     proj = np.asarray(proj)
     freqs = np.asarray(freqs)
-    ncomp = proj.shape[1] if ncoord is None else ncoord
+    nprof, ntot = proj.shape
+    if icoord is not None:
+        if not 0 <= icoord < ntot:
+            raise ValueError(f"0 <= icoord < {ntot}; got {icoord}")
+        coords = [icoord]
+    else:
+        ncoord = ntot if ncoord is None else ncoord
+        if not 1 <= ncoord <= ntot:
+            raise ValueError(f"1 <= ncoord <= {ntot}; got {ncoord}")
+        coords = list(range(ncoord))
+    flip = -1 if len(freqs) > 1 and freqs[0] > freqs[-1] else 1
     if tck is not None:
-        grid = np.linspace(freqs.min(), freqs.max(), 256)
-        curve = np.asarray(bspline_eval(grid, tck))
-        knots = np.asarray(tck[0])
-        kin = knots[(knots >= freqs.min()) & (knots <= freqs.max())]
-        knot_vals = np.asarray(bspline_eval(kin, tck)) if len(kin) else None
-    npair = max(ncomp - 1, 0)
-    fig, axes = plt.subplots(1, npair + ncomp,
-                             figsize=(3 * (npair + ncomp), 3),
-                             squeeze=False)
-    icol = 0
-    for i in range(npair):
-        ax = axes[0, icol]
-        ax.plot(proj[:, i], proj[:, i + 1], "k.", ms=3)
+        grid = np.linspace(freqs.min(), freqs.max(), nprof * 10)
+        curve = np.atleast_2d(np.asarray(bspline_eval(grid, tck)))
+        knot_pos = np.asarray(tck[0])
+        knot_vals = np.atleast_2d(np.asarray(bspline_eval(knot_pos,
+                                                          tck)))
+    # weight-mapped marker sizes on [5, 15] pt, opacity ramp along the
+    # channel order (reference pplib.py:4040-4046)
+    if weights is None:
+        ms = np.full(nprof, 4.0)
+    else:
+        w = np.asarray(weights, float)
+        span = w.max() - w.min()
+        ms = 5.0 + 10.0 * (w - w.min()) / (span if span > 0 else 1.0)
+    alphas = np.linspace(0.25, 1.0, nprof)
+    colors = np.asarray([to_rgba("purple", a) for a in alphas])
+
+    def scatter_pts(ax, x, y):
+        ax.scatter(x, y, s=ms ** 2, c=colors, marker="o",
+                   linewidths=0.0)
+
+    npair_axis = len(coords) - 1
+    fig_pair = None
+    if icoord is None and npair_axis >= 1:
+        fig_pair, paxes = plt.subplots(
+            npair_axis, npair_axis, squeeze=False,
+            figsize=(3 * npair_axis + 2, 3 * npair_axis + 2))
+        for ix in range(npair_axis):        # x coordinate index
+            for iy in range(npair_axis):    # row: y coordinate ix+...
+                oy = iy + 1
+                ax = paxes[iy, ix]
+                if oy <= ix:                # lower triangle: unused
+                    ax.axis("off")
+                    continue
+                scatter_pts(ax, proj[:, ix], proj[:, oy])
+                ax.plot(proj[:, ix], proj[:, oy], "k-", lw=1)
+                if tck is not None:
+                    ax.plot(curve[:, ix], curve[:, oy], "g-", lw=2)
+                    ax.plot(knot_vals[:, ix], knot_vals[:, oy], "k*",
+                            ms=10)
+                if oy == npair_axis:
+                    ax.set_xlabel(str(ix + 1))
+                else:
+                    ax.tick_params(labelbottom=False)
+                if ix == 0:
+                    ax.set_ylabel(str(oy + 1))
+                else:
+                    ax.tick_params(labelleft=False)
+        fig_pair.supxlabel("Coordinate")
+        fig_pair.supylabel("Coordinate")
+        if title:
+            fig_pair.suptitle(title)
+
+    fig_freq, faxes = plt.subplots(len(coords), 1, sharex=True,
+                                   squeeze=False,
+                                   figsize=(7, 3 * len(coords) + 1))
+    for row, ic in enumerate(coords):
+        ax = faxes[row, 0]
+        scatter_pts(ax, freqs, proj[:, ic])
+        ax.plot(freqs, proj[:, ic], "k-", lw=1)
         if tck is not None:
-            ax.plot(curve[:, i], curve[:, i + 1], "r-", lw=1)
-        ax.set_xlabel(f"coord {i}")
-        ax.set_ylabel(f"coord {i + 1}")
-        icol += 1
-    for i in range(ncomp):
-        ax = axes[0, icol]
-        ax.plot(freqs, proj[:, i], "k.", ms=3)
-        if tck is not None:
-            ax.plot(grid, curve[:, i], "r-", lw=1)
-            if knot_vals is not None:
-                ax.plot(kin, knot_vals[:, i], "b|", ms=10)
-        ax.set_xlabel("Frequency [MHz]")
-        ax.set_ylabel(f"coord {i}")
-        icol += 1
+            ax.plot(grid[::flip], curve[:, ic][::flip], "g-", lw=2)
+            ax.plot(knot_pos[::flip], knot_vals[:, ic][::flip], "k*",
+                    ms=10)
+        ax.set_ylabel(f"Coordinate {ic + 1}")
+        ax.yaxis.set_label_coords(-0.1, 0.5)
+    faxes[-1, 0].set_xlabel("Frequency [MHz]")
     if title:
-        fig.suptitle(title)
-    fig.tight_layout()
-    return _finish(fig, show, savefig)
+        fig_freq.suptitle(title)
+
+    if savefig:
+        if fig_pair is not None:
+            fig_pair.savefig(f"{savefig}.proj.png", format="png",
+                             bbox_inches="tight", dpi=120)
+            plt.close(fig_pair)
+        fig_freq.savefig(f"{savefig}.freq.png", format="png",
+                         bbox_inches="tight", dpi=120)
+        plt.close(fig_freq)
+    elif show:
+        plt.show()
+    return fig_pair, fig_freq

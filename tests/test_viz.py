@@ -103,5 +103,127 @@ def test_show_profiles_and_residual_smoke():
     assert fig.axes[0].get_legend() is not None
     fig2 = show_residual_plot(port, port * 1.01,
                               noise_stds=np.full(len(port), 0.01),
-                              show=False)
+                              colorbar=False, show=False)
     assert len(fig2.axes) == 4
+
+
+def test_show_residual_plot_reference_behaviors():
+    """Round-5 parity for show_residual_plot (pplib.py:3853-3974):
+    model panel inherits the DATA panel's clim; per-panel colorbars;
+    composite step histogram counting only unzapped channels with the
+    '# chans. (total = N)' label; default bin/channel-number labels
+    when phases/freqs are absent."""
+    port = _port()
+    model = 0.9 * port
+    noise = np.full(len(port), 0.05)
+    w = np.ones(len(port))
+    w[2] = 0.0
+    fig = show_residual_plot(port, model, noise_stds=noise, weights=w,
+                             show=False)
+    # 4 panels + 3 colorbars
+    assert len(fig.axes) == 7
+    img_axes = [a for a in fig.axes if a.get_images()]
+    assert len(img_axes) == 3
+    data_im, model_im, _ = [a.get_images()[0] for a in img_axes]
+    assert model_im.get_clim() == data_im.get_clim()
+    # default labels are bin/channel numbers (no phases/freqs given)
+    assert img_axes[0].get_xlabel() == "Bin Number"
+    assert img_axes[0].get_ylabel() == "Channel Number"
+    # histogram: zapped channel excluded from the count label
+    ax_h = next(a for a in fig.axes if "# chans." in a.get_ylabel())
+    assert f"total = {len(port) - 1}" in ax_h.get_ylabel()
+    # step outline (Polygon patch), not filled bars only
+    assert ax_h.patches
+
+
+def test_show_residual_plot_rvrsd_and_clim_override():
+    port = _port()
+    freqs = np.linspace(1300.0, 1500.0, len(port))
+    phases = (np.arange(port.shape[1]) + 0.5) / port.shape[1]
+    fig = show_residual_plot(port, port * 0.5, phases, freqs,
+                             noise_stds=np.full(len(port), 0.05),
+                             rvrsd=True, colorbar=False, show=False,
+                             vmin=0.0, vmax=3.0)
+    img_axes = [a for a in fig.axes if a.get_images()]
+    im = img_axes[0].get_images()[0]
+    # rvrsd flips the frequency extent
+    ext = im.get_extent()
+    assert ext[2] > ext[3]
+    # explicit vmin/vmax wins everywhere
+    assert im.get_clim() == (0.0, 3.0)
+    assert img_axes[1].get_images()[0].get_clim() == (0.0, 3.0)
+    assert img_axes[0].get_xlabel() == "Phase [rot]"
+
+
+def test_show_eigenprofiles_reference_behaviors():
+    """Round-5 parity (pplib.py:4126-4207): phase-in-rotations x axis,
+    1-indexed 'Eigenprofile N' labels, raw-dotted under smoothed-solid,
+    S/N annotation, xlim clipping."""
+    nbin, ncomp = 128, 2
+    rng = np.random.default_rng(0)
+    x = (np.arange(nbin) + 0.5) / nbin
+    ev = np.stack([np.sin(2 * np.pi * x), np.cos(2 * np.pi * x)], -1)
+    ev_noisy = ev + 0.05 * rng.standard_normal(ev.shape)
+    mean = np.exp(-0.5 * ((x - 0.5) / 0.05) ** 2)
+    from pulseportraiture_tpu.viz.plots import show_eigenprofiles
+
+    fig = show_eigenprofiles(ev_noisy, smooth_eigvec=ev, mean_prof=mean,
+                             smooth_mean_prof=mean, show=False,
+                             show_snrs=True, xlim=(0.1, 0.9),
+                             title="t")
+    assert len(fig.axes) == 3
+    assert fig.axes[0].get_ylabel() == "Mean profile"
+    assert fig.axes[1].get_ylabel() == "Eigenprofile 1"
+    assert fig.axes[2].get_ylabel() == "Eigenprofile 2"
+    assert fig.axes[2].get_xlabel() == "Phase [rot]"
+    assert fig.axes[0].get_title() == "t"
+    # phases in rotations, clipped to xlim
+    assert fig.axes[1].get_xlim() == (0.1, 0.9)
+    xs = fig.axes[1].lines[0].get_xdata()
+    assert 0.0 < xs[0] < 0.01 and 0.99 < xs[-1] < 1.0
+    # S/N annotations on the smoothed eigen panels
+    texts = [t.get_text() for ax in fig.axes[1:] for t in ax.texts]
+    assert len(texts) == 2 and all(t.startswith("S/N") for t in texts)
+
+
+def test_show_spline_curve_projections_reference_behaviors(tmp_path):
+    """Round-5 parity (pplib.py:3977-4123): two figures (pair grid +
+    frequency column), knot stars, weight-mapped marker sizes,
+    descending-frequency flip, icoord single-panel mode, and the
+    .proj.png/.freq.png save convention."""
+    from scipy.interpolate import splprep
+
+    from pulseportraiture_tpu.viz.plots import (
+        show_spline_curve_projections)
+
+    nchan, ncomp = 24, 3
+    freqs = np.linspace(1500.0, 1300.0, nchan)  # descending band
+    t = np.linspace(0, 1, nchan)
+    proj = np.stack([t, t ** 2, np.sin(3 * t)], -1)
+    tck, _ = splprep(list(proj.T), u=freqs[::-1], k=3, s=0.0)
+    w = np.linspace(1.0, 3.0, nchan)
+    figp, figf = show_spline_curve_projections(
+        proj, freqs, tck=tck, weights=w, show=False)
+    # pair grid: (ncomp-1)^2 layout with the lower triangle blanked
+    pair_axes = [a for a in figp.axes if a.axison]
+    assert len(pair_axes) == ncomp * (ncomp - 1) // 2
+    # frequency column: one panel per coordinate, shared x, knot stars
+    assert len(figf.axes) == ncomp
+    assert figf.axes[-1].get_xlabel() == "Frequency [MHz]"
+    assert figf.axes[0].get_ylabel() == "Coordinate 1"
+    # scatter sizes map the weights onto [5,15]pt (s = ms^2)
+    sc = figf.axes[0].collections[0]
+    sizes = sc.get_sizes()
+    assert sizes.min() == pytest.approx(25.0) \
+        and sizes.max() == pytest.approx(225.0)
+    # icoord mode: single frequency panel, no pair figure
+    figp1, figf1 = show_spline_curve_projections(
+        proj, freqs, tck=tck, icoord=2, show=False)
+    assert figp1 is None and len(figf1.axes) == 1
+    assert figf1.axes[0].get_ylabel() == "Coordinate 3"
+    # save convention
+    base = str(tmp_path / "spl")
+    show_spline_curve_projections(proj, freqs, tck=tck, savefig=base)
+    import os
+    assert os.path.exists(base + ".proj.png")
+    assert os.path.exists(base + ".freq.png")
