@@ -10,9 +10,59 @@ Prints ONE JSON line like bench.py.
 """
 
 import json
+import os
 import sys
+import time
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main_cli():
+    """--cli: the file-level align_archives path (PSRFITS IO + batched
+    phase-guess + harmonic-domain accumulate; round 5 batched its two
+    per-subint host loops — A/B numbers in BENCHMARKS.md).  Host-bound
+    either way; archives cached like bench_campaign."""
+    import jax
+
+    import pulseportraiture_tpu  # noqa: F401
+    from pulseportraiture_tpu.pipeline import align_archives
+    from pulseportraiture_tpu.synth import default_test_model, \
+        make_fake_pulsar
+    from pulseportraiture_tpu.utils.mjd import MJD
+
+    NARCH, NSUB, NCHAN, NBIN, NITER = 4, 16, 64, 512, 2
+    PAR = {"PSR": "FAKE", "P0": 0.003, "DM": 50.0, "PEPOCH": 56000.0}
+    cache = os.environ.get("PPT_ALIGN_CACHE", "/tmp/ppt_align_cli")
+    root = os.path.join(cache, f"{NARCH}x{NSUB}x{NCHAN}x{NBIN}")
+    os.makedirs(root, exist_ok=True)
+    model = default_test_model(1500.0)
+    files = []
+    for i in range(NARCH):
+        p = os.path.join(root, f"ep{i}.fits")
+        if not os.path.exists(p):
+            make_fake_pulsar(model, PAR, outfile=p, nsub=NSUB,
+                             nchan=NCHAN, nbin=NBIN, nu0=1500.0,
+                             bw=600.0, tsub=60.0, phase=0.03 * i,
+                             dDM=1e-4 * i, start_MJD=MJD(55100 + i, 0.2),
+                             noise_stds=0.06, dedispersed=False,
+                             quiet=True, rng=i)
+        files.append(p)
+    out = os.path.join(root, "out.fits")
+    times = []
+    for _ in range(3):  # first rep pays compile; report min (warm)
+        t0 = time.perf_counter()
+        align_archives(files, files[0], niter=NITER, quiet=True,
+                       outfile=out)
+        times.append(time.perf_counter() - t0)
+    print(json.dumps({
+        "metric": f"align_archives CLI path (IO + {NITER} iterations), "
+                  f"{NARCH}x{NSUB}x{NCHAN}x{NBIN}",
+        "value": round(NARCH * NSUB * NITER / min(times), 2),
+        "unit": "subint-iterations/sec",
+        "warm_s": round(min(times), 2),
+        "cold_s": round(times[0], 2),
+        "device": str(jax.devices()[0]),
+    }))
 
 
 def main():
@@ -78,4 +128,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    main_cli() if "--cli" in sys.argv else main()
