@@ -6,7 +6,6 @@ with a jittable Gauss-Newton, and fit_DM_to_freq_resids
 """
 
 
-import jax
 import jax.numpy as jnp
 
 from ..config import Dconst

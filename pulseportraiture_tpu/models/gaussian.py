@@ -14,7 +14,6 @@ jittable; parameters live in a flat pytree so the LM template fitter
 
 from dataclasses import dataclass, field
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

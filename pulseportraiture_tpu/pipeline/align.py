@@ -13,7 +13,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from ..fit.phase_shift import fit_phase_shift, fit_phase_shift_batch
+from ..fit.phase_shift import fit_phase_shift_batch
 from ..fit.portrait import (FitFlags, fit_portrait_batch,
                             fit_portrait_batch_fast,
                             resolve_harmonic_window,

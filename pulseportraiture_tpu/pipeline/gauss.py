@@ -17,7 +17,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..utils.device import on_host
-from ..config import default_model_code, scattering_alpha, wid_max
+from ..config import default_model_code, scattering_alpha
 from ..fit.gauss import fit_gaussian_portrait, fit_gaussian_profile
 from ..fit.phase_shift import fit_phase_shift
 from ..fit.portrait import FitFlags, fit_portrait

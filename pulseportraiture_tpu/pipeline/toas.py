@@ -21,7 +21,6 @@ statistics — re-architected TPU-first:
 
 import time
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
