@@ -349,3 +349,35 @@ def test_window_engages_on_pipeline_built_spline_model(tmp_path):
     rt = fit_portrait_batch_fast(*args, harmonic_window=K)
     assert abs(float(rf.phi[0]) - float(rt.phi[0])) < 1e-4
     assert np.allclose(rf.phi_err, rt.phi_err, rtol=1e-2)
+
+
+def test_window_engages_on_pipeline_built_gauss_model(tmp_path):
+    """The OTHER template factory: a ppgauss-built model is analytic
+    (generated from fitted Gaussian parameters), so the absolute
+    criterion already engages — this locks the window DERIVATION for
+    both pipeline template types (windowed-vs-full FIT parity on
+    analytic templates is covered by test_truncated_fit_parity; the
+    noisy-template fit gates live in the spline sibling test)."""
+    from pulseportraiture_tpu.pipeline.gauss import (
+        DataPortrait as GaussPortrait)
+    from pulseportraiture_tpu.synth import make_fake_pulsar
+    from pulseportraiture_tpu.utils.mjd import MJD
+
+    PAR = {"PSR": "J1909-3744", "RAJ": "19:09:47.4",
+           "DECJ": "-37:44:14.5", "P0": 0.002947, "PEPOCH": 55000.0,
+           "DM": 10.391}
+    nbin = 1024
+    model = default_test_model(1500.0)
+    path = str(tmp_path / "avg.fits")
+    make_fake_pulsar(model, PAR, outfile=path, nsub=1, nchan=24,
+                     nbin=nbin, nu0=1500.0, bw=800.0, tsub=1800.0,
+                     noise_stds=0.02, dedispersed=True,
+                     start_MJD=MJD(55200, 0.3), quiet=True, rng=21)
+    dp = GaussPortrait(path, quiet=True)
+    dp.make_gaussian_model(ref_prof=(1500.0, 200.0), niter=2,
+                           auto_gauss=0.05, quiet=True)
+    K = model_harmonic_window(np.asarray(dp.model), nbin)
+    K_abs = model_harmonic_window(np.asarray(dp.model), nbin,
+                                  floor_sigma=0)
+    assert K is not None and K <= 384, K
+    assert K_abs is not None  # analytic model: no floor needed
