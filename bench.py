@@ -120,7 +120,7 @@ def main():
 
     # (a) synchronized latency: one batch, host sync per rep — includes
     # the tunnel round-trip, the number an interactive caller sees
-    nrep = 3
+    nrep = 5
     t_sync = []
     for _ in range(nrep):
         t0 = time.perf_counter()
@@ -132,11 +132,12 @@ def main():
     # (b) pipelined throughput: enqueue K batches back-to-back, sync
     # once — steady-state rate when streaming a campaign (the per-batch
     # round-trip amortizes away; results are small and pulled async).
-    # Min of 3 runs: the tunneled TPU is shared and its effective
-    # throughput swings severalfold with external load.
+    # Min of 6 runs: the shared tunneled chip's load swings up to ~8x
+    # within minutes; more samples give the min-of-N estimator a
+    # better chance of catching an unloaded window.
     K = 8
     tKs = []
-    for _ in range(3):
+    for _ in range(6):
         t0 = time.perf_counter()
         for _ in range(K):
             res = run()
