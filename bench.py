@@ -29,14 +29,15 @@ def main():
     # end-to-end than 3-pass, and the per-harmonic quantization error
     # averages down across harmonics x channels — the |dphi| gate below
     # measures BETTER than at 'high' at these noise levels (must be set
-    # before the first jit trace — the program caches it).
-    # PPT_XSPEC=float32 reverts the cross-spectrum storage for A/B runs.
+    # before the first jit trace — the program caches it).  The
+    # documented PPT_* env hooks (config.env_overrides: PPT_XSPEC,
+    # PPT_DFT_PRECISION, PPT_DFT_FOLD) re-apply after the script
+    # defaults so A/B runs always win.
     import os as _os
 
     config.dft_precision = "default"
-    config.cross_spectrum_dtype = (
-        None if _os.environ.get("PPT_XSPEC", "").lower() == "float32"
-        else "bfloat16")
+    config.cross_spectrum_dtype = "bfloat16"
+    config.env_overrides()
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
@@ -197,9 +198,9 @@ def main():
     mxu_flops = dft_flops + ccf_flops
     tflops = mxu_flops / t_tpu / 1e12
     # bf16 MXU peak per chip: v5e 197 TFLOPS, v4 275, v5p 459
-    peaks = {"v5 lite": 197.0, "v4": 275.0, "v5p": 459.0, "v6": 918.0}
-    peak = next((v for k, v in peaks.items() if k in str(dev).lower()),
-                None)
+    from benchmarks.common import mxu_peak_tflops
+
+    peak = mxu_peak_tflops(dev)
 
     out = {
         "metric": "wideband (phi,DM) portrait fits, 512ch x 2048bin",

@@ -1,0 +1,207 @@
+"""Stage-attribution driver for the two historically-unprofiled lanes
+(ISSUE 1): the 5-parameter scattering fit (BASELINE config 3) and the
+device-resident raw-campaign bucket program (config 5c).
+
+Built on pulseportraiture_tpu.profiling (the reusable promotion of
+exp_breakdown.py's methodology): each lane is decomposed into named
+PREFIX stages — cumulative slices of the real program, so fusion
+behavior stays honest — plus a PIECE stage (the Newton loop on
+precomputed inputs), and the profiler checks that the independently
+measured stages sum to the end-to-end slope (>= 90% gates the
+benchmarks).
+
+The stage builders here are imported by bench_scatter.py and
+bench_device_campaign.py so their JSON lines carry the same per-stage
+breakdown this script prints; run standalone for the attribution alone:
+
+    python benchmarks/attrib.py scatter
+    python benchmarks/attrib.py campaign
+
+Shapes via PPT_NB / PPT_NCHAN / PPT_NBIN (campaign: PPT_NSUBB).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def scatter_stage_profile(ports, model, noise, freqs, P, nu_fit, th0,
+                          flags, hwin, max_iter, compensated, full_fn,
+                          K=3, nrun=2):
+    """Attribution of the complex-free scattering lane
+    (fit_portrait_batch_fast -> fast_scatter_fit_one):
+
+      dft    (prefix)  windowed matmul DFTs of data + model
+      xasm   (prefix)  + weights, X/M2 assembly, Parseval Sd (no seed)
+      seed   (prefix)  + the tau-matched CCF phase seed
+      newton (piece)   the _cgh_scatter Newton loop + finalize on a
+                       precomputed cross-spectrum
+
+    full_fn: the end-to-end batched fit the bench times (so the
+    attribution denominator is exactly the benched program)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pulseportraiture_tpu.fit.portrait import (
+        FitFlags, _fit_portrait_core_real_scatter, effective_x_bf16,
+        prepare_scatter_fit_real)
+    from pulseportraiture_tpu.ops.fourier import _gated_precision, rfft_mm
+    from pulseportraiture_tpu.profiling import Stage, profile_stages
+
+    dt = ports.dtype
+    nbin = ports.shape[-1]
+    prec = _gated_precision(None)
+    x_bf16 = effective_x_bf16(compensated)
+    kw = dict(fit_flags=flags, log10_tau=True, compensated=compensated,
+              x_bf16=x_bf16, nharm_eff=hwin, seed_derotate=False)
+
+    # every stage program takes its arrays as ARGUMENTS: a jnp array
+    # closed over by jit becomes an embedded constant, and XLA
+    # constant-folds the whole stage at compile time (minutes of
+    # single-threaded folding; the exp_breakdown lesson, round 5)
+    @jax.jit
+    def dft_prefix(ports, model):
+        dr, di = jax.vmap(
+            lambda p: rfft_mm(p, precision=prec, nharm=hwin))(ports)
+        mr, mi = rfft_mm(model.astype(dt), precision=prec, nharm=hwin)
+        return (jnp.sum(dr) + jnp.sum(di) + jnp.sum(mr) + jnp.sum(mi))
+
+    def _prep(seed):
+        fl = flags if seed else FitFlags(False, *flags[1:])
+
+        def one(p, m, n, t):
+            Xr, Xi, M2w, Sd, th = prepare_scatter_fit_real(
+                p, m, n, jnp.ones(p.shape[0], dt), freqs, P,
+                nu_fit, t, **{**kw, "fit_flags": fl})
+            return (jnp.sum(Xr.astype(jnp.float32)) + jnp.sum(M2w)
+                    + Sd + jnp.sum(th))
+
+        return jax.jit(jax.vmap(one, in_axes=(0, None, 0, 0)))
+
+    xasm = _prep(False)
+    seed = _prep(True)
+
+    @jax.jit
+    def prep_out(ports, model, noise, th0):
+        def one(p, m, n, t):
+            return prepare_scatter_fit_real(
+                p, m, n, jnp.ones(p.shape[0], dt), freqs, P,
+                nu_fit, t, **kw)
+
+        return jax.vmap(one, in_axes=(0, None, 0, 0))(
+            ports, model, noise, th0)
+
+    Xr, Xi, M2w, Sd, th = jax.block_until_ready(
+        prep_out(ports, model, noise, th0))
+
+    # X ships as arguments, not closed-over constants — a closure would
+    # embed the spectra into the program (compile-request size limits
+    # on tunneled runtimes)
+    nu_out = jnp.asarray(-1.0, dt)
+    core = jax.jit(jax.vmap(
+        lambda xr, xi, m2, sd, t0: (
+            _fit_portrait_core_real_scatter.__wrapped__(
+                xr, xi, m2, sd, freqs, P, nu_fit, nu_out, t0,
+                fit_flags=flags, log10_tau=True, max_iter=max_iter,
+                compensated=compensated,
+                nharm_total=nbin // 2 + 1 if hwin else None))))
+
+    stages = [
+        Stage("dft", lambda: dft_prefix(ports, model), "prefix"),
+        Stage("xasm", lambda: xasm(ports, model, noise, th0), "prefix"),
+        Stage("seed", lambda: seed(ports, model, noise, th0), "prefix"),
+        Stage("newton", lambda: core(Xr, Xi, M2w, Sd, th), "piece",
+              lambda r: r.phi),
+    ]
+    return profile_stages(full_fn, stages, pick=lambda r: r.phi, K=K,
+                          nrun=nrun)
+
+
+def campaign_stage_profile(raw, scl, offs, cmask, model, freqs, Ps,
+                           DMg, hwin, flags, max_iter, full_fn,
+                           K=3, nrun=2):
+    """Attribution of the fused raw-bucket program (pipeline/stream
+    _raw_fit_fn):
+
+      decode (prefix)  int16 decode + min-window baseline
+      stats  (prefix)  + PS noise, S/N (sort-free median), nu_fit seed
+      fit    (piece)   the batched no-scatter fit on the decoded ports
+
+    The prefixes call the SAME _raw_decode/_raw_stats helpers the
+    production program runs."""
+    import jax
+    import jax.numpy as jnp
+
+    from pulseportraiture_tpu.fit.portrait import FitFlags, _fast_batch_fn
+    from pulseportraiture_tpu.ops.fourier import use_dft_fold
+    from pulseportraiture_tpu.pipeline.stream import _raw_decode, _raw_stats
+    from pulseportraiture_tpu.profiling import Stage, profile_stages
+
+    ft = jnp.float32
+    nbin = raw.shape[-1]
+    tiny = float(np.finfo("float32").tiny)
+
+    # arrays ship as ARGUMENTS, never jit-closed-over constants (XLA
+    # would constant-fold the whole stage at compile time — see
+    # scatter_stage_profile)
+    @jax.jit
+    def decode_prefix(raw, scl, offs):
+        return jnp.sum(_raw_decode(raw, scl, offs, nbin, ft))
+
+    @jax.jit
+    def stats_prefix(raw, scl, offs, cmask, freqs):
+        x = _raw_decode(raw, scl, offs, nbin, ft)
+        noise, snr, nu_fit = _raw_stats(x, cmask, freqs, ft, tiny)
+        return jnp.sum(x) + jnp.sum(noise) + jnp.sum(nu_fit)
+
+    @jax.jit
+    def precompute(raw, scl, offs, cmask, freqs):
+        x = _raw_decode(raw, scl, offs, nbin, ft)
+        noise, snr, nu_fit = _raw_stats(x, cmask, freqs, ft, tiny)
+        return x, noise, nu_fit
+
+    x, noise, nu_fit = jax.block_until_ready(
+        precompute(raw, scl, offs, cmask, freqs))
+    nb = x.shape[0]
+    theta0 = jnp.zeros((nb, 5), ft).at[:, 1].set(DMg.astype(ft))
+    nu_out = jnp.full((nb,), -1.0, ft)
+    fit = _fast_batch_fn(FitFlags(*flags), max_iter, None, None, 0, 0,
+                         seed_derotate=bool(np.any(np.asarray(DMg))),
+                         x_bf16=True, nharm_eff=hwin,
+                         dft_fold=use_dft_fold())
+    Ps_b = jnp.broadcast_to(jnp.asarray(Ps, ft), (nb,))
+
+    stages = [
+        Stage("decode", lambda: decode_prefix(raw, scl, offs),
+              "prefix"),
+        Stage("stats", lambda: stats_prefix(raw, scl, offs, cmask,
+                                            freqs), "prefix"),
+        Stage("fit", lambda: fit(x, model, noise, cmask, freqs, Ps_b,
+                                 nu_fit, nu_out, theta0), "piece",
+              lambda r: r.phi),
+    ]
+    return profile_stages(full_fn, stages, pick=lambda r: r, K=K,
+                          nrun=nrun)
+
+
+def main():
+    lane = sys.argv[1] if len(sys.argv) > 1 else "scatter"
+    if lane == "scatter":
+        from benchmarks import bench_scatter
+
+        out = bench_scatter.run_bench(attrib_only=True)
+    elif lane == "campaign":
+        from benchmarks import bench_device_campaign
+
+        out = bench_device_campaign.run_bench(attrib_only=True)
+    else:
+        raise SystemExit(f"unknown lane {lane!r} (scatter|campaign)")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -30,7 +30,11 @@ def main_cli():
         make_fake_pulsar
     from pulseportraiture_tpu.utils.mjd import MJD
 
-    NARCH, NSUB, NCHAN, NBIN, NITER = 4, 16, 64, 512, 2
+    NARCH = int(os.environ.get("PPT_NARCH", 4))
+    NSUB = int(os.environ.get("PPT_NSUB", 16))
+    NCHAN = int(os.environ.get("PPT_NCHAN", 64))
+    NBIN = int(os.environ.get("PPT_NBIN", 512))
+    NITER = int(os.environ.get("PPT_NITER", 2))
     PAR = {"PSR": "FAKE", "P0": 0.003, "DM": 50.0, "PEPOCH": 56000.0}
     cache = os.environ.get("PPT_ALIGN_CACHE", "/tmp/ppt_align_cli")
     root = os.path.join(cache, f"{NARCH}x{NSUB}x{NCHAN}x{NBIN}")
@@ -78,7 +82,9 @@ def main():
     from pulseportraiture_tpu.fit import fit_portrait_batch_fast
     from pulseportraiture_tpu.ops.rotation import rotate_portrait
 
-    NE, NCHAN, NBIN = 256, 512, 2048
+    NE = int(os.environ.get("PPT_NE", 256))
+    NCHAN = int(os.environ.get("PPT_NCHAN", 512))
+    NBIN = int(os.environ.get("PPT_NBIN", 2048))
     DT = jnp.float32
     P, NU_FIT = 0.003, 1500.0
     model, freqs = bench_model(NCHAN, NBIN)

@@ -35,7 +35,11 @@ def main():
     from pulseportraiture_tpu.synth import default_test_model
     from pulseportraiture_tpu.synth.archive import make_fake_pulsar
 
-    NPSR, NARCH, NSUB, NCHAN, NBIN = 5, 40, 4, 256, 1024
+    NPSR = int(os.environ.get("PPT_NPSR", 5))
+    NARCH = int(os.environ.get("PPT_NARCH", 40))
+    NSUB = int(os.environ.get("PPT_NSUB", 4))
+    NCHAN = int(os.environ.get("PPT_NCHAN", 256))
+    NBIN = int(os.environ.get("PPT_NBIN", 1024))
 
     with tempfile.TemporaryDirectory() as td:
         jobs = []

@@ -41,7 +41,9 @@ def main():
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
-    NB, NCHAN, NBIN = (640 if on_tpu else 128), 512, 2048
+    NB = int(os.environ.get("PPT_NB", 640 if on_tpu else 128))
+    NCHAN = int(os.environ.get("PPT_NCHAN", 512))
+    NBIN = int(os.environ.get("PPT_NBIN", 2048))
     DTYPE = jnp.float32
     P, NU_FIT = 0.003, 1500.0
     s_tmpl = float(os.environ.get("PPT_TEMPLATE_NOISE", 1e-2))
@@ -55,7 +57,8 @@ def main():
         np.asarray(model_clean, np.float64)
         + rng.standard_normal((NCHAN, NBIN)) * s_tmpl, DTYPE)
 
-    NB_SYNTH = 128
+    NB_SYNTH = min(128, NB)
+    NTILE = -(-NB // NB_SYNTH)  # ceil: NB need not be a multiple
 
     @jax.jit
     def synth(key):
@@ -72,7 +75,8 @@ def main():
         rot = irfft_mm(Xr * c - Xi * s, Xr * s + Xi * c, NBIN)
         return rot + 0.05 * jax.random.normal(k3, rot.shape, DTYPE)
 
-    ports = jnp.tile(synth(jax.random.PRNGKey(0)), (NB // NB_SYNTH, 1, 1))
+    ports = jnp.tile(synth(jax.random.PRNGKey(0)),
+                     (NTILE, 1, 1))[:NB]
     noise = jnp.full((NB, NCHAN), 0.05, DTYPE)
     Ps = jnp.full((NB,), P, DTYPE)
     nus = jnp.full((NB,), NU_FIT, DTYPE)

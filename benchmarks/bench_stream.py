@@ -33,7 +33,10 @@ def main():
     from pulseportraiture_tpu.synth import default_test_model
     from pulseportraiture_tpu.synth.archive import make_fake_pulsar
 
-    NARCH, NSUB, NCHAN, NBIN = 16, 16, 256, 1024
+    NARCH = int(os.environ.get("PPT_NARCH", 16))
+    NSUB = int(os.environ.get("PPT_NSUB", 16))
+    NCHAN = int(os.environ.get("PPT_NCHAN", 256))
+    NBIN = int(os.environ.get("PPT_NBIN", 1024))
     PAR = {"PSR": "FAKE", "P0": 0.003, "DM": 50.0, "PEPOCH": 56000.0}
 
     with tempfile.TemporaryDirectory() as td:

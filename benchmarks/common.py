@@ -4,53 +4,27 @@ Timing methodology matches bench.py: the tunneled TPU runtime's
 block_until_ready can return early and host transfers are slow, so every
 measurement enqueues K dispatches back-to-back, reduces to a scalar on
 device, and syncs once — slope = steady-state device time; a single
-synchronized rep gives the interactive latency.
+synchronized rep gives the interactive latency.  The timer itself lives
+in pulseportraiture_tpu.profiling (the reusable stage-attribution
+profiler); this module keeps the import path the benchmarks always used.
 """
 
-import time
-
-import jax
 import jax.numpy as jnp
-import numpy as np
+
+from pulseportraiture_tpu.profiling import devtime  # noqa: F401
 
 
-@jax.jit
-def _scl(x):
-    return jnp.sum(x)
+# bf16 MXU peak per chip, shared by every bench's mfu accounting (one
+# table — a second copy would drift when a chip generation is added)
+MXU_PEAK_TFLOPS = {"v5 lite": 197.0, "v4": 275.0, "v5p": 459.0,
+                   "v6": 918.0}
 
 
-def devtime(fn, pick, K=4, warm=1, nrun=3):
-    """fn() -> result pytree; pick(result) -> array to reduce.
-    Returns (slope_s, single_s).
-
-    Takes the MIN over nrun separate measurements of both the single
-    synchronized rep and the K-rep pipelined run: the tunneled TPU is a
-    shared resource whose effective throughput swings by up to ~8x with
-    external load, and min-of-several is the standard way to estimate
-    the unloaded cost."""
-    for _ in range(warm):
-        _ = np.asarray(_scl(pick(fn())))
-
-    def single():
-        t0 = time.perf_counter()
-        _ = np.asarray(_scl(pick(fn())))
-        return time.perf_counter() - t0
-
-    def krun():
-        t0 = time.perf_counter()
-        for _ in range(K):
-            s = _scl(pick(fn()))
-        _ = np.asarray(s)
-        return time.perf_counter() - t0
-
-    t1 = min(single() for _ in range(nrun))
-    tK = min(krun() for _ in range(nrun))
-    slope = (tK - t1) / (K - 1)
-    if slope <= 0:
-        # different run populations under variable load; conservative
-        # fallback counts one round-trip against the K batches
-        slope = tK / K
-    return slope, t1
+def mxu_peak_tflops(device):
+    """bf16 MXU peak for a jax device, or None when unknown (CPU)."""
+    name = str(device).lower()
+    return next((v for k, v in MXU_PEAK_TFLOPS.items() if k in name),
+                None)
 
 
 def bench_model(nchan, nbin, dtype=jnp.float32, P=0.003, nu_fit=1500.0):

@@ -91,6 +91,23 @@ cross_spectrum_dtype = "bfloat16"
 # (bf16 per-term quantization would dominate what Dot2 removes).
 scatter_compensated = False
 
+# Fold-symmetry matmul DFT (ops/fourier.rfft_mm): cos/sin symmetry of
+# real input halves the contraction length exactly (two (n/2-1)-row
+# matmuls replace two n-row ones; accuracy stays f32-grade, ~5e-7
+# relative).  Whether the halved FLOPs win depends on the backend:
+# measured ~25% faster on CPU at 64x512x2048->K=384 (sgemm is
+# FLOP-bound there), but a net LOSS on TPU v5e (the lane-reversal
+# relayout costs more than the saved MACs — benchmarks/exp_folddft.py,
+# round 4).
+#   False (default): always the direct matmul.  Keeps every lane's
+#         outputs bit-stable across releases (the device-campaign
+#         bench guards its packed output bit-for-bit).
+#   'auto': fold on non-TPU backends only.
+#   True:  force fold everywhere.
+# bench_scatter.py enables 'auto' and re-validates through its tau
+# accuracy gates every run.
+dft_fold = False
+
 # Harmonic window for the fast fit lane.  A smooth template's power
 # spectrum decays to numerical zero well below the Nyquist harmonic
 # (the bench Gaussian template holds all but ~7e-13 of its power in
@@ -152,3 +169,59 @@ RCSTRINGS = {
     6: "NOPROGRESS: Unable to progress",
     7: "USERABORT: User requested end of minimization",
 }
+
+# --- Environment hooks ----------------------------------------------------
+# One documented A/B switch shared by every benchmark and CLI (the
+# per-script parsing that used to live in bench.py).  Applied once at
+# import; scripts that set their own config defaults re-apply with
+# env_overrides() afterwards so the environment always wins:
+#
+#   PPT_XSPEC=float32|bfloat16      -> cross_spectrum_dtype
+#   PPT_DFT_PRECISION=highest|high|default -> dft_precision
+#   PPT_DFT_FOLD=off|auto|on        -> dft_fold
+#
+# Unset variables leave the module values untouched; a typo raises
+# (strict like the config parsers — a silent fallback would quietly
+# invalidate an A/B run).
+
+
+def env_overrides():
+    """Apply the PPT_* environment hooks to this module; call after
+    setting script-level config defaults so the env A/B switch wins.
+    Returns the names it changed."""
+    import os as _os
+    import sys as _sys
+
+    cfg = _sys.modules[__name__]
+    changed = []
+    xspec = _os.environ.get("PPT_XSPEC", "").lower()
+    if xspec:
+        table = {"float32": None, "none": None, "bfloat16": "bfloat16"}
+        if xspec not in table:
+            raise ValueError(
+                f"PPT_XSPEC must be 'float32' or 'bfloat16', got "
+                f"{xspec!r}")
+        cfg.cross_spectrum_dtype = table[xspec]
+        changed.append("cross_spectrum_dtype")
+    prec = _os.environ.get("PPT_DFT_PRECISION", "").lower()
+    if prec:
+        if prec not in ("highest", "high", "default"):
+            raise ValueError(
+                "PPT_DFT_PRECISION must be 'highest', 'high' or "
+                f"'default', got {prec!r}")
+        cfg.dft_precision = prec
+        changed.append("dft_precision")
+    fold = _os.environ.get("PPT_DFT_FOLD", "").lower()
+    if fold:
+        table = {"off": False, "false": False, "auto": "auto",
+                 "on": True, "true": True}
+        if fold not in table:
+            raise ValueError(
+                f"PPT_DFT_FOLD must be 'off', 'auto' or 'on', got "
+                f"{fold!r}")
+        cfg.dft_fold = table[fold]
+        changed.append("dft_fold")
+    return changed
+
+
+env_overrides()

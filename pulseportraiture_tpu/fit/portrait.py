@@ -687,12 +687,16 @@ def _scatter_ftol(dt, compensated=False):
     deterministic ~0.3% short of the true minimum (measured round 3:
     bias -3.2e-3 at ftol=3e-6, -1.1e-4 at 1e-8, floor -6e-5 at 1e-10) —
     far above extreme-S/N sigma_tau.  f32 scattering fits therefore run
-    to 1e-8 by default (+1 Newton trip), and to 1e-10 when the
-    compensated Dot2 reductions are on (their purpose is precisely this
-    regime; the remaining floor is elementwise product/trig rounding,
-    which no summation scheme can remove).  f64 keeps 50*eps."""
+    to 1e-9 by default (round 6: was 1e-8 — the tau-matched CCF seed
+    lands the loop so close that the old threshold could stop a trip
+    early and leave the plain-lane high-S/N tau bias at ~2.5e-4; one
+    decade buys ~1 extra trip from a 3-trip fit and holds the floor
+    near -1.5e-4), and 1e-10 when the compensated Dot2 reductions are
+    on (their purpose is precisely this regime; the remaining floor is
+    elementwise product/trig rounding, which no summation scheme can
+    remove).  f64 keeps 50*eps."""
     if jnp.dtype(dt) == jnp.float32:
-        return 1e-10 if compensated else 1e-8
+        return 1e-10 if compensated else 1e-9
     return 50.0 * float(jnp.finfo(dt).eps)
 
 
@@ -1306,7 +1310,8 @@ def _parseval_Sd(port, w_full):
 
 def prepare_portrait_fit_real(port, model, w, freqs, P, nu_fit, theta0,
                               seed_phi=True, seed_derotate=True,
-                              x_dtype=None, nharm_eff=None):
+                              x_dtype=None, nharm_eff=None,
+                              dft_fold=None):
     """Everything before the Newton loop, in pure real arithmetic:
     matmul DFTs (ops/fourier.py — XLA's TPU FFT is ~2000x slower at
     these shapes), weighted cross-spectrum as a real pair, model/data
@@ -1322,12 +1327,16 @@ def prepare_portrait_fit_real(port, model, w, freqs, P, nu_fit, theta0,
     TPU runtimes whose transports and FFT lowerings cannot handle
     complex types at all (ops/fourier.py).
     Returns (Xr, Xi, S0, Sd, theta0_seeded).
+
+    dft_fold: the fold-symmetry DFT knob, resolved by the BATCH
+    wrappers and carried in their program-cache keys (None = read
+    config at trace time, with the usual already-traced caveat).
     """
     from ..ops.fourier import rfft_mm
 
     dt = w.dtype
-    dr, di = rfft_mm(port, nharm=nharm_eff)
-    mr, mi = rfft_mm(model, nharm=nharm_eff)
+    dr, di = rfft_mm(port, nharm=nharm_eff, fold=dft_fold)
+    mr, mi = rfft_mm(model, nharm=nharm_eff, fold=dft_fold)
     if nharm_eff is not None:
         w_full, w = w, w[..., :nharm_eff]
     # X = dFT * conj(mFT) * w, split into parts
@@ -1488,26 +1497,48 @@ def _fit_portrait_core_real_scatter(
         P, nu_fit, nu_out, log10_tau, dt)
 
 
-def fast_scatter_fit_one(port, model, noise_stds, chan_mask, freqs, P,
-                         nu_fit, nu_out, theta0, ir_r=None, ir_i=None,
-                         bounds=None, *, fit_flags, log10_tau, max_iter,
-                         compensated=False, x_bf16=None, nharm_eff=None):
-    """One complex-free SCATTERING fit: weights, matmul DFTs + CCF
-    seed, the real _cgh_scatter Newton loop — the per-element body for
-    scattering batches on TPU runtimes (vmapped by _fast_batch_fn,
-    sharded by parallel.fit_portrait_sharded_fast).
+def _initial_phase_guess_scatter(Xr, Xi, cvec, DM0, tau_n, nbin,
+                                 derotate=True, oversamp=2):
+    """The CCF phase seed MATCHED to the scattering kernel: the
+    channel-summed CCF of X' = X conj(B(tau_seed)) is exactly
+    sum_n C_n(phi) on the lag grid — argmax of the fit's own objective
+    at the seeded tau — whereas CCF-ing the raw X against the
+    unscattered template peaks early by O(tau) (the scattering tail
+    drags the correlation peak), which used to cost the vmapped Newton
+    loop several extra trips at heavy scattering (the whole batch pays
+    for its worst element).  tau_n: per-channel seed timescale in
+    rotations (0 reduces exactly to the unmatched seed: B = 1).
+    Rational in 2 pi tau k — no extra trig."""
+    nharm = Xr.shape[-1]
+    dt = cvec.dtype
+    k = jnp.arange(nharm, dtype=dt)
+    bk = (2.0 * jnp.pi * tau_n)[:, None] * k
+    q = 1.0 / (1.0 + bk * bk)
+    cBi = bk * q
+    Yr = Xr * q - Xi * cBi
+    Yi = Xr * cBi + Xi * q
+    return _initial_phase_guess_real(Yr, Yi, cvec, DM0,
+                                     derotate=derotate, nbin=nbin,
+                                     oversamp=oversamp)
 
-    ir_r/ir_i: optional instrumental-response FT split into real parts
-    (complex buffers cannot cross some tunneled-runtime transports, so
-    the response ships as two real arrays and is folded into the
-    spectra here: X' = X conj(ir), M2' = M2 |ir|^2); when nharm_eff is
-    set they must already be sliced to the window.  The tau/alpha
-    seeds arrive via theta0 (cols 3, 4), exactly like the complex
-    engine.
 
-    nharm_eff (static): the UNSCATTERED template's harmonic window —
-    valid for every tau, because the scattering kernel and the
-    response only multiply the template spectrum, never widen it."""
+def prepare_scatter_fit_real(port, model, noise_stds, chan_mask, freqs,
+                             P, nu_fit, theta0, ir_r=None, ir_i=None, *,
+                             fit_flags, log10_tau=False,
+                             compensated=False, x_bf16=None,
+                             nharm_eff=None, seed_derotate=True,
+                             dft_fold=None):
+    """Everything before the scattering Newton loop, in pure real
+    arithmetic: weights, matmul DFTs (band-limited when nharm_eff is
+    set), cross-spectrum/model-power assembly with the instrumental
+    response folded in, full-spectrum Sd, and the tau-matched CCF phase
+    seed — the scattering twin of prepare_portrait_fit_real, split out
+    so the stage-attribution profiler (benchmarks/attrib.py) can time
+    prefixes of the real program.  Returns (Xr, Xi, M2w, Sd, theta0).
+
+    seed_derotate=False (static) skips the seed's DM-derotation trig
+    pass — valid when the caller knows every DM guess is zero (the
+    batch wrappers check the concrete theta0 on host)."""
     if x_bf16 is None:
         x_bf16 = use_bf16_cross_spectrum()
     from ..ops.fourier import _gated_precision, rfft_mm
@@ -1515,13 +1546,17 @@ def fast_scatter_fit_one(port, model, noise_stds, chan_mask, freqs, P,
     # clamp dft_precision 'default' up to 'high' like the complex
     # interface (rfft_c): the bench-validated single-pass-bf16 setting
     # would floor tau accuracy at ~2.5e-4, defeating the tightened
-    # scatter ftol; the DFT is a once-per-fit cost, not per-Newton-step
+    # scatter ftol; the DFT is a once-per-fit cost, not per-Newton-step.
+    # config.dft_fold (off by default) may halve the contraction length
+    # here — the tau gates re-validate it wherever it is enabled.
     prec = _gated_precision(None)
     nbin = port.shape[-1]
     dt = port.dtype
     w = make_weights(noise_stds, nbin, chan_mask, dtype=dt)
-    dr, di = rfft_mm(port, precision=prec, nharm=nharm_eff)
-    mr, mi = rfft_mm(model.astype(dt), precision=prec, nharm=nharm_eff)
+    dr, di = rfft_mm(port, precision=prec, nharm=nharm_eff,
+                     fold=dft_fold)
+    mr, mi = rfft_mm(model.astype(dt), precision=prec, nharm=nharm_eff,
+                     fold=dft_fold)
     if nharm_eff is not None:
         w_full, w = w, w[..., :nharm_eff]
     Xr = (dr * mr + di * mi) * w
@@ -1537,8 +1572,13 @@ def fast_scatter_fit_one(port, model, noise_stds, chan_mask, freqs, P,
         M2w = M2w * (ir_r**2 + ir_i**2)
     cvec, _ = _t_coeffs(freqs, P, nu_fit)
     if fit_flags[0]:
-        phi0 = _initial_phase_guess_real(Xr, Xi, cvec.astype(dt),
-                                         theta0[1], nbin=nbin)
+        # per-channel seed timescale from the theta0 (tau, alpha)
+        # columns — the same kernel the first Newton eval will see
+        tau0 = 10.0 ** theta0[3] if log10_tau else theta0[3]
+        tau_n = tau0 * (freqs.astype(dt) / nu_fit) ** theta0[4]
+        phi0 = _initial_phase_guess_scatter(
+            Xr, Xi, cvec.astype(dt), theta0[1], tau_n, nbin,
+            derotate=seed_derotate)
         theta0 = jnp.where(jnp.arange(5) == 0, phi0, theta0).astype(dt)
     else:
         theta0 = theta0.astype(dt)
@@ -1548,8 +1588,39 @@ def fast_scatter_fit_one(port, model, noise_stds, chan_mask, freqs, P,
     # precision X whenever the compensated reductions are on
     xdt = (dt if compensated
            else jnp.bfloat16 if (x_bf16 and dt == jnp.float32) else dt)
+    return Xr.astype(xdt), Xi.astype(xdt), M2w, Sd, theta0
+
+
+def fast_scatter_fit_one(port, model, noise_stds, chan_mask, freqs, P,
+                         nu_fit, nu_out, theta0, ir_r=None, ir_i=None,
+                         bounds=None, *, fit_flags, log10_tau, max_iter,
+                         compensated=False, x_bf16=None, nharm_eff=None,
+                         seed_derotate=True, dft_fold=None):
+    """One complex-free SCATTERING fit: weights, matmul DFTs + the
+    tau-matched CCF seed (prepare_scatter_fit_real), the real
+    _cgh_scatter Newton loop — the per-element body for scattering
+    batches on TPU runtimes (vmapped by _fast_batch_fn, sharded by
+    parallel.fit_portrait_sharded_fast).
+
+    ir_r/ir_i: optional instrumental-response FT split into real parts
+    (complex buffers cannot cross some tunneled-runtime transports, so
+    the response ships as two real arrays and is folded into the
+    spectra here: X' = X conj(ir), M2' = M2 |ir|^2); when nharm_eff is
+    set they must already be sliced to the window.  The tau/alpha
+    seeds arrive via theta0 (cols 3, 4), exactly like the complex
+    engine.
+
+    nharm_eff (static): the UNSCATTERED template's harmonic window —
+    valid for every tau, because the scattering kernel and the
+    response only multiply the template spectrum, never widen it."""
+    nbin = port.shape[-1]
+    Xr, Xi, M2w, Sd, theta0 = prepare_scatter_fit_real(
+        port, model, noise_stds, chan_mask, freqs, P, nu_fit, theta0,
+        ir_r, ir_i, fit_flags=fit_flags, log10_tau=log10_tau,
+        compensated=compensated, x_bf16=x_bf16, nharm_eff=nharm_eff,
+        seed_derotate=seed_derotate, dft_fold=dft_fold)
     return _fit_portrait_core_real_scatter.__wrapped__(
-        Xr.astype(xdt), Xi.astype(xdt), M2w, Sd, freqs, P, nu_fit,
+        Xr, Xi, M2w, Sd, freqs, P, nu_fit,
         nu_out, theta0, fit_flags=fit_flags, log10_tau=log10_tau,
         max_iter=max_iter, compensated=compensated,
         nharm_total=nbin // 2 + 1 if nharm_eff is not None else None,
@@ -1651,12 +1722,14 @@ def fit_portrait_batch_fast(
     if chan_masks is None:
         chan_masks = jnp.ones(ports.shape[:2], dt)
 
+    from ..ops.fourier import use_dft_fold
+
     x_bf16 = use_bf16_cross_spectrum()
     bounds, b_ax = _resolve_bounds_axis(bounds, dt)
     fit = _fast_batch_fn(
         FitFlags(*[bool(f) for f in fit_flags]), int(max_iter),
         m_ax, f_ax, p_ax, nf_ax, seed_derotate, x_bf16,
-        nharm_eff, b_ax)
+        nharm_eff, b_ax, use_dft_fold())
     args = (ports, models, jnp.asarray(noise_stds), chan_masks,
             freqs, P, nu_fit, nu_out_val, theta0)
     if b_ax != "off":
@@ -1666,7 +1739,8 @@ def fit_portrait_batch_fast(
 
 def fast_fit_one(port, model, noise_stds, chan_mask, freqs, P, nu_fit,
                  nu_out, theta0, bounds=None, *, fit_flags, max_iter,
-                 seed_derotate=True, x_bf16=None, nharm_eff=None):
+                 seed_derotate=True, x_bf16=None, nharm_eff=None,
+                 dft_fold=None):
     """One complex-free fast fit: weights, matmul DFTs + CCF seed, real
     Newton core — the per-element body shared by the vmapped batch
     (_fast_batch_fn) and the sharded scale-out path
@@ -1692,7 +1766,7 @@ def fast_fit_one(port, model, noise_stds, chan_mask, freqs, P, nu_fit,
     Xr, Xi, S0, Sd, th0 = prepare_portrait_fit_real(
         port, model.astype(port.dtype), w, freqs, P, nu_fit, theta0,
         seed_phi=bool(fit_flags[0]), seed_derotate=seed_derotate,
-        x_dtype=x_dtype, nharm_eff=nharm_eff)
+        x_dtype=x_dtype, nharm_eff=nharm_eff, dft_fold=dft_fold)
     return _fit_portrait_core_real.__wrapped__(
         Xr, Xi, S0, Sd, freqs, P, nu_fit, nu_out, th0,
         fit_flags=fit_flags, max_iter=max_iter,
@@ -1724,14 +1798,17 @@ def reject_fixed_tau_seed(theta0, caller):
 @lru_cache(maxsize=None)
 def _fast_batch_fn(fit_flags, max_iter, m_ax, f_ax, p_ax, nf_ax,
                    seed_derotate=True, x_bf16=False, nharm_eff=None,
-                   b_ax="off"):
+                   b_ax="off", dft_fold=None):
     """Cached jitted end-to-end fast fit — a fresh jit per call would
     recompile every invocation.  One program: matmul DFTs, real
     cross-spectrum, CCF seed, Newton loop, finalize — no complex types
-    anywhere."""
+    anywhere.  dft_fold rides the cache key (resolved by callers via
+    use_dft_fold) so flipping config.dft_fold mid-process retraces
+    instead of silently reusing the other arm's program."""
     one = partial(fast_fit_one, fit_flags=fit_flags, max_iter=max_iter,
                   seed_derotate=seed_derotate,
-                  x_bf16=x_bf16, nharm_eff=nharm_eff)
+                  x_bf16=x_bf16, nharm_eff=nharm_eff,
+                  dft_fold=dft_fold)
     # "off" (a string, NOT False) marks no-bounds: False == 0 in
     # Python, so a boolean sentinel would collide with per-element
     # bounds (b_ax=0) in the lru_cache key and return the wrong
@@ -1765,6 +1842,19 @@ def _fit_batch_fast_scatter(ports, models, noise_stds, freqs, P, nu_fit,
     nf_ax = 0 if nu_fit.ndim == 1 else None
     if theta0 is None:
         theta0 = jnp.zeros((nb, 5), dt)
+        seed_derotate = False
+    elif isinstance(theta0, jax.core.Tracer):
+        # traced caller: can't inspect values; keep the derotation pass
+        seed_derotate = True
+    else:
+        # host-side check on the concrete seed (same rule as the
+        # no-scatter wrapper): an all-zero DM guess makes the seed's
+        # derotation phasor the identity, and skipping it saves a
+        # trig pass over the cross-spectrum
+        import numpy as _np
+
+        seed_derotate = bool(
+            _np.any(_np.asarray(theta0)[..., 1] != 0.0))
     nu_out_arr = jnp.broadcast_to(
         jnp.asarray(-1.0 if nu_out is None else nu_out, dt), (nb,))
     if chan_masks is None:
@@ -1778,11 +1868,14 @@ def _fit_batch_fast_scatter(ports, models, noise_stds, freqs, P, nu_fit,
         ir_FT = _np.asarray(ir_FT)[..., :nharm_eff]
     ir_r, ir_i = split_ir_host(ir_FT, dt)
     bounds, b_ax = _resolve_bounds_axis(bounds, dt)
+    from ..ops.fourier import use_dft_fold
+
     fit = _fast_scatter_batch_fn(
         FitFlags(*[bool(f) for f in fit_flags]), bool(log10_tau),
         int(max_iter), bool(compensated),
         effective_x_bf16(compensated),
-        m_ax, f_ax, p_ax, nf_ax, use_ir, nharm_eff, b_ax)
+        m_ax, f_ax, p_ax, nf_ax, use_ir, nharm_eff, b_ax,
+        seed_derotate, use_dft_fold())
     args = (ports, models, jnp.asarray(noise_stds),
             jnp.asarray(chan_masks, dt), freqs, P, nu_fit,
             nu_out_arr, jnp.asarray(theta0), ir_r, ir_i)
@@ -1794,12 +1887,16 @@ def _fit_batch_fast_scatter(ports, models, noise_stds, freqs, P, nu_fit,
 @lru_cache(maxsize=None)
 def _fast_scatter_batch_fn(fit_flags, log10_tau, max_iter, compensated,
                            x_bf16, m_ax, f_ax, p_ax, nf_ax, use_ir,
-                           nharm_eff=None, b_ax="off"):
-    """Cached jitted end-to-end complex-free scattering batch fit."""
+                           nharm_eff=None, b_ax="off",
+                           seed_derotate=True, dft_fold=None):
+    """Cached jitted end-to-end complex-free scattering batch fit.
+    dft_fold rides the cache key like seed_derotate/x_bf16 (see
+    _fast_batch_fn)."""
     one = partial(fast_scatter_fit_one, fit_flags=fit_flags,
                   log10_tau=log10_tau, max_iter=max_iter,
                   compensated=compensated, x_bf16=x_bf16,
-                  nharm_eff=nharm_eff)
+                  nharm_eff=nharm_eff, seed_derotate=seed_derotate,
+                  dft_fold=dft_fold)
     ir_ax = None  # shared response across the batch
     axes = (0, m_ax, 0, 0, f_ax, p_ax, nf_ax, 0, 0, ir_ax, ir_ax)
     if b_ax != "off":
@@ -1924,7 +2021,25 @@ def estimate_tau(port, model, noise_stds, chan_mask=None):
     A = jnp.sum(u * q_sig * b, axis=1) / jnp.maximum(
         jnp.sum(u * b**2.0, axis=1), _tiny(dt))
     sse = jnp.sum(u * (q_sig - A[:, None] * b) ** 2.0, axis=1)
-    tau = taus[jnp.argmin(sse)]
+    i0 = jnp.argmin(sse)
+    # sub-grid refinement: parabolic interpolation of sse through the
+    # argmin and its neighbors, in grid-index (= log-tau) units.  The
+    # 64-point log grid spaces tau by ~13% — a pure-grid seed hands the
+    # Newton loop up to half a grid step of error it must burn trips
+    # removing; the parabola cuts that to ~1-2% for free.  Edge bins
+    # and degenerate curvature keep the grid value.
+    im = jnp.clip(i0 - 1, 0, sse.shape[0] - 1)
+    ip = jnp.clip(i0 + 1, 0, sse.shape[0] - 1)
+    f0, fm, fp = sse[i0], sse[im], sse[ip]
+    denom = fm - 2.0 * f0 + fp
+    interior = jnp.logical_and(i0 > 0, i0 < sse.shape[0] - 1)
+    ok = jnp.logical_and(interior, denom > 0.0)
+    delta = jnp.where(ok, 0.5 * (fm - fp)
+                      / jnp.where(ok, denom, 1.0), 0.0)
+    delta = jnp.clip(delta, -0.5, 0.5)
+    dlog = (jnp.log10(taus[-1]) - jnp.log10(taus[0])) / (
+        sse.shape[0] - 1.0)
+    tau = 10.0 ** (jnp.log10(taus[i0]) + delta * dlog)
     neutral = 0.5 / nbin
     # an unscattered portrait fits best at the grid's bottom edge; the
     # neutral seed is the right answer there

@@ -23,7 +23,8 @@ import numpy as np
 
 from .. import config
 
-__all__ = ["rfft_mm", "irfft_mm", "rfft_c", "irfft_c", "use_matmul_dft"]
+__all__ = ["rfft_mm", "irfft_mm", "rfft_c", "irfft_c", "use_matmul_dft",
+           "use_dft_fold"]
 
 
 def _default_precision():
@@ -79,15 +80,61 @@ def _irfft_weights(nharm, n, dtype_str):
     return (Vc.astype(dtype_str), Vs.astype(dtype_str))
 
 
-def rfft_mm(x, precision=None, nharm=None):
+@lru_cache(maxsize=None)
+def _rfft_fold_weights(n, dtype_str, nharm=None):
+    """Half-length weights for the fold-symmetry real DFT (even n):
+      Re X_k = x[0] + (-1)^k x[n/2] + sum_{j=1}^{n/2-1} xe_j cos(2pi jk/n)
+      Im X_k =                      - sum_{j=1}^{n/2-1} xo_j sin(2pi jk/n)
+    with xe_j = x[j] + x[n-j], xo_j = x[j] - x[n-j] — two (n/2-1)-row
+    matmuls instead of two n-row ones (exactly half the MACs, f32-grade
+    accuracy; see config.dft_fold for where this wins)."""
+    j = np.arange(1, n // 2)
+    k = np.arange(n // 2 + 1 if nharm is None else nharm)
+    ang = 2.0 * np.pi * np.outer(j, k) / n
+    sgn = (-1.0) ** k
+    return (np.cos(ang).astype(dtype_str), (-np.sin(ang)).astype(dtype_str),
+            sgn.astype(dtype_str))
+
+
+def use_dft_fold():
+    """Whether rfft_mm should take the fold-symmetry half-length path:
+    config.dft_fold (True/False force; 'auto' = non-TPU backends, where
+    the halved sgemm FLOPs win — on TPU v5e the lane-reversal relayout
+    measured a net loss, benchmarks/exp_folddft.py).  Read at trace
+    time.  The default is False: folding re-associates the DFT sums, so
+    lanes that guarantee bit-stable output (the raw-campaign bucket
+    program) keep the direct matmul unless the deployment opts in."""
+    setting = getattr(config, "dft_fold", False)
+    if setting is True or setting is False:
+        return setting
+    if setting != "auto":
+        raise ValueError(
+            f"config.dft_fold must be True, False, or 'auto'; got "
+            f"{setting!r}")
+    return jax.default_backend() != "tpu"
+
+
+def rfft_mm(x, precision=None, nharm=None, fold=None):
     """Real DFT of the last axis via matmul: (..., n) -> two (..., nharm)
     real arrays (Re, Im); nharm defaults to the full n//2+1.  precision
     None -> config.dft_precision ('highest' keeps f32 accuracy at the
     1e-7 level; 'high' ~1e-6 and ~20% faster end-to-end; bf16
-    single-pass would cost ~1e-3)."""
+    single-pass would cost ~1e-3).  fold None -> config.dft_fold (the
+    half-length fold-symmetry contraction; False forces the direct
+    matmul for callers that must stay bit-stable)."""
     if precision is None:
         precision = _default_precision()
     n = x.shape[-1]
+    if fold is None:
+        fold = use_dft_fold()
+    if fold and n % 2 == 0 and n >= 8:
+        Wc_h, Ws_h, sgn = _rfft_fold_weights(n, str(x.dtype), nharm)
+        head = x[..., 1:n // 2]
+        tail = jnp.flip(x[..., n // 2 + 1:], axis=-1)
+        dr = (jnp.matmul(head + tail, Wc_h, precision=precision)
+              + x[..., 0:1] + x[..., n // 2:n // 2 + 1] * sgn)
+        di = jnp.matmul(head - tail, Ws_h, precision=precision)
+        return dr, di
     Wc, Ws = _rfft_weights(n, str(x.dtype), nharm)
     return (
         jnp.matmul(x, Wc, precision=precision),

@@ -374,16 +374,71 @@ def _load_raw(f):
         telescope_code=telescope_code(arch.get_telescope()))
 
 
+def _raw_decode(raw, scl, offs, nbin, ft, redisp=False,
+                redisp_turns=None, dft_fold=None):
+    """Stage 1 of the fused raw-bucket program: int16 decode (scl/offs
+    affine), min-window baseline subtraction, and (for dedispersed-on-
+    disk archives) the on-device re-dispersion rotation.  Split out of
+    _raw_fit_fn so the stage-attribution profiler (benchmarks/attrib.py)
+    times prefixes of the REAL program — this is the single source of
+    truth for the decode stage."""
+    x = raw.astype(ft) * scl[..., None] + offs[..., None]
+    x = x - min_window_baseline(x)[..., None]
+    if redisp:
+        # dedispersed-on-disk archives: restore the dispersion
+        # delays of the stored DM (load_data's dededisperse, here
+        # as a matmul-DFT phasor rotation on device).  The turns
+        # arrive from host pre-wrapped mod 1 in f64 — raw delays
+        # reach hundreds of turns, beyond f32.  Convention matches
+        # io/psrfits.rotate_phase(amps, -delays) (psrfits.py:377):
+        # phasor exp(-2 i pi k delays).
+        from ..ops.fourier import irfft_mm, rfft_mm
+
+        k = jnp.arange(nbin // 2 + 1, dtype=ft)
+        ang = -2.0 * jnp.pi * redisp_turns.astype(ft)[..., None] * k
+        c, s = jnp.cos(ang), jnp.sin(ang)
+        Xr, Xi = rfft_mm(x, fold=dft_fold)
+        x = irfft_mm(Xr * c - Xi * s, Xr * s + Xi * c, nbin)
+    return x
+
+
+def _raw_stats(x, cmask, freqs, ft, tiny):
+    """Stage 2 of the fused raw-bucket program: power-spectrum noise,
+    equivalent-width S/N (sort-free exact median — see
+    ops.noise.exact_median_lastaxis; the XLA-sort median used to be the
+    single most expensive stage of the whole bucket), and the
+    S/N-weighted nu_fit seed.  Returns (noise, snr, nu_fit)."""
+    noise = jnp.maximum(get_noise_PS(x), tiny)
+    snr = get_SNR(x, noise) * cmask
+    # S/N * nu^-2-weighted center-of-mass frequency (host mirror:
+    # pipeline.toas.snr_weighted_nu_fit; reference pplib.py:2715)
+    w_nf = jnp.maximum(snr, 0.0) * freqs[None, :] ** -2.0
+    den = jnp.sum(w_nf * freqs[None, :] ** -2.0, axis=1)
+    nu_fit = jnp.sqrt(jnp.sum(w_nf, axis=1)
+                      / jnp.where(den > 0, den, 1.0))
+    nu_fit = jnp.where(jnp.isfinite(nu_fit) & (nu_fit > 0),
+                       nu_fit, jnp.mean(freqs)).astype(ft)
+    return noise, snr, nu_fit
+
+
 def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
                 use_fast, ftname, x_bf16, redisp=False,
                 want_flux=False, use_ir=False, compensated=False,
-                nharm_eff=None):
+                nharm_eff=None, seed_derotate=True):
     """Cache-key normalizing front for _raw_fit_fn_cached: dead knob
     combinations collapse onto one compiled program — compensated is
     meaningless without the scatter engine, and under compensated mode
     the bf16 cross-spectrum knob is dead (fast_scatter_fit_one forces
     f32 X; fit.portrait.effective_x_bf16) — so flipping either under
-    the other never recompiles a bit-identical bucket program."""
+    the other never recompiles a bit-identical bucket program.
+
+    seed_derotate=False asserts every DM guess in the bucket is zero
+    (the launcher checks the host-side DM_guess list): the CCF seed's
+    derotation phasor is then the identity and the trig pass over the
+    cross-spectrum is skipped — same packed output to the bit, one
+    fewer moment-sized pass per subint."""
+    from ..ops.fourier import use_dft_fold
+
     scat_engine = (flags[3] or flags[4] or log10_tau
                    or tau_mode != "none" or use_ir)
     if not scat_engine:
@@ -392,22 +447,29 @@ def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
         x_bf16 = False
     if not use_fast:
         nharm_eff = None  # the complex engine is never band-limited
+        seed_derotate = True  # only the fast lanes thread the knob
+    # dft_fold resolves HERE and rides the cache key (like x_bf16 /
+    # seed_derotate): an in-process config flip must retrace, not
+    # silently reuse the other arm's program
     return _raw_fit_fn_cached(
         nchan, nbin, flags, max_iter, log10_tau, tau_mode, use_fast,
         ftname, x_bf16, redisp, want_flux, use_ir, compensated,
-        nharm_eff)
+        nharm_eff, seed_derotate, use_dft_fold())
 
 
 @lru_cache(maxsize=None)
 def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
                        tau_mode, use_fast, ftname, x_bf16,
                        redisp=False, want_flux=False, use_ir=False,
-                       compensated=False, nharm_eff=None):
+                       compensated=False, nharm_eff=None,
+                       seed_derotate=True, dft_fold=None):
     """ONE jitted program for a raw bucket: int16 decode (scl/offs),
     min-window baseline subtraction, power-spectrum noise, S/N,
     nu_fit seeding, the batched fit, and result packing into a single
     (nfield, nb) array — so a bucket costs one h2d of int16 bytes, one
-    dispatch, and one small d2h pull.
+    dispatch, and one small d2h pull.  The decode and stats stages live
+    in _raw_decode/_raw_stats (shared with benchmarks/attrib.py's
+    prefix programs).
 
     tau_mode: 'none' (no scattering anywhere), 'neutral' (half-bin
     seed), 'explicit' ((tau_s, nu, alpha) runtime args), 'auto'
@@ -423,33 +485,9 @@ def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
 
     def run(raw, scl, offs, cmask, modelx, freqs, Ps, DMg, nu_out,
             tau_s, tau_nu, tau_a, alpha0, redisp_turns, ir_r, ir_i):
-        x = raw.astype(ft) * scl[..., None] + offs[..., None]
-        x = x - min_window_baseline(x)[..., None]
-        if redisp:
-            # dedispersed-on-disk archives: restore the dispersion
-            # delays of the stored DM (load_data's dededisperse, here
-            # as a matmul-DFT phasor rotation on device).  The turns
-            # arrive from host pre-wrapped mod 1 in f64 — raw delays
-            # reach hundreds of turns, beyond f32.  Convention matches
-            # io/psrfits.rotate_phase(amps, -delays) (psrfits.py:377):
-            # phasor exp(-2 i pi k delays).
-            from ..ops.fourier import irfft_mm, rfft_mm
-
-            k = jnp.arange(nbin // 2 + 1, dtype=ft)
-            ang = -2.0 * jnp.pi * redisp_turns.astype(ft)[..., None] * k
-            c, s = jnp.cos(ang), jnp.sin(ang)
-            Xr, Xi = rfft_mm(x)
-            x = irfft_mm(Xr * c - Xi * s, Xr * s + Xi * c, nbin)
-        noise = jnp.maximum(get_noise_PS(x), tiny)
-        snr = get_SNR(x, noise) * cmask
-        # S/N * nu^-2-weighted center-of-mass frequency (host mirror:
-        # pipeline.toas.snr_weighted_nu_fit; reference pplib.py:2715)
-        w_nf = jnp.maximum(snr, 0.0) * freqs[None, :] ** -2.0
-        den = jnp.sum(w_nf * freqs[None, :] ** -2.0, axis=1)
-        nu_fit = jnp.sqrt(jnp.sum(w_nf, axis=1)
-                          / jnp.where(den > 0, den, 1.0))
-        nu_fit = jnp.where(jnp.isfinite(nu_fit) & (nu_fit > 0),
-                           nu_fit, jnp.mean(freqs)).astype(ft)
+        x = _raw_decode(raw, scl, offs, nbin, ft, redisp=redisp,
+                        redisp_turns=redisp_turns, dft_fold=dft_fold)
+        noise, snr, nu_fit = _raw_stats(x, cmask, freqs, ft, tiny)
         nb = x.shape[0]
         if tau_mode == "none":
             tau0 = jnp.zeros(nb, ft)
@@ -467,8 +505,10 @@ def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
         nu_out_arr = jnp.broadcast_to(jnp.asarray(nu_out, ft), (nb,))
         if use_fast and not scat_engine:
             fit = _fast_batch_fn(FitFlags(*flags), max_iter,
-                                 None, None, 0, 0, seed_derotate=True,
-                                 x_bf16=x_bf16, nharm_eff=nharm_eff)
+                                 None, None, 0, 0,
+                                 seed_derotate=seed_derotate,
+                                 x_bf16=x_bf16, nharm_eff=nharm_eff,
+                                 dft_fold=dft_fold)
             r = fit(x, modelx, noise, cmask, freqs, Ps, nu_fit,
                     nu_out_arr, theta0)
         elif use_fast:
@@ -483,7 +523,8 @@ def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
                 fast_scatter_fit_one, fit_flags=FitFlags(*flags),
                 log10_tau=log10_tau, max_iter=max_iter,
                 compensated=compensated, x_bf16=x_bf16,
-                nharm_eff=nharm_eff)
+                nharm_eff=nharm_eff, seed_derotate=seed_derotate,
+                dft_fold=dft_fold)
             r = jax.vmap(one, in_axes=(0, None, 0, 0, None, 0, 0, 0, 0,
                                        None, None))(
                 x, modelx, noise, cmask, freqs, Ps, nu_fit,
@@ -595,7 +636,11 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                          use_bf16_cross_spectrum(), redisp=redisp,
                          want_flux=want_flux, use_ir=use_ir,
                          compensated=use_scatter_compensated(),
-                         nharm_eff=hwin)
+                         nharm_eff=hwin,
+                         # all-zero DM guesses make the CCF seed's
+                         # derotation phasor the identity; the host
+                         # knows, so the program skips the trig pass
+                         seed_derotate=bool(np.any(DMg != 0.0)))
         ft = jnp.float32 if use_fast else jnp.float64
         t_s, t_nu, t_a = tau_args
         modelx, freqs = bucket.modelx, bucket.freqs

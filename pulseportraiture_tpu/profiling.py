@@ -1,0 +1,193 @@
+"""Reusable stage-attribution profiler for device programs.
+
+Promotes the one-off methodology of ``benchmarks/exp_breakdown.py``
+(round 5, which first attributed 100% of the windowed fast fit's slope)
+into a library API, so any lane can answer "where does the batch
+latency go?" with numbers that are honest under a tunneled, shared
+accelerator:
+
+- **Slope timing** (`devtime`): ``block_until_ready`` can return early
+  on tunneled runtimes and host transfers are slow, so every
+  measurement enqueues K dispatches back-to-back, reduces each result
+  to a scalar ON DEVICE, and syncs once — the slope between the K-rep
+  and 1-rep walls is steady-state device time.  Min over ``nrun``
+  separate measurements: a shared chip's effective throughput swings up
+  to ~8x with external load, and min-of-N is the standard unloaded-cost
+  estimator.
+- **Prefix stages**: cumulative slices of the real program, each
+  measured independently; a stage's cost is the difference between its
+  prefix slope and the previous one.  Timing prefixes of the *actual*
+  program (not isolated re-creations) keeps fusion behavior honest —
+  XLA schedules an isolated piece differently than the same piece
+  embedded in the full program.
+- **Piece stages**: everything after the last prefix, measured on
+  precomputed inputs (e.g. the Newton loop on a prepared
+  cross-spectrum).
+- **The attribution check**: ``attributed = slope(last prefix) +
+  sum(pieces)`` compared against the full program's slope.  The sum is
+  built ONLY from independently measured programs — never from
+  differences that include the full slope, which would telescope to
+  1.0 by construction (the exp_breakdown lesson).  A lane is "fully
+  attributed" when ``attributed_frac`` clears a stated tolerance
+  (benchmarks gate on >= 0.9).
+
+Typical use (see ``benchmarks/attrib.py`` for the two production
+lanes)::
+
+    stages = [
+        Stage("dft",  dft_prefix_fn,  kind="prefix"),
+        Stage("prep", prep_prefix_fn, kind="prefix"),
+        Stage("newton", loop_on_precomputed_fn, kind="piece"),
+    ]
+    att = profile_stages(full_fn, stages, pick=lambda r: r.phi)
+    print(att.breakdown_ms())      # {"stage_dft_ms": ..., ...}
+    assert att.attributed_frac >= 0.9
+"""
+
+import time
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["devtime", "Stage", "StageTiming", "Attribution",
+           "profile_stages"]
+
+
+@jax.jit
+def _scl(x):
+    return jnp.sum(x)
+
+
+def _identity(x):
+    return x
+
+
+def devtime(fn, pick=_identity, K=4, warm=1, nrun=3):
+    """Slope-time ``fn`` (returns a pytree; ``pick`` selects an array
+    to reduce device-side).  Returns ``(slope_s, single_s)``.
+
+    The K dispatches are enqueued back-to-back with a device-side
+    scalar reduction and ONE host sync; both the single synchronized
+    rep and the K-rep run take the MIN over ``nrun`` measurements (see
+    module docstring for why).  When the populations disagree under
+    load and the slope goes non-positive, the conservative fallback
+    ``tK / K`` counts one round-trip against the K batches."""
+    for _ in range(warm):
+        _ = np.asarray(_scl(pick(fn())))
+
+    def single():
+        t0 = time.perf_counter()
+        _ = np.asarray(_scl(pick(fn())))
+        return time.perf_counter() - t0
+
+    def krun():
+        t0 = time.perf_counter()
+        for _ in range(K):
+            s = _scl(pick(fn()))
+        _ = np.asarray(s)
+        return time.perf_counter() - t0
+
+    t1 = min(single() for _ in range(nrun))
+    tK = min(krun() for _ in range(nrun))
+    slope = (tK - t1) / (K - 1)
+    if slope <= 0:
+        slope = tK / K
+    return slope, t1
+
+
+class Stage(NamedTuple):
+    """One named, independently dispatchable slice of a program.
+
+    kind 'prefix': a cumulative slice of the real program (each prefix
+    contains all previous ones); its attributed cost is the difference
+    from the previous prefix's slope.  kind 'piece': an isolated
+    remainder on precomputed inputs (costs add directly).  ``fn`` takes
+    no arguments (close over the inputs); ``pick`` selects the array to
+    scalar-reduce on device (default: the result itself)."""
+
+    name: str
+    fn: Callable
+    kind: str = "prefix"
+    pick: Callable = _identity
+
+
+class StageTiming(NamedTuple):
+    name: str
+    kind: str
+    slope_s: float   # the stage program's own slope
+    cost_s: float    # attributed cost (differenced for prefixes)
+
+
+class Attribution(NamedTuple):
+    """profile_stages result: the full program's slope, per-stage
+    costs, and the independent-sum attribution check."""
+
+    total_s: float
+    single_s: float
+    stages: tuple          # of StageTiming
+    attributed_s: float    # last prefix slope + sum of piece slopes
+    attributed_frac: float
+
+    def check(self, min_frac=0.9):
+        """True when the independently-measured stages cover at least
+        ``min_frac`` of the full slope."""
+        return self.attributed_frac >= min_frac
+
+    def cost(self, name):
+        for s in self.stages:
+            if s.name == name:
+                return s.cost_s
+        raise KeyError(name)
+
+    def breakdown_ms(self, ndigits=2):
+        """JSON-ready flat dict: per-stage attributed cost in ms plus
+        the totals and the attribution fraction — the per-stage fields
+        the benchmark JSON lines carry."""
+        out = {}
+        for s in self.stages:
+            out[f"stage_{s.name}_ms"] = round(s.cost_s * 1e3, ndigits)
+        out["full_ms"] = round(self.total_s * 1e3, ndigits)
+        out["attributed_frac"] = round(self.attributed_frac, 3)
+        return out
+
+
+def profile_stages(full_fn, stages, pick=_identity, K=4, warm=1,
+                   nrun=3, devtime_fn: Optional[Callable] = None):
+    """Measure ``full_fn`` and each ``Stage``; return an Attribution.
+
+    ``stages``: prefixes in cumulative order, then pieces (order of
+    pieces is free).  ``pick`` applies to full_fn's result.
+    ``devtime_fn`` overrides the timer (tests stub it to avoid real
+    dispatch timing)."""
+    dt = devtime_fn or devtime
+    total_s, single_s = dt(full_fn, pick, K=K, warm=warm, nrun=nrun)
+
+    timings = []
+    prev_prefix = 0.0
+    last_prefix = 0.0
+    piece_sum = 0.0
+    seen_piece = False
+    for st in stages:
+        if st.kind not in ("prefix", "piece"):
+            raise ValueError(f"unknown stage kind {st.kind!r}")
+        slope_s, _ = dt(st.fn, st.pick, K=K, warm=warm, nrun=nrun)
+        if st.kind == "prefix":
+            if seen_piece:
+                raise ValueError(
+                    "prefix stages must precede piece stages "
+                    f"(got prefix {st.name!r} after a piece)")
+            cost = max(slope_s - prev_prefix, 0.0)
+            prev_prefix = slope_s
+            last_prefix = slope_s
+        else:
+            seen_piece = True
+            cost = slope_s
+            piece_sum += slope_s
+        timings.append(StageTiming(st.name, st.kind, slope_s, cost))
+
+    attributed = last_prefix + piece_sum
+    frac = attributed / total_s if total_s > 0 else float("nan")
+    return Attribution(total_s, single_s, tuple(timings), attributed,
+                       frac)

@@ -25,6 +25,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running sweeps (full option lattice) excluded from "
+        "tier-1's -m 'not slow' run")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

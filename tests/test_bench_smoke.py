@@ -1,0 +1,68 @@
+"""Benchmark-rot guard (ISSUE 1 satellite): every benchmarks/bench_*.py
+script runs end-to-end at a tiny CPU-safe shape and prints a parseable
+JSON line.  The bench scripts had no test coverage at all, so an engine
+refactor could silently break the measurement tooling the performance
+history depends on."""
+
+import glob
+import importlib
+import json
+import os
+
+import pytest
+
+from pulseportraiture_tpu import config
+
+BENCH_DIR = os.path.join(os.path.dirname(__file__), "..", "benchmarks")
+BENCH_MODULES = sorted(
+    os.path.basename(p)[:-3]
+    for p in glob.glob(os.path.join(BENCH_DIR, "bench_*.py")))
+
+# tiny CPU-safe shapes per script (env knobs each script reads)
+TINY_ENV = {
+    "bench_scatter": {"PPT_NB": "4", "PPT_NCHAN": "16",
+                      "PPT_NBIN": "128"},
+    "bench_device_campaign": {"PPT_NSUBB": "4", "PPT_NCHAN": "16",
+                              "PPT_NBIN": "128"},
+    "bench_align": {"PPT_NE": "4", "PPT_NCHAN": "16", "PPT_NBIN": "128"},
+    "bench_noisy_template": {"PPT_NB": "4", "PPT_NCHAN": "16",
+                             "PPT_NBIN": "256"},
+    "bench_stream": {"PPT_NARCH": "2", "PPT_NSUB": "2",
+                     "PPT_NCHAN": "16", "PPT_NBIN": "128"},
+    "bench_campaign": {"PPT_NARCH": "2", "PPT_NSUB": "2",
+                       "PPT_NCHAN": "16", "PPT_NBIN": "128",
+                       "PPT_CAMPAIGN_CACHE": ""},
+    "bench_ipta": {"PPT_NPSR": "1", "PPT_NARCH": "2", "PPT_NSUB": "2",
+                   "PPT_NCHAN": "16", "PPT_NBIN": "128"},
+}
+
+_CONFIG_KEYS = ("dft_precision", "cross_spectrum_dtype", "dft_fold",
+                "scatter_compensated", "fit_harmonic_window")
+
+
+def test_all_bench_scripts_covered():
+    """A new bench script must register a tiny shape here or the rot
+    guard silently stops covering it."""
+    assert set(BENCH_MODULES) == set(TINY_ENV), (
+        set(BENCH_MODULES) ^ set(TINY_ENV))
+
+
+@pytest.mark.parametrize("name", BENCH_MODULES)
+def test_bench_smoke(name, monkeypatch, capsys, tmp_path):
+    for k, v in TINY_ENV[name].items():
+        if k == "PPT_CAMPAIGN_CACHE":
+            v = str(tmp_path / "cache")
+        monkeypatch.setenv(k, v)
+    saved = {k: getattr(config, k) for k in _CONFIG_KEYS}
+    mod = importlib.import_module(f"benchmarks.{name}")
+    try:
+        mod.main()
+    finally:
+        for k, v in saved.items():
+            setattr(config, k, v)
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.strip().startswith("{")]
+    assert lines, f"{name} printed no JSON line"
+    out = json.loads(lines[-1])
+    assert "metric" in out and "value" in out and "unit" in out
+    assert out["value"] > 0

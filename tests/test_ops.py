@@ -190,3 +190,108 @@ def test_fit_powlaw_function_residuals(rng):
     r = np.asarray(fit_powlaw_function((2.0, -1.4), freqs, 1500.0,
                                        jnp.asarray(data)))
     np.testing.assert_allclose(r, 0.0, atol=1e-12)
+
+
+# --- exact sort-free median (ops/noise.exact_median_lastaxis) -----------
+
+
+@pytest.mark.parametrize("shape", [(7, 64), (3, 4, 63), (1, 2)])
+def test_exact_median_matches_jnp_median(rng, shape):
+    from pulseportraiture_tpu.ops.noise import exact_median_lastaxis
+
+    x = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+    got = np.asarray(jax.jit(exact_median_lastaxis)(x))
+    ref = np.asarray(jax.jit(lambda v: jnp.median(v, axis=-1))(x))
+    # bit-identical, not just close: the streaming raw program promises
+    # bit-stable packed output and get_SNR sits on that path
+    assert np.array_equal(got.view(np.int32), ref.view(np.int32))
+
+
+def test_exact_median_adversarial_values():
+    from pulseportraiture_tpu.ops.noise import exact_median_lastaxis
+
+    # duplicates, signed zeros, negatives, huge/tiny magnitudes
+    rows = np.array([
+        [-3.5, -0.0, 0.0, 1.25, 1.25, 7.0],
+        [1e30, -1e30, 1e-30, -1e-30, 0.0, 2.0],
+        [5.0, 5.0, 5.0, 5.0, 5.0, 5.0],
+        [-1.0, -2.0, -3.0, -4.0, -5.0, -6.0],
+    ], dtype=np.float32)
+    got = np.asarray(exact_median_lastaxis(jnp.asarray(rows)))
+    ref = np.median(rows, axis=-1).astype(np.float32)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_exact_median_f64_falls_back(rng):
+    from pulseportraiture_tpu.ops.noise import exact_median_lastaxis
+
+    x = jnp.asarray(rng.standard_normal((5, 33)))
+    np.testing.assert_array_equal(
+        np.asarray(exact_median_lastaxis(x)),
+        np.median(np.asarray(x), axis=-1))
+
+
+def test_get_snr_unchanged_by_median_swap(rng):
+    # get_SNR through the sort-free median must equal the f64 numpy
+    # recomputation of the same formula
+    from pulseportraiture_tpu.ops.noise import get_SNR
+
+    prof = rng.standard_normal((4, 128)).astype(np.float32)
+    prof[:, 30:40] += 5.0
+    snr = np.asarray(get_SNR(jnp.asarray(prof), jnp.asarray(
+        np.full(4, 1.0, np.float32))))
+    p = prof - np.median(prof, axis=-1, keepdims=True)
+    peak = np.abs(p).max(axis=-1)
+    weq = np.maximum(np.abs(p.sum(axis=-1)) / peak, 1.0)
+    ref = np.abs(p.sum(axis=-1)) / (1.0 * np.sqrt(weq)) / 3.25
+    np.testing.assert_allclose(snr, ref, rtol=2e-6)
+
+
+# --- fold-symmetry matmul DFT (config.dft_fold) -------------------------
+
+
+@pytest.mark.parametrize("nharm", [None, 16])
+def test_rfft_mm_fold_matches_direct(rng, nharm):
+    from pulseportraiture_tpu.ops.fourier import rfft_mm
+
+    x = jnp.asarray(rng.standard_normal((3, 128)).astype(np.float32))
+    dr, di = rfft_mm(x, fold=False, nharm=nharm)
+    fr, fi = rfft_mm(x, fold=True, nharm=nharm)
+    ref = np.fft.rfft(np.asarray(x, np.float64), axis=-1)
+    if nharm is not None:
+        ref = ref[..., :nharm]
+    scale = np.abs(ref).max()
+    assert np.abs(np.asarray(fr, np.float64) - ref.real).max() < 1e-5 * scale
+    assert np.abs(np.asarray(fi, np.float64) - ref.imag).max() < 1e-5 * scale
+    # fold and direct agree to f32 rounding on the same harmonics
+    np.testing.assert_allclose(np.asarray(fr), np.asarray(dr),
+                               atol=2e-5 * scale)
+    np.testing.assert_allclose(np.asarray(fi), np.asarray(di),
+                               atol=2e-5 * scale)
+
+
+def test_rfft_mm_fold_odd_n_falls_back(rng):
+    from pulseportraiture_tpu.ops.fourier import rfft_mm
+
+    x = jnp.asarray(rng.standard_normal((2, 65)).astype(np.float32))
+    dr, di = rfft_mm(x, fold=True)
+    ref = np.fft.rfft(np.asarray(x, np.float64), axis=-1)
+    scale = np.abs(ref).max()
+    assert np.abs(np.asarray(dr, np.float64) - ref.real).max() < 1e-5 * scale
+
+
+def test_dft_fold_config_strict():
+    from pulseportraiture_tpu import config
+    from pulseportraiture_tpu.ops.fourier import use_dft_fold
+
+    old = config.dft_fold
+    try:
+        config.dft_fold = "typo"
+        with pytest.raises(ValueError, match="dft_fold"):
+            use_dft_fold()
+        config.dft_fold = "auto"
+        assert use_dft_fold() in (True, False)
+        config.dft_fold = True
+        assert use_dft_fold() is True
+    finally:
+        config.dft_fold = old

@@ -1,0 +1,196 @@
+"""Option-lattice coverage for the fast fit engine (ISSUE 1 satellite).
+
+The engine's knob lattice — fit flags x bounds x harmonic window x
+cross-spectrum dtype x compensated reductions x instrumental response —
+was previously tested only at directed points; a knob interaction that
+broke an untested combination (e.g. bounds under a windowed bf16
+scattering fit) would ship silently.  This sweeps the full lattice on a
+tiny synthetic batch with KNOWN injected (phi, DM, tau), asserting
+convergence (return codes in the engine's success vocabulary), truth
+recovery within per-combo tolerances, and — for the no-scatter,
+no-response combos — agreement with the independent NumPy reference.
+
+A directed fast subset runs in tier-1; the full lattice (every
+combination, ~60 compiled programs) is marked `slow`.
+"""
+
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu import config
+from pulseportraiture_tpu.config import Dconst
+from pulseportraiture_tpu.fit import FitFlags
+from pulseportraiture_tpu.fit.portrait import (fit_portrait_batch_fast,
+                                               model_harmonic_window)
+from pulseportraiture_tpu.fit.reference_numpy import fit_portrait_numpy
+
+NB, NCHAN, NBIN = 2, 8, 512
+P, NU_FIT = 0.003, 1500.0
+PHI_TRUE = np.array([0.021, -0.0137])
+DM_TRUE = np.array([0.4, -0.3])  # small DM offsets [pc cm^-3]
+TAU_TRUE = 0.02  # rotations at NU_FIT (scatter combos)
+ALPHA_TRUE = -4.0
+NOISE = 0.003
+
+FLAG_SETS = {
+    "phiDM": (FitFlags(True, True, False, False, False), False),
+    "scat": (FitFlags(True, True, False, True, True), True),
+}
+BOUNDS = {
+    # generous box containing truth; exercises the projected-gradient
+    # path and the TNC-vocabulary return codes
+    "on": np.array([[-0.5, 0.5], [-50.0, 50.0], [-1.0, 1.0],
+                    [-8.0, 1.0], [-8.0, 0.0]]),
+    "off": None,
+}
+
+
+def _synth(with_ir=False, scattered=False):
+    """Tiny batch with injected truth, built in f64 numpy (independent
+    of the engine's DFT path)."""
+    from pulseportraiture_tpu.models.gaussian import gen_gaussian_portrait
+    from pulseportraiture_tpu.synth import default_test_model
+
+    rng = np.random.default_rng(7)
+    tm = default_test_model(NU_FIT)
+    freqs = np.linspace(1300.0, 1899.0, NCHAN)
+    params = {k: np.asarray(v, np.float64)
+              for k, v in tm.params_pytree().items()}
+    model = np.asarray(gen_gaussian_portrait(
+        {k: jnp.asarray(v) for k, v in params.items()}, jnp.asarray(freqs),
+        tm.nu_ref, NBIN, P=P, code=tm.code, scattered=False), np.float64)
+    nharm = NBIN // 2 + 1
+    k = np.arange(nharm)
+    mFT = np.fft.rfft(model, axis=-1)
+    ir = None
+    if with_ir:
+        # mild per-channel low-pass response with a linear phase ramp
+        sig = 80.0 + 10.0 * np.arange(NCHAN)[:, None]
+        ir = (np.exp(-0.5 * (k[None, :] / sig) ** 2)
+              * np.exp(-2j * np.pi * k[None, :] * 0.001))
+    ports = np.empty((NB, NCHAN, NBIN))
+    for i in range(NB):
+        t_n = PHI_TRUE[i] + (Dconst * DM_TRUE[i] / P) * (
+            freqs**-2.0 - NU_FIT**-2.0)
+        # rotate by -t_n: the engine's objective phasor is e^{+2pi i k t}
+        # (C peaks where the rotation is undone), matching bench.py
+        dFT = mFT * np.exp(-2j * np.pi * np.outer(t_n, k))
+        if scattered:
+            taus = TAU_TRUE * (freqs / NU_FIT) ** ALPHA_TRUE
+            B = 1.0 / (1.0 + 2j * np.pi * taus[:, None] * k[None, :])
+            dFT = dFT * B
+        if ir is not None:
+            dFT = dFT * ir
+        ports[i] = np.fft.irfft(dFT, n=NBIN, axis=-1)
+    ports += NOISE * rng.standard_normal(ports.shape)
+    return (ports.astype(np.float32), model.astype(np.float32),
+            freqs.astype(np.float32), ir)
+
+
+def _run_combo(flag_key, bounds_key, window, xspec, comp, ir_key):
+    flags, scattered = FLAG_SETS[flag_key]
+    with_ir = ir_key == "ir"
+    ports, model, freqs, ir = _synth(with_ir=with_ir,
+                                     scattered=scattered)
+    old_x, old_c = config.cross_spectrum_dtype, config.scatter_compensated
+    config.cross_spectrum_dtype = ("bfloat16" if xspec == "bf16"
+                                   else None)
+    config.scatter_compensated = comp == "comp"
+    try:
+        hwin = (model_harmonic_window(model, NBIN)
+                if window == "derived" else False)
+        th0 = np.zeros((NB, 5), np.float32)
+        if scattered:
+            th0[:, 3] = np.log10(TAU_TRUE)
+            th0[:, 4] = ALPHA_TRUE
+        r = fit_portrait_batch_fast(
+            jnp.asarray(ports), model, jnp.full((NB, NCHAN), NOISE,
+                                                jnp.float32),
+            jnp.asarray(freqs), P, NU_FIT, theta0=jnp.asarray(th0),
+            fit_flags=flags, log10_tau=scattered, max_iter=40,
+            ir_FT=ir, harmonic_window=hwin if hwin else False,
+            bounds=BOUNDS[bounds_key])
+    finally:
+        config.cross_spectrum_dtype = old_x
+        config.scatter_compensated = old_c
+    return r, ports, model, freqs
+
+
+def _check_combo(flag_key, bounds_key, window, xspec, comp, ir_key):
+    flags, scattered = FLAG_SETS[flag_key]
+    r, ports, model, freqs = _run_combo(flag_key, bounds_key, window,
+                                        xspec, comp, ir_key)
+    rc = np.asarray(r.return_code)
+    # success vocabulary: 0/2 historical, 1 = interior convergence in
+    # bounds mode (config.RCSTRINGS)
+    assert np.all(np.isin(rc, [0, 1, 2])), rc
+    assert np.all(np.isfinite(np.asarray(r.phi)))
+
+    # truth recovery at nu_fit reference (re-reference the reported phi
+    # from nu_DM back to NU_FIT through the fitted DM)
+    phi = np.asarray(r.phi) + (Dconst * np.asarray(r.DM) / P) * (
+        np.float64(NU_FIT) ** -2.0 - np.asarray(r.nu_DM) ** -2.0)
+    phi = (phi + 0.5) % 1.0 - 0.5
+    # per-combo tolerance: bf16 X quantization doesn't average down at
+    # 8 channels the way it does at 512, so those combos get more room
+    tol_phi = 5e-4 if xspec == "bf16" else 2e-4
+    assert np.all(np.abs(phi - PHI_TRUE) < tol_phi), (
+        phi - PHI_TRUE, tol_phi)
+    assert np.all(np.abs(np.asarray(r.DM) - DM_TRUE) < 0.3), r.DM
+    if scattered:
+        tau = np.asarray(r.tau) * (NU_FIT / np.asarray(r.nu_tau)) ** \
+            np.asarray(r.alpha)
+        rel = np.abs(tau - TAU_TRUE) / TAU_TRUE
+        tol_tau = 0.05 if xspec == "bf16" else 0.02
+        assert np.all(rel < tol_tau), (rel, tol_tau)
+
+    # independent NumPy oracle where it applies
+    if not scattered and ir_key == "noir":
+        ref = fit_portrait_numpy(
+            np.asarray(ports[0], np.float64),
+            np.asarray(model, np.float64),
+            np.full(NCHAN, NOISE), np.asarray(freqs, np.float64),
+            P, NU_FIT)
+        phi_ref = (ref["phi"] + 0.5) % 1.0 - 0.5
+        assert abs(phi[0] - phi_ref) < tol_phi
+
+
+# --- directed fast subset (tier-1) --------------------------------------
+
+FAST_COMBOS = [
+    ("phiDM", "off", "full", "bf16", "plain", "noir"),
+    ("phiDM", "on", "derived", "f32", "plain", "noir"),
+    ("scat", "off", "derived", "bf16", "plain", "noir"),
+    ("scat", "on", "full", "f32", "comp", "noir"),
+    ("scat", "off", "full", "f32", "plain", "ir"),
+]
+
+
+@pytest.mark.parametrize("combo", FAST_COMBOS,
+                         ids=["-".join(c) for c in FAST_COMBOS])
+def test_option_lattice_directed(combo):
+    _check_combo(*combo)
+
+
+# --- full lattice (slow) ------------------------------------------------
+
+ALL_COMBOS = [
+    (fk, bk, win, xs, cp, ir)
+    for fk, bk, win, xs, cp, ir in itertools.product(
+        FLAG_SETS, BOUNDS, ("full", "derived"), ("bf16", "f32"),
+        ("plain", "comp"), ("noir", "ir"))
+    # compensated is a scattering-engine knob; on the no-scatter path
+    # it is dead by construction (stream._raw_fit_fn normalizes it
+    # away), so those combos are not distinct programs
+    if not (cp == "comp" and fk == "phiDM")
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("combo", ALL_COMBOS,
+                         ids=["-".join(c) for c in ALL_COMBOS])
+def test_option_lattice_full(combo):
+    _check_combo(*combo)

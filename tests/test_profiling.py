@@ -1,0 +1,96 @@
+"""Unit tests for the stage-attribution profiler
+(pulseportraiture_tpu.profiling) — the reusable promotion of
+exp_breakdown.py's methodology (ISSUE 1 tentpole)."""
+
+import jax.numpy as jnp
+import pytest
+
+from pulseportraiture_tpu.profiling import (Attribution, Stage, devtime,
+                                            profile_stages)
+
+
+def _fake_devtime(table):
+    """Stub timer: each stage fn returns its key into `table`."""
+
+    def dt(fn, pick=None, K=4, warm=1, nrun=3):
+        s = table[fn()]
+        return s, s
+
+    return dt
+
+
+def test_prefix_differencing_and_attribution_math():
+    table = {"full": 10.0, "a": 2.0, "b": 5.0, "p": 4.0}
+    stages = [
+        Stage("a", lambda: "a"),
+        Stage("b", lambda: "b"),
+        Stage("p", lambda: "p", "piece"),
+    ]
+    att = profile_stages(lambda: "full", stages,
+                         devtime_fn=_fake_devtime(table))
+    assert att.total_s == 10.0
+    # prefix costs are differenced; the piece adds directly
+    assert att.cost("a") == 2.0
+    assert att.cost("b") == 3.0
+    assert att.cost("p") == 4.0
+    # attributed = last prefix slope + pieces, NEVER built from total
+    assert att.attributed_s == 9.0
+    assert att.attributed_frac == pytest.approx(0.9)
+    assert att.check(0.9)
+    assert not att.check(0.95)
+
+
+def test_breakdown_ms_fields():
+    table = {"full": 0.010, "a": 0.004, "p": 0.005}
+    att = profile_stages(
+        lambda: "full",
+        [Stage("a", lambda: "a"), Stage("p", lambda: "p", "piece")],
+        devtime_fn=_fake_devtime(table))
+    d = att.breakdown_ms()
+    assert d["stage_a_ms"] == 4.0
+    assert d["stage_p_ms"] == 5.0
+    assert d["full_ms"] == 10.0
+    assert d["attributed_frac"] == 0.9
+
+
+def test_negative_prefix_difference_clamps_to_zero():
+    # load noise can make a later prefix measure FASTER; the stage cost
+    # clamps at 0 instead of going negative
+    table = {"full": 10.0, "a": 5.0, "b": 4.0}
+    att = profile_stages(
+        lambda: "full",
+        [Stage("a", lambda: "a"), Stage("b", lambda: "b")],
+        devtime_fn=_fake_devtime(table))
+    assert att.cost("b") == 0.0
+    # attribution still uses the last prefix's own slope
+    assert att.attributed_s == 4.0
+
+
+def test_prefix_after_piece_raises():
+    table = {"full": 1.0, "a": 0.5, "p": 0.2}
+    with pytest.raises(ValueError, match="prefix.*piece"):
+        profile_stages(
+            lambda: "full",
+            [Stage("p", lambda: "p", "piece"),
+             Stage("a", lambda: "a")],
+            devtime_fn=_fake_devtime(table))
+
+
+def test_unknown_stage_kind_raises():
+    with pytest.raises(ValueError, match="unknown stage kind"):
+        profile_stages(
+            lambda: "full", [Stage("x", lambda: "full", "weird")],
+            devtime_fn=_fake_devtime({"full": 1.0}))
+
+
+def test_unknown_stage_name_raises():
+    att = Attribution(1.0, 1.0, (), 1.0, 1.0)
+    with pytest.raises(KeyError):
+        att.cost("nope")
+
+
+def test_devtime_real_dispatch_smoke():
+    x = jnp.arange(64.0)
+    slope, single = devtime(lambda: x * 2.0, K=2, warm=1, nrun=1)
+    assert slope > 0.0
+    assert single > 0.0
