@@ -1,6 +1,7 @@
-"""Stage-attribution driver for the two historically-unprofiled lanes
-(ISSUE 1): the 5-parameter scattering fit (BASELINE config 3) and the
-device-resident raw-campaign bucket program (config 5c).
+"""Stage-attribution driver for the historically-unprofiled lanes:
+the 5-parameter scattering fit (BASELINE config 3), the
+device-resident raw-campaign bucket program (config 5c), and — ISSUE 2
+— the device-resident align iteration (config 4).
 
 Built on pulseportraiture_tpu.profiling (the reusable promotion of
 exp_breakdown.py's methodology): each lane is decomposed into named
@@ -10,14 +11,17 @@ precomputed inputs), and the profiler checks that the independently
 measured stages sum to the end-to-end slope (>= 90% gates the
 benchmarks).
 
-The stage builders here are imported by bench_scatter.py and
-bench_device_campaign.py so their JSON lines carry the same per-stage
-breakdown this script prints; run standalone for the attribution alone:
+The stage builders here are imported by bench_scatter.py,
+bench_device_campaign.py and bench_align.py so their JSON lines carry
+the same per-stage breakdown this script prints; run standalone for
+the attribution alone:
 
     python benchmarks/attrib.py scatter
     python benchmarks/attrib.py campaign
+    python benchmarks/attrib.py align
 
-Shapes via PPT_NB / PPT_NCHAN / PPT_NBIN (campaign: PPT_NSUBB).
+Shapes via PPT_NB / PPT_NCHAN / PPT_NBIN (campaign: PPT_NSUBB; align:
+PPT_NE).
 """
 
 import json
@@ -188,6 +192,106 @@ def campaign_stage_profile(raw, scl, offs, cmask, model, freqs, Ps,
                           nrun=nrun)
 
 
+def align_stage_profile(cube, noise, masks, freqs, P_s, acc_dt,
+                        fit_fn, full_fn, K=4, nrun=3):
+    """Attribution of the device-resident align iteration
+    (pipeline/align.align_archives device lane; parallel/batch.py):
+
+      fit        (prefix)  the batched (phi, DM) fast fit
+      rotate     (prefix)  + delays/weights + split-real phasor
+                           rotation of the chunked harmonic stacks
+                           (_align_rotate_real — the production math)
+      accumulate (prefix)  + the donated weighted on-chip accumulate
+                           (align_accumulate_archive itself)
+      irfft      (prefix)  + the iteration's ONE irfft + normalization
+                           (align_finalize)
+      host_sync  (piece)   the per-iteration device->host pull of the
+                           finalized (npol, nchan, nbin) portrait
+
+    cube: (nb, npol, nchan, nbin); fit_fn() runs the batched fit the
+    production lane runs; full_fn() is the end-to-end iteration the
+    bench times (fit -> accumulate -> finalize -> host pull), so the
+    attribution denominator is exactly the benched program."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pulseportraiture_tpu.parallel.batch import (
+        ALIGN_DEVICE_CHUNK, _align_chunk, _align_precision,
+        _align_rotate_real, _align_weights_fn, align_accumulate_archive,
+        align_accumulator_init, align_finalize)
+    from pulseportraiture_tpu.ops.fourier import rfft_sr
+    from pulseportraiture_tpu.profiling import Stage, profile_stages
+
+    npol, nchan = cube.shape[1], cube.shape[2]
+    nbin = cube.shape[-1]
+    dt_str = str(jnp.dtype(acc_dt))
+    prec = _align_precision()
+    # keep the cube in its PRODUCTION dtype (f32 from the loader/synth)
+    # and convert inside the measured prefixes, exactly where
+    # align_accumulate_archive converts — a precomputed acc_dt cube
+    # would leave the (possibly ~100s of MB) widening pass
+    # unattributed on CPU, where acc_dt is f64
+    cube_j = jnp.asarray(cube)
+    chunk = _align_chunk(cube.shape[0], ALIGN_DEVICE_CHUNK)
+
+    def weights(r):
+        return _align_weights_fn(dt_str)(
+            jnp.asarray(r.phi, acc_dt), jnp.asarray(r.DM, acc_dt),
+            jnp.asarray(r.nu_DM, acc_dt), jnp.asarray(P_s, acc_dt),
+            jnp.asarray(freqs, acc_dt), jnp.asarray(noise, acc_dt),
+            jnp.asarray(masks, acc_dt), jnp.asarray(r.scales, acc_dt))
+
+    # arrays ship as ARGUMENTS, never jit-closed-over constants (XLA
+    # would constant-fold the stage at compile time — the exp_breakdown
+    # lesson, see scatter_stage_profile)
+    @jax.jit
+    def rot_chunk(cc, dd):
+        cr, ci = rfft_sr(cc, precision=prec)
+        rr, ri = _align_rotate_real(cr, ci, dd)
+        return jnp.sum(rr) + jnp.sum(ri)
+
+    def pad(a, m):
+        return jnp.pad(a, ((0, chunk - m),) + ((0, 0),) * (a.ndim - 1))
+
+    def rotate_prefix():
+        r = fit_fn()
+        delays, _ = weights(r)
+        cd = jnp.asarray(cube_j, acc_dt)  # production widening pass
+        tot = jnp.zeros((), acc_dt)
+        for lo in range(0, cd.shape[0], chunk):
+            cc, dd = cd[lo:lo + chunk], delays[lo:lo + chunk]
+            m = cc.shape[0]
+            if m != chunk:
+                cc, dd = pad(cc, m), pad(dd, m)
+            tot = tot + rot_chunk(cc, dd)
+        return tot
+
+    def accum_prefix():
+        r = fit_fn()
+        acc = align_accumulator_init(npol, nchan, nbin, acc_dt)
+        return align_accumulate_archive(acc, cube_j, r.phi, r.DM,
+                                        r.nu_DM, P_s, freqs, noise,
+                                        masks, r.scales)
+
+    def irfft_prefix():
+        acc = accum_prefix()
+        return align_finalize(acc, nbin)
+
+    # host_sync piece: the d2h pull of a PRECOMPUTED finalized portrait
+    # (everything before it is the irfft prefix)
+    final_dev = jax.block_until_ready(irfft_prefix())
+
+    stages = [
+        Stage("fit", fit_fn, "prefix", lambda r: r.phi),
+        Stage("rotate", rotate_prefix, "prefix"),
+        Stage("accumulate", accum_prefix, "prefix", lambda a: a[0]),
+        Stage("irfft", irfft_prefix, "prefix"),
+        Stage("host_sync", lambda: np.asarray(final_dev), "piece"),
+    ]
+    return profile_stages(full_fn, stages, K=K, nrun=nrun)
+
+
 def main():
     lane = sys.argv[1] if len(sys.argv) > 1 else "scatter"
     if lane == "scatter":
@@ -198,8 +302,13 @@ def main():
         from benchmarks import bench_device_campaign
 
         out = bench_device_campaign.run_bench(attrib_only=True)
+    elif lane == "align":
+        from benchmarks import bench_align
+
+        out = bench_align.run_bench(attrib_only=True)
     else:
-        raise SystemExit(f"unknown lane {lane!r} (scatter|campaign)")
+        raise SystemExit(f"unknown lane {lane!r} "
+                         "(scatter|campaign|align)")
     print(json.dumps(out))
 
 

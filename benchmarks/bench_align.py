@@ -1,12 +1,25 @@
-"""BASELINE.md config 4 (single-chip form): one ppalign-style iteration
-over 256 epochs at 512 chan x 2048 bin — batched (phi, DM) fits of every
-epoch against the current template, then a weighted rotate-and-stack.
+"""BASELINE.md config 4: one ppalign-style iteration over 256 epochs at
+512 chan x 2048 bin — batched (phi, DM) fits of every epoch against the
+current template, then the weighted rotate-and-stack template update.
+
+ISSUE 2: the template update now has a DEVICE-RESIDENT lane (jitted
+split-real harmonic accumulate with donated on-chip buffers,
+parallel/batch.py, selected by config.align_device) next to the chunked
+c128 host lane that used to idle the chip.  This bench measures BOTH
+lanes of the production iteration (same fit engine, same inputs),
+checks they are digit-exact on the fixed seed, and prints the
+stage-attribution breakdown of the device lane (benchmarks/attrib.py:
+fit / rotate / accumulate / irfft / host_sync, gated >= 0.9) so the
+dominant stage is named — the TPU re-measure next chip session is
+pre-scoped by the breakdown, the CPU A/B gates the routing today.
 
 This is the in-memory math of pipeline/align.align_archives's inner
-loop (the file-level driver adds PSRFITS IO around exactly this); the
-multi-chip form shards the epoch axis (parallel/batch.py).
+loop (the file-level driver adds PSRFITS IO around exactly this — run
+with --cli for that path); the multi-chip form shards the epoch axis
+(parallel/batch.py).
 
-Prints ONE JSON line like bench.py.
+Prints ONE JSON line like bench.py.  Shapes via PPT_NE / PPT_NCHAN /
+PPT_NBIN; --cli shapes via PPT_NARCH / PPT_NSUB / PPT_NCHAN / PPT_NBIN.
 """
 
 import json
@@ -16,15 +29,23 @@ import time
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
+# digit-exactness gates, device vs host accumulate on the same fixed
+# seed: f64 round-off discipline (round 5's align test) when the device
+# accumulate runs f64 (CPU A/B), f32-grade when it runs f32 (TPU)
+EXACT_GATE_F64 = 1e-10
+EXACT_GATE_F32 = 2e-5
+
 
 def main_cli():
     """--cli: the file-level align_archives path (PSRFITS IO + batched
     phase-guess + harmonic-domain accumulate; round 5 batched its two
-    per-subint host loops — A/B numbers in BENCHMARKS.md).  Host-bound
-    either way; archives cached like bench_campaign."""
+    per-subint host loops — A/B numbers in BENCHMARKS.md).  The
+    accumulate lane follows config.align_device (PPT_ALIGN_DEVICE
+    flips it); archives cached like bench_campaign."""
     import jax
 
     import pulseportraiture_tpu  # noqa: F401
+    from pulseportraiture_tpu.parallel.batch import use_align_device
     from pulseportraiture_tpu.pipeline import align_archives
     from pulseportraiture_tpu.synth import default_test_model, \
         make_fake_pulsar
@@ -65,28 +86,54 @@ def main_cli():
         "unit": "subint-iterations/sec",
         "warm_s": round(min(times), 2),
         "cold_s": round(times[0], 2),
+        "align_device": bool(use_align_device()),
         "device": str(jax.devices()[0]),
     }))
 
 
-def main():
-    import jax
-    import jax.numpy as jnp
-
+def run_bench(attrib_only=False, with_attrib=True):
     import pulseportraiture_tpu  # noqa: F401
     from pulseportraiture_tpu import config
+
+    # importable by attrib.py / tests: restore the process-global
+    # config this bench overrides
+    saved = {k: getattr(config, k) for k in
+             ("dft_precision", "cross_spectrum_dtype")}
     config.dft_precision = "default"
     config.cross_spectrum_dtype = "bfloat16"
+    config.env_overrides()  # PPT_* A/B switches win over script defaults
+    try:
+        return _run_bench_inner(attrib_only, with_attrib)
+    finally:
+        for k, v in saved.items():
+            setattr(config, k, v)
 
+
+def _run_bench_inner(attrib_only, with_attrib):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from benchmarks.attrib import align_stage_profile
     from benchmarks.common import bench_model, devtime
     from pulseportraiture_tpu.fit import fit_portrait_batch_fast
-    from pulseportraiture_tpu.ops.rotation import rotate_portrait
+    from pulseportraiture_tpu.fit.portrait import resolve_harmonic_window
+    from pulseportraiture_tpu.ops.fourier import irfft_c
+    from pulseportraiture_tpu.parallel.batch import (
+        align_accumulate_archive, align_accumulator_init, align_finalize)
+    from pulseportraiture_tpu.pipeline.align import \
+        _host_accumulate_archive
+    from pulseportraiture_tpu.utils.device import host_compute
 
     NE = int(os.environ.get("PPT_NE", 256))
     NCHAN = int(os.environ.get("PPT_NCHAN", 512))
     NBIN = int(os.environ.get("PPT_NBIN", 2048))
     DT = jnp.float32
     P, NU_FIT = 0.003, 1500.0
+    # the device accumulate dtype mirrors align_archives' rule: f32 on
+    # TPU (no f64 there), f64 elsewhere (the host lane's digit peer)
+    on_tpu = jax.default_backend() == "tpu"
+    ACC_DT = jnp.float32 if on_tpu else jnp.float64
     model, freqs = bench_model(NCHAN, NBIN)
 
     @jax.jit
@@ -98,40 +145,110 @@ def main():
 
     ports = synth(jax.random.PRNGKey(0))
     noise = jnp.full((NE, NCHAN), 0.05, DT)
-
-    @jax.jit
-    def stack(ports, phis, DMs, scales, noise_stds):
-        rot = jax.vmap(
-            lambda p, ph, dm: rotate_portrait(p, -ph, -dm, freqs, P, NU_FIT)
-        )(ports, phis, DMs)
-        wts = scales / noise_stds**2.0  # reference ppalign.py:236-242
-        num = jnp.einsum("enb,en->nb", rot, wts)
-        return num / jnp.maximum(jnp.sum(wts, 0), 1e-30)[:, None]
+    masks = jnp.ones((NE, NCHAN), DT)
+    P_s = jnp.full((NE,), P, DT)
+    cube = ports[:, None]  # (NE, npol=1, NCHAN, NBIN)
 
     # the production align_archives derives the harmonic window from
-    # its host template each iteration (noisy averages resolve to full
-    # spectrum); mirror that here from the one-time host pull
-    import numpy as np
-
-    from pulseportraiture_tpu.fit.portrait import resolve_harmonic_window
-
+    # its host template each iteration; mirror that here
     hwin = resolve_harmonic_window(None, np.asarray(model), NBIN)
 
-    def iteration():
-        r = fit_portrait_batch_fast(
+    def run_fit():
+        return fit_portrait_batch_fast(
             ports, model, noise, freqs, P, NU_FIT, max_iter=25,
             harmonic_window=hwin if hwin is not None else False)
-        return stack(ports, r.phi, r.DM, r.scales, noise)
 
-    slope, single = devtime(iteration, lambda t: t)
-    print(json.dumps({
-        "metric": "align iteration (fit+stack), 256 epochs x 512ch x 2048bin",
-        "value": round(NE / slope, 2),
+    def device_iteration():
+        """The production device lane: batched fit -> on-chip
+        split-real rotate-accumulate (donated buffers) -> ONE irfft ->
+        the per-iteration host pull."""
+        r = run_fit()
+        acc = align_accumulator_init(1, NCHAN, NBIN, ACC_DT)
+        acc = align_accumulate_archive(acc, cube, r.phi, r.DM, r.nu_DM,
+                                       P_s, freqs, noise, masks,
+                                       r.scales)
+        return np.asarray(align_finalize(acc, NBIN))
+
+    # host-lane numpy views (the host accumulate is eager)
+    cube_np = np.asarray(cube, float)
+    freqs_np = np.asarray(freqs, float)
+    noise_np = np.asarray(noise, float)
+    masks_np = np.asarray(masks, float)
+    Ps_np = np.asarray(P_s, float)
+
+    def host_iteration():
+        """The pre-ISSUE-2 host lane: same fit, then the chunked c128
+        harmonic accumulate under host_compute() (the production
+        oracle, pipeline/align._host_accumulate_archive)."""
+        r = run_fit()
+        aligned_FT = np.zeros((1, NCHAN, NBIN // 2 + 1), complex)
+        total_weights = np.zeros((NCHAN, NBIN))
+        aligned_FT, total_weights = _host_accumulate_archive(
+            aligned_FT, total_weights, cube_np, np.asarray(r.phi),
+            np.asarray(r.DM), np.asarray(r.nu_DM), Ps_np, freqs_np,
+            noise_np, masks_np, np.asarray(r.scales) * masks_np)
+        with host_compute():
+            aligned = np.array(irfft_c(jnp.asarray(aligned_FT),
+                                       n=NBIN))
+        return aligned / np.maximum(total_weights, 1e-30)[None]
+
+    # digit-exactness on the fixed seed BEFORE timing (also the warmup)
+    dev_out = device_iteration()
+    host_out = host_iteration()
+    scale = float(np.abs(host_out).max())
+    exact_rel = float(np.abs(dev_out - host_out).max() / scale)
+    exact_gate = (EXACT_GATE_F32 if ACC_DT == jnp.float32
+                  else EXACT_GATE_F64)
+
+    att = None
+    if with_attrib or attrib_only:
+        att = align_stage_profile(cube, noise, masks, freqs, P_s,
+                                  ACC_DT, run_fit, device_iteration)
+    if attrib_only:
+        out = {"metric": "align-lane stage attribution",
+               "batch": NE, "device": str(jax.devices()[0])}
+        out.update(att.breakdown_ms())
+        return out
+
+    dev_slope, dev_single = devtime(device_iteration)
+    host_slope, host_single = devtime(host_iteration)
+
+    out = {
+        "metric": f"align iteration (fit + rotate-and-stack), "
+                  f"{NE} epochs x {NCHAN}ch x {NBIN}bin",
+        "value": round(NE / dev_slope, 2),
         "unit": "epochs/sec",
-        "iteration_latency_ms": round(single * 1e3, 1),
+        "iteration_latency_ms": round(dev_single * 1e3, 1),
+        "batch": NE,
         "device": str(jax.devices()[0]),
-    }))
+        "align_device_dtype": str(jnp.dtype(ACC_DT)),
+        "harmonic_window": hwin,
+        # the measured A/B: same fit engine both lanes, the accumulate
+        # lane is the variable (acceptance: device no slower on CPU)
+        "host_epochs_per_sec": round(NE / host_slope, 2),
+        "host_iteration_latency_ms": round(host_single * 1e3, 1),
+        "ab_speedup_vs_host": round(host_slope / dev_slope, 2),
+        "ab_device_not_slower": bool(dev_slope <= host_slope),
+        "digit_exact_rel": float(f"{exact_rel:.3g}"),
+        "digit_exact_gate": exact_gate,
+        "digit_exact_ok": bool(exact_rel < exact_gate),
+    }
+    if att is not None:
+        out.update(att.breakdown_ms())
+        # >= 90% of the device lane's slope must be explained by
+        # independently measured stages (one-sided; see BENCHMARKS.md)
+        out["attrib_ok"] = bool(att.check(0.9))
+        out["dominant_stage"] = max(att.stages,
+                                    key=lambda s: s.cost_s).name
+    return out
+
+
+def main():
+    if "--cli" in sys.argv:
+        main_cli()
+    else:
+        print(json.dumps(run_bench()))
 
 
 if __name__ == "__main__":
-    main_cli() if "--cli" in sys.argv else main()
+    main()

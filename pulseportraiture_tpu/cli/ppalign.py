@@ -44,6 +44,13 @@ def build_parser():
                    help="Place the peak at this phase (overrides --rot).")
     p.add_argument("--niter", type=int, default=1,
                    help="Number of align/average iterations.")
+    p.add_argument("--align-device", dest="align_device", default=None,
+                   choices=("auto", "on", "off"),
+                   help="Run the rotate-and-accumulate template update "
+                        "on the default device (jitted split-real "
+                        "harmonic programs) instead of the chunked "
+                        "complex host loop.  auto = on for TPU "
+                        "backends.  [default: config.align_device]")
     p.add_argument("--verbose", dest="quiet", action="store_false",
                    default=True)
     return p
@@ -72,11 +79,14 @@ def main(argv=None):
     else:
         init = datafiles[0]
     outfile = args.outfile or (args.metafile + ".algnd.fits")
+    adev = {None: None, "auto": "auto", "on": True,
+            "off": False}[args.align_device]
     align_archives(datafiles, init, fit_dm=args.fit_dm,
                    tscrunch=args.tscrunch, pscrunch=args.pscrunch,
                    SNR_cutoff=args.SNR_cutoff, outfile=outfile,
                    norm=args.norm, rot_phase=args.rot_phase,
-                   place=args.place, niter=args.niter, quiet=args.quiet)
+                   place=args.place, niter=args.niter, quiet=args.quiet,
+                   align_device=adev)
     if args.smooth:
         import os.path
 

@@ -23,8 +23,8 @@ import numpy as np
 
 from .. import config
 
-__all__ = ["rfft_mm", "irfft_mm", "rfft_c", "irfft_c", "use_matmul_dft",
-           "use_dft_fold"]
+__all__ = ["rfft_mm", "irfft_mm", "rfft_c", "irfft_c", "rfft_sr",
+           "irfft_sr", "use_matmul_dft", "use_dft_fold"]
 
 
 def _default_precision():
@@ -207,6 +207,34 @@ def irfft_c(X, n=None, precision=None):
         return irfft_mm(jnp.real(X), jnp.imag(X), n=n,
                         precision=_gated_precision(precision))
     return jnp.fft.irfft(X, n=n, axis=-1)
+
+
+def rfft_sr(x, precision=None):
+    """Split-real backend-dispatched rfft: (..., n) -> (Re, Im), each
+    (..., n//2+1) real.  The split-real analogue of rfft_c: matmul-DFT
+    weights where use_matmul_dft() says so (TPU, where XLA's FFT
+    lowering is unusable AND complex dtypes cannot appear in the
+    program at all), jnp.fft elsewhere (CPU f64 matmul DFTs would cost
+    ~n/log n times the FFT's FLOPs).  For jitted programs that must
+    stay complex-free on the accelerator end to end (the device align
+    accumulate, parallel/batch.py) — the jnp.fft arm materializes a
+    complex intermediate INSIDE the program, which is fine on backends
+    that take that arm.  Precision gating follows the complex
+    interface (config 'default' clamps to 'high')."""
+    x = jnp.asarray(x)
+    if use_matmul_dft():
+        return rfft_mm(x, precision=_gated_precision(precision),
+                       fold=False)
+    X = jnp.fft.rfft(x, axis=-1)
+    return jnp.real(X), jnp.imag(X)
+
+
+def irfft_sr(Xr, Xi, n=None, precision=None):
+    """Inverse of rfft_sr: (Re, Im) -> (..., n) real, same dispatch."""
+    if use_matmul_dft():
+        return irfft_mm(Xr, Xi, n=n,
+                        precision=_gated_precision(precision))
+    return jnp.fft.irfft(jax.lax.complex(Xr, Xi), n=n, axis=-1)
 
 
 def _gated_precision(precision):

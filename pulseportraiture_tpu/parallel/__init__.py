@@ -1,6 +1,8 @@
 from .mesh import make_mesh, batch_sharding, replicated
-from .batch import (align_iteration_sharded, fit_portrait_sharded,
-                    fit_portrait_sharded_fast, shard_batch)
+from .batch import (align_accumulate_archive, align_accumulator_init,
+                    align_finalize, align_iteration_sharded,
+                    fit_portrait_sharded, fit_portrait_sharded_fast,
+                    shard_batch, use_align_device)
 from .multihost import (global_mesh, init_multihost, process_allgather,
                         process_count, process_index, shard_files)
 
@@ -8,6 +10,10 @@ __all__ = [
     "make_mesh",
     "batch_sharding",
     "replicated",
+    "align_accumulate_archive",
+    "align_accumulator_init",
+    "align_finalize",
+    "use_align_device",
     "align_iteration_sharded",
     "fit_portrait_sharded",
     "fit_portrait_sharded_fast",

@@ -275,6 +275,216 @@ def _sharded_align_fn(mesh, flags, max_iter, shard_channels):
 
 _ALIGN_TINY = 1e-30
 
+# ---------------------------------------------------------------------------
+# Single-device align iteration: the split-real rotate-and-accumulate
+# equivalent of align_iteration_sharded for the single-process
+# CLI/pipeline path (pipeline/align.align_archives, config.align_device).
+# The template update accumulates in the HARMONIC domain on the default
+# device — phasor rotation is a split-real (cos, sin) multiply on the
+# spectra, the weighted sum over subints stays on-chip with the
+# accumulator buffers DONATED across calls, and ONE irfft per iteration
+# recovers the average.  The DFTs dispatch through ops.fourier.rfft_sr:
+# matmul weights on TPU (no complex dtypes anywhere in the program, so
+# it compiles on runtimes that reject c64/c128), jnp.fft on backends
+# with a working FFT (CPU f64 matmul DFTs would cost ~n/log n times the
+# FLOPs).
+# ---------------------------------------------------------------------------
+
+# Subints per accumulate dispatch: bounds the transient (Cr, Ci, phasor)
+# HBM footprint to ~4 * chunk * npol * nchan * nharm floats while keeping
+# ONE compiled program per (chunk, npol, nchan, nbin, dtype) shape —
+# callers zero-pad the batch axis (w = 0 rows contribute exactly nothing).
+ALIGN_DEVICE_CHUNK = 64
+
+
+def use_align_device(setting=None):
+    """Whether align_archives should run its rotate-and-accumulate on
+    the default device: config.align_device (True/False force; 'auto' =
+    TPU backends, where the chunked c128 host accumulate idles the
+    chip).  Read per call, so in-process A/B flips take effect.
+    setting: an explicit per-call override (align_archives'
+    align_device= argument / ppalign --align-device); None -> config."""
+    if setting is None:
+        from .. import config
+
+        setting = getattr(config, "align_device", "auto")
+    if setting is True or setting is False:
+        return setting
+    if setting != "auto":
+        # strict like config's other tri-state knobs — a typo must not
+        # silently mean 'auto'
+        raise ValueError(
+            f"align_device must be True, False, or 'auto'; got "
+            f"{setting!r}")
+    return jax.default_backend() == "tpu"
+
+
+def _align_rotate_real(cube_r, cube_i, delays):
+    """Split-real phasor rotation of per-subint harmonic stacks:
+    (Cr + i Ci) * exp(+2 pi i k t) expanded into real parts.  Rotating
+    by positive delays moves features to earlier phase — the same
+    convention as ops.phasor.phasor / ops.rotation.rotate_portrait.
+
+    cube_r/cube_i: (nb, npol, nchan, nharm); delays: (nb, nchan) [rot].
+    Shared by the accumulate program and the bench attribution's
+    'rotate' prefix stage (benchmarks/attrib.py), so the profiled stage
+    is the production math, not a re-creation."""
+    k = jnp.arange(cube_r.shape[-1], dtype=cube_r.dtype)
+    ang = 2.0 * jnp.pi * delays[..., None] * k          # (nb, nchan, K)
+    c, s = jnp.cos(ang)[:, None], jnp.sin(ang)[:, None]
+    return cube_r * c - cube_i * s, cube_r * s + cube_i * c
+
+
+@lru_cache(maxsize=None)
+def _align_weights_fn(dt_str):
+    """Cached jit of the per-archive (delays, weights) computation on
+    device — the fit results never round-trip to the host (on a
+    tunneled TPU a (nb, nchan) f64 pull costs more than the whole
+    dispatch).  Same formulas as the host path (pipeline/align.py):
+    delays = phase_shifts(phi, DM, GM=0) and w = mask * max(scales, 0)
+    / noise**2 with non-positive noise zero-weighted."""
+    from ..ops.phasor import phase_shifts
+
+    def run(phi, DM, nu_ref, P_s, freqs, noise, masks, scales):
+        delays = phase_shifts(phi[:, None], DM[:, None], 0.0,
+                              freqs[None, :], P_s[:, None],
+                              nu_ref[:, None], 1.0)
+        # wrap to [-0.5, 0.5): integer-harmonic phasors are 1-periodic,
+        # and small arguments keep the f32 trig on TPU accurate
+        delays = delays - jnp.round(delays)
+        good = noise > 0.0
+        inv = jnp.where(good, 1.0 / jnp.where(good, noise, 1.0) ** 2, 0.0)
+        # masked channels must weight EXACTLY zero even when the fit
+        # left NaN scales there (0 * NaN = NaN would poison the stack)
+        w = jnp.where(masks > 0.0,
+                      masks * jnp.maximum(scales, 0.0) * inv, 0.0)
+        return delays, w
+
+    return jax.jit(run)
+
+
+@lru_cache(maxsize=None)
+def _align_accum_fn(dt_str, prec, mm):
+    """Cached donated jit of ONE accumulate chunk.  The lru key carries
+    the resolved DFT precision AND the DFT-dispatch arm (matmul vs
+    jnp.fft, ops.fourier.rfft_sr) so config flips retrace instead of
+    silently reusing the other arm's program; shapes key the underlying
+    jit cache as usual."""
+    from ..ops.fourier import rfft_sr
+
+    def run(acc_r, acc_i, wacc, cube, delays, w):
+        # cube: (C, npol, nchan, nbin); delays/w: (C, nchan)
+        cr, ci = rfft_sr(cube, precision=prec)
+        rr, ri = _align_rotate_real(cr, ci, delays)
+        wb = w[:, None, :, None]
+        acc_r = acc_r + jnp.sum(rr * wb, axis=0)
+        acc_i = acc_i + jnp.sum(ri * wb, axis=0)
+        wacc = wacc + jnp.sum(w, axis=0)
+        return acc_r, acc_i, wacc
+
+    return jax.jit(run, donate_argnums=(0, 1, 2))
+
+
+@lru_cache(maxsize=None)
+def _align_finalize_fn(dt_str, nbin, prec, mm):
+    """Cached jit of the iteration's ONE irfft + weight normalization."""
+    from ..ops.fourier import irfft_sr
+
+    def run(acc_r, acc_i, wacc):
+        aligned = irfft_sr(acc_r, acc_i, n=nbin, precision=prec)
+        return aligned / jnp.maximum(wacc, _ALIGN_TINY)[:, None]
+
+    return jax.jit(run)
+
+
+def _align_precision():
+    """Alignment math follows the complex-interface precision policy:
+    config.dft_precision with 'default' clamped up to 'high'
+    (ops.fourier._gated_precision) — the single-pass-bf16 setting is
+    validated only for the portrait fit's gates."""
+    from ..ops.fourier import _gated_precision
+
+    return _gated_precision(None)
+
+
+def _align_chunk(nb, chunk):
+    """Bucketed chunk size: the configured chunk when the batch fills
+    it, else the next power of two >= nb — padding waste stays <= 2x
+    for small archives while the compiled-program count stays
+    O(log chunk) across archive sizes (a per-size program would
+    recompile for every distinct nsub in a campaign)."""
+    if nb >= chunk:
+        return chunk
+    c = 1
+    while c < nb:
+        c <<= 1
+    return c
+
+
+def align_accumulator_init(npol, nchan, nbin, dtype):
+    """Fresh zeroed device accumulators (acc_r, acc_i, wacc) for one
+    align iteration; feed to align_accumulate_archive and finish with
+    align_finalize.  The buffers are donated by every accumulate call,
+    so hold no other references to them."""
+    k = nbin // 2 + 1
+    return (jnp.zeros((npol, nchan, k), dtype),
+            jnp.zeros((npol, nchan, k), dtype),
+            jnp.zeros((nchan,), dtype))
+
+
+def align_accumulate_archive(acc, cube, phi, DM, nu_ref, P_s, freqs,
+                             noise, masks, scales,
+                             chunk=ALIGN_DEVICE_CHUNK):
+    """Accumulate one archive's weighted, back-rotated subints into the
+    donated harmonic accumulators (the device-resident core of one
+    align_archives iteration; reference ppalign.py:236-242).
+
+    acc: (acc_r, acc_i, wacc) from align_accumulator_init (donated and
+    replaced).  cube: (nb, npol, nchan, nbin) device or host array;
+    phi/DM/nu_ref/scales may be device arrays straight from the batched
+    fit — nothing here forces a host sync.  Returns the new acc tuple.
+    """
+    from ..ops.fourier import use_matmul_dft
+
+    acc_r, acc_i, wacc = acc
+    dt = acc_r.dtype
+    cube = jnp.asarray(cube, dt)
+    nb = cube.shape[0]
+    chunk = _align_chunk(nb, chunk)
+    dt_str = str(dt)
+    prec = _align_precision()
+    delays, w = _align_weights_fn(dt_str)(
+        jnp.asarray(phi, dt), jnp.asarray(DM, dt),
+        jnp.asarray(nu_ref, dt), jnp.asarray(P_s, dt),
+        jnp.asarray(freqs, dt), jnp.asarray(noise, dt),
+        jnp.asarray(masks, dt), jnp.asarray(scales, dt))
+    step = _align_accum_fn(dt_str, prec, use_matmul_dft())
+    for lo in range(0, nb, chunk):
+        cc = cube[lo:lo + chunk]
+        dd = delays[lo:lo + chunk]
+        ww = w[lo:lo + chunk]
+        m = cc.shape[0]
+        if m != chunk:
+            # zero-weight padding rows contribute exactly nothing;
+            # padding the tail keeps ONE compiled accumulate program
+            # across archive sizes
+            cc = jnp.pad(cc, ((0, chunk - m),) + ((0, 0),) * (cc.ndim - 1))
+            dd = jnp.pad(dd, ((0, chunk - m), (0, 0)))
+            ww = jnp.pad(ww, ((0, chunk - m), (0, 0)))
+        acc_r, acc_i, wacc = step(acc_r, acc_i, wacc, cc, dd, ww)
+    return acc_r, acc_i, wacc
+
+
+def align_finalize(acc, nbin):
+    """The iteration's single irfft + weight normalization: harmonic
+    accumulators -> (npol, nchan, nbin) average portrait (device)."""
+    from ..ops.fourier import use_matmul_dft
+
+    acc_r, acc_i, wacc = acc
+    return _align_finalize_fn(str(acc_r.dtype), int(nbin),
+                              _align_precision(),
+                              use_matmul_dft())(acc_r, acc_i, wacc)
+
 
 @lru_cache(maxsize=None)
 def _sharded_fast_fn(mesh, flags, max_iter, m_ax, f_ax,

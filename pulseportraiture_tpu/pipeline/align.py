@@ -11,6 +11,7 @@ by internal averaging, wavelet smoothing, and header reads.
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..fit.phase_shift import fit_phase_shift_batch
@@ -18,6 +19,9 @@ from ..fit.portrait import (FitFlags, fit_portrait_batch,
                             fit_portrait_batch_fast,
                             resolve_harmonic_window,
                             use_fast_fit_default)
+from ..parallel.batch import (align_accumulate_archive,
+                              align_accumulator_init, align_finalize,
+                              use_align_device)
 from ..utils.device import host_compute
 from ..io.psrfits import load_data, read_archive, unload_new_archive
 from ..models.gaussian import gen_gaussian_profile
@@ -94,15 +98,61 @@ def gaussian_seed_portrait(nchan, nbin, fwhm, loc=0.5):
     return np.tile(prof, (nchan, 1))
 
 
+def _host_accumulate_archive(aligned_FT, total_weights, sub_cube, phis,
+                             DMs, nu_ref_fit, Ps_ok, freqs0, noise,
+                             masks, scales):
+    """Host lane of one archive's weighted back-rotated accumulate
+    (reference ppalign.py:236-242): weights = scales / noise^2, the
+    rotation is a phasor multiply in the harmonic domain, and the whole
+    archive accumulates as sum_j cFT_j * ph_j * w_j in chunks of 16
+    (bounded memory) under host_compute() — no per-subint inverse
+    transforms; the single irfft happens after the archive loop.
+
+    This is the digit-exactness oracle for the device lane
+    (parallel/batch.align_accumulate_archive) and the host arm of
+    bench_align's A/B — one implementation for both, so the comparison
+    is against the production math.  aligned_FT (npol, nchan, nharm)
+    c128 and total_weights (nchan, nbin) are updated and returned;
+    scales arrives already mask-multiplied (the loop's convention)."""
+    noise_safe = np.where(noise > 0.0, noise, np.inf)
+    w = masks * np.maximum(scales, 0.0) / noise_safe ** 2
+    with host_compute():
+        delays = phase_shifts(
+            jnp.asarray(phis)[:, None],
+            jnp.asarray(DMs)[:, None], 0.0,
+            jnp.asarray(np.broadcast_to(freqs0, w.shape)),
+            jnp.asarray(Ps_ok)[:, None],
+            jnp.asarray(nu_ref_fit)[:, None], 1.0)
+        for lo in range(0, len(sub_cube), 16):
+            sl = slice(lo, lo + 16)
+            cFT = rfft_c(jnp.asarray(sub_cube[sl]))
+            ph = phasor(delays[sl], cFT.shape[-1])
+            aligned_FT += np.asarray(jnp.sum(
+                cFT * ph[:, None]
+                * jnp.asarray(w[sl])[:, None, :, None],
+                axis=0))
+    total_weights += w.sum(axis=0)[:, None]
+    return aligned_FT, total_weights
+
+
 def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
                    pscrunch=True, SNR_cutoff=0.0, outfile=None, norm=None,
-                   rot_phase=0.0, place=None, niter=1, quiet=False):
+                   rot_phase=0.0, place=None, niter=1, quiet=False,
+                   align_device=None):
     """Iteratively align and average archives against a template
     (reference ppalign.py:65-280; same options/semantics).
 
     initial_guess: archive path OR an (nchan, nbin) portrait array.
     The output archive has DM=0 and unit weights.  Returns the final
     average portrait (npol, nchan, nbin).
+
+    align_device: None -> config.align_device; 'auto' = device
+    accumulate on TPU backends; True/False force.  The device lane
+    runs the rotate-and-stack template update as jitted split-real
+    harmonic programs with donated accumulators (parallel/batch.py) —
+    fit results and the subint stack never round-trip to the host
+    inside an iteration; the host lane is the digit-exactness oracle
+    (tests/test_pipeline_align.py).
     """
     if isinstance(metafile, str):
         datafiles = _read_metafile(metafile)
@@ -125,6 +175,13 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
         template_arch_path = None
     nchan, nbin = model_port.shape[-2:]
 
+    use_dev = use_align_device(align_device)
+    # the device accumulate runs f32 on TPU (no f64 there; alignment
+    # phasors stay accurate via the mod-1 wrap) and f64 elsewhere —
+    # a CPU-forced device lane is the host path's digit-exactness peer
+    dev_dt = jnp.float32 if jax.default_backend() == "tpu" \
+        else jnp.float64
+
     skip_these = set()
     final = None
     for it in range(niter):
@@ -134,9 +191,15 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
         # epoch contributes cFT * phasor * w (linear), and ONE irfft
         # per iteration recovers the average — instead of one inverse
         # transform per subint (reference ppalign.py:236-242 rotates
-        # every subint back through the time domain)
-        aligned_FT = np.zeros((npol, nchan, nbin // 2 + 1), complex)
-        total_weights = np.zeros((nchan, nbin))
+        # every subint back through the time domain).  Device lane:
+        # the same math as jitted split-real programs with donated
+        # on-chip accumulators (parallel/batch.py); host lane: chunked
+        # c128 under host_compute().
+        if use_dev:
+            acc = align_accumulator_init(npol, nchan, nbin, dev_dt)
+        else:
+            aligned_FT = np.zeros((npol, nchan, nbin // 2 + 1), complex)
+            total_weights = np.zeros((nchan, nbin))
         model_j = jnp.asarray(model_port)
         use_fast = use_fast_fit_default()
         if use_fast:
@@ -228,10 +291,10 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
                     fit_flags=FitFlags(True, bool(fit_dm), False, False,
                                        False),
                     chan_masks=jnp.asarray(masks, ft), **kw)
-                phis = np.asarray(res.phi)
-                DMs = np.asarray(res.DM)
-                scales = np.asarray(res.scales) * masks
-                nu_ref_fit = np.asarray(res.nu_DM)
+                # device lane: leave the fit leaves as device arrays —
+                # the accumulate consumes them on-chip, no host pull
+                phis, DMs = res.phi, res.DM
+                scales, nu_ref_fit = res.scales, res.nu_DM
             else:  # 1-channel fallback (ppalign.py:230-235)
                 phis = theta0[:, 0]
                 DMs = np.full(len(ok), DM_guess)
@@ -239,37 +302,32 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
                 nu_ref_fit = np.full(len(ok), freqs0.mean())
 
             # weighted accumulate of back-rotated subints
-            # (ppalign.py:236-242): weights = scales / noise^2.
-            # Rotation is a phasor multiply in the harmonic domain, so
-            # the whole archive accumulates as sum_j cFT_j*ph_j*w_j in
-            # chunks (bounded memory) — no per-subint inverse
-            # transforms; the single irfft happens after the archive
-            # loop
+            # (ppalign.py:236-242): weights = scales / noise^2
             sub_cube = np.asarray(d.subints[ok], float)  # (nok, npol, ...)
-            noise_safe = np.where(noise > 0.0, noise, np.inf)
-            w = masks * np.maximum(scales, 0.0) / noise_safe ** 2
+            if use_dev:
+                acc = align_accumulate_archive(
+                    acc, sub_cube, phis, DMs, nu_ref_fit, Ps_ok,
+                    freqs0, noise, masks, scales)
+            else:
+                aligned_FT, total_weights = _host_accumulate_archive(
+                    aligned_FT, total_weights, sub_cube,
+                    np.asarray(phis), np.asarray(DMs),
+                    np.asarray(nu_ref_fit), Ps_ok, freqs0, noise,
+                    masks, np.asarray(scales) * masks)
+        if use_dev:
+            # ONE device->host pull per iteration (the portrait seeds
+            # the next iteration's host-side window derivation) — the
+            # iteration boundary stays the only synchronization point
+            if not np.asarray(acc[2]).any():
+                raise RuntimeError("no archives could be aligned")
+            aligned = np.asarray(align_finalize(acc, nbin), float)
+        else:
+            if not total_weights.any():
+                raise RuntimeError("no archives could be aligned")
             with host_compute():
-                delays = phase_shifts(
-                    jnp.asarray(phis)[:, None],
-                    jnp.asarray(DMs)[:, None], 0.0,
-                    jnp.asarray(np.broadcast_to(freqs0, w.shape)),
-                    jnp.asarray(Ps_ok)[:, None],
-                    jnp.asarray(nu_ref_fit)[:, None], 1.0)
-                for lo in range(0, len(ok), 16):
-                    sl = slice(lo, lo + 16)
-                    cFT = rfft_c(jnp.asarray(sub_cube[sl]))
-                    ph = phasor(delays[sl], cFT.shape[-1])
-                    aligned_FT += np.asarray(jnp.sum(
-                        cFT * ph[:, None]
-                        * jnp.asarray(w[sl])[:, None, :, None],
-                        axis=0))
-            total_weights += w.sum(axis=0)[:, None]
-        if not total_weights.any():
-            raise RuntimeError("no archives could be aligned")
-        with host_compute():
-            aligned = np.array(irfft_c(jnp.asarray(aligned_FT),
-                                       n=nbin))
-        aligned /= np.maximum(total_weights, 1e-30)[None]
+                aligned = np.array(irfft_c(jnp.asarray(aligned_FT),
+                                           n=nbin))
+            aligned /= np.maximum(total_weights, 1e-30)[None]
         model_port = aligned[0]
         final = aligned
 

@@ -17,6 +17,7 @@ inherits these conventions from PSRCHIVE; this codec must earn them).
 import numpy as np
 import pytest
 
+from pulseportraiture_tpu.io import native
 from pulseportraiture_tpu.io.fitsio import (_parse_card, parse_tform,
                                             read_fits)
 
@@ -195,14 +196,15 @@ def _patch_card(blob, key, newcard):
     return blob[:i] + newcard.ljust(80).encode("ascii") + blob[i + 80:]
 
 
-@pytest.mark.parametrize("seed", range(8))
-@pytest.mark.parametrize("kind", [
+MALFORMED_KINDS = [
     "truncated_header", "truncated_data", "bad_tform", "tdim_mismatch",
-    "naxis1_mismatch", "missing_end", "missing_ttype"])
-def test_fuzz_malformed_refuses_cleanly(kind, seed, tmp_path):
-    """Deliberately broken files raise ValueError/KeyError — the codec
-    must never return silently-misparsed arrays."""
-    rng = np.random.default_rng(seed)
+    "naxis1_mismatch", "missing_end", "missing_ttype"]
+
+
+def _forge_malformed(kind, rng, tmp_path):
+    """Build one deliberately-broken file of the given class; returns
+    its path.  Shared by the Python-codec and native-lane refusal
+    tests so both lanes face the identical corpus."""
     blob, path = _forge_valid(rng, tmp_path)
     if kind == "truncated_header":
         cut = int(rng.integers(1, BLOCK))
@@ -236,6 +238,16 @@ def test_fuzz_malformed_refuses_cleanly(kind, seed, tmp_path):
     elif kind == "missing_ttype":
         blob = _patch_card(blob, "TTYPE2", "TXXXX2  = 'GONE    '")
     path.write_bytes(blob)
+    return path
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("kind", MALFORMED_KINDS)
+def test_fuzz_malformed_refuses_cleanly(kind, seed, tmp_path):
+    """Deliberately broken files raise ValueError/KeyError — the codec
+    must never return silently-misparsed arrays."""
+    rng = np.random.default_rng(seed)
+    path = _forge_malformed(kind, rng, tmp_path)
     with pytest.raises((ValueError, KeyError)):
         read_fits(str(path))
 
@@ -255,3 +267,157 @@ def test_parse_tform_variants():
     assert parse_tform(" 1J ") == (1, "J", "")
     assert parse_tform("D") == (1, "D", "")
     assert parse_tform("16X") == (16, "X", "")
+
+
+# --------------------------------------------------------------------------
+# Native C++ lane (VERDICT r5 #4): the SAME forged corpus through
+# ppt_native's fused decode kernel.  The kernel normally sees only
+# SUBINT DATA columns; here every supported column of every fuzz table
+# goes through it (npol=1, nchan=1, nbin=repeat) and must match the
+# Python codec bit-for-bit, and every malformed class must refuse in
+# this lane too — the C path reads raw bytes with no bounds checks of
+# its own, so the refusal discipline lives in the geometry validation
+# that fronts it (mirrored from psrfits.read_archive).
+# --------------------------------------------------------------------------
+
+_NATIVE_CODES = ("B", "I", "E")  # sample types the C kernel implements
+_NATIVE_SAMP = {"B": 1, "I": 2, "E": 4}
+
+
+class _DeferAll:
+    """Membership-always container: defers every bintable column, so
+    read_fits parses headers and validates row geometry but decodes NO
+    samples — the values under test come only from the C kernel."""
+
+    def __contains__(self, name):
+        return True
+
+
+def _native_decode_tables(path):
+    """Native-lane decoder for the fuzz corpus: header parse through
+    the Python codec with EVERY column deferred (no numpy sample
+    decode anywhere), samples of each supported column through
+    native.decode_fused straight from the wire bytes, TSCAL/TZERO
+    fused in as the kernel's scale/offset plane.  Mirrors
+    psrfits.read_archive's discipline: the C kernel has no bounds
+    checks, so column extents and TDIM factorizations are validated
+    here and inconsistent files refuse with ValueError instead of
+    reading past the column.  Returns [(extname, {col: f64 array})]
+    for the bintable HDUs."""
+    out = []
+    for hdu in read_fits(path, defer=_DeferAll()):
+        if not hdu.layout:
+            continue
+        nrows = int(hdu.header["NAXIS2"])
+        if len(hdu.raw) < nrows * hdu.row_stride:
+            raise ValueError("bintable payload shorter than NAXIS1*NAXIS2")
+        cols = {}
+        for i, (name, (col_off, code, repeat)) in enumerate(
+                hdu.layout.items()):
+            tdim = hdu.header.get(f"TDIM{i + 1}")
+            shape = (repeat,) if repeat > 1 else ()
+            if tdim:
+                shape = tuple(int(x) for x in
+                              str(tdim).strip("() ").split(","))[::-1]
+                if int(np.prod(shape)) != repeat:
+                    raise ValueError(
+                        f"TDIM{i + 1} {tdim!r} does not factor "
+                        f"repeat={repeat}")
+            if code not in _NATIVE_CODES:
+                continue
+            if col_off + repeat * _NATIVE_SAMP[code] > hdu.row_stride:
+                raise ValueError(f"column {name} exceeds its row extent")
+            tscal, tzero = hdu.col_scaling.get(name, (1.0, 0.0))
+            arr = native.decode_fused(
+                hdu.raw, nrows, hdu.row_stride, col_off, code,
+                1, 1, repeat,
+                scl=np.full((nrows, 1), tscal),
+                offs=np.full((nrows, 1), tzero))
+            cols[name] = arr.reshape((nrows,) + shape)
+        out.append((hdu.name, cols))
+    return out
+
+
+def _assert_bit_equal(native_arr, py_arr, msg):
+    """The kernel's f64 output must carry the Python codec's value
+    EXACTLY — compared as raw bytes after the lossless widening to
+    f64 (u8/i16/f32/int conventions are all exactly representable),
+    so even a sign-of-zero or ULP discrepancy fails."""
+    py_arr = np.asarray(py_arr)
+    assert native_arr.shape == py_arr.shape, msg
+    as64 = np.ascontiguousarray(py_arr, np.float64)
+    assert native_arr.tobytes() == as64.tobytes(), msg
+
+
+@pytest.mark.parametrize("seed", range(32))
+def test_fuzz_native_lane_bit_equal(seed, tmp_path):
+    """The randomized corpus (same seeds as the Python roundtrip
+    sweep) decodes identically through both lanes: every
+    kernel-supported column, bit-for-bit."""
+    if not native.available():
+        pytest.skip("native build unavailable (no g++ / no .so)")
+    rng = np.random.default_rng(1000 + seed)
+    columns, col_cards, tdims, expected = _random_table(rng)
+    blob = primary_hdu() + bintable_hdu(
+        "FUZZ", columns, tdim_overrides=tdims, col_cards=col_cards)
+    path = tmp_path / "fuzz.fits"
+    path.write_bytes(blob)
+
+    py = read_fits(str(path))[1]
+    (extname, ncols), = _native_decode_tables(str(path))
+    assert extname == "FUZZ"
+    for name, arr in ncols.items():
+        _assert_bit_equal(arr, py.data[name], name)
+
+
+def test_native_lane_conventions_bit_equal(tmp_path):
+    """Deterministic coverage of every kernel sample type crossed with
+    every scaling convention the codec implements (the random sweep
+    cannot guarantee each cell is hit): unscaled, signed-byte
+    TZERO=-128, unsigned-16 TZERO=32768, float TSCAL/TZERO, trivial
+    scaling cards, and a TDIM reshape."""
+    if not native.available():
+        pytest.skip("native build unavailable (no g++ / no .so)")
+    rng = np.random.default_rng(7)
+    nrows = 5
+    columns = [
+        ("BRAW", rng.integers(0, 256, (nrows, 3)).astype("u1")),
+        ("BSGN", rng.integers(0, 256, (nrows,)).astype("u1")),
+        ("IRAW", rng.integers(-2**15, 2**15, (nrows, 4)).astype(">i2")),
+        ("IUNS", rng.integers(-2**15, 2**15, (nrows,)).astype(">i2")),
+        ("ISCL", rng.integers(-2**15, 2**15, (nrows, 6)).astype(">i2")),
+        ("ERAW", rng.standard_normal((nrows, 8)).astype(">f4")),
+        ("ESCL", rng.standard_normal((nrows, 2)).astype(">f4")),
+        ("ETRV", rng.standard_normal((nrows,)).astype(">f4")),
+    ]
+    col_cards = {"BSGN": {"TZERO": -128.0},
+                 "IUNS": {"TZERO": 32768.0},
+                 "ISCL": {"TSCAL": 0.5, "TZERO": 3.0},
+                 "ESCL": {"TSCAL": 0.25, "TZERO": -1.0},
+                 "ETRV": {"TSCAL": 1.0, "TZERO": 0.0}}
+    blob = primary_hdu() + bintable_hdu(
+        "CONV", columns, tdim_overrides={"ERAW": "(4,2)"},
+        col_cards=col_cards)
+    path = tmp_path / "conv.fits"
+    path.write_bytes(blob)
+
+    py = read_fits(str(path))[1]
+    (_, ncols), = _native_decode_tables(str(path))
+    assert set(ncols) == {n for n, _ in columns}
+    for name, arr in ncols.items():
+        _assert_bit_equal(arr, py.data[name], name)
+    assert ncols["ERAW"].shape == (nrows, 2, 4)  # TDIM honored
+
+
+@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("kind", MALFORMED_KINDS)
+def test_fuzz_malformed_refuses_in_native_lane(kind, seed, tmp_path):
+    """Every malformed class refuses in the native lane too — the
+    identical corpus (shared _forge_malformed) must never reach the
+    bounds-check-free C kernel with inconsistent geometry."""
+    if not native.available():
+        pytest.skip("native build unavailable (no g++ / no .so)")
+    rng = np.random.default_rng(seed)
+    path = _forge_malformed(kind, rng, tmp_path)
+    with pytest.raises((ValueError, KeyError)):
+        _native_decode_tables(str(path))

@@ -274,6 +274,110 @@ def test_align_batched_accumulate_matches_loop_reference(epochs_files,
     assert np.abs(avg - aligned).max() < 1e-10 * scale
 
 
+def test_align_device_lane_matches_host(epochs_files, tmp_path):
+    """ISSUE 2 tentpole: the device-resident split-real accumulate
+    (parallel/batch.py, jitted with donated on-chip buffers) is
+    digit-exact against the chunked-c128 host oracle over full
+    align_archives runs — same tolerance discipline as round 5's
+    batched-accumulate test (f64 round-off, <= 1e-10 relative)."""
+    meta, files, model = epochs_files
+    host = align_archives(meta, files[0], niter=2, quiet=True,
+                          outfile=str(tmp_path / "h.fits"),
+                          align_device=False)
+    dev = align_archives(meta, files[0], niter=2, quiet=True,
+                         outfile=str(tmp_path / "d.fits"),
+                         align_device=True)
+    scale = np.abs(host).max()
+    assert np.abs(dev - host).max() < 1e-10 * scale
+
+
+def test_align_device_config_flip_rides_per_call(epochs_files, tmp_path,
+                                                 monkeypatch):
+    """config.align_device is read per align_archives call (no cached
+    routing decision), so in-process A/B flips actually switch lanes."""
+    from pulseportraiture_tpu import config
+    from pulseportraiture_tpu.pipeline import align as align_mod
+
+    meta, files, model = epochs_files
+    calls = []
+    real = align_mod.align_accumulate_archive
+    monkeypatch.setattr(align_mod, "align_accumulate_archive",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    monkeypatch.setattr(config, "align_device", True)
+    align_archives(files[:1], files[0], niter=1, quiet=True,
+                   outfile=str(tmp_path / "on.fits"))
+    n_on = len(calls)
+    assert n_on > 0, "align_device=True did not route to the device lane"
+    monkeypatch.setattr(config, "align_device", False)
+    align_archives(files[:1], files[0], niter=1, quiet=True,
+                   outfile=str(tmp_path / "off.fits"))
+    assert len(calls) == n_on, \
+        "align_device=False still hit the device accumulate"
+
+
+def test_align_device_option_strict_and_program_keys():
+    """Tri-state strictness (a typo must not mean 'auto') and the
+    cached-program keys: the accumulate/finalize programs are keyed on
+    the resolved DFT precision AND dispatch arm, so in-process config
+    flips retrace instead of silently reusing the other arm's
+    program."""
+    import jax
+
+    from pulseportraiture_tpu.parallel.batch import (
+        _align_accum_fn, _align_chunk, _align_finalize_fn,
+        use_align_device)
+
+    assert use_align_device(True) is True
+    assert use_align_device(False) is False
+    assert use_align_device("auto") == (jax.default_backend() == "tpu")
+    with pytest.raises(ValueError):
+        use_align_device("ture")
+
+    hi = jax.lax.Precision.HIGHEST
+    lo = jax.lax.Precision.HIGH
+    assert _align_accum_fn("float64", hi, True) \
+        is not _align_accum_fn("float64", hi, False)
+    assert _align_accum_fn("float64", hi, True) \
+        is not _align_accum_fn("float64", lo, True)
+    assert _align_finalize_fn("float64", 256, hi, True) \
+        is not _align_finalize_fn("float64", 256, hi, False)
+    # same key -> same cached program (the retrace is keyed, not
+    # unconditional)
+    assert _align_accum_fn("float64", hi, True) \
+        is _align_accum_fn("float64", hi, True)
+
+    # chunk bucketing: full batches keep the configured chunk, small
+    # archives round up to the next power of two (bounded padding AND
+    # bounded program count)
+    assert _align_chunk(256, 64) == 64
+    assert _align_chunk(64, 64) == 64
+    assert _align_chunk(5, 64) == 8
+    assert _align_chunk(1, 64) == 1
+
+
+def test_align_device_env_hook(monkeypatch):
+    """PPT_ALIGN_DEVICE rides config.env_overrides() like the other
+    A/B switches, strictly (a typo raises)."""
+    from pulseportraiture_tpu import config
+
+    old = config.align_device
+    try:
+        monkeypatch.setenv("PPT_ALIGN_DEVICE", "on")
+        assert "align_device" in config.env_overrides()
+        assert config.align_device is True
+        monkeypatch.setenv("PPT_ALIGN_DEVICE", "off")
+        config.env_overrides()
+        assert config.align_device is False
+        monkeypatch.setenv("PPT_ALIGN_DEVICE", "auto")
+        config.env_overrides()
+        assert config.align_device == "auto"
+        monkeypatch.setenv("PPT_ALIGN_DEVICE", "bogus")
+        with pytest.raises(ValueError):
+            config.env_overrides()
+    finally:
+        config.align_device = old
+
+
 def test_canonical_real_dtype_keeps_f64_under_host_compute(monkeypatch):
     """On a TPU session, _canonical_real_dtype downcasts f64 (c128
     spectra do not compile there) — but NOT inside host_compute(),
