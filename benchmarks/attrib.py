@@ -1,7 +1,8 @@
 """Stage-attribution driver for the historically-unprofiled lanes:
 the 5-parameter scattering fit (BASELINE config 3), the
-device-resident raw-campaign bucket program (config 5c), and — ISSUE 2
-— the device-resident align iteration (config 4).
+device-resident raw-campaign bucket program (config 5c), the
+device-resident align iteration (config 4, ISSUE 2), and — ISSUE 4 —
+the end-to-end streaming campaign (config 5).
 
 Built on pulseportraiture_tpu.profiling (the reusable promotion of
 exp_breakdown.py's methodology): each lane is decomposed into named
@@ -19,9 +20,10 @@ the attribution alone:
     python benchmarks/attrib.py scatter
     python benchmarks/attrib.py campaign
     python benchmarks/attrib.py align
+    python benchmarks/attrib.py stream
 
 Shapes via PPT_NB / PPT_NCHAN / PPT_NBIN (campaign: PPT_NSUBB; align:
-PPT_NE).
+PPT_NE; stream: PPT_NARCH / PPT_NSUB).
 """
 
 import json
@@ -292,6 +294,197 @@ def align_stage_profile(cube, noise, masks, freqs, P_s, acc_dt,
     return profile_stages(full_fn, stages, K=K, nrun=nrun)
 
 
+def stream_stage_profile(files, modelfile, nsub_batch, end_to_end_s,
+                         max_iter=25):
+    """Attribution of the streaming campaign lane (pipeline/stream,
+    BASELINE config 5), the ISSUE 4 discipline for the multi-device
+    executor.  Unlike the device-program lanes, a campaign is a HOST
+    pipeline wrapped around one fused device program, so the stages
+    are wall-clock costs of the REAL helpers (the same single-source-
+    of-truth functions the driver runs) measured over the same archive
+    set, and the denominator ``end_to_end_s`` must come from a
+    SERIALIZED campaign run (prefetch off, max_inflight 1, one
+    device): overlap is a scheduling win the bench_stream scaling
+    table reports separately; attribution explains where the
+    serialized second goes.
+
+      load     — archive ingest: raw int16 load (_load_raw) + the
+                 per-archive template portrait build
+      stack    — bucket payload stacking (_stack_raw + masks/Ps)
+      h2d      — committed device_put of every stacked dispatch
+      fit      — the fused raw-bucket program (_raw_fit_fn), each
+                 dispatch group executed and timed ONCE on its own
+                 arrays (slope timing on one cached group is the
+                 device-lane tool; a campaign touches fresh bucket
+                 bytes per dispatch, so repeated-input timing
+                 under-reports the memory-bound part)
+      scatter  — d2h pull + per-owner unpack of the packed results
+      assemble — per-archive TOA assembly (_assemble_archive)
+
+    The corpus must be raw-lane wideband (int16 DATA, npol 1), the
+    no-scattering campaign configuration — what bench_stream
+    generates."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from pulseportraiture_tpu.fit.portrait import (
+        use_bf16_cross_spectrum, use_fast_fit_default,
+        use_scatter_compensated)
+    from pulseportraiture_tpu.pipeline.models import TemplateModel
+    from pulseportraiture_tpu.pipeline.stream import (
+        _assemble_archive, _Bucket, _load_raw, _raw_fit_fn,
+        _result_keys, _stack_raw)
+    from pulseportraiture_tpu.utils.bunch import DataBunch
+
+    model = TemplateModel(modelfile, quiet=True)
+    device = jax.local_devices()[0]
+    use_fast = use_fast_fit_default()
+    ftname = "float32" if use_fast else "float64"
+    ft = jnp.float32 if use_fast else jnp.float64
+
+    # ---- load: archive ingest (the driver's loader + portrait) ------
+    t0 = time.perf_counter()
+    ds, modelxs = [], []
+    for f in files:
+        d = _load_raw(f)
+        ds.append(d)
+        freqs0 = np.asarray(d.freqs[0], float)
+        P_mean = float(np.mean(d.Ps[np.asarray(d.ok_isubs, int)]))
+        modelxs.append(model.portrait(freqs0, d.nbin, P=P_mean))
+    t_load = time.perf_counter() - t0
+
+    # one shape bucket (the bench corpus is homogeneous); flags are
+    # the wideband default (phi, DM)
+    d0 = ds[0]
+    nchan, nbin = d0.nchan, d0.nbin
+    freqs0 = np.asarray(d0.freqs[0], float)
+    flags = (True, True, False, False, False)
+    bucket = _Bucket(freqs0, nbin, modelxs[0], flags, kind="raw")
+    metas = []
+    for iarch, d in enumerate(ds):
+        ok = np.asarray(d.ok_isubs, int)
+        masks = np.asarray(d.weights[ok] > 0.0, float)
+        DM_stored = float(d.DM)
+        # the driver's DM0 fallback collapses to the stored DM here
+        # (DM0 is None in the bench campaign)
+        DM_guess = DM_stored
+        metas.append(DataBunch(
+            datafile=files[iarch], iarch=iarch, ok=ok,
+            DM0_arch=DM_stored, nbin=nbin, nchan=nchan,
+            epochs=[d.epochs[i] for i in ok],
+            Ps=[float(d.Ps[i]) for i in ok],
+            dfs=[float(d.doppler_factors[i]) for i in ok],
+            subtimes=[float(d.subtimes[i]) for i in ok],
+            backend_delay=d.backend_delay, backend=d.backend,
+            frontend=d.frontend, telescope=d.telescope,
+            telescope_code=d.telescope_code))
+        for j, isub in enumerate(ok):
+            bucket.raw.append(d.raw[isub])
+            bucket.scl.append(d.scl[isub])
+            bucket.offs.append(d.offs[isub])
+            bucket.DM_guess.append(DM_guess)
+            bucket.dedisp.append(
+                (float(d.DM) if d.get("dmc") else 0.0,
+                 float(d.get("dedisp_nu") or d.get("nu0", 0.0) or 0.0)))
+            bucket.masks.append(masks[j])
+            bucket.Ps.append(float(d.Ps[isub]))
+            bucket.owners.append((iarch, int(isub)))
+
+    n_total = len(bucket)
+    groups = []
+    for lo in range(0, n_total, nsub_batch):
+        idx = list(range(lo, min(lo + nsub_batch, n_total)))
+        pad = (-len(idx)) % nsub_batch
+        groups.append(idx + [idx[0]] * pad)
+
+    # ---- stack: the host-side payload stacking per dispatch ---------
+    t0 = time.perf_counter()
+    stacked = []
+    for idx0 in groups:
+        masks_g = np.stack([bucket.masks[i] for i in idx0])
+        Ps_g = np.asarray([bucket.Ps[i] for i in idx0])
+        raw, scl, offs, redisp, turns = _stack_raw(bucket, idx0, Ps_g)
+        DMg = np.asarray([bucket.DM_guess[i] for i in idx0])
+        stacked.append((raw, scl, offs, masks_g, Ps_g, redisp, turns,
+                        DMg))
+    t_stack = time.perf_counter() - t0
+
+    # ---- h2d: committed placement of every dispatch's arrays --------
+    hwin = bucket.harmonic_window() if use_fast else None
+    t0 = time.perf_counter()
+    dev_groups = []
+    for raw, scl, offs, masks_g, Ps_g, redisp, turns, DMg in stacked:
+        put = [jax.device_put(np.asarray(a, dt) if dt else a, device)
+               for a, dt in ((raw, None), (scl, ftname), (offs, ftname),
+                             (masks_g, ftname),
+                             (np.asarray(bucket.modelx), ftname),
+                             (freqs0, ftname), (Ps_g, ftname),
+                             (DMg, ftname), (turns, ftname))]
+        jax.block_until_ready(put)
+        dev_groups.append((put, redisp))
+    t_h2d = time.perf_counter() - t0
+
+    # ---- fit: the fused device program, slope-timed -----------------
+    redisp0 = dev_groups[0][1]
+    fn = _raw_fit_fn(nchan, nbin, flags, int(max_iter), False, "none",
+                     use_fast, ftname, use_bf16_cross_spectrum(),
+                     redisp=redisp0, want_flux=False, use_ir=False,
+                     compensated=use_scatter_compensated(),
+                     nharm_eff=hwin,
+                     seed_derotate=bool(np.any(
+                         np.asarray(bucket.DM_guess) != 0.0)))
+    keys = _result_keys(flags)
+
+    def run_group(g):
+        (raw_d, scl_d, offs_d, masks_d, modelx_d, freqs_d, Ps_d, DMg_d,
+         turns_d), _ = g
+        return fn(raw_d, scl_d, offs_d, masks_d, modelx_d, freqs_d,
+                  Ps_d, DMg_d, ft(-1.0), ft(0.0), ft(1.0), ft(0.0),
+                  ft(0.0), turns_d, None, None)
+
+    jax.block_until_ready(run_group(dev_groups[0]))  # compile
+    t_fit, outs = 0.0, []
+    for g in dev_groups:
+        t0 = time.perf_counter()
+        outs.append(jax.block_until_ready(run_group(g)))
+        t_fit += time.perf_counter() - t0
+
+    # ---- scatter: d2h pull + per-owner unpack -----------------------
+    results = {}
+    t0 = time.perf_counter()
+    for gi, out in enumerate(outs):
+        packed = np.asarray(out)
+        owners = [bucket.owners[i] for i in groups[gi]]
+        for i, owner in enumerate(owners):
+            results[owner] = {k: packed[j, i]
+                              for j, k in enumerate(keys)}
+    t_scatter = time.perf_counter() - t0
+
+    # ---- assemble: per-archive TOA construction ---------------------
+    t0 = time.perf_counter()
+    ntoa = 0
+    for m in metas:
+        toas, _, _ = _assemble_archive(m, results, modelfile, True,
+                                       True, {}, quiet=True)
+        ntoa += len(toas)
+    t_assemble = time.perf_counter() - t0
+
+    stages = {"load": t_load, "stack": t_stack, "h2d": t_h2d,
+              "fit": t_fit, "scatter": t_scatter,
+              "assemble": t_assemble}
+    out = {f"stage_{k}_ms": round(v * 1e3, 3)
+           for k, v in stages.items()}
+    total = sum(stages.values())
+    out["attributed_frac"] = round(total / max(end_to_end_s, 1e-12), 3)
+    out["serialized_wall_s"] = round(end_to_end_s, 3)
+    out["dominant_stage"] = max(stages, key=stages.get)
+    out["ndispatch"] = len(groups)
+    out["attrib_ntoa"] = ntoa
+    return out
+
+
 def main():
     lane = sys.argv[1] if len(sys.argv) > 1 else "scatter"
     if lane == "scatter":
@@ -306,9 +499,13 @@ def main():
         from benchmarks import bench_align
 
         out = bench_align.run_bench(attrib_only=True)
+    elif lane == "stream":
+        from benchmarks import bench_stream
+
+        out = bench_stream.run_bench(attrib_only=True)
     else:
         raise SystemExit(f"unknown lane {lane!r} "
-                         "(scatter|campaign|align)")
+                         "(scatter|campaign|align|stream)")
     print(json.dumps(out))
 
 

@@ -72,6 +72,14 @@ def build_parser():
     p.add_argument("--stream", action="store_true", default=False,
                    help="Cross-archive batched dispatches for large "
                         "campaigns (wideband phi/DM fits only).")
+    p.add_argument("--stream-devices", dest="stream_devices",
+                   default=None, metavar="auto|N",
+                   help="With --stream: local devices to deal fused "
+                        "buckets across, round-robin ('auto' = all "
+                        "local devices of the default backend, or an "
+                        "explicit count).  Output is digit-identical "
+                        "for any value. [default: config.stream_devices"
+                        " / PPT_STREAM_DEVICES]")
     p.add_argument("--bound", action="append", default=[],
                    metavar="PARAM:LO,HI",
                    help="Box bound on a fit parameter (repeatable): "
@@ -144,6 +152,23 @@ def main(argv=None):
                                or args.psrchive):
         raise SystemExit("--bound applies to the standard wideband "
                          "GetTOAs path (no --stream/--narrowband)")
+    stream_devices = args.stream_devices
+    if stream_devices is not None:
+        if not args.stream:
+            raise SystemExit("--stream-devices requires --stream")
+        s = stream_devices.strip().lower()
+        if s == "auto":
+            stream_devices = "auto"
+        else:
+            try:
+                stream_devices = int(s)
+            except ValueError:
+                raise SystemExit("--stream-devices: expected 'auto' or "
+                                 f"a positive count, got "
+                                 f"{args.stream_devices!r}")
+            if stream_devices < 1:
+                raise SystemExit("--stream-devices: count must be "
+                                 f">= 1, got {stream_devices}")
 
     if args.stream and args.narrowband:
         if (args.psrchive or args.one_DM or args.print_flux
@@ -157,7 +182,7 @@ def main(argv=None):
         res = stream_narrowband_TOAs(
             args.datafiles, args.modelfile, fit_scat=args.fit_scat,
             log10_tau=args.log10_tau, scat_guess=scat_guess,
-            tscrunch=args.tscrunch,
+            tscrunch=args.tscrunch, stream_devices=stream_devices,
             print_phase=args.print_phase, addtnl_toa_flags=addtnl,
             quiet=args.quiet)
         if args.format == "princeton":
@@ -188,7 +213,7 @@ def main(argv=None):
             tscrunch=args.tscrunch, fit_scat=args.fit_scat,
             log10_tau=args.log10_tau, scat_guess=scat_guess,
             fix_alpha=args.fix_alpha, addtnl_toa_flags=addtnl,
-            quiet=args.quiet)
+            stream_devices=stream_devices, quiet=args.quiet)
         if args.format == "princeton":
             dDMs = [toa.DM - res.DM0s[res.order.index(toa.archive)]
                     if toa.DM is not None else 0.0

@@ -121,6 +121,26 @@ scatter_compensated = False
 # accuracy gates every run.
 dft_fold = False
 
+# Local devices the streaming campaign drivers (pipeline/stream.py:
+# stream_wideband_TOAs / stream_narrowband_TOAs) dispatch fused
+# buckets across, round-robin with per-device bounded in-flight queues
+# and one h2d worker thread per device.
+#   'auto' (default): every local device of the default backend — a
+#          multi-chip host feeds all its chips from one archive stream.
+#   int:   use the first N local devices (loud error when N exceeds
+#          the local device count — a silent clamp would quietly
+#          invalidate a scaling A/B).
+# Campaign output is digit-identical for any value: results stay keyed
+# by (archive, subint) owners and .tim checkpoints are written in
+# archive order regardless of completion order.
+stream_devices = "auto"
+
+# How many fused dispatches may be pending PER DEVICE before the
+# streaming drivers block on that device's oldest (the bound is exact:
+# a queue never holds more than this many).  Per-driver override via
+# their max_inflight= argument.
+stream_max_inflight = 4
+
 # Harmonic window for the fast fit lane.  A smooth template's power
 # spectrum decays to numerical zero well below the Nyquist harmonic
 # (the bench Gaussian template holds all but ~7e-13 of its power in
@@ -193,6 +213,8 @@ RCSTRINGS = {
 #   PPT_DFT_PRECISION=highest|high|default -> dft_precision
 #   PPT_DFT_FOLD=off|auto|on        -> dft_fold
 #   PPT_ALIGN_DEVICE=off|auto|on    -> align_device
+#   PPT_STREAM_DEVICES=auto|<N>     -> stream_devices
+#   PPT_MAX_INFLIGHT=<N>            -> stream_max_inflight
 #
 # Unset variables leave the module values untouched; a typo raises
 # (strict like the config parsers — a silent fallback would quietly
@@ -245,6 +267,36 @@ def env_overrides():
                 f"{adev!r}")
         cfg.align_device = table[adev]
         changed.append("align_device")
+    sdev = _os.environ.get("PPT_STREAM_DEVICES", "").lower()
+    if sdev:
+        if sdev == "auto":
+            cfg.stream_devices = "auto"
+        else:
+            try:
+                n = int(sdev)
+            except ValueError:
+                raise ValueError(
+                    "PPT_STREAM_DEVICES must be 'auto' or a positive "
+                    f"device count, got {sdev!r}")
+            if n < 1:
+                raise ValueError(
+                    "PPT_STREAM_DEVICES must be >= 1 when numeric, "
+                    f"got {n}")
+            cfg.stream_devices = n
+        changed.append("stream_devices")
+    minf = _os.environ.get("PPT_MAX_INFLIGHT", "")
+    if minf:
+        try:
+            n = int(minf)
+        except ValueError:
+            raise ValueError(
+                "PPT_MAX_INFLIGHT must be a positive integer, got "
+                f"{minf!r}")
+        if n < 1:
+            raise ValueError(
+                f"PPT_MAX_INFLIGHT must be >= 1, got {n}")
+        cfg.stream_max_inflight = n
+        changed.append("stream_max_inflight")
     return changed
 
 

@@ -140,8 +140,12 @@ def test_pptoas_cli_stream_matches(workspace, tmp_path):
     tim_b = tmp_path / "str.tim"
     assert pptoas.main(["-d", meta, "-m", gm, "-o", str(tim_a),
                         "--quiet"]) == 0
+    # --stream-devices 8: the CLI plumbing into the multi-device
+    # executor (output is digit-identical to any device count, so the
+    # comparisons below are unchanged)
     assert pptoas.main(["-d", meta, "-m", gm, "-o", str(tim_b),
-                        "--stream", "--quiet"]) == 0
+                        "--stream", "--stream-devices", "8",
+                        "--quiet"]) == 0
     la = tim_a.read_text().strip().splitlines()
     lb = tim_b.read_text().strip().splitlines()
     assert len(la) == len(lb) == 6
@@ -169,3 +173,21 @@ def test_pptoas_cli_stream_matches(workspace, tmp_path):
     with pytest.raises(SystemExit):
         pptoas.main(["-d", meta, "-m", gm, "--stream", "--fit_GM",
                      "--quiet"])
+
+
+def test_pptoas_stream_devices_flag_validation():
+    """--stream-devices parses 'auto' or a positive count, requires
+    --stream, and rejects garbage loudly — all before any file IO."""
+    args = pptoas.build_parser().parse_args(
+        ["-d", "x.fits", "-m", "m.gmodel", "--stream",
+         "--stream-devices", "auto"])
+    assert args.stream_devices == "auto"
+    with pytest.raises(SystemExit, match="requires --stream"):
+        pptoas.main(["-d", "x.fits", "-m", "m.gmodel",
+                     "--stream-devices", "2"])
+    with pytest.raises(SystemExit, match="expected 'auto'"):
+        pptoas.main(["-d", "x.fits", "-m", "m.gmodel", "--stream",
+                     "--stream-devices", "several"])
+    with pytest.raises(SystemExit, match=">= 1"):
+        pptoas.main(["-d", "x.fits", "-m", "m.gmodel", "--stream",
+                     "--stream-devices", "0"])

@@ -548,6 +548,181 @@ def test_ipta_resume_scan_ignores_prefix_pulsar_shards(tmp_path):
     assert got == ["J1713+0747.p0.tim", "J1713+0747.tim"]
 
 
+def test_stream_multidevice_digit_identical(campaign, tmp_path):
+    """ISSUE 4: the same mixed-shape campaign dealt round-robin across
+    all 8 virtual devices must produce DIGIT-IDENTICAL output — .tim
+    checkpoint content byte-for-byte (archive-order checkpoint writes
+    make it completion-order-independent) and every assembled TOA
+    field — while actually spreading buckets over more than one
+    device."""
+    files, gmodel = campaign
+    tim1, tim8 = tmp_path / "d1.tim", tmp_path / "d8.tim"
+    a = stream_wideband_TOAs(files, gmodel, nsub_batch=4,
+                             stream_devices=1, tim_out=str(tim1),
+                             quiet=True)
+    b = stream_wideband_TOAs(files, gmodel, nsub_batch=4,
+                             stream_devices=8, tim_out=str(tim8),
+                             quiet=True)
+    assert b.devices_used > 1, "buckets never left device 0"
+    assert b.nfit == a.nfit
+    assert tim1.read_bytes() == tim8.read_bytes()
+    assert len(a.TOA_list) == len(b.TOA_list) == 12
+    for ta, tb in zip(a.TOA_list, b.TOA_list):
+        assert ta.archive == tb.archive
+        assert (ta.MJD.day, ta.MJD.frac) == (tb.MJD.day, tb.MJD.frac)
+        assert ta.DM == tb.DM
+        assert ta.TOA_error == tb.TOA_error
+        assert ta.flags == tb.flags
+    assert a.DeltaDM_means == b.DeltaDM_means
+    assert a.DeltaDM_errs == b.DeltaDM_errs
+
+
+def test_stream_multidevice_resume_out_of_order(campaign, tmp_path):
+    """Multi-device resume: forge an interrupted checkpoint (first
+    archive's block + a torn tail), re-enter with 8 devices — where
+    completions land out of archive order — and require the final file
+    to equal the uninterrupted single-device run byte-for-byte."""
+    files, gmodel = campaign
+    tim_full = tmp_path / "full.tim"
+    stream_wideband_TOAs(files, gmodel, nsub_batch=4, stream_devices=1,
+                         tim_out=str(tim_full), quiet=True)
+    lines = tim_full.read_text().splitlines(keepends=True)
+    first_done = next(i for i, l in enumerate(lines)
+                      if l.startswith("C ppt-done "))
+    tim_part = tmp_path / "part.tim"
+    tim_part.write_text("".join(lines[:first_done + 1])
+                        + "torn 1400.0 55100.12")
+    done_arch = lines[first_done].split("C ppt-done ", 1)[1].strip()
+    res = stream_wideband_TOAs(files, gmodel, nsub_batch=4,
+                               stream_devices=8, tim_out=str(tim_part),
+                               quiet=True, resume=True)
+    assert res.devices_used > 1
+    assert done_arch not in [t.archive for t in res.TOA_list]
+    assert tim_part.read_bytes() == tim_full.read_bytes()
+
+
+def test_stream_inflight_bound_exact(campaign):
+    """The per-device in-flight bound is EXACT: with max_inflight=1 a
+    device's queue never holds two pending dispatches (the old
+    append-then-drain executor admitted max_inflight + 1)."""
+    files, gmodel = campaign
+    res = stream_wideband_TOAs(files, gmodel, nsub_batch=2,
+                               max_inflight=1, stream_devices=2,
+                               quiet=True)
+    assert res.nfit >= 4          # the bound was actually exercised
+    assert res.peak_inflight == 1
+    assert len(res.TOA_list) == 12
+
+
+def test_resolve_stream_devices():
+    """'auto' = every local device; an int = that prefix; bad values
+    error loudly instead of clamping."""
+    import jax
+
+    from pulseportraiture_tpu.pipeline.stream import (
+        resolve_stream_devices)
+
+    devs = jax.local_devices()
+    assert resolve_stream_devices("auto") == list(devs)
+    assert resolve_stream_devices(3) == list(devs[:3])
+    assert resolve_stream_devices("2") == list(devs[:2])
+    assert resolve_stream_devices(devs[1:3]) == list(devs[1:3])
+    with pytest.raises(ValueError, match=">= 1"):
+        resolve_stream_devices(0)
+    with pytest.raises(ValueError, match="exceeds"):
+        resolve_stream_devices(len(devs) + 1)
+    with pytest.raises(ValueError, match="stream_devices"):
+        resolve_stream_devices("bananas")
+
+
+def test_stream_env_hooks(monkeypatch):
+    """PPT_STREAM_DEVICES / PPT_MAX_INFLIGHT ride config.env_overrides
+    like the other PPT_* hooks (strict parse, loud errors — a silent
+    fallback would quietly invalidate a scaling A/B)."""
+    from pulseportraiture_tpu import config
+
+    old = (config.stream_devices, config.stream_max_inflight)
+    try:
+        monkeypatch.setenv("PPT_STREAM_DEVICES", "auto")
+        assert "stream_devices" in config.env_overrides()
+        assert config.stream_devices == "auto"
+        monkeypatch.setenv("PPT_STREAM_DEVICES", "4")
+        config.env_overrides()
+        assert config.stream_devices == 4
+        for bad in ("0", "-2", "many"):
+            monkeypatch.setenv("PPT_STREAM_DEVICES", bad)
+            with pytest.raises(ValueError, match="PPT_STREAM_DEVICES"):
+                config.env_overrides()
+        monkeypatch.delenv("PPT_STREAM_DEVICES")
+        monkeypatch.setenv("PPT_MAX_INFLIGHT", "7")
+        assert "stream_max_inflight" in config.env_overrides()
+        assert config.stream_max_inflight == 7
+        for bad in ("0", "nope"):
+            monkeypatch.setenv("PPT_MAX_INFLIGHT", bad)
+            with pytest.raises(ValueError, match="PPT_MAX_INFLIGHT"):
+                config.env_overrides()
+    finally:
+        config.stream_devices, config.stream_max_inflight = old
+
+
+def test_stream_ckpt_staleness_horizon(tmp_path, monkeypatch):
+    """In-order checkpoint writes must not let an early archive stuck
+    in a never-filling rare-shape bucket defer later archives' .tim
+    durability forever: once it lags CKPT_STALENESS_HORIZON prepared
+    archives, all pending buckets force-flush (visible as an extra
+    dispatch), and the trigger depends only on the deterministic
+    fill/launch sequence so output stays digit-identical across
+    device counts."""
+    from pulseportraiture_tpu.pipeline import stream as stream_mod
+    from pulseportraiture_tpu.io import write_gmodel
+
+    model = default_test_model(1500.0)
+    gmodel = str(tmp_path / "m.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files = []
+    for i in range(6):
+        nchan = 24 if i == 0 else 32  # archive 0: rare shape
+        p = str(tmp_path / f"h{i}.fits")
+        make_fake_pulsar(model, PAR, outfile=p, nsub=1, nchan=nchan,
+                         nbin=128, nu0=1500.0, bw=800.0, tsub=60.0,
+                         phase=0.01 * i, dDM=1e-4,
+                         start_MJD=MJD(55800 + i, 0.1), noise_stds=0.08,
+                         dedispersed=False, quiet=True, rng=500 + i)
+        files.append(p)
+    monkeypatch.setattr(stream_mod, "CKPT_STALENESS_HORIZON", 3)
+    kw = dict(nsub_batch=64, quiet=True)  # nothing fills naturally
+    a = stream_wideband_TOAs(files, gmodel, stream_devices=1, **kw)
+    b = stream_wideband_TOAs(files, gmodel, stream_devices=8, **kw)
+    # horizon fired at archive 3 (the rare bucket + the part-filled
+    # common bucket flushed mid-run), tail flushed at end-of-stream:
+    # 3 dispatches, not the 2 an end-only flush would fire
+    assert a.nfit == b.nfit == 3
+    assert len(a.TOA_list) == len(b.TOA_list) == 6
+    for ta, tb in zip(a.TOA_list, b.TOA_list):
+        assert (ta.MJD.day, ta.MJD.frac) == (tb.MJD.day, tb.MJD.frac)
+        assert ta.DM == tb.DM
+
+
+def test_stream_narrowband_multidevice_digit_identical(campaign,
+                                                       tmp_path):
+    """The narrowband streaming lane shares the multi-device executor:
+    1 vs 8 devices must agree on every per-channel TOA field."""
+    from pulseportraiture_tpu.pipeline.stream import (
+        stream_narrowband_TOAs)
+
+    files, gmodel = campaign
+    a = stream_narrowband_TOAs(files[:2], gmodel, nsub_batch=2,
+                               stream_devices=1, quiet=True)
+    b = stream_narrowband_TOAs(files[:2], gmodel, nsub_batch=2,
+                               stream_devices=8, quiet=True)
+    assert b.devices_used > 1
+    assert len(a.TOA_list) == len(b.TOA_list) > 0
+    for ta, tb in zip(a.TOA_list, b.TOA_list):
+        assert (ta.MJD.day, ta.MJD.frac) == (tb.MJD.day, tb.MJD.frac)
+        assert ta.TOA_error == tb.TOA_error
+        assert ta.flags == tb.flags
+
+
 def test_stream_bf16_guard_estimate_tracks_exact_channel_snr(campaign):
     """The streaming lanes' bf16 guard input is snr/sqrt(nchan) — the
     packed result carries no per-channel S/N.  Bias bound, asserted on
