@@ -20,6 +20,7 @@ models    - template portrait models (gaussian, spline/PCA, wavelet)
 io        - PSRFITS / model-file / TOA-file I/O (no PSRCHIVE dependency)
 pipeline  - high-level pipelines (toas, align, spline, gauss, zap)
 parallel  - device-mesh sharding helpers
+telemetry - campaign event tracing, run manifests, pptrace report
 synth     - synthetic data generation (the test fixture)
 viz       - matplotlib visualization (host-side)
 utils     - MJD arithmetic, misc

@@ -89,6 +89,17 @@ def build_parser():
                         "parameterization.  The reference's TNC "
                         "`bounds` capability; a fit converging on a "
                         "bound reports return code 0 (LOCALMINIMUM).")
+    p.add_argument("--telemetry", metavar="trace.jsonl", default=None,
+                   help="Write a structured JSONL campaign trace "
+                        "(per-bucket dispatch/drain, per-archive "
+                        "prepare/flush/skip, per-TOA quality) to this "
+                        "path; analyze with tools/pptrace.py.  Also "
+                        "via PPT_TELEMETRY / config.telemetry_path. "
+                        "[default: off]")
+    p.add_argument("--quality_flags", action="store_true", default=False,
+                   help="Add per-TOA -nfev/-chi2 fit-diagnostic flags "
+                        "to the .tim lines (wideband paths; -snr/-gof "
+                        "are always present). [default: off]")
     p.add_argument("--quiet", action="store_true", default=False)
     # accepted for reference-script compatibility; no-ops here:
     p.add_argument("--psrchive", action="store_true", default=False,
@@ -170,6 +181,27 @@ def main(argv=None):
                 raise SystemExit("--stream-devices: count must be "
                                  f">= 1, got {stream_devices}")
 
+    if args.quality_flags and args.narrowband:
+        raise SystemExit("--quality_flags applies to the wideband "
+                         "paths (per-channel lines already carry "
+                         "-snr/-gof)")
+    if args.narrowband and not args.stream:
+        if args.telemetry:
+            raise SystemExit("--telemetry covers the wideband GetTOAs "
+                             "path and the --stream drivers (use "
+                             "--stream --narrowband for traced "
+                             "per-channel campaigns)")
+        from .. import config
+        if config.telemetry_path:
+            # PPT_TELEMETRY / config.telemetry_path set, but this path
+            # emits no trace — say so instead of being silently inert
+            # (the same hazard the unknown-PPT_* warning exists for)
+            from ..telemetry import log
+            log("pptoas: telemetry_path is set but the non-stream "
+                "narrowband path is untraced; use --stream "
+                "--narrowband for a traced per-channel campaign",
+                level="warn")
+
     if args.stream and args.narrowband:
         if (args.psrchive or args.one_DM or args.print_flux
                 or args.print_parangle or args.fit_GM or args.showplot):
@@ -184,7 +216,7 @@ def main(argv=None):
             log10_tau=args.log10_tau, scat_guess=scat_guess,
             tscrunch=args.tscrunch, stream_devices=stream_devices,
             print_phase=args.print_phase, addtnl_toa_flags=addtnl,
-            quiet=args.quiet)
+            telemetry=args.telemetry, quiet=args.quiet)
         if args.format == "princeton":
             write_princeton_TOAs(res.TOA_list, outfile=args.outfile,
                                  dDMs=[0.0] * len(res.TOA_list))
@@ -213,7 +245,8 @@ def main(argv=None):
             tscrunch=args.tscrunch, fit_scat=args.fit_scat,
             log10_tau=args.log10_tau, scat_guess=scat_guess,
             fix_alpha=args.fix_alpha, addtnl_toa_flags=addtnl,
-            stream_devices=stream_devices, quiet=args.quiet)
+            stream_devices=stream_devices, telemetry=args.telemetry,
+            quality_flags=args.quality_flags, quiet=args.quiet)
         if args.format == "princeton":
             dDMs = [toa.DM - res.DM0s[res.order.index(toa.archive)]
                     if toa.DM is not None else 0.0
@@ -247,7 +280,9 @@ def main(argv=None):
                     print_flux=args.print_flux,
                     print_parangle=args.print_parangle,
                     addtnl_toa_flags=addtnl, prefetch=args.prefetch,
-                    quiet=args.quiet, bounds=bounds)
+                    quiet=args.quiet, bounds=bounds,
+                    quality_flags=args.quality_flags,
+                    telemetry=args.telemetry)
         if args.one_DM:
             gt.apply_one_DM()
     if args.format == "princeton":

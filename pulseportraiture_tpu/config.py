@@ -141,6 +141,18 @@ stream_devices = "auto"
 # their max_inflight= argument.
 stream_max_inflight = 4
 
+# Campaign telemetry (telemetry.py): path of the JSONL event trace the
+# campaign drivers (GetTOAs.get_TOAs, stream_wideband_TOAs /
+# stream_narrowband_TOAs, stream_ipta_campaign) append structured
+# events to — per-bucket dispatch/drain records with device ids and
+# queue depths, per-archive prepare/flush/skip records, per-TOA fit
+# quality, and a self-describing manifest header.  None (default) =
+# off, with near-zero cost on the hot path (one attribute read per
+# instrumentation site).  Per-call override via the drivers'
+# telemetry= argument (a path, or a shared telemetry.Tracer); analyze
+# with tools/pptrace.py.
+telemetry_path = None
+
 # Harmonic window for the fast fit lane.  A smooth template's power
 # spectrum decays to numerical zero well below the Nyquist harmonic
 # (the bench Gaussian template holds all but ~7e-13 of its power in
@@ -215,10 +227,53 @@ RCSTRINGS = {
 #   PPT_ALIGN_DEVICE=off|auto|on    -> align_device
 #   PPT_STREAM_DEVICES=auto|<N>     -> stream_devices
 #   PPT_MAX_INFLIGHT=<N>            -> stream_max_inflight
+#   PPT_TELEMETRY=<path>|off        -> telemetry_path
 #
-# Unset variables leave the module values untouched; a typo raises
-# (strict like the config parsers — a silent fallback would quietly
-# invalidate an A/B run).
+# Unset variables leave the module values untouched; a typo in a
+# KNOWN variable's value raises (strict like the config parsers — a
+# silent fallback would quietly invalidate an A/B run), and an
+# unrecognized PPT_*-prefixed NAME warns once to stderr: PPT_STREAM
+# _DEVICE would otherwise be silently ignored while PPT_STREAM_DEVICES
+# changes behavior.
+
+
+# Every PPT_* variable something in this repo reads: the config hooks
+# above plus the benchmark/test shape knobs (benchmarks/*.py, bench.py,
+# tests/test_bench_smoke.py).  A new knob must be registered here or
+# env_overrides() warns about it.
+KNOWN_PPT_ENV = frozenset({
+    # config hooks (this module)
+    "PPT_XSPEC", "PPT_DFT_PRECISION", "PPT_DFT_FOLD",
+    "PPT_ALIGN_DEVICE", "PPT_STREAM_DEVICES", "PPT_MAX_INFLIGHT",
+    "PPT_TELEMETRY",
+    # benchmark / smoke-test shape and mode knobs
+    "PPT_NB", "PPT_NE", "PPT_NPSR", "PPT_NARCH", "PPT_NSUB",
+    "PPT_NSUBB", "PPT_NCHAN", "PPT_NBIN", "PPT_NITER", "PPT_K",
+    "PPT_DEVICES", "PPT_CAMPAIGN_CACHE", "PPT_ALIGN_CACHE",
+    "PPT_TEMPLATE_NOISE", "PPT_STREAM_SPEEDUP_GATE",
+    "PPT_HARMONIC_WINDOW",
+})
+
+_warned_unknown_ppt = set()  # warn ONCE per process per variable
+
+
+def _warn_unknown_ppt_vars(environ):
+    """Warn (once, stderr) about PPT_*-prefixed variables nothing
+    reads — a typo like PPT_STREAM_DEVICE is silently inert while its
+    correct spelling changes behavior, the worst kind of A/B hazard."""
+    import difflib
+    import sys as _sys
+
+    for name in sorted(environ):
+        if (not name.startswith("PPT_") or name in KNOWN_PPT_ENV
+                or name in _warned_unknown_ppt):
+            continue
+        _warned_unknown_ppt.add(name)
+        close = difflib.get_close_matches(name, KNOWN_PPT_ENV, n=1)
+        hint = f" (did you mean {close[0]}?)" if close else ""
+        print(f"pulseportraiture_tpu.config: ignoring unrecognized "
+              f"environment variable {name}{hint} — known PPT_* hooks "
+              "are listed in config.KNOWN_PPT_ENV", file=_sys.stderr)
 
 
 def env_overrides():
@@ -230,6 +285,7 @@ def env_overrides():
 
     cfg = _sys.modules[__name__]
     changed = []
+    _warn_unknown_ppt_vars(_os.environ)
     xspec = _os.environ.get("PPT_XSPEC", "").lower()
     if xspec:
         table = {"float32": None, "none": None, "bfloat16": "bfloat16"}
@@ -297,6 +353,14 @@ def env_overrides():
                 f"PPT_MAX_INFLIGHT must be >= 1, got {n}")
         cfg.stream_max_inflight = n
         changed.append("stream_max_inflight")
+    tel = _os.environ.get("PPT_TELEMETRY", "")
+    if tel:
+        # 'off'/'none'/'0' disable explicitly (so a wrapper script can
+        # force telemetry off over a config default); anything else is
+        # the trace path
+        cfg.telemetry_path = (None if tel.lower() in ("off", "none", "0")
+                              else tel)
+        changed.append("telemetry_path")
     return changed
 
 

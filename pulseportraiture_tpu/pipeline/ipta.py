@@ -39,6 +39,7 @@ import time
 
 import numpy as np
 
+from ..telemetry import log, resolve_tracer
 from ..utils.bunch import DataBunch
 from .stream import stream_wideband_TOAs
 from .toas import _is_metafile, _read_metafile
@@ -79,7 +80,8 @@ class IPTAJob:
 
 
 def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
-                         quiet=False, resume=False, **stream_kwargs):
+                         quiet=False, resume=False, telemetry=None,
+                         **stream_kwargs):
     """Measure wideband TOAs for a multi-pulsar campaign.
 
     jobs: sequence of IPTAJob (or (pulsar, datafiles, modelfile)
@@ -101,6 +103,13 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
     count — therefore finishes exactly the missing archives, and the
     union of the .tim shards equals an uninterrupted run's lines.
     Requires outdir.
+
+    telemetry: a trace path or telemetry.Tracer — ONE tracer is
+    threaded through every per-pulsar stream call, so the whole
+    campaign (campaign start/end, resume rollup, per-pulsar rollups,
+    and every per-bucket dispatch/drain record) lands in a single
+    self-describing JSONL trace; None follows config.telemetry_path
+    (default off).  Analyze with tools/pptrace.py.
 
     Returns a DataBunch with:
       pulsars     — job order (all jobs, even if this host's shard of
@@ -129,91 +138,115 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
     grid = [(j.pulsar, f) for j in jobs for f in j.datafiles]
     pid, nproc = parallel.process_index(), parallel.process_count()
     mine = parallel.shard_files(grid) if shard else grid
-    by_psr = {}
-    for psr, f in mine:
-        by_psr.setdefault(psr, []).append(f)
+    tracer, own_tracer = resolve_tracer(telemetry,
+                                        run="stream_ipta_campaign")
+    tracer.emit("campaign_start", n_jobs=len(jobs), pid=pid,
+                nproc=nproc, resume=bool(resume),
+                n_archives_shard=len(mine))
+    try:
+        by_psr = {}
+        for psr, f in mine:
+            by_psr.setdefault(psr, []).append(f)
 
-    def _tim_name(pulsar, p=None):
-        suffix = f".p{p if p is not None else pid}" \
-            if (shard and nproc > 1) else ""
-        return os.path.join(outdir, f"{pulsar}{suffix}.tim")
+        def _tim_name(pulsar, p=None):
+            suffix = f".p{p if p is not None else pid}" \
+                if (shard and nproc > 1) else ""
+            return os.path.join(outdir, f"{pulsar}{suffix}.tim")
 
-    completed = {}
-    if resume:
-        from .stream import checkpoint_completed, sanitize_checkpoint
+        completed = {}
+        if resume:
+            from .stream import checkpoint_completed, sanitize_checkpoint
 
-        current_outputs = {os.path.abspath(_tim_name(j.pulsar, p))
-                           for j in jobs for p in range(nproc)}
-        for job in jobs:
-            done = set()
-            own = os.path.abspath(_tim_name(job.pulsar))
-            will_stream = bool(by_psr.get(job.pulsar))
-            for path in _shard_checkpoints(outdir, job.pulsar):
-                ap = os.path.abspath(path)
-                if ap == own and not will_stream:
-                    # this process owns the filename but has no files
-                    # for the pulsar this run (reshuffled grid), so no
-                    # stream call will sanitize it — drop its torn
-                    # tail here, or it pollutes the shard union
-                    done |= sanitize_checkpoint(path)
-                elif ap in current_outputs:
-                    # a live shard: its owner sanitizes it (stream
-                    # resume=True, or the branch above); only read
-                    done |= checkpoint_completed(path)
-                elif pid == 0:
-                    # orphaned shard from a previous process layout
-                    # (e.g. a killed worker): no current process
-                    # writes it, so process 0 may drop its partial
-                    # tail safely
-                    done |= sanitize_checkpoint(path)
-                else:
-                    done |= checkpoint_completed(path)
-            completed[job.pulsar] = done
-        if not quiet:
+            current_outputs = {os.path.abspath(_tim_name(j.pulsar, p))
+                               for j in jobs for p in range(nproc)}
+            for job in jobs:
+                done = set()
+                own = os.path.abspath(_tim_name(job.pulsar))
+                will_stream = bool(by_psr.get(job.pulsar))
+                for path in _shard_checkpoints(outdir, job.pulsar):
+                    ap = os.path.abspath(path)
+                    if ap == own and not will_stream:
+                        # this process owns the filename but has no files
+                        # for the pulsar this run (reshuffled grid), so no
+                        # stream call will sanitize it — drop its torn
+                        # tail here, or it pollutes the shard union
+                        done |= sanitize_checkpoint(path)
+                    elif ap in current_outputs:
+                        # a live shard: its owner sanitizes it (stream
+                        # resume=True, or the branch above); only read
+                        done |= checkpoint_completed(path)
+                    elif pid == 0:
+                        # orphaned shard from a previous process layout
+                        # (e.g. a killed worker): no current process
+                        # writes it, so process 0 may drop its partial
+                        # tail safely
+                        done |= sanitize_checkpoint(path)
+                    else:
+                        done |= checkpoint_completed(path)
+                completed[job.pulsar] = done
             ntot = sum(len(v) for v in completed.values())
-            print(f"IPTA resume: {ntot} archive(s) recorded complete "
-                  "across existing checkpoint shards will be skipped")
+            tracer.emit("resume_skip", n_skipped=ntot)
+            log(f"IPTA resume: {ntot} archive(s) recorded complete "
+                "across existing checkpoint shards will be skipped",
+                quiet=quiet)
 
-    t0 = time.time()
-    per_pulsar = {}
-    TOA_list = []
-    nfit = 0
-    fit_duration = 0.0
-    for job in jobs:
-        files = by_psr.get(job.pulsar, [])
-        if not files:
-            continue
-        tim_out = _tim_name(job.pulsar) if outdir else None
-        kw = {**stream_kwargs, **job.kwargs}
-        res = stream_wideband_TOAs(
-            files, job.modelfile, nsub_batch=nsub_batch,
-            tim_out=tim_out, quiet=True, resume=resume,
-            skip_archives=completed.get(job.pulsar), **kw)
-        per_pulsar[job.pulsar] = res
-        TOA_list.extend(res.TOA_list)
-        nfit += res.nfit
-        fit_duration += res.fit_duration
+        t0 = time.time()
+        per_pulsar = {}
+        TOA_list = []
+        nfit = 0
+        fit_duration = 0.0
+        for job in jobs:
+            files = by_psr.get(job.pulsar, [])
+            if not files:
+                continue
+            tim_out = _tim_name(job.pulsar) if outdir else None
+            kw = {**stream_kwargs, **job.kwargs}
+            t_job = time.time()
+            res = stream_wideband_TOAs(
+                files, job.modelfile, nsub_batch=nsub_batch,
+                tim_out=tim_out, quiet=True, resume=resume,
+                skip_archives=completed.get(job.pulsar),
+                telemetry=kw.pop("telemetry", tracer), **kw)
+            per_pulsar[job.pulsar] = res
+            TOA_list.extend(res.TOA_list)
+            nfit += res.nfit
+            fit_duration += res.fit_duration
+            if tracer.enabled:
+                tracer.emit("pulsar_done", pulsar=job.pulsar,
+                            n_toas=len(res.TOA_list),
+                            n_archives=len(res.order), nfit=res.nfit,
+                            fit_s=round(res.fit_duration, 6),
+                            peak_inflight=res.peak_inflight,
+                            wall_s=round(time.time() - t_job, 6))
 
-    # ---- allgather per-pulsar DeltaDM summaries across hosts ---------
-    summary = {}
-    for job in jobs:
-        res = per_pulsar.get(job.pulsar)
-        means = np.asarray(res.DeltaDM_means if res else [], float)
-        errs = np.asarray(res.DeltaDM_errs if res else [], float)
-        gm = parallel.process_allgather(means)
-        ge = parallel.process_allgather(errs)
-        summary[job.pulsar] = (np.concatenate([np.atleast_1d(g)
-                                               for g in gm]),
-                               np.concatenate([np.atleast_1d(g)
-                                               for g in ge]))
+        # ---- allgather per-pulsar DeltaDM summaries across hosts -----
+        summary = {}
+        for job in jobs:
+            res = per_pulsar.get(job.pulsar)
+            means = np.asarray(res.DeltaDM_means if res else [], float)
+            errs = np.asarray(res.DeltaDM_errs if res else [], float)
+            gm = parallel.process_allgather(means)
+            ge = parallel.process_allgather(errs)
+            summary[job.pulsar] = (np.concatenate([np.atleast_1d(g)
+                                                   for g in gm]),
+                                   np.concatenate([np.atleast_1d(g)
+                                                   for g in ge]))
 
-    wall = time.time() - t0
-    if not quiet:
+        wall = time.time() - t0
         n = len(TOA_list)
-        print(f"IPTA campaign: {n} TOAs across {len(per_pulsar)}/"
-              f"{len(jobs)} pulsars on process {pid}/{nproc} in "
-              f"{wall:.2f} s ({nfit} fused dispatches, "
-              f"{n / max(wall, 1e-9):.1f} TOAs/s end-to-end)")
+        log(f"IPTA campaign: {n} TOAs across {len(per_pulsar)}/"
+            f"{len(jobs)} pulsars on process {pid}/{nproc} in "
+            f"{wall:.2f} s ({nfit} fused dispatches, "
+            f"{n / max(wall, 1e-9):.1f} TOAs/s end-to-end)",
+            quiet=quiet, tracer=tracer)
+        tracer.emit("campaign_end", n_toas=n, nfit=nfit,
+                    n_pulsars=len(per_pulsar),
+                    wall_s=round(wall, 6))
+    finally:
+        # a failed resume scan or pulsar must still leave a closed,
+        # counter-bearing trace (same stance as the stream drivers)
+        if own_tracer:
+            tracer.close()
     return DataBunch(pulsars=names, per_pulsar=per_pulsar,
                      TOA_list=TOA_list, DeltaDM_summary=summary,
                      nfit=nfit, fit_duration=fit_duration, wall_s=wall)

@@ -65,6 +65,7 @@ from ..fit.portrait import (FitFlags, _fast_batch_fn, estimate_tau_batch,
 from ..io.psrfits import read_archive
 from ..io.tim import TOA, write_TOAs
 from ..ops.noise import get_SNR, get_noise_PS, min_window_baseline
+from ..telemetry import NULL_TRACER, finite, log, resolve_tracer
 from ..utils.bunch import DataBunch
 from .models import TemplateModel
 from .toas import (_is_metafile, _iter_archives, _read_metafile,
@@ -281,7 +282,7 @@ class _StreamExecutor:
     def __init__(self, lane, datafiles, loader, nsub_batch,
                  max_inflight=None, prefetch=True, tim_out=None,
                  resume=False, skip_archives=None, quiet=False,
-                 stream_devices=None):
+                 stream_devices=None, tracer=None):
         from collections import deque
         from concurrent.futures import ThreadPoolExecutor
 
@@ -295,6 +296,7 @@ class _StreamExecutor:
         self.prefetch = prefetch
         self.tim_out = tim_out
         self.quiet = quiet
+        self.tracer = NULL_TRACER if tracer is None else tracer
         done = {os.path.abspath(f) for f in (skip_archives or ())}
         if tim_out:
             if resume:
@@ -308,10 +310,14 @@ class _StreamExecutor:
                        if os.path.abspath(f) in done]
             datafiles = [f for f in datafiles
                          if os.path.abspath(f) not in done]
-            if skipped and not quiet:
-                print(f"Resuming: {len(skipped)} archive(s) already "
-                      f"complete in checkpoints, {len(datafiles)} "
-                      "to go")
+            if skipped:
+                if self.tracer.enabled:
+                    self.tracer.emit("resume_skip",
+                                     n_skipped=len(skipped),
+                                     n_remaining=len(datafiles))
+                log(f"Resuming: {len(skipped)} archive(s) already "
+                    f"complete in checkpoints, {len(datafiles)} "
+                    "to go", quiet=quiet)
         self.datafiles = datafiles
         self.loader = loader
         self.devices = resolve_stream_devices(stream_devices)
@@ -339,6 +345,8 @@ class _StreamExecutor:
         self.scatter_duration = 0.0  # host-side unpack of results
         self.devices_used = set()
         self.peak_inflight = 0
+        self.dispatch_counts = [0] * len(self.devices)
+        self._warm = set()           # (shape, idev) pairs dispatched
         # checkpoint bookkeeping: archives in ACCEPTED order, plus the
         # index of the next one to write (in-order emission)
         self._ckpt_order = []
@@ -359,7 +367,16 @@ class _StreamExecutor:
             ia = self._ckpt_order[self._ckpt_next]
             if ia not in self.assembled:
                 break
-            self._checkpoint(self.meta_by_iarch[ia], self.assembled[ia])
+            m, out = self.meta_by_iarch[ia], self.assembled[ia]
+            self._checkpoint(m, out)
+            if self.tracer.enabled:
+                # lag: archives PREPARED after this one by the time
+                # its in-order write landed — the straggler signal the
+                # pptrace stall section ranks on
+                self.tracer.emit(
+                    "ckpt_flush", iarch=ia, datafile=m.datafile,
+                    n_toas=len(out[0]),
+                    lag=len(self._ckpt_order) - 1 - self._prep_idx[ia])
             self._ckpt_next += 1
 
     @staticmethod
@@ -385,7 +402,7 @@ class _StreamExecutor:
     def _drain_head(self, idev):
         """Drain device idev's oldest dispatch (blocking on it)."""
         t0 = time.time()
-        handle, owners, extra = self.in_flight[idev].popleft()
+        handle, owners, extra, seq = self.in_flight[idev].popleft()
         out = handle.result() if hasattr(handle, "result") else handle
         # wait for the device program itself, not just the dispatch
         # thread: the split below must charge device time to
@@ -396,10 +413,36 @@ class _StreamExecutor:
             out = jax.block_until_ready(out)
         except TypeError:
             pass  # non-array handle (already host data)
-        self.fit_duration += time.time() - t0
+        wait_s = time.time() - t0
+        self.fit_duration += wait_s
         t1 = time.time()
         self.lane.scatter(out, owners, extra, self.results)
-        self.scatter_duration += time.time() - t1
+        scat_s = time.time() - t1
+        self.scatter_duration += scat_s
+        if self.tracer.enabled:
+            # timestamps only around the two calls above, which block
+            # regardless of telemetry — no extra host sync
+            self.tracer.emit("drain", seq=seq, device=idev,
+                             wait_s=round(wait_s, 6),
+                             scatter_s=round(scat_s, 6))
+            # per-TOA quality rollup for this dispatch (dict-shaped
+            # results, i.e. the wideband lane; the narrowband lane
+            # packs per-channel arrays and already flags snr/gof per
+            # TOA line)
+            snrs, gofs, nfevs = [], [], []
+            for ow in owners:
+                r = self.results.get(ow)
+                if isinstance(r, dict) and "snr" in r:
+                    # finite(): degenerate fits yield NaN snr/chi2 and
+                    # json.dumps would write bare NaN tokens strict
+                    # JSON consumers reject — map them to null
+                    snrs.append(finite(r["snr"], 3))
+                    gofs.append(finite(float(r["chi2"])
+                                       / max(float(r["dof"]), 1.0), 4))
+                    nfevs.append(int(r["nfeval"]))
+            if snrs:
+                self.tracer.emit("quality", seq=seq, snr=snrs,
+                                 gof=gofs, nfev=nfevs)
         touched = set()
         for iarch, _ in owners:
             if iarch in self.remaining:
@@ -413,6 +456,9 @@ class _StreamExecutor:
                 m = self.meta_by_iarch[ia]
                 out = self.lane.assemble(m, self.results)
                 self.assembled[ia] = out
+                if self.tracer.enabled:
+                    self.tracer.emit("archive_done", iarch=ia,
+                                     datafile=m.datafile)
                 # per-subint records fold into the assembly; dropping
                 # them keeps host memory O(bucket)
                 for isub in m.ok:
@@ -478,23 +524,60 @@ class _StreamExecutor:
         while idev is None:
             self._drain_any()
             idev = self._pick_device()
+        tr = self.tracer
+        if tr.enabled:
+            # bucket identity for the trace, captured BEFORE launch
+            # clears the bucket: layout x payload kind x effective
+            # flag bits (the pieces of the dispatch key a reader can
+            # interpret)
+            shape = f"{len(b.freqs)}x{b.nbin}:{b.kind}"
+            if b.flags:
+                shape += ":" + "".join("1" if f else "0"
+                                       for f in b.flags)
+            n_subints = len(b)
         rec = self.lane.launch(b, self.devices[idev],
                                self.dispatch_exs[idev])
         if rec is None:
             return
         self.nfit += 1
         self.devices_used.add(idev)
+        self.dispatch_counts[idev] += 1
         for ia, _ in rec[1]:
             if ia in self.undispatched:
                 self.undispatched[ia] -= 1
                 if self.undispatched[ia] == 0:
                     del self.undispatched[ia]
         q = self.in_flight[idev]
-        q.append(rec)
+        # seq comes from the TRACER, not this executor: several
+        # executors may share one trace (stream_ipta_campaign), and
+        # the report pairs dispatch/drain events by seq
+        seq = tr.next_seq()
+        q.append(rec + (seq,))
         # the bound is EXACT: _pick_device guaranteed room, so no
         # queue ever holds more than max_inflight dispatches (the old
         # append-then-drain order admitted max_inflight + 1)
         self.peak_inflight = max(self.peak_inflight, len(q))
+        if tr.enabled:
+            # cold = first dispatch of this bucket shape on this
+            # device: the worker will pay the jit trace + XLA compile
+            # (jax keys its cache on input placement), so the
+            # dispatch -> dispatched gap on cold records is the
+            # K-chip cold-start cost pptrace accounts for
+            cold = (shape, idev) not in self._warm
+            self._warm.add((shape, idev))
+            tr.emit("dispatch", seq=seq, device=idev, shape=shape,
+                    n=n_subints, queue_depth=len(q), cold=cold)
+            tr.counter("dispatches")
+            tr.counter(f"dispatches_dev{idev}")
+            tr.gauge_max("peak_inflight", len(q))
+            handle = rec[0]
+            if hasattr(handle, "add_done_callback"):
+                # fires on the dispatch worker thread the moment the
+                # h2d copy + program enqueue (+ compile, when cold)
+                # finish — the tracer is thread-safe by contract
+                handle.add_done_callback(
+                    lambda f, seq=seq, idev=idev: tr.emit(
+                        "dispatched", seq=seq, device=idev))
 
     def _shutdown(self, wait):
         for ex in self.dispatch_exs:
@@ -505,19 +588,31 @@ class _StreamExecutor:
         # grinding through queued h2d copies (each holding a full
         # stacked batch) while the exception propagates
         try:
+            tr = self.tracer
             for iarch, (datafile, d) in enumerate(
                     _iter_archives(self.datafiles, self.loader,
                                    self.prefetch)):
                 if isinstance(d, Exception):
-                    print(f"Skipping {datafile}: {d}")
+                    tr.emit("archive_skip", datafile=datafile,
+                            reason=str(d))
+                    tr.counter("archives_skipped")
+                    log(f"Skipping {datafile}: {d}", level="warn",
+                        tracer=None)
                     continue
                 ok = np.asarray(d.ok_isubs, int)
                 if d.nsub == 0 or len(ok) == 0:
-                    print(f"No subints to fit in {datafile}; "
-                          "skipping.")
+                    tr.emit("archive_skip", datafile=datafile,
+                            reason="no subints to fit")
+                    tr.counter("archives_skipped")
+                    log(f"No subints to fit in {datafile}; skipping.",
+                        level="warn", tracer=None)
                     continue
+                t_prep = time.time()
                 prep = self.lane.prepare(iarch, datafile, d, ok)
                 if prep is None:
+                    # the lane already emitted archive_skip with the
+                    # real reason (it shares this executor's tracer)
+                    tr.counter("archives_skipped")
                     continue
                 m, per_subint = prep
                 self.meta.append(m)
@@ -526,6 +621,12 @@ class _StreamExecutor:
                 self.undispatched[iarch] = len(per_subint)
                 self._ckpt_order.append(iarch)
                 self._prep_idx[iarch] = len(self._ckpt_order) - 1
+                if tr.enabled:
+                    tr.emit("archive_prepare", iarch=iarch,
+                            datafile=datafile, n_ok=len(ok),
+                            n_subints=len(per_subint),
+                            prep_s=round(time.time() - t_prep, 6))
+                    tr.counter("archives_prepared")
                 for key, factory, fill in per_subint:
                     b = self.buckets.get(key)
                     if b is None:
@@ -548,6 +649,13 @@ class _StreamExecutor:
                 if head_d is not None and \
                         self._prep_idx[iarch] - self._prep_idx[head_d] \
                         >= CKPT_STALENESS_HORIZON:
+                    if tr.enabled:
+                        tr.emit(
+                            "force_flush",
+                            datafile=self.meta_by_iarch[head_d].datafile,
+                            lag=self._prep_idx[iarch]
+                            - self._prep_idx[head_d])
+                        tr.counter("force_flushes")
                     for b in self.buckets.values():
                         if len(b):
                             self._flush(b)
@@ -566,6 +674,9 @@ class _StreamExecutor:
             if m.iarch not in self.assembled:
                 self.assembled[m.iarch] = self.lane.assemble(
                     m, self.results)
+                if self.tracer.enabled:
+                    self.tracer.emit("archive_done", iarch=m.iarch,
+                                     datafile=m.datafile)
         self._ckpt_flush()
         return self.meta, self.assembled
 
@@ -1051,7 +1162,8 @@ def _assemble_archive(m, results, modelfile, fit_DM, bary,
                       addtnl_toa_flags, log10_tau=False,
                       alpha_fitted=False, nu_ref_tau=None,
                       fit_GM=False, print_flux=False,
-                      print_phase=False, quiet=False):
+                      print_phase=False, quiet=False,
+                      quality_flags=False):
     """Build the TOA objects + DeltaDM stats for one archive from the
     scattered fit results."""
     toas, dDMs, dDM_errs = [], [], []
@@ -1102,6 +1214,12 @@ def _assemble_archive(m, results, modelfile, fit_DM, bary,
             flags["flux"] = float(r["flux"])
             flags["flux_err"] = float(r["flux_err"])
             flags["flux_ref_freq"] = float(r["flux_ref_freq"])
+        if quality_flags:
+            # per-TOA fit diagnostics from the packed result (-snr is
+            # always present above); OFF by default so .tim output
+            # stays byte-identical to previous releases
+            flags["nfev"] = int(r["nfeval"])
+            flags["chi2"] = float(r["chi2"])
         flags.update(addtnl_toa_flags)
         DM_out = DM_j if fit_DM else None
         DM_err_out = float(r["DM_err"]) if fit_DM else None
@@ -1126,7 +1244,8 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                          instrumental_response_dict=None,
                          addtnl_toa_flags={}, tim_out=None,
                          quiet=False, resume=False,
-                         skip_archives=None, stream_devices=None):
+                         skip_archives=None, stream_devices=None,
+                         telemetry=None, quality_flags=False):
     """Measure wideband (phi[, DM[, tau, alpha]]) TOAs for many
     archives with cross-archive batched dispatches.
 
@@ -1166,6 +1285,22 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
     Output (TOA fields and .tim checkpoint content) is digit-identical
     for any device count: results stay keyed by (archive, subint)
     owners and checkpoints are written in archive order.
+
+    telemetry: structured JSONL event trace of the campaign — a path
+    (a new trace is written there), a telemetry.Tracer to share (how
+    stream_ipta_campaign pools every pulsar into one trace), or None
+    to follow config.telemetry_path (default off; PPT_TELEMETRY /
+    pptoas --telemetry set it).  Per-bucket dispatch/drain records
+    carry device id, shape key, queue depth, and cold-start markers;
+    per-archive prepare/flush/skip records and per-TOA quality rollups
+    ride along; analyze with tools/pptrace.py.  Tracing reads clocks
+    only around already-blocking calls, so enabling it never adds a
+    host sync — and output is byte-identical with telemetry on or off.
+
+    quality_flags: add per-TOA -nfev and -chi2 fit diagnostics to the
+    TOA flags (.tim lines), sourced from the packed fit results (-snr
+    and -gof are always present).  Off by default: golden .tim files
+    stay byte-identical.
 
     Returns a DataBunch with:
       TOA_list        — TOA objects in archive order
@@ -1237,6 +1372,8 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
         tau_mode, tau_args, alpha0_run = "none", (0.0, 1.0, 0.0), \
             float(default_alpha)
 
+    tracer, own_tracer = resolve_tracer(telemetry,
+                                        run="stream_wideband_TOAs")
     t_start = time.time()
 
     class _WidebandLane:
@@ -1249,7 +1386,12 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
             try:
                 modelx = model.portrait(freqs0, nbin, P=P_mean)
             except ValueError as e:
-                print(f"Skipping {datafile}: {e}")
+                # typed archive_skip (not just a log line) so pptrace's
+                # skipped-archives section shows the REAL mismatch,
+                # matching GetTOAs' skip path
+                tracer.emit("archive_skip", datafile=datafile,
+                            reason=str(e))
+                log(f"Skipping {datafile}: {e}", level="warn")
                 return None
             base_key = (nchan, nbin, freqs0.tobytes())
             if p_dependent:
@@ -1374,36 +1516,53 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                 alpha_fitted=fit_scat and not fix_alpha,
                 nu_ref_tau=nu_ref_tau, fit_GM=fit_GM,
                 print_flux=print_flux, print_phase=print_phase,
-                quiet=quiet)
+                quiet=quiet, quality_flags=quality_flags)
 
-    ex = _StreamExecutor(_WidebandLane(), datafiles, _loader,
-                         nsub_batch, max_inflight=max_inflight,
-                         prefetch=prefetch, tim_out=tim_out,
-                         resume=resume, skip_archives=skip_archives,
-                         quiet=quiet, stream_devices=stream_devices)
-    meta, assembled = ex.run()
-    nfit, fit_duration = ex.nfit, ex.fit_duration
+    try:
+        # inside the try: a constructor failure (bad stream_devices,
+        # corrupt resume checkpoint) must still close an owned trace
+        ex = _StreamExecutor(_WidebandLane(), datafiles, _loader,
+                             nsub_batch, max_inflight=max_inflight,
+                             prefetch=prefetch, tim_out=tim_out,
+                             resume=resume, skip_archives=skip_archives,
+                             quiet=quiet, stream_devices=stream_devices,
+                             tracer=tracer)
+        meta, assembled = ex.run()
+        nfit, fit_duration = ex.nfit, ex.fit_duration
 
-    # ---- collect TOAs + per-archive DeltaDM stats in archive order --
-    TOA_list = []
-    order, DM0s, DeltaDM_means, DeltaDM_errs = [], [], [], []
-    for m in meta:
-        toas, mean, err = assembled[m.iarch]
-        TOA_list.extend(toas)
-        order.append(m.datafile)
-        DM0s.append(m.DM0_arch)
-        DeltaDM_means.append(mean)
-        DeltaDM_errs.append(err)
+        # ---- collect TOAs + per-archive DeltaDM stats in archive order
+        TOA_list = []
+        order, DM0s, DeltaDM_means, DeltaDM_errs = [], [], [], []
+        for m in meta:
+            toas, mean, err = assembled[m.iarch]
+            TOA_list.extend(toas)
+            order.append(m.datafile)
+            DM0s.append(m.DM0_arch)
+            DeltaDM_means.append(mean)
+            DeltaDM_errs.append(err)
 
-    if not quiet:
         tot = time.time() - t_start
         n = len(TOA_list)
-        print(f"streamed {n} TOAs from {len(order)} archives in "
-              f"{tot:.2f} s ({nfit} fused dispatches across "
-              f"{len(ex.devices_used)} device(s), "
-              f"{fit_duration:.2f} s blocked on device, "
-              f"{ex.scatter_duration:.2f} s in host scatter, "
-              f"{n / max(tot, 1e-9):.1f} TOAs/s end-to-end)")
+        log(f"streamed {n} TOAs from {len(order)} archives in "
+            f"{tot:.2f} s ({nfit} fused dispatches across "
+            f"{len(ex.devices_used)} device(s), "
+            f"{fit_duration:.2f} s blocked on device, "
+            f"{ex.scatter_duration:.2f} s in host scatter, "
+            f"{n / max(tot, 1e-9):.1f} TOAs/s end-to-end)",
+            quiet=quiet, tracer=tracer)
+        if tracer.enabled:
+            tracer.emit("run_end", driver="stream_wideband_TOAs",
+                        n_toas=n, n_archives=len(order), nfit=nfit,
+                        peak_inflight=ex.peak_inflight,
+                        max_inflight=ex.max_inflight,
+                        fit_s=round(fit_duration, 6),
+                        scatter_s=round(ex.scatter_duration, 6),
+                        wall_s=round(tot, 6),
+                        devices_used=len(ex.devices_used),
+                        dispatches_per_device=ex.dispatch_counts)
+    finally:
+        if own_tracer:
+            tracer.close()
     return DataBunch(TOA_list=TOA_list, order=order, DM0s=DM0s,
                      DeltaDM_means=DeltaDM_means,
                      DeltaDM_errs=DeltaDM_errs,
@@ -1513,7 +1672,8 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
                            max_inflight=None, print_phase=False,
                            addtnl_toa_flags={}, tim_out=None,
                            quiet=False, resume=False,
-                           skip_archives=None, stream_devices=None):
+                           skip_archives=None, stream_devices=None,
+                           telemetry=None):
     """Campaign-scale narrowband TOAs: per-channel 1-D fits with the
     same raw-int16 device pipeline, bucketing, and asynchronous
     dispatch as stream_wideband_TOAs — one TOA per unzapped channel
@@ -1522,11 +1682,12 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
 
     Non-raw-compatible archives (AA+BB multi-pol, float DATA) fall
     back to a host-decoded dispatch of the same device fits.
-    tim_out / resume / skip_archives / stream_devices / max_inflight
-    follow stream_wideband_TOAs (per-archive completion sentinels;
-    round-robin multi-device dispatch; _StreamExecutor).  Returns a
-    DataBunch(TOA_list, order, fit_duration, scatter_duration, nfit,
-    devices_used, peak_inflight)."""
+    tim_out / resume / skip_archives / stream_devices / max_inflight /
+    telemetry follow stream_wideband_TOAs (per-archive completion
+    sentinels; round-robin multi-device dispatch; _StreamExecutor;
+    JSONL event tracing).  Returns a DataBunch(TOA_list, order,
+    fit_duration, scatter_duration, nfit, devices_used,
+    peak_inflight)."""
     if isinstance(datafiles, str):
         datafiles = (_read_metafile(datafiles) if _is_metafile(datafiles)
                      else [datafiles])
@@ -1562,6 +1723,8 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
         return load_for_toas(f, tscrunch=tscrunch, quiet=True,
                              dtype=load_dtype)
 
+    tracer, own_tracer = resolve_tracer(telemetry,
+                                        run="stream_narrowband_TOAs")
     t_start = time.time()
     keys = _NB_SCAT_KEYS if fit_scat else _NB_KEYS
     ftname = "float32" if use_fast_fit_default() else "float64"
@@ -1663,7 +1826,12 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
             try:
                 modelx = model.portrait(freqs0, nbin, P=P_mean)
             except ValueError as e:
-                print(f"Skipping {datafile}: {e}")
+                # typed archive_skip (not just a log line) so pptrace's
+                # skipped-archives section shows the REAL mismatch,
+                # matching GetTOAs' skip path
+                tracer.emit("archive_skip", datafile=datafile,
+                            reason=str(e))
+                log(f"Skipping {datafile}: {e}", level="warn")
                 return None
             raw_mode = bool(d.get("raw_mode", False))
             masks = np.asarray(d.weights[ok] > 0.0, float)
@@ -1723,29 +1891,46 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
         def assemble(self, m, results):
             return (assemble(m, results),)
 
-    ex = _StreamExecutor(_NarrowbandLane(), datafiles, _loader,
-                         nsub_batch, max_inflight=max_inflight,
-                         prefetch=prefetch, tim_out=tim_out,
-                         resume=resume, skip_archives=skip_archives,
-                         quiet=quiet, stream_devices=stream_devices)
-    meta, assembled = ex.run()
-    nfit, fit_duration = ex.nfit, ex.fit_duration
+    try:
+        # inside the try: a constructor failure (bad stream_devices,
+        # corrupt resume checkpoint) must still close an owned trace
+        ex = _StreamExecutor(_NarrowbandLane(), datafiles, _loader,
+                             nsub_batch, max_inflight=max_inflight,
+                             prefetch=prefetch, tim_out=tim_out,
+                             resume=resume, skip_archives=skip_archives,
+                             quiet=quiet, stream_devices=stream_devices,
+                             tracer=tracer)
+        meta, assembled = ex.run()
+        nfit, fit_duration = ex.nfit, ex.fit_duration
 
-    # ---- collect per-archive TOAs in archive order -------------------
-    TOA_list, order = [], []
-    for m in meta:
-        TOA_list.extend(assembled[m.iarch][0])
-        order.append(m.datafile)
+        # ---- collect per-archive TOAs in archive order ---------------
+        TOA_list, order = [], []
+        for m in meta:
+            TOA_list.extend(assembled[m.iarch][0])
+            order.append(m.datafile)
 
-    if not quiet:
         tot = time.time() - t_start
         n = len(TOA_list)
-        print(f"streamed {n} narrowband TOAs from {len(order)} archives "
-              f"in {tot:.2f} s ({nfit} fused dispatches across "
-              f"{len(ex.devices_used)} device(s), "
-              f"{fit_duration:.2f} s blocked on device, "
-              f"{ex.scatter_duration:.2f} s in host scatter, "
-              f"{n / max(tot, 1e-9):.1f} TOAs/s end-to-end)")
+        log(f"streamed {n} narrowband TOAs from {len(order)} archives "
+            f"in {tot:.2f} s ({nfit} fused dispatches across "
+            f"{len(ex.devices_used)} device(s), "
+            f"{fit_duration:.2f} s blocked on device, "
+            f"{ex.scatter_duration:.2f} s in host scatter, "
+            f"{n / max(tot, 1e-9):.1f} TOAs/s end-to-end)",
+            quiet=quiet, tracer=tracer)
+        if tracer.enabled:
+            tracer.emit("run_end", driver="stream_narrowband_TOAs",
+                        n_toas=n, n_archives=len(order), nfit=nfit,
+                        peak_inflight=ex.peak_inflight,
+                        max_inflight=ex.max_inflight,
+                        fit_s=round(fit_duration, 6),
+                        scatter_s=round(ex.scatter_duration, 6),
+                        wall_s=round(tot, 6),
+                        devices_used=len(ex.devices_used),
+                        dispatches_per_device=ex.dispatch_counts)
+    finally:
+        if own_tracer:
+            tracer.close()
     return DataBunch(TOA_list=TOA_list, order=order,
                      fit_duration=fit_duration,
                      scatter_duration=ex.scatter_duration, nfit=nfit,

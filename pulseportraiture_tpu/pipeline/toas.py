@@ -30,6 +30,7 @@ from ..fit.portrait import (FitFlags, fit_portrait_batch,
 from ..io.psrfits import load_data
 from ..io.tim import TOA
 from ..ops.scattering import scattering_portrait_FT, scattering_times
+from ..telemetry import finite, log, resolve_tracer
 from ..utils.device import on_host
 from .models import TemplateModel
 
@@ -382,7 +383,7 @@ class GetTOAs:
                  fix_alpha=False, print_phase=False, print_flux=False,
                  print_parangle=False, addtnl_toa_flags={},
                  nu_fits=None, max_iter=40, prefetch=False, quiet=None,
-                 bounds=None):
+                 bounds=None, quality_flags=False, telemetry=None):
         """Measure wideband TOAs (reference pptoas.py:161-792; same
         options minus the scipy `method` knob, which has no analogue
         in the fused-Newton engine).  prefetch=True overlaps
@@ -395,7 +396,15 @@ class GetTOAs:
         (pptoaslib.py:1039-1060): parameters are clipped to the box and
         a fit converging ON a bound reports return code 0
         (LOCALMINIMUM, |projected g| ~= 0); use None entries as +-inf
-        via np.inf."""
+        via np.inf.
+
+        quality_flags=True adds per-TOA -nfev and -chi2 fit
+        diagnostics to the TOA flags from the already-computed result
+        arrays (-snr and -gof are always emitted); off by default so
+        .tim output stays byte-identical.  telemetry: a trace path or
+        telemetry.Tracer — per-archive load/fit events and per-TOA
+        quality records (nfeval, chi2/dof, snr) append to the JSONL
+        trace (None follows config.telemetry_path; default off)."""
         if quiet is None:
             quiet = self.quiet
         if bounds is not None:
@@ -427,6 +436,13 @@ class GetTOAs:
         nu_ref_tau = nu_refs[1] if nu_refs is not None else None
 
         load_times = {}
+        tracer, own_tracer = resolve_tracer(telemetry,
+                                            run="GetTOAs.get_TOAs")
+        ntoa_before = len(self.TOA_list)
+        narch_before = len(self.order)
+        nfit_calls = 0  # batched fit invocations (one per flag group
+        # per archive) — run_end.nfit matches the stream drivers'
+        # fused-dispatch semantics, not the archive count
 
         def _loader(f):
             t0 = time.time()
@@ -435,371 +451,418 @@ class GetTOAs:
             finally:
                 load_times[f] = time.time() - t0
 
-        for datafile, d in _iter_archives(datafiles, _loader, prefetch):
-            t_start = time.time()
-            if isinstance(d, Exception):
-                # skip-and-continue (pptoas.py:261-304)
-                print(f"Skipping {datafile}: {d}")
-                continue
-            if d.nsub == 0 or len(d.ok_isubs) == 0:
-                print(f"No subints to fit in {datafile}; skipping.")
-                continue
-            nsub, nchan, nbin = d.nsub, d.nchan, d.nbin
-            ok = np.asarray(d.ok_isubs, int)
-            nok = len(ok)
-            P_mean = float(np.mean(d.Ps[ok]))
-            freqs0 = np.asarray(d.freqs[0], float)
-            DM_stored = float(d.DM)
-            DM0_arch = DM_stored if DM0 is None else float(DM0)
-            DM_guess = DM_stored if DM_stored != 0.0 else DM0_arch
+        try:
+            for datafile, d in _iter_archives(datafiles, _loader, prefetch):
+                t_start = time.time()
+                if isinstance(d, Exception):
+                    # skip-and-continue (pptoas.py:261-304)
+                    tracer.emit("archive_skip", datafile=datafile,
+                                reason=str(d))
+                    log(f"Skipping {datafile}: {d}", level="warn")
+                    continue
+                if d.nsub == 0 or len(d.ok_isubs) == 0:
+                    tracer.emit("archive_skip", datafile=datafile,
+                                reason="no subints to fit")
+                    log(f"No subints to fit in {datafile}; skipping.",
+                        level="warn")
+                    continue
+                if tracer.enabled:
+                    tracer.emit("archive_load", datafile=datafile,
+                                load_s=round(load_times.get(datafile, 0.0),
+                                             6),
+                                n_ok=len(d.ok_isubs))
+                nsub, nchan, nbin = d.nsub, d.nchan, d.nbin
+                ok = np.asarray(d.ok_isubs, int)
+                nok = len(ok)
+                P_mean = float(np.mean(d.Ps[ok]))
+                freqs0 = np.asarray(d.freqs[0], float)
+                DM_stored = float(d.DM)
+                DM0_arch = DM_stored if DM0 is None else float(DM0)
+                DM_guess = DM_stored if DM_stored != 0.0 else DM0_arch
 
-            # template (cached per unique frequency layout)
-            try:
-                modelx = self.model.portrait(freqs0, nbin, P=P_mean)
-            except ValueError as e:
-                print(f"Skipping {datafile}: {e}")
-                continue
+                # template (cached per unique frequency layout)
+                try:
+                    modelx = self.model.portrait(freqs0, nbin, P=P_mean)
+                except ValueError as e:
+                    tracer.emit("archive_skip", datafile=datafile,
+                                reason=str(e))
+                    log(f"Skipping {datafile}: {e}", level="warn")
+                    continue
 
-            ports = np.asarray(d.subints[ok, 0], float)
-            masks = np.asarray(d.weights[ok] > 0.0, float)
-            noise = np.asarray(d.noise_stds[ok, 0], float)
-            snrs_chan = np.asarray(d.SNRs[ok, 0], float) * masks
+                ports = np.asarray(d.subints[ok, 0], float)
+                masks = np.asarray(d.weights[ok] > 0.0, float)
+                noise = np.asarray(d.noise_stds[ok, 0], float)
+                snrs_chan = np.asarray(d.SNRs[ok, 0], float) * masks
 
-            # per-subint fit reference frequency (pplib.py:2715-2729)
-            if nu_fits is not None:
-                nu_fit_arr = np.full(nok, float(nu_fits[0]))
-            else:
-                nu_fit_arr = snr_weighted_nu_fit(snrs_chan, freqs0)
-
-            # initial tau guess [rot at nu_fit]; "auto" = data-driven
-            # broadband estimate per subint (|X| is phase-invariant, so
-            # no alignment needed first) — cuts the scattering fit's
-            # Newton evals severalfold vs the neutral seed
-            tau0, alpha0 = scat_seed_tau0(
-                scat_guess, fit_scat, nok, nbin, P_mean, nu_fit_arr,
-                self.model.gauss.alpha if self.model.is_gaussian
-                else scattering_alpha,
-                ports=ports, modelx=modelx, noise=noise, masks=masks)
-
-            theta0 = np.zeros((nok, 5))
-            theta0[:, 1] = DM_guess
-            theta0[:, 3] = (np.log10(np.maximum(tau0, 1e-12))
-                            if log10_tau else tau0)
-            theta0[:, 4] = alpha0
-
-            # group subints by effective fit flags (degenerate-geometry
-            # fallbacks, pptoas.py:519-527)
-            nchx = masks.sum(axis=1).astype(int)
-            base = (True, bool(fit_DM), bool(fit_GM), bool(fit_scat),
-                    bool(fit_scat and not fix_alpha))
-            groups = {}
-            for i in range(nok):
-                groups.setdefault(
-                    effective_fit_flags(nchx[i], base), []).append(i)
-
-            # instrumental-response FT for this archive's layout
-            # (pptoas.py:428-434): product of configured achromatic
-            # kernels and, optionally, per-channel DM-smearing sincs
-            ir_FT = build_instrumental_response_FT(
-                self.instrumental_response_dict, freqs0, nbin,
-                DM_guess, P_mean, bw=d.bw)
-
-            fit_duration = 0.0
-            res_arrays = {k: np.full(nok, np.nan) for k in
-                          ("phi", "phi_err", "DM", "DM_err", "GM", "GM_err",
-                           "tau", "tau_err", "alpha", "alpha_err", "nu_DM",
-                           "nu_GM", "nu_tau", "snr", "chi2", "dof")}
-            res_arrays["nfeval"] = np.zeros(nok, int)
-            res_arrays["rc"] = np.full(nok, -2, int)
-            scales_arr = np.zeros((nok, nchan))
-            scale_errs_arr = np.zeros((nok, nchan))
-            channel_snrs_arr = np.zeros((nok, nchan))
-            covs = np.zeros((nok, 5, 5))
-
-            for flags, idx in groups.items():
-                idx = np.asarray(idx, int)
-                tfit = time.time()
-                # no-scattering fits route through the complex-free f32
-                # fast path on TPU backends, where complex FFTs are
-                # unsupported/unusably slow (config.use_fast_fit)
-                use_fast = (not flags[3] and not flags[4]
-                            and ir_FT is None
-                            # a fixed nonzero tau seed (scat_guess, or a
-                            # scattering run's degenerate subint group)
-                            # still needs the scattering kernel
-                            and not np.any(theta0[idx][:, 3] != 0.0)
-                            and use_fast_fit_default())
-                if use_fast:
-                    r = fit_portrait_batch_fast(
-                        jnp.asarray(ports[idx], jnp.float32),
-                        # host numpy template: lets the harmonic-window
-                        # 'auto' derivation see the model's spectrum
-                        # (fit.portrait.resolve_harmonic_window)
-                        np.asarray(modelx, np.float32),
-                        jnp.asarray(noise[idx], jnp.float32),
-                        jnp.asarray(freqs0, jnp.float32),
-                        jnp.asarray(d.Ps[ok][idx], jnp.float32),
-                        jnp.asarray(nu_fit_arr[idx], jnp.float32),
-                        nu_out=nu_ref_DM,
-                        theta0=jnp.asarray(theta0[idx], jnp.float32),
-                        fit_flags=FitFlags(*flags),
-                        chan_masks=jnp.asarray(masks[idx], jnp.float32),
-                        max_iter=max_iter,
-                        bounds=bounds,
-                    )
+                # per-subint fit reference frequency (pplib.py:2715-2729)
+                if nu_fits is not None:
+                    nu_fit_arr = np.full(nok, float(nu_fits[0]))
                 else:
-                    # fit_portrait_batch canonicalizes f64 -> f32 on TPU
-                    # backends itself (c128 spectra do not compile there)
-                    r = fit_portrait_batch(
-                        jnp.asarray(ports[idx]),
-                        jnp.asarray(np.broadcast_to(modelx,
-                                                    ports[idx].shape)),
-                        jnp.asarray(noise[idx]),
-                        jnp.asarray(freqs0),
-                        jnp.asarray(d.Ps[ok][idx]),
-                        jnp.asarray(nu_fit_arr[idx]),
-                        nu_out=nu_ref_DM,
-                        theta0=jnp.asarray(theta0[idx]),
-                        fit_flags=FitFlags(*flags),
-                        chan_masks=jnp.asarray(masks[idx]),
-                        # unconditional: a degenerate (phase-only) group
-                        # in a log10 scattering run still carries its
-                        # fixed tau seed in log10 space, and the engine
-                        # must decode it that way (log10_tau is already
-                        # False whenever fit_scat is off)
-                        log10_tau=log10_tau,
-                        max_iter=max_iter,
-                        ir_FT=ir_FT,
-                        bounds=bounds,
-                    )
-                r = {k: np.asarray(v) for k, v in r._asdict().items()}
-                fit_duration += time.time() - tfit
-                for k_res, k_arr in (
-                        ("phi", "phi"), ("phi_err", "phi_err"),
-                        ("DM", "DM"), ("DM_err", "DM_err"),
-                        ("GM", "GM"), ("GM_err", "GM_err"),
-                        ("tau", "tau"), ("tau_err", "tau_err"),
-                        ("alpha", "alpha"), ("alpha_err", "alpha_err"),
-                        ("nu_DM", "nu_DM"), ("nu_GM", "nu_GM"),
-                        ("nu_tau", "nu_tau"), ("snr", "snr"),
-                        ("chi2", "chi2"), ("dof", "dof")):
-                    res_arrays[k_arr][idx] = r[k_res]
-                res_arrays["nfeval"][idx] = r["nfeval"]
-                res_arrays["rc"][idx] = r["return_code"]
-                scales_arr[idx] = r["scales"] * masks[idx]
-                scale_errs_arr[idx] = r["scale_errs"] * masks[idx]
-                channel_snrs_arr[idx] = r["channel_snrs"] * masks[idx]
-                covs[idx] = r["covariance"]
+                    nu_fit_arr = snr_weighted_nu_fit(snrs_chan, freqs0)
 
-            # guard rail for the bf16 cross-spectrum default: warn
-            # (once per process) when this archive's channel S/N
-            # leaves the calibrated regime
-            from ..fit.portrait import warn_bf16_high_snr
-            with np.errstate(invalid="ignore"):
-                warn_bf16_high_snr(float(np.nanmax(
-                    channel_snrs_arr, initial=0.0)), quiet=quiet)
+                # initial tau guess [rot at nu_fit]; "auto" = data-driven
+                # broadband estimate per subint (|X| is phase-invariant, so
+                # no alignment needed first) — cuts the scattering fit's
+                # Newton evals severalfold vs the neutral seed
+                tau0, alpha0 = scat_seed_tau0(
+                    scat_guess, fit_scat, nok, nbin, P_mean, nu_fit_arr,
+                    self.model.gauss.alpha if self.model.is_gaussian
+                    else scattering_alpha,
+                    ports=ports, modelx=modelx, noise=noise, masks=masks)
 
-            # user-requested tau output reference (reference -nu_tau;
-            # None keeps each fit's zero-covariance frequency)
-            if fit_scat and nu_ref_tau is not None:
-                tau_r, tau_err_r = reref_tau(
-                    res_arrays["tau"], res_arrays["tau_err"],
-                    res_arrays["nu_tau"], nu_ref_tau, res_arrays["alpha"])
-                res_arrays["tau"], res_arrays["tau_err"] = tau_r, tau_err_r
-                res_arrays["nu_tau"] = np.full(nok, float(nu_ref_tau))
+                theta0 = np.zeros((nok, 5))
+                theta0[:, 1] = DM_guess
+                theta0[:, 3] = (np.log10(np.maximum(tau0, 1e-12))
+                                if log10_tau else tau0)
+                theta0[:, 4] = alpha0
 
-            # ---- per-subint host post-processing --------------------------
-            phis = np.full(nsub, np.nan)
-            phi_errs = np.full(nsub, np.nan)
-            TOAs_arr = [None] * nsub
-            TOA_errs = np.full(nsub, np.nan)
-            DMs = np.full(nsub, np.nan)
-            DM_errs = np.full(nsub, np.nan)
-            GMs = np.full(nsub, np.nan)
-            GM_errs = np.full(nsub, np.nan)
-            taus = np.full(nsub, np.nan)
-            tau_errs = np.full(nsub, np.nan)
-            alphas = np.full(nsub, np.nan)
-            alpha_errs = np.full(nsub, np.nan)
-            snrs_sub = np.full(nsub, np.nan)
-            red_chi2s = np.full(nsub, np.nan)
-            nfevals = np.zeros(nsub, int)
-            rcs = np.full(nsub, -2, int)
-            nu_refs_sub = np.full((nsub, 3), np.nan)
-            scales_full = np.zeros((nsub, nchan))
-            scale_errs_full = np.zeros((nsub, nchan))
-            channel_snrs_full = np.zeros((nsub, nchan))
-            covariances = np.zeros((nsub, 5, 5))
-            profile_fluxes = np.zeros((nsub, nchan))
-            profile_flux_errs = np.zeros((nsub, nchan))
-            fluxes = np.full(nsub, np.nan)
-            flux_errs = np.full(nsub, np.nan)
-            flux_freqs = np.full(nsub, np.nan)
-            MJDs = np.full(nsub, np.nan)
+                # group subints by effective fit flags (degenerate-geometry
+                # fallbacks, pptoas.py:519-527)
+                nchx = masks.sum(axis=1).astype(int)
+                base = (True, bool(fit_DM), bool(fit_GM), bool(fit_scat),
+                        bool(fit_scat and not fix_alpha))
+                groups = {}
+                for i in range(nok):
+                    groups.setdefault(
+                        effective_fit_flags(nchx[i], base), []).append(i)
 
-            for j, isub in enumerate(ok):
-                phi = float(res_arrays["phi"][j])
-                P = float(d.Ps[isub])
-                epoch = d.epochs[isub]
-                toa_mjd = epoch.add_seconds(phi * P + d.backend_delay)
-                df = float(d.doppler_factors[isub]) if bary else 1.0
-                DM_j, GM_j = doppler_corrected_DM_GM(
-                    float(res_arrays["DM"][j]), float(res_arrays["GM"][j]),
-                    df, self.fit_flags[1], self.fit_flags[2], bary)
+                # instrumental-response FT for this archive's layout
+                # (pptoas.py:428-434): product of configured achromatic
+                # kernels and, optionally, per-channel DM-smearing sincs
+                ir_FT = build_instrumental_response_FT(
+                    self.instrumental_response_dict, freqs0, nbin,
+                    DM_guess, P_mean, bw=d.bw)
 
-                phis[isub] = phi
-                phi_errs[isub] = res_arrays["phi_err"][j]
-                TOAs_arr[isub] = toa_mjd
-                TOA_errs[isub] = res_arrays["phi_err"][j] * P * 1e6
-                DMs[isub] = DM_j
-                DM_errs[isub] = res_arrays["DM_err"][j]
-                GMs[isub] = GM_j
-                GM_errs[isub] = res_arrays["GM_err"][j]
-                taus[isub] = res_arrays["tau"][j]
-                tau_errs[isub] = res_arrays["tau_err"][j]
-                alphas[isub] = res_arrays["alpha"][j]
-                alpha_errs[isub] = res_arrays["alpha_err"][j]
-                snrs_sub[isub] = res_arrays["snr"][j]
-                dof = max(float(res_arrays["dof"][j]), 1.0)
-                red_chi2s[isub] = res_arrays["chi2"][j] / dof
-                nfevals[isub] = res_arrays["nfeval"][j]
-                rcs[isub] = res_arrays["rc"][j]
-                nu_refs_sub[isub] = (res_arrays["nu_DM"][j],
-                                     res_arrays["nu_GM"][j],
-                                     res_arrays["nu_tau"][j])
-                scales_full[isub] = scales_arr[j]
-                scale_errs_full[isub] = scale_errs_arr[j]
-                channel_snrs_full[isub] = channel_snrs_arr[j]
-                covariances[isub] = covs[j]
-                MJDs[isub] = toa_mjd.to_float()
+                fit_duration = 0.0
+                res_arrays = {k: np.full(nok, np.nan) for k in
+                              ("phi", "phi_err", "DM", "DM_err", "GM", "GM_err",
+                               "tau", "tau_err", "alpha", "alpha_err", "nu_DM",
+                               "nu_GM", "nu_tau", "snr", "chi2", "dof")}
+                res_arrays["nfeval"] = np.zeros(nok, int)
+                res_arrays["rc"] = np.full(nok, -2, int)
+                scales_arr = np.zeros((nok, nchan))
+                scale_errs_arr = np.zeros((nok, nchan))
+                channel_snrs_arr = np.zeros((nok, nchan))
+                covs = np.zeros((nok, 5, 5))
 
-                # flux estimate (pptoas.py:595-624).  The reference
-                # rebuilds the scattered model here, but the one-sided
-                # exponential kernel has unit DC gain (B_0 = 1), so the
-                # model CHANNEL MEANS — the only model quantity flux
-                # uses — are unchanged by any fitted tau; the rebuild
-                # was pure waste (one FFT round-trip per subint).
-                if print_flux:
+                for flags, idx in groups.items():
+                    idx = np.asarray(idx, int)
+                    nfit_calls += 1
+                    tfit = time.time()
+                    # no-scattering fits route through the complex-free f32
+                    # fast path on TPU backends, where complex FFTs are
+                    # unsupported/unusably slow (config.use_fast_fit)
+                    use_fast = (not flags[3] and not flags[4]
+                                and ir_FT is None
+                                # a fixed nonzero tau seed (scat_guess, or a
+                                # scattering run's degenerate subint group)
+                                # still needs the scattering kernel
+                                and not np.any(theta0[idx][:, 3] != 0.0)
+                                and use_fast_fit_default())
+                    if use_fast:
+                        r = fit_portrait_batch_fast(
+                            jnp.asarray(ports[idx], jnp.float32),
+                            # host numpy template: lets the harmonic-window
+                            # 'auto' derivation see the model's spectrum
+                            # (fit.portrait.resolve_harmonic_window)
+                            np.asarray(modelx, np.float32),
+                            jnp.asarray(noise[idx], jnp.float32),
+                            jnp.asarray(freqs0, jnp.float32),
+                            jnp.asarray(d.Ps[ok][idx], jnp.float32),
+                            jnp.asarray(nu_fit_arr[idx], jnp.float32),
+                            nu_out=nu_ref_DM,
+                            theta0=jnp.asarray(theta0[idx], jnp.float32),
+                            fit_flags=FitFlags(*flags),
+                            chan_masks=jnp.asarray(masks[idx], jnp.float32),
+                            max_iter=max_iter,
+                            bounds=bounds,
+                        )
+                    else:
+                        # fit_portrait_batch canonicalizes f64 -> f32 on TPU
+                        # backends itself (c128 spectra do not compile there)
+                        r = fit_portrait_batch(
+                            jnp.asarray(ports[idx]),
+                            jnp.asarray(np.broadcast_to(modelx,
+                                                        ports[idx].shape)),
+                            jnp.asarray(noise[idx]),
+                            jnp.asarray(freqs0),
+                            jnp.asarray(d.Ps[ok][idx]),
+                            jnp.asarray(nu_fit_arr[idx]),
+                            nu_out=nu_ref_DM,
+                            theta0=jnp.asarray(theta0[idx]),
+                            fit_flags=FitFlags(*flags),
+                            chan_masks=jnp.asarray(masks[idx]),
+                            # unconditional: a degenerate (phase-only) group
+                            # in a log10 scattering run still carries its
+                            # fixed tau seed in log10 space, and the engine
+                            # must decode it that way (log10_tau is already
+                            # False whenever fit_scat is off)
+                            log10_tau=log10_tau,
+                            max_iter=max_iter,
+                            ir_FT=ir_FT,
+                            bounds=bounds,
+                        )
+                    r = {k: np.asarray(v) for k, v in r._asdict().items()}
+                    fit_duration += time.time() - tfit
+                    for k_res, k_arr in (
+                            ("phi", "phi"), ("phi_err", "phi_err"),
+                            ("DM", "DM"), ("DM_err", "DM_err"),
+                            ("GM", "GM"), ("GM_err", "GM_err"),
+                            ("tau", "tau"), ("tau_err", "tau_err"),
+                            ("alpha", "alpha"), ("alpha_err", "alpha_err"),
+                            ("nu_DM", "nu_DM"), ("nu_GM", "nu_GM"),
+                            ("nu_tau", "nu_tau"), ("snr", "snr"),
+                            ("chi2", "chi2"), ("dof", "dof")):
+                        res_arrays[k_arr][idx] = r[k_res]
+                    res_arrays["nfeval"][idx] = r["nfeval"]
+                    res_arrays["rc"][idx] = r["return_code"]
+                    scales_arr[idx] = r["scales"] * masks[idx]
+                    scale_errs_arr[idx] = r["scale_errs"] * masks[idx]
+                    channel_snrs_arr[idx] = r["channel_snrs"] * masks[idx]
+                    covs[idx] = r["covariance"]
+
+                if tracer.enabled:
+                    tracer.emit("archive_fit", datafile=datafile,
+                                n_ok=nok, fit_s=round(fit_duration, 6))
+                    dofs = np.maximum(res_arrays["dof"], 1.0)
+                    with np.errstate(invalid="ignore"):
+                        # finite() maps NaN/Inf from degenerate fits to
+                        # JSON null (bare NaN tokens break strict readers)
+                        tracer.emit(
+                            "quality", datafile=datafile,
+                            snr=[finite(v, 3) for v in res_arrays["snr"]],
+                            gof=[finite(float(c) / float(s), 4) for c, s in
+                                 zip(res_arrays["chi2"], dofs)],
+                            nfev=[int(v) for v in res_arrays["nfeval"]])
+
+                # guard rail for the bf16 cross-spectrum default: warn
+                # (once per process) when this archive's channel S/N
+                # leaves the calibrated regime
+                from ..fit.portrait import warn_bf16_high_snr
+                with np.errstate(invalid="ignore"):
+                    warn_bf16_high_snr(float(np.nanmax(
+                        channel_snrs_arr, initial=0.0)), quiet=quiet)
+
+                # user-requested tau output reference (reference -nu_tau;
+                # None keeps each fit's zero-covariance frequency)
+                if fit_scat and nu_ref_tau is not None:
+                    tau_r, tau_err_r = reref_tau(
+                        res_arrays["tau"], res_arrays["tau_err"],
+                        res_arrays["nu_tau"], nu_ref_tau, res_arrays["alpha"])
+                    res_arrays["tau"], res_arrays["tau_err"] = tau_r, tau_err_r
+                    res_arrays["nu_tau"] = np.full(nok, float(nu_ref_tau))
+
+                # ---- per-subint host post-processing --------------------------
+                phis = np.full(nsub, np.nan)
+                phi_errs = np.full(nsub, np.nan)
+                TOAs_arr = [None] * nsub
+                TOA_errs = np.full(nsub, np.nan)
+                DMs = np.full(nsub, np.nan)
+                DM_errs = np.full(nsub, np.nan)
+                GMs = np.full(nsub, np.nan)
+                GM_errs = np.full(nsub, np.nan)
+                taus = np.full(nsub, np.nan)
+                tau_errs = np.full(nsub, np.nan)
+                alphas = np.full(nsub, np.nan)
+                alpha_errs = np.full(nsub, np.nan)
+                snrs_sub = np.full(nsub, np.nan)
+                red_chi2s = np.full(nsub, np.nan)
+                nfevals = np.zeros(nsub, int)
+                rcs = np.full(nsub, -2, int)
+                nu_refs_sub = np.full((nsub, 3), np.nan)
+                scales_full = np.zeros((nsub, nchan))
+                scale_errs_full = np.zeros((nsub, nchan))
+                channel_snrs_full = np.zeros((nsub, nchan))
+                covariances = np.zeros((nsub, 5, 5))
+                profile_fluxes = np.zeros((nsub, nchan))
+                profile_flux_errs = np.zeros((nsub, nchan))
+                fluxes = np.full(nsub, np.nan)
+                flux_errs = np.full(nsub, np.nan)
+                flux_freqs = np.full(nsub, np.nan)
+                MJDs = np.full(nsub, np.nan)
+
+                for j, isub in enumerate(ok):
+                    phi = float(res_arrays["phi"][j])
+                    P = float(d.Ps[isub])
+                    epoch = d.epochs[isub]
+                    toa_mjd = epoch.add_seconds(phi * P + d.backend_delay)
+                    df = float(d.doppler_factors[isub]) if bary else 1.0
+                    DM_j, GM_j = doppler_corrected_DM_GM(
+                        float(res_arrays["DM"][j]), float(res_arrays["GM"][j]),
+                        df, self.fit_flags[1], self.fit_flags[2], bary)
+
+                    phis[isub] = phi
+                    phi_errs[isub] = res_arrays["phi_err"][j]
+                    TOAs_arr[isub] = toa_mjd
+                    TOA_errs[isub] = res_arrays["phi_err"][j] * P * 1e6
+                    DMs[isub] = DM_j
+                    DM_errs[isub] = res_arrays["DM_err"][j]
+                    GMs[isub] = GM_j
+                    GM_errs[isub] = res_arrays["GM_err"][j]
+                    taus[isub] = res_arrays["tau"][j]
+                    tau_errs[isub] = res_arrays["tau_err"][j]
+                    alphas[isub] = res_arrays["alpha"][j]
+                    alpha_errs[isub] = res_arrays["alpha_err"][j]
+                    snrs_sub[isub] = res_arrays["snr"][j]
+                    dof = max(float(res_arrays["dof"][j]), 1.0)
+                    red_chi2s[isub] = res_arrays["chi2"][j] / dof
+                    nfevals[isub] = res_arrays["nfeval"][j]
+                    rcs[isub] = res_arrays["rc"][j]
+                    nu_refs_sub[isub] = (res_arrays["nu_DM"][j],
+                                         res_arrays["nu_GM"][j],
+                                         res_arrays["nu_tau"][j])
+                    scales_full[isub] = scales_arr[j]
+                    scale_errs_full[isub] = scale_errs_arr[j]
+                    channel_snrs_full[isub] = channel_snrs_arr[j]
+                    covariances[isub] = covs[j]
+                    MJDs[isub] = toa_mjd.to_float()
+
+                    # flux estimate (pptoas.py:595-624).  The reference
+                    # rebuilds the scattered model here, but the one-sided
+                    # exponential kernel has unit DC gain (B_0 = 1), so the
+                    # model CHANNEL MEANS — the only model quantity flux
+                    # uses — are unchanged by any fitted tau; the rebuild
+                    # was pure waste (one FFT round-trip per subint).
+                    if print_flux:
+                        okc = np.asarray(d.ok_ichans[isub], int)
+                        means = modelx.mean(axis=1)
+                        profile_fluxes[isub, okc] = means[okc] * \
+                            scales_full[isub, okc]
+                        profile_flux_errs[isub, okc] = np.abs(means[okc]) * \
+                            scale_errs_full[isub, okc]
+                        fl, fl_err = weighted_mean(profile_fluxes[isub, okc],
+                                                   profile_flux_errs[isub, okc])
+                        ffreq, _ = weighted_mean(freqs0[okc],
+                                                 profile_flux_errs[isub, okc])
+                        fluxes[isub], flux_errs[isub] = fl, fl_err
+                        flux_freqs[isub] = ffreq
+
+                    # ---- TOA flags (pptoas.py:653-707) -----------------------
                     okc = np.asarray(d.ok_ichans[isub], int)
-                    means = modelx.mean(axis=1)
-                    profile_fluxes[isub, okc] = means[okc] * \
-                        scales_full[isub, okc]
-                    profile_flux_errs[isub, okc] = np.abs(means[okc]) * \
-                        scale_errs_full[isub, okc]
-                    fl, fl_err = weighted_mean(profile_fluxes[isub, okc],
-                                               profile_flux_errs[isub, okc])
-                    ffreq, _ = weighted_mean(freqs0[okc],
-                                             profile_flux_errs[isub, okc])
-                    fluxes[isub], flux_errs[isub] = fl, fl_err
-                    flux_freqs[isub] = ffreq
+                    freqsx = freqs0[okc]
+                    toa_flags = {}
+                    DM_out, DM_err_out = DM_j, float(DM_errs[isub])
+                    if not self.fit_flags[1]:
+                        DM_out = DM_err_out = None
+                    if self.fit_flags[2]:
+                        toa_flags["gm"] = GM_j
+                        toa_flags["gm_err"] = float(GM_errs[isub])
+                    if self.fit_flags[3]:
+                        # nu_ref_tau=None: the array-level reref above
+                        # already applied any user-requested reference
+                        toa_flags.update(scattering_toa_flags(
+                            float(res_arrays["tau"][j]),
+                            float(res_arrays["tau_err"][j]),
+                            float(res_arrays["nu_tau"][j]),
+                            float(res_arrays["alpha"][j]),
+                            float(res_arrays["alpha_err"][j]), P, df,
+                            log10_tau, bool(self.fit_flags[4])))
+                    toa_flags["be"] = d.backend
+                    toa_flags["fe"] = d.frontend
+                    toa_flags["f"] = f"{d.frontend}_{d.backend}"
+                    toa_flags["nbin"] = int(nbin)
+                    toa_flags["nch"] = int(nchan)
+                    toa_flags["nchx"] = int(len(freqsx))
+                    toa_flags["bw"] = float(freqsx.max() - freqsx.min()) \
+                        if len(freqsx) else 0.0
+                    toa_flags["chbw"] = abs(float(d.bw)) / nchan
+                    toa_flags["subint"] = int(isub)
+                    toa_flags["tobs"] = float(d.subtimes[isub])
+                    toa_flags["fratio"] = float(freqsx.max() / freqsx.min()) \
+                        if len(freqsx) else 1.0
+                    toa_flags["tmplt"] = self.modelfile
+                    toa_flags["snr"] = float(res_arrays["snr"][j])
+                    if nu_ref_DM is None and self.fit_flags[1]:
+                        toa_flags["phi_DM_cov"] = float(covs[j][0, 1])
+                    toa_flags["gof"] = float(red_chi2s[isub])
+                    if quality_flags:
+                        # per-TOA fit diagnostics from res_arrays (-snr
+                        # and -gof are always present above); OFF by
+                        # default so golden .tim files stay byte-identical
+                        toa_flags["nfev"] = int(res_arrays["nfeval"][j])
+                        toa_flags["chi2"] = float(res_arrays["chi2"][j])
+                    if print_phase:
+                        toa_flags["phs"] = phi
+                        toa_flags["phs_err"] = float(phi_errs[isub])
+                    if print_flux:
+                        toa_flags["flux"] = float(fluxes[isub])
+                        toa_flags["flux_err"] = float(flux_errs[isub])
+                        toa_flags["flux_ref_freq"] = float(flux_freqs[isub])
+                    if print_parangle:
+                        toa_flags["par_angle"] = \
+                            float(d.parallactic_angles[isub])
+                    toa_flags.update(addtnl_toa_flags)
+                    self.TOA_list.append(TOA(
+                        datafile, float(res_arrays["nu_DM"][j]), toa_mjd,
+                        float(TOA_errs[isub]), d.telescope, d.telescope_code,
+                        DM_out, DM_err_out, toa_flags))
 
-                # ---- TOA flags (pptoas.py:653-707) -----------------------
-                okc = np.asarray(d.ok_ichans[isub], int)
-                freqsx = freqs0[okc]
-                toa_flags = {}
-                DM_out, DM_err_out = DM_j, float(DM_errs[isub])
-                if not self.fit_flags[1]:
-                    DM_out = DM_err_out = None
-                if self.fit_flags[2]:
-                    toa_flags["gm"] = GM_j
-                    toa_flags["gm_err"] = float(GM_errs[isub])
-                if self.fit_flags[3]:
-                    # nu_ref_tau=None: the array-level reref above
-                    # already applied any user-requested reference
-                    toa_flags.update(scattering_toa_flags(
-                        float(res_arrays["tau"][j]),
-                        float(res_arrays["tau_err"][j]),
-                        float(res_arrays["nu_tau"][j]),
-                        float(res_arrays["alpha"][j]),
-                        float(res_arrays["alpha_err"][j]), P, df,
-                        log10_tau, bool(self.fit_flags[4])))
-                toa_flags["be"] = d.backend
-                toa_flags["fe"] = d.frontend
-                toa_flags["f"] = f"{d.frontend}_{d.backend}"
-                toa_flags["nbin"] = int(nbin)
-                toa_flags["nch"] = int(nchan)
-                toa_flags["nchx"] = int(len(freqsx))
-                toa_flags["bw"] = float(freqsx.max() - freqsx.min()) \
-                    if len(freqsx) else 0.0
-                toa_flags["chbw"] = abs(float(d.bw)) / nchan
-                toa_flags["subint"] = int(isub)
-                toa_flags["tobs"] = float(d.subtimes[isub])
-                toa_flags["fratio"] = float(freqsx.max() / freqsx.min()) \
-                    if len(freqsx) else 1.0
-                toa_flags["tmplt"] = self.modelfile
-                toa_flags["snr"] = float(res_arrays["snr"][j])
-                if nu_ref_DM is None and self.fit_flags[1]:
-                    toa_flags["phi_DM_cov"] = float(covs[j][0, 1])
-                toa_flags["gof"] = float(red_chi2s[isub])
-                if print_phase:
-                    toa_flags["phs"] = phi
-                    toa_flags["phs_err"] = float(phi_errs[isub])
-                if print_flux:
-                    toa_flags["flux"] = float(fluxes[isub])
-                    toa_flags["flux_err"] = float(flux_errs[isub])
-                    toa_flags["flux_ref_freq"] = float(flux_freqs[isub])
-                if print_parangle:
-                    toa_flags["par_angle"] = \
-                        float(d.parallactic_angles[isub])
-                toa_flags.update(addtnl_toa_flags)
-                self.TOA_list.append(TOA(
-                    datafile, float(res_arrays["nu_DM"][j]), toa_mjd,
-                    float(TOA_errs[isub]), d.telescope, d.telescope_code,
-                    DM_out, DM_err_out, toa_flags))
+                # ---- per-archive DeltaDM statistics (pptoas.py:713-729) ------
+                DeltaDM_mean, DeltaDM_err = delta_dm_stats(
+                    DMs[ok] - DM0_arch, DM_errs[ok])
+                self.order.append(datafile)
+                self.obs.append(d.telescope_code)
+                self.doppler_fs.append(np.asarray(d.doppler_factors))
+                self.nu0s.append(d.nu0)
+                self.nu_fits.append(nu_fit_arr)
+                self.nu_refs.append(nu_refs_sub)
+                self.ok_isubs.append(ok)
+                self.epochs.append(d.epochs)
+                self.MJDs.append(MJDs)
+                self.Ps.append(np.asarray(d.Ps))
+                self.phis.append(phis)
+                self.phi_errs.append(phi_errs)
+                self.TOAs.append(TOAs_arr)
+                self.TOA_errs.append(TOA_errs)
+                self.DM0s.append(DM0_arch)
+                self.DMs.append(DMs)
+                self.DM_errs.append(DM_errs)
+                self.DeltaDM_means.append(DeltaDM_mean)
+                self.DeltaDM_errs.append(DeltaDM_err)
+                self.GMs.append(GMs)
+                self.GM_errs.append(GM_errs)
+                self.taus.append(taus)
+                self.tau_errs.append(tau_errs)
+                self.alphas.append(alphas)
+                self.alpha_errs.append(alpha_errs)
+                self.scales.append(scales_full)
+                self.scale_errs.append(scale_errs_full)
+                self.snrs.append(snrs_sub)
+                self.channel_snrs.append(channel_snrs_full)
+                self.profile_fluxes.append(profile_fluxes)
+                self.profile_flux_errs.append(profile_flux_errs)
+                self.fluxes.append(fluxes)
+                self.flux_errs.append(flux_errs)
+                self.flux_freqs.append(flux_freqs)
+                self.covariances.append(covariances)
+                self.red_chi2s.append(red_chi2s)
+                self.nfevals.append(nfevals)
+                self.rcs.append(rcs)
+                self.fit_durations.append(fit_duration)
+                if not quiet:
+                    # the load happened inside the archive iterator (maybe
+                    # on the prefetch thread) — count it back into 'total'
+                    tot = (time.time() - t_start
+                           + load_times.get(datafile, 0.0))
+                    med = np.nanmedian(phi_errs[ok]) * np.mean(d.Ps[ok]) * 1e6
+                    log("--------------------------\n"
+                        f"{datafile}\n"
+                        f"~{fit_duration / max(nok, 1):.4f} sec/TOA (fit), "
+                        f"{tot:.2f} sec total\n"
+                        f"Med. TOA error is {med:.3f} us", quiet=quiet)
 
-            # ---- per-archive DeltaDM statistics (pptoas.py:713-729) ------
-            DeltaDM_mean, DeltaDM_err = delta_dm_stats(
-                DMs[ok] - DM0_arch, DM_errs[ok])
-            self.order.append(datafile)
-            self.obs.append(d.telescope_code)
-            self.doppler_fs.append(np.asarray(d.doppler_factors))
-            self.nu0s.append(d.nu0)
-            self.nu_fits.append(nu_fit_arr)
-            self.nu_refs.append(nu_refs_sub)
-            self.ok_isubs.append(ok)
-            self.epochs.append(d.epochs)
-            self.MJDs.append(MJDs)
-            self.Ps.append(np.asarray(d.Ps))
-            self.phis.append(phis)
-            self.phi_errs.append(phi_errs)
-            self.TOAs.append(TOAs_arr)
-            self.TOA_errs.append(TOA_errs)
-            self.DM0s.append(DM0_arch)
-            self.DMs.append(DMs)
-            self.DM_errs.append(DM_errs)
-            self.DeltaDM_means.append(DeltaDM_mean)
-            self.DeltaDM_errs.append(DeltaDM_err)
-            self.GMs.append(GMs)
-            self.GM_errs.append(GM_errs)
-            self.taus.append(taus)
-            self.tau_errs.append(tau_errs)
-            self.alphas.append(alphas)
-            self.alpha_errs.append(alpha_errs)
-            self.scales.append(scales_full)
-            self.scale_errs.append(scale_errs_full)
-            self.snrs.append(snrs_sub)
-            self.channel_snrs.append(channel_snrs_full)
-            self.profile_fluxes.append(profile_fluxes)
-            self.profile_flux_errs.append(profile_flux_errs)
-            self.fluxes.append(fluxes)
-            self.flux_errs.append(flux_errs)
-            self.flux_freqs.append(flux_freqs)
-            self.covariances.append(covariances)
-            self.red_chi2s.append(red_chi2s)
-            self.nfevals.append(nfevals)
-            self.rcs.append(rcs)
-            self.fit_durations.append(fit_duration)
-            if not quiet:
-                # the load happened inside the archive iterator (maybe
-                # on the prefetch thread) — count it back into 'total'
-                tot = (time.time() - t_start
-                       + load_times.get(datafile, 0.0))
-                print("--------------------------")
-                print(datafile)
-                print(f"~{fit_duration / max(nok, 1):.4f} sec/TOA (fit), "
-                      f"{tot:.2f} sec total")
-                med = np.nanmedian(phi_errs[ok]) * np.mean(d.Ps[ok]) * 1e6
-                print(f"Med. TOA error is {med:.3f} us")
+            if tracer.enabled:
+                done = self.fit_durations[narch_before:]
+                tracer.emit("run_end", driver="GetTOAs.get_TOAs",
+                            n_toas=len(self.TOA_list) - ntoa_before,
+                            n_archives=len(self.order) - narch_before,
+                            nfit=nfit_calls, fit_s=round(sum(done), 6))
+        finally:
+            # an exception mid-campaign (or Ctrl-C) must
+            # still leave a closed, counter-bearing trace —
+            # same stance as the stream/ipta drivers
+            if own_tracer:
+                tracer.close()
 
     # ------------------------------------------------------------------
     def get_narrowband_TOAs(self, datafile=None, tscrunch=False,
@@ -836,7 +899,7 @@ class GetTOAs:
                 d = load_data(datafile, dedisperse=False, dededisperse=True,
                               tscrunch=tscrunch, pscrunch=True, quiet=quiet)
             except Exception as e:
-                print(f"Skipping {datafile}: {e}")
+                log(f"Skipping {datafile}: {e}", level="warn")
                 continue
             ok = np.asarray(d.ok_isubs, int)
             if len(ok) == 0:
@@ -970,7 +1033,7 @@ class GetTOAs:
                               dededisperse=True, tscrunch=tscrunch,
                               pscrunch=True, quiet=quiet)
             except Exception as e:
-                print(f"Skipping {datafile}: {e}")
+                log(f"Skipping {datafile}: {e}", level="warn")
                 continue
             ok = np.asarray(d.ok_isubs, int)
             if len(ok) == 0:
@@ -1056,8 +1119,8 @@ class GetTOAs:
         if (algorithm != "PGS" or kwargs) and not (quiet or self.quiet):
             ignored = ([f"algorithm={algorithm!r}"] if algorithm != "PGS"
                        else []) + [f"{k}=..." for k in kwargs]
-            print("get_psrchive_TOAs: ignoring PSRCHIVE-specific "
-                  f"option(s) {', '.join(ignored)}")
+            log("get_psrchive_TOAs: ignoring PSRCHIVE-specific "
+                f"option(s) {', '.join(ignored)}")
         return self.get_crosscheck_TOAs(
             datafile=datafile, tscrunch=tscrunch,
             addtnl_toa_flags=addtnl_toa_flags, quiet=quiet)

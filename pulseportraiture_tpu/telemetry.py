@@ -1,0 +1,635 @@
+"""Campaign telemetry: structured event tracing, run manifests, and
+the ``pptrace`` report (ISSUE 5 tentpole).
+
+The benchmark side of this repo has a strong profiling discipline
+(profiling.py stage attribution, bench gates); this module is the
+*production* counterpart: when a million-TOA campaign streams across K
+chips, the operator needs to see which device got which bucket, where
+the in-flight queues saturated, which archives stalled the in-order
+checkpoint writer, what the K-compile cold start cost, and how fit
+quality (reduced chi^2, nfev, S/N) drifted — without re-running
+anything under a profiler.
+
+Three layers:
+
+- **Tracer** — a thread-safe, append-only JSONL event writer plus a
+  counters/gauges registry.  One file per run; the FIRST record is a
+  versioned *manifest* (schema version, jax backend + device list, a
+  config snapshot of every ``config.env_overrides()``-controlled knob)
+  so traces are self-describing; the LAST record dumps the counters.
+  Disabled mode (the default — ``config.telemetry_path`` is None) is a
+  module singleton whose methods are no-ops and whose ``enabled`` flag
+  lets hot paths skip even building the event dict, so the off cost is
+  one attribute read per instrumentation site.  Timestamps are taken
+  only around calls that already block (dispatch drains, file IO) —
+  tracing never adds a host sync to the device hot path.
+- **Instrumentation** lives in the campaign drivers
+  (pipeline/stream.py, pipeline/toas.py, pipeline/ipta.py), which emit
+  the event vocabulary validated by :func:`validate_trace`.
+- **Report** — :func:`report` (CLI: ``tools/pptrace.py`` or
+  ``python -m pulseportraiture_tpu.telemetry report``) turns a trace
+  into a device-utilization timeline, per-device busy/idle fractions,
+  queue-depth statistics vs ``stream_max_inflight``, checkpoint
+  straggler/stall analysis, cold-start (compile) accounting, and
+  quality histograms.
+
+The leveled :func:`log` helper also lives here (ISSUE 5 satellite):
+one status-line function that honors ``quiet`` consistently across
+every driver and mirrors its lines into the active trace.
+"""
+
+import json
+import math
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["TRACE_SCHEMA_VERSION", "Tracer", "NULL_TRACER",
+           "resolve_tracer", "log", "finite", "load_trace",
+           "validate_trace", "report", "main"]
+
+TRACE_SCHEMA_VERSION = 1
+
+# Config knobs snapshotted into every manifest: the full set
+# config.env_overrides() can touch, plus the dispatch-routing knobs a
+# trace reader needs to interpret device/queue numbers.
+CONFIG_SNAPSHOT_KEYS = (
+    "cross_spectrum_dtype", "dft_precision", "dft_fold", "align_device",
+    "stream_devices", "stream_max_inflight", "telemetry_path",
+    "use_fast_fit", "use_matmul_dft", "fit_harmonic_window",
+    "scatter_compensated",
+)
+
+# The event vocabulary: type -> fields REQUIRED beyond (type, t).
+# Extra fields are allowed (forward-compatible); unknown types are NOT
+# (validate_trace exists to catch event-shape drift when the executor
+# changes — see tests/test_bench_smoke.py).
+EVENT_FIELDS = {
+    "manifest": {"schema", "run", "t0_unix", "backend", "devices",
+                 "config"},
+    "log": {"level", "msg"},
+    "resume_skip": {"n_skipped"},
+    "archive_skip": {"datafile", "reason"},
+    "archive_prepare": {"iarch", "datafile", "n_ok", "n_subints",
+                        "prep_s"},
+    "archive_load": {"datafile", "load_s"},
+    "archive_fit": {"datafile", "n_ok", "fit_s"},
+    "dispatch": {"seq", "device", "shape", "n", "queue_depth", "cold"},
+    "dispatched": {"seq", "device"},
+    "drain": {"seq", "device", "wait_s", "scatter_s"},
+    "quality": {"snr", "gof", "nfev"},
+    "archive_done": {"iarch", "datafile"},
+    "ckpt_flush": {"iarch", "datafile", "n_toas", "lag"},
+    "force_flush": {"datafile", "lag"},
+    "run_end": {"driver", "n_toas", "nfit"},
+    "campaign_start": {"n_jobs", "pid", "nproc"},
+    "pulsar_done": {"pulsar", "n_toas", "nfit"},
+    "campaign_end": {"n_toas", "nfit", "wall_s"},
+    "counters": {"counters", "gauges"},
+}
+
+
+def finite(value, ndigits=None):
+    """Round a float for an event payload, mapping NaN/Inf to None
+    (JSON null) — json.dumps would otherwise write bare ``NaN`` tokens
+    that strict JSON consumers (jq, log pipelines) reject.  Degenerate
+    fits DO produce NaN chi2/snr, so quality emits route through
+    here."""
+    value = float(value)
+    if not math.isfinite(value):
+        return None
+    return round(value, ndigits) if ndigits is not None else value
+
+
+def _jsonable(obj):
+    """json.dumps default= hook: numpy scalars/arrays -> plain Python.
+    Device objects and anything else fall back to str."""
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    return str(obj)
+
+
+class Tracer:
+    """Append-only JSONL event trace for one campaign run.
+
+    Thread-safe: the streaming executor has one dispatch worker per
+    device plus prefetch threads, and all of them emit (worker-side
+    ``dispatched`` completions arrive via Future callbacks).  A single
+    lock serializes writes; events carry their own monotonic ``t``
+    (seconds since the manifest), so near-simultaneous events from
+    different threads may appear a few microseconds out of ``t`` order
+    in the file — readers sort on ``t`` when they care.
+
+    The manifest (first record) makes the trace self-describing:
+    schema version, the run label, wall-clock anchor, jax backend and
+    local device list, and a snapshot of every env-overridable config
+    knob.  ``close()`` appends the counters/gauges registry as the
+    final record.
+    """
+
+    enabled = True
+
+    def __init__(self, path, run="run"):
+        from . import config
+
+        self.path = str(path)
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._counters = {}
+        self._gauges = {}
+        self._seq = 0
+        self._closed = False
+        # one-level rotation: a killed run's trace is crash forensics
+        # (events flush per emit exactly so they survive), and resume
+        # re-resolves the same telemetry path — truncating here would
+        # destroy the record of what was in flight when the run died
+        try:
+            if os.path.getsize(self.path) > 0:
+                os.replace(self.path, self.path + ".prev")
+        except OSError:
+            pass  # no previous trace
+        self._fh = open(self.path, "w")
+        try:
+            import jax
+            backend = jax.default_backend()
+            devices = [str(d) for d in jax.local_devices()]
+        except Exception:  # trace even when jax is broken/absent
+            backend, devices = "unknown", []
+        manifest = {
+            "schema": TRACE_SCHEMA_VERSION,
+            "run": str(run),
+            "t0_unix": time.time(),
+            "backend": backend,
+            "devices": devices,
+            "config": {k: getattr(config, k, None)
+                       for k in CONFIG_SNAPSHOT_KEYS},
+        }
+        self.emit("manifest", **manifest)
+
+    # -- event + registry API -----------------------------------------
+    def emit(self, type, **fields):
+        """Append one event record.  ``t`` is seconds since the
+        manifest (monotonic clock)."""
+        fields["type"] = type
+        fields["t"] = round(time.perf_counter() - self._t0, 6)
+        line = json.dumps(fields, separators=(",", ":"),
+                          default=_jsonable) + "\n"
+        with self._lock:
+            if self._closed:
+                return
+            self._fh.write(line)
+            self._fh.flush()  # crash-visible: a killed run keeps its
+            # events on disk (the same stance as the .tim checkpoints)
+
+    def next_seq(self):
+        """Trace-global dispatch sequence number.  The TRACER owns the
+        counter (not the executor): several executors can share one
+        trace — stream_ipta_campaign runs one per pulsar — and the
+        report pairs dispatch/dispatched/drain events by seq, so seqs
+        must be unique across the whole file."""
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+            return seq
+
+    def counter(self, name, inc=1):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + inc
+
+    def gauge_max(self, name, value):
+        """High-water-mark gauge (e.g. peak queue depth)."""
+        with self._lock:
+            if value > self._gauges.get(name, float("-inf")):
+                self._gauges[name] = value
+
+    def close(self):
+        """Write the counters record and close the file (idempotent).
+        The counters write and the closed flag flip under ONE lock
+        acquisition: a straggling worker-thread emit (e.g. a
+        ``dispatched`` Future callback on an aborted run) either lands
+        before the counters record or is dropped — it can never
+        interleave after it, so the counters record is always last."""
+        with self._lock:
+            if self._closed:
+                return
+            rec = {"type": "counters",
+                   "t": round(time.perf_counter() - self._t0, 6),
+                   "counters": dict(self._counters),
+                   "gauges": dict(self._gauges)}
+            self._fh.write(json.dumps(rec, separators=(",", ":"),
+                                      default=_jsonable) + "\n")
+            self._closed = True
+            self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        # events flush per emit, so a tracer dropped on an exception
+        # path loses nothing on disk; this just releases the fd (and
+        # appends the counters record when the interpreter still can)
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class _NullTracer:
+    """The disabled tracer: every method is a no-op and ``enabled`` is
+    False so instrumentation sites can skip building event payloads
+    entirely — the telemetry-off cost of the streaming hot path is one
+    attribute read per dispatch."""
+
+    enabled = False
+    path = None
+
+    def emit(self, type, **fields):
+        pass
+
+    def next_seq(self):
+        return 0  # never emitted, so uniqueness is moot
+
+    def counter(self, name, inc=1):
+        pass
+
+    def gauge_max(self, name, value):
+        pass
+
+    def close(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+
+NULL_TRACER = _NullTracer()
+
+
+def resolve_tracer(arg=None, run="run"):
+    """Resolve a driver's ``telemetry=`` argument to ``(tracer,
+    owned)``.
+
+    ``arg`` may be an existing Tracer (shared — e.g.
+    stream_ipta_campaign threads ONE tracer through every per-pulsar
+    stream call so the whole campaign lands in one trace; not owned,
+    the caller closes it), a path (a new trace is opened; owned), or
+    None (``config.telemetry_path`` decides; the NULL tracer when that
+    is unset — the default).  Owned tracers must be closed by the
+    caller that resolved them."""
+    if isinstance(arg, (Tracer, _NullTracer)):
+        return arg, False
+    if arg is None:
+        from . import config
+        arg = getattr(config, "telemetry_path", None)
+    if not arg:
+        return NULL_TRACER, False
+    return Tracer(arg, run=run), True
+
+
+# ---------------------------------------------------------------------------
+# Leveled status logging (ISSUE 5 satellite): the drivers' bare
+# print() lines applied `quiet` inconsistently (load_for_toas defaults
+# quiet=True, the driver classes quiet=False, and skip/fail messages
+# ignored it entirely).  One helper, one rule.
+# ---------------------------------------------------------------------------
+
+def log(msg, quiet=False, level="info", tracer=None):
+    """Driver status line.
+
+    ``info`` honors ``quiet`` and goes to stdout (progress/summary
+    lines).  ``warn`` goes to stderr and is NEVER suppressed —
+    skip/fail reasons must not vanish just because a campaign runs
+    quiet (and they are mirrored into the trace regardless, so a quiet
+    campaign still records why an archive was dropped).  When a tracer
+    is given the line is also recorded as a ``log`` event."""
+    if level not in ("info", "warn"):
+        raise ValueError(f"log level must be 'info' or 'warn', "
+                         f"got {level!r}")
+    if tracer is not None and tracer.enabled:
+        tracer.emit("log", level=level, msg=str(msg))
+    if level == "warn":
+        print(msg, file=sys.stderr)
+    elif not quiet:
+        print(msg)
+
+
+# ---------------------------------------------------------------------------
+# Trace reading / validation
+# ---------------------------------------------------------------------------
+
+def load_trace(path):
+    """Read a trace -> (manifest, events).  Raises ValueError on a
+    malformed file (no manifest, bad JSON).  Events keep file order
+    (writes are lock-serialized; ``t`` is per-event)."""
+    records = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{lineno}: bad JSON: {e}")
+            records.append(rec)
+    if not records or records[0].get("type") != "manifest":
+        raise ValueError(f"{path}: first record is not a manifest")
+    return records[0], records[1:]
+
+
+def validate_trace(path):
+    """Validate a trace against the schema: manifest first, known
+    schema version, every event of a known type with its required
+    fields.  Returns (manifest, events); raises ValueError naming the
+    first offending record.  This is the drift guard the bench smoke
+    test runs whenever the executor changes."""
+    manifest, events = load_trace(path)
+    if manifest.get("schema") != TRACE_SCHEMA_VERSION:
+        raise ValueError(
+            f"{path}: schema {manifest.get('schema')!r} != supported "
+            f"{TRACE_SCHEMA_VERSION}")
+    missing = EVENT_FIELDS["manifest"] - set(manifest)
+    if missing:
+        raise ValueError(f"{path}: manifest missing {sorted(missing)}")
+    for i, ev in enumerate(events, 2):
+        etype = ev.get("type")
+        if etype not in EVENT_FIELDS:
+            raise ValueError(f"{path}: record {i}: unknown event type "
+                             f"{etype!r}")
+        if "t" not in ev:
+            raise ValueError(f"{path}: record {i}: no timestamp")
+        missing = EVENT_FIELDS[etype] - set(ev)
+        if missing:
+            raise ValueError(f"{path}: record {i} ({etype}): missing "
+                             f"{sorted(missing)}")
+    return manifest, events
+
+
+# ---------------------------------------------------------------------------
+# pptrace report
+# ---------------------------------------------------------------------------
+
+def _merge_intervals(spans):
+    """Union length-preserving merge of (start, end) intervals."""
+    merged = []
+    for s, e in sorted(spans):
+        if merged and s <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], e))
+        else:
+            merged.append((s, e))
+    return merged
+
+
+def _hist_lines(values, nbins=8, width=32, fmt="{:.3g}"):
+    """Text histogram rows for the quality section."""
+    values = np.asarray(values, float)
+    values = values[np.isfinite(values)]
+    if values.size == 0:
+        return ["  (no samples)"]
+    lo, hi = float(values.min()), float(values.max())
+    if lo == hi:
+        return [f"  {fmt.format(lo)} x{values.size}"]
+    counts, edges = np.histogram(values, bins=nbins, range=(lo, hi))
+    peak = max(int(counts.max()), 1)
+    rows = []
+    for c, e0, e1 in zip(counts, edges[:-1], edges[1:]):
+        bar = "#" * max(1 if c else 0, round(width * c / peak))
+        rows.append(f"  {fmt.format(e0):>10} .. {fmt.format(e1):<10} "
+                    f"|{bar:<{width}}| {int(c)}")
+    return rows
+
+
+def _timeline(busy_by_dev, t_end, width=60):
+    """ASCII device-utilization timeline: one row per device, '#'
+    where the device had at least one dispatch in flight."""
+    rows = []
+    t_end = max(t_end, 1e-9)
+    for dev in sorted(busy_by_dev):
+        cells = [" "] * width
+        for s, e in busy_by_dev[dev]:
+            i0 = min(int(s / t_end * width), width - 1)
+            i1 = min(int(e / t_end * width), width - 1)
+            for i in range(i0, i1 + 1):
+                cells[i] = "#"
+        rows.append(f"  dev{dev} |{''.join(cells)}|")
+    return rows
+
+
+def report(path, file=None):
+    """Analyze a trace and print the pptrace report.  Returns the
+    summary dict (what the tests — and scripts — consume); the printed
+    text is the same numbers, human-shaped."""
+    out = file or sys.stdout
+    manifest, events = validate_trace(path)
+    by_type = {}
+    for ev in events:
+        by_type.setdefault(ev["type"], []).append(ev)
+
+    p = lambda s="": print(s, file=out)  # noqa: E731
+    p(f"== pptrace report: {path} ==")
+    p(f"run {manifest['run']!r}  schema {manifest['schema']}  "
+      f"backend {manifest['backend']}  "
+      f"{len(manifest['devices'])} local device(s)")
+    cfg = manifest.get("config", {})
+    p("config: " + ", ".join(f"{k}={cfg[k]!r}" for k in sorted(cfg)))
+
+    # ---- dispatch/drain bookkeeping ---------------------------------
+    dispatches = by_type.get("dispatch", [])
+    drains = {ev["seq"]: ev for ev in by_type.get("drain", [])}
+    done = {ev["seq"]: ev for ev in by_type.get("dispatched", [])}
+    per_dev = {}
+    busy_by_dev = {}
+    t_end = max((ev["t"] for ev in events), default=0.0)
+    cold_s = warm_s = 0.0
+    n_cold = n_warm = 0
+    for ev in dispatches:
+        dev = ev["device"]
+        d = per_dev.setdefault(dev, {"dispatches": 0, "subints": 0,
+                                     "shapes": set()})
+        d["dispatches"] += 1
+        d["subints"] += ev["n"]
+        d["shapes"].add(ev["shape"])
+        drain = drains.get(ev["seq"])
+        end_t = drain["t"] if drain else ev["t"]
+        busy_by_dev.setdefault(dev, []).append((ev["t"], end_t))
+        w = done.get(ev["seq"])
+        if w is None:
+            # no worker completion recorded (non-Future handle, or the
+            # run died before the callback) — counting it as 0 s warm
+            # would dilute avg_warm and inflate the compile estimate
+            continue
+        worker_s = w["t"] - ev["t"]
+        if ev.get("cold"):
+            n_cold += 1
+            cold_s += worker_s
+        else:
+            n_warm += 1
+            warm_s += worker_s
+    for dev in busy_by_dev:
+        busy_by_dev[dev] = _merge_intervals(busy_by_dev[dev])
+
+    nfit_run = None
+    peak_run = None
+    run_ends = by_type.get("run_end", [])
+    if run_ends:
+        nfit_run = sum(ev["nfit"] for ev in run_ends)
+        peak_run = max(ev.get("peak_inflight", 0) for ev in run_ends)
+
+    p("")
+    p("-- devices --")
+    p(f"  {'dev':>4} {'dispatches':>10} {'subints':>8} {'shapes':>7} "
+      f"{'busy_s':>8} {'busy%':>6}")
+    device_counts = {}
+    for dev in sorted(per_dev):
+        d = per_dev[dev]
+        busy = sum(e - s for s, e in busy_by_dev.get(dev, []))
+        frac = busy / t_end if t_end > 0 else 0.0
+        device_counts[dev] = d["dispatches"]
+        p(f"  {dev:>4} {d['dispatches']:>10} {d['subints']:>8} "
+          f"{len(d['shapes']):>7} {busy:>8.3f} {100 * frac:>5.1f}%")
+    total_disp = sum(device_counts.values())
+    tail = f" (run_end nfit {nfit_run})" if nfit_run is not None else ""
+    p(f"  total dispatches {total_disp}{tail}")
+    if busy_by_dev:
+        p(f"  timeline over {t_end:.3f} s ('#' = >=1 dispatch in "
+          "flight):")
+        for row in _timeline(busy_by_dev, t_end):
+            p(row)
+
+    # ---- queue depth ------------------------------------------------
+    depths = [ev["queue_depth"] for ev in dispatches]
+    # effective limit: the executor records its resolved per-call
+    # max_inflight in run_end; the manifest's config snapshot is only
+    # the process default and is wrong when a driver was called with
+    # max_inflight= explicitly
+    limits = [ev["max_inflight"] for ev in run_ends
+              if ev.get("max_inflight")]
+    limit = max(limits) if limits else cfg.get("stream_max_inflight")
+    max_depth = max(depths) if depths else 0
+    p("")
+    p("-- queue depth (at dispatch) --")
+    if depths:
+        sat = sum(1 for d in depths if limit and d >= limit)
+        src = "run" if limits else "config default"
+        p(f"  max {max_depth}  mean {np.mean(depths):.2f}  "
+          f"limit max_inflight={limit} ({src})  "
+          f"saturated dispatches {sat}/{len(depths)}")
+    else:
+        p("  (no dispatches)")
+
+    # ---- checkpoint stalls / stragglers -----------------------------
+    flushes = by_type.get("ckpt_flush", [])
+    forces = by_type.get("force_flush", [])
+    p("")
+    p("-- checkpoint stalls --")
+    if flushes:
+        lags = sorted(flushes, key=lambda ev: -ev["lag"])
+        p(f"  {len(flushes)} in-order flushes; "
+          f"{len(forces)} staleness-horizon force-flushes")
+        for ev in lags[:3]:
+            if ev["lag"] > 0:
+                p(f"  straggler: {ev['datafile']} flushed "
+                  f"{ev['lag']} prepared archive(s) late "
+                  f"({ev['n_toas']} TOAs)")
+        if all(ev["lag"] == 0 for ev in flushes):
+            p("  no archive deferred a checkpoint write")
+    else:
+        p("  (no checkpointing in this run)")
+
+    # ---- cold start / compile accounting ----------------------------
+    p("")
+    p("-- cold start (first dispatch per shape x device: trace + XLA "
+      "compile on the worker) --")
+    if n_cold:
+        avg_warm = warm_s / n_warm if n_warm else 0.0
+        p(f"  {n_cold} cold dispatch(es), {cold_s:.3f} s on workers "
+          f"(warm avg {avg_warm:.4f} s x {n_warm}); est. compile cost "
+          f"~{max(cold_s - avg_warm * n_cold, 0.0):.3f} s")
+    else:
+        p("  (no dispatch events)")
+
+    # ---- quality ----------------------------------------------------
+    qual = by_type.get("quality", [])
+    snr = [v for ev in qual for v in ev["snr"]]
+    gof = [v for ev in qual for v in ev["gof"]]
+    nfev = [v for ev in qual for v in ev["nfev"]]
+    p("")
+    p(f"-- fit quality ({len(snr)} TOA records) --")
+    for name, vals in (("snr", snr), ("gof (chi2/dof)", gof),
+                       ("nfev", nfev)):
+        p(f"  {name}:")
+        for row in _hist_lines(vals):
+            p(row)
+
+    skips = by_type.get("archive_skip", [])
+    if skips:
+        p("")
+        p(f"-- skipped archives ({len(skips)}) --")
+        for ev in skips[:10]:
+            p(f"  {ev['datafile']}: {ev['reason']}")
+
+    counters = {}
+    gauges = {}
+    if by_type.get("counters"):
+        counters = by_type["counters"][-1]["counters"]
+        gauges = by_type["counters"][-1]["gauges"]
+
+    return {
+        "manifest": manifest,
+        "device_counts": device_counts,
+        "total_dispatches": total_disp,
+        "nfit": nfit_run,
+        "max_queue_depth": max_depth,
+        "peak_inflight": (gauges.get("peak_inflight")
+                          if gauges else peak_run),
+        "n_cold": n_cold,
+        "cold_s": cold_s,
+        "n_quality": len(snr),
+        "n_force_flush": len(forces),
+        "n_skipped": len(skips),
+        "counters": counters,
+        "gauges": gauges,
+    }
+
+
+def main(argv=None):
+    """``python -m pulseportraiture_tpu.telemetry {report,validate}
+    trace.jsonl`` — the same entry tools/pptrace.py wraps."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="pptrace",
+        description="Analyze a pulseportraiture_tpu campaign trace "
+                    "(JSONL, written via config.telemetry_path / "
+                    "PPT_TELEMETRY / pptoas --telemetry).")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    rp = sub.add_parser("report", help="print the full trace report")
+    rp.add_argument("trace", help="trace .jsonl path")
+    vp = sub.add_parser("validate",
+                        help="schema-check a trace and exit")
+    vp.add_argument("trace", help="trace .jsonl path")
+    args = p.parse_args(argv)
+    if args.cmd == "validate":
+        manifest, events = validate_trace(args.trace)
+        print(f"{args.trace}: ok (schema {manifest['schema']}, "
+              f"{len(events)} events)")
+        return 0
+    report(args.trace)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

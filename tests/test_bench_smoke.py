@@ -32,7 +32,12 @@ TINY_ENV = {
                      # multi-device mode: the suite runs with 8
                      # virtual CPU devices, so the 1->2 sweep really
                      # exercises the round-robin executor
-                     "PPT_DEVICES": "2"},
+                     "PPT_DEVICES": "2",
+                     # telemetry rides along (resolved to a tmp path
+                     # below): the emitted trace must validate against
+                     # the schema, so event-shape drift in the
+                     # executor fails HERE, not in a user's campaign
+                     "PPT_TELEMETRY": ""},
     "bench_campaign": {"PPT_NARCH": "2", "PPT_NSUB": "2",
                        "PPT_NCHAN": "16", "PPT_NBIN": "128",
                        "PPT_CAMPAIGN_CACHE": ""},
@@ -41,7 +46,8 @@ TINY_ENV = {
 }
 
 _CONFIG_KEYS = ("dft_precision", "cross_spectrum_dtype", "dft_fold",
-                "scatter_compensated", "fit_harmonic_window")
+                "scatter_compensated", "fit_harmonic_window",
+                "telemetry_path")
 
 
 def test_all_bench_scripts_covered():
@@ -56,6 +62,8 @@ def test_bench_smoke(name, monkeypatch, capsys, tmp_path):
     for k, v in TINY_ENV[name].items():
         if k == "PPT_CAMPAIGN_CACHE":
             v = str(tmp_path / "cache")
+        elif k == "PPT_TELEMETRY":
+            v = str(tmp_path / "trace.jsonl")
         monkeypatch.setenv(k, v)
     saved = {k: getattr(config, k) for k in _CONFIG_KEYS}
     mod = importlib.import_module(f"benchmarks.{name}")
@@ -84,3 +92,20 @@ def test_bench_smoke(name, monkeypatch, capsys, tmp_path):
             assert f"stage_{stage}_ms" in out, stage
         assert out["attributed_frac"] > 0
         assert "scaling_ok" in out and "attrib_ok" in out
+        # ISSUE 5: the bench ran its sweep with telemetry enabled
+        # (PPT_TELEMETRY above) — the emitted trace must validate
+        # against the schema, so executor/event-shape drift is caught
+        # by CI the moment it lands
+        from pulseportraiture_tpu import telemetry
+
+        trace = str(tmp_path / "trace.jsonl")
+        assert os.path.exists(trace), "bench_stream emitted no trace"
+        manifest, events = telemetry.validate_trace(trace)
+        assert manifest["run"] == "stream_wideband_TOAs"
+        etypes = {e["type"] for e in events}
+        for needed in ("dispatch", "drain", "quality",
+                       "archive_prepare", "run_end"):
+            assert needed in etypes, needed
+        dispatches = [e for e in events if e["type"] == "dispatch"]
+        last_run = [e for e in events if e["type"] == "run_end"][-1]
+        assert len(dispatches) >= last_run["nfit"]

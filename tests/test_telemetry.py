@@ -1,0 +1,348 @@
+"""Campaign telemetry (ISSUE 5): trace round-trip and schema, counter
+consistency with the executor's returned accounting, disabled-mode
+zero-output, byte-identity of campaign output with telemetry on/off
+across device counts, the pptrace report, the PPT_TELEMETRY /
+unknown-PPT_* env hooks, and the optional per-TOA quality flags.
+
+Shapes are deliberately tiny (8 chan x 64 bin, 3 archives x 2 subints)
+and the traced 8-device campaign runs ONCE per module — tier-1 runs
+close to its time cap."""
+
+import json
+import os
+
+import pytest
+
+from pulseportraiture_tpu import config, telemetry
+from pulseportraiture_tpu.io import write_gmodel
+from pulseportraiture_tpu.pipeline import GetTOAs, stream_wideband_TOAs
+from pulseportraiture_tpu.synth import default_test_model, make_fake_pulsar
+from pulseportraiture_tpu.utils.mjd import MJD
+
+PAR = {"PSR": "J1744-1134", "RAJ": "17:44:29.4", "DECJ": "-11:34:54.6",
+       "P0": 0.004074, "PEPOCH": 55000.0, "DM": 3.139}
+
+
+@pytest.fixture(scope="module")
+def campaign(tmp_path_factory):
+    root = tmp_path_factory.mktemp("telemetry")
+    model = default_test_model(1500.0)
+    gmodel = str(root / "model.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files = []
+    for i in range(3):
+        path = str(root / f"ep{i}.fits")
+        make_fake_pulsar(model, PAR, outfile=path, nsub=2, nchan=8,
+                         nbin=64, nu0=1500.0, bw=400.0, tsub=60.0,
+                         phase=0.03 * i, dDM=1e-4 * i,
+                         start_MJD=MJD(55900 + 5 * i, 0.1),
+                         noise_stds=0.05, dedispersed=False, quiet=True,
+                         rng=700 + i)
+        files.append(path)
+    return files, gmodel
+
+
+@pytest.fixture(scope="module")
+def traced_run(campaign, tmp_path_factory):
+    """ONE 8-device streaming run with telemetry on (plus the 1-device
+    telemetry-off reference) shared by the round-trip / report /
+    byte-identity tests below."""
+    files, gmodel = campaign
+    root = tmp_path_factory.mktemp("traced")
+    trace = str(root / "trace.jsonl")
+    tim8 = str(root / "d8.tim")
+    tim1 = str(root / "d1.tim")
+    res1 = stream_wideband_TOAs(files, gmodel, nsub_batch=2,
+                                stream_devices=1, tim_out=tim1,
+                                quiet=True)  # telemetry OFF
+    res8 = stream_wideband_TOAs(files, gmodel, nsub_batch=2,
+                                stream_devices=8, tim_out=tim8,
+                                telemetry=trace, quiet=True)
+    return dict(files=files, trace=trace, tim1=tim1, tim8=tim8,
+                res1=res1, res8=res8)
+
+
+def test_trace_round_trip_schema_and_ordering(traced_run):
+    """Manifest first (versioned, self-describing), counters last,
+    every event of a known type with its required fields, event
+    timeline consistent (dispatch before its drain)."""
+    manifest, events = telemetry.validate_trace(traced_run["trace"])
+    assert manifest["schema"] == telemetry.TRACE_SCHEMA_VERSION
+    assert manifest["run"] == "stream_wideband_TOAs"
+    assert manifest["backend"] == "cpu"
+    assert len(manifest["devices"]) == 8
+    # config snapshot names every env_overrides()-controlled knob
+    for key in ("stream_devices", "stream_max_inflight",
+                "cross_spectrum_dtype", "dft_precision"):
+        assert key in manifest["config"], key
+    assert events[-1]["type"] == "counters"
+    disp = {e["seq"]: e for e in events if e["type"] == "dispatch"}
+    drain = {e["seq"]: e for e in events if e["type"] == "drain"}
+    assert set(disp) == set(drain)  # every dispatch drained
+    for seq, d in drain.items():
+        assert d["t"] >= disp[seq]["t"]
+        assert d["device"] == disp[seq]["device"]
+    # per-archive lifecycle: 3 prepares, 3 assemblies, 3 in-order
+    # checkpoint flushes
+    for etype in ("archive_prepare", "archive_done", "ckpt_flush"):
+        assert sum(e["type"] == etype for e in events) == 3, etype
+
+
+def test_trace_counters_match_executor_accounting(traced_run):
+    """The acceptance criterion: per-device bucket counts sum to the
+    executor's nfit and the max recorded queue depth equals its
+    peak_inflight."""
+    res8 = traced_run["res8"]
+    manifest, events = telemetry.validate_trace(traced_run["trace"])
+    dispatches = [e for e in events if e["type"] == "dispatch"]
+    per_dev = {}
+    for e in dispatches:
+        per_dev[e["device"]] = per_dev.get(e["device"], 0) + 1
+    assert sum(per_dev.values()) == res8.nfit
+    assert len(per_dev) == res8.devices_used > 1
+    assert max(e["queue_depth"] for e in dispatches) == \
+        res8.peak_inflight
+    counters = events[-1]["counters"]
+    assert counters["dispatches"] == res8.nfit
+    assert sum(v for k, v in counters.items()
+               if k.startswith("dispatches_dev")) == res8.nfit
+    assert events[-1]["gauges"]["peak_inflight"] == res8.peak_inflight
+    # every fitted TOA got a quality record
+    nq = sum(len(e["snr"]) for e in events if e["type"] == "quality")
+    assert nq == len(res8.TOA_list)
+    # first dispatch per (shape, device) is marked cold
+    cold = [(e["shape"], e["device"]) for e in dispatches if e["cold"]]
+    assert len(cold) == len(set(cold)) == len(
+        {(e["shape"], e["device"]) for e in dispatches})
+
+
+def test_telemetry_output_byte_identical(traced_run):
+    """Telemetry on (8 devices) vs off (1 device) must not perturb the
+    campaign output by one byte."""
+    with open(traced_run["tim1"], "rb") as f1, \
+            open(traced_run["tim8"], "rb") as f8:
+        assert f1.read() == f8.read()
+    res1, res8 = traced_run["res1"], traced_run["res8"]
+    assert len(res1.TOA_list) == len(res8.TOA_list) == 6
+    for ta, tb in zip(res1.TOA_list, res8.TOA_list):
+        assert (ta.MJD.day, ta.MJD.frac) == (tb.MJD.day, tb.MJD.frac)
+        assert ta.flags == tb.flags
+
+
+def test_pptrace_report_smoke(traced_run, capsys):
+    """The report renders every section and its summary dict agrees
+    with the executor (what tools/pptrace.py prints)."""
+    summary = telemetry.report(traced_run["trace"])
+    out = capsys.readouterr().out
+    for section in ("pptrace report", "-- devices --", "timeline",
+                    "-- queue depth", "-- checkpoint stalls --",
+                    "-- cold start", "-- fit quality"):
+        assert section in out, section
+    res8 = traced_run["res8"]
+    assert summary["total_dispatches"] == res8.nfit
+    assert sum(summary["device_counts"].values()) == res8.nfit
+    assert summary["max_queue_depth"] == res8.peak_inflight
+    assert summary["peak_inflight"] == res8.peak_inflight
+    assert summary["n_quality"] == len(res8.TOA_list)
+    # the module CLI entry drives the same code
+    assert telemetry.main(["validate", traced_run["trace"]]) == 0
+
+
+def test_disabled_mode_emits_nothing(campaign, tmp_path, monkeypatch):
+    """Default-off: no tracer object is created, no file is written,
+    and the null tracer's enabled flag lets hot paths skip payload
+    construction entirely."""
+    monkeypatch.setattr(config, "telemetry_path", None)
+    tr, owned = telemetry.resolve_tracer(None)
+    assert tr is telemetry.NULL_TRACER and not owned
+    assert not tr.enabled
+    tr.emit("dispatch", anything=1)  # all no-ops
+    tr.counter("x")
+    tr.gauge_max("y", 3)
+    tr.close()
+    files, gmodel = campaign
+    before = set(os.listdir(tmp_path))
+    gt = GetTOAs(files[:1], gmodel, quiet=True)
+    gt.get_TOAs(quiet=True, max_iter=25)
+    assert set(os.listdir(tmp_path)) == before  # nothing appeared
+    # a shared tracer is never closed by the driver that borrowed it
+    tr2, owned2 = telemetry.resolve_tracer(
+        telemetry.Tracer(str(tmp_path / "t.jsonl"), run="x"))
+    assert not owned2
+    tr2.close()
+
+
+def test_gettoas_trace_and_quality_flags(campaign, tmp_path):
+    """GetTOAs emits per-archive load/fit events and per-TOA quality
+    records from res_arrays; quality_flags=True adds -nfev/-chi2 to
+    the .tim lines and stays off by default (golden files
+    byte-identical)."""
+    from pulseportraiture_tpu.io.tim import toa_string
+
+    files, gmodel = campaign
+    trace = str(tmp_path / "gt.jsonl")
+    gt = GetTOAs(files[:2], gmodel, quiet=True)
+    gt.get_TOAs(quiet=True, max_iter=25, telemetry=trace,
+                quality_flags=True)
+    manifest, events = telemetry.validate_trace(trace)
+    types = [e["type"] for e in events]
+    assert types.count("archive_load") == 2
+    assert types.count("archive_fit") == 2
+    qual = [e for e in events if e["type"] == "quality"]
+    assert sum(len(e["snr"]) for e in qual) == len(gt.TOA_list)
+    ends = [e for e in events if e["type"] == "run_end"]
+    assert ends and ends[-1]["n_toas"] == len(gt.TOA_list)
+    for i, toa in enumerate(gt.TOA_list):
+        line = toa_string(toa)
+        assert " -nfev " in line and " -chi2 " in line, line
+        iarch = files[:2].index(toa.archive)
+        isub = toa.flags["subint"]
+        assert toa.flags["nfev"] == int(gt.nfevals[iarch][isub])
+        # chi2 = gof * dof: consistent with the always-present -gof
+        assert toa.flags["chi2"] / max(
+            gt.red_chi2s[iarch][isub], 1e-300) == pytest.approx(
+            round(toa.flags["chi2"] / gt.red_chi2s[iarch][isub]),
+            rel=1e-6)  # dof is an integer
+    # default off: flag set unchanged
+    gt2 = GetTOAs(files[:2], gmodel, quiet=True)
+    gt2.get_TOAs(quiet=True, max_iter=25)
+    for toa in gt2.TOA_list:
+        assert "nfev" not in toa.flags and "chi2" not in toa.flags
+
+
+def test_stream_quality_flags(campaign):
+    """The streaming lane's quality_flags mirrors GetTOAs' (same flag
+    names, sourced from the packed results) and defaults off."""
+    files, gmodel = campaign
+    a = stream_wideband_TOAs(files[:1], gmodel, nsub_batch=2,
+                             stream_devices=1, quiet=True,
+                             quality_flags=True)
+    for toa in a.TOA_list:
+        assert isinstance(toa.flags["nfev"], int)
+        assert toa.flags["chi2"] > 0.0
+    b = stream_wideband_TOAs(files[:1], gmodel, nsub_batch=2,
+                             stream_devices=1, quiet=True)
+    for toa in b.TOA_list:
+        assert "nfev" not in toa.flags and "chi2" not in toa.flags
+
+
+def test_ipta_campaign_single_trace(campaign, tmp_path):
+    """stream_ipta_campaign threads ONE tracer through every
+    per-pulsar stream call: campaign + per-pulsar rollups + the
+    per-bucket dispatch records all land in one valid trace."""
+    from pulseportraiture_tpu.pipeline.ipta import (IPTAJob,
+                                                    stream_ipta_campaign)
+
+    files, gmodel = campaign
+    trace = str(tmp_path / "ipta.jsonl")
+    out = stream_ipta_campaign(
+        [IPTAJob("FAKE", files[:2], gmodel),
+         IPTAJob("FAKE2", files[2:], gmodel)],
+        outdir=str(tmp_path / "tims"), quiet=True, nsub_batch=2,
+        telemetry=trace)
+    manifest, events = telemetry.validate_trace(trace)
+    assert manifest["run"] == "stream_ipta_campaign"
+    types = [e["type"] for e in events]
+    assert types[0] == "campaign_start"
+    assert types.count("pulsar_done") == 2 and "campaign_end" in types
+    pds = {e["pulsar"]: e for e in events if e["type"] == "pulsar_done"}
+    assert set(pds) == {"FAKE", "FAKE2"}
+    assert sum(e["nfit"] for e in pds.values()) == out.nfit
+    # dispatch seqs must be UNIQUE across the per-pulsar executors
+    # sharing this trace (the report pairs drain events by seq)
+    seqs = [e["seq"] for e in events if e["type"] == "dispatch"]
+    assert len(seqs) == len(set(seqs)) == out.nfit > 1
+    drains = [e["seq"] for e in events if e["type"] == "drain"]
+    assert sorted(drains) == sorted(seqs)
+    end = [e for e in events if e["type"] == "campaign_end"][0]
+    assert end["n_toas"] == len(out.TOA_list)
+    telemetry.report(trace, file=open(os.devnull, "w"))  # still renders
+
+
+def test_env_hooks_and_unknown_ppt_warning(monkeypatch, capsys):
+    """PPT_TELEMETRY rides env_overrides ('off' disables explicitly);
+    an unrecognized PPT_*-prefixed NAME warns once to stderr with a
+    did-you-mean hint — a typo like PPT_STREAM_DEVICE was previously
+    silently inert while PPT_STREAM_DEVICES changes behavior."""
+    old = config.telemetry_path
+    try:
+        monkeypatch.setenv("PPT_TELEMETRY", "/tmp/x.jsonl")
+        assert "telemetry_path" in config.env_overrides()
+        assert config.telemetry_path == "/tmp/x.jsonl"
+        monkeypatch.setenv("PPT_TELEMETRY", "off")
+        config.env_overrides()
+        assert config.telemetry_path is None
+        monkeypatch.delenv("PPT_TELEMETRY")
+
+        monkeypatch.setattr(config, "_warned_unknown_ppt", set())
+        monkeypatch.setenv("PPT_STREAM_DEVICE", "4")  # the typo
+        config.env_overrides()
+        err = capsys.readouterr().err
+        assert "PPT_STREAM_DEVICE" in err
+        assert "PPT_STREAM_DEVICES" in err  # did-you-mean hint
+        config.env_overrides()  # warned ONCE per process
+        assert capsys.readouterr().err == ""
+        # every registered knob passes silently
+        monkeypatch.delenv("PPT_STREAM_DEVICE")
+        monkeypatch.setenv("PPT_NCHAN", "16")
+        config.env_overrides()
+        assert capsys.readouterr().err == ""
+    finally:
+        config.telemetry_path = old
+
+
+def test_log_levels(capsys):
+    """info honors quiet (stdout); warn is never suppressed (stderr);
+    unknown levels refuse."""
+    telemetry.log("hello", quiet=False)
+    telemetry.log("silent", quiet=True)
+    telemetry.log("danger", quiet=True, level="warn")
+    cap = capsys.readouterr()
+    assert "hello" in cap.out and "silent" not in cap.out
+    assert "danger" in cap.err
+    with pytest.raises(ValueError, match="level"):
+        telemetry.log("x", level="debug")
+
+
+def test_validate_trace_rejects_drift(tmp_path):
+    """The schema guard fails loudly on unknown event types, missing
+    required fields, bad versions, and headerless files — the drift
+    net the bench smoke test throws over the executor."""
+    good_manifest = {"type": "manifest", "t": 0.0,
+                     "schema": telemetry.TRACE_SCHEMA_VERSION,
+                     "run": "x", "t0_unix": 0.0, "backend": "cpu",
+                     "devices": [], "config": {}}
+
+    def write(path, records):
+        with open(path, "w") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+        return str(path)
+
+    p = write(tmp_path / "a.jsonl", [good_manifest,
+                                     {"type": "warp", "t": 1.0}])
+    with pytest.raises(ValueError, match="unknown event type"):
+        telemetry.validate_trace(p)
+    p = write(tmp_path / "b.jsonl",
+              [good_manifest,
+               {"type": "dispatch", "t": 1.0, "seq": 0}])
+    with pytest.raises(ValueError, match="missing"):
+        telemetry.validate_trace(p)
+    p = write(tmp_path / "c.jsonl", [dict(good_manifest, schema=99)])
+    with pytest.raises(ValueError, match="schema"):
+        telemetry.validate_trace(p)
+    p = write(tmp_path / "d.jsonl", [{"type": "dispatch", "t": 0.0}])
+    with pytest.raises(ValueError, match="manifest"):
+        telemetry.validate_trace(p)
+    # a trace the drivers actually write passes (tiny hand-rolled one)
+    tr = telemetry.Tracer(str(tmp_path / "e.jsonl"), run="unit")
+    tr.emit("dispatch", seq=0, device=0, shape="8x64:raw", n=2,
+            queue_depth=1, cold=True)
+    tr.emit("drain", seq=0, device=0, wait_s=0.1, scatter_s=0.01)
+    tr.counter("dispatches")
+    tr.gauge_max("peak_inflight", 1)
+    tr.close()
+    manifest, events = telemetry.validate_trace(
+        str(tmp_path / "e.jsonl"))
+    assert events[-1]["counters"] == {"dispatches": 1}
+    assert events[-1]["gauges"] == {"peak_inflight": 1}

@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""pptrace — analyze a pulseportraiture_tpu campaign telemetry trace.
+
+Thin wrapper over ``python -m pulseportraiture_tpu.telemetry``:
+
+    python tools/pptrace.py report  /path/to/trace.jsonl
+    python tools/pptrace.py validate /path/to/trace.jsonl
+
+Traces are written by the campaign drivers when telemetry is enabled
+(``config.telemetry_path``, ``PPT_TELEMETRY=...``, or
+``pptoas --telemetry PATH``); see docs/GUIDE.md "Tracing a campaign".
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from pulseportraiture_tpu.telemetry import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
