@@ -1,16 +1,37 @@
 """Campaign-scale streaming benchmark (BASELINE.md config 5 shape):
 NARCH archives x NSUB subints of NCHAN x NBIN through
-stream_wideband_TOAs, end-to-end (PSRFITS IO -> raw int16 h2d ->
-on-device decode/stats/fit -> .tim assembly).
+stream_wideband_TOAs, end-to-end (PSRFITS IO -> raw h2d -> on-device
+decode/stats/fit -> .tim assembly) — now an A/B over the transfer
+pipeline (ISSUE 6): depth 1 (copy serialized against fit-enqueue, the
+pre-pipeline behavior) vs depth N (double-buffered h2d, default 2 or
+PPT_PIPELINE_DEPTH), asserting byte-identical .tim output across arms.
+
+When PPT_TELEMETRY is set, each arm writes its own trace
+(<path>.d<depth>) and the emitted h2d_start/h2d_done events are
+schema-validated; the JSON line then carries the pptrace-computed link
+stall fraction per arm — the copy-stage drift guard CI runs at tiny
+shapes (tests/test_bench_smoke.py).
+
+A bare CPU host has no link to hide (device_put is a memcpy), so the
+depth A/B measures ~1.0x there.  PPT_TUNNEL_EMU="<mbps>[:<dispatch_ms>]"
+emulates the tunneled-runtime transport this pipeline exists for —
+device_put throttled to <mbps> MB/s and each fused dispatch made
+SYNCHRONOUS with a <dispatch_ms> round-trip floor (default 100, the
+measured tunnel floor; same discipline as bench_stream's virtual
+devices: a CPU-measurable model of the runtime property under study).
+Under emulation depth 1 serializes copy-then-fit per device while
+depth 2 overlaps them, which is exactly the production claim.
 
 The synthetic dataset is generated once into a cache directory (env
 PPT_CAMPAIGN_CACHE, default /tmp/ppt_campaign) and reused across runs —
 generation is host-bound and would otherwise dominate.
 
 Knobs via env: PPT_NARCH (default 200), PPT_NSUB (64), PPT_NCHAN (256),
-PPT_NBIN (1024).  Prints ONE JSON line like bench.py.
+PPT_NBIN (1024), PPT_PIPELINE_DEPTH (deep arm, default 2),
+PPT_TUNNEL_EMU (off by default).  Prints ONE JSON line like bench.py.
 """
 
+import io
 import json
 import os
 import sys
@@ -24,9 +45,11 @@ def main():
     from pulseportraiture_tpu import config
     config.dft_precision = "default"
     config.cross_spectrum_dtype = "bfloat16"
+    config.env_overrides()
 
     import jax
 
+    from pulseportraiture_tpu import telemetry
     from pulseportraiture_tpu.io.gmodel import write_gmodel
     from pulseportraiture_tpu.pipeline.stream import stream_wideband_TOAs
     from pulseportraiture_tpu.synth import default_test_model
@@ -36,11 +59,14 @@ def main():
     NSUB = int(os.environ.get("PPT_NSUB", 64))
     NCHAN = int(os.environ.get("PPT_NCHAN", 256))
     NBIN = int(os.environ.get("PPT_NBIN", 1024))
+    DEEP = max(2, int(config.stream_pipeline_depth))
+    TUNNEL = os.environ.get("PPT_TUNNEL_EMU", "")
     PAR = {"PSR": "FAKE", "P0": 0.003, "DM": 50.0, "PEPOCH": 56000.0}
     cache = os.environ.get("PPT_CAMPAIGN_CACHE", "/tmp/ppt_campaign")
     tag = f"{NARCH}x{NSUB}x{NCHAN}x{NBIN}"
     root = os.path.join(cache, tag)
     os.makedirs(root, exist_ok=True)
+    trace_base = config.telemetry_path  # PPT_TELEMETRY (or None)
 
     mpath = os.path.join(root, "model.gmodel")
     if not os.path.exists(mpath):
@@ -57,24 +83,100 @@ def main():
         files.append(path)
     t_gen = time.perf_counter() - t_gen
 
-    # warm (compile) on one archive, then measure the full campaign
-    stream_wideband_TOAs(files[:1], mpath, nsub_batch=64, quiet=True)
-    t0 = time.perf_counter()
-    res = stream_wideband_TOAs(files, mpath, nsub_batch=64, quiet=True)
-    wall = time.perf_counter() - t0
+    # ---- optional tunneled-transport emulation ----------------------
+    from pulseportraiture_tpu.pipeline import stream as S
+    unpatch = []
+    if TUNNEL:
+        parts = TUNNEL.split(":")
+        mbps = float(parts[0])
+        disp_ms = float(parts[1]) if len(parts) > 1 else 100.0
+        real_put = jax.device_put
 
-    ntoa = len(res.TOA_list)
+        def throttled_put(x, device=None, **kw):
+            out = real_put(x, device, **kw)
+            time.sleep(getattr(x, "nbytes", 0) / (mbps * 1e6))
+            return out
+
+        real_fit_fn = S._raw_fit_fn
+
+        def sync_fit_fn(*a, **kw):
+            fn = real_fit_fn(*a, **kw)
+
+            def run(*args):
+                out = jax.block_until_ready(fn(*args))
+                time.sleep(disp_ms / 1e3)  # tunnel round-trip floor
+                return out
+
+            return run
+
+        jax.device_put = throttled_put
+        S._raw_fit_fn = sync_fit_fn
+        unpatch = [(jax, "device_put", real_put),
+                   (S, "_raw_fit_fn", real_fit_fn)]
+
+    # warm (compile) on one archive, then measure each pipeline arm
+    # over the full campaign; the tunnel-emu patches MUST come off even
+    # if an arm fails (test_bench_smoke runs main() in-process — a
+    # leaked throttled device_put would slow every later test)
+    arms = {}
+    tims = {}
+    try:
+        stream_wideband_TOAs(files[:1], mpath, nsub_batch=64, quiet=True)
+        for depth in (1, DEEP):
+            tim = os.path.join(root, f"bench.d{depth}.tim")
+            trace = f"{trace_base}.d{depth}" if trace_base else None
+            t0 = time.perf_counter()
+            res = stream_wideband_TOAs(files, mpath, nsub_batch=64,
+                                       quiet=True, pipeline_depth=depth,
+                                       tim_out=tim, telemetry=trace)
+            wall = time.perf_counter() - t0
+            arm = {
+                "toas_per_sec": round(len(res.TOA_list) / wall, 2),
+                "wall_s": round(wall, 2),
+                "h2d_bytes": int(res.h2d_bytes),
+                "h2d_s": round(float(res.h2d_duration), 3),
+                "blocked_on_device_fraction": round(
+                    float(res.fit_duration) / wall, 3),
+            }
+            if trace:
+                # schema-validate the emitted trace (h2d events
+                # included) and pull the pptrace link numbers —
+                # event-shape drift in the copy stage fails RIGHT HERE
+                summary = telemetry.report(trace, file=io.StringIO())
+                assert summary["n_h2d"] == res.nfit, (
+                    f"depth {depth}: {summary['n_h2d']} h2d_done events "
+                    f"for {res.nfit} dispatches")
+                assert summary["h2d_bytes"] == res.h2d_bytes
+                arm["link_stall_frac"] = (
+                    round(summary["h2d_stall_frac"], 3)
+                    if summary["h2d_stall_frac"] is not None else None)
+            arms[depth] = arm
+            tims[depth] = open(tim).read()
+            ntoa = len(res.TOA_list)
+            nfit = int(res.nfit)
+    finally:
+        for obj, name, val in unpatch:
+            setattr(obj, name, val)
+
+    assert tims[1] == tims[DEEP], (
+        "pipeline depth changed .tim content — the transfer pipeline "
+        "must only reorder WHEN bytes move")
+
     print(json.dumps({
         "metric": f"streamed campaign TOAs incl. PSRFITS IO, {NARCH} "
-                  f"archives x {NSUB}sub x {NCHAN}ch x {NBIN}bin",
-        "value": round(ntoa / wall, 2),
+                  f"archives x {NSUB}sub x {NCHAN}ch x {NBIN}bin, "
+                  f"transfer pipeline depth {DEEP} (vs 1)",
+        "value": arms[DEEP]["toas_per_sec"],
         "unit": "TOAs/sec",
-        "wall_s": round(wall, 2),
         "gen_s": round(t_gen, 2),
         "toas": ntoa,
-        "dispatches": int(res.nfit),
-        "blocked_on_device_fraction": round(float(res.fit_duration) / wall,
-                                            3),
+        "dispatches": nfit,
+        "pipeline": {str(d): arms[d] for d in arms},
+        "pipeline_speedup": round(
+            arms[DEEP]["toas_per_sec"]
+            / max(arms[1]["toas_per_sec"], 1e-9), 3),
+        "tim_identical": True,
+        "tunnel_emu": TUNNEL or None,
         "device": str(jax.devices()[0]),
     }))
 

@@ -80,6 +80,23 @@ def build_parser():
                         "explicit count).  Output is digit-identical "
                         "for any value. [default: config.stream_devices"
                         " / PPT_STREAM_DEVICES]")
+    p.add_argument("--pipeline-depth", dest="pipeline_depth",
+                   default=None, type=int, metavar="N",
+                   help="With --stream: per-device transfer-pipeline "
+                        "depth — how many buckets may occupy a "
+                        "device's copy->fit pipeline at once (2 "
+                        "double-buffers h2d against in-flight fits, 1 "
+                        "serializes the stages; output is byte-"
+                        "identical for any value). [default: "
+                        "config.stream_pipeline_depth / "
+                        "PPT_PIPELINE_DEPTH]")
+    p.add_argument("--compile-cache", dest="compile_cache",
+                   default=None, metavar="DIR",
+                   help="Persistent jax compilation cache directory: "
+                        "re-runs skip the per-(bucket shape x device) "
+                        "XLA compile cold start.  Also via "
+                        "PPT_COMPILE_CACHE / config.compile_cache_dir."
+                        " [default: off]")
     p.add_argument("--bound", action="append", default=[],
                    metavar="PARAM:LO,HI",
                    help="Box bound on a fit parameter (repeatable): "
@@ -180,6 +197,20 @@ def main(argv=None):
             if stream_devices < 1:
                 raise SystemExit("--stream-devices: count must be "
                                  f">= 1, got {stream_devices}")
+    if args.pipeline_depth is not None:
+        if not args.stream:
+            raise SystemExit("--pipeline-depth requires --stream")
+        if args.pipeline_depth < 1:
+            raise SystemExit("--pipeline-depth: depth must be >= 1, "
+                             f"got {args.pipeline_depth}")
+    if args.compile_cache:
+        # applies to EVERY lane (GetTOAs compiles too); also sets the
+        # config default so spawned helpers resolve the same cache
+        from .. import config
+        from ..utils.device import enable_compile_cache
+
+        config.compile_cache_dir = args.compile_cache
+        enable_compile_cache(args.compile_cache)
 
     if args.quality_flags and args.narrowband:
         raise SystemExit("--quality_flags applies to the wideband "
@@ -215,6 +246,7 @@ def main(argv=None):
             args.datafiles, args.modelfile, fit_scat=args.fit_scat,
             log10_tau=args.log10_tau, scat_guess=scat_guess,
             tscrunch=args.tscrunch, stream_devices=stream_devices,
+            pipeline_depth=args.pipeline_depth,
             print_phase=args.print_phase, addtnl_toa_flags=addtnl,
             telemetry=args.telemetry, quiet=args.quiet)
         if args.format == "princeton":
@@ -245,7 +277,9 @@ def main(argv=None):
             tscrunch=args.tscrunch, fit_scat=args.fit_scat,
             log10_tau=args.log10_tau, scat_guess=scat_guess,
             fix_alpha=args.fix_alpha, addtnl_toa_flags=addtnl,
-            stream_devices=stream_devices, telemetry=args.telemetry,
+            stream_devices=stream_devices,
+            pipeline_depth=args.pipeline_depth,
+            telemetry=args.telemetry,
             quality_flags=args.quality_flags, quiet=args.quiet)
         if args.format == "princeton":
             dDMs = [toa.DM - res.DM0s[res.order.index(toa.archive)]

@@ -141,6 +141,28 @@ stream_devices = "auto"
 # their max_inflight= argument.
 stream_max_inflight = 4
 
+# Per-device transfer-pipeline depth in the streaming drivers: how
+# many buckets may occupy a device's two-stage copy->fit pipeline at
+# once.  The host->device link is the campaign bottleneck on tunneled
+# runtimes, so each device runs a dedicated COPY worker (stack +
+# dtype-convert + device_put) ahead of its FIT worker (program
+# enqueue); depth 2 (default) double-buffers — bucket N+1's h2d runs
+# while bucket N's fused fit executes — and depth 1 serializes the
+# stages (the pre-pipeline behavior, kept as the A/B arm; output is
+# byte-identical for any depth).  Per-driver override via their
+# pipeline_depth= argument.
+stream_pipeline_depth = 2
+
+# jax persistent compilation cache directory (ROADMAP item 5): the
+# streaming drivers pay a trace + XLA compile per (bucket shape x
+# device) on every process start, and a serving fleet re-pays that
+# cold start across its whole shape lattice on every restart.  Set a
+# path to have utils/device.enable_compile_cache() route jax's
+# persistent cache there (created on demand; the stream executor and
+# pptoas enable it automatically when set).  None (default) = off.
+# Telemetry's cold-start events gate the before/after.
+compile_cache_dir = None
+
 # Campaign telemetry (telemetry.py): path of the JSONL event trace the
 # campaign drivers (GetTOAs.get_TOAs, stream_wideband_TOAs /
 # stream_narrowband_TOAs, stream_ipta_campaign) append structured
@@ -227,6 +249,8 @@ RCSTRINGS = {
 #   PPT_ALIGN_DEVICE=off|auto|on    -> align_device
 #   PPT_STREAM_DEVICES=auto|<N>     -> stream_devices
 #   PPT_MAX_INFLIGHT=<N>            -> stream_max_inflight
+#   PPT_PIPELINE_DEPTH=<N>          -> stream_pipeline_depth
+#   PPT_COMPILE_CACHE=<dir>|off     -> compile_cache_dir
 #   PPT_TELEMETRY=<path>|off        -> telemetry_path
 #
 # Unset variables leave the module values untouched; a typo in a
@@ -245,13 +269,13 @@ KNOWN_PPT_ENV = frozenset({
     # config hooks (this module)
     "PPT_XSPEC", "PPT_DFT_PRECISION", "PPT_DFT_FOLD",
     "PPT_ALIGN_DEVICE", "PPT_STREAM_DEVICES", "PPT_MAX_INFLIGHT",
-    "PPT_TELEMETRY",
+    "PPT_PIPELINE_DEPTH", "PPT_COMPILE_CACHE", "PPT_TELEMETRY",
     # benchmark / smoke-test shape and mode knobs
     "PPT_NB", "PPT_NE", "PPT_NPSR", "PPT_NARCH", "PPT_NSUB",
     "PPT_NSUBB", "PPT_NCHAN", "PPT_NBIN", "PPT_NITER", "PPT_K",
     "PPT_DEVICES", "PPT_CAMPAIGN_CACHE", "PPT_ALIGN_CACHE",
     "PPT_TEMPLATE_NOISE", "PPT_STREAM_SPEEDUP_GATE",
-    "PPT_HARMONIC_WINDOW",
+    "PPT_HARMONIC_WINDOW", "PPT_TUNNEL_EMU",
 })
 
 _warned_unknown_ppt = set()  # warn ONCE per process per variable
@@ -353,6 +377,26 @@ def env_overrides():
                 f"PPT_MAX_INFLIGHT must be >= 1, got {n}")
         cfg.stream_max_inflight = n
         changed.append("stream_max_inflight")
+    pdep = _os.environ.get("PPT_PIPELINE_DEPTH", "")
+    if pdep:
+        try:
+            n = int(pdep)
+        except ValueError:
+            raise ValueError(
+                "PPT_PIPELINE_DEPTH must be a positive integer, got "
+                f"{pdep!r}")
+        if n < 1:
+            raise ValueError(
+                f"PPT_PIPELINE_DEPTH must be >= 1, got {n}")
+        cfg.stream_pipeline_depth = n
+        changed.append("stream_pipeline_depth")
+    cache = _os.environ.get("PPT_COMPILE_CACHE", "")
+    if cache:
+        # 'off'/'none'/'0' disable explicitly (a wrapper script can
+        # force the cache off over a config default)
+        cfg.compile_cache_dir = (
+            None if cache.lower() in ("off", "none", "0") else cache)
+        changed.append("compile_cache_dir")
     tel = _os.environ.get("PPT_TELEMETRY", "")
     if tel:
         # 'off'/'none'/'0' disable explicitly (so a wrapper script can

@@ -572,13 +572,19 @@ def read_archive(path, dtype=np.float64, decode=True):
     loader decodes straight to f32, halving host memory traffic for
     data that feeds the f32 fast fit anyway.
 
-    decode=False (raw streaming mode): requires an int16 DATA column;
-    the Archive's ``amps`` becomes a read-only zero placeholder and the
-    undecoded samples are attached as ``raw_data`` (nsub, npol, nchan,
-    nbin) native-endian int16 with ``raw_scl``/``raw_offs`` (nsub,
-    npol, nchan) float32 — the streaming driver ships these to the
-    accelerator and decodes there, halving host->device bytes.  Raises
-    ValueError for non-int16 layouts (caller falls back to decoding).
+    decode=False (raw streaming mode): requires a DATA column in one
+    of the raw-transportable sample types — int16 (TFORM 'I'),
+    unsigned byte ('B'), signed byte ('B' + the FITS TZERO=-128
+    convention), or float32 ('E').  The Archive's ``amps`` becomes a
+    read-only zero placeholder and the undecoded samples are attached
+    as ``raw_data`` (nsub, npol, nchan, nbin) in the native-endian
+    wire dtype with ``raw_scl``/``raw_offs`` (nsub, npol, nchan)
+    float32 and ``raw_code`` naming the sample type for the device
+    decode (ops/decode.RAW_CODES) — the streaming driver ships these
+    to the accelerator and decodes there, cutting host->device bytes
+    2-4x vs decoded float32.  Raises ValueError for layouts raw mode
+    cannot represent (sub-byte NBIT packing, general TSCAL/TZERO
+    scaling); the caller falls back to decoding.
 
     When the native decoder (io/native.py) is available, the DATA
     column is decoded straight from the wire bytes with DAT_SCL /
@@ -619,22 +625,40 @@ def read_archive(path, dtype=np.float64, decode=True):
     # raw int16 transport and the native kernel read stored values
     data_scaling = subint.col_scaling.get("DATA")
     raw_data = None
+    raw_code = None
     if not decode:
         col_off, code, repeat = subint.layout["DATA"]
         nbin = int(hdr.get("NBIN", 0)) or repeat // (npol * nchan)
-        if (code != "I" or npol * nchan * nbin != repeat
+        # wire dtype + device sample code per TFORM (ops/decode).  'B'
+        # with the FITS signed-byte convention (TSCAL 1, TZERO -128)
+        # ships as-is and the device decode removes the bias exactly;
+        # any OTHER TSCAL/TZERO scaling needs the scaling-aware host
+        # path.
+        wire = {"I": (">i2", np.int16, "i16"),
+                "B": ("u1", np.uint8, "u8"),
+                "E": (">f4", np.float32, "f32")}.get(code)
+        if code == "B" and data_scaling is not None \
+                and float(data_scaling[0]) == 1.0 \
+                and float(data_scaling[1]) == -128.0:
+            wire = ("u1", np.uint8, "i8")
+            data_scaling = None
+        samp = np.dtype(wire[0]).itemsize if wire else 0
+        if (wire is None or npol * nchan * nbin != repeat
                 or data_scaling is not None
-                or col_off + repeat * 2 > subint.row_stride
+                or col_off + repeat * samp > subint.row_stride
                 or len(subint.raw) < nsub * subint.row_stride):
             raise ValueError(
                 f"{path}: raw streaming mode needs a consistent "
-                "unscaled int16 DATA column")
+                "int16/byte/float32 DATA column (unscaled, or the "
+                "signed-byte TZERO convention)")
         rows = np.frombuffer(subint.raw, np.uint8)[
             : nsub * subint.row_stride].reshape(nsub, subint.row_stride)
-        col = np.ascontiguousarray(rows[:, col_off:col_off + repeat * 2])
+        col = np.ascontiguousarray(
+            rows[:, col_off:col_off + repeat * samp])
         # one byteswap/memcpy pass; no float decode anywhere on host
-        raw_data = col.view(">i2").astype(np.int16).reshape(
+        raw_data = col.view(wire[0]).astype(wire[1]).reshape(
             nsub, npol, nchan, nbin)
+        raw_code = wire[2]
         amps = np.broadcast_to(np.float32(0.0), raw_data.shape)
     elif use_native:
         col_off, code, repeat = subint.layout["DATA"]
@@ -735,6 +759,7 @@ def read_archive(path, dtype=np.float64, decode=True):
                    par_angs=par_ang, filename=str(path))
     if raw_data is not None:
         arch.raw_data = raw_data
+        arch.raw_code = raw_code
         arch.raw_scl = scl.astype(np.float32)
         arch.raw_offs = offs.astype(np.float32)
     if polyco is not None and "PERIOD" not in cols:

@@ -59,6 +59,11 @@ from .ism import (
     GM_from_DMc,
     DMc_from_GM,
 )
+from .decode import (
+    RAW_CODES,
+    affine_decode,
+    decode_stokes_I,
+)
 
 __all__ = [
     "cexp",
@@ -99,4 +104,7 @@ __all__ = [
     "dDM",
     "GM_from_DMc",
     "DMc_from_GM",
+    "RAW_CODES",
+    "affine_decode",
+    "decode_stokes_I",
 ]

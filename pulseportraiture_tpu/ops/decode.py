@@ -1,0 +1,72 @@
+"""On-device PSRFITS sample decode (the raw streaming lane's stage 1).
+
+The streaming campaign drivers ship the UNDECODED DATA column payload
+to the accelerator — 2-4x fewer bytes than decoded float32 on a link
+that bottlenecks the whole campaign — and decode there, inside the
+fused bucket program.  These kernels are the single source of truth
+for that decode: the affine sample reconstruction per TFORM sample
+type, and the polarization reduction to Stokes I for multi-pol
+archives.  The host-side oracle is ``io/psrfits.read_archive`` /
+``io/native.decode_fused`` (the FITS fuzz corpus pins its semantics);
+tests assert the two lanes produce digit-identical TOAs.
+
+Sample-type codes (``RAW_CODES``) name the wire format the host
+shipped, after any endian normalization (``io/psrfits`` byteswaps
+int16/float32 to native order — a memcpy pass, no float decode):
+
+  'i16'  int16 samples        (TFORM 'I', the classic PSRFITS layout)
+  'u8'   unsigned byte        (TFORM 'B')
+  'i8'   signed byte          (TFORM 'B' with the FITS TZERO=-128
+         convention: stored unsigned, physical = stored - 128 — the
+         subtraction happens HERE, exactly, before DAT_SCL/DAT_OFFS,
+         matching the host decode order bit-for-bit)
+  'f32'  float32 samples      (TFORM 'E'; DAT_SCL/DAT_OFFS usually
+         identity but applied uniformly anyway)
+"""
+
+import jax.numpy as jnp
+
+from .noise import min_window_baseline
+
+RAW_CODES = ("i16", "u8", "i8", "f32")
+
+
+def affine_decode(raw, scl, offs, ft, code="i16"):
+    """Decode raw samples to physical amplitudes: ``x * scl + offs``
+    per channel, in dtype ``ft``, with the signed-byte bias removed
+    first for code 'i8'.
+
+    raw: (..., nchan, nbin) integer or float samples; scl/offs:
+    (..., nchan) per-channel DAT_SCL/DAT_OFFS.  The operation order
+    (cast, bias, scale, offset) mirrors the host decode exactly so the
+    two lanes agree to the bit in matching precision."""
+    if code not in RAW_CODES:
+        raise ValueError(f"unknown raw sample code {code!r}; "
+                         f"known: {RAW_CODES}")
+    x = raw.astype(ft)
+    if code == "i8":
+        # stored unsigned, TZERO = -128: exact for all 0..255 values
+        x = x - jnp.asarray(128.0, ft)
+    return x * scl[..., None] + offs[..., None]
+
+
+def decode_stokes_I(raw, scl, offs, ft, code="i16", pol_sum=False):
+    """Full decode stage of the fused bucket program: affine sample
+    decode, min-window baseline subtraction, and the polarization
+    reduction to Stokes I.
+
+    pol_sum=False: raw is (nb, nchan, nbin) — a single-pol payload
+    (Intensity data, or the host-sliced Stokes I plane of an IQUV
+    archive, which ships no extra bytes).  pol_sum=True: raw is
+    (nb, 2, nchan, nbin) — the two summand pols of an AA+BB/Coherence
+    archive, decoded and baselined PER POL then summed, matching the
+    host lane's remove_baseline-then-pscrunch order bit-for-bit."""
+    x = affine_decode(raw, scl, offs, ft, code=code)
+    x = x - min_window_baseline(x)[..., None]
+    if pol_sum:
+        if x.ndim < 4:
+            raise ValueError(
+                f"pol_sum needs a (nb, 2, nchan, nbin) payload; got "
+                f"shape {x.shape}")
+        x = x[..., 0, :, :] + x[..., 1, :, :]
+    return x

@@ -18,18 +18,27 @@ levels of overlap keep every resource busy:
   slow chip never stalls its siblings, and .tim checkpoints are
   written in archive order so output is digit-identical to the
   single-device lane;
-- in raw mode the host never decodes the data at all: the int16 DATA
-  column ships to the accelerator as-is (half the bytes of f32 —
-  host->device bandwidth is the campaign bottleneck) and ONE jitted
-  program does decode -> baseline -> noise -> S/N -> nu_fit -> fit,
-  returning a single packed per-subint result array (one small
-  device->host pull per bucket).
+- in raw mode the host never decodes the data at all: the undecoded
+  DATA column ships to the accelerator as-is (2-4x fewer bytes than
+  f32 — host->device bandwidth is the campaign bottleneck) and ONE
+  jitted program does decode -> baseline -> noise -> S/N -> nu_fit ->
+  fit, returning a single packed per-subint result array (one small
+  device->host pull per bucket);
+- each device's h2d copies run on their own COPY worker, double-
+  buffered against the device's FIT worker (_DevicePipeline,
+  config.stream_pipeline_depth): bucket N+1's bytes move while bucket
+  N's fused program executes, so the link and the chip stay busy
+  simultaneously (h2d_start/h2d_done trace events measure it).
 
-Raw mode needs an int16 DATA column and either npol == 1 or an IQUV
-state (Stokes I = pol 0, sliced with no extra bytes); dedispersed-on-
-disk archives are re-dispersed ON DEVICE by the stored DM (host-wrapped
-f64 turns, matmul-DFT rotation).  AA+BB multi-pol or tscrunch fall
-back to the decoded (host-side load_data) lane per archive.
+Raw mode is UNIVERSAL over the PSRFITS sample types (int16, unsigned/
+signed byte, float32 — ops/decode.RAW_CODES) and polarization states:
+npol == 1 ships as-is, IQUV ships only its Stokes-I plane (a host
+index, no extra bytes), AA+BB/Coherence ship their two summand pols
+and the device decode reduces them to Stokes I.  Dedispersed-on-disk
+archives are re-dispersed ON DEVICE by the stored DM (host-wrapped
+f64 turns, matmul-DFT rotation).  Sub-byte NBIT packing, general
+TSCAL/TZERO column scaling, or tscrunch fall back to the decoded
+(host-side load_data) lane per archive.
 
 Scope: campaign configurations — wideband (phi[, DM[, GM]]) fits,
 scattering (fit_scat/log10_tau/scat_guess/fix_alpha as in GetTOAs),
@@ -50,6 +59,7 @@ pptoas.py:258); this is new capability enabled by the batched engine.
 """
 
 import os
+import threading
 import time
 from contextlib import nullcontext as _null_ctx
 from functools import lru_cache
@@ -64,7 +74,7 @@ from ..fit.portrait import (FitFlags, _fast_batch_fn, estimate_tau_batch,
                             use_bf16_cross_spectrum, use_fast_fit_default)
 from ..io.psrfits import read_archive
 from ..io.tim import TOA, write_TOAs
-from ..ops.noise import get_SNR, get_noise_PS, min_window_baseline
+from ..ops.noise import get_SNR, get_noise_PS
 from ..telemetry import NULL_TRACER, finite, log, resolve_tracer
 from ..utils.bunch import DataBunch
 from .models import TemplateModel
@@ -141,16 +151,21 @@ class _Bucket:
 
     kind 'dec': rows are decoded float ports; noise/nu_fit/theta0 are
     computed on host (round-1 lane).  kind 'raw': rows are undecoded
-    int16 with per-channel scl/offs; everything downstream happens in
-    the fused device program."""
+    wire samples (raw_code names the sample type — ops/decode
+    RAW_CODES) with per-channel scl/offs; everything downstream
+    happens in the fused device program.  pol_sum=True raw rows carry
+    the TWO summand pols of an AA+BB/Coherence archive ((2, nchan,
+    nbin) each) and the device decode reduces them to Stokes I."""
 
     def __init__(self, freqs, nbin, modelx, flags, kind="dec",
-                 ir_FT=None):
+                 ir_FT=None, raw_code="i16", pol_sum=False):
         self.freqs = freqs          # (nchan,)
         self.nbin = int(nbin)
         self.modelx = modelx        # (nchan, nbin) template
         self.flags = flags          # effective FitFlags tuple
         self.kind = kind
+        self.raw_code = raw_code    # 'raw': wire sample type
+        self.pol_sum = bool(pol_sum)  # 'raw': device pol0+pol1 sum
         self.ir_FT = ir_FT          # (nchan, nharm) complex or None
         self._hwin = None
         self._hwin_key = object()   # never equals a config value
@@ -246,11 +261,11 @@ class _StreamExecutor:
           per_subint: [(bucket_key, bucket_factory, fill)] — fill(b)
           appends one subint's payload AND its (iarch, isub) owner.
           None skips the archive (prepare prints why).
-      launch(bucket, device, executor) -> (handle, owners, extra) or
-          None — fires one fused dispatch on ``executor``'s worker
-          thread with the bucket's arrays placed on ``device``,
-          snapshots owners, and clears the bucket; handle may be a
-          Future.
+      launch(bucket, pipeline, seq) -> (handle, owners, extra) or
+          None — admits one fused dispatch into ``pipeline`` (the
+          device's two-stage copy->fit _DevicePipeline; ``seq`` is
+          the trace sequence its h2d events stamp), snapshots owners,
+          and clears the bucket; handle is the fit-stage Future.
       scatter(out, owners, extra, results) -> None
           unpacks one dispatch's packed output into per-owner records.
       assemble(m, results) -> tuple whose first element is the TOA list
@@ -259,10 +274,13 @@ class _StreamExecutor:
     MULTI-DEVICE dispatch (ISSUE 4): full buckets are dealt round-robin
     across ``stream_devices`` (config.stream_devices: 'auto' = all
     local devices).  Each device owns a bounded in-flight deque (the
-    bound is EXACT — a queue never exceeds max_inflight) and ONE
-    dispatch worker thread: the h2d copy is the campaign bottleneck on
-    tunneled runtimes, and per-device workers keep N copies overlapped
-    instead of serialized on a single thread.  The drain policy always
+    bound is EXACT — a queue never exceeds max_inflight) and a
+    two-stage TRANSFER PIPELINE (ISSUE 6, _DevicePipeline): the h2d
+    copy is the campaign bottleneck on tunneled runtimes, so each
+    device runs a dedicated copy worker double-buffered
+    (config.stream_pipeline_depth) against its fit worker — bucket
+    N+1's bytes move while bucket N's program runs, and copies to
+    different devices overlap each other.  The drain policy always
     services ready dispatches first, on whichever device they
     completed, so a slow chip never stalls its siblings; when every
     queue is full the host blocks on the FIRST completion among the
@@ -282,17 +300,25 @@ class _StreamExecutor:
     def __init__(self, lane, datafiles, loader, nsub_batch,
                  max_inflight=None, prefetch=True, tim_out=None,
                  resume=False, skip_archives=None, quiet=False,
-                 stream_devices=None, tracer=None):
+                 stream_devices=None, tracer=None,
+                 pipeline_depth=None):
         from collections import deque
-        from concurrent.futures import ThreadPoolExecutor
 
         from .. import config
+        from ..utils.device import enable_compile_cache
 
+        # persistent compilation cache (config.compile_cache_dir /
+        # PPT_COMPILE_CACHE): a no-op when unset; applied here so any
+        # campaign driver benefits without its own wiring
+        enable_compile_cache()
         self.lane = lane
         self.nsub_batch = int(nsub_batch)
         if max_inflight is None:
             max_inflight = config.stream_max_inflight
         self.max_inflight = max(1, int(max_inflight))
+        if pipeline_depth is None:
+            pipeline_depth = config.stream_pipeline_depth
+        self.pipeline_depth = max(1, int(pipeline_depth))
         self.prefetch = prefetch
         self.tim_out = tim_out
         self.quiet = quiet
@@ -321,12 +347,6 @@ class _StreamExecutor:
         self.datafiles = datafiles
         self.loader = loader
         self.devices = resolve_stream_devices(stream_devices)
-        # one worker PER DEVICE: within a device h2d copies serialize
-        # on its link anyway (a single thread keeps that device's
-        # dispatch order deterministic), while copies to DIFFERENT
-        # devices overlap (device_put releases the GIL)
-        self.dispatch_exs = [ThreadPoolExecutor(max_workers=1)
-                             for _ in self.devices]
         self.buckets = {}
         self.results = {}
         self.meta = []
@@ -334,6 +354,30 @@ class _StreamExecutor:
         self.remaining = {}
         self.assembled = {}
         self.in_flight = [deque() for _ in self.devices]
+        # ONE transfer pipeline PER DEVICE (copy worker + fit worker):
+        # within a device h2d copies serialize on its link anyway (a
+        # single copy thread keeps that device's dispatch order
+        # deterministic), copies to DIFFERENT devices overlap
+        # (device_put releases the GIL), and the copy/fit stage split
+        # double-buffers each device's link against its own in-flight
+        # compute.  The inflight_fn closure binds THIS device's deque
+        # so the copy worker can flag h2d-vs-fit overlap without any
+        # executor lock: a dispatch counts only while UNFINISHED
+        # (pending future or still-running device program — the same
+        # readiness test the drain uses), and only when EARLIER than
+        # the copy (seq is monotonic): the copy's own record and any
+        # already-admitted successor still queued BEHIND this copy on
+        # the single copy worker are trivially unfinished but represent
+        # no device compute, and counting them would flatter the
+        # overlap stat at depth >= 2; list(q) snapshots the deque
+        # atomically under the GIL against main-thread appends.
+        self.pipelines = [
+            _DevicePipeline(dev, i, self.pipeline_depth, self.tracer,
+                            (lambda seq, q=self.in_flight[i]: any(
+                                r[3] < seq
+                                and not _StreamExecutor._head_ready(r)
+                                for r in list(q))))
+            for i, dev in enumerate(self.devices)]
         self._rr = 0
         # iarch -> subints not yet launched; entries leave at zero so
         # the staleness scan in run() stays O(live archives), not
@@ -527,16 +571,25 @@ class _StreamExecutor:
         tr = self.tracer
         if tr.enabled:
             # bucket identity for the trace, captured BEFORE launch
-            # clears the bucket: layout x payload kind x effective
-            # flag bits (the pieces of the dispatch key a reader can
-            # interpret)
+            # clears the bucket: layout x payload kind (raw buckets
+            # name their wire sample type and pol reduction — each is
+            # its own compiled program) x effective flag bits (the
+            # pieces of the dispatch key a reader can interpret)
             shape = f"{len(b.freqs)}x{b.nbin}:{b.kind}"
+            if b.kind == "raw":
+                shape += f":{b.raw_code}"
+                if b.pol_sum:
+                    shape += ":sum2"
             if b.flags:
                 shape += ":" + "".join("1" if f else "0"
                                        for f in b.flags)
             n_subints = len(b)
-        rec = self.lane.launch(b, self.devices[idev],
-                               self.dispatch_exs[idev])
+        # seq comes from the TRACER, not this executor: several
+        # executors may share one trace (stream_ipta_campaign), and
+        # the report pairs dispatch/h2d/drain events by seq — assigned
+        # BEFORE launch so the copy stage can stamp its h2d events
+        seq = tr.next_seq()
+        rec = self.lane.launch(b, self.pipelines[idev], seq)
         if rec is None:
             return
         self.nfit += 1
@@ -548,10 +601,6 @@ class _StreamExecutor:
                 if self.undispatched[ia] == 0:
                     del self.undispatched[ia]
         q = self.in_flight[idev]
-        # seq comes from the TRACER, not this executor: several
-        # executors may share one trace (stream_ipta_campaign), and
-        # the report pairs dispatch/drain events by seq
-        seq = tr.next_seq()
         q.append(rec + (seq,))
         # the bound is EXACT: _pick_device guaranteed room, so no
         # queue ever holds more than max_inflight dispatches (the old
@@ -579,9 +628,25 @@ class _StreamExecutor:
                     lambda f, seq=seq, idev=idev: tr.emit(
                         "dispatched", seq=seq, device=idev))
 
+    @property
+    def h2d_bytes(self):
+        """Total bytes the copy stages shipped host->device."""
+        return sum(pl.h2d_bytes for pl in self.pipelines)
+
+    @property
+    def h2d_duration(self):
+        """Total seconds the copy stages spent moving bytes."""
+        return sum(pl.h2d_s for pl in self.pipelines)
+
+    @property
+    def h2d_overlap_duration(self):
+        """Seconds of copy time that ran while a fit was in flight on
+        the same device (the link hidden behind compute)."""
+        return sum(pl.h2d_overlap_s for pl in self.pipelines)
+
     def _shutdown(self, wait):
-        for ex in self.dispatch_exs:
-            ex.shutdown(wait=wait, cancel_futures=not wait)
+        for pl in self.pipelines:
+            pl.shutdown(wait)
 
     def run(self):
         # a failed dispatch/assembly must not leave ANY worker thread
@@ -682,20 +747,37 @@ class _StreamExecutor:
 
 
 def _load_raw(f):
-    """Raw streaming load: undecoded int16 samples + the small per-
+    """Raw streaming load: undecoded DATA samples + the small per-
     archive metadata TOA assembly needs.
 
-    npol > 1 is supported for IQUV states (Stokes I is pol 0 — sliced
-    with no extra bytes shipped); AA+BB needs a host pscrunch, so it
-    falls back.  Dedispersed-on-disk archives are supported: the device
-    program re-disperses them (matmul-DFT rotation by the stored DM)
-    before fitting, mirroring load_data's dededisperse-on-load.
-    Raises ValueError when raw mode cannot represent the archive
-    (non-int16 DATA, non-IQUV multi-pol)."""
+    Sample types: int16, unsigned/signed byte, or float32 DATA columns
+    (ops/decode RAW_CODES; read_archive(decode=False) refuses anything
+    else — sub-byte NBIT packing, general TSCAL/TZERO — and the caller
+    falls back to the decoded lane).  Polarization is universal: npol
+    == 1 ships as-is; an IQUV state ships only its Stokes-I plane
+    (pol 0 — a host INDEX into the undecoded payload, no extra bytes);
+    any other multi-pol state (AA+BB, Coherence) ships its TWO summand
+    pols and the device decode baselines each pol then sums — the same
+    remove_baseline-then-pscrunch order as load_data, so the lanes
+    stay digit-identical.  Dedispersed-on-disk archives are supported:
+    the device program re-disperses them (matmul-DFT rotation by the
+    stored DM) before fitting, mirroring load_data's dededisperse-on-
+    load."""
     arch = read_archive(f, decode=False)
-    if arch.npol != 1 and arch.get_state() != "Stokes":
-        raise ValueError(
-            "raw streaming mode needs npol == 1 or an IQUV state")
+    if arch.npol == 1 or arch.get_state() == "Stokes":
+        # Stokes I is pol 0: index the wire payload, ship one pol
+        raw = arch.raw_data[:, 0]
+        scl = arch.raw_scl[:, 0]
+        offs = arch.raw_offs[:, 0]
+        pol_sum = False
+    else:
+        # AA+BB / Coherence: I = pol0 + pol1, decoded and baselined
+        # per pol ON DEVICE (twice the payload bytes of one pol, but
+        # still <= decoded float32 — and the host never decodes)
+        raw = arch.raw_data[:, :2]
+        scl = arch.raw_scl[:, :2]
+        offs = arch.raw_offs[:, :2]
+        pol_sum = True
     weights = arch.get_weights()
     weights_norm = np.where(weights == 0.0, 0.0, 1.0)
     nsub = arch.nsub
@@ -704,8 +786,9 @@ def _load_raw(f):
     from ..io.telescopes import telescope_code
 
     return DataBunch(
-        raw_mode=True, raw=arch.raw_data[:, 0], scl=arch.raw_scl[:, 0],
-        offs=arch.raw_offs[:, 0], weights=weights, ok_isubs=ok_isubs,
+        raw_mode=True, raw=raw, scl=scl, offs=offs,
+        raw_code=arch.raw_code, pol_sum=pol_sum,
+        weights=weights, ok_isubs=ok_isubs,
         nsub=nsub, nchan=arch.nchan, nbin=arch.nbin,
         freqs=arch.freqs_table, Ps=arch.folding_periods(),
         epochs=arch.epochs(), subtimes=list(arch.tsubints),
@@ -722,15 +805,19 @@ def _load_raw(f):
 
 
 def _raw_decode(raw, scl, offs, nbin, ft, redisp=False,
-                redisp_turns=None, dft_fold=None):
-    """Stage 1 of the fused raw-bucket program: int16 decode (scl/offs
-    affine), min-window baseline subtraction, and (for dedispersed-on-
-    disk archives) the on-device re-dispersion rotation.  Split out of
-    _raw_fit_fn so the stage-attribution profiler (benchmarks/attrib.py)
-    times prefixes of the REAL program — this is the single source of
-    truth for the decode stage."""
-    x = raw.astype(ft) * scl[..., None] + offs[..., None]
-    x = x - min_window_baseline(x)[..., None]
+                redisp_turns=None, dft_fold=None, code="i16",
+                pol_sum=False):
+    """Stage 1 of the fused raw-bucket program: sample decode (scl/offs
+    affine per the wire sample type — ops/decode.decode_stokes_I),
+    min-window baseline subtraction, the Stokes-I pol reduction for
+    two-pol payloads, and (for dedispersed-on-disk archives) the
+    on-device re-dispersion rotation.  Split out of _raw_fit_fn so the
+    stage-attribution profiler (benchmarks/attrib.py) times prefixes
+    of the REAL program — this is the single source of truth for the
+    decode stage."""
+    from ..ops.decode import decode_stokes_I
+
+    x = decode_stokes_I(raw, scl, offs, ft, code=code, pol_sum=pol_sum)
     if redisp:
         # dedispersed-on-disk archives: restore the dispersion
         # delays of the stored DM (load_data's dededisperse, here
@@ -771,7 +858,8 @@ def _raw_stats(x, cmask, freqs, ft, tiny):
 def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
                 use_fast, ftname, x_bf16, redisp=False,
                 want_flux=False, use_ir=False, compensated=False,
-                nharm_eff=None, seed_derotate=True):
+                nharm_eff=None, seed_derotate=True, raw_code="i16",
+                pol_sum=False):
     """Cache-key normalizing front for _raw_fit_fn_cached: dead knob
     combinations collapse onto one compiled program — compensated is
     meaningless without the scatter engine, and under compensated mode
@@ -801,7 +889,7 @@ def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
     return _raw_fit_fn_cached(
         nchan, nbin, flags, max_iter, log10_tau, tau_mode, use_fast,
         ftname, x_bf16, redisp, want_flux, use_ir, compensated,
-        nharm_eff, seed_derotate, use_dft_fold())
+        nharm_eff, seed_derotate, use_dft_fold(), raw_code, pol_sum)
 
 
 @lru_cache(maxsize=None)
@@ -809,9 +897,11 @@ def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
                        tau_mode, use_fast, ftname, x_bf16,
                        redisp=False, want_flux=False, use_ir=False,
                        compensated=False, nharm_eff=None,
-                       seed_derotate=True, dft_fold=None):
-    """ONE jitted program for a raw bucket: int16 decode (scl/offs),
-    min-window baseline subtraction, power-spectrum noise, S/N,
+                       seed_derotate=True, dft_fold=None,
+                       raw_code="i16", pol_sum=False):
+    """ONE jitted program for a raw bucket: sample decode (scl/offs
+    affine per raw_code — ops/decode; pol_sum reduces two-pol payloads
+    to Stokes I), min-window baseline subtraction, power-spectrum noise, S/N,
     nu_fit seeding, the batched fit, and result packing into a single
     (nfield, nb) array — so a bucket costs one h2d of int16 bytes, one
     dispatch, and one small d2h pull.  The decode and stats stages live
@@ -833,7 +923,8 @@ def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
     def run(raw, scl, offs, cmask, modelx, freqs, Ps, DMg, nu_out,
             tau_s, tau_nu, tau_a, alpha0, redisp_turns, ir_r, ir_i):
         x = _raw_decode(raw, scl, offs, nbin, ft, redisp=redisp,
-                        redisp_turns=redisp_turns, dft_fold=dft_fold)
+                        redisp_turns=redisp_turns, dft_fold=dft_fold,
+                        code=raw_code, pol_sum=pol_sum)
         noise, snr, nu_fit = _raw_stats(x, cmask, freqs, ft, tiny)
         nb = x.shape[0]
         if tau_mode == "none":
@@ -918,34 +1009,48 @@ def _result_keys(flags):
     return keys
 
 
-def _stack_raw(bucket, idx0, Ps):
-    """Stack a raw bucket's padded payload and compute the host-side
-    re-dispersion turns (f64 on host, wrapped to [-0.5, 0.5) before
-    the f32 device trig — raw delays reach 100s of turns).  Shared by
-    the wideband and narrowband launchers."""
-    raw = np.stack([bucket.raw[i] for i in idx0])
-    scl = np.stack([bucket.scl[i] for i in idx0])
-    offs = np.stack([bucket.offs[i] for i in idx0])
+def _raw_rows(bucket, idx0):
+    """Snapshot a raw bucket's padded per-subint rows (cheap list
+    gathers on the caller's thread — the bucket is CLEARED right after
+    launch, so the pipeline's copy stage must never read it) plus the
+    redisp flag, which selects the compiled program and therefore must
+    resolve before the copy stage runs."""
+    rows = ([bucket.raw[i] for i in idx0],
+            [bucket.scl[i] for i in idx0],
+            [bucket.offs[i] for i in idx0])
     dedisp = np.asarray([bucket.dedisp[i] for i in idx0])  # (n, 2)
     redisp = bool(np.any(dedisp[:, 0] != 0.0))
+    return rows, dedisp, redisp
+
+
+def _stack_rows(rows, dedisp, redisp, Ps, freqs):
+    """Stack snapshotted raw rows into the dispatch payload and
+    compute the host-side re-dispersion turns (f64 on host, wrapped to
+    [-0.5, 0.5) before the f32 device trig — raw delays reach 100s of
+    turns).  Runs on the transfer pipeline's COPY worker, so the
+    stacking pass overlaps in-flight fits instead of blocking the
+    archive loop."""
+    raw = np.stack(rows[0])
+    scl = np.stack(rows[1])
+    offs = np.stack(rows[2])
     if redisp:
-        freqs_h = np.asarray(bucket.freqs, np.float64)
+        freqs_h = np.asarray(freqs, np.float64)
         turns = (Dconst * dedisp[:, :1] / Ps[:, None]) * (
             freqs_h[None, :] ** -2.0 - dedisp[:, 1:] ** -2.0)
         turns = (turns + 0.5) % 1.0 - 0.5
     else:
-        turns = np.zeros((len(idx0), 1))
+        turns = np.zeros((len(rows[0]), 1))
+    return raw, scl, offs, turns
+
+
+def _stack_raw(bucket, idx0, Ps):
+    """Snapshot + stack in one call — the serialized convenience the
+    stage-attribution profiler (benchmarks/attrib.py) times; the
+    drivers run the two halves on different threads."""
+    rows, dedisp, redisp = _raw_rows(bucket, idx0)
+    raw, scl, offs, turns = _stack_rows(rows, dedisp, redisp, Ps,
+                                        bucket.freqs)
     return raw, scl, offs, redisp, turns
-
-
-def _dev_put(a, device, dtype=None):
-    """Host-side dtype conversion + committed placement on ``device``
-    (None = default device).  The numpy conversion happens on the
-    dispatch worker thread; device_put releases the GIL while the
-    bytes move, which is what lets per-device workers overlap their
-    h2d copies."""
-    arr = np.asarray(a) if dtype is None else np.asarray(a, dtype)
-    return jax.device_put(arr, device)
 
 
 def _on_device(device):
@@ -957,32 +1062,143 @@ def _on_device(device):
             else _null_ctx())
 
 
+class _DevicePipeline:
+    """Two-stage host->device dispatch pipeline for ONE device — the
+    transfer pipeline that hides the h2d link behind in-flight compute
+    (ISSUE 6 tentpole).
+
+    Stage 1, the COPY worker, stacks the bucket payload, converts
+    dtypes, and ``device_put``s it — the host->device move that
+    dominates campaign wall time on tunneled runtimes.  Stage 2, the
+    FIT worker, enqueues the fused program on the copied arrays.  A
+    bounded semaphore of ``depth`` buckets gates admission: depth 1
+    serializes copy against fit-enqueue (the pre-pipeline single-
+    worker behavior, kept as the A/B arm), depth 2 (default,
+    ``config.stream_pipeline_depth``) double-buffers so bucket N+1's
+    h2d runs while bucket N's fused fit executes.  Output is
+    byte-identical for any depth — the pipeline reorders WHEN bytes
+    move, never what is computed.
+
+    Telemetry: ``h2d_start`` fires on the copy worker as a bucket's
+    move begins (``overlap`` = the device had an undrained dispatch in
+    flight, i.e. the link is hidden behind compute) and ``h2d_done``
+    carries the byte count and duration — what pptrace's link section
+    aggregates into utilization and stall fraction.  The byte/second
+    totals also accumulate here for the drivers' run_end summary."""
+
+    def __init__(self, device, idev, depth, tracer, inflight_fn):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.device = device
+        self.idev = idev
+        self.depth = max(1, int(depth))
+        self.tracer = tracer
+        self._inflight_fn = inflight_fn
+        self._sem = threading.BoundedSemaphore(self.depth)
+        self.copy_ex = ThreadPoolExecutor(max_workers=1)
+        self.fit_ex = ThreadPoolExecutor(max_workers=1)
+        self.h2d_bytes = 0
+        self.h2d_s = 0.0
+        self.h2d_overlap_s = 0.0
+
+    def submit(self, copy_fn, fit_fn, seq):
+        """Admit one bucket: ``copy_fn() -> (dev_args, nbytes)`` runs
+        on the copy worker, ``fit_fn(*dev_args)`` on the fit worker as
+        soon as both the copy and the previous fit-enqueue finish.
+        Returns the fit Future.  Blocks the caller only when ``depth``
+        buckets already occupy the pipeline — back-pressure that is
+        released as fits clear the ENQUEUE stage, which never depends
+        on the caller draining results, so no deadlock."""
+        self._sem.acquire()
+        copy_fut = self.copy_ex.submit(self._run_copy, copy_fn, seq)
+        return self.fit_ex.submit(self._run_fit, copy_fut, fit_fn)
+
+    def _run_copy(self, copy_fn, seq):
+        tr = self.tracer
+        # overlap: an EARLIER dispatch was UNFINISHED (future pending,
+        # or its device program still running) on this device while the
+        # copy started — the link hid behind compute.  The flag is the
+        # h2d-vs-fit overlap signal pptrace's stall fraction reports;
+        # already-completed-but-undrained dispatches, this copy's own
+        # record, and admitted-but-not-yet-copied successors do NOT
+        # count, those would flatter the number.
+        overlap = bool(self._inflight_fn(seq))
+        if tr.enabled:
+            tr.emit("h2d_start", seq=seq, device=self.idev,
+                    overlap=overlap)
+        t0 = time.perf_counter()
+        dev_args, nbytes = copy_fn()
+        dt = time.perf_counter() - t0
+        self.h2d_bytes += nbytes
+        self.h2d_s += dt
+        if overlap:
+            self.h2d_overlap_s += dt
+        if tr.enabled:
+            tr.emit("h2d_done", seq=seq, device=self.idev,
+                    bytes=int(nbytes), h2d_s=round(dt, 6),
+                    overlap=overlap)
+        return dev_args
+
+    def _run_fit(self, copy_fut, fit_fn):
+        try:
+            dev_args = copy_fut.result()
+            return fit_fn(*dev_args)
+        finally:
+            # release on ANY exit (a failed copy included): the
+            # semaphore is what un-blocks the submitting thread
+            self._sem.release()
+
+    def shutdown(self, wait):
+        self.copy_ex.shutdown(wait=wait, cancel_futures=not wait)
+        self.fit_ex.shutdown(wait=wait, cancel_futures=not wait)
+
+
+def _byte_put(device, nbytes):
+    """A _dev_put that also counts the bytes it ships: the transfer
+    pipeline's copy closures use this so h2d_done telemetry (and the
+    drivers' run_end byte accounting) reports the REAL post-conversion
+    payload, not an estimate.  ``nbytes`` is a one-element list cell
+    the closure accumulates into."""
+    def put(a, dtype=None):
+        arr = np.asarray(a) if dtype is None else np.asarray(a, dtype)
+        nbytes[0] += arr.nbytes
+        return jax.device_put(arr, device)
+    return put
+
+
 def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
             tau_mode="none", tau_args=(0.0, 1.0, 0.0), alpha0=0.0,
-            executor=None, want_flux=False, device=None):
-    """Launch ONE fused dispatch for a bucket's pending subints and
-    return an in-flight record — WITHOUT waiting for the device.  The
-    host->device copy (device_put) can be SYNCHRONOUS and is the
-    campaign bottleneck on tunneled runtimes, so when an ``executor``
-    is given the copy+dispatch runs on its worker thread (device_put
-    releases the GIL) and the record carries a Future — the caller
-    keeps loading and bucketing archives while the bytes move.  The
-    batch is always padded to a multiple of nsub_batch so dispatch
-    shapes stay canonical (each distinct shape costs an XLA compile).
+            pipeline=None, want_flux=False, seq=0):
+    """Launch ONE fused dispatch for a bucket's pending subints
+    through ``pipeline`` (the bucket's _DevicePipeline) and return an
+    in-flight record — WITHOUT waiting for the device.  The
+    host->device copy (stack + convert + device_put) is SYNCHRONOUS
+    and is the campaign bottleneck on tunneled runtimes, so it runs as
+    its own pipeline stage on the device's COPY worker, overlapped
+    against the FIT worker's program enqueues (double-buffered at
+    config.stream_pipeline_depth >= 2) — the caller keeps loading and
+    bucketing archives while the bytes move, and the link keeps moving
+    bytes while the device fits.  The batch is always padded to a
+    multiple of nsub_batch so dispatch shapes stay canonical (each
+    distinct shape costs an XLA compile).
 
-    ``device``: the jax device this bucket's arrays are committed to
-    (None = default).  The jitted programs follow their inputs, so one
-    _raw_fit_fn_cached entry serves every device of a shape — but jax
-    keys its jit cache on input placement, so each device pays its own
-    trace + XLA compile on the FIRST dispatch it receives (campaign
-    cold start costs ~ndev compiles per bucket shape, measured, not
-    one); every later dispatch is a cache hit."""
+    The jitted programs follow their inputs, so one _raw_fit_fn_cached
+    entry serves every device of a shape — but jax keys its jit cache
+    on input placement, so each device pays its own trace + XLA
+    compile on the FIRST dispatch it receives (campaign cold start
+    costs ~ndev compiles per bucket shape, measured, not one; see
+    config.compile_cache_dir for the cross-process fix); every later
+    dispatch is a cache hit."""
     n = len(bucket)
     if n == 0:
         return None
+    device = pipeline.device
     pad = (-n) % nsub_batch
     idx0 = list(range(n)) + [0] * pad  # pad with copies of subint 0
-    masks = np.stack([bucket.masks[i] for i in idx0])
+    # row SNAPSHOTS on the caller's thread (cheap list gathers — the
+    # bucket is cleared below, so the copy stage works from these);
+    # the expensive np.stack passes run on the copy worker
+    masks_rows = [bucket.masks[i] for i in idx0]
     Ps = np.asarray([bucket.Ps[i] for i in idx0])
     flags = FitFlags(*bucket.flags)
     keys = _result_keys(flags)
@@ -990,20 +1206,22 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
         keys = keys + ("flux", "flux_err", "flux_ref_freq")
     nu_out = -1.0 if nu_ref_DM is None else float(nu_ref_DM)
     use_fast = use_fast_fit_default()
+    ir_FT = bucket.ir_FT
+    modelx, freqs = bucket.modelx, bucket.freqs
 
     if bucket.kind == "raw":
-        raw, scl, offs, redisp, turns = _stack_raw(bucket, idx0, Ps)
+        rows, dedisp, redisp = _raw_rows(bucket, idx0)
         DMg = np.asarray([bucket.DM_guess[i] for i in idx0])
         ftname = "float32" if use_fast else "float64"
         # bf16/compensated config read per call (cache-key args,
         # mirroring _fast_batch_fn): mid-process toggles take effect
-        use_ir = bucket.ir_FT is not None
+        use_ir = ir_FT is not None
         from ..fit.portrait import use_scatter_compensated
 
         # per-bucket memoized window (fit.portrait) — only the fast
         # lanes band-limit; the complex engine never does
         hwin = bucket.harmonic_window() if use_fast else None
-        fn = _raw_fit_fn(int(raw.shape[1]), bucket.nbin,
+        fn = _raw_fit_fn(len(np.asarray(freqs)), bucket.nbin,
                          tuple(bool(f) for f in bucket.flags),
                          int(max_iter), bool(log10_tau), tau_mode,
                          use_fast, ftname,
@@ -1014,10 +1232,11 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                          # all-zero DM guesses make the CCF seed's
                          # derotation phasor the identity; the host
                          # knows, so the program skips the trig pass
-                         seed_derotate=bool(np.any(DMg != 0.0)))
+                         seed_derotate=bool(np.any(DMg != 0.0)),
+                         raw_code=bucket.raw_code,
+                         pol_sum=bucket.pol_sum)
         ft = jnp.float32 if use_fast else jnp.float64
         t_s, t_nu, t_a = tau_args
-        modelx, freqs = bucket.modelx, bucket.freqs
         # the response ships as TWO REAL arrays (the complex engine
         # reassembles them device-side inside the program — complex
         # buffers cannot cross some tunneled transports).  A
@@ -1025,32 +1244,38 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
         # host first.  Split here as HOST numpy so the placement below
         # commits them to the bucket's device like every other input.
         if use_ir:
-            ir_src = np.asarray(bucket.ir_FT)
+            ir_src = np.asarray(ir_FT)
             if hwin is not None:
                 ir_src = ir_src[..., :hwin]
             ir_r_h, ir_i_h = ir_src.real, ir_src.imag
         else:
             ir_r_h = ir_i_h = None
 
-        def dispatch():
+        def copy():
+            raw, scl, offs, turns = _stack_rows(rows, dedisp, redisp,
+                                                Ps, freqs)
+            masks = np.stack(masks_rows)
+            nbytes = [0]
+            put = _byte_put(device, nbytes)
             with _on_device(device):
-                ir_r = (_dev_put(ir_r_h, device, ft) if use_ir
-                        else None)
-                ir_i = (_dev_put(ir_i_h, device, ft) if use_ir
-                        else None)
-                return fn(_dev_put(raw, device),
-                          _dev_put(scl, device, ft),
-                          _dev_put(offs, device, ft),
-                          _dev_put(masks, device, ft),
-                          _dev_put(modelx, device, ft),
-                          _dev_put(freqs, device, ft),
-                          _dev_put(Ps, device, ft),
-                          _dev_put(DMg, device, ft), ft(nu_out),
-                          ft(t_s), ft(t_nu), ft(t_a), ft(alpha0),
-                          _dev_put(turns, device, ft), ir_r, ir_i)
+                ir_r = put(ir_r_h, ft) if use_ir else None
+                ir_i = put(ir_i_h, ft) if use_ir else None
+                args = (put(raw), put(scl, ft), put(offs, ft),
+                        put(masks, ft), put(modelx, ft),
+                        put(freqs, ft), put(Ps, ft), put(DMg, ft),
+                        put(turns, ft), ir_r, ir_i)
+            return args, nbytes[0]
+
+        def fit(raw_d, scl_d, offs_d, masks_d, modelx_d, freqs_d,
+                Ps_d, DMg_d, turns_d, ir_r, ir_i):
+            with _on_device(device):
+                return fn(raw_d, scl_d, offs_d, masks_d, modelx_d,
+                          freqs_d, Ps_d, DMg_d, ft(nu_out), ft(t_s),
+                          ft(t_nu), ft(t_a), ft(alpha0), turns_d,
+                          ir_r, ir_i)
     else:
-        ports = np.stack([bucket.ports[i] for i in idx0])
-        noise = np.stack([bucket.noise[i] for i in idx0])
+        ports_rows = [bucket.ports[i] for i in idx0]
+        noise_rows = [bucket.noise[i] for i in idx0]
         nu_fit = np.asarray([bucket.nu_fits[i] for i in idx0])
         theta0 = np.stack([bucket.theta0[i] for i in idx0])
         # scattering (fitted, or a fixed nonzero/log10 tau seed in a
@@ -1058,54 +1283,49 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
         # to the scatter-shaped engine — complex-free on fast backends
         scat = (flags[3] or flags[4] or log10_tau
                 or bool(np.any(theta0[:, 3] != 0.0))
-                or bucket.ir_FT is not None)
-        modelx, freqs = bucket.modelx, bucket.freqs
+                or ir_FT is not None)
         hwin = bucket.harmonic_window() if use_fast else None
+        dt = jnp.float32 if use_fast else None
 
-        def dispatch():
+        def copy():
+            ports = np.stack(ports_rows)
+            noise = np.stack(noise_rows)
+            masks = np.stack(masks_rows)
+            nbytes = [0]
+            put = _byte_put(device, nbytes)
             with _on_device(device):
                 # placed ONCE per dispatch and shared between the fit
-                # call and _flux_rows below — a second device_put of
+                # call and _flux_rows — a second device_put of
                 # modelx/masks/freqs would double their h2d bytes on
                 # exactly the link that bottlenecks the campaign
-                dt = jnp.float32 if use_fast else None
-                modelx_d = _dev_put(modelx, device, dt)
-                masks_d = _dev_put(masks, device, dt)
-                freqs_d = _dev_put(freqs, device, dt)
+                args = (put(ports, dt), put(modelx, dt),
+                        put(noise, dt), put(freqs, dt), put(Ps, dt),
+                        put(nu_fit, dt), put(theta0, dt),
+                        put(masks, dt))
+            return args, nbytes[0]
+
+        def fit(ports_d, modelx_d, noise_d, freqs_d, Ps_d, nu_fit_d,
+                theta0_d, masks_d):
+            with _on_device(device):
                 if use_fast:
                     # both regimes share the complex-free matmul-DFT
                     # lane; scattering buckets route to the fused
                     # analytic _cgh_scatter Newton loop inside
                     r = fit_portrait_batch_fast(
-                        _dev_put(ports, device, dt),
-                        modelx_d,
-                        _dev_put(noise, device, dt),
-                        freqs_d,
-                        _dev_put(Ps, device, dt),
-                        _dev_put(nu_fit, device, dt),
-                        nu_out=nu_ref_DM,
-                        theta0=_dev_put(theta0, device, dt),
-                        fit_flags=flags,
-                        chan_masks=masks_d,
+                        ports_d, modelx_d, noise_d, freqs_d, Ps_d,
+                        nu_fit_d, nu_out=nu_ref_DM, theta0=theta0_d,
+                        fit_flags=flags, chan_masks=masks_d,
                         max_iter=max_iter, log10_tau=log10_tau,
-                        ir_FT=bucket.ir_FT, use_scatter=scat,
+                        ir_FT=ir_FT, use_scatter=scat,
                         harmonic_window=hwin if hwin is not None
                         else False)
                 else:
                     r = fit_portrait_batch(
-                        _dev_put(ports, device),
-                        # shared 2-D: one model DFT
-                        modelx_d,
-                        _dev_put(noise, device),
-                        freqs_d,
-                        _dev_put(Ps, device),
-                        _dev_put(nu_fit, device),
-                        nu_out=nu_ref_DM,
-                        theta0=_dev_put(theta0, device),
-                        fit_flags=flags,
-                        chan_masks=masks_d,
+                        ports_d, modelx_d, noise_d, freqs_d, Ps_d,
+                        nu_fit_d, nu_out=nu_ref_DM, theta0=theta0_d,
+                        fit_flags=flags, chan_masks=masks_d,
                         log10_tau=log10_tau, max_iter=max_iter,
-                        ir_FT=bucket.ir_FT)
+                        ir_FT=ir_FT)
                 # pack into one array so draining costs a single d2h
                 # pull (~100 ms round-trip each on tunneled runtimes);
                 # flux reduces to 3 per-subint rows on device
@@ -1120,8 +1340,7 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                         masks_d, freqs_d)]
                 return jnp.stack(fields)
 
-    handle = executor.submit(dispatch) if executor is not None \
-        else dispatch()
+    handle = pipeline.submit(copy, fit, seq)
     rec = (handle, list(bucket.owners), keys)
     bucket.clear()
     return rec
@@ -1245,7 +1464,8 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                          addtnl_toa_flags={}, tim_out=None,
                          quiet=False, resume=False,
                          skip_archives=None, stream_devices=None,
-                         telemetry=None, quality_flags=False):
+                         telemetry=None, quality_flags=False,
+                         pipeline_depth=None):
     """Measure wideband (phi[, DM[, tau, alpha]]) TOAs for many
     archives with cross-archive batched dispatches.
 
@@ -1278,6 +1498,16 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
     exact; None reads config.stream_max_inflight) — dispatch latency,
     archive IO (see prefetch), and device compute all overlap, which
     is what makes campaign-scale throughput dispatch-latency-immune.
+
+    pipeline_depth: how many buckets may occupy a device's two-stage
+    copy->fit transfer pipeline at once (None reads
+    config.stream_pipeline_depth, default 2).  Depth 2 double-buffers
+    the h2d link against in-flight fits — bucket N+1's bytes move
+    while bucket N's program runs; depth 1 serializes the stages (the
+    A/B arm).  Output — .tim content included — is byte-identical for
+    any depth; only the overlap schedule changes.  The h2d_start/
+    h2d_done trace events record per-copy bytes, duration, and the
+    overlap flag pptrace's link section aggregates.
 
     stream_devices: which local devices buckets are dealt across,
     round-robin — None reads config.stream_devices; 'auto' = every
@@ -1312,6 +1542,8 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
       nfit            — number of fused dispatches fired
       devices_used    — distinct devices that received dispatches
       peak_inflight   — max pending dispatches observed on one device
+      h2d_bytes       — total bytes the copy stages shipped h2d
+      h2d_duration    — total seconds the copy stages spent moving
     """
     if isinstance(datafiles, str):
         datafiles = (_read_metafile(datafiles) if _is_metafile(datafiles)
@@ -1347,8 +1579,8 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
     def _loader(f):
         if not tscrunch:
             try:
-                # raw lane: int16 straight to the accelerator, decode
-                # and statistics on device
+                # raw lane: undecoded wire samples straight to the
+                # accelerator, decode and statistics on device
                 return _load_raw(f)
             except (ValueError, KeyError):
                 pass
@@ -1450,6 +1682,12 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                           bool(fit_scat),
                           bool(fit_scat and not fix_alpha))
             kind = "raw" if raw_mode else "dec"
+            # raw payloads bucket by wire sample type and pol
+            # reduction too: each combination is its own compiled
+            # decode stage, and mixing them would stack incompatible
+            # row shapes/dtypes
+            raw_code = str(d.get("raw_code") or "i16")
+            pol_sum = bool(d.get("pol_sum", False))
             per_subint = []
             for j, isub in enumerate(ok):
                 # degenerate-geometry demotion — the SAME helper
@@ -1457,12 +1695,16 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                 # effective_fit_flags; reference pptoas.py:519-527)
                 eff_flags = effective_fit_flags(nchx[j], base_flags)
                 key = base_key + (eff_flags, kind)
+                if raw_mode:
+                    key += (raw_code, pol_sum)
 
                 def factory(freqs0=freqs0, nbin=nbin, modelx=modelx,
                             eff_flags=eff_flags, kind=kind,
-                            ir_FT=ir_FT):
+                            ir_FT=ir_FT, raw_code=raw_code,
+                            pol_sum=pol_sum):
                     return _Bucket(freqs0, nbin, modelx, eff_flags,
-                                   kind=kind, ir_FT=ir_FT)
+                                   kind=kind, ir_FT=ir_FT,
+                                   raw_code=raw_code, pol_sum=pol_sum)
 
                 def fill(b, j=j, isub=int(isub), d=d, masks=masks,
                          DM_guess=DM_guess, raw_mode=raw_mode,
@@ -1496,12 +1738,12 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                 per_subint.append((key, factory, fill))
             return m, per_subint
 
-        def launch(self, b, device, executor):
+        def launch(self, b, pipeline, seq):
             return _launch(b, nu_ref_DM, max_iter, nsub_batch,
                            log10_tau=log10_tau, tau_mode=tau_mode,
                            tau_args=tau_args, alpha0=alpha0_run,
-                           executor=executor, want_flux=print_flux,
-                           device=device)
+                           pipeline=pipeline, want_flux=print_flux,
+                           seq=seq)
 
         def scatter(self, out, owners, keys, results):
             packed = np.asarray(out)
@@ -1526,7 +1768,7 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                              prefetch=prefetch, tim_out=tim_out,
                              resume=resume, skip_archives=skip_archives,
                              quiet=quiet, stream_devices=stream_devices,
-                             tracer=tracer)
+                             tracer=tracer, pipeline_depth=pipeline_depth)
         meta, assembled = ex.run()
         nfit, fit_duration = ex.nfit, ex.fit_duration
 
@@ -1555,8 +1797,12 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                         n_toas=n, n_archives=len(order), nfit=nfit,
                         peak_inflight=ex.peak_inflight,
                         max_inflight=ex.max_inflight,
+                        pipeline_depth=ex.pipeline_depth,
                         fit_s=round(fit_duration, 6),
                         scatter_s=round(ex.scatter_duration, 6),
+                        h2d_s=round(ex.h2d_duration, 6),
+                        h2d_bytes=int(ex.h2d_bytes),
+                        h2d_overlap_s=round(ex.h2d_overlap_duration, 6),
                         wall_s=round(tot, 6),
                         devices_used=len(ex.devices_used),
                         dispatches_per_device=ex.dispatch_counts)
@@ -1569,7 +1815,9 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                      fit_duration=fit_duration,
                      scatter_duration=ex.scatter_duration, nfit=nfit,
                      devices_used=len(ex.devices_used),
-                     peak_inflight=ex.peak_inflight)
+                     peak_inflight=ex.peak_inflight,
+                     h2d_bytes=int(ex.h2d_bytes),
+                     h2d_duration=ex.h2d_duration)
 
 
 # --------------------------------------------------------------------------
@@ -1632,9 +1880,11 @@ def _nb_fit_fields(x, modelx, noise, cmask, freqs, Ps, ft, nbin,
 
 @lru_cache(maxsize=None)
 def _raw_nb_fn(nchan, nbin, fit_scat, log10_tau, tau_mode, max_iter,
-               ftname, redisp):
-    """ONE jitted program for a narrowband raw bucket: decode,
-    baseline, optional re-dispersion, then per-channel 1-D fits —
+               ftname, redisp, raw_code="i16", pol_sum=False):
+    """ONE jitted program for a narrowband raw bucket: sample decode
+    (_raw_decode — shared with the wideband program, so the two lanes
+    cannot drift on sample types or the pol reduction), baseline,
+    optional re-dispersion, then per-channel 1-D fits —
     fit_phase_shift_batch (no scattering) or the 5-param engine with
     (phi, tau) per single-channel portrait (get_narrowband_TOAs'
     flattened path, pipeline/toas.py:786-835).  Returns a packed
@@ -1646,16 +1896,9 @@ def _raw_nb_fn(nchan, nbin, fit_scat, log10_tau, tau_mode, max_iter,
 
     def run(raw, scl, offs, cmask, modelx, freqs, Ps,
             tau_s, tau_nu, tau_a, redisp_turns):
-        x = raw.astype(ft) * scl[..., None] + offs[..., None]
-        x = x - min_window_baseline(x)[..., None]
-        if redisp:
-            from ..ops.fourier import irfft_mm, rfft_mm
-
-            k = jnp.arange(nbin // 2 + 1, dtype=ft)
-            ang = -2.0 * jnp.pi * redisp_turns.astype(ft)[..., None] * k
-            c, s = jnp.cos(ang), jnp.sin(ang)
-            Xr, Xi = rfft_mm(x)
-            x = irfft_mm(Xr * c - Xi * s, Xr * s + Xi * c, nbin)
+        x = _raw_decode(raw, scl, offs, nbin, ft, redisp=redisp,
+                        redisp_turns=redisp_turns, code=raw_code,
+                        pol_sum=pol_sum)
         noise = jnp.maximum(get_noise_PS(x), tiny)
         fields = _nb_fit_fields(x, modelx, noise, cmask, freqs, Ps,
                                 ft, nbin, fit_scat, log10_tau, tau_mode,
@@ -1673,21 +1916,23 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
                            addtnl_toa_flags={}, tim_out=None,
                            quiet=False, resume=False,
                            skip_archives=None, stream_devices=None,
-                           telemetry=None):
+                           telemetry=None, pipeline_depth=None):
     """Campaign-scale narrowband TOAs: per-channel 1-D fits with the
     same raw-int16 device pipeline, bucketing, and asynchronous
     dispatch as stream_wideband_TOAs — one TOA per unzapped channel
     (get_narrowband_TOAs semantics; the reference left the narrowband
     scattering fit "NOT YET IMPLEMENTED", pptoas.py:1046-1049).
 
-    Non-raw-compatible archives (AA+BB multi-pol, float DATA) fall
-    back to a host-decoded dispatch of the same device fits.
+    Non-raw-compatible archives (sub-byte NBIT packing, general
+    TSCAL/TZERO scaling) fall back to a host-decoded dispatch of the
+    same device fits.
     tim_out / resume / skip_archives / stream_devices / max_inflight /
-    telemetry follow stream_wideband_TOAs (per-archive completion
-    sentinels; round-robin multi-device dispatch; _StreamExecutor;
-    JSONL event tracing).  Returns a DataBunch(TOA_list, order,
-    fit_duration, scatter_duration, nfit, devices_used,
-    peak_inflight)."""
+    pipeline_depth / telemetry follow stream_wideband_TOAs
+    (per-archive completion sentinels; round-robin multi-device
+    dispatch through per-device copy->fit transfer pipelines;
+    _StreamExecutor; JSONL event tracing).  Returns a
+    DataBunch(TOA_list, order, fit_duration, scatter_duration, nfit,
+    devices_used, peak_inflight, h2d_bytes, h2d_duration)."""
     if isinstance(datafiles, str):
         datafiles = (_read_metafile(datafiles) if _is_metafile(datafiles)
                      else [datafiles])
@@ -1766,53 +2011,74 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
                     m.telescope, m.telescope_code, None, None, flags))
         return toas
 
-    def launch_nb(b, device, executor):
+    def launch_nb(b, pipeline, seq):
         n = len(b)
         if n == 0:
             return None
+        device = pipeline.device
         pad = (-n) % nsub_batch
         idx0 = list(range(n)) + [0] * pad
-        masks = np.stack([b.masks[i] for i in idx0])
+        # row snapshots on the caller's thread (the bucket is cleared
+        # below); the np.stack passes run on the copy worker
+        masks_rows = [b.masks[i] for i in idx0]
         Ps = np.asarray([b.Ps[i] for i in idx0])
         t_s, t_nu, t_a = tau_args
+        modelx, freqs = b.modelx, b.freqs
+        nbin = b.nbin
         if b.kind == "raw":
-            raw, scl, offs, redisp, turns = _stack_raw(b, idx0, Ps)
-            fn = _raw_nb_fn(int(raw.shape[1]), b.nbin, bool(fit_scat),
-                            bool(log10_tau), tau_mode, int(max_iter),
-                            ftname, redisp)
-            modelx, freqs = b.modelx, b.freqs
+            rows, dedisp, redisp = _raw_rows(b, idx0)
+            fn = _raw_nb_fn(len(np.asarray(freqs)), nbin,
+                            bool(fit_scat), bool(log10_tau), tau_mode,
+                            int(max_iter), ftname, redisp,
+                            raw_code=b.raw_code, pol_sum=b.pol_sum)
 
-            def dispatch():
+            def copy():
+                raw, scl, offs, turns = _stack_rows(rows, dedisp,
+                                                    redisp, Ps, freqs)
+                masks = np.stack(masks_rows)
+                nbytes = [0]
+                put = _byte_put(device, nbytes)
                 with _on_device(device):
-                    return fn(_dev_put(raw, device),
-                              _dev_put(scl, device, ft),
-                              _dev_put(offs, device, ft),
-                              _dev_put(masks, device, ft),
-                              _dev_put(modelx, device, ft),
-                              _dev_put(freqs, device, ft),
-                              _dev_put(Ps, device, ft), ft(t_s),
-                              ft(t_nu), ft(t_a),
-                              _dev_put(turns, device, ft))
-        else:
-            ports = np.stack([b.ports[i] for i in idx0])
-            noise = np.stack([b.noise[i] for i in idx0])
-            modelx, freqs = b.modelx, b.freqs
+                    args = (put(raw), put(scl, ft), put(offs, ft),
+                            put(masks, ft), put(modelx, ft),
+                            put(freqs, ft), put(Ps, ft),
+                            put(turns, ft))
+                return args, nbytes[0]
 
-            def dispatch():
+            def fit(raw_d, scl_d, offs_d, masks_d, modelx_d, freqs_d,
+                    Ps_d, turns_d):
+                with _on_device(device):
+                    return fn(raw_d, scl_d, offs_d, masks_d, modelx_d,
+                              freqs_d, Ps_d, ft(t_s), ft(t_nu),
+                              ft(t_a), turns_d)
+        else:
+            ports_rows = [b.ports[i] for i in idx0]
+            noise_rows = [b.noise[i] for i in idx0]
+
+            def copy():
+                ports = np.stack(ports_rows)
+                noise = np.stack(noise_rows)
+                masks = np.stack(masks_rows)
+                nbytes = [0]
+                put = _byte_put(device, nbytes)
+                with _on_device(device):
+                    args = (put(ports, ft), put(modelx, ft),
+                            put(noise, ft), put(masks, ft),
+                            put(freqs, ft), put(Ps, ft))
+                return args, nbytes[0]
+
+            def fit(ports_d, modelx_d, noise_d, masks_d, freqs_d,
+                    Ps_d):
                 with _on_device(device):
                     return jnp.stack([
                         jnp.asarray(f).astype(ft)
                         for f in _nb_fit_fields(
-                            _dev_put(ports, device, ft),
-                            _dev_put(modelx, device, ft),
-                            _dev_put(noise, device, ft),
-                            _dev_put(masks, device, ft),
-                            _dev_put(freqs, device, ft),
-                            _dev_put(Ps, device, ft),
-                            ft, b.nbin, fit_scat, log10_tau, tau_mode,
-                            max_iter, t_s, t_nu, t_a)])
+                            ports_d, modelx_d, noise_d, masks_d,
+                            freqs_d, Ps_d, ft, nbin, fit_scat,
+                            log10_tau, tau_mode, max_iter, t_s, t_nu,
+                            t_a)])
 
-        rec = (executor.submit(dispatch), list(b.owners), None)
+        rec = (pipeline.submit(copy, fit, seq), list(b.owners), None)
         b.clear()
         return rec
 
@@ -1834,9 +2100,12 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
                 log(f"Skipping {datafile}: {e}", level="warn")
                 return None
             raw_mode = bool(d.get("raw_mode", False))
+            raw_code = str(d.get("raw_code") or "i16")
+            pol_sum = bool(d.get("pol_sum", False))
             masks = np.asarray(d.weights[ok] > 0.0, float)
             key = (nchan, nbin, freqs0.tobytes(),
                    "raw" if raw_mode else "dec") + (
+                       (raw_code, pol_sum) if raw_mode else ()) + (
                        (round(P_mean, 12),) if p_dependent else ())
             m = DataBunch(
                 datafile=datafile, iarch=iarch, ok=ok, nbin=nbin,
@@ -1851,9 +2120,11 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
                 telescope_code=d.telescope_code)
 
             def factory(freqs0=freqs0, nbin=nbin, modelx=modelx,
-                        raw_mode=raw_mode):
+                        raw_mode=raw_mode, raw_code=raw_code,
+                        pol_sum=pol_sum):
                 return _Bucket(freqs0, nbin, modelx, (),
-                               kind="raw" if raw_mode else "dec")
+                               kind="raw" if raw_mode else "dec",
+                               raw_code=raw_code, pol_sum=pol_sum)
 
             per_subint = []
             for j, isub in enumerate(ok):
@@ -1880,8 +2151,8 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
                 per_subint.append((key, factory, fill))
             return m, per_subint
 
-        def launch(self, b, device, executor):
-            return launch_nb(b, device, executor)
+        def launch(self, b, pipeline, seq):
+            return launch_nb(b, pipeline, seq)
 
         def scatter(self, out, owners, extra, results):
             packed = np.asarray(out)
@@ -1899,7 +2170,7 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
                              prefetch=prefetch, tim_out=tim_out,
                              resume=resume, skip_archives=skip_archives,
                              quiet=quiet, stream_devices=stream_devices,
-                             tracer=tracer)
+                             tracer=tracer, pipeline_depth=pipeline_depth)
         meta, assembled = ex.run()
         nfit, fit_duration = ex.nfit, ex.fit_duration
 
@@ -1923,8 +2194,12 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
                         n_toas=n, n_archives=len(order), nfit=nfit,
                         peak_inflight=ex.peak_inflight,
                         max_inflight=ex.max_inflight,
+                        pipeline_depth=ex.pipeline_depth,
                         fit_s=round(fit_duration, 6),
                         scatter_s=round(ex.scatter_duration, 6),
+                        h2d_s=round(ex.h2d_duration, 6),
+                        h2d_bytes=int(ex.h2d_bytes),
+                        h2d_overlap_s=round(ex.h2d_overlap_duration, 6),
                         wall_s=round(tot, 6),
                         devices_used=len(ex.devices_used),
                         dispatches_per_device=ex.dispatch_counts)
@@ -1935,4 +2210,6 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
                      fit_duration=fit_duration,
                      scatter_duration=ex.scatter_duration, nfit=nfit,
                      devices_used=len(ex.devices_used),
-                     peak_inflight=ex.peak_inflight)
+                     peak_inflight=ex.peak_inflight,
+                     h2d_bytes=int(ex.h2d_bytes),
+                     h2d_duration=ex.h2d_duration)

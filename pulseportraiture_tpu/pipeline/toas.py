@@ -316,6 +316,14 @@ class GetTOAs:
     """
 
     def __init__(self, datafiles, modelfile, quiet=False):
+        from ..utils.device import enable_compile_cache
+
+        # persistent compilation cache (config.compile_cache_dir /
+        # PPT_COMPILE_CACHE / pptoas --compile-cache): a no-op when
+        # unset — the per-shape jit cold start is paid here exactly
+        # like in the streaming drivers, so library users of this
+        # lane get the cache without their own wiring
+        enable_compile_cache()
         if isinstance(datafiles, str):
             if _is_metafile(datafiles):
                 self.datafiles = _read_metafile(datafiles)

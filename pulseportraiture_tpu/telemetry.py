@@ -58,7 +58,8 @@ TRACE_SCHEMA_VERSION = 1
 # trace reader needs to interpret device/queue numbers.
 CONFIG_SNAPSHOT_KEYS = (
     "cross_spectrum_dtype", "dft_precision", "dft_fold", "align_device",
-    "stream_devices", "stream_max_inflight", "telemetry_path",
+    "stream_devices", "stream_max_inflight", "stream_pipeline_depth",
+    "compile_cache_dir", "telemetry_path",
     "use_fast_fit", "use_matmul_dft", "fit_harmonic_window",
     "scatter_compensated",
 )
@@ -79,6 +80,13 @@ EVENT_FIELDS = {
     "archive_fit": {"datafile", "n_ok", "fit_s"},
     "dispatch": {"seq", "device", "shape", "n", "queue_depth", "cold"},
     "dispatched": {"seq", "device"},
+    # the transfer pipeline's copy stage: h2d_start fires on the copy
+    # worker as the bucket's host->device move begins (overlap = a fit
+    # was in flight on that device, i.e. the link is hidden behind
+    # compute); h2d_done carries the byte count and duration pptrace's
+    # link-utilization section aggregates
+    "h2d_start": {"seq", "device", "overlap"},
+    "h2d_done": {"seq", "device", "bytes", "h2d_s", "overlap"},
     "drain": {"seq", "device", "wait_s", "scatter_s"},
     "quality": {"snr", "gof", "nfev"},
     "archive_done": {"iarch", "datafile"},
@@ -252,12 +260,20 @@ class _NullTracer:
 
     enabled = False
     path = None
+    _seq = 0
 
     def emit(self, type, **fields):
         pass
 
     def next_seq(self):
-        return 0  # never emitted, so uniqueness is moot
+        # never emitted, but still monotonic: the transfer pipeline's
+        # overlap accounting (res.h2d_overlap_s) orders dispatches by
+        # seq, and that stat is surfaced with telemetry off too.  A GIL
+        # race between executors can at worst produce a tie, which the
+        # strict < comparison reads as "not earlier" — an undercount,
+        # never an overcount.
+        _NullTracer._seq += 1
+        return _NullTracer._seq
 
     def counter(self, name, inc=1):
         pass
@@ -530,6 +546,45 @@ def report(path, file=None):
     else:
         p("  (no dispatches)")
 
+    # ---- h2d link utilization ---------------------------------------
+    h2d = by_type.get("h2d_done", [])
+    h2d_bytes = sum(int(ev["bytes"]) for ev in h2d)
+    h2d_s = sum(float(ev["h2d_s"]) for ev in h2d)
+    h2d_overlap_s = sum(float(ev["h2d_s"]) for ev in h2d
+                        if ev.get("overlap"))
+    h2d_stall_frac = (1.0 - h2d_overlap_s / h2d_s) if h2d_s > 0 else None
+    p("")
+    p("-- h2d link (copy stage) --")
+    if h2d:
+        mbps = h2d_bytes / max(h2d_s, 1e-9) / 1e6
+        link_frac = h2d_s / max(t_end, 1e-9)
+        p(f"  {len(h2d)} copies, {h2d_bytes / 1e6:.2f} MB in "
+          f"{h2d_s:.3f} s ({mbps:.1f} MB/s effective); link busy "
+          f"{100 * link_frac:.1f}% of wall")
+        # h2d_s can sum to 0.0 (sub-microsecond copies round to 0 at
+        # emit time), leaving h2d_stall_frac None
+        ov_pct = 100 * h2d_overlap_s / h2d_s if h2d_s > 0 else 0.0
+        stall = (f"{100 * h2d_stall_frac:.1f}%"
+                 if h2d_stall_frac is not None else "n/a")
+        p(f"  overlapped with in-flight fit: {h2d_overlap_s:.3f} s "
+          f"({ov_pct:.1f}%)  ->  link stall "
+          f"fraction {stall} (copy time the fit "
+          "stage could not hide; lower pipeline stalls = raise "
+          "stream_pipeline_depth only if this is high AND devices "
+          "idle)")
+        per_dev_h2d = {}
+        for ev in h2d:
+            d = per_dev_h2d.setdefault(ev["device"], [0, 0.0, 0.0])
+            d[0] += int(ev["bytes"])
+            d[1] += float(ev["h2d_s"])
+            d[2] += float(ev["h2d_s"]) if ev.get("overlap") else 0.0
+        for dev in sorted(per_dev_h2d):
+            b, s, o = per_dev_h2d[dev]
+            p(f"  dev{dev}: {b / 1e6:.2f} MB, {s:.3f} s, "
+              f"{100 * (o / s if s else 0.0):.1f}% overlapped")
+    else:
+        p("  (no h2d events — pre-pipeline trace, or no dispatches)")
+
     # ---- checkpoint stalls / stragglers -----------------------------
     flushes = by_type.get("ckpt_flush", [])
     forces = by_type.get("force_flush", [])
@@ -597,6 +652,10 @@ def report(path, file=None):
                           if gauges else peak_run),
         "n_cold": n_cold,
         "cold_s": cold_s,
+        "n_h2d": len(h2d),
+        "h2d_bytes": h2d_bytes,
+        "h2d_s": h2d_s,
+        "h2d_stall_frac": h2d_stall_frac,
         "n_quality": len(snr),
         "n_force_flush": len(forces),
         "n_skipped": len(skips),

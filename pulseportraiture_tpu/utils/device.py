@@ -21,6 +21,66 @@ def host_compute():
     return jax.default_device(jax.local_devices(backend="cpu")[0])
 
 
+_compile_cache_dir = None  # what enable_compile_cache last applied
+
+
+def enable_compile_cache(path=None):
+    """Route jax's persistent compilation cache to ``path`` (None =
+    ``config.compile_cache_dir``), so fleet restarts stop re-paying
+    the per-(bucket shape x device) trace + XLA compile cold start
+    (ROADMAP item 5).  The thresholds are zeroed so even the small
+    CPU-test programs cache — campaign bucket programs are far above
+    any default cutoff anyway.
+
+    Returns the applied directory, or None when unconfigured.
+    Idempotent: re-applying the same path is free; the streaming
+    executor calls this on every construction so a config flip (or
+    PPT_COMPILE_CACHE) takes effect without restart."""
+    global _compile_cache_dir
+    from .. import config
+
+    if path is None:
+        path = getattr(config, "compile_cache_dir", None)
+    if not path:
+        # unconfigure: a flip BACK to off (PPT_COMPILE_CACHE=off over a
+        # config default) must stop routing compiles to the old dir,
+        # not silently keep the previous cache
+        if _compile_cache_dir is not None:
+            jax.config.update("jax_compilation_cache_dir", None)
+            try:
+                from jax._src import compilation_cache as _cc
+                _cc.reset_cache()
+            except Exception:
+                pass
+            _compile_cache_dir = None
+        return None
+    path = str(path)
+    if path == _compile_cache_dir:
+        return path
+    import os
+
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache every program: the defaults skip fast-compiling entries,
+    # which is exactly the K-small-shapes lattice a campaign compiles
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(knob, val)
+        except AttributeError:
+            pass  # older jax: threshold knob absent, cache still works
+    try:
+        # jax initializes its cache singleton at most once per process;
+        # a dir configured AFTER the first compile would be silently
+        # ignored without this reset
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:
+        pass
+    _compile_cache_dir = path
+    return path
+
+
 def on_host(fn):
     """Decorator: run the whole function under host_compute().
 

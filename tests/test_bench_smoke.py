@@ -40,7 +40,13 @@ TINY_ENV = {
                      "PPT_TELEMETRY": ""},
     "bench_campaign": {"PPT_NARCH": "2", "PPT_NSUB": "2",
                        "PPT_NCHAN": "16", "PPT_NBIN": "128",
-                       "PPT_CAMPAIGN_CACHE": ""},
+                       "PPT_CAMPAIGN_CACHE": "",
+                       # ISSUE 6: the link-bound bench runs its
+                       # depth-1-vs-N transfer-pipeline A/B under
+                       # telemetry; the emitted h2d events must
+                       # validate against the schema so copy-stage
+                       # drift fails in CI
+                       "PPT_TELEMETRY": ""},
     "bench_ipta": {"PPT_NPSR": "1", "PPT_NARCH": "2", "PPT_NSUB": "2",
                    "PPT_NCHAN": "16", "PPT_NBIN": "128"},
 }
@@ -109,3 +115,27 @@ def test_bench_smoke(name, monkeypatch, capsys, tmp_path):
         dispatches = [e for e in events if e["type"] == "dispatch"]
         last_run = [e for e in events if e["type"] == "run_end"][-1]
         assert len(dispatches) >= last_run["nfit"]
+    if name == "bench_campaign":
+        # ISSUE 6: the reworked link-bound bench must report both
+        # pipeline arms with byte-identical .tim output and emit
+        # schema-valid h2d events (validated inside the bench via
+        # telemetry.report; re-checked structurally here)
+        assert out["tim_identical"] is True
+        assert set(out["pipeline"]) == {"1", "2"}
+        for arm in out["pipeline"].values():
+            assert arm["toas_per_sec"] > 0
+            assert arm["h2d_bytes"] > 0 and arm["h2d_s"] >= 0
+            # PPT_TELEMETRY was set: the pptrace link numbers rode in
+            assert "link_stall_frac" in arm
+        assert out["pipeline_speedup"] > 0
+        from pulseportraiture_tpu import telemetry
+
+        for depth in ("1", "2"):
+            trace = str(tmp_path / "trace.jsonl") + f".d{depth}"
+            assert os.path.exists(trace), f"no depth-{depth} trace"
+            manifest, events = telemetry.validate_trace(trace)
+            h2d_done = [e for e in events if e["type"] == "h2d_done"]
+            assert h2d_done, "bench_campaign emitted no h2d events"
+            for ev in h2d_done:
+                assert ev["bytes"] > 0 and ev["h2d_s"] >= 0
+                assert isinstance(ev["overlap"], bool)

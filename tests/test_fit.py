@@ -452,6 +452,11 @@ def test_cgh_scatter_matches_autodiff():
                 assert C1.shape == S1.shape == (nchan,)
 
 
+@pytest.mark.slow  # ~19 s two-engine parity sweep (tier-1 budget,
+# r10): fast-vs-complex scattering parity stays tier-1 via
+# test_stream_fast_lane_scattering_parity (driver level) and the
+# directed option-lattice scatter arm; this direct IR/no-IR sweep
+# rides the slow tier with the precision-floor gates below
 def test_fast_scatter_lane_matches_complex_engine(key):
     """The complex-free scattering lane (fit_portrait_batch_fast with
     tau/alpha active -> fast_scatter_fit_one) must agree with the
@@ -564,6 +569,10 @@ def test_two_product_and_dot2_exactness():
         (got, want, plain)
 
 
+@pytest.mark.slow  # ~28 s precision-floor gate (tier-1 budget, r10):
+# the scatter lane's FUNCTIONAL coverage stays tier-1 via the tau
+# recovery tests, the stream scattering-parity test, and the directed
+# option-lattice subset; this sweep guards the extreme-S/N floor only
 def test_f32_scatter_tau_resolution_high_snr(key):
     """The f32 scattering lane resolves tau far below the old ~0.3%
     convergence floor at extreme S/N (VERDICT round 2, weak #3): the
@@ -597,6 +606,10 @@ def test_f32_scatter_tau_resolution_high_snr(key):
         assert np.abs(rels).max() < gate, (comp, rels)
 
 
+@pytest.mark.slow  # ~24 s compensated-mode guard (tier-1 budget,
+# r10): compensated mode is off by default and this guards its
+# extreme-S/N bit-identity only, so it rides the slow tier with the
+# other Dot2 floor gates
 def test_compensated_forces_f32_cross_spectrum(key):
     """scatter_compensated=True must not be silently degraded by the
     bf16 cross-spectrum default: the fast lane forces full-precision X
@@ -625,6 +638,8 @@ def test_compensated_forces_f32_cross_spectrum(key):
     assert float(r_bf16.phi) == float(r_f32.phi)
 
 
+@pytest.mark.slow  # ~15 s precision-floor gate (tier-1 budget, r10);
+# rides the slow tier with its real-lane twin above
 def test_complex_engine_compensated_ftol(key):
     """The complex engine forwards `compensated` into the scatter ftol
     (ADVICE r3: it used to stop at the plain 1e-8 threshold, leaving a

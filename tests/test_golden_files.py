@@ -249,9 +249,20 @@ def test_signed_byte_data_tzero(tmp_path):
     _check_amps(arch, stored, atol=0.05)
     prof = np.asarray(arch.amps)[0, 0, 4]
     assert np.corrcoef(prof, stored[0, 0, 4])[0, 1] > 0.999
-    # raw streaming mode cannot represent a scaled column: clean refusal
-    with pytest.raises(ValueError, match="int16"):
-        read_archive(p, decode=False)
+    # raw streaming mode carries the signed-byte convention since r10:
+    # the payload ships as stored unsigned bytes and the DEVICE decode
+    # removes the TZERO=-128 bias exactly (ops/decode code 'i8')
+    raw = read_archive(p, decode=False)
+    assert raw.raw_code == "i8"
+    assert raw.raw_data.dtype == np.uint8
+    dec = (raw.raw_data.astype(np.float64) - 128.0) \
+        * np.asarray(raw.raw_scl, np.float64)[..., None] \
+        + np.asarray(raw.raw_offs, np.float64)[..., None]
+    np.testing.assert_allclose(dec, stored, rtol=0, atol=1e-6)
+    # layouts raw mode still cannot represent refuse cleanly
+    forge_archive(str(tmp_path / "nbit.fits"), data_dtype="nbit4")
+    with pytest.raises(ValueError, match="int16/byte/float32"):
+        read_archive(str(tmp_path / "nbit.fits"), decode=False)
 
 
 def test_chan_dm_fallback_and_dedispersion(tmp_path):

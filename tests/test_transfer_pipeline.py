@@ -1,0 +1,322 @@
+"""ISSUE 6: the double-buffered transfer pipeline and the universal
+raw device lane.
+
+Covers: depth-1 vs depth-2 byte-identity (+ the exact in-flight
+bound), raw-vs-decoded digit-identity for every newly supported DATA
+sample type (u8, signed byte, float32) and multi-pol state (4-pol
+IQUV, AA+BB), the h2d_start/h2d_done telemetry schema and pptrace's
+link section, the PPT_PIPELINE_DEPTH / PPT_COMPILE_CACHE env hooks,
+and the persistent compilation cache wiring.  All shapes tiny
+(nchan <= 16, nbin <= 256) per the tier-1 budget."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu import config, telemetry
+from pulseportraiture_tpu.pipeline import stream as S
+
+from fits_forge import forge_archive, gaussian_portrait
+
+
+def _noisy_maker(nchan, nbin, nsub, npol, seed=3, sigma=0.08):
+    """Gaussian portrait + per-(subint, pol) noise: a noiseless forge
+    makes chi2 astronomically conditioned (data == template exactly),
+    where host-FFT-vs-device-DFT rounding at 1e-16 shows in the 11th
+    digit of the -snr flag; realistic noise is what the lanes meet."""
+    base = gaussian_portrait(nchan, nbin)
+    rng = np.random.default_rng(seed)
+    noise = {(s, p): rng.normal(0.0, sigma, (nchan, nbin))
+             for s in range(nsub) for p in range(npol)}
+    return lambda s, p: base * (1.0 + 0.1 * p) + 0.1 * s + noise[(s, p)]
+
+
+def _forge_and_template(tmp_path, name, **kw):
+    """Forge one noisy archive + a template built from its scrunch."""
+    from pulseportraiture_tpu.io.psrfits import (read_archive,
+                                                 unload_new_archive)
+
+    nsub, nchan, nbin = 2, 8, 128
+    npol = kw.get("npol", 1)
+    f = str(tmp_path / f"{name}.fits")
+    forge_archive(f, nsub=nsub, nchan=nchan, nbin=nbin, dedisp=0,
+                  data_maker=_noisy_maker(nchan, nbin, nsub, npol),
+                  **kw)
+    arch = read_archive(f)
+    arch.tscrunch()
+    tmpl = str(tmp_path / f"{name}_tmpl.fits")
+    unload_new_archive(np.asarray(arch.amps), arch, tmpl, DM=0.0,
+                      dmc=1, quiet=True)
+    return f, tmpl
+
+
+# ---------------------------------------------------------------------------
+# universal raw lane: every sample type / pol state, digit-identical
+# ---------------------------------------------------------------------------
+
+RAW_CASES = {
+    # name -> (forge kwargs, expected raw_code, expected pol_sum)
+    "u8": (dict(data_dtype="u1"), "u8", False),
+    "i8": (dict(data_dtype="i1"), "i8", False),
+    "f32be": (dict(data_dtype=">f4"), "f32", False),
+    "iquv4": (dict(data_dtype=">i2", npol=4, pol_type="IQUV"),
+              "i16", False),
+    "aabb": (dict(data_dtype=">i2", npol=2, pol_type="AA+BB"),
+             "i16", True),
+}
+
+
+@pytest.mark.parametrize("case", sorted(RAW_CASES))
+def test_raw_lane_universal_digit_identical(case, tmp_path,
+                                            monkeypatch):
+    """The raw device lane must (a) actually engage for the new
+    sample types / pol states and (b) produce .tim output
+    digit-identical to the decoded host lane (the oracle)."""
+    kw, want_code, want_sum = RAW_CASES[case]
+    f, tmpl = _forge_and_template(tmp_path, case, **kw)
+
+    d = S._load_raw(f)
+    assert d.raw_code == want_code
+    assert d.pol_sum is want_sum
+    if want_sum:
+        assert d.raw.shape[1] == 2  # two summand pols ship
+
+    tim_raw = str(tmp_path / "raw.tim")
+    r1 = S.stream_wideband_TOAs([f], tmpl, nsub_batch=4, quiet=True,
+                                tim_out=tim_raw)
+    assert len(r1.TOA_list) == 2
+    assert r1.h2d_bytes > 0
+
+    # force the decoded fallback lane (the digit-exactness oracle)
+    def refuse(path):
+        raise ValueError("forced decode for the oracle arm")
+
+    monkeypatch.setattr(S, "_load_raw", refuse)
+    tim_dec = str(tmp_path / "dec.tim")
+    r2 = S.stream_wideband_TOAs([f], tmpl, nsub_batch=4, quiet=True,
+                                tim_out=tim_dec)
+    assert len(r2.TOA_list) == 2
+    assert open(tim_raw).read() == open(tim_dec).read()
+
+
+def test_raw_refuses_sub_byte_and_scaled(tmp_path):
+    """Layouts raw mode cannot represent keep refusing loudly (the
+    loader then falls back to the decoded lane)."""
+    nchan, nbin = 8, 64
+    f = str(tmp_path / "nbit4.fits")
+    forge_archive(f, nsub=1, nchan=nchan, nbin=nbin,
+                  data_dtype="nbit4")
+    with pytest.raises(ValueError):
+        S._load_raw(f)
+
+
+# ---------------------------------------------------------------------------
+# the transfer pipeline: depth A/B, exact bound, telemetry
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def pipeline_corpus(tmp_path_factory):
+    """Three tiny int16 archives + template, shared by the depth A/B
+    and telemetry tests."""
+    from pulseportraiture_tpu.io import write_gmodel
+    from pulseportraiture_tpu.synth import (default_test_model,
+                                            make_fake_pulsar)
+    from pulseportraiture_tpu.utils.mjd import MJD
+
+    tmp = tmp_path_factory.mktemp("tpipe")
+    model = default_test_model(1500.0)
+    gmodel = str(tmp / "m.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files = []
+    for i in range(3):
+        p = str(tmp / f"a{i}.fits")
+        make_fake_pulsar(model, {"PSR": "TP", "P0": 0.003, "DM": 10.0,
+                                 "PEPOCH": 55000.0},
+                         outfile=p, nsub=2, nchan=16, nbin=128,
+                         dDM=2e-4 * i, start_MJD=MJD(55100 + i, 0.1),
+                         noise_stds=0.05, dedispersed=False,
+                         quiet=True, rng=i)
+        files.append(p)
+    return tmp, files, gmodel
+
+
+def test_pipeline_depth_byte_identical_and_bounded(pipeline_corpus):
+    """depth=1 (serialized copy/fit, the pre-pipeline arm) and
+    depth=2 (double-buffered) must produce byte-identical .tim and
+    TOA fields, and the exact per-device in-flight bound must hold
+    with the pipeline in front of it."""
+    tmp, files, gmodel = pipeline_corpus
+    outs = {}
+    for depth in (1, 2):
+        tim = str(tmp / f"d{depth}.tim")
+        res = S.stream_wideband_TOAs(
+            files, gmodel, nsub_batch=2, quiet=True, tim_out=tim,
+            pipeline_depth=depth, max_inflight=2)
+        assert res.peak_inflight <= 2
+        assert res.h2d_bytes > 0 and res.h2d_duration >= 0.0
+        outs[depth] = (open(tim).read(),
+                       [(t.MJD.tim_string(), t.TOA_error, dict(t.flags))
+                        for t in res.TOA_list])
+    assert outs[1] == outs[2]
+
+
+def test_h2d_telemetry_schema_and_report(pipeline_corpus):
+    """A traced pipelined run emits schema-valid h2d_start/h2d_done
+    pairs (one per dispatch, keyed by seq, byte counts positive) and
+    pptrace's link section aggregates them."""
+    tmp, files, gmodel = pipeline_corpus
+    trace = str(tmp / "trace.jsonl")
+    res = S.stream_wideband_TOAs(files, gmodel, nsub_batch=2,
+                                 quiet=True, telemetry=trace,
+                                 pipeline_depth=2)
+    manifest, events = telemetry.validate_trace(trace)
+    assert manifest["config"]["stream_pipeline_depth"] == \
+        config.stream_pipeline_depth
+    starts = {e["seq"]: e for e in events if e["type"] == "h2d_start"}
+    dones = {e["seq"]: e for e in events if e["type"] == "h2d_done"}
+    dispatches = {e["seq"] for e in events if e["type"] == "dispatch"}
+    assert len(dones) == res.nfit
+    assert set(starts) == set(dones) == dispatches
+    assert sum(e["bytes"] for e in dones.values()) == res.h2d_bytes
+    for seq, e in dones.items():
+        assert e["bytes"] > 0 and e["h2d_s"] >= 0.0
+        assert isinstance(e["overlap"], bool)
+        assert starts[seq]["device"] == e["device"]
+    run_end = [e for e in events if e["type"] == "run_end"][-1]
+    assert run_end["h2d_bytes"] == res.h2d_bytes
+    assert run_end["pipeline_depth"] == 2
+
+    summary = telemetry.report(trace, file=io.StringIO())
+    assert summary["n_h2d"] == res.nfit
+    assert summary["h2d_bytes"] == res.h2d_bytes
+    assert summary["h2d_s"] >= 0.0
+    sf = summary["h2d_stall_frac"]
+    assert sf is None or 0.0 <= sf <= 1.0
+
+
+def test_report_tolerates_pre_pipeline_traces(tmp_path):
+    """Traces written before the transfer pipeline (no h2d events)
+    must still report — the link section just says so."""
+    trace = str(tmp_path / "old.jsonl")
+    tr = telemetry.Tracer(trace, run="old")
+    tr.emit("run_end", driver="x", n_toas=0, nfit=0)
+    tr.close()
+    buf = io.StringIO()
+    summary = telemetry.report(trace, file=buf)
+    assert summary["n_h2d"] == 0
+    assert summary["h2d_stall_frac"] is None
+    assert "no h2d events" in buf.getvalue()
+
+
+def test_pipeline_depth_config_and_env(monkeypatch):
+    """config.stream_pipeline_depth default, the PPT_PIPELINE_DEPTH /
+    PPT_COMPILE_CACHE env hooks, and their strict parses."""
+    assert config.stream_pipeline_depth >= 1
+    monkeypatch.setenv("PPT_PIPELINE_DEPTH", "3")
+    monkeypatch.setenv("PPT_COMPILE_CACHE", "/tmp/ppt-cc-test")
+    saved = (config.stream_pipeline_depth, config.compile_cache_dir)
+    try:
+        changed = config.env_overrides()
+        assert "stream_pipeline_depth" in changed
+        assert "compile_cache_dir" in changed
+        assert config.stream_pipeline_depth == 3
+        assert config.compile_cache_dir == "/tmp/ppt-cc-test"
+        monkeypatch.setenv("PPT_COMPILE_CACHE", "off")
+        config.env_overrides()
+        assert config.compile_cache_dir is None
+        monkeypatch.setenv("PPT_PIPELINE_DEPTH", "0")
+        with pytest.raises(ValueError):
+            config.env_overrides()
+        monkeypatch.setenv("PPT_PIPELINE_DEPTH", "two")
+        with pytest.raises(ValueError):
+            config.env_overrides()
+    finally:
+        config.stream_pipeline_depth, config.compile_cache_dir = saved
+
+
+def test_compile_cache_populates(tmp_path, monkeypatch):
+    """enable_compile_cache routes jax's persistent cache to the
+    configured directory and compiled programs land there (ROADMAP
+    item 5 down payment — fleet restarts skip the recompile)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pulseportraiture_tpu.utils import device as D
+
+    cache = str(tmp_path / "cc")
+    monkeypatch.setattr(D, "_compile_cache_dir", None)
+    monkeypatch.setattr(config, "compile_cache_dir", cache)
+    try:
+        assert D.enable_compile_cache() == cache
+        fn = jax.jit(lambda x: jnp.cos(x) @ x.T * 2.0)
+        jax.block_until_ready(fn(jnp.ones((32, 32))))
+        assert os.listdir(cache), "no cache entries written"
+        # idempotent re-apply
+        assert D.enable_compile_cache() == cache
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+        monkeypatch.setattr(D, "_compile_cache_dir", None)
+
+
+def test_pptoas_pipeline_flags_validate():
+    """--pipeline-depth needs --stream and a sane value (cheap parse-
+    level checks; the e2e plumbing rides test_cli's stream runs)."""
+    from pulseportraiture_tpu.cli import pptoas
+
+    with pytest.raises(SystemExit):
+        pptoas.main(["-d", "x.fits", "-m", "m.gmodel",
+                     "--pipeline-depth", "2"])
+    with pytest.raises(SystemExit):
+        pptoas.main(["-d", "x.fits", "-m", "m.gmodel", "--stream",
+                     "--pipeline-depth", "0"])
+
+
+def test_ops_decode_units():
+    """ops/decode: the signed-byte bias is removed exactly BEFORE
+    scl/offs (bit-matching the host decode order), and pol_sum
+    refuses payloads without a pol axis."""
+    import jax.numpy as jnp
+
+    from pulseportraiture_tpu.ops.decode import affine_decode
+
+    raw = np.array([[[0, 128, 255, 7]]], np.uint8)  # (1, 1, 4)
+    scl = np.array([[0.5]])
+    offs = np.array([[1.0]])
+    got = np.asarray(affine_decode(jnp.asarray(raw), jnp.asarray(scl),
+                                   jnp.asarray(offs), jnp.float64,
+                                   code="i8"))
+    want = (raw.astype(np.float64) - 128.0) * 0.5 + 1.0
+    assert np.array_equal(got, want)
+    got_u8 = np.asarray(affine_decode(jnp.asarray(raw),
+                                      jnp.asarray(scl),
+                                      jnp.asarray(offs), jnp.float64,
+                                      code="u8"))
+    assert np.array_equal(got_u8, raw * 0.5 + 1.0)
+    with pytest.raises(ValueError):
+        affine_decode(jnp.asarray(raw), jnp.asarray(scl),
+                      jnp.asarray(offs), jnp.float64, code="i4")
+
+    # pol_sum: the two summand pols are baselined PER POL then summed
+    # (host rm_baseline -> pscrunch order), and a payload without a
+    # pol axis refuses
+    from pulseportraiture_tpu.ops.decode import decode_stokes_I
+    from pulseportraiture_tpu.ops.noise import min_window_baseline
+
+    rng = np.random.default_rng(11)
+    raw2 = rng.integers(0, 255, (1, 2, 3, 64)).astype(np.uint8)
+    scl2 = np.ones((1, 2, 3))
+    offs2 = np.zeros((1, 2, 3))
+    got2 = np.asarray(decode_stokes_I(
+        jnp.asarray(raw2), jnp.asarray(scl2), jnp.asarray(offs2),
+        jnp.float64, code="u8", pol_sum=True))
+    per_pol = raw2.astype(np.float64)
+    per_pol = per_pol - np.asarray(
+        min_window_baseline(jnp.asarray(per_pol)))[..., None]
+    np.testing.assert_allclose(got2, per_pol[:, 0] + per_pol[:, 1],
+                               rtol=0, atol=1e-12)
+    with pytest.raises(ValueError):
+        decode_stokes_I(jnp.asarray(raw2[:, 0]), jnp.asarray(scl2[:, 0]),
+                        jnp.asarray(offs2[:, 0]), jnp.float64,
+                        code="u8", pol_sum=True)
