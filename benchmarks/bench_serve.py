@@ -1,0 +1,205 @@
+"""Serving-loop benchmark (ISSUE 8 acceptance gate): warm-server
+steady-state throughput vs the one-shot streaming driver at the same
+shape, plus request-latency percentiles under an offered-load sweep.
+
+Arms:
+  oneshot   — stream_wideband_TOAs over the whole campaign (the
+              bench_campaign measurement, re-run here so the ratio is
+              apples-to-apples in one process);
+  serve@R   — a warm ToaServer fed R concurrent client threads, each
+              submitting an equal slice of the same archives against
+              the same template (requests coalesce into shared fused
+              buckets).  Measured from first submit to last result;
+              per-request latencies give p50/p99.
+
+The gate: serve@R throughput within 1.1x of oneshot (the serving loop
+must not tax steady state) — reported as ``serve_vs_oneshot`` (>= 1/1.1
+passes).  PPT_TUNNEL_EMU="<mbps>[:<dispatch_ms>]" applies the same
+tunneled-transport emulation bench_campaign documents (throttled
+device_put + synchronous dispatch floor), so the serve loop is also
+measurable under the transport it exists for.
+
+Knobs via env: PPT_NARCH (default 32), PPT_NSUB (16), PPT_NCHAN (64),
+PPT_NBIN (256), PPT_NREQ (4 — the offered-load sweep runs 1 and NREQ),
+PPT_SERVE_MAX_WAIT_MS (bucket deadline).  The synthetic campaign is
+cached under PPT_CAMPAIGN_CACHE (default /tmp/ppt_campaign, shared
+with bench_campaign).  When PPT_TELEMETRY is set the serve arm traces
+to <path>.serve and the trace is schema-validated (request_done +
+batch_coalesce events) — the serve-section drift guard CI runs at tiny
+shapes (tests/test_bench_smoke.py).  Prints ONE JSON line.
+"""
+
+import io
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    import pulseportraiture_tpu  # noqa: F401
+    from pulseportraiture_tpu import config
+    config.dft_precision = "default"
+    config.cross_spectrum_dtype = "bfloat16"
+    config.env_overrides()
+
+    import jax
+
+    from pulseportraiture_tpu import telemetry
+    from pulseportraiture_tpu.io.gmodel import write_gmodel
+    from pulseportraiture_tpu.pipeline.stream import stream_wideband_TOAs
+    from pulseportraiture_tpu.serve import ToaServer
+    from pulseportraiture_tpu.synth import default_test_model
+    from pulseportraiture_tpu.synth.archive import make_fake_pulsar
+
+    NARCH = int(os.environ.get("PPT_NARCH", 32))
+    NSUB = int(os.environ.get("PPT_NSUB", 16))
+    NCHAN = int(os.environ.get("PPT_NCHAN", 64))
+    NBIN = int(os.environ.get("PPT_NBIN", 256))
+    NREQ = max(1, int(os.environ.get("PPT_NREQ", 4)))
+    TUNNEL = os.environ.get("PPT_TUNNEL_EMU", "")
+    PAR = {"PSR": "FAKE", "P0": 0.003, "DM": 50.0, "PEPOCH": 56000.0}
+    cache = os.environ.get("PPT_CAMPAIGN_CACHE", "/tmp/ppt_campaign")
+    tag = f"{NARCH}x{NSUB}x{NCHAN}x{NBIN}"
+    root = os.path.join(cache, tag)
+    os.makedirs(root, exist_ok=True)
+    trace_base = config.telemetry_path  # PPT_TELEMETRY (or None)
+
+    mpath = os.path.join(root, "model.gmodel")
+    if not os.path.exists(mpath):
+        write_gmodel(default_test_model(1500.0), mpath, quiet=True)
+    files = []
+    for i in range(NARCH):
+        path = os.path.join(root, f"a{i:04d}.fits")
+        if not os.path.exists(path):
+            make_fake_pulsar(mpath, PAR, outfile=path, nsub=NSUB,
+                             nchan=NCHAN, nbin=NBIN, nu0=1500.0, bw=600.0,
+                             phase=0.01 * (i % 50), dDM=1e-4 * (i % 40),
+                             noise_stds=0.05, quiet=True, rng=i)
+        files.append(path)
+
+    # ---- optional tunneled-transport emulation (bench_campaign's) ---
+    from pulseportraiture_tpu.pipeline import stream as S
+    unpatch = []
+    if TUNNEL:
+        parts = TUNNEL.split(":")
+        mbps = float(parts[0])
+        disp_ms = float(parts[1]) if len(parts) > 1 else 100.0
+        real_put = jax.device_put
+
+        def throttled_put(x, device=None, **kw):
+            out = real_put(x, device, **kw)
+            time.sleep(getattr(x, "nbytes", 0) / (mbps * 1e6))
+            return out
+
+        real_fit_fn = S._raw_fit_fn
+
+        def sync_fit_fn(*a, **kw):
+            fn = real_fit_fn(*a, **kw)
+
+            def run(*args):
+                out = jax.block_until_ready(fn(*args))
+                time.sleep(disp_ms / 1e3)  # tunnel round-trip floor
+                return out
+
+            return run
+
+        jax.device_put = throttled_put
+        S._raw_fit_fn = sync_fit_fn
+        unpatch = [(jax, "device_put", real_put),
+                   (S, "_raw_fit_fn", real_fit_fn)]
+
+    try:
+        # warm the jit caches once so BOTH arms measure steady state
+        stream_wideband_TOAs(files[:1], mpath, nsub_batch=64, quiet=True)
+
+        # ---- one-shot arm ------------------------------------------
+        t0 = time.perf_counter()
+        res = stream_wideband_TOAs(files, mpath, nsub_batch=64,
+                                   quiet=True)
+        oneshot_wall = time.perf_counter() - t0
+        ntoa = len(res.TOA_list)
+        oneshot_tps = ntoa / oneshot_wall
+
+        # ---- serve arms: offered-load sweep ------------------------
+        sweep = []
+        for conc in sorted({1, NREQ}):
+            trace = (f"{trace_base}.serve{conc}" if trace_base
+                     else None)
+            srv = ToaServer(nsub_batch=64, telemetry=trace,
+                            quiet=True).start()
+            slices = [files[i::conc] for i in range(conc)]
+            lat = [None] * conc
+            errs = []
+
+            def client(i):
+                t = time.perf_counter()
+                try:
+                    srv.submit(slices[i], mpath,
+                               name=f"load{i}").result(3600)
+                except Exception as e:  # surfaced after join
+                    errs.append(e)
+                    return
+                lat[i] = time.perf_counter() - t
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(conc)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            srv.stop()
+            if errs:
+                raise errs[0]
+            lat_sorted = sorted(lat)
+            arm = {
+                "concurrency": conc,
+                "toas_per_sec": round(ntoa / wall, 2),
+                "wall_s": round(wall, 3),
+                "p50_s": round(lat_sorted[len(lat_sorted) // 2], 4),
+                "p99_s": round(lat_sorted[-1], 4),
+            }
+            if trace:
+                summary = telemetry.report(trace, file=io.StringIO())
+                assert summary["n_requests"] == conc, (
+                    f"{summary['n_requests']} request_done events for "
+                    f"{conc} clients")
+                assert summary["n_coalesce"] > 0, \
+                    "serve arm emitted no batch_coalesce events"
+                arm["batch_occupancy"] = (
+                    round(summary["batch_occupancy"], 3)
+                    if summary["batch_occupancy"] is not None else None)
+            sweep.append(arm)
+    finally:
+        for obj, name, val in unpatch:
+            setattr(obj, name, val)
+
+    top = sweep[-1]
+    print(json.dumps({
+        "metric": f"served campaign TOAs incl. PSRFITS IO, {NARCH} "
+                  f"archives x {NSUB}sub x {NCHAN}ch x {NBIN}bin, "
+                  f"{top['concurrency']} concurrent client(s) vs "
+                  "one-shot",
+        "value": top["toas_per_sec"],
+        "unit": "TOAs/sec",
+        "toas": ntoa,
+        "oneshot_toas_per_sec": round(oneshot_tps, 2),
+        "serve_vs_oneshot": round(top["toas_per_sec"]
+                                  / max(oneshot_tps, 1e-9), 3),
+        "serve_within_1p1x": bool(top["toas_per_sec"] * 1.1
+                                  >= oneshot_tps),
+        "p50_s": top["p50_s"],
+        "p99_s": top["p99_s"],
+        "sweep": sweep,
+        "tunnel_emu": TUNNEL or None,
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,7 @@ fit       - fit engines (1-D FFTFIT, 2-D..5-param portrait fit, LM)
 models    - template portrait models (gaussian, spline/PCA, wavelet)
 io        - PSRFITS / model-file / TOA-file I/O (no PSRCHIVE dependency)
 pipeline  - high-level pipelines (toas, align, spline, gauss, zap)
+serve     - continuous-batching TOA service (warm executor, ppserve)
 parallel  - device-mesh sharding helpers
 telemetry - campaign event tracing, run manifests, pptrace report
 synth     - synthetic data generation (the test fixture)

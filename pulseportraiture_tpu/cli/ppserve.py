@@ -1,0 +1,240 @@
+"""ppserve — run the continuous-batching TOA service over a request
+set.
+
+The serving loop (serve/server.ToaServer) keeps ONE warm stream
+executor alive and coalesces compatible subints across requests into
+shared fused dispatches; this CLI is its batch client: it reads a
+JSONL request file, submits every request concurrently through the
+bounded admission queue (retrying politely on backpressure), waits for
+all results, and writes one ``<name>.tim`` per request — each
+byte-identical to what the one-shot ``pptoas --stream`` would produce
+for the same archives.
+
+Request file: one JSON object per line —
+    {"name": "J0030+0451", "datafiles": ["a.fits", ...] | "meta.txt",
+     "modelfile": "J0030.spl", "options": {"fit_scat": true, ...}}
+``options`` are stream_wideband_TOAs fit options (lane options);
+requests sharing (modelfile, options) coalesce.
+
+``--warmup-manifest trace.jsonl`` AOT-compiles every dispatch shape a
+prior run's telemetry trace recorded before serving starts
+(utils/device.warmup_from_manifest), so the first requests skip the
+cold-start compiles; gate the before/after with ``--telemetry`` and
+``tools/pptrace.py report`` (cold-start + serve sections).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="ppserve", description=__doc__.splitlines()[0])
+    p.add_argument("-r", "--requests", metavar="requests.jsonl",
+                   required=True,
+                   help="JSONL request file (one JSON object per "
+                        "line: name, datafiles, modelfile, options).")
+    p.add_argument("-O", "--outdir", metavar="DIR", default=".",
+                   help="Directory for per-request <name>.tim outputs "
+                        "(created). [default: .]")
+    p.add_argument("--nsub-batch", dest="nsub_batch", type=int,
+                   default=64, metavar="N",
+                   help="Fused-bucket row count (the compiled batch "
+                        "shape class). [default: 64]")
+    p.add_argument("--max-wait-ms", dest="max_wait_ms", type=float,
+                   default=None, metavar="MS",
+                   help="Deadline for partially-filled buckets: a "
+                        "bucket launches when full OR when its oldest "
+                        "subint has waited this long. [default: "
+                        "config.serve_max_wait_ms / "
+                        "PPT_SERVE_MAX_WAIT_MS]")
+    p.add_argument("--queue-depth", dest="queue_depth", type=int,
+                   default=None, metavar="N",
+                   help="Admission-queue capacity in archives; a "
+                        "submit beyond it is rejected loudly "
+                        "(backpressure). [default: "
+                        "config.serve_queue_depth / "
+                        "PPT_SERVE_QUEUE_DEPTH]")
+    p.add_argument("--stream-devices", dest="stream_devices",
+                   default=None, metavar="auto|N",
+                   help="Local devices to deal fused buckets across "
+                        "('auto' = all, or a count). [default: "
+                        "config.stream_devices]")
+    p.add_argument("--max-inflight", dest="max_inflight", type=int,
+                   default=None, metavar="N",
+                   help="Pending fused dispatches per device before "
+                        "the loop blocks on the oldest. [default: "
+                        "config.stream_max_inflight]")
+    p.add_argument("--pipeline-depth", dest="pipeline_depth",
+                   default=None, type=int, metavar="N",
+                   help="Per-device copy->fit transfer-pipeline "
+                        "depth. [default: config.stream_pipeline_depth]")
+    p.add_argument("--warmup-manifest", dest="warmup_manifest",
+                   default=None, metavar="trace.jsonl",
+                   help="AOT-compile every dispatch shape this prior "
+                        "telemetry trace records before serving "
+                        "starts (kills the cold-start compiles).")
+    p.add_argument("--warmup-model", dest="warmup_model", default=None,
+                   metavar="model",
+                   help="Template whose portrait shapes the warmup "
+                        "programs (with --warmup-manifest). "
+                        "[default: synthetic profile]")
+    p.add_argument("--telemetry", metavar="trace.jsonl", default=None,
+                   help="Write the serve trace (request lifecycle, "
+                        "batch_coalesce occupancy, cold starts) here; "
+                        "analyze with tools/pptrace.py. Also via "
+                        "PPT_TELEMETRY. [default: off]")
+    p.add_argument("--compile-cache", dest="compile_cache",
+                   default=None, metavar="DIR",
+                   help="Persistent jax compilation cache directory "
+                        "(restarts skip the XLA compiles). Also via "
+                        "PPT_COMPILE_CACHE. [default: off]")
+    p.add_argument("--timeout", type=float, default=None, metavar="S",
+                   help="Per-request result timeout in seconds. "
+                        "[default: none]")
+    p.add_argument("--quiet", action="store_true", default=False)
+    return p
+
+
+def parse_requests(path):
+    """Read + validate the JSONL request file -> list of dicts with
+    name/datafiles/modelfile/options.  Loud SystemExit on anything
+    malformed (a silently-dropped request line is a lost pulsar)."""
+    if not os.path.exists(path):
+        raise SystemExit(f"ppserve: request file not found: {path}")
+    reqs, names = [], set()
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise SystemExit(
+                    f"ppserve: {path}:{lineno}: bad JSON: {e}")
+            if not isinstance(rec, dict):
+                raise SystemExit(
+                    f"ppserve: {path}:{lineno}: expected an object")
+            missing = {"datafiles", "modelfile"} - set(rec)
+            if missing:
+                raise SystemExit(
+                    f"ppserve: {path}:{lineno}: missing "
+                    f"{sorted(missing)}")
+            name = str(rec.get("name", f"req{lineno}"))
+            if name in names:
+                raise SystemExit(
+                    f"ppserve: {path}:{lineno}: duplicate request "
+                    f"name {name!r} (each writes <name>.tim)")
+            names.add(name)
+            options = rec.get("options", {})
+            if not isinstance(options, dict):
+                raise SystemExit(
+                    f"ppserve: {path}:{lineno}: options must be an "
+                    "object")
+            reqs.append(dict(name=name, datafiles=rec["datafiles"],
+                             modelfile=str(rec["modelfile"]),
+                             options=options))
+    if not reqs:
+        raise SystemExit(f"ppserve: no requests in {path}")
+    return reqs
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.nsub_batch < 1:
+        raise SystemExit("--nsub-batch: must be >= 1, got "
+                         f"{args.nsub_batch}")
+    if args.max_wait_ms is not None and args.max_wait_ms < 0:
+        raise SystemExit("--max-wait-ms: must be >= 0, got "
+                         f"{args.max_wait_ms}")
+    if args.queue_depth is not None and args.queue_depth < 1:
+        raise SystemExit("--queue-depth: must be >= 1, got "
+                         f"{args.queue_depth}")
+    if args.max_inflight is not None and args.max_inflight < 1:
+        raise SystemExit("--max-inflight: must be >= 1, got "
+                         f"{args.max_inflight}")
+    if args.pipeline_depth is not None and args.pipeline_depth < 1:
+        raise SystemExit("--pipeline-depth: depth must be >= 1, got "
+                         f"{args.pipeline_depth}")
+    stream_devices = args.stream_devices
+    if stream_devices is not None:
+        s = str(stream_devices).strip().lower()
+        if s == "auto":
+            stream_devices = "auto"
+        else:
+            try:
+                stream_devices = int(s)
+            except ValueError:
+                raise SystemExit("--stream-devices: expected 'auto' "
+                                 f"or a positive count, got "
+                                 f"{args.stream_devices!r}")
+            if stream_devices < 1:
+                raise SystemExit("--stream-devices: count must be "
+                                 f">= 1, got {stream_devices}")
+    if args.warmup_model and not args.warmup_manifest:
+        raise SystemExit("--warmup-model requires --warmup-manifest")
+    reqs = parse_requests(args.requests)
+
+    if args.compile_cache:
+        from .. import config
+        from ..utils.device import enable_compile_cache
+
+        config.compile_cache_dir = args.compile_cache
+        enable_compile_cache(args.compile_cache)
+    os.makedirs(args.outdir, exist_ok=True)
+
+    from ..serve import ServeRejected, ToaServer
+
+    server = ToaServer(
+        nsub_batch=args.nsub_batch, max_wait_ms=args.max_wait_ms,
+        queue_depth=args.queue_depth, stream_devices=stream_devices,
+        max_inflight=args.max_inflight,
+        pipeline_depth=args.pipeline_depth, telemetry=args.telemetry,
+        warmup_manifest=args.warmup_manifest,
+        warmup_model=args.warmup_model, quiet=args.quiet)
+    failures = 0
+    t0 = time.time()
+    with server:
+        handles = []
+        for rec in reqs:
+            tim = os.path.join(args.outdir, f"{rec['name']}.tim")
+            while True:
+                try:
+                    handles.append(server.submit(
+                        rec["datafiles"], rec["modelfile"],
+                        tim_out=tim, name=rec["name"],
+                        **rec["options"]))
+                    break
+                except ServeRejected as e:
+                    if not e.retryable:
+                        raise
+                    # the CLI is a patient batch client: honor the
+                    # backpressure instead of failing the run
+                    if not args.quiet:
+                        print(f"ppserve: {e}; retrying",
+                              file=sys.stderr)
+                    time.sleep(0.05)
+        for rec, h in zip(reqs, handles):
+            try:
+                res = h.result(args.timeout)
+            except Exception as e:
+                failures += 1
+                print(f"ppserve: request {rec['name']!r} FAILED: {e}",
+                      file=sys.stderr)
+                continue
+            if not args.quiet:
+                print(f"ppserve: {rec['name']}: "
+                      f"{len(res.TOA_list)} TOAs from "
+                      f"{len(res.order)} archive(s) -> {res.tim_out}")
+    if not args.quiet:
+        print(f"ppserve: {len(reqs) - failures}/{len(reqs)} requests "
+              f"in {time.time() - t0:.2f} s")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

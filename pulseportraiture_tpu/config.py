@@ -153,6 +153,36 @@ stream_max_inflight = 4
 # pipeline_depth= argument.
 stream_pipeline_depth = 2
 
+# --- Serving (serve/: the continuous-batching TOA service) ----------------
+# Deadline for partially-filled buckets in the serving loop
+# (serve/server.ToaServer): a fused bucket launches when FULL
+# (nsub_batch subints) or when its oldest pending subint has waited
+# this many milliseconds — the continuous-batching policy that keeps
+# per-request latency bounded under light traffic while heavy traffic
+# fills buckets completely.  Per-server override via
+# ToaServer(max_wait_ms=...) / ppserve --max-wait-ms.
+serve_max_wait_ms = 50.0
+
+# Admission-queue capacity of the serving loop, counted in ARCHIVES
+# (the unit of admission work) across all pending requests.  The bound
+# is the backpressure story: a submit that would exceed it is REJECTED
+# loudly (serve.ServeRejected) rather than queued into unbounded host
+# memory — clients retry or shed load.  Per-server override via
+# ToaServer(queue_depth=...) / ppserve --queue-depth.
+serve_queue_depth = 64
+
+# Bucket-lattice coarsening (ROADMAP item 5): pad bucket channel
+# layouts up to the next power of two with zero-weight channels so a
+# campaign's (or serving fleet's) shape diversity costs log2 as many
+# distinct XLA compiles.  Masked pad channels contribute exactly zero
+# to every fit statistic, so .tim output is digit-identical padded vs
+# exact (guarded by tests/test_serve.py).
+#   False (default): exact shapes — keeps every lane's outputs
+#          bit-stable across releases and pays one compile per nchan.
+#   'auto': pad on TPU backends (where the compile cost dominates).
+#   True:  always pad.
+bucket_pad = False
+
 # jax persistent compilation cache directory (ROADMAP item 5): the
 # streaming drivers pay a trace + XLA compile per (bucket shape x
 # device) on every process start, and a serving fleet re-pays that
@@ -252,6 +282,9 @@ RCSTRINGS = {
 #   PPT_PIPELINE_DEPTH=<N>          -> stream_pipeline_depth
 #   PPT_COMPILE_CACHE=<dir>|off     -> compile_cache_dir
 #   PPT_TELEMETRY=<path>|off        -> telemetry_path
+#   PPT_SERVE_MAX_WAIT_MS=<float>   -> serve_max_wait_ms
+#   PPT_SERVE_QUEUE_DEPTH=<N>       -> serve_queue_depth
+#   PPT_BUCKET_PAD=off|auto|on      -> bucket_pad
 #
 # Unset variables leave the module values untouched; a typo in a
 # KNOWN variable's value raises (strict like the config parsers — a
@@ -270,10 +303,11 @@ KNOWN_PPT_ENV = frozenset({
     "PPT_XSPEC", "PPT_DFT_PRECISION", "PPT_DFT_FOLD",
     "PPT_ALIGN_DEVICE", "PPT_STREAM_DEVICES", "PPT_MAX_INFLIGHT",
     "PPT_PIPELINE_DEPTH", "PPT_COMPILE_CACHE", "PPT_TELEMETRY",
+    "PPT_SERVE_MAX_WAIT_MS", "PPT_SERVE_QUEUE_DEPTH", "PPT_BUCKET_PAD",
     # benchmark / smoke-test shape and mode knobs
     "PPT_NB", "PPT_NE", "PPT_NPSR", "PPT_NARCH", "PPT_NSUB",
     "PPT_NSUBB", "PPT_NCHAN", "PPT_NBIN", "PPT_NITER", "PPT_K",
-    "PPT_DEVICES", "PPT_CAMPAIGN_CACHE", "PPT_ALIGN_CACHE",
+    "PPT_NREQ", "PPT_DEVICES", "PPT_CAMPAIGN_CACHE", "PPT_ALIGN_CACHE",
     "PPT_TEMPLATE_NOISE", "PPT_STREAM_SPEEDUP_GATE",
     "PPT_HARMONIC_WINDOW", "PPT_TUNNEL_EMU",
 })
@@ -397,6 +431,42 @@ def env_overrides():
         cfg.compile_cache_dir = (
             None if cache.lower() in ("off", "none", "0") else cache)
         changed.append("compile_cache_dir")
+    wait = _os.environ.get("PPT_SERVE_MAX_WAIT_MS", "")
+    if wait:
+        try:
+            w = float(wait)
+        except ValueError:
+            raise ValueError(
+                "PPT_SERVE_MAX_WAIT_MS must be a non-negative number "
+                f"of milliseconds, got {wait!r}")
+        if w < 0:
+            raise ValueError(
+                f"PPT_SERVE_MAX_WAIT_MS must be >= 0, got {w}")
+        cfg.serve_max_wait_ms = w
+        changed.append("serve_max_wait_ms")
+    qd = _os.environ.get("PPT_SERVE_QUEUE_DEPTH", "")
+    if qd:
+        try:
+            n = int(qd)
+        except ValueError:
+            raise ValueError(
+                "PPT_SERVE_QUEUE_DEPTH must be a positive integer, "
+                f"got {qd!r}")
+        if n < 1:
+            raise ValueError(
+                f"PPT_SERVE_QUEUE_DEPTH must be >= 1, got {n}")
+        cfg.serve_queue_depth = n
+        changed.append("serve_queue_depth")
+    bpad = _os.environ.get("PPT_BUCKET_PAD", "").lower()
+    if bpad:
+        table = {"off": False, "false": False, "auto": "auto",
+                 "on": True, "true": True}
+        if bpad not in table:
+            raise ValueError(
+                f"PPT_BUCKET_PAD must be 'off', 'auto' or 'on', got "
+                f"{bpad!r}")
+        cfg.bucket_pad = table[bpad]
+        changed.append("bucket_pad")
     tel = _os.environ.get("PPT_TELEMETRY", "")
     if tel:
         # 'off'/'none'/'0' disable explicitly (so a wrapper script can

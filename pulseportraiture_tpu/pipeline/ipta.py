@@ -81,8 +81,22 @@ class IPTAJob:
 
 def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
                          quiet=False, resume=False, telemetry=None,
-                         **stream_kwargs):
+                         server=None, **stream_kwargs):
     """Measure wideband TOAs for a multi-pulsar campaign.
+
+    server: an already-started serve.ToaServer — the campaign becomes
+    a THIN CLIENT of the long-lived serving loop (ISSUE 8): each
+    pulsar's shard is submitted as one request against the shared warm
+    executor, so jit caches and device pipelines carry across pulsars
+    (and across campaigns — the server outlives this call), small
+    per-pulsar shards coalesce into shared fused buckets, and the
+    per-request .tim files land in outdir exactly as the executor-per-
+    pulsar path writes them.  The server's nsub_batch/devices/
+    telemetry govern dispatch (per-bucket events ride the SERVER's
+    trace; this call's telemetry= still records the campaign rollup);
+    job kwargs must be lane options (fit_scat=, DM0=, ...).
+    resume=True is not supported with server= — restartability comes
+    from re-submitting against the durable request .tim files.
 
     jobs: sequence of IPTAJob (or (pulsar, datafiles, modelfile)
     tuples).  outdir: directory for per-pulsar .tim outputs (created;
@@ -131,6 +145,11 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
     if resume and not outdir:
         raise ValueError("stream_ipta_campaign: resume=True needs "
                          "outdir (the checkpoints live there)")
+    if server is not None and resume:
+        raise ValueError(
+            "stream_ipta_campaign: resume=True is not supported with "
+            "server= — restart by re-submitting; the per-request .tim "
+            "files are the durable artifact")
     if outdir:
         os.makedirs(outdir, exist_ok=True)
 
@@ -195,7 +214,54 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
         TOA_list = []
         nfit = 0
         fit_duration = 0.0
-        for job in jobs:
+        if server is not None:
+            from ..serve import ServeRejected
+
+            # executor-level knobs belong to the SERVER (it was
+            # constructed with them); forwarding them as lane options
+            # would fail every request with an opaque TypeError deep
+            # in the serving thread — refuse here, by name
+            executor_kw = {"max_inflight", "pipeline_depth",
+                           "stream_devices", "prefetch", "tim_out",
+                           "resume", "skip_archives"}
+            bad = executor_kw & (set(stream_kwargs)
+                                 | {k for j in jobs for k in j.kwargs})
+            if bad:
+                raise ValueError(
+                    f"stream_ipta_campaign: {sorted(bad)} are executor"
+                    "-level options — configure them on the ToaServer "
+                    "when using server=")
+            # thin-client path: submit EVERY shard first (the serving
+            # loop pipelines admissions against in-flight dispatches
+            # and coalesces small shards across pulsars), then collect
+            handles = []
+            for job in jobs:
+                files = by_psr.get(job.pulsar, [])
+                if not files:
+                    continue
+                tim_out = _tim_name(job.pulsar) if outdir else None
+                kw = {**stream_kwargs, **job.kwargs}
+                kw.pop("telemetry", None)
+                while True:
+                    try:
+                        h = server.submit(files, job.modelfile,
+                                          tim_out=tim_out,
+                                          name=job.pulsar, **kw)
+                        break
+                    except ServeRejected as e:
+                        if not getattr(e, "retryable", False):
+                            raise
+                        time.sleep(0.05)  # honor the backpressure
+                handles.append((job, time.time(), h))
+            for job, t_job, h in handles:
+                res = per_pulsar[job.pulsar] = h.result()
+                TOA_list.extend(res.TOA_list)
+                if tracer.enabled:
+                    tracer.emit("pulsar_done", pulsar=job.pulsar,
+                                n_toas=len(res.TOA_list),
+                                n_archives=len(res.order), nfit=0,
+                                wall_s=round(time.time() - t_job, 6))
+        for job in (jobs if server is None else ()):
             files = by_psr.get(job.pulsar, [])
             if not files:
                 continue

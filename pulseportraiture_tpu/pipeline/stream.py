@@ -164,6 +164,11 @@ class _Bucket:
         self.modelx = modelx        # (nchan, nbin) template
         self.flags = flags          # effective FitFlags tuple
         self.kind = kind
+        self.key = None             # executor bucket key (set at admit)
+        self.lane = None            # the lane whose launch/scatter/
+        # assemble hooks own this bucket's subints — per-bucket so ONE
+        # executor can serve several lanes (the serving loop feeds one
+        # warm executor from many concurrent requests/templates)
         self.raw_code = raw_code    # 'raw': wire sample type
         self.pol_sum = bool(pol_sum)  # 'raw': device pol0+pol1 sum
         self.ir_FT = ir_FT          # (nchan, nharm) complex or None
@@ -206,6 +211,80 @@ class _Bucket:
                     self.noise, self.masks, self.Ps, self.nu_fits,
                     self.theta0, self.DM_guess, self.owners):
             lst.clear()
+
+
+def _bucket_shape(b):
+    """The dispatch-event shape string for a bucket: layout x payload
+    kind (raw buckets name their wire sample type and pol reduction —
+    each is its own compiled program) x effective flag bits.  This is
+    the trace key pptrace groups compiles by AND the manifest entry
+    ``utils/device.warmup_from_manifest`` compiles from, so
+    :func:`parse_shape_key` must stay its exact inverse."""
+    shape = f"{len(b.freqs)}x{b.nbin}:{b.kind}"
+    if b.kind == "raw":
+        shape += f":{b.raw_code}"
+        if b.pol_sum:
+            shape += ":sum2"
+    if b.flags:
+        shape += ":" + "".join("1" if f else "0" for f in b.flags)
+    return shape
+
+
+def parse_shape_key(shape):
+    """Inverse of :func:`_bucket_shape`: parse a dispatch-event shape
+    string back into the bucket geometry an AOT warmup pass needs to
+    rebuild the compiled program (nchan, nbin, kind, raw_code, pol_sum,
+    flags).  flags is None for flagless (narrowband) shapes.  Raises
+    ValueError on anything it cannot round-trip — warmup must not
+    silently compile the wrong program."""
+    from ..ops.decode import RAW_CODES
+
+    parts = shape.split(":")
+    try:
+        nchan, nbin = (int(v) for v in parts[0].split("x"))
+        kind = parts[1]
+    except (ValueError, IndexError):
+        raise ValueError(f"unparseable dispatch shape {shape!r}")
+    if kind not in ("raw", "dec") or nchan < 1 or nbin < 1:
+        raise ValueError(f"unparseable dispatch shape {shape!r}")
+    raw_code, pol_sum, flags = "i16", False, None
+    for tok in parts[2:]:
+        if kind == "raw" and tok == "sum2":
+            pol_sum = True
+        elif kind == "raw" and tok in RAW_CODES:
+            raw_code = tok
+        elif tok and set(tok) <= {"0", "1"}:
+            flags = tuple(c == "1" for c in tok)
+        else:
+            raise ValueError(
+                f"unknown token {tok!r} in dispatch shape {shape!r}")
+    return dict(nchan=nchan, nbin=nbin, kind=kind, raw_code=raw_code,
+                pol_sum=pol_sum, flags=flags)
+
+
+def bucket_pad_to(nchan):
+    """Resolve ``config.bucket_pad`` to the padded channel count for a
+    bucket layout (ROADMAP item 5: coarsen the bucket lattice).  Every
+    distinct nchan is a distinct XLA compile; padding layouts up to the
+    next power of two with zero-weight channels collapses the lattice
+    so a fleet's shape diversity costs log2 as many compiles.  False
+    (default): exact shapes (bit-stable outputs across releases);
+    'auto': pad on TPU backends (where the compile cost dominates);
+    True: always pad.  Masked pad channels contribute exactly zero to
+    every fit statistic, so .tim output is digit-identical padded vs
+    exact (tests/test_serve.py guards it)."""
+    from .. import config
+
+    v = getattr(config, "bucket_pad", False)
+    if isinstance(v, str):
+        if v.strip().lower() != "auto":
+            raise ValueError(
+                f"config.bucket_pad must be False, 'auto' or True; "
+                f"got {v!r}")
+        v = jax.default_backend() == "tpu"
+    if not v or nchan <= 1:
+        return int(nchan)
+    return 1 << (int(nchan) - 1).bit_length()
 
 
 def resolve_stream_devices(value=None):
@@ -295,13 +374,28 @@ class _StreamExecutor:
 
     run() returns (meta, assembled) with assembled keyed by iarch; the
     caller finishes lane-specific summaries from those.
+
+    DRIVER-AGNOSTIC FEEDING (ISSUE 8): run() is now a thin client of
+    the incremental interface — ``admit()`` prepares one loaded
+    archive into buckets (flushing any that fill), ``flush_stale()``
+    launches partial buckets past a deadline (the serving loop's
+    continuous-batching policy), ``flush_all``/``drain_all``/
+    ``finalize`` end a stream, and the ``on_launch``/
+    ``on_archive_done`` hooks let an owner demultiplex completions.
+    A long-lived owner (serve/server.ToaServer) constructs ONE
+    executor with ``service=True`` and no datafiles, keeps it warm
+    across requests (jit caches, device pipelines, compile cache all
+    survive), and passes a per-request ``lane`` to each admit — lanes
+    ride the buckets and in-flight records, so subints from different
+    requests coalesce into shared dispatches whenever their bucket
+    keys match.
     """
 
     def __init__(self, lane, datafiles, loader, nsub_batch,
                  max_inflight=None, prefetch=True, tim_out=None,
                  resume=False, skip_archives=None, quiet=False,
                  stream_devices=None, tracer=None,
-                 pipeline_depth=None):
+                 pipeline_depth=None, service=False):
         from collections import deque
 
         from .. import config
@@ -346,8 +440,17 @@ class _StreamExecutor:
                     "to go", quiet=quiet)
         self.datafiles = datafiles
         self.loader = loader
+        # service mode (a long-lived queue-fed owner): per-archive
+        # bookkeeping must stay O(live work), so the run()-only growing
+        # lists (meta, checkpoint order) are skipped and the owner
+        # calls forget() as requests complete
+        self.service = bool(service)
+        self.on_launch = None        # hook(seq, owners, pad) per dispatch
+        self.on_archive_done = None  # hook(iarch, m, out) per assembly
         self.devices = resolve_stream_devices(stream_devices)
         self.buckets = {}
+        self._bucket_t0 = {}  # bucket key -> first pending fill (mono s)
+        self._lane_by_iarch = {}
         self.results = {}
         self.meta = []
         self.meta_by_iarch = {}
@@ -446,7 +549,7 @@ class _StreamExecutor:
     def _drain_head(self, idev):
         """Drain device idev's oldest dispatch (blocking on it)."""
         t0 = time.time()
-        handle, owners, extra, seq = self.in_flight[idev].popleft()
+        handle, owners, extra, seq, lane = self.in_flight[idev].popleft()
         out = handle.result() if hasattr(handle, "result") else handle
         # wait for the device program itself, not just the dispatch
         # thread: the split below must charge device time to
@@ -460,7 +563,7 @@ class _StreamExecutor:
         wait_s = time.time() - t0
         self.fit_duration += wait_s
         t1 = time.time()
-        self.lane.scatter(out, owners, extra, self.results)
+        lane.scatter(out, owners, extra, self.results)
         scat_s = time.time() - t1
         self.scatter_duration += scat_s
         if self.tracer.enabled:
@@ -498,7 +601,8 @@ class _StreamExecutor:
             # archive order
             if self.remaining.get(ia) == 0 and ia not in self.assembled:
                 m = self.meta_by_iarch[ia]
-                out = self.lane.assemble(m, self.results)
+                out = self._lane_by_iarch.get(ia, self.lane).assemble(
+                    m, self.results)
                 self.assembled[ia] = out
                 if self.tracer.enabled:
                     self.tracer.emit("archive_done", iarch=ia,
@@ -508,6 +612,11 @@ class _StreamExecutor:
                 for isub in m.ok:
                     self.results.pop((ia, int(isub)), None)
                 self._ckpt_flush()
+                if self.on_archive_done is not None:
+                    # the owner's demux hook (serving loop): runs on
+                    # the draining thread, AFTER the per-subint records
+                    # folded, so the owner may forget() this archive
+                    self.on_archive_done(ia, m, out)
 
     def _drain_ready(self):
         """Non-blocking: drain every dispatch whose handle has already
@@ -571,25 +680,18 @@ class _StreamExecutor:
         tr = self.tracer
         if tr.enabled:
             # bucket identity for the trace, captured BEFORE launch
-            # clears the bucket: layout x payload kind (raw buckets
-            # name their wire sample type and pol reduction — each is
-            # its own compiled program) x effective flag bits (the
-            # pieces of the dispatch key a reader can interpret)
-            shape = f"{len(b.freqs)}x{b.nbin}:{b.kind}"
-            if b.kind == "raw":
-                shape += f":{b.raw_code}"
-                if b.pol_sum:
-                    shape += ":sum2"
-            if b.flags:
-                shape += ":" + "".join("1" if f else "0"
-                                       for f in b.flags)
+            # clears the bucket (_bucket_shape; parse_shape_key is its
+            # warmup-side inverse)
+            shape = _bucket_shape(b)
             n_subints = len(b)
+        self._bucket_t0.pop(b.key, None)  # deadline clock resets
         # seq comes from the TRACER, not this executor: several
         # executors may share one trace (stream_ipta_campaign), and
         # the report pairs dispatch/h2d/drain events by seq — assigned
         # BEFORE launch so the copy stage can stamp its h2d events
         seq = tr.next_seq()
-        rec = self.lane.launch(b, self.pipelines[idev], seq)
+        lane = b.lane if b.lane is not None else self.lane
+        rec = lane.launch(b, self.pipelines[idev], seq)
         if rec is None:
             return
         self.nfit += 1
@@ -601,11 +703,20 @@ class _StreamExecutor:
                 if self.undispatched[ia] == 0:
                     del self.undispatched[ia]
         q = self.in_flight[idev]
-        q.append(rec + (seq,))
+        # the record carries its lane: drains scatter through the lane
+        # that launched the bucket (per-request physics in service
+        # mode); seq stays at index 3 — the copy-overlap closure in
+        # __init__ reads r[3]
+        q.append(rec + (seq, lane))
         # the bound is EXACT: _pick_device guaranteed room, so no
         # queue ever holds more than max_inflight dispatches (the old
         # append-then-drain order admitted max_inflight + 1)
         self.peak_inflight = max(self.peak_inflight, len(q))
+        if self.on_launch is not None:
+            # owner hook (serving loop): owners snapshot + pad rows of
+            # this dispatch — the batch-occupancy/coalesce signal
+            self.on_launch(seq, rec[1],
+                           (-len(rec[1])) % self.nsub_batch)
         if tr.enabled:
             # cold = first dispatch of this bucket shape on this
             # device: the worker will pay the jit trace + XLA compile
@@ -648,6 +759,138 @@ class _StreamExecutor:
         for pl in self.pipelines:
             pl.shutdown(wait)
 
+    def admit(self, iarch, datafile, d, ok, lane=None):
+        """Prepare one loaded archive through ``lane`` (default: the
+        executor's own) and fill its subints into the shared buckets,
+        flushing any bucket that reaches nsub_batch.  Returns the
+        number of per-subint entries admitted, or None when the lane
+        skipped the archive (it emitted the typed archive_skip).
+
+        This is the driver-agnostic feeding interface: run() calls it
+        per archive of a fixed list; the serving loop calls it with a
+        per-request lane, so subints from different requests coalesce
+        whenever their bucket keys match."""
+        lane = self.lane if lane is None else lane
+        tr = self.tracer
+        t_prep = time.time()
+        prep = lane.prepare(iarch, datafile, d, ok)
+        if prep is None:
+            # the lane already emitted archive_skip with the real
+            # reason (it shares this executor's tracer)
+            tr.counter("archives_skipped")
+            return None
+        m, per_subint = prep
+        if not self.service:
+            # run()-only growing state: the finalize() meta order and
+            # the in-order checkpoint ledger (a serving owner keeps its
+            # own per-request order and calls forget() instead)
+            self.meta.append(m)
+            self._ckpt_order.append(iarch)
+            self._prep_idx[iarch] = len(self._ckpt_order) - 1
+        self.meta_by_iarch[iarch] = m
+        self._lane_by_iarch[iarch] = lane
+        self.remaining[iarch] = len(ok)
+        self.undispatched[iarch] = len(per_subint)
+        if tr.enabled:
+            tr.emit("archive_prepare", iarch=iarch,
+                    datafile=datafile, n_ok=len(ok),
+                    n_subints=len(per_subint),
+                    prep_s=round(time.time() - t_prep, 6))
+            tr.counter("archives_prepared")
+        for key, factory, fill in per_subint:
+            b = self.buckets.get(key)
+            if b is None:
+                b = self.buckets[key] = factory()
+                b.key = key
+                b.lane = lane
+            fill(b)
+            if key not in self._bucket_t0 and len(b):
+                # deadline clock: when the bucket's OLDEST pending
+                # subint arrived (flush_stale's continuous-batching
+                # trigger); reset on every flush
+                self._bucket_t0[key] = time.monotonic()
+            if len(b) >= self.nsub_batch:
+                self._flush(b)
+        return len(per_subint)
+
+    def flush_all(self):
+        """Launch every non-empty bucket (end of stream, staleness
+        horizon, or a serving drain)."""
+        for b in self.buckets.values():
+            if len(b):
+                self._flush(b)
+
+    def flush_stale(self, max_age_s):
+        """Continuous-batching deadline policy: launch each partially-
+        filled bucket whose OLDEST pending subint has waited at least
+        ``max_age_s`` — a bucket dispatches when full OR when its head
+        request has waited long enough, so light traffic still meets
+        latency targets while heavy traffic fills buckets completely.
+        Returns the number of buckets flushed."""
+        if not self._bucket_t0:
+            return 0
+        now = time.monotonic()
+        n = 0
+        for key, t0 in list(self._bucket_t0.items()):
+            if now - t0 < max_age_s:
+                continue
+            b = self.buckets.get(key)
+            if b is not None and len(b):
+                self._flush(b)
+                n += 1
+            else:
+                self._bucket_t0.pop(key, None)
+        return n
+
+    def oldest_bucket_age(self):
+        """Seconds the oldest pending (unfilled) bucket entry has
+        waited, or None when no bucket holds work — what a serving
+        loop sleeps against between deadline flushes."""
+        if not self._bucket_t0:
+            return None
+        return time.monotonic() - min(self._bucket_t0.values())
+
+    def drain_all(self):
+        """Block until every in-flight dispatch has drained."""
+        while any(self.in_flight):
+            self._drain_any()
+
+    def assemble_leftover(self, iarch):
+        """Assemble an archive that never completed through the drain
+        (e.g. a lane admitting fewer bucket entries than ok subints);
+        idempotent."""
+        if iarch in self.assembled:
+            return self.assembled[iarch]
+        m = self.meta_by_iarch[iarch]
+        out = self._lane_by_iarch.get(iarch, self.lane).assemble(
+            m, self.results)
+        self.assembled[iarch] = out
+        if self.tracer.enabled:
+            self.tracer.emit("archive_done", iarch=iarch,
+                             datafile=m.datafile)
+        if self.on_archive_done is not None:
+            self.on_archive_done(iarch, m, out)
+        return out
+
+    def forget(self, iarch):
+        """Drop one archive's bookkeeping after its owner consumed the
+        assembly — what keeps a LONG-LIVED (service=True) executor's
+        memory O(live requests) instead of O(requests ever served)."""
+        self.meta_by_iarch.pop(iarch, None)
+        self.assembled.pop(iarch, None)
+        self.remaining.pop(iarch, None)
+        self._lane_by_iarch.pop(iarch, None)
+        self.undispatched.pop(iarch, None)
+        self._prep_idx.pop(iarch, None)
+
+    def finalize(self):
+        """Late assemblies (anything not completed through the drain,
+        e.g. archives whose subints all failed) in archive order, then
+        the final in-order checkpoint flush."""
+        for m in self.meta:
+            self.assemble_leftover(m.iarch)
+        self._ckpt_flush()
+
     def run(self):
         # a failed dispatch/assembly must not leave ANY worker thread
         # grinding through queued h2d copies (each holding a full
@@ -672,33 +915,8 @@ class _StreamExecutor:
                     log(f"No subints to fit in {datafile}; skipping.",
                         level="warn", tracer=None)
                     continue
-                t_prep = time.time()
-                prep = self.lane.prepare(iarch, datafile, d, ok)
-                if prep is None:
-                    # the lane already emitted archive_skip with the
-                    # real reason (it shares this executor's tracer)
-                    tr.counter("archives_skipped")
+                if self.admit(iarch, datafile, d, ok) is None:
                     continue
-                m, per_subint = prep
-                self.meta.append(m)
-                self.meta_by_iarch[iarch] = m
-                self.remaining[iarch] = len(ok)
-                self.undispatched[iarch] = len(per_subint)
-                self._ckpt_order.append(iarch)
-                self._prep_idx[iarch] = len(self._ckpt_order) - 1
-                if tr.enabled:
-                    tr.emit("archive_prepare", iarch=iarch,
-                            datafile=datafile, n_ok=len(ok),
-                            n_subints=len(per_subint),
-                            prep_s=round(time.time() - t_prep, 6))
-                    tr.counter("archives_prepared")
-                for key, factory, fill in per_subint:
-                    b = self.buckets.get(key)
-                    if b is None:
-                        b = self.buckets[key] = factory()
-                    fill(b)
-                    if len(b) >= self.nsub_batch:
-                        self._flush(b)
                 # checkpoint-staleness horizon: an early archive whose
                 # rare-shape bucket never fills would hold back every
                 # later archive's in-order checkpoint write; once it
@@ -721,28 +939,14 @@ class _StreamExecutor:
                             lag=self._prep_idx[iarch]
                             - self._prep_idx[head_d])
                         tr.counter("force_flushes")
-                    for b in self.buckets.values():
-                        if len(b):
-                            self._flush(b)
-            for b in self.buckets.values():
-                if len(b):
-                    self._flush(b)
-            while any(self.in_flight):
-                self._drain_any()
+                    self.flush_all()
+            self.flush_all()
+            self.drain_all()
         except BaseException:
             self._shutdown(wait=False)
             raise
         self._shutdown(wait=True)
-        # late assemblies (anything not completed through the drain,
-        # e.g. archives whose subints all failed) in archive order
-        for m in self.meta:
-            if m.iarch not in self.assembled:
-                self.assembled[m.iarch] = self.lane.assemble(
-                    m, self.results)
-                if self.tracer.enabled:
-                    self.tracer.emit("archive_done", iarch=m.iarch,
-                                     datafile=m.datafile)
-        self._ckpt_flush()
+        self.finalize()
         return self.meta, self.assembled
 
 
@@ -1453,6 +1657,315 @@ def _assemble_archive(m, results, modelfile, fit_DM, bary,
     return toas, mean, err
 
 
+def _collect_wideband(meta, assembled):
+    """Collect TOAs + per-archive DeltaDM statistics in archive order
+    from a run's (meta, assembled) — shared by the one-shot driver and
+    the serving loop's per-request demux (serve/server.py), so the two
+    paths cannot drift on result assembly."""
+    TOA_list = []
+    order, DM0s, means, errs = [], [], [], []
+    for m in meta:
+        toas, mean, err = assembled[m.iarch]
+        TOA_list.extend(toas)
+        order.append(m.datafile)
+        DM0s.append(m.DM0_arch)
+        means.append(mean)
+        errs.append(err)
+    return TOA_list, order, DM0s, means, errs
+
+
+def make_wideband_lane(modelfile, nsub_batch=256, fit_DM=True,
+                       fit_GM=False, nu_ref_DM=None, nu_ref_tau=None,
+                       DM0=None, bary=True, tscrunch=False,
+                       fit_scat=False, log10_tau=True, scat_guess=None,
+                       fix_alpha=False, max_iter=25, print_flux=False,
+                       print_phase=False,
+                       instrumental_response_dict=None,
+                       addtnl_toa_flags={}, quiet=False,
+                       quality_flags=False, tracer=None,
+                       key_prefix=()):
+    """Build the wideband physics lane + archive loader for a template
+    and option set — the per-driver half of the streaming split.
+    Returns ``(lane, loader)``: the lane supplies _StreamExecutor's
+    prepare/launch/scatter/assemble hooks, the loader is what
+    _iter_archives (or a serving loop) reads archives with.
+
+    This is the enabling refactor behind the serving subsystem
+    (ISSUE 8 / ROADMAP item 2): the executor is driver-agnostic and a
+    lane is a VALUE, so a long-lived server builds one lane per
+    (template, options) pair, caches it (the TemplateModel load
+    amortizes across requests), and feeds every lane into ONE warm
+    executor.  ``key_prefix`` namespaces the lane's bucket keys so
+    different templates with identical layouts can never share a fused
+    dispatch; requests with the SAME template and options reuse the
+    same prefix and therefore coalesce.  The one-shot
+    stream_wideband_TOAs driver is now a thin client of this factory.
+
+    Option semantics follow stream_wideband_TOAs (which documents
+    them); ``tracer`` is the telemetry sink prepare's typed
+    archive_skip events go to."""
+    from .toas import DEFAULT_IR_DICT, build_instrumental_response_FT
+
+    tracer = NULL_TRACER if tracer is None else tracer
+    ird = {**DEFAULT_IR_DICT, **(instrumental_response_dict or {})}
+    if len(ird["wids"]) != len(ird["irf_types"]):
+        raise ValueError(
+            "instrumental_response_dict: wids and irf_types must pair "
+            f"up (got {len(ird['wids'])} widths, "
+            f"{len(ird['irf_types'])} kinds)")
+    use_ir = bool(ird["wids"] or ird["DM-smear"])
+    ir_cache = {}  # ir signature -> (nchan, nharm) kernel (one build
+    # per distinct layout, not per archive — eager device ops are not
+    # free on tunneled runtimes)
+    scat_guess = _validate_scat_guess(scat_guess, fit_scat)
+    if not fit_scat:
+        log10_tau = False
+    model = TemplateModel(modelfile, quiet=quiet)
+    # scattering baked into the template makes the portrait depend on
+    # the folding period (tau seconds -> bins) — such templates must
+    # not be shared across archives with different P
+    p_dependent = model.has_scattering()
+
+    # f32 load on fast-fit backends: the data feeds the f32 engine
+    # anyway, and single precision halves per-archive host time — on
+    # CPU (tests/parity) keep f64 so results bit-match GetTOAs
+    load_dtype = np.float32 if use_fast_fit_default() else None
+
+    def _loader(f):
+        if not tscrunch:
+            try:
+                # raw lane: undecoded wire samples straight to the
+                # accelerator, decode and statistics on device
+                return _load_raw(f)
+            except (ValueError, KeyError):
+                pass
+        return load_for_toas(f, tscrunch=tscrunch, quiet=True,
+                             dtype=load_dtype)
+
+    # tau seeding mode, resolved once (both lanes)
+    default_alpha = (model.gauss.alpha if model.is_gaussian
+                     else scattering_alpha)
+    if scat_guess is not None and not isinstance(scat_guess, str):
+        tau_mode = "explicit"
+        tau_args = tuple(float(v) for v in scat_guess)
+        alpha0_run = tau_args[2]
+    elif fit_scat and scat_guess == "auto":
+        tau_mode, tau_args, alpha0_run = "auto", (0.0, 1.0, 0.0), \
+            float(default_alpha)
+    elif fit_scat:
+        tau_mode, tau_args, alpha0_run = "neutral", (0.0, 1.0, 0.0), \
+            float(default_alpha)
+    else:
+        tau_mode, tau_args, alpha0_run = "none", (0.0, 1.0, 0.0), \
+            float(default_alpha)
+
+    class _WidebandLane:
+        """The wideband physics hooks for _StreamExecutor."""
+
+        def prepare(self, iarch, datafile, d, ok):
+            nchan, nbin = d.nchan, d.nbin
+            freqs0 = np.asarray(d.freqs[0], float)
+            P_mean = float(np.mean(d.Ps[ok]))
+            # bucket-lattice coarsening (config.bucket_pad): pad the
+            # DEVICE layout to the next power-of-two channel count
+            # with zero-weight channels, so distinct nchans collapse
+            # onto one compiled program class.  Host-side statistics
+            # and TOA flags keep the true nchan; masked pad channels
+            # contribute exactly zero to every fit sum, so output is
+            # digit-identical padded vs exact (tests/test_serve.py).
+            # Pad frequencies repeat the last channel (extrapolating
+            # could cross zero on a descending band, and freqs**-2
+            # must stay finite).
+            pad_c = bucket_pad_to(nchan) - nchan
+            freqs_b = (np.concatenate([freqs0,
+                                       np.full(pad_c, freqs0[-1])])
+                       if pad_c else freqs0)
+            try:
+                modelx = model.portrait(freqs_b, nbin, P=P_mean)
+            except ValueError as e:
+                # typed archive_skip (not just a log line) so pptrace's
+                # skipped-archives section shows the REAL mismatch,
+                # matching GetTOAs' skip path
+                tracer.emit("archive_skip", datafile=datafile,
+                            reason=str(e))
+                log(f"Skipping {datafile}: {e}", level="warn")
+                return None
+            base_key = key_prefix + (nchan + pad_c, nbin,
+                                     freqs_b.tobytes())
+            if p_dependent:
+                base_key += (round(P_mean, 12),)
+
+            DM_stored = float(d.DM)
+            DM0_arch = DM_stored if DM0 is None else float(DM0)
+            DM_guess = DM_stored if DM_stored != 0.0 else DM0_arch
+
+            # instrumental-response FT for this archive's layout (same
+            # construction as GetTOAs, pptoas.py:428-434).  DM-smearing
+            # makes the kernel archive-specific, so it joins the bucket
+            # key; pure achromatic kernels share across same layouts.
+            if use_ir:
+                ir_sig = ((nchan + pad_c, nbin, freqs_b.tobytes(),
+                           tuple(ird["wids"]), tuple(ird["irf_types"]))
+                          + ((round(DM_guess, 9), round(P_mean, 12))
+                             if ird["DM-smear"] else ()))
+                if ir_sig not in ir_cache:
+                    ir_cache[ir_sig] = build_instrumental_response_FT(
+                        ird, freqs_b, nbin, DM_guess, P_mean,
+                        bw=d.get("bw", 0.0))
+                ir_FT = ir_cache[ir_sig]
+                base_key += (ir_sig[3:],)
+            else:
+                ir_FT = None
+            masks = np.asarray(d.weights[ok] > 0.0, float)
+            masks_b = (np.pad(masks, ((0, 0), (0, pad_c)))
+                       if pad_c else masks)
+            raw_mode = bool(d.get("raw_mode", False))
+
+            # keep only what TOA assembly needs — NOT the data cube
+            m = DataBunch(
+                datafile=datafile, iarch=iarch, ok=ok,
+                DM0_arch=DM0_arch, nbin=nbin, nchan=nchan,
+                epochs=[d.epochs[isub] for isub in ok],
+                Ps=[float(d.Ps[isub]) for isub in ok],
+                dfs=[float(d.doppler_factors[isub]) for isub in ok],
+                subtimes=[float(d.subtimes[isub]) for isub in ok],
+                backend_delay=d.backend_delay, backend=d.backend,
+                frontend=d.frontend, telescope=d.telescope,
+                telescope_code=d.telescope_code)
+            nchx = masks.sum(axis=1).astype(int)
+
+            if not raw_mode:
+                ports = np.asarray(d.subints[ok, 0])  # dtype preserved
+                noise = np.asarray(d.noise_stds[ok, 0], float)
+                snrs_chan = np.asarray(d.SNRs[ok, 0], float) * masks
+                nu_fit_arr = snr_weighted_nu_fit(snrs_chan, freqs0)
+                # tau/alpha seeds (shared with GetTOAs.get_TOAs) —
+                # host seeds from the TRUE layout; only the device
+                # payload below is padded
+                tau0, alpha0 = scat_seed_tau0(
+                    scat_guess, fit_scat, len(ok), nbin, P_mean,
+                    nu_fit_arr, default_alpha,
+                    ports=ports, modelx=modelx[:nchan], noise=noise,
+                    masks=masks)
+                if pad_c:
+                    # edge-replicated data + noise for the same reason
+                    # the raw fill pads with mode="edge": masked-out
+                    # channels must carry ORDINARY finite noise so the
+                    # fit's weights stay benign
+                    ports = np.pad(ports, ((0, 0), (0, pad_c), (0, 0)),
+                                   mode="edge")
+                    noise = np.pad(noise, ((0, 0), (0, pad_c)),
+                                   mode="edge")
+
+            base_flags = (True, bool(fit_DM), bool(fit_GM),
+                          bool(fit_scat),
+                          bool(fit_scat and not fix_alpha))
+            kind = "raw" if raw_mode else "dec"
+            # raw payloads bucket by wire sample type and pol
+            # reduction too: each combination is its own compiled
+            # decode stage, and mixing them would stack incompatible
+            # row shapes/dtypes
+            raw_code = str(d.get("raw_code") or "i16")
+            pol_sum = bool(d.get("pol_sum", False))
+            per_subint = []
+            for j, isub in enumerate(ok):
+                # degenerate-geometry demotion — the SAME helper
+                # GetTOAs' flag groups use (pipeline/toas.py
+                # effective_fit_flags; reference pptoas.py:519-527)
+                eff_flags = effective_fit_flags(nchx[j], base_flags)
+                key = base_key + (eff_flags, kind)
+                if raw_mode:
+                    key += (raw_code, pol_sum)
+
+                def factory(freqs_b=freqs_b, nbin=nbin, modelx=modelx,
+                            eff_flags=eff_flags, kind=kind,
+                            ir_FT=ir_FT, raw_code=raw_code,
+                            pol_sum=pol_sum):
+                    return _Bucket(freqs_b, nbin, modelx, eff_flags,
+                                   kind=kind, ir_FT=ir_FT,
+                                   raw_code=raw_code, pol_sum=pol_sum)
+
+                def fill(b, j=j, isub=int(isub), d=d, masks_b=masks_b,
+                         DM_guess=DM_guess, raw_mode=raw_mode,
+                         iarch=iarch, pad_c=pad_c):
+                    if raw_mode:
+                        raw_row = d.raw[isub]
+                        scl_row = d.scl[isub]
+                        offs_row = d.offs[isub]
+                        if pad_c:
+                            # pad channels REPLICATE the edge channel
+                            # (samples and scl/offs), not zeros: the
+                            # fused program estimates noise from the
+                            # data, and a zero channel's tiny-clamped
+                            # noise would blow up the fit's 1/noise^2
+                            # weights.  A replicated channel has
+                            # ordinary finite noise and is suppressed
+                            # by its zero mask exactly like a zapped
+                            # channel — the path the GetTOAs parity
+                            # tests already pin down.
+                            raw_row = np.pad(
+                                raw_row, [(0, 0)] * (raw_row.ndim - 2)
+                                + [(0, pad_c), (0, 0)], mode="edge")
+                            scl_row = np.pad(
+                                scl_row, [(0, 0)] * (scl_row.ndim - 1)
+                                + [(0, pad_c)], mode="edge")
+                            offs_row = np.pad(
+                                offs_row,
+                                [(0, 0)] * (offs_row.ndim - 1)
+                                + [(0, pad_c)], mode="edge")
+                        b.raw.append(raw_row)
+                        b.scl.append(scl_row)
+                        b.offs.append(offs_row)
+                        b.DM_guess.append(DM_guess)
+                        # dedispersed-on-disk: the device program
+                        # restores the stored DM's delays before
+                        # fitting; reference frequency honors REF_FREQ
+                        b.dedisp.append(
+                            (float(d.DM) if d.get("dmc") else 0.0,
+                             float(d.get("dedisp_nu")
+                                   or d.get("nu0", 0.0) or 0.0)))
+                    else:
+                        th = np.zeros(5)
+                        th[1] = DM_guess
+                        th[3] = (np.log10(max(tau0[j], 1e-12))
+                                 if log10_tau else tau0[j])
+                        th[4] = alpha0
+                        b.ports.append(ports[j])
+                        b.noise.append(noise[j])
+                        b.nu_fits.append(float(nu_fit_arr[j]))
+                        b.theta0.append(th)
+                    b.masks.append(masks_b[j])
+                    b.Ps.append(float(d.Ps[isub]))
+                    b.owners.append((iarch, isub))
+
+                per_subint.append((key, factory, fill))
+            return m, per_subint
+
+        def launch(self, b, pipeline, seq):
+            return _launch(b, nu_ref_DM, max_iter, nsub_batch,
+                           log10_tau=log10_tau, tau_mode=tau_mode,
+                           tau_args=tau_args, alpha0=alpha0_run,
+                           pipeline=pipeline, want_flux=print_flux,
+                           seq=seq)
+
+        def scatter(self, out, owners, keys, results):
+            packed = np.asarray(out)
+            for i, owner in enumerate(owners):  # pad lanes discarded
+                results[owner] = {k: packed[j, i]
+                                  for j, k in enumerate(keys)}
+
+        def assemble(self, m, results):
+            return _assemble_archive(
+                m, results, modelfile, fit_DM, bary, addtnl_toa_flags,
+                log10_tau=log10_tau,
+                alpha_fitted=fit_scat and not fix_alpha,
+                nu_ref_tau=nu_ref_tau, fit_GM=fit_GM,
+                print_flux=print_flux, print_phase=print_phase,
+                quiet=quiet, quality_flags=quality_flags)
+
+    return _WidebandLane(), _loader
+
+
 def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                          fit_DM=True, fit_GM=False, nu_ref_DM=None,
                          nu_ref_tau=None, DM0=None, bary=True,
@@ -1550,220 +2063,25 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                      else [datafiles])
     else:
         datafiles = list(datafiles)
-    from .toas import DEFAULT_IR_DICT, build_instrumental_response_FT
-
-    ird = {**DEFAULT_IR_DICT, **(instrumental_response_dict or {})}
-    if len(ird["wids"]) != len(ird["irf_types"]):
-        raise ValueError(
-            "instrumental_response_dict: wids and irf_types must pair "
-            f"up (got {len(ird['wids'])} widths, "
-            f"{len(ird['irf_types'])} kinds)")
-    use_ir = bool(ird["wids"] or ird["DM-smear"])
-    ir_cache = {}  # ir signature -> (nchan, nharm) kernel (one build
-    # per distinct layout, not per archive — eager device ops are not
-    # free on tunneled runtimes)
-    scat_guess = _validate_scat_guess(scat_guess, fit_scat)
-    if not fit_scat:
-        log10_tau = False
-    model = TemplateModel(modelfile, quiet=quiet)
-    # scattering baked into the template makes the portrait depend on
-    # the folding period (tau seconds -> bins) — such templates must
-    # not be shared across archives with different P
-    p_dependent = model.has_scattering()
-
-    # f32 load on fast-fit backends: the data feeds the f32 engine
-    # anyway, and single precision halves per-archive host time — on
-    # CPU (tests/parity) keep f64 so results bit-match GetTOAs
-    load_dtype = np.float32 if use_fast_fit_default() else None
-
-    def _loader(f):
-        if not tscrunch:
-            try:
-                # raw lane: undecoded wire samples straight to the
-                # accelerator, decode and statistics on device
-                return _load_raw(f)
-            except (ValueError, KeyError):
-                pass
-        return load_for_toas(f, tscrunch=tscrunch, quiet=True,
-                             dtype=load_dtype)
-
-    # tau seeding mode, resolved once (both lanes)
-    default_alpha = (model.gauss.alpha if model.is_gaussian
-                     else scattering_alpha)
-    if scat_guess is not None and not isinstance(scat_guess, str):
-        tau_mode = "explicit"
-        tau_args = tuple(float(v) for v in scat_guess)
-        alpha0_run = tau_args[2]
-    elif fit_scat and scat_guess == "auto":
-        tau_mode, tau_args, alpha0_run = "auto", (0.0, 1.0, 0.0), \
-            float(default_alpha)
-    elif fit_scat:
-        tau_mode, tau_args, alpha0_run = "neutral", (0.0, 1.0, 0.0), \
-            float(default_alpha)
-    else:
-        tau_mode, tau_args, alpha0_run = "none", (0.0, 1.0, 0.0), \
-            float(default_alpha)
-
     tracer, own_tracer = resolve_tracer(telemetry,
                                         run="stream_wideband_TOAs")
     t_start = time.time()
 
-    class _WidebandLane:
-        """stream_wideband_TOAs' physics hooks for _StreamExecutor."""
-
-        def prepare(self, iarch, datafile, d, ok):
-            nchan, nbin = d.nchan, d.nbin
-            freqs0 = np.asarray(d.freqs[0], float)
-            P_mean = float(np.mean(d.Ps[ok]))
-            try:
-                modelx = model.portrait(freqs0, nbin, P=P_mean)
-            except ValueError as e:
-                # typed archive_skip (not just a log line) so pptrace's
-                # skipped-archives section shows the REAL mismatch,
-                # matching GetTOAs' skip path
-                tracer.emit("archive_skip", datafile=datafile,
-                            reason=str(e))
-                log(f"Skipping {datafile}: {e}", level="warn")
-                return None
-            base_key = (nchan, nbin, freqs0.tobytes())
-            if p_dependent:
-                base_key += (round(P_mean, 12),)
-
-            DM_stored = float(d.DM)
-            DM0_arch = DM_stored if DM0 is None else float(DM0)
-            DM_guess = DM_stored if DM_stored != 0.0 else DM0_arch
-
-            # instrumental-response FT for this archive's layout (same
-            # construction as GetTOAs, pptoas.py:428-434).  DM-smearing
-            # makes the kernel archive-specific, so it joins the bucket
-            # key; pure achromatic kernels share across same layouts.
-            if use_ir:
-                ir_sig = ((nchan, nbin, freqs0.tobytes(),
-                           tuple(ird["wids"]), tuple(ird["irf_types"]))
-                          + ((round(DM_guess, 9), round(P_mean, 12))
-                             if ird["DM-smear"] else ()))
-                if ir_sig not in ir_cache:
-                    ir_cache[ir_sig] = build_instrumental_response_FT(
-                        ird, freqs0, nbin, DM_guess, P_mean,
-                        bw=d.get("bw", 0.0))
-                ir_FT = ir_cache[ir_sig]
-                base_key += (ir_sig[3:],)
-            else:
-                ir_FT = None
-            masks = np.asarray(d.weights[ok] > 0.0, float)
-            raw_mode = bool(d.get("raw_mode", False))
-
-            # keep only what TOA assembly needs — NOT the data cube
-            m = DataBunch(
-                datafile=datafile, iarch=iarch, ok=ok,
-                DM0_arch=DM0_arch, nbin=nbin, nchan=nchan,
-                epochs=[d.epochs[isub] for isub in ok],
-                Ps=[float(d.Ps[isub]) for isub in ok],
-                dfs=[float(d.doppler_factors[isub]) for isub in ok],
-                subtimes=[float(d.subtimes[isub]) for isub in ok],
-                backend_delay=d.backend_delay, backend=d.backend,
-                frontend=d.frontend, telescope=d.telescope,
-                telescope_code=d.telescope_code)
-            nchx = masks.sum(axis=1).astype(int)
-
-            if not raw_mode:
-                ports = np.asarray(d.subints[ok, 0])  # dtype preserved
-                noise = np.asarray(d.noise_stds[ok, 0], float)
-                snrs_chan = np.asarray(d.SNRs[ok, 0], float) * masks
-                nu_fit_arr = snr_weighted_nu_fit(snrs_chan, freqs0)
-                # tau/alpha seeds (shared with GetTOAs.get_TOAs)
-                tau0, alpha0 = scat_seed_tau0(
-                    scat_guess, fit_scat, len(ok), nbin, P_mean,
-                    nu_fit_arr, default_alpha,
-                    ports=ports, modelx=modelx, noise=noise,
-                    masks=masks)
-
-            base_flags = (True, bool(fit_DM), bool(fit_GM),
-                          bool(fit_scat),
-                          bool(fit_scat and not fix_alpha))
-            kind = "raw" if raw_mode else "dec"
-            # raw payloads bucket by wire sample type and pol
-            # reduction too: each combination is its own compiled
-            # decode stage, and mixing them would stack incompatible
-            # row shapes/dtypes
-            raw_code = str(d.get("raw_code") or "i16")
-            pol_sum = bool(d.get("pol_sum", False))
-            per_subint = []
-            for j, isub in enumerate(ok):
-                # degenerate-geometry demotion — the SAME helper
-                # GetTOAs' flag groups use (pipeline/toas.py
-                # effective_fit_flags; reference pptoas.py:519-527)
-                eff_flags = effective_fit_flags(nchx[j], base_flags)
-                key = base_key + (eff_flags, kind)
-                if raw_mode:
-                    key += (raw_code, pol_sum)
-
-                def factory(freqs0=freqs0, nbin=nbin, modelx=modelx,
-                            eff_flags=eff_flags, kind=kind,
-                            ir_FT=ir_FT, raw_code=raw_code,
-                            pol_sum=pol_sum):
-                    return _Bucket(freqs0, nbin, modelx, eff_flags,
-                                   kind=kind, ir_FT=ir_FT,
-                                   raw_code=raw_code, pol_sum=pol_sum)
-
-                def fill(b, j=j, isub=int(isub), d=d, masks=masks,
-                         DM_guess=DM_guess, raw_mode=raw_mode,
-                         iarch=iarch):
-                    if raw_mode:
-                        b.raw.append(d.raw[isub])
-                        b.scl.append(d.scl[isub])
-                        b.offs.append(d.offs[isub])
-                        b.DM_guess.append(DM_guess)
-                        # dedispersed-on-disk: the device program
-                        # restores the stored DM's delays before
-                        # fitting; reference frequency honors REF_FREQ
-                        b.dedisp.append(
-                            (float(d.DM) if d.get("dmc") else 0.0,
-                             float(d.get("dedisp_nu")
-                                   or d.get("nu0", 0.0) or 0.0)))
-                    else:
-                        th = np.zeros(5)
-                        th[1] = DM_guess
-                        th[3] = (np.log10(max(tau0[j], 1e-12))
-                                 if log10_tau else tau0[j])
-                        th[4] = alpha0
-                        b.ports.append(ports[j])
-                        b.noise.append(noise[j])
-                        b.nu_fits.append(float(nu_fit_arr[j]))
-                        b.theta0.append(th)
-                    b.masks.append(masks[j])
-                    b.Ps.append(float(d.Ps[isub]))
-                    b.owners.append((iarch, isub))
-
-                per_subint.append((key, factory, fill))
-            return m, per_subint
-
-        def launch(self, b, pipeline, seq):
-            return _launch(b, nu_ref_DM, max_iter, nsub_batch,
-                           log10_tau=log10_tau, tau_mode=tau_mode,
-                           tau_args=tau_args, alpha0=alpha0_run,
-                           pipeline=pipeline, want_flux=print_flux,
-                           seq=seq)
-
-        def scatter(self, out, owners, keys, results):
-            packed = np.asarray(out)
-            for i, owner in enumerate(owners):  # pad lanes discarded
-                results[owner] = {k: packed[j, i]
-                                  for j, k in enumerate(keys)}
-
-        def assemble(self, m, results):
-            return _assemble_archive(
-                m, results, modelfile, fit_DM, bary, addtnl_toa_flags,
-                log10_tau=log10_tau,
-                alpha_fitted=fit_scat and not fix_alpha,
-                nu_ref_tau=nu_ref_tau, fit_GM=fit_GM,
-                print_flux=print_flux, print_phase=print_phase,
-                quiet=quiet, quality_flags=quality_flags)
-
     try:
-        # inside the try: a constructor failure (bad stream_devices,
-        # corrupt resume checkpoint) must still close an owned trace
-        ex = _StreamExecutor(_WidebandLane(), datafiles, _loader,
+        # inside the try: a factory/constructor failure (bad options,
+        # bad stream_devices, corrupt resume checkpoint) must still
+        # close an owned trace
+        lane, loader = make_wideband_lane(
+            modelfile, nsub_batch=nsub_batch, fit_DM=fit_DM,
+            fit_GM=fit_GM, nu_ref_DM=nu_ref_DM, nu_ref_tau=nu_ref_tau,
+            DM0=DM0, bary=bary, tscrunch=tscrunch, fit_scat=fit_scat,
+            log10_tau=log10_tau, scat_guess=scat_guess,
+            fix_alpha=fix_alpha, max_iter=max_iter,
+            print_flux=print_flux, print_phase=print_phase,
+            instrumental_response_dict=instrumental_response_dict,
+            addtnl_toa_flags=addtnl_toa_flags, quiet=quiet,
+            quality_flags=quality_flags, tracer=tracer)
+        ex = _StreamExecutor(lane, datafiles, loader,
                              nsub_batch, max_inflight=max_inflight,
                              prefetch=prefetch, tim_out=tim_out,
                              resume=resume, skip_archives=skip_archives,
@@ -1773,15 +2091,8 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
         nfit, fit_duration = ex.nfit, ex.fit_duration
 
         # ---- collect TOAs + per-archive DeltaDM stats in archive order
-        TOA_list = []
-        order, DM0s, DeltaDM_means, DeltaDM_errs = [], [], [], []
-        for m in meta:
-            toas, mean, err = assembled[m.iarch]
-            TOA_list.extend(toas)
-            order.append(m.datafile)
-            DM0s.append(m.DM0_arch)
-            DeltaDM_means.append(mean)
-            DeltaDM_errs.append(err)
+        (TOA_list, order, DM0s, DeltaDM_means,
+         DeltaDM_errs) = _collect_wideband(meta, assembled)
 
         tot = time.time() - t_start
         n = len(TOA_list)

@@ -1,0 +1,14 @@
+"""Continuous-batching TOA service (ISSUE 8; ROADMAP item 2).
+
+One warm stream executor per host, fed by a shape-bucketed admission
+queue: concurrent clients submit archives, compatible subints coalesce
+into shared fused dispatches across requests (a bucket launches when
+full or past ``config.serve_max_wait_ms``), and completed TOAs
+demultiplex back to per-request ``.tim`` results byte-identical to the
+one-shot drivers.  See serve/server.py for the architecture and
+docs/GUIDE.md "Serving TOAs" for usage; the CLI is ``ppserve``.
+"""
+
+from .client import ToaClient  # noqa: F401
+from .queue import AdmissionQueue, ServeRejected, ServeRequest  # noqa: F401
+from .server import ToaServer  # noqa: F401

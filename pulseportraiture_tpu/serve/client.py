@@ -1,0 +1,50 @@
+"""Thin in-process client of a :class:`~.server.ToaServer`.
+
+The server's ``submit`` is already thread-safe; this wrapper is the
+blocking convenience most callers want — submit-and-wait with the
+one-shot driver's return shape — plus a fan-out helper for scripted
+multi-request clients (benchmarks, the ppserve CLI).  A remote
+transport would implement this same two-call surface over a socket;
+everything below it (queueing, coalescing, demux) is transport-
+agnostic.
+"""
+
+__all__ = ["ToaClient"]
+
+
+class ToaClient:
+    """Blocking client: each call is one request against the shared
+    warm server; concurrent callers coalesce into shared fused
+    dispatches whenever they use the same template and options."""
+
+    def __init__(self, server):
+        self.server = server
+
+    def submit(self, datafiles, modelfile, tim_out=None, name=None,
+               **options):
+        """Non-blocking: returns the ServeRequest handle (may raise
+        ServeRejected — the backpressure signal)."""
+        return self.server.submit(datafiles, modelfile,
+                                  tim_out=tim_out, name=name,
+                                  **options)
+
+    def get_TOAs(self, datafiles, modelfile, timeout=None,
+                 tim_out=None, name=None, **options):
+        """Submit and wait: returns the per-request DataBunch
+        (TOA_list, order, DM0s, DeltaDM_means/errs, tim_out), the same
+        result shape as stream_wideband_TOAs."""
+        return self.submit(datafiles, modelfile, tim_out=tim_out,
+                           name=name, **options).result(timeout)
+
+    def map(self, specs, timeout=None):
+        """Submit many requests, then wait for all: ``specs`` is a
+        sequence of (datafiles, modelfile[, kwargs-dict]) tuples;
+        returns the results in spec order.  Submission errors
+        (ServeRejected) raise immediately — before any wait — so a
+        load-shedding server is visible at the call site."""
+        handles = []
+        for spec in specs:
+            datafiles, modelfile = spec[0], spec[1]
+            kwargs = dict(spec[2]) if len(spec) > 2 else {}
+            handles.append(self.submit(datafiles, modelfile, **kwargs))
+        return [h.result(timeout) for h in handles]

@@ -1,0 +1,184 @@
+"""Admission queue + request objects for the TOA serving loop.
+
+The queue is the BACKPRESSURE story of the service (ISSUE 8): it is
+bounded in ARCHIVES (the unit of admission work — one archive is one
+load + prepare + bucket fill), and a submit that would exceed the
+bound raises :class:`ServeRejected` LOUDLY instead of absorbing
+unbounded host memory.  Clients retry, shed load, or raise
+``config.serve_queue_depth``; the server never silently queues more
+than it agreed to.  Device-side concurrency is bounded separately by
+the executor's ``max_inflight``/``pipeline_depth`` — the admission
+bound only governs what the host has promised to prepare.
+
+A :class:`ServeRequest` is one client submission: a batch of archives
+measured against one template with one option set.  Its lifecycle is
+submit -> admit (the server loads + buckets its archives; subints from
+different requests coalesce into shared fused dispatches) -> done (the
+per-request ``.tim``/result is demultiplexed back out).  ``result()``
+blocks the submitting client; the server thread resolves it.
+"""
+
+import itertools
+import threading
+import time
+
+__all__ = ["ServeRejected", "ServeRequest", "AdmissionQueue"]
+
+
+class ServeRejected(RuntimeError):
+    """A submission the server did NOT accept: the admission queue is
+    at capacity (backpressure — ``retryable`` is True, retry later or
+    shed load) or the server is stopping/closed (``retryable`` False —
+    resubmitting can never succeed).  Nothing about the request was
+    enqueued."""
+
+    def __init__(self, msg, retryable=False):
+        super().__init__(msg)
+        self.retryable = bool(retryable)
+
+
+class ServeRequest:
+    """One client submission to the serving loop.
+
+    datafiles: archive paths (or a metafile path); modelfile: the
+    template; options: make_wideband_lane kwargs (fit_scat=, DM0=,
+    print_flux=, ...) — requests sharing (modelfile, options) share a
+    lane and therefore coalesce into the same fused buckets; tim_out:
+    optional path the server writes this request's .tim to (archive
+    order, completion sentinels — byte-identical to the one-shot
+    driver's checkpoint).  The server fills the bookkeeping fields;
+    clients call :meth:`result`.
+    """
+
+    _ids = itertools.count()
+
+    def __init__(self, datafiles, modelfile, options=None, tim_out=None,
+                 name=None):
+        from ..pipeline.toas import _is_metafile, _read_metafile
+
+        if isinstance(datafiles, str):
+            self.datafiles = (_read_metafile(datafiles)
+                              if _is_metafile(datafiles)
+                              else [datafiles])
+        else:
+            self.datafiles = list(datafiles)
+        if not self.datafiles:
+            raise ValueError("ServeRequest: empty datafile list")
+        self.modelfile = str(modelfile)
+        self.options = dict(options or {})
+        self.tim_out = tim_out
+        self.name = str(name) if name is not None else \
+            f"req{next(ServeRequest._ids)}"
+        # lifecycle timestamps (monotonic): submit by the queue, admit/
+        # done by the server — what the request_done latency split and
+        # the pptrace serve section report
+        self.t_submit = None
+        self.t_admit = None
+        self.t_done = None
+        # server-side demux state: archive position -> (meta, assembly)
+        self.meta = {}
+        self.assembled = {}
+        self.n_skipped = 0
+        self.all_admitted = False
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        """Block until the server resolves this request; returns the
+        per-request DataBunch (TOA_list, order, DM0s, DeltaDM_means/
+        errs, tim_out) or raises the server-side failure."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"{self.name}: no result within {timeout} s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class AdmissionQueue:
+    """Bounded, thread-safe request queue feeding one serving loop.
+
+    ``submit`` (any client thread) appends or REJECTS — it never
+    blocks, so a client can tell load-shedding from slowness.  ``get``
+    (the server thread) pops with a timeout so the serving loop keeps
+    ticking its deadline flushes while idle.  The archive-count
+    accounting is released as the server admits each archive
+    (:meth:`release`), i.e. the bound covers submitted-but-not-yet-
+    prepared work.
+    """
+
+    def __init__(self, max_pending):
+        self.max_pending = max(1, int(max_pending))
+        self._cv = threading.Condition()
+        self._q = []
+        self._pending = 0
+        self._closed = False
+
+    def __len__(self):
+        with self._cv:
+            return len(self._q)
+
+    @property
+    def pending_archives(self):
+        with self._cv:
+            return self._pending
+
+    def submit(self, request):
+        """Enqueue or raise ServeRejected (queue full / closed)."""
+        n = len(request.datafiles)
+        with self._cv:
+            if self._closed:
+                raise ServeRejected(
+                    "serving queue is closed (server stopping); "
+                    f"request {request.name!r} rejected")
+            if n > self.max_pending:
+                # could NEVER fit, even into an idle queue: terminal,
+                # not retryable — a retrying client would spin forever
+                raise ServeRejected(
+                    f"request {request.name!r} holds {n} archives, "
+                    f"more than the whole queue depth "
+                    f"{self.max_pending}; split it or raise "
+                    "config.serve_queue_depth")
+            if self._pending + n > self.max_pending:
+                raise ServeRejected(
+                    f"admission queue full: {self._pending} archive(s) "
+                    f"pending + {n} submitted > queue depth "
+                    f"{self.max_pending} (config.serve_queue_depth / "
+                    "PPT_SERVE_QUEUE_DEPTH); retry later",
+                    retryable=True)
+            self._pending += n
+            request.t_submit = time.monotonic()
+            self._q.append(request)
+            self._cv.notify()
+
+    def get(self, timeout=None):
+        """Pop the oldest request, waiting up to ``timeout`` seconds;
+        None on timeout (or closed-and-empty)."""
+        with self._cv:
+            if not self._q and not self._closed:
+                self._cv.wait(timeout)
+            return self._q.pop(0) if self._q else None
+
+    def release(self, n=1):
+        """Return ``n`` archives' worth of admission credit (the
+        server admitted or abandoned them)."""
+        with self._cv:
+            self._pending = max(0, self._pending - int(n))
+
+    def close(self):
+        """Refuse all further submissions (graceful-drain entry);
+        already-queued requests still drain."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def drain(self):
+        """Pop everything still queued (abort path) — the caller fails
+        these requests loudly."""
+        with self._cv:
+            out, self._q = self._q, []
+            return out

@@ -60,6 +60,7 @@ CONFIG_SNAPSHOT_KEYS = (
     "cross_spectrum_dtype", "dft_precision", "dft_fold", "align_device",
     "stream_devices", "stream_max_inflight", "stream_pipeline_depth",
     "compile_cache_dir", "telemetry_path",
+    "serve_max_wait_ms", "serve_queue_depth", "bucket_pad",
     "use_fast_fit", "use_matmul_dft", "fit_harmonic_window",
     "scatter_compensated",
 )
@@ -96,6 +97,22 @@ EVENT_FIELDS = {
     "campaign_start": {"n_jobs", "pid", "nproc"},
     "pulsar_done": {"pulsar", "n_toas", "nfit"},
     "campaign_end": {"n_toas", "nfit", "wall_s"},
+    # the serving loop (serve/server.py): request lifecycle, the
+    # cross-request coalescing proof, and the AOT warmup ledger the
+    # "serve" report section aggregates
+    "serve_start": {"n_devices", "nsub_batch", "max_wait_ms",
+                    "queue_depth"},
+    "serve_stop": {"drained"},
+    "request_submit": {"req", "n_archives"},
+    "request_done": {"req", "n_toas", "n_archives", "wall_s",
+                     "queue_s"},
+    # one per fused dispatch the server launches: rows = real subints,
+    # pad = padded rows, n_requests = distinct requests sharing the
+    # bucket (> 1 is continuous batching doing its job)
+    "batch_coalesce": {"seq", "n_requests", "rows", "pad"},
+    # AOT warmup (utils/device.warmup_from_manifest): one per
+    # (manifest shape x device) compiled before serving started
+    "warmup_compile": {"shape", "device", "compile_s"},
     "counters": {"counters", "gauges"},
 }
 
@@ -616,6 +633,47 @@ def report(path, file=None):
     else:
         p("  (no dispatch events)")
 
+    # ---- serve (request lifecycle + continuous batching) ------------
+    req_done = by_type.get("request_done", [])
+    coalesce = by_type.get("batch_coalesce", [])
+    warmups = by_type.get("warmup_compile", [])
+    occupancy = None
+    req_p50 = req_p99 = None
+    if req_done or coalesce or warmups:
+        p("")
+        p("-- serve (continuous batching) --")
+        n_sub = len(by_type.get("request_submit", []))
+        if req_done:
+            walls = np.asarray([ev["wall_s"] for ev in req_done], float)
+            queues = np.asarray([ev["queue_s"] for ev in req_done],
+                                float)
+            req_p50 = float(np.percentile(walls, 50))
+            req_p99 = float(np.percentile(walls, 99))
+            ntoa = sum(int(ev["n_toas"]) for ev in req_done)
+            p(f"  {len(req_done)}/{n_sub or len(req_done)} requests "
+              f"done, {ntoa} TOAs")
+            p(f"  request latency (submit->done): p50 {req_p50:.3f} s  "
+              f"p90 {np.percentile(walls, 90):.3f} s  "
+              f"p99 {req_p99:.3f} s")
+            serve_s = walls - queues
+            p(f"  queue-wait vs serve split: mean wait "
+              f"{queues.mean():.3f} s, mean serve {serve_s.mean():.3f} "
+              f"s (of which fused-fit wall rides the device sections "
+              "above)")
+        if coalesce:
+            rows = sum(int(ev["rows"]) for ev in coalesce)
+            pad = sum(int(ev["pad"]) for ev in coalesce)
+            occupancy = rows / max(rows + pad, 1)
+            shared = sum(1 for ev in coalesce if ev["n_requests"] > 1)
+            p(f"  batch occupancy: {rows} rows used / {pad} padded "
+              f"({100 * occupancy:.1f}% full) across {len(coalesce)} "
+              f"dispatches; {shared} dispatch(es) coalesced >1 "
+              "request")
+        if warmups:
+            w_s = sum(float(ev["compile_s"]) for ev in warmups)
+            p(f"  AOT warmup: {len(warmups)} (shape x device) "
+              f"program(s) compiled in {w_s:.3f} s before serving")
+
     # ---- quality ----------------------------------------------------
     qual = by_type.get("quality", [])
     snr = [v for ev in qual for v in ev["snr"]]
@@ -659,6 +717,12 @@ def report(path, file=None):
         "n_quality": len(snr),
         "n_force_flush": len(forces),
         "n_skipped": len(skips),
+        "n_requests": len(req_done),
+        "req_p50_s": req_p50,
+        "req_p99_s": req_p99,
+        "n_coalesce": len(coalesce),
+        "batch_occupancy": occupancy,
+        "n_warmup": len(warmups),
         "counters": counters,
         "gauges": gauges,
     }

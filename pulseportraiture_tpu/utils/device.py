@@ -81,6 +81,163 @@ def enable_compile_cache(path=None):
     return path
 
 
+def warmup_from_manifest(manifest_path, modelfile=None, devices=None,
+                         nsub_batch=64, tracer=None, quiet=True,
+                         max_iter=25, fit_scat=False, log10_tau=True,
+                         scat_guess=None, print_flux=False,
+                         nu_ref_DM=None):
+    """AOT warmup pass (ROADMAP item 5's tail): lower + compile the
+    fused fit programs for every dispatch shape recorded in a PRIOR
+    run's telemetry trace, before a server starts taking traffic.
+
+    R9 run manifests already record every shape a campaign dispatched
+    (the ``dispatch`` events' ``shape`` strings), so past traces ARE
+    the shape manifest: each distinct shape is parsed back to its
+    bucket geometry (pipeline/stream.parse_shape_key), a synthetic
+    bucket of that geometry is built, and ONE padded dispatch runs
+    through the REAL launch path per (shape x device) — jit compiles
+    per shape/dtype/placement, not values, so the compiled programs
+    are exactly the ones real traffic will hit (and they land in the
+    persistent compile cache when ``config.compile_cache_dir`` is
+    set).  ``modelfile`` shapes the warmup template (its harmonic
+    window feeds the compiled program class on fast-fit backends);
+    without one a synthetic smooth profile is used.  The remaining
+    fit options must match the serving workload (they ride the program
+    cache keys); warmup assumes nonzero DM guesses and
+    not-dedispersed-on-disk archives — a dedispersed archive still
+    pays its own first compile.
+
+    Narrowband (flagless) shapes are skipped with a warning — their
+    launch path is driver-local.  Returns the ``[(shape, device_index)]``
+    list actually compiled; a server feeds it into the executor's warm
+    set so the serve trace records zero cold dispatches for manifest
+    shapes (the before/after gate)."""
+    import time
+
+    import numpy as np
+
+    from ..pipeline import stream as S
+    from ..telemetry import NULL_TRACER, load_trace, log
+
+    tracer = NULL_TRACER if tracer is None else tracer
+    _, events = load_trace(manifest_path)
+    shapes, seen = [], set()
+    for ev in events:
+        if ev.get("type") == "dispatch":
+            s = ev.get("shape")
+            if s and s not in seen:
+                seen.add(s)
+                shapes.append(s)
+    devices = S.resolve_stream_devices(devices)
+
+    # tau seeding resolution mirroring make_wideband_lane
+    if scat_guess is not None and not isinstance(scat_guess, str):
+        tau_mode = "explicit"
+        tau_args = tuple(float(v) for v in scat_guess)
+    elif fit_scat and scat_guess == "auto":
+        tau_mode, tau_args = "auto", (0.0, 1.0, 0.0)
+    elif fit_scat:
+        tau_mode, tau_args = "neutral", (0.0, 1.0, 0.0)
+    else:
+        tau_mode, tau_args = "none", (0.0, 1.0, 0.0)
+    if not fit_scat:
+        log10_tau = False
+    wire = {"i16": np.int16, "u8": np.uint8, "i8": np.uint8,
+            "f32": np.float32}
+
+    rng = np.random.default_rng(0)
+    warmed = []
+    t_all = time.perf_counter()
+    for shape in shapes:
+        try:
+            spec = S.parse_shape_key(shape)
+        except ValueError as e:
+            log(f"warmup: skipping {shape!r}: {e}", level="warn")
+            continue
+        if spec["flags"] is None:
+            log(f"warmup: skipping narrowband shape {shape!r} (only "
+                "the wideband launch path is warmed)", level="warn")
+            continue
+        nchan, nbin = spec["nchan"], spec["nbin"]
+        freqs = np.linspace(1400.0, 1600.0, nchan) if nchan > 1 \
+            else np.array([1500.0])
+        modelx = None
+        if modelfile:
+            try:
+                from ..pipeline.models import TemplateModel
+                modelx = np.asarray(TemplateModel(
+                    modelfile, quiet=True).portrait(freqs, nbin,
+                                                    P=0.003))
+            except Exception as e:
+                log(f"warmup: template portrait failed for {shape!r} "
+                    f"({e}); using a synthetic profile", level="warn")
+        if modelx is None:
+            ph = np.arange(nbin) / nbin
+            prof = np.exp(-0.5 * ((ph - 0.3) / 0.02) ** 2)
+            modelx = np.broadcast_to(prof, (nchan, nbin)).copy()
+
+        for idev, dev in enumerate(devices):
+            b = S._Bucket(freqs, nbin, modelx, spec["flags"],
+                          kind=spec["kind"],
+                          raw_code=spec["raw_code"],
+                          pol_sum=spec["pol_sum"])
+            # ONE row; _launch pads to nsub_batch — the real batch
+            # shape class.  Values are arbitrary (compiles key on
+            # shape/dtype); the DM guess is NONZERO so the general
+            # seed-derotation program compiles, matching real archives
+            if spec["kind"] == "raw":
+                rshape = ((2, nchan, nbin) if spec["pol_sum"]
+                          else (nchan, nbin))
+                cshape = (2, nchan) if spec["pol_sum"] else (nchan,)
+                if spec["raw_code"] == "f32":
+                    b.raw.append(rng.standard_normal(rshape)
+                                 .astype(np.float32))
+                else:
+                    b.raw.append(rng.integers(1, 100, size=rshape)
+                                 .astype(wire[spec["raw_code"]]))
+                b.scl.append(np.ones(cshape, np.float32))
+                b.offs.append(np.zeros(cshape, np.float32))
+                b.DM_guess.append(1.0)
+                b.dedisp.append((0.0, 0.0))
+            else:
+                b.ports.append(rng.standard_normal((nchan, nbin)))
+                b.noise.append(np.ones(nchan))
+                b.nu_fits.append(float(freqs.mean()))
+                th = np.zeros(5)
+                th[1] = 1.0
+                b.theta0.append(th)
+            b.masks.append(np.ones(nchan))
+            b.Ps.append(0.003)
+            b.owners.append((0, 0))
+            pl = S._DevicePipeline(dev, idev, 1, NULL_TRACER,
+                                   lambda seq: False)
+            t0 = time.perf_counter()
+            try:
+                rec = S._launch(b, nu_ref_DM, max_iter, nsub_batch,
+                                log10_tau=log10_tau, tau_mode=tau_mode,
+                                tau_args=tau_args, alpha0=-4.0,
+                                pipeline=pl, want_flux=print_flux,
+                                seq=0)
+                out = rec[0].result()
+                try:
+                    jax.block_until_ready(out)
+                except TypeError:
+                    pass
+            finally:
+                pl.shutdown(wait=True)
+            dt = time.perf_counter() - t0
+            if tracer.enabled:
+                tracer.emit("warmup_compile", shape=shape, device=idev,
+                            compile_s=round(dt, 6))
+            warmed.append((shape, idev))
+    if warmed:
+        log(f"warmup: compiled {len(warmed)} (shape x device) "
+            f"program(s) from {manifest_path} in "
+            f"{time.perf_counter() - t_all:.2f} s", quiet=quiet,
+            tracer=None)
+    return warmed
+
+
 def on_host(fn):
     """Decorator: run the whole function under host_compute().
 

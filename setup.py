@@ -14,6 +14,7 @@ setup(
     entry_points={
         "console_scripts": [
             "pptoas=pulseportraiture_tpu.cli.pptoas:main",
+            "ppserve=pulseportraiture_tpu.cli.ppserve:main",
             "ppalign=pulseportraiture_tpu.cli.ppalign:main",
             "ppgauss=pulseportraiture_tpu.cli.ppgauss:main",
             "ppspline=pulseportraiture_tpu.cli.ppspline:main",

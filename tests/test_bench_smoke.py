@@ -49,6 +49,13 @@ TINY_ENV = {
                        "PPT_TELEMETRY": ""},
     "bench_ipta": {"PPT_NPSR": "1", "PPT_NARCH": "2", "PPT_NSUB": "2",
                    "PPT_NCHAN": "16", "PPT_NBIN": "128"},
+    "bench_serve": {"PPT_NARCH": "2", "PPT_NSUB": "2",
+                    "PPT_NCHAN": "16", "PPT_NBIN": "128",
+                    "PPT_NREQ": "2", "PPT_CAMPAIGN_CACHE": "",
+                    # ISSUE 8: the serve arm traces request lifecycle
+                    # + batch_coalesce occupancy; the emitted trace
+                    # must validate so serve-event drift fails in CI
+                    "PPT_TELEMETRY": ""},
 }
 
 _CONFIG_KEYS = ("dft_precision", "cross_spectrum_dtype", "dft_fold",
@@ -115,6 +122,33 @@ def test_bench_smoke(name, monkeypatch, capsys, tmp_path):
         dispatches = [e for e in events if e["type"] == "dispatch"]
         last_run = [e for e in events if e["type"] == "run_end"][-1]
         assert len(dispatches) >= last_run["nfit"]
+    if name == "bench_serve":
+        # ISSUE 8: the offered-load sweep must report both arms with
+        # latency percentiles, and the serve traces must schema-
+        # validate with the request lifecycle + coalesce events (the
+        # 1.1x throughput gate belongs to real bench runs — a tiny
+        # CPU shape pays the whole bucket deadline per dispatch)
+        assert out["oneshot_toas_per_sec"] > 0
+        assert out["serve_vs_oneshot"] > 0
+        assert [a["concurrency"] for a in out["sweep"]] == [1, 2]
+        for arm in out["sweep"]:
+            assert arm["toas_per_sec"] > 0
+            assert arm["p99_s"] >= arm["p50_s"] > 0
+            assert arm["batch_occupancy"] is not None
+        from pulseportraiture_tpu import telemetry
+
+        for conc in ("1", "2"):
+            trace = str(tmp_path / "trace.jsonl") + f".serve{conc}"
+            assert os.path.exists(trace), f"no serve{conc} trace"
+            manifest, events = telemetry.validate_trace(trace)
+            assert manifest["run"] == "ppserve"
+            etypes = {e["type"] for e in events}
+            for needed in ("serve_start", "request_submit",
+                           "request_done", "batch_coalesce",
+                           "dispatch", "drain", "serve_stop"):
+                assert needed in etypes, needed
+            done = [e for e in events if e["type"] == "request_done"]
+            assert len(done) == int(conc)
     if name == "bench_campaign":
         # ISSUE 6: the reworked link-bound bench must report both
         # pipeline arms with byte-identical .tim output and emit

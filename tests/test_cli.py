@@ -10,7 +10,8 @@ import re
 import numpy as np
 import pytest
 
-from pulseportraiture_tpu.cli import ppalign, ppgauss, ppspline, pptoas, ppzap
+from pulseportraiture_tpu.cli import (ppalign, ppgauss, ppserve,
+                                      ppspline, pptoas, ppzap)
 from pulseportraiture_tpu.synth import default_test_model, make_fake_pulsar
 from pulseportraiture_tpu.utils.mjd import MJD
 
@@ -173,6 +174,79 @@ def test_pptoas_cli_stream_matches(workspace, tmp_path):
     with pytest.raises(SystemExit):
         pptoas.main(["-d", meta, "-m", gm, "--stream", "--fit_GM",
                      "--quiet"])
+
+
+def test_ppserve_cli_serves_requests(workspace, tmp_path):
+    """ppserve end-to-end: a 2-request JSONL spec served through one
+    warm loop writes per-request .tim files identical to the one-shot
+    --stream driver's checkpoints."""
+    import json
+
+    from pulseportraiture_tpu.io import write_gmodel
+
+    root, meta, files = workspace
+    gm = str(tmp_path / "truth.gmodel")
+    write_gmodel(default_test_model(1500.0), gm, quiet=True)
+    # per-request one-shot references
+    refs = {}
+    for name, f in (("R0", files[0]), ("R1", files[1])):
+        tim = tmp_path / f"{name}.ref.tim"
+        from pulseportraiture_tpu.pipeline import stream_wideband_TOAs
+
+        stream_wideband_TOAs([f], gm, nsub_batch=8, tim_out=str(tim),
+                             quiet=True)
+        refs[name] = tim.read_bytes()
+    reqfile = tmp_path / "requests.jsonl"
+    reqfile.write_text("".join(
+        json.dumps({"name": name, "datafiles": [f], "modelfile": gm})
+        + "\n" for name, f in (("R0", files[0]), ("R1", files[1]))))
+    outdir = tmp_path / "served"
+    rc = ppserve.main(["-r", str(reqfile), "-O", str(outdir),
+                       "--nsub-batch", "8", "--max-wait-ms", "30",
+                       "--quiet"])
+    assert rc == 0
+    for name, ref in refs.items():
+        assert (outdir / f"{name}.tim").read_bytes() == ref
+
+
+def test_ppserve_flag_and_request_validation(tmp_path):
+    """ppserve rejects malformed flags and request files loudly,
+    before any serving starts."""
+    import json
+
+    good = tmp_path / "ok.jsonl"
+    good.write_text(json.dumps({"name": "A", "datafiles": ["a.fits"],
+                                "modelfile": "m.gmodel"}) + "\n")
+    base = ["-r", str(good)]
+    with pytest.raises(SystemExit, match="max-wait-ms"):
+        ppserve.main(base + ["--max-wait-ms", "-5"])
+    with pytest.raises(SystemExit, match="queue-depth"):
+        ppserve.main(base + ["--queue-depth", "0"])
+    with pytest.raises(SystemExit, match="nsub-batch"):
+        ppserve.main(base + ["--nsub-batch", "0"])
+    with pytest.raises(SystemExit, match="pipeline-depth"):
+        ppserve.main(base + ["--pipeline-depth", "0"])
+    with pytest.raises(SystemExit, match="stream-devices"):
+        ppserve.main(base + ["--stream-devices", "several"])
+    with pytest.raises(SystemExit, match="warmup-model"):
+        ppserve.main(base + ["--warmup-model", "m.gmodel"])
+    with pytest.raises(SystemExit, match="not found"):
+        ppserve.main(["-r", str(tmp_path / "missing.jsonl")])
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("not json\n")
+    with pytest.raises(SystemExit, match="bad JSON"):
+        ppserve.main(["-r", str(bad)])
+    bad.write_text(json.dumps({"datafiles": ["a.fits"]}) + "\n")
+    with pytest.raises(SystemExit, match="modelfile"):
+        ppserve.main(["-r", str(bad)])
+    dup = json.dumps({"name": "X", "datafiles": ["a.fits"],
+                      "modelfile": "m"})
+    bad.write_text(dup + "\n" + dup + "\n")
+    with pytest.raises(SystemExit, match="duplicate"):
+        ppserve.main(["-r", str(bad)])
+    bad.write_text("")
+    with pytest.raises(SystemExit, match="no requests"):
+        ppserve.main(["-r", str(bad)])
 
 
 def test_pptoas_stream_devices_flag_validation():

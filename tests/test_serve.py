@@ -1,0 +1,396 @@
+"""Continuous-batching TOA service (ISSUE 8): the serving loop must
+reproduce the one-shot driver byte-for-byte while coalescing subints
+across concurrent requests, honor its deadline/backpressure/drain
+contracts, and the satellite passes (manifest AOT warmup, bucket-
+lattice padding) must hold their gates."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu import config, telemetry
+from pulseportraiture_tpu.io import write_gmodel
+from pulseportraiture_tpu.pipeline import stream_wideband_TOAs
+from pulseportraiture_tpu.serve import (AdmissionQueue, ServeRejected,
+                                        ServeRequest, ToaClient,
+                                        ToaServer)
+from pulseportraiture_tpu.synth import default_test_model, make_fake_pulsar
+from pulseportraiture_tpu.utils.mjd import MJD
+
+PAR = {"PSR": "J1744-1134", "RAJ": "17:44:29.4", "DECJ": "-11:34:54.6",
+       "P0": 0.004074, "PEPOCH": 55000.0, "DM": 3.139}
+
+
+@pytest.fixture(scope="module")
+def campaign(tmp_path_factory):
+    root = tmp_path_factory.mktemp("serve")
+    model = default_test_model(1500.0)
+    gmodel = str(root / "model.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files = []
+    for i in range(4):
+        path = str(root / f"ep{i}.fits")
+        make_fake_pulsar(model, PAR, outfile=path, nsub=2, nchan=16,
+                         nbin=128, nu0=1500.0, bw=400.0, tsub=60.0,
+                         phase=0.01 * i, dDM=1e-4,
+                         start_MJD=MJD(55100 + i, 0.1), noise_stds=0.08,
+                         dedispersed=False, quiet=True, rng=100 + i)
+        files.append(path)
+    return files, gmodel
+
+
+def test_serve_concurrent_clients_byte_identical(campaign, tmp_path):
+    """The acceptance core: >= 2 client threads submit concurrently,
+    their subints COALESCE into shared fused buckets (batch_coalesce
+    proves it), and each request's .tim is byte-identical to the
+    one-shot driver's checkpoint for the same archives."""
+    files, gmodel = campaign
+    filesA, filesB = files[:2], files[2:]
+    timA1, timB1 = tmp_path / "A1.tim", tmp_path / "B1.tim"
+    a1 = stream_wideband_TOAs(filesA, gmodel, nsub_batch=8,
+                              tim_out=str(timA1), quiet=True)
+    b1 = stream_wideband_TOAs(filesB, gmodel, nsub_batch=8,
+                              tim_out=str(timB1), quiet=True)
+
+    trace = str(tmp_path / "serve.jsonl")
+    timA2, timB2 = tmp_path / "A2.tim", tmp_path / "B2.tim"
+    # max_wait longer than admission so the shared bucket really spans
+    # both requests before anything launches (each request alone holds
+    # 4 subints of the 8-subint bucket)
+    srv = ToaServer(nsub_batch=8, max_wait_ms=500,
+                    telemetry=trace).start()
+    client = ToaClient(srv)
+    results = {}
+
+    def go(tag, fs, tim):
+        results[tag] = client.get_TOAs(fs, gmodel, timeout=300,
+                                       tim_out=str(tim), name=tag)
+
+    threads = [threading.Thread(target=go, args=("A", filesA, timA2)),
+               threading.Thread(target=go, args=("B", filesB, timB2))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    srv.stop()
+
+    assert timA1.read_bytes() == timA2.read_bytes()
+    assert timB1.read_bytes() == timB2.read_bytes()
+    for one, served in ((a1, results["A"]), (b1, results["B"])):
+        assert len(served.TOA_list) == len(one.TOA_list) == 4
+        assert served.order == one.order
+        assert served.DeltaDM_means == one.DeltaDM_means
+        for ta, tb in zip(one.TOA_list, served.TOA_list):
+            assert (ta.MJD.day, ta.MJD.frac) == (tb.MJD.day, tb.MJD.frac)
+            assert ta.DM == tb.DM
+            assert ta.flags == tb.flags
+
+    manifest, events = telemetry.validate_trace(trace)
+    coalesce = [e for e in events if e["type"] == "batch_coalesce"]
+    assert coalesce, "server launched no dispatches?"
+    # the fused bucket really mixed both requests' subints
+    assert max(e["n_requests"] for e in coalesce) >= 2
+    done = [e for e in events if e["type"] == "request_done"]
+    assert {e["req"] for e in done} == {"A", "B"}
+    assert all(e["wall_s"] >= e["queue_s"] >= 0 for e in done)
+    import io
+
+    summary = telemetry.report(trace, file=io.StringIO())
+    assert summary["n_requests"] == 2
+    assert summary["req_p50_s"] is not None
+    assert summary["batch_occupancy"] is not None
+
+
+def test_serve_deadline_flush_partial_bucket(campaign, tmp_path):
+    """Continuous batching's latency half: a bucket that can never
+    fill (nsub_batch far above the offered subints) launches once its
+    oldest subint exceeds serve_max_wait_ms, padded to the shape
+    class — the request completes without further traffic."""
+    files, gmodel = campaign
+    trace = str(tmp_path / "deadline.jsonl")
+    with ToaServer(nsub_batch=64, max_wait_ms=30,
+                   telemetry=trace) as srv:
+        res = srv.submit(files[:1], gmodel, name="D").result(300)
+    assert len(res.TOA_list) == 2
+    _, events = telemetry.validate_trace(trace)
+    co = [e for e in events if e["type"] == "batch_coalesce"]
+    assert len(co) == 1
+    assert co[0]["rows"] == 2 and co[0]["pad"] == 62  # padded partial
+
+
+def test_serve_backpressure_and_closed_rejection(campaign, tmp_path):
+    """The admission bound is LOUD: a submit beyond queue_depth
+    archives raises ServeRejected with retryable=True (nothing
+    enqueued); after stop() the rejection is terminal
+    (retryable=False)."""
+    files, gmodel = campaign
+    srv = ToaServer(nsub_batch=8, max_wait_ms=20, queue_depth=2)
+    # a request larger than the WHOLE queue could never fit: terminal
+    # rejection (retrying it would spin forever), even on an idle queue
+    with pytest.raises(ServeRejected, match="split it") as ei:
+        srv.submit(files[:3], gmodel, name="huge")
+    assert not ei.value.retryable
+    # not started: nothing drains the queue, so the bound is exact
+    first = srv.submit(files[:2], gmodel, name="ok")
+    with pytest.raises(ServeRejected, match="queue full") as ei:
+        srv.submit(files[2:], gmodel, name="shed")
+    assert ei.value.retryable
+    srv.start()
+    res = first.result(300)
+    assert len(res.TOA_list) == 4
+    srv.stop()
+    with pytest.raises(ServeRejected, match="stopping") as ei:
+        srv.submit(files[:1], gmodel)
+    assert not ei.value.retryable
+
+
+def test_serve_graceful_drain_completes_outstanding(campaign, tmp_path):
+    """stop(drain=True) called right after submit: the request must
+    still resolve (queue drains, buckets flush, dispatches drain)
+    before stop returns."""
+    files, gmodel = campaign
+    srv = ToaServer(nsub_batch=64, max_wait_ms=1000).start()
+    h = srv.submit(files[:2], gmodel, name="G")
+    srv.stop(drain=True)  # long deadline: only the drain flushes it
+    assert h.done()
+    assert len(h.result(0)
+               .TOA_list) == 4
+
+
+def test_serve_request_error_isolated(campaign, tmp_path):
+    """A request with a broken option set fails ITS result; the
+    server keeps serving."""
+    files, gmodel = campaign
+    with ToaServer(nsub_batch=8, max_wait_ms=20) as srv:
+        bad = srv.submit(files[:1], gmodel, name="bad",
+                         no_such_option=True)
+        good = srv.submit(files[:1], gmodel, name="good")
+        with pytest.raises(TypeError, match="no_such_option"):
+            bad.result(300)
+        assert len(good.result(300).TOA_list) == 2
+
+
+def test_serve_warmup_manifest_kills_cold_starts(campaign, tmp_path):
+    """ROADMAP item 5's tail: AOT warmup from a prior run's trace
+    compiles every recorded dispatch shape at server start, and the
+    serve trace then records ZERO cold dispatches — with output still
+    byte-identical to the one-shot driver."""
+    files, gmodel = campaign
+    prior = str(tmp_path / "prior.jsonl")
+    tim1 = tmp_path / "one.tim"
+    stream_wideband_TOAs(files, gmodel, nsub_batch=8,
+                         tim_out=str(tim1), quiet=True,
+                         telemetry=prior)
+    n_shapes = len({e["shape"]
+                    for e in telemetry.validate_trace(prior)[1]
+                    if e["type"] == "dispatch"})
+    assert n_shapes >= 1
+
+    trace = str(tmp_path / "warm.jsonl")
+    tim2 = tmp_path / "served.tim"
+    with ToaServer(nsub_batch=8, max_wait_ms=20, telemetry=trace,
+                   warmup_manifest=prior, warmup_model=gmodel) as srv:
+        srv.submit(files, gmodel, name="W",
+                   tim_out=str(tim2)).result(300)
+    assert tim1.read_bytes() == tim2.read_bytes()
+
+    import io
+
+    import jax
+
+    _, events = telemetry.validate_trace(trace)
+    warm = [e for e in events if e["type"] == "warmup_compile"]
+    assert len(warm) == n_shapes * len(jax.local_devices())
+    disp = [e for e in events if e["type"] == "dispatch"]
+    assert disp and not any(e["cold"] for e in disp)
+    summary = telemetry.report(trace, file=io.StringIO())
+    assert summary["n_cold"] == 0
+    assert summary["n_warmup"] == len(warm)
+
+
+def test_serve_ipta_campaign_thin_client(campaign, tmp_path):
+    """stream_ipta_campaign(server=...) routes every pulsar's shard
+    through the shared warm server and produces the same per-pulsar
+    .tim files as the executor-per-pulsar path."""
+    from pulseportraiture_tpu.pipeline import stream_ipta_campaign
+
+    files, gmodel = campaign
+    jobs = [("PSRA", files[:2], gmodel), ("PSRB", files[2:], gmodel)]
+    out1, out2 = tmp_path / "solo", tmp_path / "served"
+    r1 = stream_ipta_campaign(jobs, outdir=str(out1), nsub_batch=8,
+                              quiet=True)
+    with ToaServer(nsub_batch=8, max_wait_ms=50) as srv:
+        r2 = stream_ipta_campaign(jobs, outdir=str(out2), nsub_batch=8,
+                                  quiet=True, server=srv)
+        with pytest.raises(ValueError, match="resume"):
+            stream_ipta_campaign(jobs, outdir=str(out2), resume=True,
+                                 quiet=True, server=srv)
+        # executor-level knobs are the SERVER's, not lane options —
+        # refused by name instead of a TypeError on the serving thread
+        with pytest.raises(ValueError, match="max_inflight"):
+            stream_ipta_campaign(jobs, outdir=str(out2), quiet=True,
+                                 server=srv, max_inflight=8)
+    for psr in ("PSRA", "PSRB"):
+        assert ((out1 / f"{psr}.tim").read_bytes()
+                == (out2 / f"{psr}.tim").read_bytes())
+        m1, e1 = r1.DeltaDM_summary[psr]
+        m2, e2 = r2.DeltaDM_summary[psr]
+        assert np.array_equal(m1, m2) and np.array_equal(e1, e2)
+    assert len(r1.TOA_list) == len(r2.TOA_list) == 8
+
+
+def test_bucket_pad_digit_identity(tmp_path):
+    """config.bucket_pad pads a 12-channel layout to the 16-channel
+    shape class (trace shapes prove it) with .tim output byte-
+    identical on BOTH payload lanes — masked edge-replicated pad
+    channels contribute exactly zero."""
+    model = default_test_model(1500.0)
+    gmodel = str(tmp_path / "m.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files = []
+    for i in range(2):
+        p = str(tmp_path / f"np{i}.fits")
+        make_fake_pulsar(model, PAR, outfile=p, nsub=2, nchan=12,
+                         nbin=128, nu0=1500.0, bw=400.0, tsub=60.0,
+                         dDM=1e-4, start_MJD=MJD(55200 + i, 0.1),
+                         noise_stds=0.08, dedispersed=False,
+                         quiet=True, rng=300 + i)
+        files.append(p)
+    assert config.bucket_pad is False
+    for tscrunch, tag in ((False, "raw"), (True, "dec")):
+        tim_e = tmp_path / f"{tag}_exact.tim"
+        tim_p = tmp_path / f"{tag}_pad.tim"
+        trace = str(tmp_path / f"{tag}_pad.jsonl")
+        stream_wideband_TOAs(files, gmodel, nsub_batch=8,
+                             tscrunch=tscrunch, tim_out=str(tim_e),
+                             quiet=True)
+        config.bucket_pad = True
+        try:
+            stream_wideband_TOAs(files, gmodel, nsub_batch=8,
+                                 tscrunch=tscrunch, tim_out=str(tim_p),
+                                 quiet=True, telemetry=trace)
+        finally:
+            config.bucket_pad = False
+        assert tim_e.read_bytes() == tim_p.read_bytes(), tag
+        shapes = {e["shape"]
+                  for e in telemetry.validate_trace(trace)[1]
+                  if e["type"] == "dispatch"}
+        assert shapes and all(s.startswith("16x128:") for s in shapes)
+
+
+def test_bucket_pad_resolution_and_env_hook(monkeypatch):
+    """bucket_pad_to: next power of two when enabled, identity when
+    off; 'auto' pads only on TPU backends; PPT_BUCKET_PAD rides
+    env_overrides with the strict tri-state parse."""
+    from pulseportraiture_tpu.pipeline.stream import bucket_pad_to
+
+    old = config.bucket_pad
+    try:
+        config.bucket_pad = False
+        assert bucket_pad_to(12) == 12
+        config.bucket_pad = True
+        assert [bucket_pad_to(n) for n in (1, 2, 12, 16, 17)] == \
+            [1, 2, 16, 16, 32]
+        config.bucket_pad = "auto"  # tests run on CPU: no padding
+        assert bucket_pad_to(12) == 12
+        config.bucket_pad = "bananas"
+        with pytest.raises(ValueError, match="bucket_pad"):
+            bucket_pad_to(12)
+        monkeypatch.setenv("PPT_BUCKET_PAD", "on")
+        assert "bucket_pad" in config.env_overrides()
+        assert config.bucket_pad is True
+        monkeypatch.setenv("PPT_BUCKET_PAD", "nope")
+        with pytest.raises(ValueError, match="PPT_BUCKET_PAD"):
+            config.env_overrides()
+    finally:
+        config.bucket_pad = old
+
+
+def test_serve_env_hooks(monkeypatch):
+    """PPT_SERVE_MAX_WAIT_MS / PPT_SERVE_QUEUE_DEPTH: strict parses,
+    loud errors, registered in KNOWN_PPT_ENV."""
+    old = (config.serve_max_wait_ms, config.serve_queue_depth)
+    try:
+        for name in ("PPT_SERVE_MAX_WAIT_MS", "PPT_SERVE_QUEUE_DEPTH",
+                     "PPT_BUCKET_PAD"):
+            assert name in config.KNOWN_PPT_ENV
+        monkeypatch.setenv("PPT_SERVE_MAX_WAIT_MS", "125.5")
+        monkeypatch.setenv("PPT_SERVE_QUEUE_DEPTH", "9")
+        changed = config.env_overrides()
+        assert "serve_max_wait_ms" in changed
+        assert "serve_queue_depth" in changed
+        assert config.serve_max_wait_ms == 125.5
+        assert config.serve_queue_depth == 9
+        monkeypatch.setenv("PPT_SERVE_MAX_WAIT_MS", "-1")
+        with pytest.raises(ValueError, match="PPT_SERVE_MAX_WAIT_MS"):
+            config.env_overrides()
+        monkeypatch.setenv("PPT_SERVE_MAX_WAIT_MS", "50")
+        monkeypatch.setenv("PPT_SERVE_QUEUE_DEPTH", "0")
+        with pytest.raises(ValueError, match="PPT_SERVE_QUEUE_DEPTH"):
+            config.env_overrides()
+    finally:
+        (config.serve_max_wait_ms, config.serve_queue_depth) = old
+
+
+def test_admission_queue_units():
+    """Queue accounting: the bound counts archives, release returns
+    credit, close makes submits terminal and drain empties."""
+    q = AdmissionQueue(3)
+    r1 = ServeRequest(["a.fits", "b.fits"], "m.gmodel")
+    r2 = ServeRequest(["c.fits", "d.fits"], "m.gmodel")
+    q.submit(r1)
+    assert q.pending_archives == 2
+    with pytest.raises(ServeRejected, match="queue full"):
+        q.submit(r2)
+    q.release(1)
+    q.submit(r2)  # 1 + 2 <= 3 now
+    assert q.get(0.01) is r1
+    assert q.get(0.01) is r2
+    assert q.get(0.01) is None  # empty -> timeout
+    # credit returns only via release (the server's admission), never
+    # via get: popping a request does not mean its archives were
+    # prepared yet
+    assert q.pending_archives == 3
+    q.release(3)
+    q.submit(ServeRequest(["e.fits"], "m.gmodel"))
+    q.close()
+    with pytest.raises(ServeRejected, match="closed"):
+        q.submit(ServeRequest(["f.fits"], "m.gmodel"))
+    assert len(q.drain()) == 1
+    with pytest.raises(ValueError, match="empty"):
+        ServeRequest([], "m.gmodel")
+
+
+def test_parse_shape_key_roundtrip():
+    """parse_shape_key inverts _bucket_shape for every bucket
+    geometry the dispatcher emits, and refuses garbage loudly."""
+    from pulseportraiture_tpu.pipeline.stream import (_Bucket,
+                                                      _bucket_shape,
+                                                      parse_shape_key)
+
+    freqs = np.linspace(1400.0, 1600.0, 12)
+    cases = [
+        dict(kind="dec", raw_code="i16", pol_sum=False,
+             flags=(True, True, False, False, False)),
+        dict(kind="raw", raw_code="i16", pol_sum=False,
+             flags=(True, True, False, True, True)),
+        dict(kind="raw", raw_code="u8", pol_sum=True,
+             flags=(True, False, False, False, False)),
+        dict(kind="raw", raw_code="f32", pol_sum=False, flags=()),
+    ]
+    for c in cases:
+        b = _Bucket(freqs, 128, None, c["flags"], kind=c["kind"],
+                    raw_code=c["raw_code"], pol_sum=c["pol_sum"])
+        spec = parse_shape_key(_bucket_shape(b))
+        assert spec["nchan"] == 12 and spec["nbin"] == 128
+        assert spec["kind"] == c["kind"]
+        assert spec["pol_sum"] == c["pol_sum"]
+        if c["kind"] == "raw":
+            assert spec["raw_code"] == c["raw_code"]
+        assert spec["flags"] == (c["flags"] or None)
+    for bad in ("x128:dec", "12x128:wat", "12x128:raw:zzz",
+                "12x128:dec:12"):
+        with pytest.raises(ValueError):
+            parse_shape_key(bad)

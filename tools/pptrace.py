@@ -7,8 +7,11 @@ Thin wrapper over ``python -m pulseportraiture_tpu.telemetry``:
     python tools/pptrace.py validate /path/to/trace.jsonl
 
 Traces are written by the campaign drivers when telemetry is enabled
-(``config.telemetry_path``, ``PPT_TELEMETRY=...``, or
-``pptoas --telemetry PATH``); see docs/GUIDE.md "Tracing a campaign".
+(``config.telemetry_path``, ``PPT_TELEMETRY=...``, ``pptoas
+--telemetry PATH``, or ``ppserve --telemetry PATH``); see
+docs/GUIDE.md "Tracing a campaign".  Serving-loop traces add a
+"serve" report section: request-latency percentiles, queue-wait vs
+serve split, batch occupancy, and the AOT warmup ledger.
 """
 
 import os
