@@ -294,6 +294,130 @@ def align_stage_profile(cube, noise, masks, freqs, P_s, acc_dt,
     return profile_stages(full_fn, stages, K=K, nrun=nrun)
 
 
+def gauss_stage_profile(resid_fn, aux, x0, lo, hi, kind, vary,
+                        K=3, nrun=2):
+    """Attribution of the batched template-LM bucket dispatch
+    (fit/lm.levenberg_marquardt_batched, the template factory's
+    portrait stage — ISSUE 9): one vmapped LM iteration decomposed as
+
+      resid    (prefix)  batched residual evaluation at the current
+                         internal parameters (model gen + weighting)
+      jacobian (prefix)  + the vmapped jacfwd (nparam forward passes
+                         through the model — the dominant per-step
+                         cost)
+      solve    (prefix)  + normal equations (g, JTJ, damped A) and the
+                         batched linear solve for the step
+      select   (piece)   the accept/convergence bookkeeping (f_new,
+                         relative-improvement and gradient tests,
+                         state selection) on precomputed pieces
+
+    The full program is exactly the iteration the vmapped while_loop
+    body runs (under vmap the lax.cond Jacobian skip becomes a select,
+    so jac IS evaluated every iteration — the decomposition matches
+    the real batched program, not the single-problem one).  Arrays
+    ship as ARGUMENTS, never jit-closed-over constants (XLA would
+    constant-fold the stage at compile time — the exp_breakdown
+    lesson)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pulseportraiture_tpu.fit.lm import _to_external, _to_internal
+    from pulseportraiture_tpu.profiling import Stage, profile_stages
+
+    x0 = jnp.asarray(x0)
+    dt = x0.dtype
+    vary_f = jnp.asarray(vary).astype(dt)
+    u0 = _to_internal(x0, lo, hi, kind)  # elementwise: batched as-is
+    lam = jnp.full(x0.shape[0], 1e-3, dt)
+
+    def rfun_one(u, lo1, hi1, k1, aux1):
+        return resid_fn(_to_external(u, lo1, hi1, k1), *aux1)
+
+    def jac_one(u, lo1, hi1, k1, v1, aux1):
+        return jax.jacfwd(rfun_one)(u, lo1, hi1, k1, aux1) * v1[None, :]
+
+    @jax.jit
+    def resid_prefix(u, lo, hi, kind, aux):
+        r = jax.vmap(rfun_one)(u, lo, hi, kind, aux)
+        return jnp.sum(r * r, axis=-1)
+
+    def _solve_parts(r, J, u, vary_f, lam):
+        g = jnp.einsum("bri,br->bi", J, r)
+        JTJ = jnp.einsum("bri,brj->bij", J, J)
+        dJ = jnp.diagonal(JTJ, axis1=-2, axis2=-1)
+        dJ = jnp.maximum(dJ, 1e-14 * jnp.max(dJ, axis=-1,
+                                             keepdims=True))
+        A = (JTJ + lam[:, None, None] * jax.vmap(jnp.diag)(dJ)
+             + jax.vmap(jnp.diag)(1.0 - vary_f))
+        step = -jnp.linalg.solve(A, g[..., None])[..., 0] * vary_f
+        smax = 100.0 * (1.0 + jnp.abs(u))
+        return g, jnp.clip(step, -smax, smax)
+
+    @jax.jit
+    def jac_prefix(u, lo, hi, kind, vary_f, aux):
+        r = jax.vmap(rfun_one)(u, lo, hi, kind, aux)
+        J = jax.vmap(jac_one)(u, lo, hi, kind, vary_f, aux)
+        return jnp.sum(r * r, axis=-1) + jnp.sum(J, axis=(1, 2))
+
+    @jax.jit
+    def solve_prefix(u, lo, hi, kind, vary_f, lam, aux):
+        r = jax.vmap(rfun_one)(u, lo, hi, kind, aux)
+        J = jax.vmap(jac_one)(u, lo, hi, kind, vary_f, aux)
+        g, step = _solve_parts(r, J, u, vary_f, lam)
+        return jnp.sum(step, axis=-1)
+
+    @jax.jit
+    def select_piece(u, f, r_try, g, step, lam, vary_f):
+        u_try = u + step
+        f_new = jnp.sum(r_try * r_try, axis=-1)
+        accept = f_new < f
+        rel = (f - f_new) / (jnp.abs(f) + 1e-300)
+        done = jnp.logical_and(jnp.logical_and(accept, rel < 1e-10),
+                               lam <= 1e-3)
+        gnorm = jnp.max(jnp.abs(g * vary_f), axis=-1)
+        done = jnp.logical_or(done, gnorm < 1e-14 * (f + 1.0))
+        u_new = jnp.where(accept[:, None], u_try, u)
+        lam_new = jnp.where(accept, lam * 0.3, lam * 5.0).clip(1e-12,
+                                                               1e12)
+        return (jnp.sum(u_new) + jnp.sum(lam_new)
+                + jnp.sum(done) + jnp.sum(f_new))
+
+    @jax.jit
+    def full_iter(u, lo, hi, kind, vary_f, lam, aux):
+        r = jax.vmap(rfun_one)(u, lo, hi, kind, aux)
+        f = jnp.sum(r * r, axis=-1)
+        J = jax.vmap(jac_one)(u, lo, hi, kind, vary_f, aux)
+        g, step = _solve_parts(r, J, u, vary_f, lam)
+        return select_piece.__wrapped__(u, f, r, g, step, lam, vary_f)
+
+    # precompute the select piece's inputs once (everything before it
+    # is the solve prefix)
+    @jax.jit
+    def precompute(u, lo, hi, kind, vary_f, lam, aux):
+        r = jax.vmap(rfun_one)(u, lo, hi, kind, aux)
+        f = jnp.sum(r * r, axis=-1)
+        J = jax.vmap(jac_one)(u, lo, hi, kind, vary_f, aux)
+        g, step = _solve_parts(r, J, u, vary_f, lam)
+        return f, r, g, step
+
+    f0, r0, g0, step0 = jax.block_until_ready(
+        precompute(u0, lo, hi, kind, vary_f, lam, aux))
+
+    stages = [
+        Stage("resid", lambda: resid_prefix(u0, lo, hi, kind, aux),
+              "prefix"),
+        Stage("jacobian", lambda: jac_prefix(u0, lo, hi, kind, vary_f,
+                                             aux), "prefix"),
+        Stage("solve", lambda: solve_prefix(u0, lo, hi, kind, vary_f,
+                                            lam, aux), "prefix"),
+        Stage("select", lambda: select_piece(u0, f0, r0, g0, step0,
+                                             lam, vary_f), "piece"),
+    ]
+    return profile_stages(
+        lambda: full_iter(u0, lo, hi, kind, vary_f, lam, aux), stages,
+        K=K, nrun=nrun)
+
+
 def stream_stage_profile(files, modelfile, nsub_batch, end_to_end_s,
                          max_iter=25):
     """Attribution of the streaming campaign lane (pipeline/stream,
@@ -503,9 +627,13 @@ def main():
         from benchmarks import bench_stream
 
         out = bench_stream.run_bench(attrib_only=True)
+    elif lane == "gauss":
+        from benchmarks import bench_gauss
+
+        out = bench_gauss.run_bench(attrib_only=True)
     else:
         raise SystemExit(f"unknown lane {lane!r} "
-                         "(scatter|campaign|align|stream)")
+                         "(scatter|campaign|align|stream|gauss)")
     print(json.dumps(out))
 
 

@@ -1,0 +1,330 @@
+"""BASELINE config 6: the template factory (ISSUE 9) — batched vs
+serial Gaussian model building at a synthetic N-pulsar fleet.
+
+Two A/Bs, both printed in ONE JSON line:
+
+1. **The production A/B** (the headline; >= 3x CPU gate at N >= 16):
+   serial arm = the pre-factory workflow, one ``ppgauss`` process per
+   pulsar (the reference CLI takes ONE datafile — a PTA template
+   campaign is N cold processes, each re-paying interpreter + jax
+   import + every per-shape LM trace/compile + one serial LM dispatch
+   per fit); batched arm = ONE ``ppfactory`` process building the
+   whole fleet through the batched engine's power-of-two buckets.
+   Both arms run cold in subprocesses, so the measured ratio is the
+   end-to-end cost an operator actually pays.  On CPU the win is
+   process/compile amortization (this box has ONE core, so lock-step
+   SIMD cannot beat a warm serial loop on raw FLOPs); on TPU the
+   per-fit dispatch amortization dominates — pre-scoped in
+   BENCHMARKS.md.
+
+2. **The oracle A/B + digit gate** (in-process, warm):
+   build_templates with gauss_device=False (host-serial oracle — the
+   SAME padded problems through the single-problem engine one at a
+   time) vs gauss_device=True; the batched lane's .gmodel output must
+   be digit-identical (<= 1e-10) to the oracle's on the full fleet,
+   and the warm speedup is reported honestly (vs_oracle_warm — on a
+   single-core host the lock-step engine pays the Jacobian on rejected
+   steps too, so expect < 1 here; compaction keeps it bounded).
+
+Plus the gauss stage profile (benchmarks/attrib.py: resid / jacobian /
+solve / select of one batched LM iteration) with attributed_frac
+>= 0.9.
+
+Each pulsar is a distinct evolving-Gaussian source (varied component
+locations/widths/amplitudes), written once to a PSRFITS cache
+(PPT_GAUSS_CACHE).  Shapes via PPT_NPSR / PPT_NCHAN / PPT_NBIN /
+PPT_NGAUSS / PPT_NITER.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+DIGIT_GATE = 1e-10
+SPEEDUP_GATE = 3.0
+
+
+def _fleet_model(rng, i, nu_ref=1500.0):
+    """A per-pulsar evolving-Gaussian truth model: NGAUSS components
+    with jittered locations/widths/amplitudes so the fleet's fits are
+    genuinely heterogeneous problems (different selected ngauss,
+    different iteration counts — the straggler regime the shared
+    while_loop must absorb)."""
+    from pulseportraiture_tpu.models.gaussian import GaussianModel
+
+    ng = int(os.environ.get("PPT_NGAUSS", 3))
+    locs = np.sort(0.35 + 0.3 * rng.random(ng))
+    return GaussianModel(
+        name=f"FLEET_{i:04d}", code="000", nu_ref=nu_ref, dc=0.0,
+        tau=0.0, alpha=-4.0,
+        locs=locs,
+        wids=0.01 + 0.03 * rng.random(ng),
+        amps=1.0 + 6.0 * rng.random(ng),
+        mlocs=0.004 * rng.standard_normal(ng),
+        mwids=0.2 * rng.standard_normal(ng),
+        mamps=-1.0 + 0.5 * rng.standard_normal(ng),
+    )
+
+
+def _make_fleet(root, npsr, nchan, nbin):
+    from pulseportraiture_tpu.synth import make_fake_pulsar
+    from pulseportraiture_tpu.utils.mjd import MJD
+
+    os.makedirs(root, exist_ok=True)
+    files = []
+    for i in range(npsr):
+        p = os.path.join(root, f"psr{i:03d}.fits")
+        if not os.path.exists(p):
+            rng = np.random.default_rng(1000 + i)
+            par = {"PSR": f"FLEET_{i:04d}", "P0": 0.003 + 0.002 * i,
+                   "DM": 20.0 + 2.0 * i, "PEPOCH": 56000.0}
+            make_fake_pulsar(_fleet_model(rng, i), par, outfile=p,
+                             nsub=2, nchan=nchan, nbin=nbin,
+                             nu0=1500.0, bw=600.0, tsub=60.0,
+                             start_MJD=MJD(55100 + i, 0.3),
+                             noise_stds=0.05, dedispersed=False,
+                             quiet=True, rng=2000 + i)
+        files.append(p)
+    return files
+
+
+def _gmodel_params(path):
+    from pulseportraiture_tpu.io.gmodel import model_to_flat, read_gmodel
+
+    m = read_gmodel(path, quiet=True)
+    params, _ = model_to_flat(m)
+    return params, float(m.alpha)
+
+
+def _attrib(files, max_ngauss, niter):
+    """Stage-attribute the dominant batched dispatch: ONE portrait
+    bucket built exactly the way the factory builds it (padded
+    channels/components/rows) from the fleet's own profile-stage
+    selections."""
+    import jax.numpy as jnp
+
+    from benchmarks.attrib import gauss_stage_profile
+    from pulseportraiture_tpu.fit.gauss import (
+        _PORTRAIT_RESID_CACHE, _make_portrait_resid, pad_portrait_params,
+        portrait_bounds, portrait_vary)
+    from pulseportraiture_tpu.fit.lm import _bounds_spec
+    from pulseportraiture_tpu.pipeline.factory import _pow2ceil
+    from pulseportraiture_tpu.pipeline.gauss import (
+        GaussPortrait, portrait_fit_flags, profile_to_portrait_params)
+
+    rows = []
+    for f in files:
+        dp = GaussPortrait(f, quiet=True)
+        profile, nu_ref = dp.select_ref_profile()
+        dp.nu_ref = nu_ref
+        dp.auto_fit_profile(profile, max_ngauss=max_ngauss,
+                            gauss_device=True, quiet=True)
+        rows.append((dp, profile_to_portrait_params(dp.init_params)))
+    gclass = _pow2ceil(max(((len(x0) - 2) // 6) for _, x0 in rows))
+    cclass = _pow2ceil(max(len(dp.ok_ichans) for dp, _ in rows))
+    nbin = rows[0][0].nbin
+    B = _pow2ceil(len(rows))
+    nmain = 2 + 6 * gclass
+    data = np.zeros((B, cclass, nbin))
+    errs = np.full((B, cclass), np.inf)
+    freqs = np.zeros((B, cclass))
+    x0s = np.zeros((B, nmain + 1))
+    varys = np.zeros((B, nmain + 1), bool)
+    nu_refs = np.zeros(B)
+    Ps = np.full(B, 0.003)
+    for b, (dp, x0) in enumerate(rows):
+        okc = dp.ok_ichans
+        n_ok = len(okc)
+        data[b, :n_ok] = dp.port[okc]
+        errs[b, :n_ok] = np.where(
+            dp.noise_stds[okc] > 0, dp.noise_stds[okc],
+            np.median(dp.noise_stds[okc]))
+        freqs[b] = dp.freqsxs[0][-1]
+        freqs[b, :n_ok] = dp.freqsxs[0]
+        xp, ng = pad_portrait_params(x0, gclass)
+        x0s[b] = np.concatenate([xp, [-4.0]])
+        flags = portrait_fit_flags(ng)
+        varys[b] = portrait_vary(flags, gclass)
+        nu_refs[b] = dp.nu_ref
+        Ps[b] = float(dp.Ps[0])
+    for b in range(len(rows), B):  # frozen pad rows, as in the factory
+        data[b], errs[b], freqs[b] = data[0], errs[0], freqs[0]
+        x0s[b], nu_refs[b], Ps[b] = x0s[0], nu_refs[0], Ps[0]
+    lower, upper = portrait_bounds(gclass, nbin)
+    lo, hi, kind = _bounds_spec(np.broadcast_to(lower, x0s.shape),
+                                np.broadcast_to(upper, x0s.shape),
+                                x0s.shape, jnp.asarray(x0s).dtype)
+    key = ("000", nbin, 0, nmain)
+    if key not in _PORTRAIT_RESID_CACHE:
+        _PORTRAIT_RESID_CACHE[key] = _make_portrait_resid("000", nbin,
+                                                          0, nmain)
+    resid = _PORTRAIT_RESID_CACHE[key]
+    aux = (jnp.asarray(data), jnp.asarray(errs), jnp.asarray(freqs),
+           jnp.asarray(nu_refs), jnp.asarray(Ps),
+           jnp.zeros((B, 0, cclass), bool))
+    att = gauss_stage_profile(resid, aux, x0s, lo, hi, kind, varys)
+    return att, {"attrib_batch": B, "attrib_bucket":
+                 f"port:{cclass}c:{nbin}b:{gclass}g"}
+
+
+def run_bench(attrib_only=False, with_attrib=True):
+    import jax
+
+    import pulseportraiture_tpu  # noqa: F401
+    from pulseportraiture_tpu import config
+
+    config.env_overrides()  # PPT_* A/B switches win over defaults
+
+    from pulseportraiture_tpu.pipeline.factory import build_templates
+    from pulseportraiture_tpu.pipeline.gauss import GaussPortrait
+
+    NPSR = int(os.environ.get("PPT_NPSR", 16))
+    NCHAN = int(os.environ.get("PPT_NCHAN", 32))
+    NBIN = int(os.environ.get("PPT_NBIN", 512))
+    NITER = int(os.environ.get("PPT_NITER", 1))
+    MAX_NG = int(os.environ.get("PPT_NGAUSS", 3)) + 1
+    cache = os.environ.get("PPT_GAUSS_CACHE", "/tmp/ppt_gauss_fleet")
+    root = os.path.join(cache, f"{NPSR}x{NCHAN}x{NBIN}")
+    files = _make_fleet(root, NPSR, NCHAN, NBIN)
+
+    if attrib_only:
+        att, extra = _attrib(files, MAX_NG, NITER)
+        out = {"metric": "template-factory batched-LM stage "
+                         "attribution", "device": str(jax.devices()[0])}
+        out.update(extra)
+        out.update(att.breakdown_ms())
+        return out
+
+    # ---- production A/B: N ppgauss processes vs one ppfactory -------
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo)
+    meta = os.path.join(root, "fleet.txt")
+    with open(meta, "w") as fh:
+        fh.write("\n".join(files) + "\n")
+    out_p = os.path.join(root, "out_production")
+    out_f = os.path.join(root, "out_factory")
+    os.makedirs(out_p, exist_ok=True)
+
+    def sub(args):
+        t0 = time.perf_counter()
+        subprocess.run([sys.executable, "-m"] + args, check=True,
+                       env=env, cwd=repo,
+                       stdout=subprocess.DEVNULL,
+                       stderr=subprocess.DEVNULL)
+        return time.perf_counter() - t0
+
+    t_production = 0.0
+    for f in files:
+        t_production += sub(
+            ["pulseportraiture_tpu.cli.ppgauss", "-d", f,
+             "--niter", str(NITER), "--max-ngauss", str(MAX_NG),
+             "-o", os.path.join(out_p,
+                                os.path.basename(f) + ".gmodel")])
+    t_batched = sub(
+        ["pulseportraiture_tpu.cli.ppfactory", "-M", meta,
+         "-O", out_f, "--niter", str(NITER),
+         "--max-ngauss", str(MAX_NG), "--gauss-device", "on"])
+
+    # ---- oracle A/B + digit gate (in-process, warm) -----------------
+    def fresh_jobs():
+        # reload per run: build_templates rotates the portraits
+        # in place, so each timed run must start from disk state
+        return [(GaussPortrait(f, quiet=True), f) for f in files]
+
+    def run(lane, outdir):
+        jobs = fresh_jobs()
+        t0 = time.perf_counter()
+        # fixloc=True: the CLI arms above run the reference ppgauss
+        # flag defaults; the in-process arms must fit the same flags
+        res = build_templates(jobs, outdir=outdir, max_ngauss=MAX_NG,
+                              niter=NITER, fixloc=True,
+                              gauss_device=lane, quiet=True)
+        return time.perf_counter() - t0, res
+
+    out_s = os.path.join(root, "out_serial")
+    out_b = os.path.join(root, "out_batched")
+    # two reps per arm: first pays trace+compile, min is the warm cost
+    runs_s = [run(False, out_s) for _ in range(2)]
+    runs_b = [run(True, out_b) for _ in range(2)]
+    t_oracle_w = min(t for t, _ in runs_s)
+    t_batched_w = min(t for t, _ in runs_b)
+    res_s, res_b = runs_s[-1][1], runs_b[-1][1]
+
+    # digit gate on the IN-MEMORY parameters (the .gmodel text grammar
+    # rounds to 8 decimals, which would hide 1e-10-scale drift); the
+    # production (unpadded, per-pulsar CLI) outputs are compared from
+    # their files as a loose cross-check of the whole refactor
+    from pulseportraiture_tpu.io.gmodel import model_to_flat
+
+    max_delta = 0.0
+    max_delta_prod = 0.0
+    n_select_mismatch = 0
+    for f, rs, rb in zip(files, res_s, res_b):
+        ps = model_to_flat(rs.model)[0]
+        pb = model_to_flat(rb.model)[0]
+        if len(ps) != len(pb):
+            # a lane-dependent component-count selection is a digit
+            # failure outright (only possible when no trial converged
+            # — see fit/gauss.select_best_trial)
+            max_delta = max(max_delta, np.inf)
+            continue
+        max_delta = max(max_delta, float(np.max(np.abs(ps - pb))),
+                        abs(rs.model.alpha - rb.model.alpha))
+        base = os.path.basename(f)
+        pf, al_f = _gmodel_params(os.path.join(out_f, base + ".gmodel"))
+        pp, al_p = _gmodel_params(os.path.join(out_p, base + ".gmodel"))
+        if len(pp) != len(pf):
+            n_select_mismatch += 1
+            continue
+        max_delta_prod = max(max_delta_prod,
+                             float(np.max(np.abs(pp - pf))),
+                             abs(al_p - al_f))
+
+    speedup = t_production / t_batched
+    out = {
+        "metric": f"template factory (one ppfactory process) vs "
+                  f"production serial (one ppgauss process per "
+                  f"pulsar), {NPSR} pulsars x {NCHAN}ch x {NBIN}bin "
+                  f"(trials 1..{MAX_NG}, niter {NITER}, cold)",
+        "value": round(NPSR / t_batched, 3),
+        "unit": "templates/sec",
+        "production_templates_per_sec": round(NPSR / t_production, 3),
+        "batched_wall_s": round(t_batched, 3),
+        "production_wall_s": round(t_production, 3),
+        "ab_speedup_vs_serial": round(speedup, 2),
+        "speedup_gate_3x": bool(speedup >= SPEEDUP_GATE),
+        "oracle_warm_wall_s": round(t_oracle_w, 3),
+        "batched_warm_wall_s": round(t_batched_w, 3),
+        "ab_speedup_vs_oracle_warm": round(t_oracle_w / t_batched_w, 2),
+        "gmodel_max_delta": float(f"{max_delta:.3g}"),
+        "digit_gate": DIGIT_GATE,
+        "digit_ok": bool(max_delta <= DIGIT_GATE),
+        "gmodel_max_delta_vs_production": float(
+            f"{max_delta_prod:.3g}"),
+        "n_production_select_mismatch": n_select_mismatch,
+        "npsr": NPSR,
+        "single_core_host": os.cpu_count() == 1,
+        "device": str(jax.devices()[0]),
+    }
+    if with_attrib:
+        att, extra = _attrib(files, MAX_NG, NITER)
+        out.update(extra)
+        out.update(att.breakdown_ms())
+        out["attrib_ok"] = bool(att.check(0.9))
+        out["dominant_stage"] = max(att.stages,
+                                    key=lambda s: s.cost_s).name
+    return out
+
+
+def main():
+    print(json.dumps(run_bench()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""ppfactory — build templates for a whole fleet of pulsars, batching
+the Gaussian/spline LM fits across archives (pipeline/factory.py,
+ISSUE 9).  One archive per line in the metafile, one template out per
+archive (this is NOT ppgauss's JOIN metafile mode — multi-receiver
+fits keep ppgauss).
+"""
+
+import argparse
+import os
+import sys
+
+GAUSS_DEVICE_CHOICES = ("off", "auto", "on")
+_GAUSS_DEVICE_TABLE = {"off": False, "auto": "auto", "on": True}
+
+
+def parse_gauss_device(value, error=None):
+    """Strict --gauss-device parse shared by ppfactory/ppgauss/
+    ppspline: 'off' | 'auto' | 'on' -> the config tri-state value;
+    anything else dies loudly BEFORE any file IO (SystemExit carries
+    the message, the ppserve convention)."""
+    v = str(value).lower()
+    if v not in _GAUSS_DEVICE_TABLE:
+        raise SystemExit(f"--gauss-device expected one of "
+                         f"{'/'.join(GAUSS_DEVICE_CHOICES)}, got "
+                         f"{value!r}")
+    return _GAUSS_DEVICE_TABLE[v]
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="ppfactory", description=__doc__.splitlines()[0])
+    p.add_argument("-M", "--metafile", required=True,
+                   help="Metafile: one archive per line, one template "
+                        "per archive.")
+    p.add_argument("-O", "--outdir", default=None,
+                   help="Directory for the output model files "
+                        "[default: next to each archive].")
+    p.add_argument("--kind", default="gauss",
+                   choices=("gauss", "spline"),
+                   help="Template type for every job.")
+    p.add_argument("--max-ngauss", type=int, default=8,
+                   help="Trial component counts 1..N fit per pulsar "
+                        "in one breadth-first dispatch.")
+    p.add_argument("--niter", type=int, default=0,
+                   help="Portrait iterations after the initial fit.")
+    p.add_argument("--mcode", dest="model_code", default="000",
+                   help="Three-digit evolution-function code.")
+    p.add_argument("--fitloc", dest="fixloc", action="store_false",
+                   default=True, help="Let component positions evolve.")
+    p.add_argument("--fixwid", action="store_true", default=False)
+    p.add_argument("--fixamp", action="store_true", default=False)
+    p.add_argument("--fitscat", dest="fixscat", action="store_false",
+                   default=True, help="Fit a scattering timescale.")
+    p.add_argument("--fitalpha", dest="fixalpha", action="store_false",
+                   default=True, help="Fit the scattering index.")
+    p.add_argument("--norm", dest="normalize", default=None,
+                   choices=(None, "mean", "max", "prof", "rms", "abs"))
+    p.add_argument("--gauss-device", default=None,
+                   help="LM lane: 'off' (host-serial oracle), 'auto' "
+                        "(batched on TPU), 'on' (force batched) "
+                        "[default: config.gauss_device].")
+    p.add_argument("--telemetry", default=None,
+                   help="Write a JSONL event trace (template_fit "
+                        "events; analyze with tools/pptrace.py).")
+    p.add_argument("--verbose", dest="quiet", action="store_false",
+                   default=True)
+    return p
+
+
+def main(argv=None):
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    gauss_device = None
+    if args.gauss_device is not None:
+        gauss_device = parse_gauss_device(args.gauss_device)
+    if args.max_ngauss < 1:
+        raise SystemExit(f"--max-ngauss must be >= 1, got "
+                         f"{args.max_ngauss}")
+    if args.niter < 0:
+        raise SystemExit(f"--niter must be >= 0, got {args.niter}")
+    if not os.path.exists(args.metafile):
+        raise SystemExit(f"ppfactory: metafile not found: "
+                         f"{args.metafile}")
+    from ..pipeline.toas import _read_metafile
+
+    files = _read_metafile(args.metafile)
+    if not files:
+        raise SystemExit(f"ppfactory: no archives listed in "
+                         f"{args.metafile}")
+    from ..pipeline.factory import build_templates
+
+    build_templates(
+        files, kind=args.kind, outdir=args.outdir,
+        max_ngauss=args.max_ngauss, niter=args.niter,
+        model_code=args.model_code, fixloc=args.fixloc,
+        fixwid=args.fixwid, fixamp=args.fixamp, fixscat=args.fixscat,
+        fixalpha=args.fixalpha, normalize=args.normalize,
+        gauss_device=gauss_device, telemetry=args.telemetry,
+        quiet=args.quiet)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

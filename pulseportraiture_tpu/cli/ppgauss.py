@@ -53,15 +53,71 @@ def build_parser():
                    choices=(None, "mean", "max", "prof", "rms", "abs"))
     p.add_argument("--figure", default=False,
                    help="Save a residual plot to this file name.")
+    p.add_argument("--batch", action="store_true", default=False,
+                   help="Fleet mode: treat -M as one archive per line, "
+                        "one template PER ARCHIVE, fits batched across "
+                        "the fleet (pipeline/factory.build_templates; "
+                        "this is not the JOIN metafile mode).")
+    p.add_argument("--max-ngauss", dest="max_ngauss", type=int,
+                   default=8,
+                   help="Trial component counts 1..N for the "
+                        "breadth-first auto profile fit.")
+    p.add_argument("--gauss-device", default=None,
+                   help="LM lane: 'off' (host-serial oracle), 'auto' "
+                        "(batched on TPU), 'on' (force batched) "
+                        "[default: config.gauss_device].")
     p.add_argument("--verbose", dest="quiet", action="store_false",
                    default=True)
     return p
 
 
 def main(argv=None):
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
     if not args.datafile and not args.metafile:
-        build_parser().error("need -d datafile or -M metafile")
+        parser.error("need -d datafile or -M metafile")
+    from .ppfactory import parse_gauss_device
+
+    gauss_device = None
+    if args.gauss_device is not None:
+        gauss_device = parse_gauss_device(args.gauss_device)
+    if args.max_ngauss < 1:
+        raise SystemExit(f"--max-ngauss must be >= 1, got "
+                         f"{args.max_ngauss}")
+    if args.batch:
+        if not args.metafile:
+            raise SystemExit("--batch requires -M metafile (one "
+                             "archive per line)")
+        # options the fleet factory does not take must fail LOUDLY,
+        # not be silently dropped (each model is named per archive;
+        # JOIN/improve/reference-slice modes keep the single driver)
+        for flag, val in (("-I/--improve", args.modelfile),
+                          ("-o/--outfile", args.outfile),
+                          ("-e/--errfile", args.errfile),
+                          ("-j/--joinfile", args.joinfile),
+                          ("-m/--model_name", args.model_name),
+                          ("--nu_ref", args.nu_ref),
+                          ("--bw", args.bw_ref),
+                          ("--figure", args.figure or None)):
+            if val is not None:
+                raise SystemExit(
+                    f"{flag} is not supported with --batch (models "
+                    "are named per archive; use ppfactory -O for an "
+                    "output directory, or the single-archive driver)")
+        from ..pipeline.factory import build_templates
+        from ..pipeline.toas import _read_metafile
+
+        files = _read_metafile(args.metafile)
+        build_templates(
+            files, kind="gauss", max_ngauss=args.max_ngauss,
+            wid0=args.auto_gauss or 0.02,
+            tau=args.tau, fixloc=args.fixloc, fixwid=args.fixwid,
+            fixamp=args.fixamp, fixscat=args.fixscat,
+            fixalpha=args.fixalpha, model_code=args.model_code,
+            niter=args.niter, fiducial_gaussian=args.fgauss,
+            normalize=args.normalize, gauss_device=gauss_device,
+            quiet=args.quiet)
+        return 0
     from ..pipeline.gauss import GaussPortrait
 
     dp = GaussPortrait(args.metafile or args.datafile,
@@ -78,7 +134,8 @@ def main(argv=None):
         fiducial_gaussian=args.fgauss, auto_gauss=args.auto_gauss,
         writemodel=True, outfile=outfile, writeerrfile=bool(args.errfile),
         errfile=args.errfile, model_name=args.model_name,
-        residplot=args.figure or None, quiet=args.quiet)
+        residplot=args.figure or None, gauss_device=gauss_device,
+        max_ngauss=args.max_ngauss, quiet=args.quiet)
     return 0
 
 

@@ -33,22 +33,49 @@ def build_parser():
     p.add_argument("--plots", dest="make_plots", action="store_true",
                    default=False,
                    help="Save eigenprofile and spline-projection plots.")
+    p.add_argument("--gauss-device", default=None,
+                   help="With -s/--smooth: smooth the MEAN profile by "
+                        "a Gaussian-component LM fit (the template "
+                        "factory's lane) instead of wavelets, on the "
+                        "'off' (host-serial) | 'auto' | 'on' (batched) "
+                        "engine; eigenprofiles keep wavelet smoothing. "
+                        "[default: wavelet mean smoothing]")
     p.add_argument("--quiet", action="store_true", default=False)
     return p
 
 
 def main(argv=None):
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    gauss_device = None
+    if args.gauss_device is not None:
+        from .ppfactory import parse_gauss_device
+
+        gauss_device = parse_gauss_device(args.gauss_device)
+        if not args.smooth:
+            # fail LOUDLY rather than silently running no smoothing at
+            # all — the flag selects the MEAN-smoothing lane, which
+            # only exists under -s/--smooth
+            raise SystemExit("--gauss-device requires -s/--smooth "
+                             "(it selects the lane that smooths the "
+                             "mean profile)")
     from ..pipeline.spline import SplinePortrait
 
     dp = SplinePortrait(args.datafile, quiet=args.quiet)
     if args.norm and args.norm != "None":
         dp.normalize_portrait(args.norm)
+    smooth_mean = None
+    if args.gauss_device is not None and args.smooth:
+        from ..pipeline.factory import gauss_smooth_mean
+
+        smooth_mean = gauss_smooth_mean(dp, rchi2_tol=args.rchi2_tol,
+                                        gauss_device=gauss_device)
     dp.make_spline_model(
         max_ncomp=args.max_ncomp, smooth=args.smooth,
         snr_cutoff=args.snr_cutoff, rchi2_tol=args.rchi2_tol, k=args.k,
         sfac=args.sfac, max_nbreak=args.max_nbreak,
-        model_name=args.model_name, quiet=args.quiet)
+        model_name=args.model_name, smooth_mean_prof=smooth_mean,
+        quiet=args.quiet)
     outfile = args.modelfile or (args.datafile + ".spl")
     dp.write_model(outfile, quiet=args.quiet)
     if args.archive:

@@ -55,6 +55,18 @@ use_fast_fit = "auto"
 # device-resident.
 align_device = "auto"
 
+# Route template building's Gaussian LM fits (breadth-first
+# auto_fit_profile trials and the template factory's fleet buckets,
+# pipeline/factory.build_templates) through the BATCHED engine
+# (fit/lm.levenberg_marquardt_batched): one vmapped dispatch fits a
+# whole padded bucket of (pulsar, ngauss-trial) problems instead of one
+# serial LM dispatch per fit.  'auto' = on TPU backends (where the
+# serial per-problem loop idles the chip between tiny dispatches);
+# True/False force.  The host-serial lane — the SAME padded problems
+# through the single-problem engine one at a time — is retained as the
+# digit-exactness oracle (bench_gauss gates .gmodel identity <= 1e-10).
+gauss_device = "auto"
+
 # Matmul-DFT precision (ops/fourier.py) on accelerators:
 # 'highest' = 6-pass bf16 (f32-exact to ~1e-7), 'high' = 3-pass
 # (~1e-6 relative, ~20% faster end-to-end at bench shapes), 'default' =
@@ -277,6 +289,7 @@ RCSTRINGS = {
 #   PPT_DFT_PRECISION=highest|high|default -> dft_precision
 #   PPT_DFT_FOLD=off|auto|on        -> dft_fold
 #   PPT_ALIGN_DEVICE=off|auto|on    -> align_device
+#   PPT_GAUSS_DEVICE=off|auto|on    -> gauss_device
 #   PPT_STREAM_DEVICES=auto|<N>     -> stream_devices
 #   PPT_MAX_INFLIGHT=<N>            -> stream_max_inflight
 #   PPT_PIPELINE_DEPTH=<N>          -> stream_pipeline_depth
@@ -301,13 +314,15 @@ RCSTRINGS = {
 KNOWN_PPT_ENV = frozenset({
     # config hooks (this module)
     "PPT_XSPEC", "PPT_DFT_PRECISION", "PPT_DFT_FOLD",
-    "PPT_ALIGN_DEVICE", "PPT_STREAM_DEVICES", "PPT_MAX_INFLIGHT",
+    "PPT_ALIGN_DEVICE", "PPT_GAUSS_DEVICE",
+    "PPT_STREAM_DEVICES", "PPT_MAX_INFLIGHT",
     "PPT_PIPELINE_DEPTH", "PPT_COMPILE_CACHE", "PPT_TELEMETRY",
     "PPT_SERVE_MAX_WAIT_MS", "PPT_SERVE_QUEUE_DEPTH", "PPT_BUCKET_PAD",
     # benchmark / smoke-test shape and mode knobs
     "PPT_NB", "PPT_NE", "PPT_NPSR", "PPT_NARCH", "PPT_NSUB",
     "PPT_NSUBB", "PPT_NCHAN", "PPT_NBIN", "PPT_NITER", "PPT_K",
     "PPT_NREQ", "PPT_DEVICES", "PPT_CAMPAIGN_CACHE", "PPT_ALIGN_CACHE",
+    "PPT_GAUSS_CACHE", "PPT_NGAUSS",
     "PPT_TEMPLATE_NOISE", "PPT_STREAM_SPEEDUP_GATE",
     "PPT_HARMONIC_WINDOW", "PPT_TUNNEL_EMU",
 })
@@ -381,6 +396,16 @@ def env_overrides():
                 f"{adev!r}")
         cfg.align_device = table[adev]
         changed.append("align_device")
+    gdev = _os.environ.get("PPT_GAUSS_DEVICE", "").lower()
+    if gdev:
+        table = {"off": False, "false": False, "auto": "auto",
+                 "on": True, "true": True}
+        if gdev not in table:
+            raise ValueError(
+                f"PPT_GAUSS_DEVICE must be 'off', 'auto' or 'on', got "
+                f"{gdev!r}")
+        cfg.gauss_device = table[gdev]
+        changed.append("gauss_device")
     sdev = _os.environ.get("PPT_STREAM_DEVICES", "").lower()
     if sdev:
         if sdev == "auto":

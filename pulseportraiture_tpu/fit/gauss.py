@@ -27,10 +27,39 @@ from ..ops.gaussian import gaussian_profile_FT
 from ..ops.phasor import cexp
 from ..ops.scattering import scattering_profile_FT
 from ..utils.bunch import DataBunch
-from .lm import levenberg_marquardt
+from .lm import levenberg_marquardt, levenberg_marquardt_batched
 
 __all__ = ["fit_gaussian_profile", "fit_gaussian_portrait",
-           "gen_gaussian_profile_flat", "gen_gaussian_portrait_flat"]
+           "gen_gaussian_profile_flat", "gen_gaussian_portrait_flat",
+           "use_gauss_device", "profile_trial_seeds", "select_best_trial",
+           "fit_profile_trials",
+           "pad_profile_params", "profile_bounds", "profile_vary",
+           "fit_gaussian_profiles_batched", "pad_portrait_params",
+           "portrait_bounds", "portrait_vary",
+           "fit_gaussian_portraits_batched"]
+
+
+def use_gauss_device(setting=None):
+    """Whether template building should run its Gaussian LM fits
+    through the BATCHED engine (fit/lm.levenberg_marquardt_batched):
+    config.gauss_device (True/False force; 'auto' = TPU backends, where
+    serial per-problem dispatches idle the chip).  Read per call so
+    in-process A/B flips take effect.  setting: an explicit per-call
+    override (build_templates' gauss_device= argument / the CLIs'
+    --gauss-device); None -> config."""
+    if setting is None:
+        from .. import config
+
+        setting = getattr(config, "gauss_device", "auto")
+    if setting is True or setting is False:
+        return setting
+    if setting != "auto":
+        # strict like config's other tri-state knobs — a typo must not
+        # silently mean 'auto'
+        raise ValueError(
+            f"gauss_device must be True, False, or 'auto'; got "
+            f"{setting!r}")
+    return jax.default_backend() == "tpu"
 
 
 def _profile_FT_flat(theta, nbin):
@@ -264,3 +293,334 @@ def fit_gaussian_portrait(data, init_params, scattering_index, errs,
         print(f"Gaussian portrait fit: ngauss={(nmain - 2) // 6} "
               f"DoF={dof} reduced chi-sq: {out.red_chi2:.2f}")
     return out
+
+
+def _serial_lm(resid_fn, aux_of, x0s, lower, upper, varys, max_iter,
+               nres_valid=None):
+    """The host-serial oracle lane shared by both batched front-ends:
+    the SAME padded problems through the single-problem engine one at a
+    time, results stacked into an LMResult with a leading B axis (host
+    numpy)."""
+    from .lm import LMResult
+
+    outs = [levenberg_marquardt(
+        resid_fn, x0s[b], aux=aux_of(b), lower=lower, upper=upper,
+        vary=varys[b], max_iter=max_iter,
+        nres_valid=(None if nres_valid is None else int(nres_valid[b])))
+        for b in range(len(x0s))]
+    return LMResult(*[np.stack([np.asarray(getattr(o, f))
+                                for o in outs])
+                      for f in LMResult._fields])
+
+
+# --------------------------------------------------------------------------
+# Breadth-first trial seeding + batched fleet dispatch (ISSUE 9)
+#
+# The template factory (pipeline/factory.py) and the breadth-first
+# auto_fit_profile fit MANY flat-layout problems per LM dispatch.  The
+# helpers here build the trial problems (matching-pursuit seeds, padded
+# parameter layouts, shared bounds/vary masks) and run them either
+# batched (one vmapped dispatch — the device lane) or serially through
+# the single-problem engine on the SAME padded problems (the host
+# oracle), so the two lanes are digit peers by construction.
+# --------------------------------------------------------------------------
+
+
+def profile_trial_seeds(profile, max_ngauss, wid0=0.02, tau=0.0,
+                        noise=None):
+    """Matching-pursuit seeds for the breadth-first multi-component
+    auto fit: greedily place a component of width wid0 at the running
+    residual peak and subtract its ANALYTIC profile (no intermediate
+    fits — that serialization is exactly what breadth-first removes).
+    Returns [trial_1, ..., trial_max_ngauss] where trial_g is the flat
+    profile layout [0, tau, (loc, wid, amp) * g] (numpy, host math)."""
+    profile = np.asarray(profile, float)
+    nbin = len(profile)
+    if noise is None:
+        noise = float(profile.std())
+    grid = np.arange(nbin) / nbin
+    resid = profile.copy()
+    comps = []
+    seeds = []
+    for _ in range(int(max_ngauss)):
+        ipeak = int(np.argmax(resid))
+        loc = (ipeak + 0.5) / nbin
+        amp = max(float(resid[ipeak]), float(noise))
+        comps.append((loc, wid0, amp))
+        d = np.mod(grid - loc + 0.5, 1.0) - 0.5
+        resid = resid - amp * np.exp(-4.0 * np.log(2.0)
+                                     * (d / wid0) ** 2.0)
+        seeds.append(np.concatenate([[0.0, tau],
+                                     np.ravel(comps)]))
+    return seeds
+
+
+def select_best_trial(red_chi2s, rchi2_tol=0.1, success=None,
+                      stalled=None):
+    """Host-side selection over ascending-ngauss trial results,
+    mirroring the serial add-refit loop's acceptance rule: a trial must
+    improve the best reduced chi2 to be kept; scanning stops early once
+    within rchi2_tol of 1 (good enough) or when adding a component
+    stopped helping.  Returns the selected index, or None when every
+    trial failed (non-finite chi2).
+
+    Lane reproducibility: a CONVERGED trial's chi2 is digit-stable
+    (~1e-15) between the batched and serial engines, so converged
+    trials use the reference 1% improvement margin.  A trial that
+    burned max_iter — or stopped on the STALL exit — sits in a flat,
+    ill-conditioned valley whose stop point (and hence chi2, at up to
+    the ~1% scale) is NOT digit-reproducible across program variants;
+    such trials still compete (a well-fitting unconverged trial must
+    beat a converged underfit — high-S/N blended profiles routinely
+    cap out while fitting well), but must improve by >5%, so a
+    lane-dependent chi2 wobble cannot flip the selected component
+    count.  ``success``/``stalled``: per-trial flags from the engine
+    (None = treat every trial as converged, the reference rule)."""
+    reds = np.asarray(red_chi2s, float)
+    n = len(reds)
+    conv = np.ones(n, bool)
+    if success is not None:
+        conv &= np.asarray(success, bool)
+    if stalled is not None:
+        conv &= ~np.asarray(stalled, bool)
+    best = None
+    for i, red in enumerate(reds):
+        if not np.isfinite(red):
+            continue
+        margin = 0.99 if conv[i] else 0.95
+        if best is None or red < reds[best] * margin:
+            best = i
+            if red < 1.0 + rchi2_tol:
+                break
+        else:  # adding components stopped helping
+            break
+    return best
+
+
+def fit_profile_trials(profile, max_ngauss, noise, wid0=0.02, tau=0.0,
+                       fit_scattering=False, rchi2_tol=0.1,
+                       max_iter=100, serial=True):
+    """The breadth-first trial pipeline shared by
+    GaussPortrait.auto_fit_profile and the factory's gauss_smooth_mean:
+    matching-pursuit seeds for every ngauss in 1..max_ngauss, padded to
+    a common max_ngauss width, fit in ONE dispatch (serial=False) or
+    through the single-problem oracle loop (serial=True), selected on
+    host.  Returns DataBunch(index, ngauss, params, param_errs,
+    red_chi2s) with params/param_errs trimmed to the selected
+    component count, or None when every trial failed (non-finite chi2).
+    (The fleet driver keeps its own bucketed version of this flow — it
+    fuses trials ACROSS pulsars; the math is this, per bucket.)"""
+    profile = np.asarray(profile, float)
+    max_ngauss = int(max_ngauss)
+    if max_ngauss < 1:
+        raise ValueError(
+            f"fit_profile_trials needs max_ngauss >= 1 (got "
+            f"{max_ngauss}): no trial component counts to fit")
+    seeds = profile_trial_seeds(profile, max_ngauss, wid0=wid0,
+                                tau=tau, noise=noise)
+    x0s, varys = [], []
+    for s in seeds:
+        padded, g = pad_profile_params(s, max_ngauss)
+        x0s.append(padded)
+        varys.append(profile_vary(g, max_ngauss,
+                                  fit_scattering=fit_scattering))
+    res = fit_gaussian_profiles_batched(
+        np.broadcast_to(profile, (max_ngauss, len(profile))),
+        np.stack(x0s), np.full(max_ngauss, float(noise)),
+        np.stack(varys), max_iter=max_iter, serial=serial)
+    red = np.asarray(res.chi2, float) / np.maximum(
+        np.asarray(res.dof, float), 1.0)
+    ibest = select_best_trial(red, rchi2_tol=rchi2_tol,
+                              success=np.asarray(res.success),
+                              stalled=np.asarray(res.stalled))
+    if ibest is None:
+        return None
+    nsel = 2 + 3 * (ibest + 1)
+    return DataBunch(
+        index=ibest, ngauss=ibest + 1,
+        params=np.asarray(res.x)[ibest][:nsel].copy(),
+        param_errs=np.asarray(res.x_err)[ibest][:nsel].copy(),
+        red_chi2s=red)
+
+
+def pad_profile_params(params, ngauss_pad):
+    """Pad a flat profile layout [dc, tau, (loc, wid, amp)*g] to
+    ngauss_pad components.  Pad components get amp=0 (contributes
+    EXACTLY nothing to the model — gaussian_profile_FT scales by amp)
+    and are frozen by profile_vary, so the padded fit is digit-
+    identical to the unpadded one.  Returns (padded_params, ngauss)."""
+    params = np.asarray(params, float)
+    ngauss = (len(params) - 2) // 3
+    if ngauss > ngauss_pad:
+        raise ValueError(f"cannot pad {ngauss} components into "
+                         f"{ngauss_pad}")
+    out = np.zeros(2 + 3 * ngauss_pad)
+    out[:len(params)] = params
+    for ig in range(ngauss, ngauss_pad):
+        out[2 + 3 * ig: 5 + 3 * ig] = [0.5, 0.02, 0.0]
+    return out, ngauss
+
+
+def profile_bounds(ngauss_pad, nbin):
+    """(lower, upper) for the padded profile layout — the same bounds
+    fit_gaussian_profile applies (tau >= 0, half-bin <= wid <= wid_max,
+    amp >= 0)."""
+    n = 2 + 3 * ngauss_pad
+    lower = np.full(n, -np.inf)
+    upper = np.full(n, np.inf)
+    lower[1] = 0.0
+    lower[3::3] = 0.5 / nbin
+    upper[3::3] = wid_max
+    lower[4::3] = 0.0
+    return lower, upper
+
+
+def profile_vary(ngauss, ngauss_pad, fit_flags=None,
+                 fit_scattering=False):
+    """vary mask for a padded profile problem: pad components frozen;
+    fit_flags covers the non-scattering params of the REAL components
+    (dc + 3*ngauss, the fit_gaussian_profile convention)."""
+    n = 2 + 3 * ngauss_pad
+    vary = np.zeros(n, bool)
+    vary[0] = True
+    vary[1] = bool(fit_scattering)
+    vary[2:2 + 3 * ngauss] = True
+    if fit_flags is not None:
+        ff = [bool(f) for f in fit_flags]
+        vary[0] = ff[0]
+        vary[2:2 + 3 * ngauss] = ff[1:1 + 3 * ngauss]
+    return vary
+
+
+def fit_gaussian_profiles_batched(data, x0s, errs, varys, nbin=None,
+                                  max_iter=100, serial=False,
+                                  compact_every=16):
+    """Fit B padded profile problems.  data (B, nbin); x0s (B, n) padded
+    flat layouts; errs (B,) or (B, nbin); varys (B, n).
+
+    serial=False: ONE batched LM dispatch (the device lane), chunked
+    with straggler compaction every ``compact_every`` iterations (an
+    underfit trial burning max_iter must not cost a full-width
+    lock-step loop; trajectories are identical either way).
+    serial=True: the same problems through the single-problem engine
+    one at a time (the host oracle — digit peer of the batched lane).
+    Returns an LMResult with leading B axis (host numpy in serial
+    mode)."""
+    data = np.asarray(data, float)
+    B, nbin_d = data.shape
+    nbin = nbin_d if nbin is None else nbin
+    x0s = np.asarray(x0s, float)
+    ngauss_pad = (x0s.shape[1] - 2) // 3
+    lower, upper = profile_bounds(ngauss_pad, nbin)
+    errs = np.asarray(errs, float)
+    if errs.ndim == 1:
+        errs = np.broadcast_to(errs[:, None], data.shape)
+    if serial:
+        return _serial_lm(_profile_resid,
+                          lambda b: (jnp.asarray(data[b]),
+                                     jnp.asarray(errs[b])),
+                          x0s, lower, upper, varys, max_iter)
+    return levenberg_marquardt_batched(
+        _profile_resid, x0s, aux=(data, errs), lower=lower, upper=upper,
+        vary=np.asarray(varys), max_iter=max_iter,
+        # min_rows=1: template stragglers (underfit trials) routinely
+        # run alone for many chunks, and the narrow-width run programs
+        # compile once per process — measured a net win over the
+        # engine's recompile-bounding default of 4 (BENCHMARKS r12)
+        compact_every=compact_every, compact_min_rows=1)
+
+
+def pad_portrait_params(params, ngauss_pad):
+    """Pad a flat portrait layout [dc, tau, (loc, mloc, wid, mwid, amp,
+    mamp)*g] to ngauss_pad frozen zero-amplitude components.  Returns
+    (padded_params, ngauss)."""
+    params = np.asarray(params, float)
+    ngauss = (len(params) - 2) // 6
+    if ngauss > ngauss_pad:
+        raise ValueError(f"cannot pad {ngauss} components into "
+                         f"{ngauss_pad}")
+    out = np.zeros(2 + 6 * ngauss_pad)
+    out[:len(params)] = params
+    for ig in range(ngauss, ngauss_pad):
+        out[2 + 6 * ig: 8 + 6 * ig] = [0.5, 0.0, 0.02, 0.0, 0.0, 0.0]
+    return out, ngauss
+
+
+def portrait_bounds(ngauss_pad, nbin):
+    """(lower, upper) over the concatenated [theta, alpha_s] vector of
+    a padded joinless portrait problem (the fit_gaussian_portrait
+    bounds; alpha free)."""
+    nmain = 2 + 6 * ngauss_pad
+    n = nmain + 1
+    lower = np.full(n, -np.inf)
+    upper = np.full(n, np.inf)
+    lower[1] = 0.0
+    lower[4:nmain:6] = 0.5 / nbin
+    upper[4:nmain:6] = wid_max
+    lower[6:nmain:6] = 0.0
+    return lower, upper
+
+
+def portrait_vary(fit_flags, ngauss_pad, fit_scattering_index=False):
+    """vary mask over [theta_padded, alpha_s]: the portrait-layout
+    fit_flags (2 + 6*ngauss entries) for the real components, pad
+    components frozen."""
+    fit_flags = np.asarray(fit_flags, bool)
+    nmain = 2 + 6 * ngauss_pad
+    vary = np.zeros(nmain + 1, bool)
+    vary[:len(fit_flags)] = fit_flags
+    vary[-1] = bool(fit_scattering_index)
+    return vary
+
+
+def fit_gaussian_portraits_batched(data, x0s, errs, varys, freqs,
+                                   nu_refs, Ps, model_code="000",
+                                   nchan_valid=None, max_iter=200,
+                                   serial=False, compact_every=16):
+    """Fit B padded joinless portrait problems (the template factory's
+    bucket dispatch).
+
+    data (B, nchan, nbin): portraits with pad channels zero; errs
+    (B, nchan) with pad channels +inf (an infinite error makes the
+    padded residual row and its Jacobian EXACTLY zero, IEEE finite/inf);
+    x0s (B, nmain+1) concatenated [theta_padded, alpha_s]; varys
+    (B, nmain+1); freqs (B, nchan) with pad channels edge-replicated;
+    nchan_valid (B,) true channel counts (restores dof under padding).
+    serial=True runs the same problems through the single-problem
+    engine (the host oracle)."""
+    data = np.asarray(data, float)
+    B, nchan, nbin = data.shape
+    x0s = np.asarray(x0s, float)
+    nmain = x0s.shape[1] - 1
+    ngauss_pad = (nmain - 2) // 6
+    lower, upper = portrait_bounds(ngauss_pad, nbin)
+    errs = np.asarray(errs, float)
+    freqs = np.asarray(freqs, float)
+    nu_refs = np.broadcast_to(np.asarray(nu_refs, float), (B,))
+    Ps = np.broadcast_to(np.asarray(Ps, float), (B,))
+    if nchan_valid is None:
+        nres_valid = None
+    else:
+        nres_valid = np.asarray(nchan_valid, int) * nbin
+    key = (model_code, nbin, 0, nmain)
+    if key not in _PORTRAIT_RESID_CACHE:
+        _PORTRAIT_RESID_CACHE[key] = _make_portrait_resid(
+            model_code, nbin, 0, nmain)
+    resid = _PORTRAIT_RESID_CACHE[key]
+    join_mask = np.zeros((B, 0, nchan), bool)
+    if serial:
+        return _serial_lm(resid,
+                          lambda b: (jnp.asarray(data[b]),
+                                     jnp.asarray(errs[b]),
+                                     jnp.asarray(freqs[b]),
+                                     jnp.asarray(nu_refs[b]),
+                                     jnp.asarray(Ps[b]),
+                                     jnp.asarray(join_mask[b])),
+                          x0s, lower, upper, varys, max_iter,
+                          nres_valid=nres_valid)
+    return levenberg_marquardt_batched(
+        resid, x0s, aux=(data, errs, freqs, nu_refs, Ps, join_mask),
+        lower=lower, upper=upper, vary=np.asarray(varys),
+        max_iter=max_iter, nres_valid=nres_valid,
+        # min_rows=1: see fit_gaussian_profiles_batched
+        compact_every=compact_every, compact_min_rows=1)

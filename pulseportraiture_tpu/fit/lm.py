@@ -13,6 +13,18 @@ shape, keeping everything jittable.
 Error bars follow lmfit's default convention: covariance scaled by
 reduced chi^2 (scale_covar=True), reported in external space via the
 transform's chain rule.
+
+ISSUE 9: the engine also runs BATCHED (`levenberg_marquardt_batched`):
+the same `_lm_core` vmapped over a leading problem axis, per-problem
+`done` flags inside one shared `lax.while_loop` — a converged problem
+holds its state (vmap's while_loop batching rule selects per-element on
+the original cond) while stragglers iterate, so `nfev`/`success` keep
+their per-problem semantics.  Heterogeneous problems coexist in one
+compiled program by padding parameter vectors to a common width with
+`vary=False` masking (a fully-frozen pad row converges on iteration 0)
+and by `nres_valid` (per-problem true residual count, so dof/errors
+ignore zero-weight padded residual entries).  The single-problem API is
+unchanged and is the B=1 digit-exactness oracle.
 """
 
 from functools import partial
@@ -22,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["LMResult", "levenberg_marquardt"]
+__all__ = ["LMResult", "levenberg_marquardt", "levenberg_marquardt_batched"]
 
 
 # --- bound transforms (lmfit/MINUIT convention) ---------------------------
@@ -55,10 +67,15 @@ def _to_internal(x, lo, hi, kind):
     )
 
 
-def _bounds_spec(lower, upper, n, dtype):
-    lo = np.full(n, -np.inf) if lower is None else np.asarray(lower, float)
-    hi = np.full(n, np.inf) if upper is None else np.asarray(upper, float)
-    kind = np.zeros(n, np.int32)
+def _bounds_spec(lower, upper, shape, dtype):
+    """Resolve (lower, upper) into (lo, hi, kind) arrays of ``shape``
+    (an int for the single-problem path, a (B, n) tuple batched —
+    per-problem bounds broadcast from (n,) or given per row)."""
+    lo = np.full(shape, -np.inf) if lower is None \
+        else np.broadcast_to(np.asarray(lower, float), shape).copy()
+    hi = np.full(shape, np.inf) if upper is None \
+        else np.broadcast_to(np.asarray(upper, float), shape).copy()
+    kind = np.zeros(shape, np.int32)
     kind[np.isfinite(lo) & ~np.isfinite(hi)] = 1
     kind[~np.isfinite(lo) & np.isfinite(hi)] = 2
     kind[np.isfinite(lo) & np.isfinite(hi)] = 3
@@ -77,6 +94,14 @@ class LMResult(NamedTuple):
     nfev: jnp.ndarray
     cov: jnp.ndarray        # external-space covariance (scaled)
     success: jnp.ndarray
+    # the fit stopped on the STALL criterion (two consecutive accepted
+    # steps with sub-ftol improvement at high damping — an
+    # ill-conditioned valley it would otherwise wander in until
+    # max_iter).  Counted as success (MINPACK's ftol-convergence
+    # spirit: further iteration polishes noise), but the stop point is
+    # not digit-reproducible across program variants the way a clean
+    # convergence is, so template-trial selection excludes these.
+    stalled: jnp.ndarray
 
 
 class _LMState(NamedTuple):
@@ -87,16 +112,20 @@ class _LMState(NamedTuple):
     lam: jnp.ndarray
     it: jnp.ndarray
     nfev: jnp.ndarray
+    nstall: jnp.ndarray  # consecutive accepted sub-ftol improvements
     done: jnp.ndarray
 
 
-@partial(jax.jit, static_argnames=("resid_fn", "max_iter"))
-def _lm_core(resid_fn, aux, x0, lo, hi, kind, vary, max_iter=100, ftol=1e-10,
-             lam0=1e-3):
-    dt = x0.dtype
-    u0 = _to_internal(x0, lo, hi, kind)
+def _lm_run(resid_fn, aux, s0, lo, hi, kind, vary, it_cap,
+            ftol=1e-10, lam0=1e-3):
+    """Advance an _LMState until convergence or ``it == it_cap`` (the
+    shared while_loop; ``it_cap`` is a traced operand so chunked
+    execution reuses one compiled program).  Splitting the loop at an
+    iteration boundary and resuming from the carried state reproduces
+    the unsplit trajectory exactly — the property the batched
+    front-end's compaction relies on."""
+    dt = s0.u.dtype
     vary = vary.astype(dt)
-    nvary = jnp.sum(vary)
 
     def rfun(u):
         return resid_fn(_to_external(u, lo, hi, kind), *aux)
@@ -106,7 +135,7 @@ def _lm_core(resid_fn, aux, x0, lo, hi, kind, vary, max_iter=100, ftol=1e-10,
         return J * vary[None, :]
 
     def cond(s):
-        return jnp.logical_and(s.it < max_iter, jnp.logical_not(s.done))
+        return jnp.logical_and(s.it < it_cap, jnp.logical_not(s.done))
 
     def body(s):
         g = s.J.T @ s.r
@@ -128,11 +157,27 @@ def _lm_core(resid_fn, aux, x0, lo, hi, kind, vary, max_iter=100, ftol=1e-10,
         # negligible relative improvement.  With large lam a small
         # improvement only means the step was short, not convergence.
         rel = (s.f - f_new) / (jnp.abs(s.f) + 1e-300)
-        done = jnp.logical_and(jnp.logical_and(accept, rel < ftol),
-                               s.lam <= lam0)
+        done_clean = jnp.logical_and(
+            jnp.logical_and(accept, rel < ftol), s.lam <= lam0)
         # also converged if the gradient is essentially zero
         gnorm = jnp.max(jnp.abs(g * vary))
-        done = jnp.logical_or(done, gnorm < 1e-14 * (s.f + 1.0))
+        done_clean = jnp.logical_or(done_clean,
+                                    gnorm < 1e-14 * (s.f + 1.0))
+        # STALL: two consecutive accepted steps whose improvement is
+        # below ftol but at high damping (so the lam<=lam0 clause never
+        # fires) — an ill-conditioned valley the loop would otherwise
+        # wander in until max_iter, each wander step paying a Jacobian.
+        # Further iteration only polishes noise (MINPACK stops on the
+        # same ftol evidence); flagged separately in LMResult.stalled.
+        # A clean convergence on this very iteration resets the
+        # counter: `stalled` must mean the stall criterion is what
+        # stopped the fit, not that the counter happened to reach 2 as
+        # the fit converged properly.
+        nstall = jnp.where(accept,
+                           jnp.where(rel < ftol, s.nstall + 1, 0),
+                           s.nstall)
+        nstall = jnp.where(done_clean, 0, nstall)
+        done = jnp.logical_or(done_clean, nstall >= 2)
         u_new = jnp.where(accept, u_try, s.u)
         # the Jacobian only changes when the step is accepted; a
         # rejected step reuses the stored one (skipping the dominant
@@ -146,27 +191,51 @@ def _lm_core(resid_fn, aux, x0, lo, hi, kind, vary, max_iter=100, ftol=1e-10,
             lam=jnp.where(accept, s.lam * 0.3, s.lam * 5.0).clip(1e-12, 1e12),
             it=s.it + 1,
             nfev=s.nfev + 1,
+            nstall=nstall,
             done=done,
         )
 
+    return jax.lax.while_loop(cond, body, s0)
+
+
+def _lm_init(resid_fn, aux, x0, lo, hi, kind, vary, lam0=1e-3):
+    """Initial _LMState at x0 (one residual + one Jacobian eval)."""
+    dt = x0.dtype
+    u0 = _to_internal(x0, lo, hi, kind)
+    vary = vary.astype(dt)
+
+    def rfun(u):
+        return resid_fn(_to_external(u, lo, hi, kind), *aux)
+
     r0 = rfun(u0)
-    s0 = _LMState(
+    J0 = jax.jacfwd(rfun)(u0) * vary[None, :]
+    return _LMState(
         u=u0,
         f=jnp.sum(r0**2.0),
         r=r0,
-        J=jac(u0),
+        J=J0,
         lam=jnp.asarray(lam0, dt),
         it=jnp.asarray(0, jnp.int32),
         nfev=jnp.asarray(1, jnp.int32),
+        nstall=jnp.asarray(0, jnp.int32),
         done=jnp.asarray(False),
     )
-    s = jax.lax.while_loop(cond, body, s0)
 
-    # --- covariance in external space, lmfit scale_covar convention ---
+
+def _lm_finalize(s, lo, hi, kind, vary, nres_valid, max_iter):
+    """Final _LMState -> LMResult (covariance in external space, lmfit
+    scale_covar convention)."""
+    dt = s.u.dtype
+    vary = vary.astype(dt)
+    nvary = jnp.sum(vary)
     r, J = s.r, s.J
     JTJ = J.T @ J + jnp.diag(1.0 - vary)
     cov_u = jnp.linalg.inv(JTJ)
-    nres = r.shape[0]
+    # padded residual entries (batched lane: zero-weight channels) are
+    # exactly zero and carry no information; nres_valid restores the
+    # true degrees of freedom so red-chi2 scaling matches the unpadded
+    # problem digit-for-digit
+    nres = r.shape[0] if nres_valid is None else nres_valid
     dof = nres - nvary
     red = s.f / jnp.maximum(dof, 1.0)
     cov_u = cov_u * red
@@ -179,33 +248,31 @@ def _lm_core(resid_fn, aux, x0, lo, hi, kind, vary, max_iter=100, ftol=1e-10,
     return LMResult(
         x=x, x_err=x_err, chi2=s.f, dof=dof, nfev=s.nfev, cov=cov_x,
         success=s.done | (s.it < max_iter),
+        stalled=s.nstall >= 2,
     )
 
 
-def levenberg_marquardt(resid_fn, x0, aux=(), lower=None, upper=None,
-                        vary=None, max_iter=100, ftol=1e-10):
-    """Minimize sum(resid_fn(x, *aux)**2) over x with optional bounds.
+def _lm_core_impl(resid_fn, aux, x0, lo, hi, kind, vary, nres_valid=None,
+                  max_iter=100, ftol=1e-10, lam0=1e-3):
+    s0 = _lm_init(resid_fn, aux, x0, lo, hi, kind, vary, lam0=lam0)
+    s = _lm_run(resid_fn, aux, s0, lo, hi, kind, vary, max_iter,
+                ftol=ftol, lam0=lam0)
+    return _lm_finalize(s, lo, hi, kind, vary, nres_valid, max_iter)
 
-    resid_fn: callable (x, *aux) -> residual vector; must be
-    jax-traceable and HASHABLE (a module-level function).  Pass data
-    arrays through `aux` — they are traced operands, so repeated fits
-    with different data reuse one compilation.
-    x0: (n,) initial external parameters (clipped into bounds).
-    lower/upper: (n,) bounds with +-inf for unbounded; vary: (n,) bool.
-    """
-    x0 = jnp.asarray(x0, float)
-    n = x0.shape[0]
-    lo, hi, kind = _bounds_spec(lower, upper, n, x0.dtype)
-    if vary is None:
-        vary = jnp.ones(n, bool)
-    vary = jnp.asarray(vary)
-    # Nudge VARYING parameters strictly inside their bounds: at the
-    # exact bound every transform has dx/du = 0 (u = 0 for one-sided,
-    # the arcsin endpoints for two-sided), which zeroes the Jacobian
-    # column and freezes the parameter forever.  Frozen (vary=False)
-    # parameters keep their exact value.  The nudge must be large
-    # enough that dx/du ~ sqrt(2*eps) does not make the column
-    # numerically singular (which produces explosive internal steps).
+
+_lm_core = partial(jax.jit, static_argnames=("resid_fn", "max_iter"))(
+    _lm_core_impl)
+
+
+def _nudge_into_bounds(x0, lo, hi, kind, vary):
+    """Nudge VARYING parameters strictly inside their bounds: at the
+    exact bound every transform has dx/du = 0 (u = 0 for one-sided,
+    the arcsin endpoints for two-sided), which zeroes the Jacobian
+    column and freezes the parameter forever.  Frozen (vary=False)
+    parameters keep their exact value.  The nudge must be large
+    enough that dx/du ~ sqrt(2*eps) does not make the column
+    numerically singular (which produces explosive internal steps).
+    Elementwise, so the single and batched front-ends share it."""
     eps = 1e-4
     inside3 = jnp.clip(x0, lo + eps * (hi - lo), hi - eps * (hi - lo))
     inside1 = jnp.maximum(x0, lo + eps * (1.0 + jnp.abs(lo)))
@@ -218,5 +285,180 @@ def levenberg_marquardt(resid_fn, x0, aux=(), lower=None, upper=None,
                    jnp.clip(x0, lo, hi), x0)
     x0 = jnp.where(~vary & (kind == 1), jnp.maximum(x0, lo), x0)
     x0 = jnp.where(~vary & (kind == 2), jnp.minimum(x0, hi), x0)
+    return x0
+
+
+def levenberg_marquardt(resid_fn, x0, aux=(), lower=None, upper=None,
+                        vary=None, max_iter=100, ftol=1e-10,
+                        nres_valid=None):
+    """Minimize sum(resid_fn(x, *aux)**2) over x with optional bounds.
+
+    resid_fn: callable (x, *aux) -> residual vector; must be
+    jax-traceable and HASHABLE (a module-level function).  Pass data
+    arrays through `aux` — they are traced operands, so repeated fits
+    with different data reuse one compilation.
+    x0: (n,) initial external parameters (clipped into bounds).
+    lower/upper: (n,) bounds with +-inf for unbounded; vary: (n,) bool.
+    nres_valid: true residual count for dof when some residual entries
+    are structural zero-weight padding (see levenberg_marquardt_batched).
+    """
+    x0 = jnp.asarray(x0, float)
+    n = x0.shape[0]
+    lo, hi, kind = _bounds_spec(lower, upper, n, x0.dtype)
+    if vary is None:
+        vary = jnp.ones(n, bool)
+    vary = jnp.asarray(vary)
+    x0 = _nudge_into_bounds(x0, lo, hi, kind, vary)
     return _lm_core(resid_fn, tuple(aux), x0, lo, hi, kind, vary,
+                    nres_valid=(None if nres_valid is None
+                                else jnp.asarray(nres_valid)),
                     max_iter=max_iter, ftol=ftol)
+
+
+# one compiled batched program per (resid_fn, max_iter, dof source);
+# shapes/dtypes key the underlying jit cache as usual
+_BATCHED_CORE_CACHE = {}
+
+
+def _batched_core(resid_fn, max_iter, has_nres):
+    key = (resid_fn, max_iter, has_nres)
+    if key not in _BATCHED_CORE_CACHE:
+        def run(aux, x0, lo, hi, kind, vary, nres_valid, ftol):
+            return _lm_core_impl(resid_fn, aux, x0, lo, hi, kind, vary,
+                                 nres_valid=nres_valid,
+                                 max_iter=max_iter, ftol=ftol)
+
+        axes = (0, 0, 0, 0, 0, 0, 0 if has_nres else None, None)
+        _BATCHED_CORE_CACHE[key] = jax.jit(jax.vmap(run, in_axes=axes))
+    return _BATCHED_CORE_CACHE[key]
+
+
+_BATCHED_PIECE_CACHE = {}
+
+
+def _batched_pieces(resid_fn, has_nres):
+    """jitted vmapped (init, run-chunk, finalize) programs for the
+    compacting front-end.  The run chunk takes ``it_cap`` as a traced
+    operand, so every chunk of every problem subset reuses one
+    compiled program per batch-width class."""
+    key = (resid_fn, has_nres)
+    if key not in _BATCHED_PIECE_CACHE:
+        def init(aux, x0, lo, hi, kind, vary):
+            return _lm_init(resid_fn, aux, x0, lo, hi, kind, vary)
+
+        def run(aux, s, lo, hi, kind, vary, it_cap, ftol):
+            return _lm_run(resid_fn, aux, s, lo, hi, kind, vary,
+                           it_cap, ftol=ftol)
+
+        def fin(s, lo, hi, kind, vary, nres_valid, max_iter):
+            return _lm_finalize(s, lo, hi, kind, vary, nres_valid,
+                                max_iter)
+
+        _BATCHED_PIECE_CACHE[key] = (
+            jax.jit(jax.vmap(init, in_axes=(0, 0, 0, 0, 0, 0))),
+            jax.jit(jax.vmap(run, in_axes=(0, 0, 0, 0, 0, 0, None,
+                                           None))),
+            jax.jit(jax.vmap(fin, in_axes=(0, 0, 0, 0, 0,
+                                           0 if has_nres else None,
+                                           None))),
+        )
+    return _BATCHED_PIECE_CACHE[key]
+
+
+def _pow2ceil(n):
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+def levenberg_marquardt_batched(resid_fn, x0, aux=(), lower=None,
+                                upper=None, vary=None, max_iter=100,
+                                ftol=1e-10, nres_valid=None,
+                                compact_every=None, compact_min_rows=4):
+    """Minimize B independent problems in ONE dispatch: `_lm_core`
+    vmapped over the leading problem axis, all problems sharing one
+    `lax.while_loop` whose per-problem `done` flags let converged
+    problems hold their state while stragglers iterate.
+
+    resid_fn: as in levenberg_marquardt — ONE hashable module-level
+    function shared by every problem; per-problem data goes through
+    ``aux``, a tuple of arrays each stacked with a leading B axis.
+    x0: (B, n) initial parameters padded to a common width n —
+    heterogeneous problems freeze their pad entries with vary=False
+    (a zero-amplitude frozen component contributes exactly nothing, so
+    the padded fit is digit-identical to the unpadded one).
+    lower/upper: (n,) shared or (B, n) per-problem; vary: (B, n).
+    nres_valid: (B,) true residual counts when problems carry
+    zero-weight padded residual entries (channel padding); dof and the
+    scale_covar error bars then match the unpadded problems.
+    Returns an LMResult whose every field has a leading B axis;
+    nfev/success keep their per-problem single-fit semantics.
+
+    compact_every: with an int K, the shared while_loop runs in chunks
+    of K iterations with host-side COMPACTION between chunks: problems
+    still iterating are re-batched into the next power-of-two width
+    (never below compact_min_rows), so one straggler stops costing a
+    full-width lock-step iteration — sum-of-iterations work like the
+    serial loop instead of B*max(iterations).  Chunking splits the
+    loop at iteration boundaries and carries exact state, so per-
+    problem trajectories (and results) are identical to the unchunked
+    dispatch.  None (default) = one dispatch, one uninterrupted loop.
+    """
+    x0 = jnp.asarray(x0, float)
+    if x0.ndim != 2:
+        raise ValueError(
+            f"levenberg_marquardt_batched needs x0 of shape (B, n); "
+            f"got {x0.shape}")
+    B, n = x0.shape
+    lo, hi, kind = _bounds_spec(lower, upper, (B, n), x0.dtype)
+    if vary is None:
+        vary = jnp.ones((B, n), bool)
+    vary = jnp.broadcast_to(jnp.asarray(vary), (B, n))
+    x0 = _nudge_into_bounds(x0, lo, hi, kind, vary)
+    aux = tuple(jnp.asarray(a) for a in aux)
+    if nres_valid is not None:
+        nres_valid = jnp.asarray(nres_valid)
+    if compact_every is None:
+        fn = _batched_core(resid_fn, int(max_iter),
+                           nres_valid is not None)
+        return fn(aux, x0, lo, hi, kind, vary, nres_valid, ftol)
+
+    init_fn, run_fn, fin_fn = _batched_pieces(resid_fn,
+                                              nres_valid is not None)
+    lo_j, hi_j = jnp.asarray(lo), jnp.asarray(hi)
+    kind_j, vary_j = jnp.asarray(kind), jnp.asarray(vary)
+    state = init_fn(aux, x0, lo_j, hi_j, kind_j, vary_j)
+    K = int(compact_every)
+    max_iter = int(max_iter)
+    it_cap = 0
+    while True:
+        done = np.asarray(state.done)
+        itv = np.asarray(state.it)
+        alive = np.where(~done & (itv < max_iter))[0]
+        if alive.size == 0:
+            break
+        it_cap = min(it_cap + K, max_iter)
+        cls = min(max(_pow2ceil(alive.size), int(compact_min_rows)), B)
+        if cls == B:
+            state = run_fn(aux, state, lo_j, hi_j, kind_j, vary_j,
+                           it_cap, ftol)
+            continue
+        idx = jnp.asarray(np.concatenate(
+            [alive, np.full(cls - alive.size, alive[0])]))
+
+        def take(a):
+            return jnp.take(a, idx, axis=0)
+
+        sub = jax.tree_util.tree_map(take, state)
+        if cls > alive.size:
+            # pad rows hold a copy of an alive problem; force them done
+            # so the chunk cond skips their updates (results discarded)
+            pad_mask = jnp.arange(cls) >= alive.size
+            sub = sub._replace(done=sub.done | pad_mask)
+        out = run_fn(tuple(take(a) for a in aux), sub, take(lo_j),
+                     take(hi_j), take(kind_j), take(vary_j), it_cap,
+                     ftol)
+        ai = jnp.asarray(alive)
+        na = alive.size
+        state = jax.tree_util.tree_map(
+            lambda fs, cs: fs.at[ai].set(cs[:na]), state, out)
+    return fin_fn(state, lo_j, hi_j, kind_j, vary_j, nres_valid,
+                  max_iter)

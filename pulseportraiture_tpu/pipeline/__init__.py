@@ -8,6 +8,7 @@ from .align import (  # noqa: F401
     psradd_archives,
     psrsmooth_archive,
 )
+from .factory import TemplateJob, build_templates  # noqa: F401
 from .ipta import IPTAJob, stream_ipta_campaign  # noqa: F401
 from .models import TemplateModel, sniff_model_type  # noqa: F401
 from .portrait import DataPortrait, normalize_portrait  # noqa: F401

@@ -29,6 +29,30 @@ from ..ops.rotation import rotate_portrait
 from .portrait import DataPortrait as _BasePortrait
 
 
+def portrait_fit_flags(ngauss, fixloc=False, fixwid=False, fixamp=False,
+                       fixscat=True, fiducial_gaussian=False):
+    """The portrait-layout fit flags (ppgauss.py:147-166): dc and every
+    component's (loc, wid, amp) always vary; tau and the evolution
+    moduli follow the fix* options; fiducial_gaussian pins the first
+    component's loc evolution.  Single source of truth for
+    make_gaussian_model AND the template factory (their flag semantics
+    must not drift — the factory-vs-single-driver parity test gates
+    it)."""
+    flags = np.zeros(2 + 6 * ngauss, int)
+    flags[0] = 1                       # dc
+    flags[1] = int(not fixscat)        # tau
+    for ig in range(ngauss):
+        flags[2 + 6 * ig + 0] = 1                  # loc
+        flags[2 + 6 * ig + 1] = int(not fixloc)    # mloc
+        flags[2 + 6 * ig + 2] = 1                  # wid
+        flags[2 + 6 * ig + 3] = int(not fixwid)    # mwid
+        flags[2 + 6 * ig + 4] = 1                  # amp
+        flags[2 + 6 * ig + 5] = int(not fixamp)    # mamp
+    if fiducial_gaussian and ngauss:
+        flags[2 + 1] = 0  # first component's loc evolution fixed
+    return flags
+
+
 def profile_to_portrait_params(profile_params):
     """[dc, tau, (loc, wid, amp)*g] -> [dc, tau, (loc, mloc, wid, mwid,
     amp, mamp)*g] with zero evolution slopes (ppgauss.py:147-156)."""
@@ -105,44 +129,45 @@ class GaussPortrait(_BasePortrait):
     @on_host
     def auto_fit_profile(self, profile=None, max_ngauss=8, wid0=0.02,
                          rchi2_tol=0.1, tau=0.0, fixscat=True,
-                         quiet=True):
-        """Iterative multi-component auto fit: add a Gaussian at the
-        residual peak and refit until reduced chi2 is within
-        rchi2_tol of 1 (or adding stops helping).  This is the
-        headless replacement for hand-sketching components in the GUI
-        — the reference's only automatic path is single-Gaussian
+                         gauss_device=None, quiet=True):
+        """Breadth-first multi-component auto fit (ISSUE 9): ALL
+        ``ngauss in 1..max_ngauss`` trials — seeded by matching pursuit
+        on the raw profile (fit/gauss.profile_trial_seeds) — are fit in
+        ONE batched LM dispatch (or, on the host-serial oracle lane,
+        one at a time on the same padded problems), and the best
+        reduced chi2 is selected on host with the serial add-refit
+        loop's acceptance rule.  Lane via gauss_device (None ->
+        config.gauss_device tri-state).  This is the headless
+        replacement for hand-sketching components in the GUI — the
+        reference's only automatic path is single-Gaussian
         (ppgauss.py:450-487)."""
+        max_ngauss = int(max_ngauss)
+        if max_ngauss < 1:
+            raise ValueError(
+                f"auto_fit_profile needs max_ngauss >= 1 (got "
+                f"{max_ngauss}): no trial component counts to fit")
+        from ..fit.gauss import fit_profile_trials, use_gauss_device
+
         if profile is None:
             profile, _ = self.select_ref_profile()
         profile = np.asarray(profile, float)
         noise = float(noise_std_ps(profile))
-        nbin = len(profile)
-        params = [0.0, tau]
-        resid = profile.copy()
-        best = None
-        for _ in range(max_ngauss):
-            ipeak = int(np.argmax(resid))
-            params = list(params) + [(ipeak + 0.5) / nbin, wid0,
-                                     max(float(resid[ipeak]), noise)]
-            fgp = fit_gaussian_profile(profile, np.asarray(params), noise,
-                                       fit_scattering=not fixscat,
-                                       quiet=True)
-            red = float(fgp.chi2) / max(int(fgp.dof), 1)
-            if best is None or red < best[0] * 0.99:
-                best = (red, np.asarray(fgp.fitted_params),
-                        np.asarray(fgp.fit_errs))
-                params = list(fgp.fitted_params)
-                resid = np.asarray(fgp.residuals)
-                if red < 1.0 + rchi2_tol:
-                    break
-            else:  # adding components stopped helping
-                break
-        self.init_params = best[1]
-        self.init_param_errs = best[2]
-        self.ngauss = (len(self.init_params) - 2) // 3
+        sel = fit_profile_trials(
+            profile, max_ngauss, noise, wid0=wid0, tau=tau,
+            fit_scattering=not fixscat, rchi2_tol=rchi2_tol,
+            serial=not use_gauss_device(gauss_device))
+        if sel is None:
+            raise ValueError(
+                f"auto_fit_profile: every trial fit of "
+                f"{self.datafile!r} failed (non-finite chi2 for all "
+                f"ngauss in 1..{max_ngauss}) — check the input profile "
+                "and noise level")
+        self.init_params = sel.params
+        self.init_param_errs = sel.param_errs
+        self.ngauss = sel.ngauss
         if not quiet:
             print(f"auto_fit_profile: {self.ngauss} components, "
-                  f"red chi2 = {best[0]:.2f}")
+                  f"red chi2 = {sel.red_chi2s[sel.index]:.2f}")
         return self.init_params
 
     # -- the main loop -----------------------------------------------------
@@ -155,7 +180,9 @@ class GaussPortrait(_BasePortrait):
                             fiducial_gaussian=False, auto_gauss=0.0,
                             writemodel=False, outfile=None,
                             writeerrfile=False, errfile=None,
-                            model_name=None, residplot=None, quiet=False):
+                            model_name=None, residplot=None,
+                            gauss_device=None, max_ngauss=8,
+                            quiet=False):
         """Fit the evolving-Gaussian portrait model (reference
         ppgauss.py:62-245; same options).  Returns the fitted
         GaussianModel."""
@@ -179,27 +206,19 @@ class GaussPortrait(_BasePortrait):
             self.nu_ref = nu_ref
             if not len(np.atleast_1d(getattr(self, "init_params", []))):
                 self.auto_fit_profile(profile, wid0=auto_gauss or 0.02,
-                                      tau=tau, fixscat=fixscat,
+                                      max_ngauss=max_ngauss, tau=tau,
+                                      fixscat=fixscat,
+                                      gauss_device=gauss_device,
                                       quiet=quiet)
             init_portrait = profile_to_portrait_params(self.init_params)
         model_name = model_name or (str(self.datafile) + ".gmodel")
         self.model_name = model_name
         self.model_code = model_code
 
-        # portrait-layout fit flags (ppgauss.py:147-166)
-        ngauss = self.ngauss
-        flags = np.zeros(2 + 6 * ngauss, int)
-        flags[0] = 1                       # dc
-        flags[1] = int(not fixscat)        # tau
-        for ig in range(ngauss):
-            flags[2 + 6 * ig + 0] = 1                  # loc
-            flags[2 + 6 * ig + 1] = int(not fixloc)    # mloc
-            flags[2 + 6 * ig + 2] = 1                  # wid
-            flags[2 + 6 * ig + 3] = int(not fixwid)    # mwid
-            flags[2 + 6 * ig + 4] = 1                  # amp
-            flags[2 + 6 * ig + 5] = int(not fixamp)    # mamp
-        if fiducial_gaussian and ngauss:
-            flags[2 + 1] = 0  # first component's loc evolution fixed
+        flags = portrait_fit_flags(self.ngauss, fixloc=fixloc,
+                                   fixwid=fixwid, fixamp=fixamp,
+                                   fixscat=fixscat,
+                                   fiducial_gaussian=fiducial_gaussian)
         self._flags_cache = flags
 
         join_params = None

@@ -26,6 +26,19 @@ from ..models.wavelet import smart_smooth
 from .portrait import DataPortrait as _BasePortrait
 
 
+def snr_weighted_mean(dp):
+    """The S/N-weighted mean profile of a portrait — the quantity
+    make_spline_model averages and the template factory's spline lane
+    Gaussian-smooths.  ONE definition: if the weighting ever changes,
+    the injected smooth_mean_prof must keep smoothing the same profile
+    make_spline_model subtracts."""
+    SNRsx = np.asarray(dp.SNRsxs[0], float)
+    w = SNRsx / SNRsx.sum()
+    # the trailing normalization is ~1.0 by construction; kept so this
+    # helper is bit-identical to the historical inline computation
+    return (dp.portx * w[:, None]).sum(axis=0) / w.sum()
+
+
 class SplinePortrait(_BasePortrait):
     """DataPortrait specialized with make_spline_model / write_model
     (the reference shadows the base class name; here the subclass is
@@ -35,16 +48,25 @@ class SplinePortrait(_BasePortrait):
     @on_host
     def make_spline_model(self, max_ncomp=10, smooth=True,
                           snr_cutoff=150.0, rchi2_tol=0.1, k=3, sfac=1.0,
-                          max_nbreak=None, model_name=None, quiet=False,
+                          max_nbreak=None, model_name=None,
+                          smooth_mean_prof=None, quiet=False,
                           **kwargs):
         """Build the PCA+spline model; same options/semantics as the
-        reference (ppspline.py:39-217)."""
+        reference (ppspline.py:39-217).
+
+        smooth_mean_prof: an externally smoothed mean profile (same
+        nbin) used INSTEAD of the wavelet smart_smooth of the mean when
+        smooth=True — the template factory (pipeline/factory.py)
+        injects the fleet's batched Gaussian-fit of the S/N-weighted
+        mean here, so spline jobs ride the shared batched LM lane.
+        Eigenprofile smoothing is unaffected (eigenvectors have
+        negative lobes the sign-constrained Gaussian basis cannot
+        represent)."""
         port = self.portx
         SNRsx = np.asarray(self.SNRsxs[0], float)
         noise_x = np.asarray(self.noise_stdsxs[0], float)
         pca_weights = SNRsx / SNRsx.sum()
-        mean_prof = (port * pca_weights[:, None]).sum(axis=0) \
-            / pca_weights.sum()
+        mean_prof = snr_weighted_mean(self)
         freqs = np.asarray(self.freqsxs[0], float)
         nbin = port.shape[1]
         if nbin % 2 != 0:
@@ -64,8 +86,16 @@ class SplinePortrait(_BasePortrait):
             smooth_eigvec = eigvec.copy()
         ncomp = len(ieig)
         if smooth:
-            smooth_mean_prof = np.asarray(smart_smooth(
-                mean_prof, rchi2_tol=rchi2_tol))
+            if smooth_mean_prof is not None:
+                smooth_mean_prof = np.asarray(smooth_mean_prof, float)
+                if smooth_mean_prof.shape != mean_prof.shape:
+                    raise ValueError(
+                        f"smooth_mean_prof shape "
+                        f"{smooth_mean_prof.shape} != mean profile "
+                        f"shape {mean_prof.shape}")
+            else:
+                smooth_mean_prof = np.asarray(smart_smooth(
+                    mean_prof, rchi2_tol=rchi2_tol))
             if not smooth_mean_prof.any():
                 # smart_smooth zeroes a profile when no (nlevel, fact)
                 # passes the red-chi2 gate — right for noise

@@ -58,6 +58,7 @@ TRACE_SCHEMA_VERSION = 1
 # trace reader needs to interpret device/queue numbers.
 CONFIG_SNAPSHOT_KEYS = (
     "cross_spectrum_dtype", "dft_precision", "dft_fold", "align_device",
+    "gauss_device",
     "stream_devices", "stream_max_inflight", "stream_pipeline_depth",
     "compile_cache_dir", "telemetry_path",
     "serve_max_wait_ms", "serve_queue_depth", "bucket_pad",
@@ -113,6 +114,18 @@ EVENT_FIELDS = {
     # AOT warmup (utils/device.warmup_from_manifest): one per
     # (manifest shape x device) compiled before serving started
     "warmup_compile": {"shape", "device", "compile_s"},
+    # the template factory (pipeline/factory.build_templates): one
+    # template_fit per bucket dispatch — stage 'profile'|'portrait',
+    # the bucket's shape key, rows (real problems), pad (padded rows:
+    # B rounded to its power-of-two class + frozen pad components),
+    # worst per-problem nfev, wall seconds, and whether the batched
+    # lane ran (False = host-serial oracle)
+    "template_fit": {"stage", "bucket", "rows", "pad", "nfev_max",
+                     "wall_s", "batched"},
+    # one per finished template job (pulsar)
+    "template_job": {"datafile", "kind", "ngauss", "converged",
+                     "iters"},
+    "factory_end": {"n_jobs", "n_dispatches", "wall_s"},
     "counters": {"counters", "gauges"},
 }
 
@@ -674,6 +687,49 @@ def report(path, file=None):
             p(f"  AOT warmup: {len(warmups)} (shape x device) "
               f"program(s) compiled in {w_s:.3f} s before serving")
 
+    # ---- template factory (batched Gaussian/spline model building) --
+    tfit = by_type.get("template_fit", [])
+    tjobs = by_type.get("template_job", [])
+    template_pad_frac = None
+    template_wall_s = None
+    if tfit or tjobs:
+        p("")
+        p("-- template factory (batched LM buckets) --")
+        by_stage = {}
+        for ev in tfit:
+            s = by_stage.setdefault(ev["stage"],
+                                    [0, 0, 0, 0.0, 0, set()])
+            s[0] += 1
+            s[1] += int(ev["rows"])
+            s[2] += int(ev["pad"])
+            s[3] += float(ev["wall_s"])
+            s[4] = max(s[4], int(ev["nfev_max"]))
+            s[5].add(ev["bucket"])
+        template_wall_s = sum(s[3] for s in by_stage.values())
+        rows_all = sum(s[1] for s in by_stage.values())
+        pad_all = sum(s[2] for s in by_stage.values())
+        template_pad_frac = pad_all / max(rows_all + pad_all, 1)
+        n_batched = sum(1 for ev in tfit if ev.get("batched"))
+        for stage in sorted(by_stage):
+            nd, rows, pad, wall, nfev, shapes = by_stage[stage]
+            occ = rows / max(rows + pad, 1)
+            p(f"  {stage}: {nd} dispatch(es) over {len(shapes)} "
+              f"bucket shape(s), {rows} problems + {pad} padded "
+              f"({100 * occ:.1f}% full), wall {wall:.3f} s, "
+              f"worst nfev {nfev}")
+        p(f"  {n_batched}/{len(tfit)} dispatches on the batched lane; "
+          f"aggregate pad fraction "
+          f"{100 * template_pad_frac:.1f}%")
+        if tjobs:
+            ngs = [int(ev["ngauss"]) for ev in tjobs
+                   if ev.get("ngauss") is not None]
+            conv = sum(1 for ev in tjobs if ev.get("converged"))
+            p(f"  {len(tjobs)} template job(s) done "
+              f"({conv} converged); ngauss "
+              f"min/median/max {min(ngs)}/{int(np.median(ngs))}/"
+              f"{max(ngs)}" if ngs else
+              f"  {len(tjobs)} template job(s) done")
+
     # ---- quality ----------------------------------------------------
     qual = by_type.get("quality", [])
     snr = [v for ev in qual for v in ev["snr"]]
@@ -723,6 +779,10 @@ def report(path, file=None):
         "n_coalesce": len(coalesce),
         "batch_occupancy": occupancy,
         "n_warmup": len(warmups),
+        "n_template_fit": len(tfit),
+        "n_template_jobs": len(tjobs),
+        "template_pad_frac": template_pad_frac,
+        "template_wall_s": template_wall_s,
         "counters": counters,
         "gauges": gauges,
     }

@@ -17,6 +17,7 @@ setup(
             "ppserve=pulseportraiture_tpu.cli.ppserve:main",
             "ppalign=pulseportraiture_tpu.cli.ppalign:main",
             "ppgauss=pulseportraiture_tpu.cli.ppgauss:main",
+            "ppfactory=pulseportraiture_tpu.cli.ppfactory:main",
             "ppspline=pulseportraiture_tpu.cli.ppspline:main",
             "ppzap=pulseportraiture_tpu.cli.ppzap:main",
         ]

@@ -10,8 +10,8 @@ import re
 import numpy as np
 import pytest
 
-from pulseportraiture_tpu.cli import (ppalign, ppgauss, ppserve,
-                                      ppspline, pptoas, ppzap)
+from pulseportraiture_tpu.cli import (ppalign, ppfactory, ppgauss,
+                                      ppserve, ppspline, pptoas, ppzap)
 from pulseportraiture_tpu.synth import default_test_model, make_fake_pulsar
 from pulseportraiture_tpu.utils.mjd import MJD
 
@@ -247,6 +247,120 @@ def test_ppserve_flag_and_request_validation(tmp_path):
     bad.write_text("")
     with pytest.raises(SystemExit, match="no requests"):
         ppserve.main(["-r", str(bad)])
+
+
+@pytest.fixture(scope="module")
+def tiny_fleet(tmp_path_factory):
+    """Two tiny single-pulsar archives + a fleet metafile (NOT a JOIN
+    metafile) for the ppfactory/ppgauss --batch paths; shapes match
+    test_factory so the jitted programs are already warm in-process."""
+    from pulseportraiture_tpu.synth import make_fake_pulsar
+
+    root = tmp_path_factory.mktemp("fleet")
+    files = []
+    for i in range(2):
+        p = str(root / f"fleet{i}.fits")
+        make_fake_pulsar(default_test_model(1500.0),
+                         {"PSR": f"FLEET{i}", "P0": 0.003, "DM": 10.0,
+                          "PEPOCH": 56000.0},
+                         outfile=p, nsub=2, nchan=8, nbin=64,
+                         nu0=1500.0, bw=600.0, tsub=60.0,
+                         start_MJD=MJD(55200 + i, 0.3),
+                         noise_stds=0.05, dedispersed=False,
+                         quiet=True, rng=60 + i)
+        files.append(p)
+    meta = root / "fleet.txt"
+    meta.write_text("\n".join(files) + "\n")
+    return root, str(meta), files
+
+
+def test_ppfactory_cli_builds_fleet(tiny_fleet):
+    """ppfactory end-to-end: one .gmodel per archive via the batched
+    template factory (serial lane on CPU 'auto' routing)."""
+    root, meta, files = tiny_fleet
+    outdir = root / "models"
+    rc = ppfactory.main(["-M", meta, "-O", str(outdir),
+                         "--max-ngauss", "2", "--niter", "0"])
+    assert rc == 0
+    for f in files:
+        import os
+
+        out = outdir / (os.path.basename(f) + ".gmodel")
+        assert out.exists()
+        assert "COMP01" in out.read_text()
+
+
+def test_ppgauss_batch_cli(tiny_fleet):
+    """ppgauss --batch routes -M through the template factory (one
+    model per archive, default naming)."""
+    root, meta, files = tiny_fleet
+    rc = ppgauss.main(["-M", meta, "--batch", "--max-ngauss", "2",
+                       "--niter", "0"])
+    assert rc == 0
+    for f in files:
+        assert (root / (f.split("/")[-1] + ".gmodel")).exists() or \
+            __import__("os").path.exists(f + ".gmodel")
+
+
+def test_ppfactory_flag_validation(tmp_path):
+    """ppfactory rejects malformed flags loudly before any file IO."""
+    meta = tmp_path / "m.txt"
+    meta.write_text("a.fits\n")
+    base = ["-M", str(meta)]
+    with pytest.raises(SystemExit, match="gauss-device"):
+        ppfactory.main(base + ["--gauss-device", "sometimes"])
+    with pytest.raises(SystemExit, match="max-ngauss"):
+        ppfactory.main(base + ["--max-ngauss", "0"])
+    with pytest.raises(SystemExit, match="niter"):
+        ppfactory.main(base + ["--niter", "-1"])
+    with pytest.raises(SystemExit, match="not found"):
+        ppfactory.main(["-M", str(tmp_path / "missing.txt")])
+    empty = tmp_path / "empty.txt"
+    empty.write_text("")
+    with pytest.raises(SystemExit, match="no archives"):
+        ppfactory.main(["-M", str(empty)])
+
+
+def test_ppgauss_gauss_device_and_batch_validation():
+    """--gauss-device parses the strict tri-state on ppgauss and
+    ppspline; --batch requires -M; bad values die before IO."""
+    args = ppgauss.build_parser().parse_args(
+        ["-d", "x.fits", "--gauss-device", "auto"])
+    assert args.gauss_device == "auto"
+    with pytest.raises(SystemExit, match="gauss-device"):
+        ppgauss.main(["-d", "x.fits", "--gauss-device", "maybe"])
+    with pytest.raises(SystemExit, match="max-ngauss"):
+        ppgauss.main(["-d", "x.fits", "--max-ngauss", "0"])
+    with pytest.raises(SystemExit, match="batch requires"):
+        ppgauss.main(["-d", "x.fits", "--batch"])
+    # options the fleet factory cannot honor die loudly instead of
+    # being silently dropped
+    with pytest.raises(SystemExit, match="not supported with --batch"):
+        ppgauss.main(["-M", "m.txt", "--batch", "-o", "out.gmodel"])
+    with pytest.raises(SystemExit, match="not supported with --batch"):
+        ppgauss.main(["-M", "m.txt", "--batch", "-I", "start.gmodel"])
+    with pytest.raises(SystemExit, match="gauss-device"):
+        ppspline.main(["-d", "x.fits", "--gauss-device", "maybe"])
+    # the flag selects the mean-smoothing lane, which only exists
+    # under -s — silently running no smoothing would be worse
+    with pytest.raises(SystemExit, match="requires -s"):
+        ppspline.main(["-d", "x.fits", "--gauss-device", "on"])
+    args = ppspline.build_parser().parse_args(
+        ["-d", "x.fits", "--gauss-device", "off"])
+    assert args.gauss_device == "off"
+
+
+def test_ppspline_gauss_device_smooths_mean(tiny_fleet):
+    """ppspline -s --gauss-device routes the MEAN smoothing through
+    the template factory's Gaussian LM lane (the injected
+    smooth_mean_prof hook) instead of wavelets."""
+    root, meta, files = tiny_fleet
+    out = root / "gd.spl"
+    rc = ppspline.main(["-d", files[0], "-o", str(out), "-s",
+                        "--gauss-device", "off", "-S", "50.0",
+                        "--quiet"])
+    assert rc == 0
+    assert out.exists()
 
 
 def test_pptoas_stream_devices_flag_validation():

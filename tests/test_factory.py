@@ -1,0 +1,207 @@
+"""Template factory (ISSUE 9): fleet-batched model building vs the
+host-serial oracle and the single-pulsar driver, telemetry events, env
+hooks, the spline mean-profile hook, and degenerate-input handling —
+all at tiny shapes (tier-1 runs near its cap)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu import config, telemetry
+from pulseportraiture_tpu.io.gmodel import model_to_flat, read_gmodel
+from pulseportraiture_tpu.pipeline import build_templates
+from pulseportraiture_tpu.pipeline.gauss import GaussPortrait
+from pulseportraiture_tpu.synth import default_test_model, make_fake_pulsar
+from pulseportraiture_tpu.utils.mjd import MJD
+
+NCHAN, NBIN = 8, 64
+MAX_NG = 2
+NITER = 1
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    root = tmp_path_factory.mktemp("factory")
+    files = []
+    for i in range(2):
+        p = str(root / f"psr{i}.fits")
+        make_fake_pulsar(default_test_model(1500.0),
+                         {"PSR": f"FAKE{i}", "P0": 0.003 + 0.001 * i,
+                          "DM": 20.0 + i, "PEPOCH": 56000.0},
+                         outfile=p, nsub=2, nchan=NCHAN, nbin=NBIN,
+                         nu0=1500.0, bw=600.0, tsub=60.0,
+                         start_MJD=MJD(55100 + i, 0.3),
+                         noise_stds=0.05, dedispersed=False,
+                         quiet=True, rng=30 + i)
+        files.append(p)
+    return root, files
+
+
+@pytest.fixture(scope="module")
+def built(fleet):
+    """One serial + one batched factory run shared by the assertions
+    below (compiles dominate the cost at these shapes)."""
+    root, files = fleet
+    out_s, out_b = str(root / "serial"), str(root / "batched")
+    trace = str(root / "trace.jsonl")
+    res_s = build_templates(files, outdir=out_s, max_ngauss=MAX_NG,
+                            niter=NITER, gauss_device=False, quiet=True)
+    res_b = build_templates(files, outdir=out_b, max_ngauss=MAX_NG,
+                            niter=NITER, gauss_device=True, quiet=True,
+                            telemetry=trace)
+    return root, files, out_s, out_b, trace, res_s, res_b
+
+
+def _params(path):
+    m = read_gmodel(path, quiet=True)
+    return model_to_flat(m)[0], float(m.alpha)
+
+
+class TestFactoryGauss:
+    def test_batched_matches_serial_oracle(self, built):
+        """The acceptance gate: batched-lane .gmodel digit-identical
+        (<= 1e-10) to the host-serial oracle on every pulsar."""
+        root, files, out_s, out_b, _, res_s, res_b = built
+        for f, rs, rb in zip(files, res_s, res_b):
+            base = os.path.basename(f)
+            ps, al_s = _params(os.path.join(out_s, base + ".gmodel"))
+            pb, al_b = _params(os.path.join(out_b, base + ".gmodel"))
+            assert np.max(np.abs(ps - pb)) <= 1e-10
+            assert abs(al_s - al_b) <= 1e-10
+            assert rs.ngauss == rb.ngauss
+            assert rs.iters == rb.iters
+
+    def test_matches_single_pulsar_driver(self, built):
+        """The factory's serial lane reproduces the single-pulsar
+        make_gaussian_model pipeline (same breadth-first profile fit,
+        same iteration/rotation bookkeeping; padding is the only
+        difference and contributes exactly zero)."""
+        root, files, out_s, _, _, res_s, _ = built
+        f = files[0]
+        dp = GaussPortrait(f, quiet=True)
+        single_out = str(root / "single.gmodel")
+        dp.make_gaussian_model(niter=NITER, writemodel=True,
+                               outfile=single_out, quiet=True)
+        # the single driver's auto_fit_profile defaults max_ngauss=8;
+        # rebuild with the factory's trial budget for a like-for-like
+        dp2 = GaussPortrait(f, quiet=True)
+        dp2.auto_fit_profile(max_ngauss=MAX_NG, quiet=True)
+        dp2.make_gaussian_model(niter=NITER, writemodel=True,
+                                outfile=single_out, quiet=True)
+        ps, al_s = _params(single_out)
+        pf, al_f = _params(os.path.join(
+            out_s, os.path.basename(f) + ".gmodel"))
+        assert np.max(np.abs(ps - pf)) <= 1e-8
+        assert abs(al_s - al_f) <= 1e-8
+
+    def test_telemetry_events_and_report(self, built):
+        root, files, _, _, trace, _, res_b = built
+        manifest, events = telemetry.validate_trace(trace)
+        assert manifest["config"]["gauss_device"] is not None
+        etypes = [e["type"] for e in events]
+        assert "template_fit" in etypes
+        assert "factory_end" in etypes
+        tfit = [e for e in events if e["type"] == "template_fit"]
+        stages = {e["stage"] for e in tfit}
+        assert stages == {"profile", "portrait"}
+        for e in tfit:
+            assert e["rows"] >= 1 and e["pad"] >= 0
+            assert e["wall_s"] >= 0 and e["nfev_max"] >= 1
+            assert e["batched"] is True
+        jobs = [e for e in events if e["type"] == "template_job"]
+        assert len(jobs) == len(files)
+        import io
+
+        buf = io.StringIO()
+        summary = telemetry.report(trace, file=buf)
+        assert summary["n_template_fit"] == len(tfit)
+        assert summary["n_template_jobs"] == len(files)
+        assert summary["template_pad_frac"] is not None
+        assert summary["template_wall_s"] > 0
+        assert "template factory" in buf.getvalue()
+
+    def test_refuses_metafile_and_bad_inputs(self, fleet, tmp_path):
+        root, files = fleet
+        meta = tmp_path / "meta.txt"
+        meta.write_text("\n".join(files) + "\n")
+        with pytest.raises(ValueError, match="metafile"):
+            build_templates([str(meta)], quiet=True)
+        with pytest.raises(ValueError, match="no datafiles"):
+            build_templates([], quiet=True)
+        with pytest.raises(ValueError, match="max_ngauss"):
+            build_templates(files, max_ngauss=0, quiet=True)
+        with pytest.raises(ValueError, match="kind"):
+            build_templates(files, kind="wavelet", quiet=True)
+        with pytest.raises(ValueError, match="one entry per"):
+            build_templates(files, kind=["gauss"], quiet=True)
+
+
+class TestFactorySpline:
+    def test_spline_jobs_ride_the_batched_profile_lane(self, fleet):
+        """kind='spline': the S/N-weighted mean profile is smoothed by
+        the fleet's batched Gaussian fit and injected through
+        make_spline_model(smooth_mean_prof=...)."""
+        root, files = fleet
+        out = str(root / "spl")
+        res = build_templates([files[0]], kind="spline", outdir=out,
+                              max_ngauss=MAX_NG, gauss_device=True,
+                              quiet=True,
+                              spline_kwargs={"snr_cutoff": 50.0})
+        assert len(res) == 1
+        assert res[0].kind == "spline"
+        assert os.path.exists(res[0].outfile)
+        from pulseportraiture_tpu.io.splmodel import read_spline_model
+
+        m = read_spline_model(res[0].outfile, quiet=True)
+        assert m.mean_prof.shape == (NBIN,)
+
+    def test_smooth_mean_prof_hook(self, fleet, rng):
+        """make_spline_model uses an injected smoothed mean verbatim
+        and validates its shape."""
+        root, files = fleet
+        from pulseportraiture_tpu.pipeline.spline import SplinePortrait
+
+        dp = SplinePortrait(files[0], quiet=True)
+        injected = np.linspace(0.0, 1.0, NBIN)
+        dp.make_spline_model(smooth=True, smooth_mean_prof=injected,
+                             snr_cutoff=50.0, quiet=True)
+        assert np.array_equal(dp.smooth_mean_prof, injected)
+        dp2 = SplinePortrait(files[0], quiet=True)
+        with pytest.raises(ValueError, match="smooth_mean_prof"):
+            dp2.make_spline_model(smooth=True, quiet=True,
+                                  smooth_mean_prof=np.zeros(NBIN + 2))
+
+
+class TestDegenerateInputs:
+    def test_auto_fit_profile_max_ngauss_validation(self, fleet):
+        """The ISSUE 9 satellite: max_ngauss < 1 raises a loud
+        ValueError naming the argument instead of dying with TypeError
+        at best[1]."""
+        root, files = fleet
+        dp = GaussPortrait(files[0], quiet=True)
+        with pytest.raises(ValueError, match="max_ngauss"):
+            dp.auto_fit_profile(max_ngauss=0)
+        with pytest.raises(ValueError, match="max_ngauss"):
+            dp.auto_fit_profile(max_ngauss=-3)
+
+
+class TestEnvHooks:
+    def test_ppt_gauss_device_env(self, monkeypatch):
+        saved = config.gauss_device
+        try:
+            for val, want in (("off", False), ("auto", "auto"),
+                              ("on", True)):
+                monkeypatch.setenv("PPT_GAUSS_DEVICE", val)
+                assert "gauss_device" in config.env_overrides()
+                assert config.gauss_device == want
+            monkeypatch.setenv("PPT_GAUSS_DEVICE", "sometimes")
+            with pytest.raises(ValueError, match="PPT_GAUSS_DEVICE"):
+                config.env_overrides()
+        finally:
+            config.gauss_device = saved
+
+    def test_new_knobs_registered(self):
+        for name in ("PPT_GAUSS_DEVICE", "PPT_GAUSS_CACHE",
+                     "PPT_NGAUSS"):
+            assert name in config.KNOWN_PPT_ENV

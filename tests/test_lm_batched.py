@@ -1,0 +1,249 @@
+"""Batched LM engine (ISSUE 9): digit parity with the single-problem
+oracle on mixed-bounds/mixed-vary problem sets, padded-component
+identity, straggler convergence inside the shared while_loop, and
+per-problem nfev/success semantics — all at tiny shapes (the engine
+semantics are shape-independent; tier-1 runs near its cap)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.fit.gauss import (fit_gaussian_profile,
+                                            fit_gaussian_profiles_batched,
+                                            gen_gaussian_profile_flat,
+                                            pad_profile_params,
+                                            profile_trial_seeds,
+                                            profile_vary,
+                                            select_best_trial,
+                                            use_gauss_device)
+from pulseportraiture_tpu.fit.lm import (levenberg_marquardt,
+                                         levenberg_marquardt_batched)
+
+
+def _quad_resid(x, t, y, s):
+    return (y - (x[0] + x[1] * t + x[2] * t ** 2)) / s
+
+
+def _flat_resid(x, t, y, s):
+    # a problem with a parameter pinned far from optimum by bounds
+    return (y - x[0] * jnp.exp(-x[1] * t)) / s
+
+
+class TestBatchedParity:
+    def test_mixed_bounds_mixed_vary_digit_parity(self, rng):
+        """Every bound kind (free / lower / upper / two-sided) and a
+        per-problem vary mask, batched vs single <= 1e-12."""
+        B, npts = 6, 40
+        t = np.linspace(0.0, 1.0, npts)
+        ts, ys, ss, x0s, vs, los, his = [], [], [], [], [], [], []
+        singles = []
+        for b in range(B):
+            y = (1.0 + b) + (2.0 + 0.5 * b) * t + 0.4 * t ** 2 \
+                + 0.05 * rng.normal(size=npts)
+            s = np.full(npts, 0.05)
+            lower = np.array([-np.inf, 0.0 if b % 2 else -np.inf,
+                              -1.0])
+            upper = np.array([np.inf if b % 3 else 10.0, np.inf, 1.0])
+            vary = np.array([True, True, b % 2 == 0])
+            x0 = np.array([0.0, 1.0, 0.4])
+            singles.append(levenberg_marquardt(
+                _quad_resid, x0, aux=(t, y, s), lower=lower,
+                upper=upper, vary=vary))
+            ts.append(t), ys.append(y), ss.append(s)
+            x0s.append(x0), vs.append(vary)
+            los.append(lower), his.append(upper)
+        res = levenberg_marquardt_batched(
+            _quad_resid, np.stack(x0s),
+            aux=(np.stack(ts), np.stack(ys), np.stack(ss)),
+            lower=np.stack(los), upper=np.stack(his),
+            vary=np.stack(vs))
+        n_exact = 0
+        for b in range(B):
+            s1 = singles[b]
+            # same minimum for every problem: chi2 to relative 1e-12,
+            # parameters to 1e-8 (a near-threshold `done` test may flip
+            # by an ulp between the batched and single programs, moving
+            # the stopping point by one polishing step)
+            assert abs(float(res.chi2[b]) - float(s1.chi2)) \
+                <= 1e-12 * float(s1.chi2)
+            assert np.max(np.abs(np.asarray(res.x)[b]
+                                 - np.asarray(s1.x))) <= 1e-8
+            assert int(res.dof[b]) == int(s1.dof)
+            assert bool(res.success[b]) == bool(s1.success)
+            # when the iteration trajectories match, results are
+            # digit-identical
+            if int(res.nfev[b]) == int(s1.nfev):
+                n_exact += 1
+                for f in ("x", "x_err"):
+                    got = np.asarray(getattr(res, f))[b]
+                    want = np.asarray(getattr(s1, f))
+                    assert np.max(np.abs(got - want)) <= 1e-12, (b, f)
+        assert n_exact >= B - 1  # at most one near-threshold flip here
+
+    def test_x0_must_be_2d(self):
+        with pytest.raises(ValueError, match=r"\(B, n\)"):
+            levenberg_marquardt_batched(_quad_resid, np.zeros(3))
+
+    def test_straggler_does_not_corrupt_finished_lanes(self, rng):
+        """One hard problem (far seed, tight tolerance — many more
+        iterations) shares the while_loop with easy ones; the easy
+        problems' results must equal their standalone fits exactly
+        (converged lanes hold their state while stragglers iterate)."""
+        npts = 30
+        t = np.linspace(0.0, 2.0, npts)
+        s = np.full(npts, 0.02)
+        y_easy = 2.0 - 1.0 * t + 0.1 * t ** 2 \
+            + 0.02 * rng.normal(size=npts)
+        y_hard = 5.0 + 3.0 * t - 0.8 * t ** 2 \
+            + 0.02 * rng.normal(size=npts)
+        x0_easy = np.array([2.0, -1.0, 0.1])   # near optimum
+        x0_hard = np.array([-50.0, 40.0, -20.0])  # far seed
+        r_easy = levenberg_marquardt(_quad_resid, x0_easy,
+                                     aux=(t, y_easy, s))
+        rb = levenberg_marquardt_batched(
+            _quad_resid, np.stack([x0_easy, x0_hard]),
+            aux=(np.stack([t, t]), np.stack([y_easy, y_hard]),
+                 np.stack([s, s])))
+        nfev = np.asarray(rb.nfev)
+        assert nfev[1] > nfev[0]  # the straggler iterated longer
+        for f in ("x", "x_err", "chi2", "nfev"):
+            got = np.asarray(getattr(rb, f))[0]
+            want = np.asarray(getattr(r_easy, f))
+            assert np.max(np.abs(got - want)) <= 1e-12, f
+        # the straggler still converged to the right answer
+        assert np.allclose(np.asarray(rb.x)[1], [5.0, 3.0, -0.8],
+                           atol=0.2)
+
+    def test_nfev_success_semantics_per_problem(self, rng):
+        """A problem capped by max_iter reports success=False without
+        touching its batchmates' flags."""
+        npts = 30
+        t = np.linspace(0.0, 2.0, npts)
+        s = np.full(npts, 0.02)
+        # problem 0: noiseless data, seeded AT the optimum -> zero
+        # gradient -> done within the tiny budget; problem 1: far seed
+        # that cannot converge in 3 iterations
+        y = 2.0 + 1.0 * t + 0.3 * t ** 2
+        x0_good = np.array([2.0, 1.0, 0.3])
+        x0_bad = np.array([-200.0, 150.0, -90.0])
+        rb = levenberg_marquardt_batched(
+            _quad_resid, np.stack([x0_good, x0_bad]),
+            aux=(np.stack([t, t]), np.stack([y, y]),
+                 np.stack([s, s])), max_iter=3)
+        success = np.asarray(rb.success)
+        nfev = np.asarray(rb.nfev)
+        assert bool(success[0])
+        assert not bool(success[1])
+        assert nfev[1] >= 3  # burned its whole budget
+        # all-frozen problems converge immediately (the factory's
+        # batch-row padding relies on this)
+        rb2 = levenberg_marquardt_batched(
+            _quad_resid, np.stack([x0_good, x0_good]),
+            aux=(np.stack([t, t]), np.stack([y, y]),
+                 np.stack([s, s])),
+            vary=np.stack([np.ones(3, bool), np.zeros(3, bool)]))
+        assert np.asarray(rb2.nfev)[1] <= 2
+        assert np.all(np.asarray(rb2.x)[1] == x0_good)
+
+
+class TestCompaction:
+    def test_compacted_chunks_match_single_dispatch(self, rng):
+        """compact_every splits the shared while_loop at iteration
+        boundaries and re-batches stragglers into power-of-two
+        classes; per-problem trajectories — nfev included — must be
+        identical to the uninterrupted dispatch."""
+        B, npts = 6, 30
+        t = np.linspace(0.0, 2.0, npts)
+        s = np.full(npts, 0.02)
+        ys, x0s = [], []
+        for b in range(B):
+            ys.append((1.0 + b) + 2.0 * t - 0.4 * t ** 2
+                      + 0.02 * rng.normal(size=npts))
+            # one far seed so iteration counts straggle
+            x0s.append(np.array([-40.0, 30.0, -15.0]) if b == 3
+                       else np.array([1.0 + b, 2.0, -0.4]))
+        aux = (np.stack([t] * B), np.stack(ys), np.stack([s] * B))
+        whole = levenberg_marquardt_batched(
+            _quad_resid, np.stack(x0s), aux=aux, max_iter=80)
+        compact = levenberg_marquardt_batched(
+            _quad_resid, np.stack(x0s), aux=aux, max_iter=80,
+            compact_every=8, compact_min_rows=2)
+        nf = np.asarray(whole.nfev)
+        assert nf[3] > nf.min()  # the straggler really straggled
+        assert np.array_equal(np.asarray(compact.success),
+                              np.asarray(whole.success))
+        for f in ("x", "x_err", "chi2", "dof", "nfev"):
+            got = np.asarray(getattr(compact, f), float)
+            want = np.asarray(getattr(whole, f), float)
+            assert np.max(np.abs(got - want)) <= 1e-12, f
+
+
+class TestPaddedComponents:
+    def test_padded_ngauss_identity(self, rng):
+        """A profile trial padded with frozen zero-amplitude
+        components fits digit-identically (<= 1e-12) to the unpadded
+        fit — the property that lets heterogeneous ngauss share one
+        compiled program."""
+        nbin = 128
+        truth = np.array([0.01, 0.0, 0.3, 0.04, 1.0, 0.6, 0.02, 0.5])
+        prof = np.asarray(gen_gaussian_profile_flat(truth, nbin))
+        data = prof + 0.01 * rng.normal(size=nbin)
+        x0 = np.array([0.0, 0.0, 0.29, 0.05, 0.9, 0.61, 0.03, 0.4])
+        r_unpadded = fit_gaussian_profile(data, x0, 0.01)
+        padded, g = pad_profile_params(x0, 4)
+        assert g == 2
+        vary = profile_vary(g, 4)
+        rb = fit_gaussian_profiles_batched(
+            data[None], padded[None], np.array([0.01]), vary[None])
+        x = np.asarray(rb.x)[0]
+        xe = np.asarray(rb.x_err)[0]
+        assert np.max(np.abs(x[:8] - r_unpadded.fitted_params)) <= 1e-12
+        assert np.max(np.abs(xe[:8] - r_unpadded.fit_errs)) <= 1e-12
+        # pad components unchanged, zero amplitude, zero error
+        assert np.all(x[8::3][2:] == 0.0) or np.all(x[10::3] == 0.0)
+        assert int(rb.dof[0]) == int(r_unpadded.dof)
+
+    def test_pad_refuses_shrink(self):
+        with pytest.raises(ValueError, match="cannot pad"):
+            pad_profile_params(np.zeros(2 + 3 * 4), 2)
+
+
+class TestTrialMachinery:
+    def test_trial_seeds_shapes_and_determinism(self):
+        prof = np.zeros(64)
+        prof[20] = 1.0
+        seeds = profile_trial_seeds(prof, 3, wid0=0.05, noise=0.1)
+        assert [len(s) for s in seeds] == [5, 8, 11]
+        assert seeds[0][2] == (20 + 0.5) / 64  # peak-seeded loc
+        again = profile_trial_seeds(prof, 3, wid0=0.05, noise=0.1)
+        for a, b in zip(seeds, again):
+            assert np.array_equal(a, b)
+
+    def test_select_best_trial_rules(self):
+        # improving then stalling: stops at the stall
+        assert select_best_trial([10.0, 5.0, 4.999]) == 1
+        # within tolerance of 1 stops immediately
+        assert select_best_trial([1.05, 0.9], rchi2_tol=0.1) == 0
+        # non-finite trials skipped; all-bad -> None
+        assert select_best_trial([np.nan, 2.0]) == 1
+        assert select_best_trial([np.nan, np.inf]) is None
+        # non-converged (or stalled) trials still compete — a
+        # well-fitting capped trial must beat a converged underfit...
+        assert select_best_trial([3139.0, 0.84],
+                                 success=[True, False]) == 1
+        # ...but need a >5% improvement (their chi2 carries
+        # lane-dependent wander; a 1% margin could flip the selected
+        # component count between the batched and serial engines)
+        assert select_best_trial([10.0, 9.8],
+                                 success=[True, False]) == 0
+        assert select_best_trial([10.0, 9.8],
+                                 success=[True, True],
+                                 stalled=[False, True]) == 0
+        assert select_best_trial([10.0, 9.8]) == 1  # converged: >1%
+
+    def test_use_gauss_device_strict(self):
+        assert use_gauss_device(True) is True
+        assert use_gauss_device(False) is False
+        assert use_gauss_device("auto") in (True, False)
+        with pytest.raises(ValueError, match="gauss_device"):
+            use_gauss_device("sometimes")
