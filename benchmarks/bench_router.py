@@ -1,0 +1,257 @@
+"""Cross-host router benchmark (ISSUE 10 acceptance gate): aggregate
+campaign throughput of a 1 -> H emulated-host fleet vs the single-host
+arm, with byte-identical per-request ``.tim`` output.
+
+Arms (all in ONE process — bench_stream's virtual-device discipline
+applied to HOSTS):
+  oneshot   — stream_wideband_TOAs per request slice (the reference
+              .tim bytes, and the single-host throughput baseline);
+  router@H  — H warm ToaServers, each pinned to its OWN virtual device
+              (its own dispatch + copy worker threads, i.e. its own
+              emulated host->device link), reached through
+              InProcTransport — the same codepath a SocketTransport
+              fleet runs minus the TCP bytes.  A ToaRouter shards the
+              campaign's PPT_NREQ requests across them; measured from
+              first submit to last collected result.
+
+The scale-out claim is about the LINK (BENCHMARKS 5b/5d: ~90-95% of
+campaign wall blocked on host->device transfer; the link multiplies
+with hosts while the archive grid is embarrassingly parallel), so the
+gate applies under the tunneled-transport emulation:
+PPT_TUNNEL_EMU="<mbps>[:<dispatch_ms>]" (bench_campaign's model —
+throttled device_put + synchronous dispatch floor, here PER HOST
+because each host owns its device's copy worker).  Gate:
+``router_speedup`` (router@H vs router@1 aggregate TOAs/s) >= 1.8 at
+H=2 (``scaling_ok``); without tunnel emu the ratio is still printed
+but the gate is not claimed (a bare-CPU box has no link to multiply —
+compute serializes on the shared cores).
+
+Always-on gates, any transport regime: every request's routed .tim is
+byte-identical to its one-shot reference (``tim_identical``), zero
+lost/duplicated requests (``n_route_done`` == requests, TOA totals
+match), and the per-arm telemetry trace schema-validates with the
+router section populated (placement imbalance reported).
+
+Knobs via env: PPT_NARCH (32), PPT_NSUB (16), PPT_NCHAN (64),
+PPT_NBIN (256), PPT_NREQ (8 requests), PPT_NHOSTS (2),
+PPT_TUNNEL_EMU, PPT_CAMPAIGN_CACHE (shared with bench_campaign),
+PPT_TELEMETRY (traces to <path>.h<H>).  Prints ONE JSON line.
+"""
+
+import io
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _ensure_devices(n):
+    """Force >= n virtual CPU devices BEFORE jax initializes (the
+    bench_stream discipline): each emulated host needs its own device
+    so its copy worker — and therefore its emulated link — runs in its
+    own thread."""
+    if "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+
+
+def main():
+    NHOSTS = max(1, int(os.environ.get("PPT_NHOSTS", 2)))
+    _ensure_devices(NHOSTS)
+    import pulseportraiture_tpu  # noqa: F401
+    from pulseportraiture_tpu import config
+    config.dft_precision = "default"
+    config.cross_spectrum_dtype = "bfloat16"
+    config.env_overrides()
+
+    import jax
+
+    from pulseportraiture_tpu import telemetry
+    from pulseportraiture_tpu.io.gmodel import write_gmodel
+    from pulseportraiture_tpu.pipeline.stream import stream_wideband_TOAs
+    from pulseportraiture_tpu.serve import (InProcTransport, ToaClient,
+                                            ToaRouter, ToaServer)
+    from pulseportraiture_tpu.synth import default_test_model
+    from pulseportraiture_tpu.synth.archive import make_fake_pulsar
+
+    NARCH = int(os.environ.get("PPT_NARCH", 32))
+    NSUB = int(os.environ.get("PPT_NSUB", 16))
+    NCHAN = int(os.environ.get("PPT_NCHAN", 64))
+    NBIN = int(os.environ.get("PPT_NBIN", 256))
+    NREQ = max(1, int(os.environ.get("PPT_NREQ", 8)))
+    TUNNEL = os.environ.get("PPT_TUNNEL_EMU", "")
+    GATE = 1.8
+    PAR = {"PSR": "FAKE", "P0": 0.003, "DM": 50.0, "PEPOCH": 56000.0}
+    cache = os.environ.get("PPT_CAMPAIGN_CACHE", "/tmp/ppt_campaign")
+    tag = f"{NARCH}x{NSUB}x{NCHAN}x{NBIN}"
+    root = os.path.join(cache, tag)
+    os.makedirs(root, exist_ok=True)
+    trace_base = config.telemetry_path  # PPT_TELEMETRY (or None)
+
+    ndev = len(jax.local_devices())
+    if ndev < NHOSTS:
+        raise SystemExit(
+            f"bench_router: {NHOSTS} emulated hosts need {NHOSTS} "
+            f"virtual devices, got {ndev} (jax was initialized before "
+            "the device-count flag could apply?)")
+
+    mpath = os.path.join(root, "model.gmodel")
+    if not os.path.exists(mpath):
+        write_gmodel(default_test_model(1500.0), mpath, quiet=True)
+    files = []
+    for i in range(NARCH):
+        path = os.path.join(root, f"a{i:04d}.fits")
+        if not os.path.exists(path):
+            make_fake_pulsar(mpath, PAR, outfile=path, nsub=NSUB,
+                             nchan=NCHAN, nbin=NBIN, nu0=1500.0, bw=600.0,
+                             phase=0.01 * (i % 50), dDM=1e-4 * (i % 40),
+                             noise_stds=0.05, quiet=True, rng=i)
+        files.append(path)
+    slices = [files[i::NREQ] for i in range(NREQ)]
+
+    # ---- optional tunneled-transport emulation (bench_campaign's) ---
+    from pulseportraiture_tpu.pipeline import stream as S
+    unpatch = []
+    if TUNNEL:
+        parts = TUNNEL.split(":")
+        mbps = float(parts[0])
+        disp_ms = float(parts[1]) if len(parts) > 1 else 100.0
+        real_put = jax.device_put
+
+        def throttled_put(x, device=None, **kw):
+            out = real_put(x, device, **kw)
+            time.sleep(getattr(x, "nbytes", 0) / (mbps * 1e6))
+            return out
+
+        real_fit_fn = S._raw_fit_fn
+
+        def sync_fit_fn(*a, **kw):
+            fn = real_fit_fn(*a, **kw)
+
+            def run(*args):
+                out = jax.block_until_ready(fn(*args))
+                time.sleep(disp_ms / 1e3)  # tunnel round-trip floor
+                return out
+
+            return run
+
+        jax.device_put = throttled_put
+        S._raw_fit_fn = sync_fit_fn
+        unpatch = [(jax, "device_put", real_put),
+                   (S, "_raw_fit_fn", real_fit_fn)]
+
+    out_root = os.path.join(root, "router_out")
+    os.makedirs(out_root, exist_ok=True)
+
+    def ref_tim(i):
+        return os.path.join(out_root, f"ref{i}.tim")
+
+    try:
+        # ---- one-shot reference arm: per-request .tim bytes + the
+        # single-process baseline wall ------------------------------
+        stream_wideband_TOAs(files[:1], mpath, nsub_batch=64,
+                             quiet=True)  # warm the jit caches
+        t0 = time.perf_counter()
+        ntoa = 0
+        for i, sl in enumerate(slices):
+            res = stream_wideband_TOAs(sl, mpath, nsub_batch=64,
+                                       tim_out=ref_tim(i), quiet=True)
+            ntoa += len(res.TOA_list)
+        oneshot_wall = time.perf_counter() - t0
+        oneshot_tps = ntoa / oneshot_wall
+
+        # ---- router arms: 1 -> H emulated hosts --------------------
+        sweep = []
+        tim_identical = True
+        for H in sorted({1, NHOSTS}):
+            trace = f"{trace_base}.h{H}" if trace_base else None
+            servers = [
+                ToaServer(nsub_batch=64, quiet=True,
+                          stream_devices=[jax.local_devices()[h]])
+                .start()
+                for h in range(H)]
+            # warm EVERY host's jit/device caches out of the timed
+            # window (each device pays its own first-dispatch compile)
+            for srv in servers:
+                ToaClient(srv).get_TOAs(files[:1], mpath, timeout=600)
+            router = ToaRouter(
+                [InProcTransport(srv, label=f"host{h}")
+                 for h, srv in enumerate(servers)],
+                telemetry=trace)
+            tims = [os.path.join(out_root, f"h{H}_r{i}.tim")
+                    for i in range(NREQ)]
+            t0 = time.perf_counter()
+            handles = [router.submit(sl, mpath, tim_out=tims[i],
+                                     name=f"req{i}")
+                       for i, sl in enumerate(slices)]
+            results = [h.result(3600) for h in handles]
+            wall = time.perf_counter() - t0
+            placed = router.stats()
+            router.close()
+            for srv in servers:
+                srv.stop()
+            arm_ntoa = sum(len(r.TOA_list) for r in results)
+            for i in range(NREQ):
+                same = (open(ref_tim(i), "rb").read()
+                        == open(tims[i], "rb").read())
+                tim_identical = tim_identical and same
+            arm = {
+                "hosts": H,
+                "toas_per_sec": round(arm_ntoa / wall, 2),
+                "wall_s": round(wall, 3),
+                "n_toas": arm_ntoa,
+                "placement": {lbl: st["n_archives"]
+                              for lbl, st in placed.items()},
+            }
+            if trace:
+                summary = telemetry.report(trace, file=io.StringIO())
+                assert summary["n_route_submit"] == NREQ, summary
+                assert summary["n_route_done"] == NREQ, (
+                    "lost/duplicated requests: "
+                    f"{summary['n_route_done']} != {NREQ}")
+                arm["router_imbalance"] = (
+                    round(summary["router_imbalance"], 3)
+                    if summary["router_imbalance"] is not None
+                    else None)
+                arm["n_route_retry"] = summary["n_route_retry"]
+            assert arm_ntoa == ntoa, (
+                f"router@{H} produced {arm_ntoa} TOAs, one-shot "
+                f"{ntoa} — lost or duplicated work")
+            sweep.append(arm)
+    finally:
+        for obj, name, val in unpatch:
+            setattr(obj, name, val)
+
+    top = sweep[-1]
+    speedup = (top["toas_per_sec"]
+               / max(sweep[0]["toas_per_sec"], 1e-9))
+    print(json.dumps({
+        "metric": f"routed campaign TOAs incl. PSRFITS IO, {NARCH} "
+                  f"archives x {NSUB}sub x {NCHAN}ch x {NBIN}bin, "
+                  f"{NREQ} requests over {top['hosts']} emulated "
+                  "host(s)",
+        "value": top["toas_per_sec"],
+        "unit": "TOAs/sec",
+        "toas": ntoa,
+        "oneshot_toas_per_sec": round(oneshot_tps, 2),
+        "router_speedup": round(speedup, 3),
+        # the >= 1.8x @ 2 hosts claim is about multiplying the
+        # host->device LINK; it is only claimable when the link is
+        # what binds (tunnel emu) — bare-CPU hosts share cores
+        "scaling_ok": (bool(speedup >= GATE) if TUNNEL and
+                       top["hosts"] >= 2 else None),
+        "scaling_gate": GATE,
+        "tim_identical": bool(tim_identical),
+        "sweep": sweep,
+        "tunnel_emu": TUNNEL or None,
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,146 @@
+"""pproute — shard a campaign's TOA requests across a fleet of warm
+``ppserve --listen`` hosts (ISSUE 10).
+
+Reads the SAME JSONL request file as ``ppserve -r`` (one JSON object
+per line: name, datafiles, modelfile, options), but instead of serving
+locally it routes every request through a
+:class:`~..serve.router.ToaRouter` over ``--hosts`` (or
+PPT_ROUTER_HOSTS): least-pending-archives placement with sticky
+per-template affinity, retryable-backpressure retries with capped
+exponential backoff, and per-request ``.tim`` files written by
+whichever host served the request — byte-identical to the single-host
+one-shot driver.
+
+Fleet assumptions: archive paths and ``--outdir`` are visible on
+every host (shared filesystem — no bulk data crosses the wire), and
+each endpoint is a running ``ppserve --listen``.  ``--telemetry``
+records the route_submit/route_retry/route_done ledger; read it with
+``tools/pptrace.py report`` (the "router" section: per-host shares,
+retry rate, placement imbalance).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="pproute", description=__doc__.splitlines()[0])
+    p.add_argument("-r", "--requests", metavar="requests.jsonl",
+                   required=True,
+                   help="JSONL request file (ppserve's format: name, "
+                        "datafiles, modelfile, options per line).")
+    p.add_argument("-H", "--hosts", metavar="host:port[,host:port...]",
+                   default=None,
+                   help="Fleet endpoints, each a running 'ppserve "
+                        "--listen'. [default: config.router_hosts / "
+                        "PPT_ROUTER_HOSTS]")
+    p.add_argument("-O", "--outdir", metavar="DIR", default=".",
+                   help="Directory for per-request <name>.tim outputs "
+                        "(must be visible to every host). "
+                        "[default: .]")
+    p.add_argument("--retry-max", dest="retry_max", type=int,
+                   default=None, metavar="N",
+                   help="Total placement attempts per request before "
+                        "the last retryable rejection is raised. "
+                        "[default: config.router_retry_max / "
+                        "PPT_ROUTER_RETRY_MAX]")
+    p.add_argument("--timeout", type=float, default=None, metavar="S",
+                   help="Per-request result timeout in seconds. "
+                        "[default: none]")
+    p.add_argument("--telemetry", metavar="trace.jsonl", default=None,
+                   help="Write the routing trace (route_submit/"
+                        "route_retry/route_done) here; analyze with "
+                        "tools/pptrace.py. Also via PPT_TELEMETRY. "
+                        "[default: off]")
+    p.add_argument("--quiet", action="store_true", default=False)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.retry_max is not None and args.retry_max < 1:
+        raise SystemExit("--retry-max: must be >= 1, got "
+                         f"{args.retry_max}")
+    from .. import config
+
+    hosts = args.hosts
+    if hosts is not None:
+        hosts = [h.strip() for h in str(hosts).split(",") if h.strip()]
+    else:
+        hosts = list(config.router_hosts)
+    if not hosts:
+        raise SystemExit("pproute: no fleet endpoints — pass --hosts "
+                         "host:port[,host:port...] or set "
+                         "PPT_ROUTER_HOSTS")
+    for h in hosts:
+        try:
+            config.parse_hostport(h)
+        except ValueError as e:
+            raise SystemExit(f"pproute: --hosts: {e}")
+
+    from .ppserve import parse_requests
+
+    reqs = parse_requests(args.requests)
+    # tim paths cross the wire and are resolved by the SERVING host —
+    # the shared-filesystem assumption only holds for absolute paths
+    # (a relative outdir would land in the remote ppserve's cwd)
+    args.outdir = os.path.abspath(args.outdir)
+    os.makedirs(args.outdir, exist_ok=True)
+
+    from ..serve import ToaRouter, TransportError
+
+    try:
+        router = ToaRouter(hosts, retry_max=args.retry_max,
+                           telemetry=args.telemetry, quiet=args.quiet)
+    except TransportError as e:
+        raise SystemExit(f"pproute: {e}")
+    failures = 0
+    t0 = time.time()
+    with router:
+        handles = []
+        for rec in reqs:
+            tim = os.path.join(args.outdir, f"{rec['name']}.tim")
+            try:
+                handles.append(router.submit(
+                    rec["datafiles"], rec["modelfile"], tim_out=tim,
+                    name=rec["name"], **rec["options"]))
+            except Exception as e:
+                # a saturated/terminal fleet fails THIS request (the
+                # documented rc=1 path), not the whole batch — the
+                # already-placed requests must still be collected
+                handles.append(None)
+                failures += 1
+                print(f"pproute: request {rec['name']!r} FAILED to "
+                      f"place: {e}", file=sys.stderr)
+        for rec, h in zip(reqs, handles):
+            if h is None:
+                continue
+            try:
+                res = h.result(args.timeout)
+            except Exception as e:
+                failures += 1
+                print(f"pproute: request {rec['name']!r} FAILED on "
+                      f"{h.host.label}: {e}", file=sys.stderr)
+                continue
+            if not args.quiet:
+                print(f"pproute: {rec['name']}: "
+                      f"{len(res.TOA_list)} TOAs from "
+                      f"{len(res.order)} archive(s) on "
+                      f"{h.host.label} -> {res.tim_out}")
+        placed = router.stats()
+    if not args.quiet:
+        share = ", ".join(f"{lbl}: {st['n_archives']} archive(s)/"
+                          f"{st['n_requests']} request(s)"
+                          for lbl, st in placed.items())
+        print(f"pproute: {len(reqs) - failures}/{len(reqs)} requests "
+              f"across {len(hosts)} host(s) in {time.time() - t0:.2f} "
+              f"s [{share}]")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,16 @@ prior run's telemetry trace recorded before serving starts
 (utils/device.warmup_from_manifest), so the first requests skip the
 cold-start compiles; gate the before/after with ``--telemetry`` and
 ``tools/pptrace.py report`` (cold-start + serve sections).
+
+``--listen HOST:PORT`` (or PPT_SERVE_LISTEN) runs the OTHER mode: no
+request file — the warm server is exposed to remote clients over the
+length-prefixed JSON transport (serve/transport.TransportServer), and
+a ``pproute`` router on any machine shards campaign requests across a
+fleet of such listeners (ISSUE 10).  Archive paths in remote requests
+must be visible on THIS host (shared filesystem); each request's
+``.tim`` is written here, byte-identical to the one-shot driver.
+Port 0 binds an ephemeral port (printed at start).  The process
+serves until SIGINT/SIGTERM, then drains gracefully.
 """
 
 import argparse
@@ -34,9 +44,17 @@ def build_parser():
     p = argparse.ArgumentParser(
         prog="ppserve", description=__doc__.splitlines()[0])
     p.add_argument("-r", "--requests", metavar="requests.jsonl",
-                   required=True,
+                   default=None,
                    help="JSONL request file (one JSON object per "
-                        "line: name, datafiles, modelfile, options).")
+                        "line: name, datafiles, modelfile, options). "
+                        "Exactly one of -r / --listen.")
+    p.add_argument("--listen", metavar="HOST:PORT", default=None,
+                   help="Serve REMOTE clients instead of a request "
+                        "file: expose the warm server on this "
+                        "endpoint (port 0 = ephemeral, printed) for "
+                        "pproute/SocketTransport clients; runs until "
+                        "SIGINT, then drains. Also via "
+                        "PPT_SERVE_LISTEN. [default: off]")
     p.add_argument("-O", "--outdir", metavar="DIR", default=".",
                    help="Directory for per-request <name>.tim outputs "
                         "(created). [default: .]")
@@ -177,10 +195,31 @@ def main(argv=None):
                                  f">= 1, got {stream_devices}")
     if args.warmup_model and not args.warmup_manifest:
         raise SystemExit("--warmup-model requires --warmup-manifest")
-    reqs = parse_requests(args.requests)
+    from .. import config
+
+    if args.listen is not None and args.requests is not None:
+        raise SystemExit("ppserve: -r/--requests and --listen are "
+                         "mutually exclusive (batch client vs fleet "
+                         "member)")
+    # PPT_SERVE_LISTEN is only a DEFAULT for the listen mode: an
+    # explicit -r is a batch-mode request and must not conflict with
+    # a fleet host's environment profile
+    listen = args.listen
+    if listen is None and args.requests is None:
+        listen = config.serve_listen
+    if listen is None and args.requests is None:
+        raise SystemExit("ppserve: need -r/--requests (batch mode) or "
+                         "--listen HOST:PORT (fleet member)")
+    if listen is not None:
+        try:
+            config.parse_hostport(listen)
+        except ValueError as e:
+            raise SystemExit(f"ppserve: --listen: {e}")
+        reqs = None
+    else:
+        reqs = parse_requests(args.requests)
 
     if args.compile_cache:
-        from .. import config
         from ..utils.device import enable_compile_cache
 
         config.compile_cache_dir = args.compile_cache
@@ -196,6 +235,38 @@ def main(argv=None):
         pipeline_depth=args.pipeline_depth, telemetry=args.telemetry,
         warmup_manifest=args.warmup_manifest,
         warmup_model=args.warmup_model, quiet=args.quiet)
+
+    if listen is not None:
+        # fleet-member mode: expose the warm loop to remote routers
+        # and serve until a signal, then drain gracefully
+        import signal
+        import threading
+
+        from ..serve import TransportServer
+
+        host, port = config.parse_hostport(listen)
+        stop = threading.Event()
+        server.start()
+        transport = TransportServer(server, host=host, port=port,
+                                    quiet=args.quiet).start()
+        print(f"ppserve: listening on {transport.label} "
+              f"({len(server._ex.devices)} device(s)); Ctrl-C to "
+              "drain and exit", flush=True)
+        try:
+            signal.signal(signal.SIGTERM,
+                          lambda *a: stop.set())
+            signal.signal(signal.SIGINT,
+                          lambda *a: stop.set())
+        except ValueError:
+            pass  # not the main thread (tests drive main() directly)
+        try:
+            stop.wait()
+        except KeyboardInterrupt:
+            pass
+        transport.close()
+        server.stop(drain=True)
+        return 0
+
     failures = 0
     t0 = time.time()
     with server:

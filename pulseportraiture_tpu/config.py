@@ -183,6 +183,28 @@ serve_max_wait_ms = 50.0
 # ToaServer(queue_depth=...) / ppserve --queue-depth.
 serve_queue_depth = 64
 
+# --- Cross-host routing (serve/router.py + serve/transport.py) ------------
+# Default fleet for ToaRouter / the pproute CLI: a tuple of
+# 'host:port' endpoints, each a ``ppserve --listen`` serving loop.
+# () (default) = no fleet configured; pproute then requires --hosts.
+# Set via PPT_ROUTER_HOSTS="hostA:9090,hostB:9090" (strict host:port
+# parse per entry — a silently dropped endpoint would quietly shrink
+# the fleet an A/B measures).
+router_hosts = ()
+
+# Total placement attempts the router spends per request before the
+# last retryable rejection is raised: every ServeRejected(retryable)
+# backpressure signal or unreachable host consumes one attempt, and
+# each full pass over the fleet backs off exponentially (capped).
+# Per-router override via ToaRouter(retry_max=...).
+router_retry_max = 16
+
+# Default listen endpoint for ``ppserve --listen`` (the remote-
+# transport server): 'host:port' (port 0 = ephemeral, printed at
+# start).  None (default) = ppserve serves its request file locally.
+# Set via PPT_SERVE_LISTEN=host:port.
+serve_listen = None
+
 # Bucket-lattice coarsening (ROADMAP item 5): pad bucket channel
 # layouts up to the next power of two with zero-weight channels so a
 # campaign's (or serving fleet's) shape diversity costs log2 as many
@@ -298,6 +320,9 @@ RCSTRINGS = {
 #   PPT_SERVE_MAX_WAIT_MS=<float>   -> serve_max_wait_ms
 #   PPT_SERVE_QUEUE_DEPTH=<N>       -> serve_queue_depth
 #   PPT_BUCKET_PAD=off|auto|on      -> bucket_pad
+#   PPT_ROUTER_HOSTS=h:p[,h:p...]|off -> router_hosts
+#   PPT_ROUTER_RETRY_MAX=<N>        -> router_retry_max
+#   PPT_SERVE_LISTEN=<host:port>|off -> serve_listen
 #
 # Unset variables leave the module values untouched; a typo in a
 # KNOWN variable's value raises (strict like the config parsers — a
@@ -318,14 +343,34 @@ KNOWN_PPT_ENV = frozenset({
     "PPT_STREAM_DEVICES", "PPT_MAX_INFLIGHT",
     "PPT_PIPELINE_DEPTH", "PPT_COMPILE_CACHE", "PPT_TELEMETRY",
     "PPT_SERVE_MAX_WAIT_MS", "PPT_SERVE_QUEUE_DEPTH", "PPT_BUCKET_PAD",
+    "PPT_ROUTER_HOSTS", "PPT_ROUTER_RETRY_MAX", "PPT_SERVE_LISTEN",
     # benchmark / smoke-test shape and mode knobs
     "PPT_NB", "PPT_NE", "PPT_NPSR", "PPT_NARCH", "PPT_NSUB",
     "PPT_NSUBB", "PPT_NCHAN", "PPT_NBIN", "PPT_NITER", "PPT_K",
-    "PPT_NREQ", "PPT_DEVICES", "PPT_CAMPAIGN_CACHE", "PPT_ALIGN_CACHE",
+    "PPT_NREQ", "PPT_NHOSTS", "PPT_DEVICES", "PPT_CAMPAIGN_CACHE",
+    "PPT_ALIGN_CACHE",
     "PPT_GAUSS_CACHE", "PPT_NGAUSS",
     "PPT_TEMPLATE_NOISE", "PPT_STREAM_SPEEDUP_GATE",
     "PPT_HARMONIC_WINDOW", "PPT_TUNNEL_EMU",
 })
+
+def parse_hostport(spec):
+    """'host:port' -> (host, port), loud on anything else — shared by
+    the env hooks below, the serve transports, and the CLIs (a
+    silently mis-parsed endpoint would strand a fleet member)."""
+    s = str(spec).strip()
+    host, sep, port = s.rpartition(":")
+    if not sep or not host:
+        raise ValueError(f"expected 'host:port', got {spec!r}")
+    try:
+        port = int(port)
+    except ValueError:
+        raise ValueError(
+            f"expected an integer port in {spec!r}, got {port!r}")
+    if not 0 <= port <= 65535:
+        raise ValueError(f"port {port} out of range in {spec!r}")
+    return host, port
+
 
 _warned_unknown_ppt = set()  # warn ONCE per process per variable
 
@@ -492,6 +537,57 @@ def env_overrides():
                 f"{bpad!r}")
         cfg.bucket_pad = table[bpad]
         changed.append("bucket_pad")
+    rh = _os.environ.get("PPT_ROUTER_HOSTS", "")
+    if rh:
+        if rh.lower() in ("off", "none"):
+            cfg.router_hosts = ()
+        else:
+            hosts = []
+            for part in rh.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                try:
+                    parse_hostport(part)
+                except ValueError as e:
+                    raise ValueError(
+                        "PPT_ROUTER_HOSTS must be a comma-separated "
+                        f"list of host:port endpoints: {e}")
+                hosts.append(part)
+            if not hosts:
+                raise ValueError(
+                    "PPT_ROUTER_HOSTS must name at least one "
+                    f"host:port endpoint (or 'off'), got {rh!r}")
+            if len(set(hosts)) != len(hosts):
+                raise ValueError(
+                    f"PPT_ROUTER_HOSTS lists a duplicate endpoint: "
+                    f"{rh!r}")
+            cfg.router_hosts = tuple(hosts)
+        changed.append("router_hosts")
+    rmax = _os.environ.get("PPT_ROUTER_RETRY_MAX", "")
+    if rmax:
+        try:
+            n = int(rmax)
+        except ValueError:
+            raise ValueError(
+                "PPT_ROUTER_RETRY_MAX must be a positive integer, "
+                f"got {rmax!r}")
+        if n < 1:
+            raise ValueError(
+                f"PPT_ROUTER_RETRY_MAX must be >= 1, got {n}")
+        cfg.router_retry_max = n
+        changed.append("router_retry_max")
+    listen = _os.environ.get("PPT_SERVE_LISTEN", "")
+    if listen:
+        if listen.lower() in ("off", "none"):
+            cfg.serve_listen = None
+        else:
+            try:
+                parse_hostport(listen)
+            except ValueError as e:
+                raise ValueError(f"PPT_SERVE_LISTEN: {e}")
+            cfg.serve_listen = listen
+        changed.append("serve_listen")
     tel = _os.environ.get("PPT_TELEMETRY", "")
     if tel:
         # 'off'/'none'/'0' disable explicitly (so a wrapper script can

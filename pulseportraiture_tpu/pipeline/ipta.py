@@ -81,7 +81,7 @@ class IPTAJob:
 
 def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
                          quiet=False, resume=False, telemetry=None,
-                         server=None, **stream_kwargs):
+                         server=None, router=None, **stream_kwargs):
     """Measure wideband TOAs for a multi-pulsar campaign.
 
     server: an already-started serve.ToaServer — the campaign becomes
@@ -97,6 +97,17 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
     job kwargs must be lane options (fit_scat=, DM0=, ...).
     resume=True is not supported with server= — restartability comes
     from re-submitting against the durable request .tim files.
+
+    router: a serve.ToaRouter over a FLEET of warm serving loops
+    (ISSUE 10) — same thin-client shape as server=, but each pulsar's
+    request is placed on the least-loaded host (sticky per-template
+    affinity, backpressure retries handled inside the router), so one
+    campaign saturates many hosts' links at once.  Per-request .tim
+    files are written by whichever host served the request and are
+    byte-identical to the single-host path; archive paths and outdir
+    must be visible to every host (the multihost drivers' shared-
+    filesystem assumption).  Mutually exclusive with server=; the
+    same lane-option and resume rules apply.
 
     jobs: sequence of IPTAJob (or (pulsar, datafiles, modelfile)
     tuples).  outdir: directory for per-pulsar .tim outputs (created;
@@ -145,11 +156,15 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
     if resume and not outdir:
         raise ValueError("stream_ipta_campaign: resume=True needs "
                          "outdir (the checkpoints live there)")
-    if server is not None and resume:
+    if server is not None and router is not None:
+        raise ValueError(
+            "stream_ipta_campaign: pass server= OR router=, not both "
+            "(a router already owns its fleet of serving loops)")
+    if (server is not None or router is not None) and resume:
         raise ValueError(
             "stream_ipta_campaign: resume=True is not supported with "
-            "server= — restart by re-submitting; the per-request .tim "
-            "files are the durable artifact")
+            "server=/router= — restart by re-submitting; the "
+            "per-request .tim files are the durable artifact")
     if outdir:
         os.makedirs(outdir, exist_ok=True)
 
@@ -214,9 +229,10 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
         TOA_list = []
         nfit = 0
         fit_duration = 0.0
-        if server is not None:
+        if server is not None or router is not None:
             from ..serve import ServeRejected
 
+            target = "ToaServer" if server is not None else "ToaRouter"
             # executor-level knobs belong to the SERVER (it was
             # constructed with them); forwarding them as lane options
             # would fail every request with an opaque TypeError deep
@@ -229,11 +245,12 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
             if bad:
                 raise ValueError(
                     f"stream_ipta_campaign: {sorted(bad)} are executor"
-                    "-level options — configure them on the ToaServer "
-                    "when using server=")
+                    f"-level options — configure them on the {target} "
+                    f"when using {'server=' if server is not None else 'router='}")
             # thin-client path: submit EVERY shard first (the serving
             # loop pipelines admissions against in-flight dispatches
-            # and coalesces small shards across pulsars), then collect
+            # and coalesces small shards across pulsars; the router
+            # additionally spreads shards over its fleet), then collect
             handles = []
             for job in jobs:
                 files = by_psr.get(job.pulsar, [])
@@ -242,16 +259,23 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
                 tim_out = _tim_name(job.pulsar) if outdir else None
                 kw = {**stream_kwargs, **job.kwargs}
                 kw.pop("telemetry", None)
-                while True:
-                    try:
-                        h = server.submit(files, job.modelfile,
-                                          tim_out=tim_out,
-                                          name=job.pulsar, **kw)
-                        break
-                    except ServeRejected as e:
-                        if not getattr(e, "retryable", False):
-                            raise
-                        time.sleep(0.05)  # honor the backpressure
+                if router is not None:
+                    # the router owns backpressure retries (capped
+                    # exponential backoff across the fleet)
+                    h = router.submit(files, job.modelfile,
+                                      tim_out=tim_out,
+                                      name=job.pulsar, **kw)
+                else:
+                    while True:
+                        try:
+                            h = server.submit(files, job.modelfile,
+                                              tim_out=tim_out,
+                                              name=job.pulsar, **kw)
+                            break
+                        except ServeRejected as e:
+                            if not getattr(e, "retryable", False):
+                                raise
+                            time.sleep(0.05)  # honor the backpressure
                 handles.append((job, time.time(), h))
             for job, t_job, h in handles:
                 res = per_pulsar[job.pulsar] = h.result()
@@ -261,7 +285,7 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
                                 n_toas=len(res.TOA_list),
                                 n_archives=len(res.order), nfit=0,
                                 wall_s=round(time.time() - t_job, 6))
-        for job in (jobs if server is None else ()):
+        for job in (jobs if server is None and router is None else ()):
             files = by_psr.get(job.pulsar, [])
             if not files:
                 continue

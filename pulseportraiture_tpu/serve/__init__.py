@@ -7,8 +7,21 @@ full or past ``config.serve_max_wait_ms``), and completed TOAs
 demultiplex back to per-request ``.tim`` results byte-identical to the
 one-shot drivers.  See serve/server.py for the architecture and
 docs/GUIDE.md "Serving TOAs" for usage; the CLI is ``ppserve``.
+
+Cross-host scale-out (ISSUE 10): ``transport.py`` wraps the client
+surface in a length-prefixed JSON protocol (``ppserve --listen`` /
+``SocketTransport``; ``InProcTransport`` for tests and emulated
+fleets), and ``router.ToaRouter`` + the ``pproute`` CLI shard a
+campaign's requests across N such hosts — least-loaded placement,
+sticky per-template affinity, backpressure retries — with the demux
+still byte-identical to one-shot no matter which host served; see
+docs/GUIDE.md "Routing a campaign across hosts".
 """
 
 from .client import ToaClient  # noqa: F401
 from .queue import AdmissionQueue, ServeRejected, ServeRequest  # noqa: F401
+from .router import RouteHandle, ToaRouter  # noqa: F401
 from .server import ToaServer  # noqa: F401
+from .transport import (InProcTransport, RemoteRequestError,  # noqa: F401
+                        SocketTransport, TransportError,
+                        TransportServer)

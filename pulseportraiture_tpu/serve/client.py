@@ -9,7 +9,28 @@ everything below it (queueing, coalescing, demux) is transport-
 agnostic.
 """
 
-__all__ = ["ToaClient"]
+__all__ = ["ToaClient", "collect_results"]
+
+
+def collect_results(handles, timeout=None, return_errors=False):
+    """Collect every handle's result, in order, waiting on ALL of
+    them before anything raises — one failed request must never
+    strand its siblings mid-flight.  With ``return_errors=True`` a
+    failed slot holds its exception object; otherwise the first
+    failure re-raises after the full collection pass.  Shared by
+    ToaClient.map and ToaRouter.map (both hand out result(timeout)
+    handles), so the two fan-out surfaces cannot drift."""
+    out = []
+    for h in handles:
+        try:
+            out.append(h.result(timeout))
+        except Exception as e:
+            out.append(e)
+    if not return_errors:
+        for r in out:
+            if isinstance(r, Exception):
+                raise r
+    return out
 
 
 class ToaClient:
@@ -36,15 +57,22 @@ class ToaClient:
         return self.submit(datafiles, modelfile, tim_out=tim_out,
                            name=name, **options).result(timeout)
 
-    def map(self, specs, timeout=None):
+    def map(self, specs, timeout=None, return_errors=False):
         """Submit many requests, then wait for all: ``specs`` is a
         sequence of (datafiles, modelfile[, kwargs-dict]) tuples;
         returns the results in spec order.  Submission errors
         (ServeRejected) raise immediately — before any wait — so a
-        load-shedding server is visible at the call site."""
+        load-shedding server is visible at the call site.
+
+        A request that fails MID-BATCH (a bad option set, a broken
+        archive) is isolated: every sibling handle is still collected
+        before anything raises, so one failure never strands the rest
+        of the batch mid-flight.  With ``return_errors=True`` the
+        failed slot holds its exception object instead of raising —
+        the fan-out caller decides per request."""
         handles = []
         for spec in specs:
             datafiles, modelfile = spec[0], spec[1]
             kwargs = dict(spec[2]) if len(spec) > 2 else {}
             handles.append(self.submit(datafiles, modelfile, **kwargs))
-        return [h.result(timeout) for h in handles]
+        return collect_results(handles, timeout, return_errors)

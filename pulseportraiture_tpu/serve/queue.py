@@ -87,6 +87,13 @@ class ServeRequest:
     def done(self):
         return self._event.is_set()
 
+    def wait(self, timeout=None):
+        """Block up to ``timeout`` seconds for the server to resolve
+        this request; True when resolved (result() will not block),
+        False on timeout.  Unlike :meth:`result` this never raises —
+        it is the polling primitive remote transports build on."""
+        return self._event.wait(timeout)
+
     def result(self, timeout=None):
         """Block until the server resolves this request; returns the
         per-request DataBunch (TOA_list, order, DM0s, DeltaDM_means/

@@ -1,0 +1,342 @@
+"""Cross-host campaign router (ISSUE 10 tentpole, second half;
+ROADMAP item 2's scale-out tail).
+
+One warm :class:`~.server.ToaServer` saturates one host's chips — and
+at campaign scale the measured bottleneck is that host's host->device
+link (BENCHMARKS 5b/5d: ~90-95% of wall blocked on transfer).  The
+link is exactly the resource that MULTIPLIES across hosts, and pulsar
+archives are embarrassingly parallel with no cross-host traffic until
+the final GLS, so the scale-out shape is the continuous-batching
+inference one: keep every replica warm, route at REQUEST granularity,
+aggregate demuxed results deterministically.
+
+:class:`ToaRouter` owns N host endpoints, each a transport
+(serve/transport.py — ``InProcTransport`` or ``SocketTransport``)
+reaching a warm serving loop:
+
+- **Load-aware placement**: submits go to the host with the fewest
+  pending archives — the router's own outstanding count (archives
+  submitted through it and not yet collected) plus the host's live
+  AdmissionQueue depth from ``stat()``, so externally-offered load on
+  a shared host is visible too.
+- **Sticky per-modelfile affinity**: requests using a template the
+  router has already placed PREFER that host, so same-template
+  requests keep coalescing into shared fused buckets (the server's
+  per-(modelfile, options) lanes) instead of fragmenting their bucket
+  fills across the fleet.  Affinity yields to balance exactly when it
+  must: the affinity host wins unless its load exceeds the
+  least-loaded host's by at least the incoming request's own archive
+  count — i.e. unless placing the request on the affinity host would
+  leave it strictly more loaded than placing it anywhere else.
+- **Backpressure retries**: a ``ServeRejected(retryable=True)`` (a
+  full admission queue) moves the request to the next-least-loaded
+  host; a ``TransportError`` (host unreachable) does the same.  Each
+  full pass over the fleet backs off exponentially
+  (``ROUTER_BACKOFF_BASE_S`` doubling, capped) up to
+  ``config.router_retry_max`` total attempts; terminal rejections
+  (``retryable=False``) raise immediately.
+- **Deterministic demux**: each request's ``.tim`` is written by the
+  SERVING host through the server's existing per-request demux, so it
+  is byte-identical to the single-host one-shot driver regardless of
+  placement, retries, or completion order; the decoded result
+  DataBunch rides the transport codec.
+
+Telemetry: ``router_start`` once, then per request ``route_submit``
+(chosen host, placement attempt count, affinity flag),
+``route_retry`` (per rejected placement, with the backoff applied),
+and ``route_done`` (serving host, wall, TOA count / error) — the
+pptrace "router" section aggregates per-host shares, retry rate, and
+a placement-imbalance metric from exactly these events.
+"""
+
+import os
+import threading
+import time
+
+from ..telemetry import resolve_tracer
+from .queue import ServeRejected
+from .transport import TransportError
+
+__all__ = ["ToaRouter", "RouteHandle", "ROUTER_BACKOFF_BASE_S",
+           "ROUTER_BACKOFF_CAP_S"]
+
+# Backoff after a full fleet pass found no host with admission room:
+# base doubles per pass, capped (a campaign client is patient, but an
+# unbounded doubling would look like a hang).
+ROUTER_BACKOFF_BASE_S = 0.05
+ROUTER_BACKOFF_CAP_S = 2.0
+
+
+class _Host:
+    """Router-side bookkeeping for one endpoint: the transport plus
+    the outstanding-archives counter placement reads."""
+
+    def __init__(self, transport, index):
+        self.transport = transport
+        self.index = index
+        self.label = getattr(transport, "label", f"host{index}")
+        self.outstanding = 0   # archives submitted, result not collected
+        self.n_requests = 0    # requests ever placed here
+        self.n_archives = 0    # archives ever placed here
+
+    def load(self):
+        """Pending archives from this router (outstanding) plus the
+        host's own admission-queue depth (other clients' submits are
+        visible there).  A host whose stat() is unreachable reports
+        infinite load — placement simply avoids it this round."""
+        try:
+            pending = int(self.transport.stat()["pending_archives"])
+        except TransportError:
+            return float("inf")
+        return self.outstanding + pending
+
+
+class RouteHandle:
+    """One routed request: which host took it, and the blocking
+    :meth:`result` that demuxes through that host's transport."""
+
+    def __init__(self, router, host, handle, name, n_archives,
+                 t_submit):
+        self._router = router
+        self.host = host
+        self._handle = handle
+        self.name = name
+        self.n_archives = n_archives
+        self._t_submit = t_submit
+        self._collected = False
+
+    def result(self, timeout=None):
+        """Block for the per-request DataBunch (the one-shot driver's
+        result shape) or raise the request's failure; either way the
+        router's load accounting and route_done telemetry fire exactly
+        once."""
+        try:
+            res = self.host.transport.result(self._handle, timeout)
+        except TimeoutError:
+            raise  # not resolved: keep the load accounted, retryable
+        except Exception as e:
+            self._router._collected(self, error=e)
+            raise
+        self._router._collected(self, result=res)
+        return res
+
+
+class ToaRouter:
+    """Shard TOA requests across a fleet of warm serving loops.
+
+    transports: sequence of transport objects (InProcTransport /
+    SocketTransport), or 'host:port' strings (each opens a
+    SocketTransport).  retry_max: total placement attempts per request
+    before the last retryable rejection is raised (None =
+    ``config.router_retry_max``).  telemetry: trace path or shared
+    Tracer (route_* events land there).
+
+    Thread model: ``submit`` and ``RouteHandle.result`` are safe from
+    any thread (one lock guards placement state); each host's own
+    thread-safety is the transport's (SocketTransport serializes
+    frames, ToaServer.submit is thread-safe).
+    """
+
+    def __init__(self, transports, retry_max=None, telemetry=None,
+                 quiet=True):
+        from .. import config
+        from .transport import SocketTransport
+
+        transports = list(transports)
+        if not transports:
+            raise ValueError("ToaRouter: no host endpoints")
+        self.hosts = [
+            _Host(SocketTransport(t) if isinstance(t, str) else t, i)
+            for i, t in enumerate(transports)]
+        labels = [h.label for h in self.hosts]
+        if len(set(labels)) != len(labels):
+            raise ValueError(
+                f"ToaRouter: duplicate host endpoints: {labels}")
+        if retry_max is None:
+            retry_max = config.router_retry_max
+        self.retry_max = max(1, int(retry_max))
+        self.quiet = quiet
+        self.tracer, self._own_tracer = resolve_tracer(telemetry,
+                                                       run="pproute")
+        self._lock = threading.Lock()
+        self._affinity = {}  # abspath(modelfile) -> _Host
+        self._closed = False
+        if self.tracer.enabled:
+            self.tracer.emit("router_start", n_hosts=len(self.hosts),
+                             hosts=labels,
+                             retry_max=self.retry_max)
+
+    # ------------------------------------------------------------------
+    # placement
+    # ------------------------------------------------------------------
+
+    def _rank(self, modelfile, n_archives):
+        """Hosts to try, best first: the affinity host for this
+        template leads while placing there would not leave it strictly
+        more loaded than the least-loaded alternative; then
+        least-loaded order.  The stat() RPCs run OUTSIDE the router
+        lock — a hung host must stall only its own probe (until the
+        transport's socket timeout), never every other thread's
+        submit/result bookkeeping — so the loads are a snapshot; the
+        lock guards only the affinity read."""
+        loads = {h: h.load() for h in self.hosts}
+        if not loads:
+            return [], False
+        by_load = sorted(loads, key=lambda h: (loads[h], h.index))
+        with self._lock:
+            aff = self._affinity.get(modelfile)
+        if aff is not None and aff in loads and by_load[0] is not aff \
+                and loads[aff] - loads[by_load[0]] < n_archives:
+            by_load.remove(aff)
+            by_load.insert(0, aff)
+            return by_load, True
+        return by_load, aff is not None and by_load[0] is aff
+
+    def submit(self, datafiles, modelfile, tim_out=None, name=None,
+               **options):
+        """Place one request on the fleet (thread-safe); returns a
+        :class:`RouteHandle`.  Retries retryable backpressure and
+        unreachable hosts up to ``retry_max`` placements with capped
+        exponential backoff between full fleet passes; raises the last
+        failure when the budget is exhausted, and terminal
+        ``ServeRejected`` (retryable=False) immediately."""
+        from ..pipeline.toas import _is_metafile, _read_metafile
+
+        if self._closed:
+            raise RuntimeError("ToaRouter is closed")
+        if isinstance(datafiles, str):
+            datafiles = (_read_metafile(datafiles)
+                         if _is_metafile(datafiles) else [datafiles])
+        datafiles = list(datafiles)
+        n_archives = len(datafiles)
+        mkey = os.path.abspath(str(modelfile))
+        attempt = 0
+        backoff = ROUTER_BACKOFF_BASE_S
+        last_err = None
+        while attempt < self.retry_max:
+            ranked, sticky = self._rank(mkey, n_archives)
+            if not ranked:
+                raise RuntimeError("ToaRouter: no reachable hosts")
+            for host in ranked:
+                if attempt >= self.retry_max:
+                    break
+                attempt += 1
+                t0 = time.monotonic()
+                try:
+                    handle = host.transport.submit(
+                        datafiles, modelfile, tim_out=tim_out,
+                        name=name, options=options)
+                except ServeRejected as e:
+                    if not e.retryable:
+                        raise  # could never fit anywhere: caller's bug
+                    last_err = e
+                except TransportError as e:
+                    last_err = e
+                else:
+                    with self._lock:
+                        host.outstanding += n_archives
+                        host.n_requests += 1
+                        host.n_archives += n_archives
+                        self._affinity[mkey] = host
+                    rh = RouteHandle(self, host, handle,
+                                     name if name is not None
+                                     else getattr(handle, "name", None),
+                                     n_archives, t0)
+                    if self.tracer.enabled:
+                        self.tracer.emit(
+                            "route_submit", req=rh.name,
+                            host=host.label, n_archives=n_archives,
+                            attempt=attempt,
+                            affinity=bool(sticky
+                                          and host is ranked[0]))
+                    return rh
+                if self.tracer.enabled:
+                    self.tracer.emit(
+                        "route_retry", req=name, host=host.label,
+                        attempt=attempt,
+                        backoff_s=round(backoff, 4),
+                        error=str(last_err))
+                sticky = False  # a rejecting affinity host lost its turn
+            # a full pass over the fleet found no room: back off so the
+            # warm loops can drain, then re-rank (loads have moved)
+            if attempt < self.retry_max:
+                time.sleep(backoff)
+                backoff = min(backoff * 2.0, ROUTER_BACKOFF_CAP_S)
+        raise last_err if last_err is not None else RuntimeError(
+            "ToaRouter: submit failed with no recorded error")
+
+    # blocking conveniences mirroring serve.ToaClient -----------------
+
+    def get_TOAs(self, datafiles, modelfile, timeout=None,
+                 tim_out=None, name=None, **options):
+        """Submit and wait (the one-shot driver's return shape)."""
+        return self.submit(datafiles, modelfile, tim_out=tim_out,
+                           name=name, **options).result(timeout)
+
+    def map(self, specs, timeout=None, return_errors=False):
+        """Submit many, then wait for all, in spec order.  specs:
+        (datafiles, modelfile[, kwargs]) tuples.  With
+        return_errors=True a failed request's exception object takes
+        its slot instead of poisoning the batch (siblings still
+        return); default re-raises the first failure AFTER every
+        sibling resolved, so one bad request never strands the rest
+        (serve.client.collect_results — the same contract as
+        ToaClient.map)."""
+        from .client import collect_results
+
+        handles = [self.submit(s[0], s[1],
+                               **(dict(s[2]) if len(s) > 2 else {}))
+                   for s in specs]
+        return collect_results(handles, timeout, return_errors)
+
+    # ------------------------------------------------------------------
+    # completion accounting (RouteHandle calls back)
+    # ------------------------------------------------------------------
+
+    def _collected(self, rh, result=None, error=None):
+        with self._lock:
+            if rh._collected:
+                return
+            rh._collected = True
+            rh.host.outstanding = max(
+                0, rh.host.outstanding - rh.n_archives)
+        if self.tracer.enabled:
+            self.tracer.emit(
+                "route_done", req=rh.name, host=rh.host.label,
+                wall_s=round(time.monotonic() - rh._t_submit, 6),
+                n_toas=len(result.TOA_list) if result else 0,
+                error=str(error) if error else None)
+
+    # ------------------------------------------------------------------
+
+    def stats(self):
+        """Per-host placement snapshot: {label: {outstanding,
+        n_requests, n_archives}} — what the dryrun witness and tests
+        assert placement against without reading the trace."""
+        with self._lock:
+            return {h.label: {"outstanding": h.outstanding,
+                              "n_requests": h.n_requests,
+                              "n_archives": h.n_archives}
+                    for h in self.hosts}
+
+    def close(self):
+        """Close every transport (idempotent).  The router never owns
+        the remote servers — a fleet outlives its clients — so this
+        releases connections only."""
+        if self._closed:
+            return
+        self._closed = True
+        for h in self.hosts:
+            try:
+                h.transport.close()
+            except Exception:
+                pass
+        if self._own_tracer:
+            self.tracer.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

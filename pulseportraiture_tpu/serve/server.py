@@ -166,6 +166,17 @@ class ToaServer:
                              n_archives=len(req.datafiles))
         return req
 
+    def stats(self):
+        """Load snapshot (thread-safe): pending_archives is the
+        admission queue's in-ARCHIVES depth (submitted, not yet
+        prepared — the backpressure bound), queue_len the queued
+        request count, n_live the admitted-but-unresolved requests.
+        This is the signal the cross-host router's least-loaded
+        placement and the transport ``stat`` op read."""
+        return {"pending_archives": self.queue.pending_archives,
+                "queue_len": len(self.queue),
+                "n_live": len(self._live)}
+
     def start(self):
         """Run the optional AOT warmup, then start the serving thread.
         Returns self (usable as ``with ToaServer(...).start() as s:``

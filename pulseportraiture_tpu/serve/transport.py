@@ -1,0 +1,570 @@
+"""Remote transport for the TOA service: the ToaClient surface over a
+wire (ISSUE 10 tentpole, first half).
+
+The per-host serving loop (serve/server.ToaServer) is already the
+right scale-out unit — pulsar archives are embarrassingly parallel and
+a campaign's bottleneck is the per-host host->device link, which
+MULTIPLIES when archives shard across hosts.  What was missing is a
+way to reach a warm server that lives in another process: this module
+wraps the in-process client surface (submit / result / stat / drain)
+behind a minimal length-prefixed JSON-over-socket protocol so a router
+(serve/router.ToaRouter) can own a fleet of hosts.
+
+Design constraints, in order:
+
+- **No bulk data on the wire.**  Requests name archive paths that are
+  host-visible (shared filesystem — the same assumption the multihost
+  campaign drivers make), and each request's ``.tim`` is written BY
+  THE SERVING HOST through the server's existing demux, so it stays
+  byte-identical to the one-shot driver no matter which host served
+  it.  Only the request spec and the per-TOA result records cross the
+  socket.
+- **Backpressure crosses the wire intact.**  A remote
+  ``ServeRejected`` arrives with its ``retryable`` flag, so the
+  router's retry policy cannot tell (and need not care) whether a
+  host is local or remote.
+- **One protocol, two transports.**  ``InProcTransport`` wraps a local
+  ToaServer through the SAME encode/decode path as the socket lane
+  (results round-trip the codec), so tests and the emulated-host
+  benchmark exercise exactly what a real fleet runs, minus the TCP
+  bytes.
+
+Wire protocol (SocketTransport <-> TransportServer): every frame is a
+4-byte big-endian length followed by a UTF-8 JSON object.  Ops:
+
+  {"op": "submit", "datafiles": [...], "modelfile": m,
+   "tim_out": p|null, "name": n|null, "options": {...}}
+      -> {"ok": true, "handle": k}
+      -> {"ok": false, "error": msg, "rejected": true,
+          "retryable": bool}                 (ServeRejected)
+      -> {"ok": false, "error": msg}        (anything else)
+  {"op": "result", "handle": k, "wait": seconds}
+      -> {"ok": true, "done": false}        (poll again)
+      -> {"ok": true, "done": true, "result": {...}}
+      -> {"ok": false, "error": msg, "etype": "TypeError", ...}
+  {"op": "stat"}
+      -> {"ok": true, "pending_archives": n, "queue_len": n,
+          "n_live": n}
+  {"op": "drain"}
+      -> {"ok": true, "n_done": n}          (this connection's handles
+                                             all resolved)
+
+``result`` is a POLL (the server blocks at most ``wait`` seconds per
+frame), so one connection can interleave submits while earlier
+requests are still in flight — a blocking result would serialize the
+router's whole fleet behind one slow request.
+"""
+
+import json
+import socket
+import struct
+import threading
+
+import numpy as np
+
+from ..telemetry import log
+from ..utils.bunch import DataBunch
+from .queue import ServeRejected
+
+__all__ = ["TransportError", "RemoteRequestError", "InProcTransport",
+           "SocketTransport", "TransportServer", "parse_hostport",
+           "encode_result", "decode_result"]
+
+# A frame above this is a protocol violation, not a big request: the
+# largest legitimate payload is a result frame (~200 bytes per TOA).
+MAX_FRAME = 256 * 1024 * 1024
+# Per-poll server-side block in the result op; the client loops.
+RESULT_POLL_S = 0.25
+# Per-round-trip server-side block in the drain op — must stay well
+# below the client's socket timeout or a long drain would poison the
+# connection; the client loops until nothing is pending.
+DRAIN_CHUNK_S = 5.0
+
+
+class TransportError(ConnectionError):
+    """The transport itself failed (connection refused/reset, protocol
+    violation) — distinct from a request-level failure, which arrives
+    as the request's own error.  The router treats a TransportError as
+    'this host is unreachable': it places elsewhere."""
+
+
+class RemoteRequestError(RuntimeError):
+    """A request failed ON THE SERVING HOST; ``etype`` names the
+    original exception class (the object itself stayed remote)."""
+
+    def __init__(self, msg, etype="Exception"):
+        super().__init__(msg)
+        self.etype = str(etype)
+
+
+def parse_hostport(spec):
+    """'host:port' -> (host, port); the strict parse lives in config
+    (shared with the PPT_ROUTER_HOSTS / PPT_SERVE_LISTEN env hooks)."""
+    from ..config import parse_hostport as _parse
+
+    return _parse(spec)
+
+
+# ---------------------------------------------------------------------------
+# result codec: the per-request DataBunch <-> JSON-safe dicts
+# ---------------------------------------------------------------------------
+
+def _flag_value(v):
+    """Narrow a flag value to what JSON round-trips: the
+    bool/int/float/str distinction matters downstream (.tim
+    formatting branches on it), and numpy scalars (incl. np.bool_,
+    which json.dumps rejects outright) must narrow to the builtin."""
+    import numbers
+
+    if isinstance(v, (bool, np.bool_)):
+        return bool(v)
+    if isinstance(v, numbers.Integral):
+        return int(v)
+    if isinstance(v, numbers.Real):
+        return float(v)
+    return v
+
+
+def _encode_toa(t):
+    # MJD ships as (int day, float64 frac) — json round-trips float64
+    # by shortest-repr exactly, so epoch precision survives the wire
+    return {"archive": t.archive, "frequency": float(t.frequency),
+            "mjd": [int(t.MJD.day), float(t.MJD.frac)],
+            "toa_error": float(t.TOA_error), "telescope": t.telescope,
+            "telescope_code": t.telescope_code,
+            "dm": None if t.DM is None else float(t.DM),
+            "dm_error": (None if t.DM_error is None
+                         else float(t.DM_error)),
+            "flags": {k: _flag_value(v) for k, v in t.flags.items()}}
+
+
+def _decode_toa(d):
+    from ..io.tim import TOA
+    from ..utils.mjd import MJD
+
+    day, frac = d["mjd"]
+    return TOA(d["archive"], d["frequency"], MJD(int(day), float(frac)),
+               d["toa_error"], d["telescope"], d["telescope_code"],
+               DM=d["dm"], DM_error=d["dm_error"], flags=d["flags"])
+
+
+def encode_result(res):
+    """Per-request DataBunch (serve/server._maybe_complete's shape) ->
+    a JSON-safe dict."""
+    return {"toas": [_encode_toa(t) for t in res.TOA_list],
+            "order": list(res.order),
+            "DM0s": [None if v is None else float(v)
+                     for v in res.DM0s],
+            "DeltaDM_means": [float(v) for v in res.DeltaDM_means],
+            "DeltaDM_errs": [float(v) for v in res.DeltaDM_errs],
+            "tim_out": res.tim_out, "n_skipped": int(res.n_skipped)}
+
+
+def decode_result(d):
+    return DataBunch(TOA_list=[_decode_toa(t) for t in d["toas"]],
+                     order=list(d["order"]), DM0s=list(d["DM0s"]),
+                     DeltaDM_means=list(d["DeltaDM_means"]),
+                     DeltaDM_errs=list(d["DeltaDM_errs"]),
+                     tim_out=d["tim_out"],
+                     n_skipped=int(d["n_skipped"]))
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+def _send_frame(sock, obj):
+    body = json.dumps(obj, separators=(",", ":")).encode()
+    sock.sendall(struct.pack(">I", len(body)) + body)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise TransportError("connection closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock):
+    head = _recv_exact(sock, 4)
+    (n,) = struct.unpack(">I", head)
+    if n > MAX_FRAME:
+        raise TransportError(f"frame of {n} bytes exceeds the "
+                             f"{MAX_FRAME}-byte protocol limit")
+    return json.loads(_recv_exact(sock, n).decode())
+
+
+# ---------------------------------------------------------------------------
+# transports (the client side the router holds)
+# ---------------------------------------------------------------------------
+
+class InProcTransport:
+    """The ToaClient surface against a ToaServer in THIS process,
+    through the same result codec as the socket lane — what tests, the
+    emulated-host benchmark, and the dryrun witness route over."""
+
+    def __init__(self, server, label=None):
+        self.server = server
+        self.label = str(label) if label is not None else \
+            f"inproc:{id(server):x}"
+        self._handles = []
+        self._lock = threading.Lock()
+
+    def submit(self, datafiles, modelfile, tim_out=None, name=None,
+               options=None):
+        req = self.server.submit(datafiles, modelfile, tim_out=tim_out,
+                                 name=name, **dict(options or {}))
+        with self._lock:
+            self._handles.append(req)
+        return req
+
+    def result(self, handle, timeout=None):
+        try:
+            res = handle.result(timeout)
+        except TimeoutError:
+            raise  # still outstanding: keep it in the drain set
+        except Exception:
+            self._evict(handle)
+            raise
+        self._evict(handle)
+        # round-trip the codec so both transports return IDENTICAL
+        # result shapes (and the codec is exercised wherever the
+        # router is) — the bytes never leave the process
+        return decode_result(json.loads(
+            json.dumps(encode_result(res), separators=(",", ":"))))
+
+    def _evict(self, handle):
+        # collect-once, like the socket lane's per-connection handle
+        # table: a collected request must not pin its result
+        with self._lock:
+            try:
+                self._handles.remove(handle)
+            except ValueError:
+                pass
+
+    def stat(self):
+        return self.server.stats()
+
+    def drain(self, timeout=None):
+        """Wait for the not-yet-collected requests submitted through
+        this transport; returns how many of them resolved.
+        ``timeout`` is a TOTAL deadline (the socket lane's
+        semantics), not a per-handle wait."""
+        import time
+
+        deadline = None if timeout is None \
+            else time.monotonic() + float(timeout)
+        with self._lock:
+            handles = list(self._handles)
+        n = 0
+        for h in handles:
+            left = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            if h.wait(left):
+                n += 1
+        return n
+
+    def close(self):
+        pass
+
+
+class SocketTransport:
+    """The ToaClient surface against a ``ppserve --listen`` host.
+
+    One TCP connection per transport; a lock serializes frames so the
+    router may call it from many threads.  ``result`` polls (bounded
+    server-side waits), so a slow request never wedges the connection
+    for sibling submits."""
+
+    def __init__(self, address, timeout=30.0):
+        self.host, self.port = parse_hostport(address)
+        self.label = f"{self.host}:{self.port}"
+        self._lock = threading.Lock()
+        self._io_timeout = float(timeout)
+        try:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self._io_timeout)
+            self._sock.setsockopt(socket.IPPROTO_TCP,
+                                  socket.TCP_NODELAY, 1)
+        except OSError as e:
+            raise TransportError(
+                f"cannot reach ppserve at {self.label}: {e}")
+
+    def _call(self, msg):
+        with self._lock:
+            if self._sock is None:
+                raise TransportError(
+                    f"transport to {self.label} is closed (a prior "
+                    "I/O failure poisoned the connection)")
+            try:
+                _send_frame(self._sock, msg)
+                reply = _recv_frame(self._sock)
+            except (TransportError, OSError, ValueError) as e:
+                # the request/reply framing is now ambiguous (a late
+                # reply to THIS op would be read as the next op's) —
+                # close the socket so every subsequent op fails loudly
+                # instead of desynchronizing
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+                if isinstance(e, TransportError):
+                    raise
+                raise TransportError(
+                    f"transport to {self.label} failed: {e}")
+        if not isinstance(reply, dict):
+            raise TransportError(
+                f"malformed reply from {self.label}: {reply!r}")
+        return reply
+
+    def submit(self, datafiles, modelfile, tim_out=None, name=None,
+               options=None):
+        reply = self._call({"op": "submit",
+                            "datafiles": list(datafiles)
+                            if not isinstance(datafiles, str)
+                            else datafiles,
+                            "modelfile": str(modelfile),
+                            "tim_out": tim_out, "name": name,
+                            "options": dict(options or {})})
+        if reply.get("ok"):
+            return reply["handle"]
+        if reply.get("rejected"):
+            # the remote admission queue's backpressure, flag intact
+            raise ServeRejected(reply.get("error", "rejected"),
+                                retryable=bool(reply.get("retryable")))
+        raise RemoteRequestError(reply.get("error", "submit failed"),
+                                 etype=reply.get("etype", "Exception"))
+
+    def result(self, handle, timeout=None):
+        import time
+
+        deadline = None if timeout is None \
+            else time.monotonic() + float(timeout)
+        while True:
+            wait = RESULT_POLL_S if deadline is None else \
+                max(0.0, min(RESULT_POLL_S, deadline - time.monotonic()))
+            reply = self._call({"op": "result", "handle": handle,
+                                "wait": wait})
+            if not reply.get("ok"):
+                raise RemoteRequestError(
+                    reply.get("error", "request failed"),
+                    etype=reply.get("etype", "Exception"))
+            if reply.get("done"):
+                return decode_result(reply["result"])
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"no result from {self.label} within {timeout} s")
+
+    def stat(self):
+        reply = self._call({"op": "stat"})
+        if not reply.get("ok"):
+            raise TransportError(
+                f"stat on {self.label} failed: {reply.get('error')}")
+        return {k: reply[k] for k in ("pending_archives", "queue_len",
+                                      "n_live")}
+
+    def drain(self, timeout=None):
+        """Wait for this connection's outstanding requests.  The
+        server bounds each reply below the socket timeout and reports
+        how many are still pending; the client loops until done or
+        ``timeout`` expires (returns the resolved count either
+        way)."""
+        import time
+
+        deadline = None if timeout is None \
+            else time.monotonic() + float(timeout)
+        while True:
+            wait = DRAIN_CHUNK_S if deadline is None else \
+                max(0.0, min(DRAIN_CHUNK_S,
+                             deadline - time.monotonic()))
+            reply = self._call({"op": "drain", "timeout": wait})
+            if not reply.get("ok"):
+                raise TransportError(
+                    f"drain on {self.label} failed: "
+                    f"{reply.get('error')}")
+            n_done = int(reply.get("n_done", 0))
+            if not reply.get("pending") or (
+                    deadline is not None
+                    and time.monotonic() >= deadline):
+                return n_done
+
+    def close(self):
+        if self._sock is None:
+            return
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._sock = None
+
+
+# ---------------------------------------------------------------------------
+# the listener (``ppserve --listen`` wraps this around its ToaServer)
+# ---------------------------------------------------------------------------
+
+class TransportServer:
+    """Accept loop exposing one local ToaServer to SocketTransports.
+
+    One daemon thread per connection; per-connection handle tables (a
+    dropped client's requests still run to completion server-side —
+    their .tim files are the durable artifact, exactly the campaign
+    drivers' crash stance).  Request-level failures reply as errors on
+    that handle; only protocol violations drop the connection."""
+
+    def __init__(self, server, host="127.0.0.1", port=0, quiet=True):
+        self.server = server
+        self.quiet = quiet
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(16)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self.label = f"{self.host}:{self.port}"
+        self._closing = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="ppt-listen", daemon=True)
+
+    def start(self):
+        self._accept_thread.start()
+        log(f"ppserve: listening on {self.label}", quiet=self.quiet,
+            tracer=None)
+        return self
+
+    def _accept_loop(self):
+        while not self._closing.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                return  # close() shut the listening socket
+            threading.Thread(target=self._serve_conn, args=(conn, addr),
+                             name="ppt-conn", daemon=True).start()
+
+    def _serve_conn(self, conn, addr):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        handles = {}
+        next_id = 0
+        try:
+            while True:
+                try:
+                    msg = _recv_frame(conn)
+                except TransportError:
+                    return  # client went away (normal teardown)
+                op = msg.get("op") if isinstance(msg, dict) else None
+                if op == "submit":
+                    try:
+                        req = self.server.submit(
+                            msg["datafiles"], msg["modelfile"],
+                            tim_out=msg.get("tim_out"),
+                            name=msg.get("name"),
+                            **dict(msg.get("options") or {}))
+                    except ServeRejected as e:
+                        _send_frame(conn, {
+                            "ok": False, "error": str(e),
+                            "rejected": True,
+                            "retryable": bool(e.retryable)})
+                    except Exception as e:
+                        _send_frame(conn, {
+                            "ok": False, "error": str(e),
+                            "etype": type(e).__name__})
+                    else:
+                        handles[next_id] = req
+                        _send_frame(conn, {"ok": True,
+                                           "handle": next_id})
+                        next_id += 1
+                elif op == "result":
+                    req = handles.get(msg.get("handle"))
+                    if req is None:
+                        _send_frame(conn, {
+                            "ok": False,
+                            "error": f"unknown handle "
+                                     f"{msg.get('handle')!r} on this "
+                                     "connection (already collected, "
+                                     "or never submitted here)",
+                            "etype": "KeyError"})
+                        continue
+                    wait = min(max(float(msg.get("wait", 0.0)), 0.0),
+                               30.0)
+                    if not req.wait(wait):
+                        _send_frame(conn, {"ok": True, "done": False})
+                        continue
+                    # collect-once: evict the resolved request so a
+                    # long-lived fleet connection stays O(outstanding)
+                    # — a retained handle would pin its whole result
+                    # DataBunch for the connection's lifetime
+                    del handles[msg["handle"]]
+                    try:
+                        res = req.result(0)
+                    except Exception as e:
+                        _send_frame(conn, {
+                            "ok": False, "error": str(e),
+                            "etype": type(e).__name__})
+                    else:
+                        _send_frame(conn, {"ok": True, "done": True,
+                                           "result":
+                                               encode_result(res)})
+                elif op == "stat":
+                    st = self.server.stats()
+                    _send_frame(conn, {"ok": True, **st})
+                elif op == "drain":
+                    # bounded: reply well under the client's socket
+                    # timeout with the still-pending count; the
+                    # client loops (SocketTransport.drain)
+                    import time as _time
+
+                    t_req = msg.get("timeout")
+                    # an explicit 0.0 is a non-blocking "how many are
+                    # done" probe — only None falls back to the chunk
+                    budget = DRAIN_CHUNK_S if t_req is None else \
+                        min(max(float(t_req), 0.0), DRAIN_CHUNK_S)
+                    t_end = _time.monotonic() + budget
+                    pending = len(handles)
+                    for req in list(handles.values()):
+                        if req.wait(max(0.0,
+                                        t_end - _time.monotonic())):
+                            pending -= 1
+                    _send_frame(conn, {
+                        "ok": True,
+                        "n_done": len(handles) - pending,
+                        "pending": pending})
+                else:
+                    _send_frame(conn, {
+                        "ok": False,
+                        "error": f"unknown op {op!r} (protocol "
+                                 "mismatch? known ops: submit, "
+                                 "result, stat, drain)"})
+        except OSError:
+            pass  # peer reset mid-reply
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self._closing.set()
+        # shutdown() wakes the thread blocked in accept() — a bare
+        # close() leaves the kernel listener alive behind the blocked
+        # syscall, still accepting connections for a dead server
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._accept_thread.is_alive():
+            self._accept_thread.join(1.0)
+
+    def __enter__(self):
+        if not self._accept_thread.is_alive():
+            self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

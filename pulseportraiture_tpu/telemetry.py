@@ -62,6 +62,7 @@ CONFIG_SNAPSHOT_KEYS = (
     "stream_devices", "stream_max_inflight", "stream_pipeline_depth",
     "compile_cache_dir", "telemetry_path",
     "serve_max_wait_ms", "serve_queue_depth", "bucket_pad",
+    "router_hosts", "router_retry_max", "serve_listen",
     "use_fast_fit", "use_matmul_dft", "fit_harmonic_window",
     "scatter_compensated",
 )
@@ -114,6 +115,20 @@ EVENT_FIELDS = {
     # AOT warmup (utils/device.warmup_from_manifest): one per
     # (manifest shape x device) compiled before serving started
     "warmup_compile": {"shape", "device", "compile_s"},
+    # the cross-host router (serve/router.ToaRouter): router_start
+    # once per router; route_submit per PLACED request (host = the
+    # endpoint that accepted it, attempt counts placements tried,
+    # affinity marks a sticky-template win); route_retry per rejected
+    # placement (backpressure or unreachable host) with the backoff
+    # then applied; route_done when the client collected the result
+    # (error non-null on a failed request).  The "router" report
+    # section aggregates per-host shares, retry rate, and the
+    # placement-imbalance metric from exactly these.
+    "router_start": {"n_hosts", "hosts", "retry_max"},
+    "route_submit": {"req", "host", "n_archives", "attempt",
+                     "affinity"},
+    "route_retry": {"req", "host", "attempt", "backoff_s", "error"},
+    "route_done": {"req", "host", "wall_s", "n_toas", "error"},
     # the template factory (pipeline/factory.build_templates): one
     # template_fit per bucket dispatch — stage 'profile'|'portrait',
     # the bucket's shape key, rows (real problems), pad (padded rows:
@@ -687,6 +702,76 @@ def report(path, file=None):
             p(f"  AOT warmup: {len(warmups)} (shape x device) "
               f"program(s) compiled in {w_s:.3f} s before serving")
 
+    # ---- router (cross-host request sharding) -----------------------
+    r_starts = by_type.get("router_start", [])
+    r_sub = by_type.get("route_submit", [])
+    r_retry = by_type.get("route_retry", [])
+    r_done = by_type.get("route_done", [])
+    router_imbalance = None
+    router_host_counts = {}
+    if r_starts or r_sub or r_retry or r_done:
+        p("")
+        p("-- router (cross-host request sharding) --")
+        n_hosts = max((ev["n_hosts"] for ev in r_starts), default=0)
+        per_host = {}
+        for ev in r_sub:
+            d = per_host.setdefault(ev["host"],
+                                    {"requests": 0, "archives": 0,
+                                     "affinity": 0})
+            d["requests"] += 1
+            d["archives"] += int(ev["n_archives"])
+            d["affinity"] += bool(ev.get("affinity"))
+        done_by_host = {}
+        err_by_host = {}
+        for ev in r_done:
+            done_by_host[ev["host"]] = \
+                done_by_host.get(ev["host"], 0) + 1
+            if ev.get("error"):
+                err_by_host[ev["host"]] = \
+                    err_by_host.get(ev["host"], 0) + 1
+        tot_req = sum(d["requests"] for d in per_host.values())
+        tot_arch = sum(d["archives"] for d in per_host.values())
+        if r_starts:
+            p(f"  fleet: {n_hosts} host(s), retry_max "
+              f"{max(ev['retry_max'] for ev in r_starts)}")
+        if per_host:
+            p(f"  {'host':>24} {'requests':>9} {'archives':>9} "
+              f"{'arch%':>6} {'affinity':>9} {'done':>5} {'errors':>7}")
+            for host in sorted(per_host):
+                d = per_host[host]
+                share = d["archives"] / max(tot_arch, 1)
+                p(f"  {host:>24} {d['requests']:>9} "
+                  f"{d['archives']:>9} {100 * share:>5.1f}% "
+                  f"{d['affinity']:>9} {done_by_host.get(host, 0):>5} "
+                  f"{err_by_host.get(host, 0):>7}")
+                router_host_counts[host] = d["archives"]
+            # placement imbalance: max per-host archive share over the
+            # ideal even share (1.0 = perfectly balanced; H = all work
+            # on one of H hosts).  Computed over hosts that RECEIVED
+            # work against the router_start fleet size, so an idle
+            # host drags the metric up — that is the point.
+            denom = max(n_hosts, len(per_host))
+            even = tot_arch / max(denom, 1)
+            router_imbalance = (max(d["archives"]
+                                    for d in per_host.values())
+                                / max(even, 1e-9))
+            p(f"  placement imbalance (max host share / even share): "
+              f"{router_imbalance:.2f} (1.0 = balanced over "
+              f"{denom} host(s))")
+        if r_sub or r_retry:
+            rate = len(r_retry) / max(len(r_sub) + len(r_retry), 1)
+            p(f"  {len(r_sub)} placement(s), {len(r_retry)} "
+              f"retried rejection(s) ({100 * rate:.1f}% of "
+              "placement attempts); backpressure retries land on the "
+              "next-least-loaded host")
+        if r_done:
+            walls = np.asarray([ev["wall_s"] for ev in r_done], float)
+            n_err = sum(1 for ev in r_done if ev.get("error"))
+            p(f"  {len(r_done)}/{tot_req or len(r_done)} request(s) "
+              f"collected ({n_err} failed); routed latency p50 "
+              f"{float(np.percentile(walls, 50)):.3f} s  p99 "
+              f"{float(np.percentile(walls, 99)):.3f} s")
+
     # ---- template factory (batched Gaussian/spline model building) --
     tfit = by_type.get("template_fit", [])
     tjobs = by_type.get("template_job", [])
@@ -779,6 +864,11 @@ def report(path, file=None):
         "n_coalesce": len(coalesce),
         "batch_occupancy": occupancy,
         "n_warmup": len(warmups),
+        "n_route_submit": len(r_sub),
+        "n_route_retry": len(r_retry),
+        "n_route_done": len(r_done),
+        "router_imbalance": router_imbalance,
+        "router_host_counts": router_host_counts,
         "n_template_fit": len(tfit),
         "n_template_jobs": len(tjobs),
         "template_pad_frac": template_pad_frac,

@@ -15,6 +15,7 @@ setup(
         "console_scripts": [
             "pptoas=pulseportraiture_tpu.cli.pptoas:main",
             "ppserve=pulseportraiture_tpu.cli.ppserve:main",
+            "pproute=pulseportraiture_tpu.cli.pproute:main",
             "ppalign=pulseportraiture_tpu.cli.ppalign:main",
             "ppgauss=pulseportraiture_tpu.cli.ppgauss:main",
             "ppfactory=pulseportraiture_tpu.cli.ppfactory:main",

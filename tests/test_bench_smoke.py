@@ -63,6 +63,15 @@ TINY_ENV = {
                     # + batch_coalesce occupancy; the emitted trace
                     # must validate so serve-event drift fails in CI
                     "PPT_TELEMETRY": ""},
+    # ISSUE 10: the 1->2 emulated-host router sweep — placement,
+    # retry ledger, and per-request .tim identity vs the one-shot
+    # references all assert inside the bench; the traces are
+    # re-validated here so route-event drift fails in CI (the 1.8x
+    # link-scaling gate belongs to real PPT_TUNNEL_EMU bench runs)
+    "bench_router": {"PPT_NARCH": "2", "PPT_NSUB": "2",
+                     "PPT_NCHAN": "16", "PPT_NBIN": "128",
+                     "PPT_NREQ": "2", "PPT_NHOSTS": "2",
+                     "PPT_CAMPAIGN_CACHE": "", "PPT_TELEMETRY": ""},
 }
 
 _CONFIG_KEYS = ("dft_precision", "cross_spectrum_dtype", "dft_fold",
@@ -156,6 +165,41 @@ def test_bench_smoke(name, monkeypatch, capsys, tmp_path):
                 assert needed in etypes, needed
             done = [e for e in events if e["type"] == "request_done"]
             assert len(done) == int(conc)
+    if name == "bench_router":
+        # ISSUE 10: both fleet sizes must report, per-request .tim
+        # output must be byte-identical to the one-shot references,
+        # requests must land on BOTH emulated hosts at H=2, and the
+        # routing traces must schema-validate with the route ledger
+        assert out["tim_identical"] is True
+        assert out["oneshot_toas_per_sec"] > 0
+        assert out["router_speedup"] > 0
+        assert [a["hosts"] for a in out["sweep"]] == [1, 2]
+        for arm in out["sweep"]:
+            assert arm["toas_per_sec"] > 0
+            assert arm["n_toas"] == out["toas"]
+            assert arm["router_imbalance"] is not None
+        two = out["sweep"][-1]
+        assert len(two["placement"]) == 2, (
+            f"requests did not shard across both hosts: "
+            f"{two['placement']}")
+        assert sum(two["placement"].values()) == 2  # archives total
+        from pulseportraiture_tpu import telemetry
+
+        for H in ("1", "2"):
+            trace = str(tmp_path / "trace.jsonl") + f".h{H}"
+            assert os.path.exists(trace), f"no h{H} trace"
+            manifest, events = telemetry.validate_trace(trace)
+            assert manifest["run"] == "pproute"
+            etypes = {e["type"] for e in events}
+            for needed in ("router_start", "route_submit",
+                           "route_done"):
+                assert needed in etypes, needed
+            done = [e for e in events if e["type"] == "route_done"]
+            assert len(done) == 2
+            assert all(e["error"] is None for e in done)
+            hosts = {e["host"]
+                     for e in events if e["type"] == "route_submit"}
+            assert len(hosts) == int(H)
     if name == "bench_gauss":
         # ISSUE 9: both A/B arms must report, the in-memory oracle
         # digit gate must HOLD even at tiny shapes (engine drift fails

@@ -11,7 +11,8 @@ import numpy as np
 import pytest
 
 from pulseportraiture_tpu.cli import (ppalign, ppfactory, ppgauss,
-                                      ppserve, ppspline, pptoas, ppzap)
+                                      pproute, ppserve, ppspline,
+                                      pptoas, ppzap)
 from pulseportraiture_tpu.synth import default_test_model, make_fake_pulsar
 from pulseportraiture_tpu.utils.mjd import MJD
 
@@ -247,6 +248,96 @@ def test_ppserve_flag_and_request_validation(tmp_path):
     bad.write_text("")
     with pytest.raises(SystemExit, match="no requests"):
         ppserve.main(["-r", str(bad)])
+
+
+def test_ppserve_listen_and_pproute_validation(tmp_path):
+    """The fleet-mode flags are loud: --listen and -r are mutually
+    exclusive, a bare ppserve needs one of them, endpoints must parse
+    as host:port, and pproute refuses an empty/garbled fleet before
+    touching the network."""
+    import json
+
+    good = tmp_path / "ok.jsonl"
+    good.write_text(json.dumps({"name": "A", "datafiles": ["a.fits"],
+                                "modelfile": "m.gmodel"}) + "\n")
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        ppserve.main(["-r", str(good), "--listen", "127.0.0.1:0"])
+    with pytest.raises(SystemExit, match="need -r"):
+        ppserve.main([])
+    with pytest.raises(SystemExit, match="listen"):
+        ppserve.main(["--listen", "nowhere"])
+    # PPT_SERVE_LISTEN is a default for LISTEN mode only: an explicit
+    # -r on a fleet-profiled host must still run batch mode (here it
+    # proceeds far enough to reject the missing request file, not the
+    # flag combination)
+    from pulseportraiture_tpu import config
+
+    old_listen = config.serve_listen
+    config.serve_listen = "0.0.0.0:9090"
+    try:
+        with pytest.raises(SystemExit, match="not found"):
+            ppserve.main(["-r", str(tmp_path / "missing.jsonl")])
+    finally:
+        config.serve_listen = old_listen
+    with pytest.raises(SystemExit, match="retry-max"):
+        pproute.main(["-r", str(good), "-H", "h:1",
+                      "--retry-max", "0"])
+    with pytest.raises(SystemExit, match="no fleet"):
+        pproute.main(["-r", str(good)])
+    with pytest.raises(SystemExit, match="hosts"):
+        pproute.main(["-r", str(good), "-H", "nodeA"])
+    with pytest.raises(SystemExit, match="not found"):
+        pproute.main(["-r", str(tmp_path / "missing.jsonl"),
+                      "-H", "nodeA:1"])
+    # an unreachable fleet fails loudly at router construction
+    with pytest.raises(SystemExit, match="cannot reach"):
+        pproute.main(["-r", str(good), "-H", "127.0.0.1:9",
+                      "--quiet"])
+
+
+def test_pproute_routes_across_listening_fleet(workspace, tmp_path):
+    """pproute end-to-end (ISSUE 10): two in-process ppserve-style
+    listeners on ephemeral ports, a 2-request JSONL spec routed
+    across them — per-request .tim files byte-identical to the
+    one-shot --stream driver, requests landing on BOTH hosts."""
+    import json
+
+    from pulseportraiture_tpu.io import write_gmodel
+    from pulseportraiture_tpu.pipeline import stream_wideband_TOAs
+    from pulseportraiture_tpu.serve import ToaServer, TransportServer
+
+    root, meta, files = workspace
+    gm = str(tmp_path / "truth.gmodel")
+    write_gmodel(default_test_model(1500.0), gm, quiet=True)
+    refs = {}
+    for name, f in (("R0", files[0]), ("R1", files[1])):
+        tim = tmp_path / f"{name}.ref.tim"
+        stream_wideband_TOAs([f], gm, nsub_batch=8, tim_out=str(tim),
+                             quiet=True)
+        refs[name] = tim.read_bytes()
+    reqfile = tmp_path / "requests.jsonl"
+    reqfile.write_text("".join(
+        json.dumps({"name": name, "datafiles": [f], "modelfile": gm})
+        + "\n" for name, f in (("R0", files[0]), ("R1", files[1]))))
+    outdir = tmp_path / "routed"
+    trace = str(tmp_path / "pproute.jsonl")
+    with ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as s0, \
+            ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as s1:
+        with TransportServer(s0, port=0) as l0, \
+                TransportServer(s1, port=0) as l1:
+            rc = pproute.main([
+                "-r", str(reqfile), "-O", str(outdir),
+                "-H", f"127.0.0.1:{l0.port},127.0.0.1:{l1.port}",
+                "--telemetry", trace, "--quiet"])
+    assert rc == 0
+    for name, ref in refs.items():
+        assert (outdir / f"{name}.tim").read_bytes() == ref
+    from pulseportraiture_tpu import telemetry
+
+    _, events = telemetry.validate_trace(trace)
+    subs = [e for e in events if e["type"] == "route_submit"]
+    assert {e["host"] for e in subs} == {
+        f"127.0.0.1:{l0.port}", f"127.0.0.1:{l1.port}"}
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,420 @@
+"""Cross-host campaign router (ISSUE 10): the ToaRouter must demux
+per-request .tim output byte-identical to the single-host one-shot
+driver over BOTH transports (in-process and socket), shard requests
+across hosts with load-aware placement, keep same-template traffic
+sticky under light load, retry retryable backpressure with the capped
+budget, and record the route ledger pptrace's router section reads."""
+
+import io
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu import config, telemetry
+from pulseportraiture_tpu.io import write_gmodel
+from pulseportraiture_tpu.pipeline import stream_wideband_TOAs
+from pulseportraiture_tpu.serve import (InProcTransport,
+                                        RemoteRequestError, ServeRejected,
+                                        SocketTransport, ToaRouter,
+                                        ToaServer, TransportError,
+                                        TransportServer)
+from pulseportraiture_tpu.serve.transport import (decode_result,
+                                                  encode_result)
+from pulseportraiture_tpu.synth import default_test_model, make_fake_pulsar
+from pulseportraiture_tpu.utils.mjd import MJD
+
+PAR = {"PSR": "J1744-1134", "RAJ": "17:44:29.4", "DECJ": "-11:34:54.6",
+       "P0": 0.004074, "PEPOCH": 55000.0, "DM": 3.139}
+
+
+@pytest.fixture(scope="module")
+def campaign(tmp_path_factory):
+    """A mixed-shape multi-pulsar-ish corpus: 4 archives, two of them
+    at a different channel count so the fleet serves >1 bucket
+    shape."""
+    root = tmp_path_factory.mktemp("router")
+    model = default_test_model(1500.0)
+    gmodel = str(root / "model.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files = []
+    for i in range(4):
+        path = str(root / f"ep{i}.fits")
+        make_fake_pulsar(model, PAR, outfile=path, nsub=2,
+                         nchan=16 if i < 2 else 12, nbin=128,
+                         nu0=1500.0, bw=400.0, tsub=60.0,
+                         phase=0.01 * i, dDM=1e-4,
+                         start_MJD=MJD(55100 + i, 0.1), noise_stds=0.08,
+                         dedispersed=False, quiet=True, rng=200 + i)
+        files.append(path)
+    return files, gmodel
+
+
+def _oneshot_tims(files, gmodel, outdir, slices):
+    refs = []
+    for i, sl in enumerate(slices):
+        tim = os.path.join(str(outdir), f"ref{i}.tim")
+        stream_wideband_TOAs(sl, gmodel, nsub_batch=8,
+                             tim_out=tim, quiet=True)
+        refs.append(open(tim, "rb").read())
+    return refs
+
+
+def test_router_two_hosts_tim_identical_and_balanced(campaign,
+                                                     tmp_path):
+    """The acceptance core, in-process transport: two warm hosts, two
+    mixed-shape requests; the router spreads them (affinity yields to
+    balance), and each demuxed .tim is byte-identical to the one-shot
+    driver regardless of which host served it."""
+    files, gmodel = campaign
+    slices = [files[:2], files[2:]]
+    refs = _oneshot_tims(files, gmodel, tmp_path, slices)
+
+    trace = str(tmp_path / "route.jsonl")
+    with ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h0, \
+            ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h1:
+        router = ToaRouter([InProcTransport(h0, label="hostA"),
+                            InProcTransport(h1, label="hostB")],
+                           telemetry=trace)
+        tims = [str(tmp_path / f"routed{i}.tim") for i in range(2)]
+        handles = [router.submit(sl, gmodel, tim_out=tims[i],
+                                 name=f"R{i}")
+                   for i, sl in enumerate(slices)]
+        results = [h.result(300) for h in handles]
+        stats = router.stats()
+        router.close()
+
+    for i, ref in enumerate(refs):
+        assert open(tims[i], "rb").read() == ref, f"request {i}"
+    assert all(len(r.TOA_list) == 4 for r in results)
+    # both hosts took exactly one request (equal template: affinity
+    # must yield — placing the second request on the first host would
+    # leave it strictly more loaded than the idle one)
+    assert sorted(st["n_requests"] for st in stats.values()) == [1, 1]
+    assert all(st["outstanding"] == 0 for st in stats.values())
+
+    manifest, events = telemetry.validate_trace(trace)
+    subs = [e for e in events if e["type"] == "route_submit"]
+    assert {e["host"] for e in subs} == {"hostA", "hostB"}
+    done = [e for e in events if e["type"] == "route_done"]
+    assert len(done) == 2 and all(e["error"] is None for e in done)
+    summary = telemetry.report(trace, file=io.StringIO())
+    assert summary["n_route_submit"] == 2
+    assert summary["n_route_retry"] == 0
+    assert summary["n_route_done"] == 2
+    assert summary["router_imbalance"] == pytest.approx(1.0)
+    assert sum(summary["router_host_counts"].values()) == 4
+
+
+def test_router_socket_transport_end_to_end(campaign, tmp_path):
+    """The same demux gate over the REAL wire: a ppserve-style
+    listener on an ephemeral port, a SocketTransport in the fleet;
+    .tim bytes and the decoded result survive the protocol, and a
+    request-level failure arrives as RemoteRequestError naming the
+    original exception type."""
+    files, gmodel = campaign
+    refs = _oneshot_tims(files, gmodel, tmp_path, [files[:2]])
+    one = stream_wideband_TOAs(files[:2], gmodel, nsub_batch=8,
+                               quiet=True)
+
+    with ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as srv:
+        with TransportServer(srv, port=0) as listener:
+            transport = SocketTransport(f"127.0.0.1:{listener.port}")
+            router = ToaRouter([transport])
+            tim = str(tmp_path / "sock.tim")
+            res = router.get_TOAs(files[:2], gmodel, timeout=300,
+                                  tim_out=tim, name="S")
+            assert open(tim, "rb").read() == refs[0]
+            assert len(res.TOA_list) == len(one.TOA_list) == 4
+            assert res.order == one.order
+            assert np.allclose(res.DeltaDM_means, one.DeltaDM_means)
+            for ta, tb in zip(one.TOA_list, res.TOA_list):
+                assert (ta.MJD.day, ta.MJD.frac) == \
+                    (tb.MJD.day, tb.MJD.frac)
+                assert ta.flags == tb.flags
+            # stat crosses the wire (the router's placement signal)
+            st = transport.stat()
+            assert st["pending_archives"] == 0 and st["n_live"] == 0
+            # a bad option set fails ITS request with the original
+            # exception type named, and the host keeps serving
+            with pytest.raises(RemoteRequestError,
+                               match="no_such_option") as ei:
+                router.get_TOAs(files[:1], gmodel, timeout=300,
+                                name="bad", no_such_option=True)
+            assert ei.value.etype == "TypeError"
+            again = router.get_TOAs(files[:1], gmodel, timeout=300,
+                                    name="again")
+            assert len(again.TOA_list) == 2
+            # collect-once handle hygiene: collected requests were
+            # EVICTED server-side (a long-lived fleet connection must
+            # stay O(outstanding)), so drain sees nothing pending...
+            assert transport.drain(30) == 0
+            # ...while an uncollected submit is what drain waits on
+            h = transport.submit([files[3]], gmodel, name="drainme")
+            assert transport.drain(60) == 1
+            assert len(transport.result(h, 60).TOA_list) == 2
+            with pytest.raises(RemoteRequestError, match="unknown"):
+                transport.result(h, 1)  # evicted after collection
+            router.close()
+    # a dead endpoint is a TransportError (the router's
+    # host-unreachable signal), not a hang
+    with pytest.raises(TransportError, match="cannot reach"):
+        SocketTransport(f"127.0.0.1:{listener.port}")
+
+
+def test_socket_protocol_violations_reply_loudly(campaign):
+    """Unknown ops and unknown handles get error replies (connection
+    survives); a garbage host spec is a loud ValueError."""
+    files, gmodel = campaign
+    with ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as srv:
+        with TransportServer(srv, port=0) as listener:
+            t = SocketTransport(f"127.0.0.1:{listener.port}")
+            reply = t._call({"op": "frobnicate"})
+            assert not reply["ok"] and "unknown op" in reply["error"]
+            with pytest.raises(RemoteRequestError, match="unknown"):
+                t.result(999, timeout=1)
+            assert t.stat()["queue_len"] == 0  # still alive
+            t.close()
+    for bad in ("nohost", "h:port", "h:99999", ":123"):
+        with pytest.raises(ValueError):
+            config.parse_hostport(bad)
+    with pytest.raises(ValueError, match="no host endpoints"):
+        ToaRouter([])
+
+
+class _FlakyTransport:
+    """Injected backpressure: rejects the first ``n_reject`` submits
+    with ServeRejected(retryable=True), then delegates."""
+
+    def __init__(self, inner, n_reject):
+        self.inner = inner
+        self.label = inner.label
+        self.n_reject = n_reject
+
+    def submit(self, *a, **kw):
+        if self.n_reject > 0:
+            self.n_reject -= 1
+            raise ServeRejected("admission queue full (injected)",
+                                retryable=True)
+        return self.inner.submit(*a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def test_router_backpressure_retry_and_exhaustion(campaign, tmp_path):
+    """Injected ServeRejected backpressure: the router retries on the
+    next-least-loaded host, backs off between full fleet passes, and
+    the demuxed .tim is STILL byte-identical; an all-rejecting fleet
+    exhausts retry_max and raises the last rejection; a terminal
+    rejection raises immediately without consuming the fleet."""
+    files, gmodel = campaign
+    refs = _oneshot_tims(files, gmodel, tmp_path, [files[:2]])
+    trace = str(tmp_path / "retry.jsonl")
+    with ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h0, \
+            ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h1:
+        # BOTH hosts reject once: pass 1 fails entirely, the router
+        # backs off, pass 2 places on the least-loaded host
+        router = ToaRouter(
+            [_FlakyTransport(InProcTransport(h0, label="f0"), 1),
+             _FlakyTransport(InProcTransport(h1, label="f1"), 1)],
+            retry_max=8, telemetry=trace)
+        tim = str(tmp_path / "retried.tim")
+        res = router.submit(files[:2], gmodel, tim_out=tim,
+                            name="RT").result(300)
+        assert len(res.TOA_list) == 4
+        assert open(tim, "rb").read() == refs[0]
+        router.close()
+    _, events = telemetry.validate_trace(trace)
+    retries = [e for e in events if e["type"] == "route_retry"]
+    assert len(retries) == 2
+    assert all(e["backoff_s"] > 0 for e in retries)
+    subs = [e for e in events if e["type"] == "route_submit"]
+    assert len(subs) == 1 and subs[0]["attempt"] == 3
+    summary = telemetry.report(trace, file=io.StringIO())
+    assert summary["n_route_retry"] == 2
+
+    # exhaustion: every host always sheds -> the LAST rejection
+    # surfaces after exactly retry_max placement attempts
+    with ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h0:
+        router = ToaRouter(
+            [_FlakyTransport(InProcTransport(h0, label="x0"), 99)],
+            retry_max=3)
+        with pytest.raises(ServeRejected, match="injected"):
+            router.submit(files[:1], gmodel, name="never")
+        router.close()
+
+        # terminal (retryable=False) rejection: raised immediately
+        router = ToaRouter([InProcTransport(h0, label="t0")])
+        h0.queue.max_pending = 1
+        with pytest.raises(ServeRejected, match="split it"):
+            router.submit(files[:2], gmodel, name="huge")
+        h0.queue.max_pending = 64
+        router.close()
+
+
+def test_router_affinity_sticks_under_light_traffic(campaign,
+                                                    tmp_path):
+    """Light traffic (results collected between submits, loads drain
+    to zero): same-template requests stay on ONE host, so their
+    subints keep coalescing into that host's shared buckets instead
+    of fragmenting across the fleet."""
+    files, gmodel = campaign
+    trace = str(tmp_path / "affinity.jsonl")
+    with ToaServer(nsub_batch=8, max_wait_ms=20, quiet=True) as h0, \
+            ToaServer(nsub_batch=8, max_wait_ms=20, quiet=True) as h1:
+        router = ToaRouter([InProcTransport(h0, label="a0"),
+                            InProcTransport(h1, label="a1")],
+                           telemetry=trace)
+        for i in range(3):
+            router.get_TOAs(files[i:i + 1], gmodel, timeout=300,
+                            name=f"L{i}")
+        stats = router.stats()
+        router.close()
+    placed = [lbl for lbl, st in stats.items() if st["n_requests"]]
+    assert placed == ["a0"], stats  # all three stuck to one host
+    _, events = telemetry.validate_trace(trace)
+    subs = [e for e in events if e["type"] == "route_submit"]
+    assert [e["affinity"] for e in subs] == [False, True, True]
+
+
+def test_router_ipta_campaign_thin_client(campaign, tmp_path):
+    """stream_ipta_campaign(router=) shards per-pulsar requests over
+    the fleet and reproduces the executor-per-pulsar path's .tim
+    files and DeltaDM summaries; server=/router= exclusivity, resume,
+    and executor-kwarg refusals are loud."""
+    from pulseportraiture_tpu.pipeline import stream_ipta_campaign
+
+    files, gmodel = campaign
+    jobs = [("PSRA", files[:2], gmodel), ("PSRB", files[2:], gmodel)]
+    out1, out2 = tmp_path / "solo", tmp_path / "routed"
+    r1 = stream_ipta_campaign(jobs, outdir=str(out1), nsub_batch=8,
+                              quiet=True)
+    with ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h0, \
+            ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h1:
+        router = ToaRouter([InProcTransport(h0, label="i0"),
+                            InProcTransport(h1, label="i1")])
+        r2 = stream_ipta_campaign(jobs, outdir=str(out2), nsub_batch=8,
+                                  quiet=True, router=router)
+        with pytest.raises(ValueError, match="not both"):
+            stream_ipta_campaign(jobs, outdir=str(out2), quiet=True,
+                                 server=h0, router=router)
+        with pytest.raises(ValueError, match="resume"):
+            stream_ipta_campaign(jobs, outdir=str(out2), resume=True,
+                                 quiet=True, router=router)
+        with pytest.raises(ValueError, match="max_inflight"):
+            stream_ipta_campaign(jobs, outdir=str(out2), quiet=True,
+                                 router=router, max_inflight=8)
+        stats = router.stats()
+        router.close()
+    # the two pulsars really sharded across the fleet
+    assert sorted(st["n_requests"] for st in stats.values()) == [1, 1]
+    for psr in ("PSRA", "PSRB"):
+        assert ((out1 / f"{psr}.tim").read_bytes()
+                == (out2 / f"{psr}.tim").read_bytes())
+        m1, e1 = r1.DeltaDM_summary[psr]
+        m2, e2 = r2.DeltaDM_summary[psr]
+        assert np.array_equal(m1, m2) and np.array_equal(e1, e2)
+    assert len(r1.TOA_list) == len(r2.TOA_list) == 8
+
+
+def test_transport_codec_roundtrip():
+    """The result codec preserves everything .tim formatting and the
+    campaign rollups read: MJD (int, f64) exactness, inf frequency,
+    the int/float/str flag trichotomy, numpy scalars narrowed."""
+    from pulseportraiture_tpu.io.tim import TOA, toa_string
+    from pulseportraiture_tpu.utils.bunch import DataBunch
+
+    t = TOA("ep0.fits", np.inf, MJD(55432, 0.9876543210987654), 1.25,
+            "GBT", "1", DM=3.139, DM_error=0.0123,
+            flags={"subint": np.int64(3), "snr": np.float32(12.5),
+                   "be": "GUPPI", "chi2": 1.0625})
+    res = DataBunch(TOA_list=[t], order=["ep0.fits"], DM0s=[3.139],
+                    DeltaDM_means=[1e-4], DeltaDM_errs=[2e-5],
+                    tim_out=None, n_skipped=0)
+    wire = json.dumps(encode_result(res))
+    back = decode_result(json.loads(wire))
+    t2 = back.TOA_list[0]
+    assert toa_string(t2) == toa_string(t)
+    assert (t2.MJD.day, t2.MJD.frac) == (t.MJD.day, t.MJD.frac)
+    assert t2.frequency == np.inf
+    assert isinstance(t2.flags["subint"], int)
+    assert isinstance(t2.flags["snr"], float)
+    assert t2.flags["be"] == "GUPPI"
+    assert back.DeltaDM_means == [1e-4] and back.DM0s == [3.139]
+    assert back.order == ["ep0.fits"] and back.n_skipped == 0
+
+
+def test_router_env_hooks(monkeypatch):
+    """PPT_ROUTER_HOSTS / PPT_ROUTER_RETRY_MAX / PPT_SERVE_LISTEN:
+    registered in KNOWN_PPT_ENV, strict parses, loud errors."""
+    old = (config.router_hosts, config.router_retry_max,
+           config.serve_listen)
+    try:
+        for name in ("PPT_ROUTER_HOSTS", "PPT_ROUTER_RETRY_MAX",
+                     "PPT_SERVE_LISTEN"):
+            assert name in config.KNOWN_PPT_ENV
+        monkeypatch.setenv("PPT_ROUTER_HOSTS",
+                           "nodeA:9090, nodeB:9091")
+        monkeypatch.setenv("PPT_ROUTER_RETRY_MAX", "7")
+        monkeypatch.setenv("PPT_SERVE_LISTEN", "0.0.0.0:9090")
+        changed = config.env_overrides()
+        for key in ("router_hosts", "router_retry_max",
+                    "serve_listen"):
+            assert key in changed
+        assert config.router_hosts == ("nodeA:9090", "nodeB:9091")
+        assert config.router_retry_max == 7
+        assert config.serve_listen == "0.0.0.0:9090"
+        monkeypatch.setenv("PPT_ROUTER_HOSTS", "off")
+        monkeypatch.setenv("PPT_SERVE_LISTEN", "off")
+        config.env_overrides()
+        assert config.router_hosts == ()
+        assert config.serve_listen is None
+        monkeypatch.setenv("PPT_ROUTER_HOSTS", "nodeA")  # no port
+        with pytest.raises(ValueError, match="PPT_ROUTER_HOSTS"):
+            config.env_overrides()
+        monkeypatch.setenv("PPT_ROUTER_HOSTS", "a:1,a:1")
+        with pytest.raises(ValueError, match="duplicate"):
+            config.env_overrides()
+        monkeypatch.setenv("PPT_ROUTER_HOSTS", "a:1")
+        monkeypatch.setenv("PPT_ROUTER_RETRY_MAX", "0")
+        with pytest.raises(ValueError, match="PPT_ROUTER_RETRY_MAX"):
+            config.env_overrides()
+        monkeypatch.setenv("PPT_ROUTER_RETRY_MAX", "2")
+        monkeypatch.setenv("PPT_SERVE_LISTEN", "nowhere")
+        with pytest.raises(ValueError, match="PPT_SERVE_LISTEN"):
+            config.env_overrides()
+    finally:
+        (config.router_hosts, config.router_retry_max,
+         config.serve_listen) = old
+
+
+def test_router_concurrent_clients(campaign, tmp_path):
+    """Thread-safety: concurrent client threads submit through ONE
+    router; every request resolves, loads return to zero, nothing is
+    lost or double-collected."""
+    files, gmodel = campaign
+    results = {}
+    with ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h0, \
+            ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h1:
+        router = ToaRouter([InProcTransport(h0, label="c0"),
+                            InProcTransport(h1, label="c1")])
+
+        def go(tag, fs):
+            results[tag] = router.get_TOAs(fs, gmodel, timeout=300,
+                                           name=tag)
+
+        threads = [threading.Thread(target=go, args=(f"T{i}", [f]))
+                   for i, f in enumerate(files)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stats = router.stats()
+        router.close()
+    assert len(results) == 4
+    assert sum(len(r.TOA_list) for r in results.values()) == 8
+    assert sum(st["n_requests"] for st in stats.values()) == 4
+    assert all(st["outstanding"] == 0 for st in stats.values())

@@ -172,6 +172,37 @@ def test_serve_request_error_isolated(campaign, tmp_path):
         assert len(good.result(300).TOA_list) == 2
 
 
+def test_toa_client_map_error_isolated(campaign, tmp_path):
+    """ToaClient.map error path (ISSUE 10 satellite): a request that
+    fails mid-batch surfaces its error from result() WITHOUT
+    poisoning siblings routed to the same host — every good spec
+    still returns its full result, and the failure is the original
+    exception.  return_errors=True hands the exception back in its
+    slot instead of raising."""
+    files, gmodel = campaign
+    with ToaServer(nsub_batch=8, max_wait_ms=20) as srv:
+        client = ToaClient(srv)
+        specs = [
+            ([files[0]], gmodel, {"name": "ok0"}),
+            ([files[1]], gmodel, {"name": "boom",
+                                  "no_such_option": True}),
+            ([files[2]], gmodel, {"name": "ok1"}),
+        ]
+        # default: raises the failure, but only after every sibling
+        # resolved (nothing left stranded in flight)
+        with pytest.raises(TypeError, match="no_such_option"):
+            client.map(specs, timeout=300)
+        # return_errors: the bad slot carries its exception object,
+        # the good slots their DataBunches, in spec order
+        out = client.map(specs, timeout=300, return_errors=True)
+        assert len(out[0].TOA_list) == 2
+        assert isinstance(out[1], TypeError)
+        assert len(out[2].TOA_list) == 2
+        # the host is not poisoned: a fresh submit still serves
+        assert len(client.get_TOAs([files[3]], gmodel,
+                                   timeout=300).TOA_list) == 2
+
+
 def test_serve_warmup_manifest_kills_cold_starts(campaign, tmp_path):
     """ROADMAP item 5's tail: AOT warmup from a prior run's trace
     compiles every recorded dispatch shape at server start, and the
