@@ -1,0 +1,164 @@
+"""ISSUE 11: the fleet timing stage — serial vs batched wideband GLS
+at fleet scale.
+
+The timing stage was the last per-pulsar-serial production stage: a
+PTA campaign ends with N_psr independent linear solves, each
+milliseconds of f64 math behind a full dispatch floor.  This bench
+measures the R12-style batching applied to it (timing/fleet.py):
+
+* **serial arm** — one device solve dispatch PER PULSAR (the same
+  padded pow2 program the batched lane compiles, batched=False);
+* **batched arm** — one dispatch PER (rows x params) BUCKET: the
+  whole fleet's systems zero-padded into a handful of pow2 classes;
+* **host oracle** — per-pulsar NumPy solves (timing/gls.gls_solve_np,
+  device=False), the algorithm reference.
+
+The headline is the DISPATCH-COUNT REDUCTION (serial pays N_psr
+dispatches, batched pays n_buckets — the chip-side win is the
+dispatch floor times that ratio; CPU walls are reported honestly but
+a millisecond lstsq on one core has nothing to amortize).  The digit
+gate (batched-vs-SERIAL <= 1e-10 on every fitted parameter, scaled by
+max(|value|, error) — same padded program at B=1, so any excess is
+genuine batching leakage) is enforced EVERY run, tiny smoke shapes
+included, plus a looser <= 1e-8 cross-library check against the NumPy
+oracle.  Under PPT_TELEMETRY the batched arm's trace is
+schema-validated and the "timing" section summary is checked.
+
+Fleet fixture: synthetic TimTOA campaigns straight from parfiles
+(synth.fake_timing_campaign — no archives), a mix of ELL1, BT and
+isolated pulsars with heterogeneous epoch counts so the pow2
+bucketing is actually exercised.  Shapes via PPT_NPSR / PPT_NE.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+DIGIT_GATE = 1e-10
+
+
+def _fleet(npsr, nep, rng_base=400):
+    from pulseportraiture_tpu.synth import fake_timing_campaign
+
+    jobs = []
+    for i in range(npsr):
+        par = {"PSR": f"B{i:03d}", "F0": str(180.0 + 17.3 * i),
+               "PEPOCH": "55500", "DM": str(8.0 + 1.5 * i)}
+        kind = i % 3
+        if kind == 0:
+            par.update({"BINARY": "ELL1", "PB": str(0.4 + 0.07 * i),
+                        "A1": str(0.04 + 0.005 * i),
+                        "TASC": "55499.13", "EPS1": "1.5e-6",
+                        "EPS2": "-6e-7"})
+        elif kind == 1:
+            par.update({"BINARY": "BT", "PB": str(0.9 + 0.05 * i),
+                        "A1": str(0.3 + 0.02 * i), "T0": "55499.4",
+                        "ECC": "0.12", "OM": str(20.0 + 10.0 * i)})
+        truth = {"F0": float(par["F0"]) * (1.0 + 1e-13)}
+        if kind != 2:
+            truth["PB"] = float(par["PB"]) + 2e-9
+        toas, _ = fake_timing_campaign(
+            par, truth=truth, n_epochs=nep + (i % 2),
+            toas_per_epoch=2, span_days=90.0, toa_err_us=0.1,
+            dmx=2e-4, rng=rng_base + i)
+        jobs.append((par["PSR"], toas, par))
+    return jobs
+
+
+def main():
+    import pulseportraiture_tpu  # noqa: F401
+    from pulseportraiture_tpu import config, telemetry
+    from pulseportraiture_tpu.timing import TimingJob, fleet_gls_fit
+
+    config.env_overrides()
+    NPSR = int(os.environ.get("PPT_NPSR", 16))
+    NEP = int(os.environ.get("PPT_NE", 8))
+    trace_path = config.telemetry_path
+
+    jobs = [TimingJob(*spec) for spec in _fleet(NPSR, NEP)]
+
+    # host oracle (per-pulsar NumPy)
+    t0 = time.perf_counter()
+    host = fleet_gls_fit(jobs, device=False, quiet=True)
+    wall_host = time.perf_counter() - t0
+
+    # warm both device program classes before timing (compile cost is
+    # a separate, amortized-once story)
+    fleet_gls_fit(jobs, device=True, batched=True, quiet=True)
+    fleet_gls_fit(jobs, device=True, batched=False, quiet=True)
+
+    t0 = time.perf_counter()
+    serial = fleet_gls_fit(jobs, device=True, batched=False,
+                           quiet=True)
+    wall_serial = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    batched = fleet_gls_fit(jobs, device=True, batched=True,
+                            telemetry=trace_path, quiet=True)
+    wall_batched = time.perf_counter() - t0
+
+    # THE digit gate (the acceptance criterion): batched vs the
+    # per-pulsar SERIAL solve — same padded program at B=1, so any
+    # excess is genuine batching leakage, not library rounding.
+    # Every pulsar, every fitted parameter (incl. DMX), scaled by
+    # max(|value|, error).
+    def _max_delta(a, b):
+        worst = 0.0
+        for name in a.pulsars:
+            ra, rc = a.results[name], b.results[name]
+            pairs = [(ra.params[k], rc.params[k], ra.param_errs[k])
+                     for k in ra.params]
+            pairs += list(zip(ra.dmx, rc.dmx, ra.dmx_errs))
+            for va, vc, err in pairs:
+                scale = max(abs(vc), float(err), 1e-300)
+                worst = max(worst, abs(va - vc) / scale)
+        return worst
+
+    digit_max = _max_delta(batched, serial)
+    digit_ok = digit_max <= DIGIT_GATE
+    assert digit_ok, (
+        f"batched-vs-serial digit gate FAILED: {digit_max:.3e} > "
+        f"{DIGIT_GATE}")
+    # cross-library check against the NumPy oracle: XLA's batched SVD
+    # and LAPACK's round differently at the last digits of a marginal
+    # system, so this gate is looser — it guards the ALGORITHM
+    # (column-normalized normal equations), not the rounding
+    digit_max_host = _max_delta(batched, host)
+    assert digit_max_host <= 1e-8, (
+        f"batched-vs-host oracle drift: {digit_max_host:.3e} > 1e-8")
+
+    reduction = serial.n_dispatches / max(batched.n_dispatches, 1)
+
+    summary = None
+    if trace_path:
+        telemetry.validate_trace(trace_path)
+        with open(os.devnull, "w") as sink:
+            summary = telemetry.report(trace_path, file=sink)
+        assert summary["n_timing_fit"] == batched.n_dispatches, summary
+        assert summary["n_timing_pulsars"] == NPSR, summary
+
+    print(json.dumps({
+        "metric": f"fleet GLS serial-vs-batched dispatch reduction: "
+                  f"{NPSR} pulsars (ELL1/BT/isolated mix), ~{NEP} "
+                  "epochs each",
+        "value": round(reduction, 2),
+        "unit": "x fewer dispatches",
+        "pulsars": NPSR,
+        "serial_dispatches": serial.n_dispatches,
+        "batched_dispatches": batched.n_dispatches,
+        "wall_host_s": round(wall_host, 4),
+        "wall_serial_s": round(wall_serial, 4),
+        "wall_batched_s": round(wall_batched, 4),
+        "speedup_vs_serial": round(wall_serial / max(wall_batched,
+                                                     1e-9), 3),
+        "digit_max": digit_max,
+        "digit_max_vs_host": digit_max_host,
+        "digit_gate_ok": bool(digit_ok),
+        "trace_validated": bool(summary is not None),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,11 @@ def build_parser():
                         "(weight edits; no PSRCHIVE needed).")
     p.add_argument("--hist", action="store_true", default=False,
                    help="Save a channel red-chi2 histogram (model path).")
+    p.add_argument("--zap-device", default=None,
+                   choices=("off", "auto", "on"),
+                   help="Route the median-algorithm statistics through "
+                        "the device op (default: config.zap_device / "
+                        "PPT_ZAP_DEVICE; digit-identical either way).")
     p.add_argument("--quiet", action="store_true", default=False)
     return p
 
@@ -94,7 +99,10 @@ def main(argv=None):
 
                     d.noise_stds[isub, 0] = noise_std_ps(
                         d.subints[isub, 0])
-            zap_list.append(get_zap_channels(d, nstd=args.nstd))
+            zap_list.append(get_zap_channels(
+                d, nstd=args.nstd,
+                device={None: None, "off": False, "auto": "auto",
+                        "on": True}[args.zap_device]))
 
     total = sum(sum(len(z) for z in arch) for arch in zap_list)
     if not args.quiet:

@@ -67,6 +67,27 @@ align_device = "auto"
 # digit-exactness oracle (bench_gauss gates .gmodel identity <= 1e-10).
 gauss_device = "auto"
 
+# Route the fleet timing stage's GLS solves (timing/fleet.py:
+# fleet_gls_fit, the pptime CLI, stream_ipta_campaign(timing_pars=))
+# through the BATCHED device lane: per-pulsar whitened systems are
+# bucketed by power-of-two (rows, params) class, zero-padded, and each
+# bucket solved in ONE jitted dispatch instead of one host solve per
+# pulsar.  'auto' = on TPU backends (a millisecond linear solve cannot
+# amortize a per-pulsar dispatch floor; one fleet dispatch can);
+# True/False force.  The host-NumPy per-pulsar path (False) is the
+# digit oracle — bench_gls.py gates batched-vs-serial solutions
+# <= 1e-10 — and stays the CPU default.
+gls_device = "auto"
+
+# Route pipeline/zap.py's iterative median + nstd noise cut through
+# the device op ops/noise.exact_median_lastaxis (ROADMAP item 4 down
+# payment: excision math on device, where the streaming lane's
+# noise_stds already live).  'auto' = on TPU backends; True/False
+# force.  Digit-identical to the host path: the op IS jnp.median
+# bit-for-bit (and exact order statistics match np.median), guarded by
+# tests/test_timing_binary.py's zap parity test.
+zap_device = "auto"
+
 # Matmul-DFT precision (ops/fourier.py) on accelerators:
 # 'highest' = 6-pass bf16 (f32-exact to ~1e-7), 'high' = 3-pass
 # (~1e-6 relative, ~20% faster end-to-end at bench shapes), 'default' =
@@ -312,6 +333,8 @@ RCSTRINGS = {
 #   PPT_DFT_FOLD=off|auto|on        -> dft_fold
 #   PPT_ALIGN_DEVICE=off|auto|on    -> align_device
 #   PPT_GAUSS_DEVICE=off|auto|on    -> gauss_device
+#   PPT_GLS_DEVICE=off|auto|on      -> gls_device
+#   PPT_ZAP_DEVICE=off|auto|on      -> zap_device
 #   PPT_STREAM_DEVICES=auto|<N>     -> stream_devices
 #   PPT_MAX_INFLIGHT=<N>            -> stream_max_inflight
 #   PPT_PIPELINE_DEPTH=<N>          -> stream_pipeline_depth
@@ -340,6 +363,7 @@ KNOWN_PPT_ENV = frozenset({
     # config hooks (this module)
     "PPT_XSPEC", "PPT_DFT_PRECISION", "PPT_DFT_FOLD",
     "PPT_ALIGN_DEVICE", "PPT_GAUSS_DEVICE",
+    "PPT_GLS_DEVICE", "PPT_ZAP_DEVICE",
     "PPT_STREAM_DEVICES", "PPT_MAX_INFLIGHT",
     "PPT_PIPELINE_DEPTH", "PPT_COMPILE_CACHE", "PPT_TELEMETRY",
     "PPT_SERVE_MAX_WAIT_MS", "PPT_SERVE_QUEUE_DEPTH", "PPT_BUCKET_PAD",
@@ -431,26 +455,21 @@ def env_overrides():
                 f"{fold!r}")
         cfg.dft_fold = table[fold]
         changed.append("dft_fold")
-    adev = _os.environ.get("PPT_ALIGN_DEVICE", "").lower()
-    if adev:
-        table = {"off": False, "false": False, "auto": "auto",
-                 "on": True, "true": True}
-        if adev not in table:
-            raise ValueError(
-                f"PPT_ALIGN_DEVICE must be 'off', 'auto' or 'on', got "
-                f"{adev!r}")
-        cfg.align_device = table[adev]
-        changed.append("align_device")
-    gdev = _os.environ.get("PPT_GAUSS_DEVICE", "").lower()
-    if gdev:
-        table = {"off": False, "false": False, "auto": "auto",
-                 "on": True, "true": True}
-        if gdev not in table:
-            raise ValueError(
-                f"PPT_GAUSS_DEVICE must be 'off', 'auto' or 'on', got "
-                f"{gdev!r}")
-        cfg.gauss_device = table[gdev]
-        changed.append("gauss_device")
+    # the tri-state device-lane knobs share one strict parse
+    for env_name, attr in (("PPT_ALIGN_DEVICE", "align_device"),
+                           ("PPT_GAUSS_DEVICE", "gauss_device"),
+                           ("PPT_GLS_DEVICE", "gls_device"),
+                           ("PPT_ZAP_DEVICE", "zap_device")):
+        val = _os.environ.get(env_name, "").lower()
+        if val:
+            table = {"off": False, "false": False, "auto": "auto",
+                     "on": True, "true": True}
+            if val not in table:
+                raise ValueError(
+                    f"{env_name} must be 'off', 'auto' or 'on', got "
+                    f"{val!r}")
+            setattr(cfg, attr, table[val])
+            changed.append(attr)
     sdev = _os.environ.get("PPT_STREAM_DEVICES", "").lower()
     if sdev:
         if sdev == "auto":

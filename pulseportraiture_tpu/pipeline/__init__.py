@@ -15,4 +15,5 @@ from .portrait import DataPortrait, normalize_portrait  # noqa: F401
 from .stream import (stream_narrowband_TOAs,  # noqa: F401
                      stream_wideband_TOAs)
 from .toas import GetTOAs  # noqa: F401
-from .zap import apply_zaps, get_zap_channels, print_paz_cmds  # noqa: F401
+from .zap import (apply_zaps, get_zap_channels,  # noqa: F401
+                  print_paz_cmds, resolve_zap_device)

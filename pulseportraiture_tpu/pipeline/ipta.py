@@ -81,7 +81,8 @@ class IPTAJob:
 
 def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
                          quiet=False, resume=False, telemetry=None,
-                         server=None, router=None, **stream_kwargs):
+                         server=None, router=None, timing_pars=None,
+                         timing_kwargs=None, **stream_kwargs):
     """Measure wideband TOAs for a multi-pulsar campaign.
 
     server: an already-started serve.ToaServer — the campaign becomes
@@ -136,6 +137,19 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
     self-describing JSONL trace; None follows config.telemetry_path
     (default off).  Analyze with tools/pptrace.py.
 
+    timing_pars: {pulsar: parfile path or mapping} — run the FLEET
+    TIMING STAGE (ISSUE 11) after TOA collection: each listed
+    pulsar's measured TOAs feed timing.fleet.fleet_gls_fit, so the
+    campaign runs archives -> TOAs -> per-pulsar timing solutions in
+    one traced pipeline (timing_fit/fleet_end events ride the same
+    tracer; pptrace renders the "timing" section).  Pulsars without a
+    parfile entry are skipped; timing_kwargs forwards fit options
+    (fit_f1=, device=, batched=, ...).  The result's ``timing`` field
+    carries the fleet_gls_fit DataBunch (None when timing_pars is
+    not given).  Refused under multi-process sharding: a shard's
+    partial TOA set would silently time a subsampled campaign — merge
+    the .tim shards and run ``pptime`` instead.
+
     Returns a DataBunch with:
       pulsars     — job order (all jobs, even if this host's shard of
                     one is empty)
@@ -171,6 +185,27 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
     # ---- shard the flattened (pulsar, archive) grid ------------------
     grid = [(j.pulsar, f) for j in jobs for f in j.datafiles]
     pid, nproc = parallel.process_index(), parallel.process_count()
+    if timing_pars and shard and nproc > 1:
+        raise ValueError(
+            "stream_ipta_campaign: timing_pars= is not supported with "
+            "multi-process sharding — each process holds only its "
+            "shard of every pulsar's TOAs, and timing a subsampled "
+            "campaign would silently misreport every solution.  Merge "
+            "the checkpoint .tim shards and run pptime instead.")
+    if timing_pars and resume:
+        raise ValueError(
+            "stream_ipta_campaign: timing_pars= is not supported with "
+            "resume=True — a resumed run's TOA_list covers only the "
+            "archives measured THIS run (already-checkpointed "
+            "archives are skipped), so the timing stage would "
+            "silently fit a subsampled campaign.  Run pptime on the "
+            "completed .tim checkpoints instead.")
+    if timing_pars:
+        unknown = sorted(set(timing_pars) - set(names))
+        if unknown:
+            raise ValueError(
+                f"stream_ipta_campaign: timing_pars names pulsars not "
+                f"in jobs: {unknown}")
     mine = parallel.shard_files(grid) if shard else grid
     tracer, own_tracer = resolve_tracer(telemetry,
                                         run="stream_ipta_campaign")
@@ -322,6 +357,26 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
                                    np.concatenate([np.atleast_1d(g)
                                                    for g in ge]))
 
+        # ---- fleet timing stage (archives -> TOAs -> solutions) ------
+        timing = None
+        if timing_pars:
+            from ..timing.fleet import (TimingJob, fleet_gls_fit,
+                                        toas_from_measurements)
+
+            tjobs = []
+            for job in jobs:
+                par = timing_pars.get(job.pulsar)
+                res = per_pulsar.get(job.pulsar)
+                if par is None or res is None:
+                    continue
+                tjobs.append(TimingJob(
+                    job.pulsar, toas_from_measurements(res.TOA_list),
+                    par))
+            if tjobs:
+                timing = fleet_gls_fit(tjobs, telemetry=tracer,
+                                       quiet=quiet,
+                                       **(timing_kwargs or {}))
+
         wall = time.time() - t0
         n = len(TOA_list)
         log(f"IPTA campaign: {n} TOAs across {len(per_pulsar)}/"
@@ -339,4 +394,5 @@ def stream_ipta_campaign(jobs, outdir=None, shard=True, nsub_batch=256,
             tracer.close()
     return DataBunch(pulsars=names, per_pulsar=per_pulsar,
                      TOA_list=TOA_list, DeltaDM_summary=summary,
+                     timing=timing,
                      nfit=nfit, fit_duration=fit_duration, wall_s=wall)

@@ -14,18 +14,65 @@ import numpy as np
 from ..io.psrfits import read_archive
 
 
-def get_zap_channels(data, nstd=3):
+def resolve_zap_device(device=None):
+    """Tri-state resolution of the zap statistics lane: None follows
+    config.zap_device; 'auto' = device on TPU backends (where the
+    streaming lane's noise_stds already live on chip and a host
+    round-trip per iteration is the only cost); True/False force."""
+    from .. import config
+
+    if device is None:
+        device = getattr(config, "zap_device", "auto")
+    if device == "auto":
+        import jax
+
+        return jax.default_backend() == "tpu"
+    if device in (True, False):
+        return bool(device)
+    raise ValueError(
+        f"zap_device must be True, False or 'auto', got {device!r}")
+
+
+def _zap_stats_host(noise_stds):
+    return float(np.median(noise_stds)), float(np.std(noise_stds))
+
+
+def _zap_stats_device(noise_stds):
+    """(median, std) with the MEDIAN — the expensive, sort-shaped
+    statistic — through the device op ops/noise.exact_median_lastaxis
+    (ROADMAP item 4 down payment).  Digit parity with the host path is
+    a hard guarantee, so the std stays on host: exact_median_lastaxis
+    is jnp.median bit-for-bit (f32 by construction, other dtypes fall
+    through to jnp.median) and jnp.median/np.median compute identical
+    order statistics, but jnp.std's reduction order is NOT np.std's —
+    one flipped borderline comparison would cascade through the
+    iterative cut and change the whole zap list."""
+    import jax.numpy as jnp
+
+    from ..ops.noise import exact_median_lastaxis
+
+    x = jnp.asarray(noise_stds)
+    return float(exact_median_lastaxis(x)), float(np.std(noise_stds))
+
+
+def get_zap_channels(data, nstd=3, device=None):
     """Iterative median + nstd*std cut on per-channel noise levels
     (reference ppzap.py:24-54).  data: a load_data DataBunch.
-    Returns [subint][channel indices]."""
+    Returns [subint][channel indices].
+
+    device: tri-state (resolve_zap_device / config.zap_device /
+    PPT_ZAP_DEVICE) — route each iteration's (median, std) through the
+    device op instead of host NumPy; the flagged channel lists are
+    digit-identical either way (guarded by tests)."""
+    stats = (_zap_stats_device if resolve_zap_device(device)
+             else _zap_stats_host)
     zap_channels = []
     for isub in data.ok_isubs:
         ichans = list(np.asarray(data.ok_ichans[isub]).copy())
         zap_ichans = []
         while len(ichans):
             noise_stds = data.noise_stds[isub, 0, ichans]
-            median = np.median(noise_stds)
-            std = np.std(noise_stds)
+            median, std = stats(noise_stds)
             bad = list(np.where(noise_stds > median + nstd * std)[0])
             if not bad:
                 break

@@ -1,5 +1,6 @@
 from .archive import add_scintillation, make_fake_pulsar
-from .fake import default_test_model, fake_observation, fake_portrait
+from .fake import (default_test_model, fake_observation, fake_portrait,
+                   fake_timing_campaign)
 
 __all__ = ["add_scintillation", "default_test_model", "fake_observation",
-           "fake_portrait", "make_fake_pulsar"]
+           "fake_portrait", "fake_timing_campaign", "make_fake_pulsar"]

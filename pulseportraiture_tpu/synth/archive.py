@@ -87,6 +87,22 @@ def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
     connect: a timing fit (timing.wideband_gls_fit) yields white
     residuals.  Default False preserves the simpler grid-aligned
     behavior (each archive's absolute phase arbitrary).
+
+    **Binary pulsars** (ISSUE 11): when the ephemeris carries a
+    complete ELL1 or BT element set (timing/binary.py semantics;
+    partial sets and unsupported models raise loudly), spin_coherent
+    folding additionally delays each subint by the orbital Roemer
+    delay — the pulse phase becomes frac(F0 (epoch - Delta_R(epoch) -
+    PEPOCH)) — so a campaign of these archives carries real orbital
+    TOA modulation that timing.wideband_gls_fit models and fits.  The
+    delay is evaluated at the SUBINT EPOCH; the measurement reports
+    the TOA up to half a spin period away (the wrapped phase offset
+    times P), where the true orbit has moved on — an injection-vs-
+    model mistiming bounded by pi * A1 * P / PB seconds.  Keep the
+    orbit mild enough that this sits below the TOA noise at test S/N
+    (e.g. A1 = 0.05 lt-s, PB = 1 d, P = 4 ms leaves < 0.01 us).
+    Binary keys without spin_coherent=True are
+    ignored (grid-aligned archives carry no absolute phase at all).
     """
     rng = np.random.default_rng(rng)
     model = read_gmodel(modelfile, quiet=True) \
@@ -144,12 +160,22 @@ def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
         # frac(F0 * (epoch - PEPOCH)) per subint, exactly (~1e9 turns,
         # beyond f64) — shared rational helper so the timing fit
         # reduces with the identical F0 representation
+        from ..timing.binary import binary_delay_np, parse_binary
         from ..utils.spin import rational, spin_F0, spin_phase_frac
 
         F0r = spin_F0(par)
+        F0f = float(F0r)
         pep = rational(par.get("PEPOCH", PEPOCH))  # parsed once
+        bp = parse_binary(par)  # None for isolated; loud on partial
         for isub, e in enumerate(epochs):
-            spin_fracs[isub] = spin_phase_frac(F0r, pep, e.day, e.frac)
+            frac = spin_phase_frac(F0r, pep, e.day, e.frac)
+            if bp is not None:
+                # the pulse is LATE by the orbital Roemer delay: phase
+                # at the epoch is F0*(t - Delta_R - PEPOCH).  F0*Delta
+                # is only ~1e2 turns, safe as a float product (and the
+                # SAME float F0 the timing fit's remainder term uses)
+                frac -= F0f * float(binary_delay_np(bp, e.day, e.frac))
+            spin_fracs[isub] = frac % 1.0
 
     amps = np.zeros((nsub, npol, nchan, nbin))
     for isub in range(nsub):

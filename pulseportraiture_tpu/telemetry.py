@@ -58,7 +58,7 @@ TRACE_SCHEMA_VERSION = 1
 # trace reader needs to interpret device/queue numbers.
 CONFIG_SNAPSHOT_KEYS = (
     "cross_spectrum_dtype", "dft_precision", "dft_fold", "align_device",
-    "gauss_device",
+    "gauss_device", "gls_device", "zap_device",
     "stream_devices", "stream_max_inflight", "stream_pipeline_depth",
     "compile_cache_dir", "telemetry_path",
     "serve_max_wait_ms", "serve_queue_depth", "bucket_pad",
@@ -141,6 +141,16 @@ EVENT_FIELDS = {
     "template_job": {"datafile", "kind", "ngauss", "converged",
                      "iters"},
     "factory_end": {"n_jobs", "n_dispatches", "wall_s"},
+    # the fleet timing stage (timing/fleet.fleet_gls_fit): one
+    # timing_fit per GLS solve dispatch — bucket is the padded
+    # (rows x params) shape class ('host:...' on the NumPy oracle
+    # lane), rows = real systems in the dispatch, pad = zero-padded
+    # batch rows, batched marks the one-dispatch-per-bucket lane
+    # (False = per-pulsar serial, the bench A/B arm / host lane) —
+    # and one fleet_end rollup per fleet_gls_fit call.  The "timing"
+    # report section aggregates exactly these.
+    "timing_fit": {"bucket", "rows", "pad", "wall_s", "batched"},
+    "fleet_end": {"n_pulsars", "n_dispatches", "wall_s"},
     "counters": {"counters", "gauges"},
 }
 
@@ -815,6 +825,47 @@ def report(path, file=None):
               f"{max(ngs)}" if ngs else
               f"  {len(tjobs)} template job(s) done")
 
+    # ---- timing (fleet-batched wideband GLS) ------------------------
+    tim_fit = by_type.get("timing_fit", [])
+    fleet_ends = by_type.get("fleet_end", [])
+    timing_pad_frac = None
+    timing_wall_s = None
+    n_timing_pulsars = None
+    timing_dispatches = None
+    if tim_fit or fleet_ends:
+        p("")
+        p("-- timing (fleet-batched wideband GLS) --")
+        if fleet_ends:
+            n_timing_pulsars = sum(int(ev["n_pulsars"])
+                                   for ev in fleet_ends)
+            timing_dispatches = sum(int(ev["n_dispatches"])
+                                    for ev in fleet_ends)
+            fleet_wall = sum(float(ev["wall_s"]) for ev in fleet_ends)
+            p(f"  {n_timing_pulsars} pulsar(s) solved in "
+              f"{timing_dispatches} dispatch(es) across "
+              f"{len(fleet_ends)} fleet call(s), wall {fleet_wall:.3f}"
+              " s (serial would pay one dispatch per pulsar — the "
+              "reduction is the batched lane's win)")
+        if tim_fit:
+            rows = sum(int(ev["rows"]) for ev in tim_fit)
+            pad = sum(int(ev["pad"]) for ev in tim_fit)
+            timing_pad_frac = pad / max(rows + pad, 1)
+            timing_wall_s = sum(float(ev["wall_s"]) for ev in tim_fit)
+            n_batched = sum(1 for ev in tim_fit if ev.get("batched"))
+            shapes = {}
+            for ev in tim_fit:
+                s = shapes.setdefault(ev["bucket"], [0, 0, 0])
+                s[0] += 1
+                s[1] += int(ev["rows"])
+                s[2] += int(ev["pad"])
+            p(f"  {len(tim_fit)} solve dispatch(es) "
+              f"({n_batched} batched), {rows} system(s) + {pad} "
+              f"zero-padded ({100 * (1 - timing_pad_frac):.1f}% "
+              f"full), solve wall {timing_wall_s:.3f} s")
+            for key in sorted(shapes):
+                nd, rw, pd = shapes[key]
+                p(f"    bucket {key}: {nd} dispatch(es), {rw} "
+                  f"system(s) + {pd} pad")
     # ---- quality ----------------------------------------------------
     qual = by_type.get("quality", [])
     snr = [v for ev in qual for v in ev["snr"]]
@@ -873,6 +924,11 @@ def report(path, file=None):
         "n_template_jobs": len(tjobs),
         "template_pad_frac": template_pad_frac,
         "template_wall_s": template_wall_s,
+        "n_timing_fit": len(tim_fit),
+        "n_timing_pulsars": n_timing_pulsars,
+        "timing_dispatches": timing_dispatches,
+        "timing_pad_frac": timing_pad_frac,
+        "timing_wall_s": timing_wall_s,
         "counters": counters,
         "gauges": gauges,
     }

@@ -1,14 +1,23 @@
-"""Minimal wideband timing: .tim reading and a NumPy GLS fitter.
+"""Wideband timing: .tim reading, a NumPy GLS fitter with ELL1/BT
+binary-orbit models, and the fleet-batched solve lane.
 
 Closes the loop the reference's example notebook closes with an
 external ``tempo`` GLS run on the produced .tim with DMDATA 1
 (examples/example_make_model_and_TOAs.ipynb cells 43-56) — here with
 no external binaries: read the wideband TOAs (+ -pp_dm DM
 measurements) back, fit a linearized timing model jointly to arrival
-times and DMs, and report white(ned) residuals.
+times and DMs, and report white(ned) residuals.  Binary pulsars
+(ISSUE 11) fit their Keplerian ELL1/BT elements alongside spin/DMX;
+timing/fleet.py batches the per-pulsar solves into padded device
+dispatches (the ``pptime`` CLI and stream_ipta_campaign's
+timing_pars= ride it).
 """
 
+from .binary import BinaryParams, parse_binary
+from .fleet import TimingJob, fleet_gls_fit, toas_from_measurements
 from .gls import WidebandGLSResult, wideband_gls_fit
 from .tim import TimTOA, read_tim
 
-__all__ = ["read_tim", "TimTOA", "wideband_gls_fit", "WidebandGLSResult"]
+__all__ = ["read_tim", "TimTOA", "wideband_gls_fit",
+           "WidebandGLSResult", "BinaryParams", "parse_binary",
+           "TimingJob", "fleet_gls_fit", "toas_from_measurements"]

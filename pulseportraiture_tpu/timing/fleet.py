@@ -1,0 +1,259 @@
+"""Fleet-batched wideband GLS timing: every pulsar's solution from a
+handful of padded device dispatches (ISSUE 11 tentpole, layer 3).
+
+The timing stage was the last per-pulsar-serial production stage in
+the system: a PTA campaign ends with N_psr independent linear solves,
+each milliseconds of math behind a full dispatch + transfer floor.
+This module applies the R12 batched-LM playbook to timing:
+
+* the LINEARIZATION stays on host (timing/gls.build_gls_system —
+  exact rational spin-phase reduction per pulsar; f64 host work that
+  no accelerator improves at these sizes);
+* the SOLVES are bucketed by power-of-two (rows, params) class,
+  zero-padded (extra rows and columns are exactly inert: zero rows
+  add nothing to the normal equations, zero columns ride the
+  pseudoinverse's null space out with zero value and zero error),
+  the batch axis padded to its own power of two with all-zero
+  systems, and each bucket solved in ONE jitted device dispatch;
+* the device program mirrors timing/gls.gls_solve_np op-for-op
+  (column-normalized normal equations through a pseudoinverse), so
+  batched-vs-serial stays digit-comparable: the serial lane runs the
+  SAME padded program one pulsar at a time (batched=False — the A/B
+  arm benchmarks/bench_gls.py measures), and the host lane
+  (device=False) is the NumPy oracle.
+
+Telemetry: one ``timing_fit`` event per solve dispatch and a
+``fleet_end`` rollup ride whatever tracer the caller threads through
+(stream_ipta_campaign passes its campaign tracer, so archives → TOAs
+→ timing solutions land in ONE trace; tools/pptrace.py renders the
+"timing" section from exactly these events).
+"""
+
+import functools
+import time
+
+import numpy as np
+
+from ..telemetry import log, resolve_tracer
+from ..utils.bunch import DataBunch
+from .gls import build_gls_system, finalize_gls, gls_solve_np
+from .tim import TimTOA, read_tim
+
+__all__ = ["TimingJob", "fleet_gls_fit", "toas_from_measurements",
+           "resolve_gls_device"]
+
+
+def _pow2(n):
+    """Smallest power of two >= n (>= 1)."""
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def resolve_gls_device(device=None):
+    """Tri-state resolution of the fleet solve lane, mirroring the
+    align_device/gauss_device convention: None follows
+    config.gls_device; 'auto' = device on TPU backends (where the
+    per-pulsar dispatch floor dominates a millisecond solve);
+    True/False force.  Loud on anything else."""
+    from .. import config
+
+    if device is None:
+        device = getattr(config, "gls_device", "auto")
+    if device == "auto":
+        import jax
+
+        return jax.default_backend() == "tpu"
+    if device in (True, False):
+        return bool(device)
+    raise ValueError(
+        f"gls_device must be True, False or 'auto', got {device!r}")
+
+
+class TimingJob:
+    """One pulsar's timing problem: TOAs + parfile (+ per-pulsar fit
+    overrides forwarded to build_gls_system, e.g. fit_f1=True for the
+    one pulsar with a measurable spindown)."""
+
+    def __init__(self, pulsar, toas, par, **fit_kwargs):
+        self.pulsar = str(pulsar)
+        if isinstance(toas, str):
+            toas = read_tim(toas)
+        self.toas = list(toas)
+        if isinstance(par, str):
+            from ..io.psrfits import parse_parfile
+
+            par = parse_parfile(par)
+        self.par = par
+        self.fit_kwargs = dict(fit_kwargs)
+
+
+def toas_from_measurements(toa_list):
+    """Adapt pipeline TOA objects (io/tim.TOA, as collected by
+    GetTOAs / the streaming drivers) to the TimTOA records the timing
+    fit consumes — the in-memory equivalent of writing and re-reading
+    a .tim file, minus the formatting round-trip."""
+    out = []
+    for t in toa_list:
+        out.append(TimTOA(
+            archive=str(t.archive), frequency=float(t.frequency),
+            mjd_int=int(t.MJD.day), mjd_frac=float(t.MJD.frac),
+            error_us=float(t.TOA_error), site=str(t.telescope_code),
+            dm=None if t.DM is None else float(t.DM),
+            dm_err=None if t.DM_error is None else float(t.DM_error),
+            flags=dict(t.flags)))
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _solve_program(nbatch, nrow, nparam):
+    """Compiled batched GLS solve for one (B, m, p) bucket class.
+
+    The math is gls_solve_np verbatim, vmapped by shape: column
+    normalization, normal equations, batched pseudoinverse, whitened
+    post-fit residuals.  f64 throughout — timing precision is the
+    point, and the batch sizes are tiny by accelerator standards.
+    Cached per shape class (pow2 bucketing keeps the class count
+    logarithmic in fleet diversity)."""
+    import jax
+    import jax.numpy as jnp
+
+    def solve(A, r):
+        col = jnp.sqrt(jnp.sum(A * A, axis=-2))
+        col = jnp.where(col > 0, col, 1.0)
+        An = A / col[..., None, :]
+        G = jnp.swapaxes(An, -1, -2) @ An
+        N = jnp.linalg.pinv(G)
+        Atr = jnp.einsum("...ji,...j->...i", An, r)
+        xn = jnp.einsum("...ij,...j->...i", N, Atr)
+        x = xn / col
+        perr = jnp.sqrt(jnp.maximum(
+            jnp.diagonal(N, axis1=-2, axis2=-1), 0.0)) / col
+        post = r - jnp.einsum("...ij,...j->...i", An, xn)
+        chi2 = jnp.sum(post * post, axis=-1)
+        return x, perr, post, chi2
+
+    return jax.jit(solve)
+
+
+def _solve_bucket(systems, nrow, nparam, batched, tracer, key):
+    """Solve a list of (index, system) pairs in one padded dispatch
+    (batched=True) or one B=1 dispatch per system (the serial A/B
+    arm).  Returns {index: (x, perr, post, chi2)}."""
+    out = {}
+    groups = [systems] if batched else [[s] for s in systems]
+    for group in groups:
+        B = _pow2(len(group)) if batched else 1
+        A = np.zeros((B, nrow, nparam))
+        r = np.zeros((B, nrow))
+        for b, (_, s) in enumerate(group):
+            m, p = s.A.shape
+            A[b, :m, :p] = s.A
+            r[b, :m] = s.r
+        t0 = time.perf_counter()
+        fn = _solve_program(B, nrow, nparam)
+        x, perr, post, chi2 = (np.asarray(v) for v in fn(A, r))
+        wall = time.perf_counter() - t0
+        if tracer.enabled:
+            tracer.emit("timing_fit", bucket=key, rows=len(group),
+                        pad=B - len(group), wall_s=round(wall, 6),
+                        batched=bool(batched))
+        for b, (idx, s) in enumerate(group):
+            m, p = s.A.shape
+            out[idx] = (x[b, :p], perr[b, :p], post[b, :m],
+                        float(chi2[b]))
+    return out
+
+
+def fleet_gls_fit(jobs, fit_f0=True, fit_f1=False, fit_binary=True,
+                  epoch_gap_days=0.5, allow_wraps=False, device=None,
+                  batched=True, telemetry=None, quiet=True):
+    """Wideband GLS timing solutions for a whole pulsar fleet.
+
+    jobs: sequence of TimingJob (or (pulsar, toas, par) tuples; toas
+    may be a .tim path, par a parfile path).  Fit options are
+    campaign-wide defaults; per-job fit_kwargs override.
+
+    device: None follows config.gls_device ('auto' = TPU); False =
+    host-NumPy per-pulsar solves (the oracle lane); True = bucketed
+    device dispatches.  batched: True packs each power-of-two
+    (rows, params) bucket into one dispatch; False runs the SAME
+    padded program per pulsar — the serial arm of bench_gls.py's A/B
+    (only meaningful with the device lane).
+
+    telemetry: tracer or path (resolve_tracer semantics); emits one
+    ``timing_fit`` per solve dispatch and a ``fleet_end`` rollup.
+
+    Returns DataBunch(pulsars, results={pulsar: WidebandGLSResult},
+    n_dispatches, wall_s, device, batched).  A pulsar whose parfile or
+    TOAs are invalid raises the underlying loud error naming it —
+    a fleet with a broken member should fail visibly, not drop it.
+    """
+    jobs = [j if isinstance(j, TimingJob) else TimingJob(*j)
+            for j in jobs]
+    names = [j.pulsar for j in jobs]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate pulsar names in jobs: {names}")
+    use_device = resolve_gls_device(device)
+    tracer, own_tracer = resolve_tracer(telemetry, run="fleet_gls_fit")
+    t0 = time.time()
+    try:
+        systems = []
+        for j in jobs:
+            kw = dict(fit_f0=fit_f0, fit_f1=fit_f1,
+                      fit_binary=fit_binary,
+                      epoch_gap_days=epoch_gap_days,
+                      allow_wraps=allow_wraps)
+            kw.update(j.fit_kwargs)
+            try:
+                systems.append(build_gls_system(j.toas, j.par, **kw))
+            except Exception as e:
+                raise type(e)(f"fleet_gls_fit: pulsar {j.pulsar!r}: "
+                              f"{e}") from e
+
+        solved = {}
+        n_dispatches = 0
+        if not use_device:
+            for i, s in enumerate(systems):
+                t1 = time.perf_counter()
+                x, perr, _, post, chi2 = gls_solve_np(s.A, s.r)
+                solved[i] = (x, perr, post, chi2)
+                if tracer.enabled:
+                    m, p = s.A.shape
+                    tracer.emit(
+                        "timing_fit", bucket=f"host:{m}x{p}", rows=1,
+                        pad=0,
+                        wall_s=round(time.perf_counter() - t1, 6),
+                        batched=False)
+                n_dispatches += 1
+        else:
+            buckets = {}
+            for i, s in enumerate(systems):
+                m, p = s.A.shape
+                buckets.setdefault((_pow2(m), _pow2(p)),
+                                   []).append((i, s))
+            for (mm, pp), group in sorted(buckets.items()):
+                key = f"{mm}x{pp}"
+                solved.update(_solve_bucket(group, mm, pp, batched,
+                                            tracer, key))
+                n_dispatches += 1 if batched else len(group)
+
+        results = {}
+        for i, (j, s) in enumerate(zip(jobs, systems)):
+            x, perr, post, chi2 = solved[i]
+            results[j.pulsar] = finalize_gls(s, x, perr, post, chi2)
+        wall = time.time() - t0
+        tracer.emit("fleet_end", n_pulsars=len(jobs),
+                    n_dispatches=n_dispatches, wall_s=round(wall, 6))
+        log(f"fleet GLS: {len(jobs)} pulsar(s) solved in "
+            f"{n_dispatches} dispatch(es) "
+            f"({'device' if use_device else 'host'}"
+            f"{', batched' if use_device and batched else ''}) in "
+            f"{wall:.3f} s", quiet=quiet, tracer=tracer)
+    finally:
+        if own_tracer:
+            tracer.close()
+    return DataBunch(pulsars=names, results=results,
+                     n_dispatches=n_dispatches, wall_s=wall,
+                     device=use_device, batched=bool(batched))

@@ -14,6 +14,7 @@ setup(
     entry_points={
         "console_scripts": [
             "pptoas=pulseportraiture_tpu.cli.pptoas:main",
+            "pptime=pulseportraiture_tpu.cli.pptime:main",
             "ppserve=pulseportraiture_tpu.cli.ppserve:main",
             "pproute=pulseportraiture_tpu.cli.pproute:main",
             "ppalign=pulseportraiture_tpu.cli.ppalign:main",

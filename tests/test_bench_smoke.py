@@ -72,6 +72,12 @@ TINY_ENV = {
                      "PPT_NCHAN": "16", "PPT_NBIN": "128",
                      "PPT_NREQ": "2", "PPT_NHOSTS": "2",
                      "PPT_CAMPAIGN_CACHE": "", "PPT_TELEMETRY": ""},
+    # ISSUE 11: the fleet timing A/B — serial-vs-batched GLS solve
+    # dispatches over a tiny mixed ELL1/BT/isolated fleet, with the
+    # <= 1e-10 batched-vs-host digit gate ENFORCED inside the bench at
+    # every shape (including this one), and the emitted trace's
+    # timing_fit/fleet_end events schema-validated
+    "bench_gls": {"PPT_NPSR": "4", "PPT_NE": "4", "PPT_TELEMETRY": ""},
 }
 
 _CONFIG_KEYS = ("dft_precision", "cross_spectrum_dtype", "dft_fold",
@@ -218,6 +224,31 @@ def test_bench_smoke(name, monkeypatch, capsys, tmp_path):
             assert f"stage_{stage}_ms" in out, stage
         assert out["attributed_frac"] > 0
         assert out["dominant_stage"]
+    if name == "bench_gls":
+        # ISSUE 11: the serial arm pays one dispatch per pulsar, the
+        # batched arm one per pow2 bucket — the reduction is the
+        # headline; the digit gate must HOLD at tiny shapes (solver
+        # drift fails here, in CI) and the trace must validate with
+        # the timing-section summary keys
+        assert out["digit_gate_ok"] is True
+        assert out["digit_max"] <= 1e-10
+        assert out["digit_max_vs_host"] <= 1e-8
+        assert out["serial_dispatches"] == out["pulsars"] == 4
+        assert out["batched_dispatches"] < out["serial_dispatches"]
+        assert out["value"] > 1
+        assert out["trace_validated"] is True
+        from pulseportraiture_tpu import telemetry
+
+        trace = str(tmp_path / "trace.jsonl")
+        assert os.path.exists(trace), "bench_gls emitted no trace"
+        manifest, events = telemetry.validate_trace(trace)
+        etypes = {e["type"] for e in events}
+        assert "timing_fit" in etypes and "fleet_end" in etypes
+        fits = [e for e in events if e["type"] == "timing_fit"]
+        assert all(e["batched"] for e in fits)
+        assert sum(e["rows"] for e in fits) == 4
+        ends = [e for e in events if e["type"] == "fleet_end"]
+        assert ends[-1]["n_pulsars"] == 4
     if name == "bench_campaign":
         # ISSUE 6: the reworked link-bound bench must report both
         # pipeline arms with byte-identical .tim output and emit

@@ -12,7 +12,7 @@ import pytest
 
 from pulseportraiture_tpu.cli import (ppalign, ppfactory, ppgauss,
                                       pproute, ppserve, ppspline,
-                                      pptoas, ppzap)
+                                      pptime, pptoas, ppzap)
 from pulseportraiture_tpu.synth import default_test_model, make_fake_pulsar
 from pulseportraiture_tpu.utils.mjd import MJD
 
@@ -470,3 +470,75 @@ def test_pptoas_stream_devices_flag_validation():
     with pytest.raises(SystemExit, match=">= 1"):
         pptoas.main(["-d", "x.fits", "-m", "m.gmodel", "--stream",
                      "--stream-devices", "0"])
+
+
+def test_pptime_cli_flag_validation(tmp_path):
+    """pptime validates its job spec loudly before any file IO."""
+    with pytest.raises(SystemExit, match="need a timfile"):
+        pptime.main([])
+    with pytest.raises(SystemExit, match="not both"):
+        pptime.main(["-j", "jobs.txt", "a.tim", "a.par"])
+    with pytest.raises(SystemExit, match="jobs file not found"):
+        pptime.main(["-j", str(tmp_path / "missing.txt")])
+    empty = tmp_path / "empty.txt"
+    empty.write_text("# nothing\n")
+    with pytest.raises(SystemExit, match="no jobs"):
+        pptime.main(["-j", str(empty)])
+    torn = tmp_path / "torn.txt"
+    torn.write_text("PSR only_two_fields\n")
+    with pytest.raises(SystemExit, match="expected '<pulsar>"):
+        pptime.main(["-j", str(torn)])
+    # strict tri-state on the device knob (argparse choices)
+    with pytest.raises(SystemExit):
+        pptime.main(["a.tim", "a.par", "--gls-device", "sometimes"])
+
+
+def test_pptime_cli_times_a_fleet(tmp_path, capsys):
+    """End-to-end: synthetic ELL1 + isolated .tim fleet -> pptime -j
+    -> per-pulsar solutions on stdout (JSON mode parseable)."""
+    import json
+
+    from pulseportraiture_tpu.io.tim import write_TOAs  # noqa: F401
+    from pulseportraiture_tpu.synth import fake_timing_campaign
+
+    specs = []
+    for i, binary in enumerate((True, False)):
+        par = {"PSR": f"T{i}", "F0": str(210.0 + 10 * i),
+               "PEPOCH": "55500", "DM": "7.5"}
+        if binary:
+            par.update({"BINARY": "ELL1", "PB": "0.7", "A1": "0.06",
+                        "TASC": "55499.2", "EPS1": "1e-6",
+                        "EPS2": "-4e-7"})
+        toas, _ = fake_timing_campaign(par, n_epochs=6, rng=70 + i)
+        tim = tmp_path / f"t{i}.tim"
+        with open(tim, "w") as f:
+            f.write("FORMAT 1\n")
+            for t in toas:
+                frac = f"{t.mjd_frac:.15f}"[1:]
+                f.write(f"{t.archive} 0.0 {t.mjd_int}{frac} "
+                        f"{t.error_us:.3f} @ -pp_dm {t.dm:.7f} "
+                        f"-pp_dme {t.dm_err:.7f}\n")
+        parf = tmp_path / f"t{i}.par"
+        parf.write_text("".join(f"{k} {v}\n" for k, v in par.items()))
+        specs.append((f"T{i}", str(tim), str(parf)))
+    jobs = tmp_path / "jobs.txt"
+    jobs.write_text("".join(f"{p} {t} {pr}\n" for p, t, pr in specs))
+
+    assert pptime.main(["-j", str(jobs), "--gls-device", "on",
+                        "--json", "--quiet"]) == 0
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.strip().startswith("{")]
+    assert len(lines) == 2
+    by_psr = {json.loads(ln)["pulsar"]: json.loads(ln)
+              for ln in lines}
+    assert by_psr["T0"]["binary"] == "ELL1"
+    assert by_psr["T1"]["binary"] is None
+    for rec in by_psr.values():
+        assert rec["n_toas"] == 12
+        assert 0.1 < rec["red_chi2"] < 5.0
+        assert "PB" in rec["params"] or rec["binary"] is None
+        assert set(rec["param_errs"]) == set(rec["params"])
+    # table mode + serial arm still run
+    assert pptime.main([specs[0][1], specs[0][2], "--serial"]) == 0
+    out = capsys.readouterr().out
+    assert "red-chi2" in out and "binary=ELL1" in out

@@ -105,15 +105,16 @@ def test_gls_rejects_malformed_parfile(tim_path):
         wideband_gls_fit(toas, {"PEPOCH": 55000.0, "DM": 10.0})
 
 
-def test_gls_refuses_binary_parfile(tim_path):
-    """A parfile carrying binary-orbit parameters must be refused with
-    a clear message (VERDICT r5 #7): the fit has no orbital delay
-    terms, and silently ignoring PB/A1/... would time the pulsar
-    against an orbit-smeared phase prediction with no visible symptom.
-    Exercised through parse_parfile so real .par spellings are what is
-    rejected."""
+def test_gls_refuses_unmodeled_binary_parfile(tim_path):
+    """Since ISSUE 11, complete ELL1/BT Keplerian parfiles are MODELED
+    (tests/test_timing_binary.py covers the fit); the loud refusal now
+    guards what is still unimplemented: Shapiro/relativistic keys and
+    partial element sets — the likeliest hand-edited failure modes,
+    which silently ignoring would time against an orbit-smeared phase
+    prediction with no visible symptom.  Exercised through
+    parse_parfile so real .par spellings are what is rejected."""
     toas = read_tim(tim_path)
-    binary_par = parse_parfile([
+    shapiro_par = parse_parfile([
         "PSR      J1012+5307",
         "RAJ      10:12:33.4",
         "DECJ     53:07:02.5",
@@ -126,13 +127,15 @@ def test_gls_refuses_binary_parfile(tim_path):
         "TASC     50700.08162891",
         "EPS1     0.00000012",
         "EPS2     -0.00000007",
+        "SINI     0.978",
+        "M2       0.21",
     ])
     with pytest.raises(ValueError, match="binary-orbit"):
-        wideband_gls_fit(toas, binary_par)
+        wideband_gls_fit(toas, shapiro_par)
     # the message names the offending keys so the user knows what to
     # strip (or that they need tempo2/PINT)
-    with pytest.raises(ValueError, match="A1.*PB.*TASC"):
-        wideband_gls_fit(toas, binary_par)
+    with pytest.raises(ValueError, match="M2.*SINI"):
+        wideband_gls_fit(toas, shapiro_par)
     # a single orbital key is enough — partial binary parfiles are the
     # likeliest hand-edited failure mode
     par = dict(PAR)

@@ -1,0 +1,538 @@
+"""ISSUE 11: the binary timing subsystem + fleet-batched GLS.
+
+Covers the physics layer (ELL1/BT delays + closed-form partials vs a
+host-NumPy oracle, finite differences, and the small-eccentricity
+analytic limit), the parfile parsing refusals (incl. the H3/H4/STIG
+orthometric-Shapiro regression — those keys used to slip PAST the old
+blanket refusal), the end-to-end tier-1 scenario (synthetic ELL1
+binary campaign: archives -> TOAs -> .tim -> timing solution, with
+injected orbital parameters recovered within errors), the fleet lane
+(batched-vs-serial digit identity <= 1e-10, dispatch-count
+reduction), the IPTA wiring (one traced pipeline with a pptrace
+"timing" section), and the new env knobs/zap device satellite.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu import config, telemetry
+from pulseportraiture_tpu.io import write_gmodel
+from pulseportraiture_tpu.io.psrfits import parse_parfile
+from pulseportraiture_tpu.io.tim import write_TOAs
+from pulseportraiture_tpu.pipeline import GetTOAs
+from pulseportraiture_tpu.synth import (default_test_model,
+                                        fake_timing_campaign,
+                                        make_fake_pulsar)
+from pulseportraiture_tpu.timing import (TimingJob, fleet_gls_fit,
+                                         parse_binary, read_tim,
+                                         toas_from_measurements,
+                                         wideband_gls_fit)
+from pulseportraiture_tpu.timing import binary as B
+from pulseportraiture_tpu.utils.mjd import MJD
+
+SECPERDAY = 86400.0
+
+# a mild ELL1 orbit: the synth's epoch-vs-TOA-instant evaluation bound
+# (pi * A1 * P / PB ~ 6e-9 s, synth/archive.py docstring) sits far
+# below the ~0.06 us TOA errors at noise_stds=0.3
+BPAR = {"PSR": "J1012+5307", "P0": 0.004074, "PEPOCH": 55150.0,
+        "DM": 3.139, "BINARY": "ELL1", "PB": 1.2, "A1": 0.05,
+        "TASC": 55149.3, "EPS1": 2e-6, "EPS2": -1e-6}
+DDMS = [3e-4, -2e-4, 5e-4, -4e-4, 1e-4]
+
+
+# ---------------------------------------------------------------------------
+# physics layer: delays + partials
+# ---------------------------------------------------------------------------
+
+def test_binary_jnp_matches_numpy_oracle(rng):
+    dt = rng.uniform(0.0, 5e5, 128)
+    args = (0.3 * SECPERDAY, 0.6, 1e-4, -5e-5, 1e-12, 1e-14,
+            1e-18, -1e-18)
+    d_j, parts = B.ell1_delay_and_partials(dt, *args)
+    np.testing.assert_allclose(np.asarray(d_j),
+                               B.ell1_delay_np(dt, *args),
+                               rtol=0, atol=1e-13)
+    assert np.asarray(parts).shape == (5, 128)
+    argsb = (0.9 * SECPERDAY, 0.4, 0.37, 123.0, 1e-12, 1e-14)
+    d_j, parts = B.bt_delay_and_partials(dt, *argsb)
+    np.testing.assert_allclose(np.asarray(d_j),
+                               B.bt_delay_np(dt, *argsb),
+                               rtol=0, atol=1e-13)
+    # jittable: same digits under jit
+    import jax
+
+    f = jax.jit(lambda d: B.ell1_delay_and_partials(d, *args)[0])
+    np.testing.assert_allclose(np.asarray(f(dt)),
+                               B.ell1_delay_np(dt, *args),
+                               rtol=0, atol=1e-12)
+    g = jax.jit(lambda d: B.bt_delay_and_partials(d, *argsb)[0])
+    np.testing.assert_allclose(np.asarray(g(dt)),
+                               B.bt_delay_np(dt, *argsb),
+                               rtol=0, atol=1e-12)
+
+
+def test_ell1_partials_match_finite_differences(rng):
+    dt = rng.uniform(0.0, 4e5, 64)
+    pb_s, a1, e1, e2 = 0.3 * SECPERDAY, 0.6, 1e-4, -5e-5
+    _, P = B.ell1_delay_and_partials(dt, pb_s, a1, e1, e2)
+    P = np.asarray(P)
+
+    def fd(i, h):
+        args = [pb_s, a1, e1, e2]
+        hi, lo = list(args), list(args)
+        hi[i] += h
+        lo[i] -= h
+        return (B.ell1_delay_np(dt, *hi)
+                - B.ell1_delay_np(dt, *lo)) / (2 * h)
+
+    np.testing.assert_allclose(P[0], fd(0, 1e-3), atol=2e-10)  # pb_s
+    np.testing.assert_allclose(P[1], fd(1, 1e-6), atol=1e-9)   # a1
+    np.testing.assert_allclose(P[3], fd(2, 1e-9), atol=1e-6)   # eps1
+    np.testing.assert_allclose(P[4], fd(3, 1e-9), atol=1e-6)   # eps2
+    # tasc partial == -d/d(dt)
+    h = 1e-2
+    num = (B.ell1_delay_np(dt - h, pb_s, a1, e1, e2)
+           - B.ell1_delay_np(dt + h, pb_s, a1, e1, e2)) / (2 * h)
+    np.testing.assert_allclose(P[2], num, atol=1e-10)
+
+
+def test_bt_partials_match_finite_differences(rng):
+    dt = rng.uniform(0.0, 4e5, 64)
+    pb_s, a1, ecc, om = 0.3 * SECPERDAY, 0.6, 0.4, 37.0
+    _, P = B.bt_delay_and_partials(dt, pb_s, a1, ecc, om)
+    P = np.asarray(P)
+
+    def fd(i, h):
+        args = [pb_s, a1, ecc, om]
+        hi, lo = list(args), list(args)
+        hi[i] += h
+        lo[i] -= h
+        return (B.bt_delay_np(dt, *hi)
+                - B.bt_delay_np(dt, *lo)) / (2 * h)
+
+    np.testing.assert_allclose(P[0], fd(0, 1e-3), atol=2e-9)
+    np.testing.assert_allclose(P[1], fd(1, 1e-6), atol=1e-8)
+    np.testing.assert_allclose(P[3], fd(2, 1e-7), atol=1e-6)
+    # om partial is per RADIAN in the raw core
+    np.testing.assert_allclose(P[4] * np.pi / 180.0, fd(3, 1e-4),
+                               atol=1e-10)
+    h = 1e-2
+    num = (B.bt_delay_np(dt - h, pb_s, a1, ecc, om)
+           - B.bt_delay_np(dt + h, pb_s, a1, ecc, om)) / (2 * h)
+    np.testing.assert_allclose(P[2], num, atol=1e-10)
+
+
+def test_ell1_matches_bt_small_eccentricity_limit(rng):
+    """Analytic limit: for e -> 0 the BT delay equals the ELL1 delay
+    (eta = e sin(om), kappa = e cos(om), TASC = T0 - om*PB/2pi) up to
+    the constant -(3/2)*x*eta the ELL1 convention drops (degenerate
+    with the phase OFFSET) and an O(x e^2) remainder."""
+    dt = rng.uniform(0.0, 5e5, 256)
+    pb_s, a1, om = 0.3 * SECPERDAY, 0.6, 37.0
+    om_r = np.deg2rad(om)
+    for e in (1e-5, 1e-4, 1e-3):
+        eta, kap = e * np.sin(om_r), e * np.cos(om_r)
+        tasc_shift = om_r / (2 * np.pi) * pb_s
+        d_bt = B.bt_delay_np(dt, pb_s, a1, e, om)
+        d_el = (B.ell1_delay_np(dt + tasc_shift, pb_s, a1, eta, kap)
+                - 1.5 * a1 * eta)
+        assert np.abs(d_bt - d_el).max() < 3.0 * a1 * e * e, e
+
+
+def test_bt_kepler_solver_converged(rng):
+    """The fixed-iteration Newton solve satisfies Kepler's equation to
+    f64 round-off across the supported eccentricity range."""
+    M = rng.uniform(-20 * np.pi, 20 * np.pi, 512)
+    for ecc in (0.01, 0.3, 0.7, 0.9):
+        E = B._kepler_E_np(M, ecc)
+        np.testing.assert_allclose(E - ecc * np.sin(E), M, rtol=0,
+                                   atol=1e-10)
+
+
+# ---------------------------------------------------------------------------
+# parsing + refusals
+# ---------------------------------------------------------------------------
+
+def test_parse_binary_semantics():
+    assert parse_binary({"F0": 300.0, "PEPOCH": 55000.0}) is None
+    bp = parse_binary(parse_parfile([
+        "BINARY ELL1", "PB 0.6", "A1 0.58", "TASC 50700.08162891",
+        "EPS1 1.2e-7", "EPS2 -7e-8", "PBDOT 1e-13"]))
+    assert bp.kind == "ELL1" and bp.param_names[2] == "TASC"
+    assert bp.tref_int == 50700 and 0 < bp.tref_frac < 1
+    assert bp.pbdot == 1e-13
+    # BINARY line optional when the element set disambiguates
+    bp = parse_binary({"PB": "67.8", "A1": "32.3", "T0": "55000.5",
+                       "ECC": "0.18", "OM": "276.4"})
+    assert bp.kind == "BT" and bp.ecc == 0.18
+    with pytest.raises(ValueError, match="not implemented"):
+        parse_binary({"BINARY": "DD", "PB": 1.0, "A1": 1.0,
+                      "T0": 55000.0})
+    with pytest.raises(ValueError, match="incomplete"):
+        parse_binary({"BINARY": "ELL1", "PB": 1.0, "A1": 1.0})
+    with pytest.raises(ValueError, match="underspecified"):
+        parse_binary({"PB": 1.0, "A1": 1.0})
+    with pytest.raises(ValueError, match="mixes ELL1"):
+        parse_binary({"PB": 1.0, "A1": 1.0, "TASC": 55000.0,
+                      "T0": 55000.0, "ECC": 0.1})
+    with pytest.raises(ValueError, match="eccentricity"):
+        parse_binary({"BINARY": "BT", "PB": 1.0, "A1": 1.0,
+                      "T0": 55000.0, "ECC": 0.99})
+    with pytest.raises(ValueError, match="PB must be positive"):
+        parse_binary({"BINARY": "ELL1", "PB": -1.0, "A1": 1.0,
+                      "TASC": 55000.0})
+
+
+def test_gls_refuses_unmodeled_binary_keys():
+    """Shapiro/relativistic keys still refuse loudly — INCLUDING the
+    orthometric ELL1 parameterization H3/H4/STIG, which slipped PAST
+    the old refusal list and would have been silently mistimed."""
+    toas, _ = fake_timing_campaign(
+        {"PSR": "X", "F0": "300.0", "PEPOCH": "55500", "DM": "10"},
+        n_epochs=4, rng=1)
+    base = {"PSR": "X", "F0": "300.0", "PEPOCH": "55500", "DM": "10",
+            "BINARY": "ELL1", "PB": "0.6", "A1": "0.58",
+            "TASC": "55499.1", "EPS1": "1e-6", "EPS2": "-5e-7"}
+    for key in ("H3", "H4", "STIG", "SINI", "M2", "GAMMA", "OMDOT",
+                "FB0", "SHAPMAX"):
+        par = dict(base)
+        par[key] = "1e-7"
+        with pytest.raises(ValueError, match=key):
+            wideband_gls_fit(toas, par)
+    # ... and the message points at the modeled alternative
+    par = dict(base)
+    par["H3"] = "1e-7"
+    with pytest.raises(ValueError, match="Shapiro"):
+        wideband_gls_fit(toas, par)
+    for key in ("H3", "H4", "STIG"):
+        from pulseportraiture_tpu.timing.gls import _BINARY_KEYS
+
+        assert key in _BINARY_KEYS
+
+
+# ---------------------------------------------------------------------------
+# archive-free campaigns (the fleet fixture)
+# ---------------------------------------------------------------------------
+
+def test_fake_timing_campaign_recovers_injections():
+    par = {"PSR": "F", "F0": "245.4261196898081", "PEPOCH": "55500",
+           "DM": "10.39", "BINARY": "ELL1", "PB": "0.60467271355",
+           "A1": "0.0581817", "TASC": "55499.08162891",
+           "EPS1": "1.2e-6", "EPS2": "-7e-7"}
+    truth = {"PB": 0.60467271355 + 3e-9, "A1": 0.0581817 + 2e-7,
+             "F0": 245.4261196898081 * (1.0 + 2e-13)}
+    toas, tb = fake_timing_campaign(par, truth=truth, n_epochs=12,
+                                    toas_per_epoch=3, span_days=120.0,
+                                    toa_err_us=0.1, dmx=3e-4, rng=7)
+    assert len(toas) == 36 and toas[0].frequency == np.inf
+    res = wideband_gls_fit(toas, par)
+    assert 0.5 < res.red_chi2 < 2.0, res.red_chi2
+    for k in ("PB", "A1", "F0"):
+        assert res.params[k] == pytest.approx(
+            tb.injected[k], abs=4.0 * res.param_errs[k]), k
+    # per-epoch DMX recovered
+    np.testing.assert_allclose(res.dmx, tb.dmx,
+                               atol=4.0 * res.dmx_errs.max())
+    # BT campaigns work too
+    parb = {"PSR": "G", "F0": "180.0", "PEPOCH": "55500", "DM": "5",
+            "BINARY": "BT", "PB": "0.9", "A1": "0.4", "T0": "55499.4",
+            "ECC": "0.15", "OM": "100.0"}
+    toas, tb = fake_timing_campaign(parb, truth={"PB": 0.9 + 4e-9},
+                                    n_epochs=10, toas_per_epoch=2,
+                                    rng=9)
+    res = wideband_gls_fit(toas, parb)
+    assert res.binary.kind == "BT"
+    assert res.params["PB"] == pytest.approx(
+        4e-9, abs=4.0 * res.param_errs["PB"])
+    with pytest.raises(ValueError, match="dmx"):
+        fake_timing_campaign(par, dmx=np.zeros(3), n_epochs=4)
+
+
+# ---------------------------------------------------------------------------
+# tier-1 end-to-end: archives -> TOAs -> .tim -> timing solutions
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def binary_campaign(tmp_path_factory):
+    """Five spin-coherent ELL1 binary epochs with injected per-epoch
+    dDMs — the flagship scenario's binary variant."""
+    root = tmp_path_factory.mktemp("binary_timing")
+    model = default_test_model(1500.0)
+    gmodel = str(root / "model.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files = []
+    for i, dDM in enumerate(DDMS):
+        path = str(root / f"ep{i}.fits")
+        make_fake_pulsar(model, BPAR, outfile=path, nsub=3, nchan=32,
+                         nbin=256, nu0=1500.0, bw=800.0, tsub=120.0,
+                         phase=0.017, dDM=dDM,
+                         start_MJD=MJD(55100 + 23 * i, 0.2 + 0.13 * i),
+                         noise_stds=0.3, dedispersed=False, quiet=True,
+                         rng=500 + i, spin_coherent=True)
+        files.append(path)
+    gt = GetTOAs(files, gmodel, quiet=True)
+    gt.get_TOAs(quiet=True)
+    out = str(root / "binary.tim")
+    write_TOAs(gt.TOA_list, outfile=out)
+    return root, files, gmodel, out, gt
+
+
+def test_binary_campaign_whitens_with_true_par(binary_campaign):
+    _, _, _, tim, _ = binary_campaign
+    toas = read_tim(tim)
+    assert len(toas) == len(DDMS) * 3
+    par = parse_parfile([f"{k} {v}" for k, v in BPAR.items()])
+    res = wideband_gls_fit(toas, par)
+    assert res.binary is not None and res.binary.kind == "ELL1"
+    assert set(res.params) == {"OFFSET", "F0", "PB", "A1", "TASC",
+                               "EPS1", "EPS2"}
+    # white residuals at the TOA errors; the true orbit leaves every
+    # fitted correction consistent with zero
+    assert 0.3 < res.red_chi2 < 3.0, res.red_chi2
+    assert np.all(np.abs(res.time_resids_us) < 5.0 * res.toa_errs_us)
+    for k in ("PB", "A1", "TASC", "EPS1", "EPS2"):
+        assert abs(res.params[k]) < 5.0 * res.param_errs[k], k
+    # per-epoch DMX still recovered alongside the orbit
+    for j, dDM in enumerate(DDMS):
+        assert res.dmx[j] == pytest.approx(
+            dDM, abs=max(4.0 * res.dmx_errs[j], 3e-5)), (j, dDM)
+
+
+def test_binary_campaign_recovers_injected_orbit(binary_campaign):
+    """Fit with a PERTURBED parfile: the injected dPB/dA1 offsets must
+    come back as the fitted corrections, within reported errors (the
+    ISSUE 11 acceptance criterion)."""
+    _, _, _, tim, _ = binary_campaign
+    toas = read_tim(tim)
+    dPB, dA1 = 3e-6, 2e-4
+    par = dict(BPAR)
+    par["PB"] = BPAR["PB"] - dPB
+    par["A1"] = BPAR["A1"] - dA1
+    res = wideband_gls_fit(toas, par)
+    assert 0.3 < res.red_chi2 < 3.0
+    assert res.params["PB"] == pytest.approx(
+        dPB, abs=4.0 * res.param_errs["PB"])
+    assert res.params["A1"] == pytest.approx(
+        dA1, abs=4.0 * res.param_errs["A1"])
+    # the corrections are DETECTED, not just allowed (several sigma)
+    assert res.params["PB"] > 3.0 * res.param_errs["PB"]
+    assert res.params["A1"] > 3.0 * res.param_errs["A1"]
+    # a wildly-wrong orbit loses phase connection LOUDLY
+    bad = dict(BPAR)
+    bad["A1"] = 5.0
+    with pytest.raises(ValueError, match="phase connection"):
+        wideband_gls_fit(toas, bad)
+    res2 = wideband_gls_fit(toas, bad, allow_wraps=True)
+    assert np.isfinite(res2.chi2)
+
+
+def test_fleet_batched_digit_identity(binary_campaign, tmp_path):
+    """The fleet lane: batched device dispatches vs the per-pulsar
+    serial solve, digit-identical <= 1e-10 (acceptance criterion),
+    with the dispatch-count reduction and the timing trace section."""
+    _, _, _, tim, _ = binary_campaign
+    jobs = []
+    for i in range(5):
+        par = {"PSR": f"S{i}", "F0": str(190.0 + 11 * i),
+               "PEPOCH": "55500", "DM": str(12 + i)}
+        if i % 2 == 0:
+            par.update({"BINARY": "ELL1", "PB": str(0.5 + 0.1 * i),
+                        "A1": "0.05", "TASC": "55499.2",
+                        "EPS1": "1e-6", "EPS2": "-4e-7"})
+        toas, _ = fake_timing_campaign(par, n_epochs=6 + (i % 2),
+                                       toas_per_epoch=2, rng=50 + i)
+        jobs.append(TimingJob(f"S{i}", toas, par))
+    # the REAL campaign's .tim rides along as a sixth fleet member
+    jobs.append(TimingJob(
+        "J1012+5307", tim,
+        parse_parfile([f"{k} {v}" for k, v in BPAR.items()])))
+
+    trace = str(tmp_path / "fleet.jsonl")
+    batched = fleet_gls_fit(jobs, device=True, batched=True,
+                            telemetry=trace)
+    serial = fleet_gls_fit(jobs, device=True, batched=False)
+    host = fleet_gls_fit(jobs, device=False)
+    assert batched.n_dispatches < serial.n_dispatches == len(jobs)
+
+    def max_delta(a, b):
+        worst = 0.0
+        for name in a.pulsars:
+            ra, rc = a.results[name], b.results[name]
+            pairs = [(ra.params[k], rc.params[k], ra.param_errs[k])
+                     for k in ra.params]
+            pairs += list(zip(ra.dmx, rc.dmx, ra.dmx_errs))
+            for va, vc, err in pairs:
+                worst = max(worst, abs(va - vc)
+                            / max(abs(vc), float(err), 1e-300))
+        return worst
+
+    assert max_delta(batched, serial) <= 1e-10
+    assert max_delta(batched, host) <= 1e-8
+    # per-pulsar results equal the single-pulsar entry point
+    solo = wideband_gls_fit(read_tim(tim), parse_parfile(
+        [f"{k} {v}" for k, v in BPAR.items()]))
+    rb = batched.results["J1012+5307"]
+    for k in solo.params:
+        assert rb.params[k] == pytest.approx(
+            solo.params[k], rel=1e-8,
+            abs=1e-8 * max(solo.param_errs[k], 1e-300)), k
+
+    manifest, events = telemetry.validate_trace(trace)
+    fits = [e for e in events if e["type"] == "timing_fit"]
+    assert fits and all(e["batched"] for e in fits)
+    assert sum(e["rows"] for e in fits) == len(jobs)
+    assert len(fits) == batched.n_dispatches
+    ends = [e for e in events if e["type"] == "fleet_end"]
+    assert ends[-1]["n_pulsars"] == len(jobs)
+    assert manifest["config"]["gls_device"] == config.gls_device
+    with open(os.devnull, "w") as sink:
+        summary = telemetry.report(trace, file=sink)
+    assert summary["n_timing_fit"] == batched.n_dispatches
+    assert summary["n_timing_pulsars"] == len(jobs)
+    assert summary["timing_dispatches"] == batched.n_dispatches
+    assert summary["timing_pad_frac"] is not None
+
+
+def test_ipta_campaign_runs_timing_stage(binary_campaign, tmp_path):
+    """stream_ipta_campaign(timing_pars=): archives -> TOAs ->
+    per-pulsar timing solutions in ONE traced pipeline."""
+    from pulseportraiture_tpu.pipeline import IPTAJob, stream_ipta_campaign
+
+    root, files, gmodel, tim, _ = binary_campaign
+    par = parse_parfile([f"{k} {v}" for k, v in BPAR.items()])
+    trace = str(tmp_path / "campaign.jsonl")
+    res = stream_ipta_campaign(
+        [IPTAJob("J1012+5307", files, gmodel)],
+        outdir=str(tmp_path / "tims"), nsub_batch=8, quiet=True,
+        telemetry=trace, timing_pars={"J1012+5307": par},
+        timing_kwargs={"device": True})
+    assert res.timing is not None
+    assert res.timing.pulsars == ["J1012+5307"]
+    tres = res.timing.results["J1012+5307"]
+    assert tres.binary.kind == "ELL1"
+    # same TOAs as the offline .tim path -> same solution up to the
+    # .tim formatting round-trip (15-decimal MJD, 7-decimal -pp_dm,
+    # 3-decimal error), which perturbs parameters at ~1e-3 of their
+    # errors — far inside any scientific tolerance
+    solo = wideband_gls_fit(read_tim(tim), par)
+    for k in solo.params:
+        assert tres.params[k] == pytest.approx(
+            solo.params[k], abs=1e-2 * max(solo.param_errs[k], 1e-300)
+            + 1e-14), k
+    # the campaign trace carries BOTH the TOA stage and the timing
+    # stage — one pipeline, one trace
+    manifest, events = telemetry.validate_trace(trace)
+    etypes = {e["type"] for e in events}
+    for needed in ("campaign_start", "dispatch", "pulsar_done",
+                   "timing_fit", "fleet_end", "campaign_end"):
+        assert needed in etypes, needed
+    # refusals: unknown pulsar names, and resume=True (a resumed run's
+    # TOA_list covers only this run's archives — timing it would
+    # silently fit a subsampled campaign)
+    with pytest.raises(ValueError, match="not in jobs"):
+        stream_ipta_campaign([IPTAJob("J1012+5307", files, gmodel)],
+                             timing_pars={"NOPE": par}, quiet=True)
+    with pytest.raises(ValueError, match="resume"):
+        stream_ipta_campaign([IPTAJob("J1012+5307", files, gmodel)],
+                             outdir=str(tmp_path / "tims"), resume=True,
+                             timing_pars={"J1012+5307": par},
+                             quiet=True)
+
+
+# ---------------------------------------------------------------------------
+# satellites: env knobs, zap device lane
+# ---------------------------------------------------------------------------
+
+def test_gls_zap_env_hooks(monkeypatch, capsys):
+    """PPT_GLS_DEVICE / PPT_ZAP_DEVICE: registered, strict parses,
+    did-you-mean on a typo."""
+    old = (config.gls_device, config.zap_device)
+    try:
+        for name in ("PPT_GLS_DEVICE", "PPT_ZAP_DEVICE"):
+            assert name in config.KNOWN_PPT_ENV
+        monkeypatch.setenv("PPT_GLS_DEVICE", "on")
+        monkeypatch.setenv("PPT_ZAP_DEVICE", "off")
+        changed = config.env_overrides()
+        assert "gls_device" in changed and "zap_device" in changed
+        assert config.gls_device is True
+        assert config.zap_device is False
+        monkeypatch.setenv("PPT_GLS_DEVICE", "auto")
+        config.env_overrides()
+        assert config.gls_device == "auto"
+        monkeypatch.setenv("PPT_GLS_DEVICE", "fast")
+        with pytest.raises(ValueError, match="PPT_GLS_DEVICE"):
+            config.env_overrides()
+        monkeypatch.setenv("PPT_GLS_DEVICE", "on")
+        monkeypatch.setenv("PPT_ZAP_DEVICE", "2")
+        with pytest.raises(ValueError, match="PPT_ZAP_DEVICE"):
+            config.env_overrides()
+        monkeypatch.delenv("PPT_GLS_DEVICE")
+        monkeypatch.delenv("PPT_ZAP_DEVICE")
+        monkeypatch.setattr(config, "_warned_unknown_ppt", set())
+        monkeypatch.setenv("PPT_GLS_DEVISE", "on")  # the typo
+        config.env_overrides()
+        err = capsys.readouterr().err
+        assert "PPT_GLS_DEVISE" in err
+        assert "PPT_GLS_DEVICE" in err  # did-you-mean hint
+        monkeypatch.delenv("PPT_GLS_DEVISE")
+    finally:
+        config.gls_device, config.zap_device = old
+
+
+def test_resolve_tristate_refusals():
+    from pulseportraiture_tpu.pipeline.zap import resolve_zap_device
+    from pulseportraiture_tpu.timing.fleet import resolve_gls_device
+
+    assert resolve_gls_device(True) is True
+    assert resolve_gls_device(False) is False
+    assert resolve_gls_device("auto") is False  # CPU test backend
+    assert resolve_zap_device("auto") is False
+    with pytest.raises(ValueError, match="gls_device"):
+        resolve_gls_device("fast")
+    with pytest.raises(ValueError, match="zap_device"):
+        resolve_zap_device("fast")
+
+
+def test_zap_device_digit_identity(tmp_path):
+    """The median-algorithm zap proposals through the device op equal
+    the host path exactly (ROADMAP item 4 down payment)."""
+    from pulseportraiture_tpu.io.psrfits import load_data
+    from pulseportraiture_tpu.pipeline.zap import get_zap_channels
+
+    path = str(tmp_path / "z.fits")
+    noise = np.full(64, 0.05)
+    noise[[3, 17, 40, 41]] = [0.4, 0.9, 0.3, 0.25]
+    make_fake_pulsar(default_test_model(1500.0),
+                     {"PSR": "Z", "P0": 0.004, "PEPOCH": 55000.0,
+                      "DM": 5.0},
+                     outfile=path, nsub=2, nchan=64, nbin=128,
+                     nu0=1500.0, bw=800.0, tsub=60.0, noise_stds=noise,
+                     dedispersed=True, quiet=True, rng=11)
+    d = load_data(path, dedisperse=False, tscrunch=False,
+                  pscrunch=True, quiet=True)
+    host = get_zap_channels(d, nstd=3, device=False)
+    dev = get_zap_channels(d, nstd=3, device=True)
+    assert host == dev
+    assert host[0], "fixture produced no zap proposals"
+    assert 3 in host[0] and 17 in host[0]
+    # the f32 streaming dtype rides the bit-exact device op too
+    d.noise_stds = d.noise_stds.astype(np.float32)
+    assert get_zap_channels(d, device=True) == \
+        get_zap_channels(d, device=False)
+
+
+def test_toas_from_measurements_roundtrip(binary_campaign):
+    """The in-memory TOA adapter equals the .tim write/read round-trip
+    up to the 15-decimal MJD formatting."""
+    _, _, _, tim, gt = binary_campaign
+    direct = toas_from_measurements(gt.TOA_list)
+    disk = read_tim(tim)
+    assert len(direct) == len(disk)
+    for a, b in zip(direct, disk):
+        assert a.mjd_int == b.mjd_int
+        assert a.mjd_frac == pytest.approx(b.mjd_frac, abs=1e-14)
+        assert a.dm == pytest.approx(b.dm, abs=1e-6)
+        assert a.error_us == pytest.approx(b.error_us, abs=1e-3)
