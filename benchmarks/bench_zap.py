@@ -1,0 +1,201 @@
+"""Inline-device vs host-offline RFI excision A/B (ISSUE 12
+acceptance gate).
+
+Arms over one synthetic RFI-contaminated campaign (narrowband tones +
+a broadband burst, known ground truth):
+
+  offline  — the pre-ISSUE-12 workflow: per-archive ppzap median
+             proposals with HOST statistics (the reference loop — one
+             (median, std) pull per iteration per subint), then the
+             fit with the lists applied as lossless weight zaps
+             (``zap_channels=``);
+  inline   — ``zap_inline=True``: the cut FUSED into the raw bucket's
+             device program (the whole iteration inside the compiled
+             while_loop on the device-resident noise levels), masks
+             zeroed before the fit consumes them;
+  device   — the standalone batched device proposal
+             (one dispatch per archive), timed against the host
+             proposal loop: the zap-wall A/B.
+
+Gates, enforced EVERY run (tiny CI smoke shapes included):
+
+  zap_digit_ok   — host and device flagged-channel lists identical on
+                   the whole corpus (the excision digit gate);
+  truth_ok       — the injector's ground-truth channels are all
+                   recovered;
+  tim_identical  — inline .tim == offline-oracle .tim, byte-for-byte;
+  clean_ok       — on the CLEAN control corpus the cut flags nothing
+                   and .tim with the quality machinery on equals the
+                   plain run byte-for-byte.
+
+Under PPT_TELEMETRY the inline arm's trace is schema-validated and
+must carry the zap_apply ledger.  Knobs: PPT_NARCH (default 8),
+PPT_NSUB (4), PPT_NCHAN (32), PPT_NBIN (256).  Prints ONE JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    import pulseportraiture_tpu  # noqa: F401
+    from pulseportraiture_tpu import config
+    config.env_overrides()
+
+    import numpy as np
+
+    from pulseportraiture_tpu import telemetry
+    from pulseportraiture_tpu.io.gmodel import write_gmodel
+    from pulseportraiture_tpu.io.psrfits import load_data
+    from pulseportraiture_tpu.pipeline.stream import stream_wideband_TOAs
+    from pulseportraiture_tpu.pipeline.zap import get_zap_channels
+    from pulseportraiture_tpu.synth import (default_test_model,
+                                            inject_rfi, make_fake_pulsar)
+
+    NARCH = int(os.environ.get("PPT_NARCH", 8))
+    NSUB = int(os.environ.get("PPT_NSUB", 4))
+    NCHAN = int(os.environ.get("PPT_NCHAN", 32))
+    NBIN = int(os.environ.get("PPT_NBIN", 256))
+    # the inline arm owns the trace explicitly; without this, every
+    # driver call in the bench would pick the PPT_TELEMETRY path up
+    # from config and rotate the arm-under-test's trace away
+    trace_path = config.telemetry_path
+    config.telemetry_path = None
+
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="ppt_zap_bench_")
+    model = default_test_model(1500.0)
+    gmodel = os.path.join(root, "model.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    par = {"PSR": "J1744-1134", "P0": 0.004074, "PEPOCH": 55000.0,
+           "DM": 3.139}
+
+    def corpus(tag, contaminated):
+        files, truths = [], []
+        for i in range(NARCH):
+            path = os.path.join(root, f"{tag}{i}.fits")
+            make_fake_pulsar(model, par, outfile=path, nsub=NSUB,
+                             nchan=NCHAN, nbin=NBIN, nu0=1500.0,
+                             bw=800.0, tsub=60.0, phase=0.003 * i,
+                             dDM=1e-4 * (i % 3 - 1), noise_stds=0.05,
+                             dedispersed=False, quiet=True, rng=500 + i)
+            if contaminated:
+                # <= 2 contaminated channels per cut round (masking
+                # breakdown margin, see tests/test_quality.py)
+                tones = [(3 + 5 * i) % NCHAN, (11 + 7 * i) % NCHAN]
+                if tones[0] == tones[1]:
+                    tones[1] = (tones[1] + 1) % NCHAN
+                truths.append(inject_rfi(
+                    path, tone_channels=tones, tone_white=8.0,
+                    tone_structured=40.0,
+                    bursts=[(i % NSUB, [(20 + i) % NCHAN], 20.0)],
+                    rng=900 + i))
+            files.append(path)
+        return files, truths
+
+    rfi_files, truths = corpus("rfi", True)
+    clean_files, _ = corpus("clean", False)
+
+    # ---- proposals: host loop vs one-dispatch device lane ------------
+    loads = {f: load_data(f, dedisperse=False, dededisperse=True,
+                          pscrunch=True, quiet=True)
+             for f in rfi_files}
+    t0 = time.perf_counter()
+    host_lists = {f: get_zap_channels(d, device=False)
+                  for f, d in loads.items()}
+    host_zap_s = time.perf_counter() - t0
+    # one throwaway call compiles the program; then time warm
+    get_zap_channels(loads[rfi_files[0]], device=True)
+    t0 = time.perf_counter()
+    dev_lists = {f: get_zap_channels(d, device=True)
+                 for f, d in loads.items()}
+    dev_zap_s = time.perf_counter() - t0
+    zap_digit_ok = host_lists == dev_lists
+
+    truth_ok = True
+    zap_map = dict(host_lists)  # rows indexed by true subint number
+    for f, tr in zip(rfi_files, truths):
+        for isub, expect in enumerate(tr.zap_truth):
+            if not set(expect) <= set(zap_map[f][isub]):
+                truth_ok = False
+
+    # ---- fits: offline oracle vs fused inline ------------------------
+    tim_off = os.path.join(root, "offline.tim")
+    tim_inl = os.path.join(root, "inline.tim")
+    t0 = time.perf_counter()
+    stream_wideband_TOAs(rfi_files, gmodel, nsub_batch=max(NSUB, 8),
+                         quiet=True, tim_out=tim_off,
+                         zap_channels=zap_map)
+    offline_fit_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    res = stream_wideband_TOAs(rfi_files, gmodel,
+                               nsub_batch=max(NSUB, 8), quiet=True,
+                               tim_out=tim_inl, zap_inline=True,
+                               telemetry=trace_path)
+    inline_fit_s = time.perf_counter() - t0
+    tim_identical = (open(tim_off, "rb").read()
+                     == open(tim_inl, "rb").read())
+
+    # ---- clean control: the quality machinery must be a no-op --------
+    clean_flags = 0
+    for f in clean_files:
+        d = load_data(f, dedisperse=False, dededisperse=True,
+                      pscrunch=True, quiet=True)
+        clean_flags += sum(len(z) for z in
+                           get_zap_channels(d, device=False))
+    tim_ca = os.path.join(root, "clean_plain.tim")
+    tim_cb = os.path.join(root, "clean_inline.tim")
+    stream_wideband_TOAs(clean_files, gmodel, nsub_batch=max(NSUB, 8),
+                         quiet=True, tim_out=tim_ca)
+    stream_wideband_TOAs(clean_files, gmodel, nsub_batch=max(NSUB, 8),
+                         quiet=True, tim_out=tim_cb, zap_inline=True)
+    clean_ok = (clean_flags == 0
+                and open(tim_ca, "rb").read()
+                == open(tim_cb, "rb").read())
+
+    trace_ok = None
+    if trace_path:
+        manifest, events = telemetry.validate_trace(trace_path)
+        apps = [e for e in events if e["type"] == "zap_apply"]
+        assert len(apps) == len(rfi_files), (
+            f"expected one zap_apply per archive, got {len(apps)}")
+        assert sum(e["n_channels"] for e in apps) == sum(
+            sum(len(z) for z in full) for full in zap_map.values())
+        trace_ok = True
+
+    assert zap_digit_ok, "host/device flagged-channel lists diverged"
+    assert truth_ok, "injected ground-truth channels not recovered"
+    assert tim_identical, "inline .tim != offline-oracle .tim"
+    assert clean_ok, "quality machinery perturbed a clean corpus"
+
+    n_cut = sum(sum(len(z) for z in full) for full in zap_map.values())
+    out = {
+        "metric": "zap_host_vs_device_wall",
+        "value": host_zap_s / max(dev_zap_s, 1e-9),
+        "unit": "x (host proposal wall / one-dispatch device wall)",
+        "narch": NARCH, "nsub": NSUB, "nchan": NCHAN, "nbin": NBIN,
+        "host_zap_s": round(host_zap_s, 4),
+        "device_zap_s": round(dev_zap_s, 4),
+        "offline_fit_s": round(offline_fit_s, 3),
+        "inline_fit_s": round(inline_fit_s, 3),
+        "inline_toas_per_s": round(
+            len(res.TOA_list) / max(inline_fit_s, 1e-9), 2),
+        "channels_cut": int(n_cut),
+        "zap_digit_ok": bool(zap_digit_ok),
+        "truth_ok": bool(truth_ok),
+        "tim_identical": bool(tim_identical),
+        "clean_ok": bool(clean_ok),
+        "trace_ok": trace_ok,
+        "backend": __import__("jax").default_backend(),
+    }
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -4,7 +4,10 @@ Flag parity: reference ppzap.py:107-253.  Model-based path runs the
 full GetTOAs fit and flags channels by red-chi2/S-N; model-less path
 uses the iterative median algorithm on channel noise levels.  Beyond
 the reference (which only prints `paz` commands), --apply edits the
-archive weights directly.
+archive weights directly, --telemetry emits the same ``zap_propose``/
+``zap_apply`` events the inline streaming lane traces (so offline and
+inline excision are analyzed with one pptrace report), and the device
+lane runs each archive's whole iterative cut in ONE dispatch.
 """
 
 import argparse
@@ -16,8 +19,10 @@ def build_parser():
         prog="ppzap", description=__doc__.splitlines()[0])
     p.add_argument("-d", "--datafiles", required=True,
                    help="PSRFITS archive or metafile of archive names.")
-    p.add_argument("-n", "--num_std", dest="nstd", type=float, default=3.0,
-                   help="Threshold [std] for the median algorithm.")
+    p.add_argument("-n", "--num_std", dest="nstd", type=float,
+                   default=None,
+                   help="Threshold [std] for the median algorithm "
+                        "(default: config.zap_nstd / PPT_ZAP_NSTD).")
     p.add_argument("-N", "--norm", default=None,
                    choices=(None, "mean", "max", "prof", "rms", "abs"),
                    help="Normalize before the median algorithm.")
@@ -29,7 +34,11 @@ def build_parser():
     p.add_argument("-R", "--rchi2-threshold", dest="rchi2_threshold",
                    type=float, default=1.3)
     p.add_argument("-o", "--outfile", default=None,
-                   help="Append the paz commands to this file.")
+                   help="Write the paz commands to this file.")
+    p.add_argument("--append", action="store_true", default=False,
+                   help="Append to --outfile instead of overwriting "
+                        "(the old always-append behavior silently "
+                        "duplicated commands on reruns).")
     p.add_argument("--modify", action="store_true", default=False,
                    help="Print paz -m (modify in place) commands.")
     p.add_argument("--apply", action="store_true", default=False,
@@ -39,9 +48,14 @@ def build_parser():
                    help="Save a channel red-chi2 histogram (model path).")
     p.add_argument("--zap-device", default=None,
                    choices=("off", "auto", "on"),
-                   help="Route the median-algorithm statistics through "
-                        "the device op (default: config.zap_device / "
-                        "PPT_ZAP_DEVICE; digit-identical either way).")
+                   help="Route the zap cut through the batched device "
+                        "program (default: config.zap_device / "
+                        "PPT_ZAP_DEVICE; flagged lists are digit-"
+                        "identical either way).")
+    p.add_argument("--telemetry", metavar="trace.jsonl", default=None,
+                   help="Append zap_propose/zap_apply events to this "
+                        "JSONL trace (default: PPT_TELEMETRY / "
+                        "config.telemetry_path; analyze with pptrace).")
     p.add_argument("--quiet", action="store_true", default=False)
     return p
 
@@ -51,67 +65,76 @@ def main(argv=None):
     from ..io.psrfits import load_data
     from ..pipeline.toas import GetTOAs, _is_metafile, _read_metafile
     from ..pipeline.zap import apply_zaps, get_zap_channels, print_paz_cmds
+    from ..telemetry import resolve_tracer
 
     if _is_metafile(args.datafiles):
         datafiles = _read_metafile(args.datafiles)
     else:
         datafiles = [args.datafiles]
 
-    if args.modelfile:
-        gt = GetTOAs(datafiles, args.modelfile, quiet=True)
-        gt.get_TOAs(tscrunch=args.tscrunch, quiet=True)
-        zap_list = gt.get_channels_to_zap(
-            SNR_threshold=args.SNR_threshold,
-            rchi2_threshold=args.rchi2_threshold)
-        # zap_list is aligned with gt.order (archives that actually
-        # fitted), which may be shorter than datafiles if any were
-        # skipped — keep the pairing consistent downstream
-        datafiles = list(gt.order)
-        if args.hist:
-            import matplotlib
+    device = (None if args.zap_device is None else
+              {"off": False, "auto": "auto", "on": True}[args.zap_device])
+    tracer, own_tracer = resolve_tracer(args.telemetry, run="ppzap")
+    try:
+        if args.modelfile:
+            gt = GetTOAs(datafiles, args.modelfile, quiet=True)
+            gt.get_TOAs(tscrunch=args.tscrunch, quiet=True)
+            zap_list = gt.get_channels_to_zap(
+                SNR_threshold=args.SNR_threshold,
+                rchi2_threshold=args.rchi2_threshold,
+                device=device, telemetry=tracer)
+            # zap_list is aligned with gt.order (archives that actually
+            # fitted), which may be shorter than datafiles if any were
+            # skipped — keep the pairing consistent downstream
+            datafiles = list(gt.order)
+            if args.hist:
+                import matplotlib
 
-            matplotlib.use("Agg", force=True)
-            import matplotlib.pyplot as plt
-            import numpy as np
+                matplotlib.use("Agg", force=True)
+                import matplotlib.pyplot as plt
+                import numpy as np
 
-            vals = np.concatenate(
-                [r[np.isfinite(r)] for r in
-                 (np.asarray(x).ravel() for x in gt.red_chi2s)])
-            fig, ax = plt.subplots()
-            ax.hist(vals, bins=30, color="0.3")
-            ax.axvline(args.rchi2_threshold, color="r")
-            ax.set_xlabel(r"red-$\chi^2$")
-            fig.savefig(args.datafiles + ".rchi2.png",
-                        bbox_inches="tight")
-    else:
-        zap_list = []
-        for path in datafiles:
-            d = load_data(path, dedisperse=False, dededisperse=True,
-                          tscrunch=args.tscrunch, pscrunch=True,
-                          quiet=True)
-            if args.norm:
-                from ..pipeline.portrait import normalize_portrait
+                vals = np.concatenate(
+                    [r[np.isfinite(r)] for r in
+                     (np.asarray(x).ravel() for x in gt.red_chi2s)])
+                fig, ax = plt.subplots()
+                ax.hist(vals, bins=30, color="0.3")
+                ax.axvline(args.rchi2_threshold, color="r")
+                ax.set_xlabel(r"red-$\chi^2$")
+                fig.savefig(args.datafiles + ".rchi2.png",
+                            bbox_inches="tight")
+        else:
+            zap_list = []
+            for path in datafiles:
+                d = load_data(path, dedisperse=False, dededisperse=True,
+                              tscrunch=args.tscrunch, pscrunch=True,
+                              quiet=True)
+                if args.norm:
+                    from ..pipeline.portrait import normalize_portrait
 
-                for isub in d.ok_isubs:
-                    d.subints[isub, 0] = normalize_portrait(
-                        d.subints[isub, 0], args.norm)
-                    from ..io.psrfits import noise_std_ps
+                    for isub in d.ok_isubs:
+                        d.subints[isub, 0] = normalize_portrait(
+                            d.subints[isub, 0], args.norm)
+                        from ..io.psrfits import noise_std_ps
 
-                    d.noise_stds[isub, 0] = noise_std_ps(
-                        d.subints[isub, 0])
-            zap_list.append(get_zap_channels(
-                d, nstd=args.nstd,
-                device={None: None, "off": False, "auto": "auto",
-                        "on": True}[args.zap_device]))
+                        d.noise_stds[isub, 0] = noise_std_ps(
+                            d.subints[isub, 0])
+                zap_list.append(get_zap_channels(
+                    d, nstd=args.nstd, device=device, tracer=tracer))
 
-    total = sum(sum(len(z) for z in arch) for arch in zap_list)
-    if not args.quiet:
-        print(f"{total} channel entries flagged.")
-    print_paz_cmds(datafiles, zap_list, modify=args.modify,
-                   outfile=args.outfile, quiet=args.quiet)
-    if args.apply:
-        for iarch, path in enumerate(datafiles):
-            apply_zaps(path, zap_list[iarch], quiet=args.quiet)
+        total = sum(sum(len(z) for z in arch) for arch in zap_list)
+        if not args.quiet:
+            print(f"{total} channel entries flagged.")
+        print_paz_cmds(datafiles, zap_list, modify=args.modify,
+                       outfile=args.outfile, quiet=args.quiet,
+                       append=args.append)
+        if args.apply:
+            for iarch, path in enumerate(datafiles):
+                apply_zaps(path, zap_list[iarch], quiet=args.quiet,
+                           tracer=tracer)
+    finally:
+        if own_tracer:
+            tracer.close()
     return 0
 
 

@@ -79,14 +79,42 @@ gauss_device = "auto"
 # <= 1e-10 — and stays the CPU default.
 gls_device = "auto"
 
-# Route pipeline/zap.py's iterative median + nstd noise cut through
-# the device op ops/noise.exact_median_lastaxis (ROADMAP item 4 down
-# payment: excision math on device, where the streaming lane's
-# noise_stds already live).  'auto' = on TPU backends; True/False
-# force.  Digit-identical to the host path: the op IS jnp.median
-# bit-for-bit (and exact order statistics match np.median), guarded by
-# tests/test_timing_binary.py's zap parity test.
+# Route the median-algorithm zap statistics (pipeline/zap.py +
+# quality/excision.py) through the batched device cut: the WHOLE
+# iterative median + nstd cut for every subint runs inside one jitted
+# while_loop — one dispatch per archive, zero per-iteration host round
+# trips.  'auto' = on TPU backends; True/False force.  The host lane
+# (the reference loop vectorized) is the digit oracle: the masked
+# median is bit-exact on device (order-statistic bisection), the std
+# agrees to ~1 ulp of accumulation, and the flagged-channel LISTS are
+# gated identical by tests + bench_zap every run.
 zap_device = "auto"
+
+# Threshold [standard deviations] of the median-algorithm channel cut
+# (the reference's hard-coded nstd=3, ppzap.py:30): a channel whose
+# noise level exceeds median + zap_nstd*std of the surviving channels
+# is flagged, iteratively.  Shared by ppzap, the streaming drivers'
+# inline zap (zap_inline=), and the serving loop's refit proposals.
+zap_nstd = 3.0
+
+# --- Quality-gated refit (serve/server.ToaServer) --------------------------
+# Master switch for the serving loop's closed quality loop: a request
+# archive whose fitted TOAs trip the thresholds below triggers exactly
+# ONE automatic zap-and-refit of that archive through the same warm
+# lanes before its .tim is demuxed (loud when the refit cannot help or
+# still trips).  Off by default: .tim output is byte-identical with
+# the loop on or off for data that never trips a gate.
+quality_refit = False
+
+# A TOA whose goodness-of-fit (reduced chi^2, the -gof flag) exceeds
+# this trips the refit gate.  The default matches the reference
+# model-based zap threshold (ppzap -R, pptoas.py:1279).
+quality_max_gof = 1.3
+
+# A TOA whose S/N falls below this trips the refit gate; 0 disables
+# the S/N gate (low S/N is usually irreducible, not zappable — opt in
+# when RFI is known to suppress the matched filter).
+quality_min_snr = 0.0
 
 # Matmul-DFT precision (ops/fourier.py) on accelerators:
 # 'highest' = 6-pass bf16 (f32-exact to ~1e-7), 'high' = 3-pass
@@ -335,6 +363,10 @@ RCSTRINGS = {
 #   PPT_GAUSS_DEVICE=off|auto|on    -> gauss_device
 #   PPT_GLS_DEVICE=off|auto|on      -> gls_device
 #   PPT_ZAP_DEVICE=off|auto|on      -> zap_device
+#   PPT_ZAP_NSTD=<float>            -> zap_nstd
+#   PPT_QUALITY_REFIT=off|on        -> quality_refit
+#   PPT_QUALITY_MAX_GOF=<float>     -> quality_max_gof
+#   PPT_QUALITY_MIN_SNR=<float>     -> quality_min_snr
 #   PPT_STREAM_DEVICES=auto|<N>     -> stream_devices
 #   PPT_MAX_INFLIGHT=<N>            -> stream_max_inflight
 #   PPT_PIPELINE_DEPTH=<N>          -> stream_pipeline_depth
@@ -363,7 +395,8 @@ KNOWN_PPT_ENV = frozenset({
     # config hooks (this module)
     "PPT_XSPEC", "PPT_DFT_PRECISION", "PPT_DFT_FOLD",
     "PPT_ALIGN_DEVICE", "PPT_GAUSS_DEVICE",
-    "PPT_GLS_DEVICE", "PPT_ZAP_DEVICE",
+    "PPT_GLS_DEVICE", "PPT_ZAP_DEVICE", "PPT_ZAP_NSTD",
+    "PPT_QUALITY_REFIT", "PPT_QUALITY_MAX_GOF", "PPT_QUALITY_MIN_SNR",
     "PPT_STREAM_DEVICES", "PPT_MAX_INFLIGHT",
     "PPT_PIPELINE_DEPTH", "PPT_COMPILE_CACHE", "PPT_TELEMETRY",
     "PPT_SERVE_MAX_WAIT_MS", "PPT_SERVE_QUEUE_DEPTH", "PPT_BUCKET_PAD",
@@ -470,6 +503,52 @@ def env_overrides():
                     f"{val!r}")
             setattr(cfg, attr, table[val])
             changed.append(attr)
+    znstd = _os.environ.get("PPT_ZAP_NSTD", "")
+    if znstd:
+        try:
+            v = float(znstd)
+        except ValueError:
+            raise ValueError(
+                "PPT_ZAP_NSTD must be a positive number of standard "
+                f"deviations, got {znstd!r}")
+        if not v > 0:
+            raise ValueError(f"PPT_ZAP_NSTD must be > 0, got {v}")
+        cfg.zap_nstd = v
+        changed.append("zap_nstd")
+    qref = _os.environ.get("PPT_QUALITY_REFIT", "").lower()
+    if qref:
+        table = {"off": False, "false": False, "on": True, "true": True}
+        if qref not in table:
+            raise ValueError(
+                f"PPT_QUALITY_REFIT must be 'off' or 'on', got {qref!r}")
+        cfg.quality_refit = table[qref]
+        changed.append("quality_refit")
+    qgof = _os.environ.get("PPT_QUALITY_MAX_GOF", "")
+    if qgof:
+        try:
+            v = float(qgof)
+        except ValueError:
+            raise ValueError(
+                "PPT_QUALITY_MAX_GOF must be a positive reduced-chi^2 "
+                f"threshold, got {qgof!r}")
+        if not v > 0:
+            raise ValueError(
+                f"PPT_QUALITY_MAX_GOF must be > 0, got {v}")
+        cfg.quality_max_gof = v
+        changed.append("quality_max_gof")
+    qsnr = _os.environ.get("PPT_QUALITY_MIN_SNR", "")
+    if qsnr:
+        try:
+            v = float(qsnr)
+        except ValueError:
+            raise ValueError(
+                "PPT_QUALITY_MIN_SNR must be a non-negative S/N "
+                f"threshold (0 disables), got {qsnr!r}")
+        if v < 0:
+            raise ValueError(
+                f"PPT_QUALITY_MIN_SNR must be >= 0, got {v}")
+        cfg.quality_min_snr = v
+        changed.append("quality_min_snr")
     sdev = _os.environ.get("PPT_STREAM_DEVICES", "").lower()
     if sdev:
         if sdev == "auto":

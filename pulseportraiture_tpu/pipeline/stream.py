@@ -1040,13 +1040,18 @@ def _raw_decode(raw, scl, offs, nbin, ft, redisp=False,
     return x
 
 
-def _raw_stats(x, cmask, freqs, ft, tiny):
+def _raw_stats(x, cmask, freqs, ft, tiny, noise=None):
     """Stage 2 of the fused raw-bucket program: power-spectrum noise,
     equivalent-width S/N (sort-free exact median — see
     ops.noise.exact_median_lastaxis; the XLA-sort median used to be the
     single most expensive stage of the whole bucket), and the
-    S/N-weighted nu_fit seed.  Returns (noise, snr, nu_fit)."""
-    noise = jnp.maximum(get_noise_PS(x), tiny)
+    S/N-weighted nu_fit seed.  Returns (noise, snr, nu_fit).
+
+    ``noise`` pre-computed lets the inline-zap lane reuse the noise it
+    cut on while the S/N and nu_fit derive from the POST-zap mask —
+    exactly what fitting an offline-zapped archive computes."""
+    if noise is None:
+        noise = jnp.maximum(get_noise_PS(x), tiny)
     snr = get_SNR(x, noise) * cmask
     # S/N * nu^-2-weighted center-of-mass frequency (host mirror:
     # pipeline.toas.snr_weighted_nu_fit; reference pplib.py:2715)
@@ -1063,7 +1068,7 @@ def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
                 use_fast, ftname, x_bf16, redisp=False,
                 want_flux=False, use_ir=False, compensated=False,
                 nharm_eff=None, seed_derotate=True, raw_code="i16",
-                pol_sum=False):
+                pol_sum=False, zap_nstd=None):
     """Cache-key normalizing front for _raw_fit_fn_cached: dead knob
     combinations collapse onto one compiled program — compensated is
     meaningless without the scatter engine, and under compensated mode
@@ -1093,7 +1098,8 @@ def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
     return _raw_fit_fn_cached(
         nchan, nbin, flags, max_iter, log10_tau, tau_mode, use_fast,
         ftname, x_bf16, redisp, want_flux, use_ir, compensated,
-        nharm_eff, seed_derotate, use_dft_fold(), raw_code, pol_sum)
+        nharm_eff, seed_derotate, use_dft_fold(), raw_code, pol_sum,
+        zap_nstd)
 
 
 @lru_cache(maxsize=None)
@@ -1102,7 +1108,7 @@ def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
                        redisp=False, want_flux=False, use_ir=False,
                        compensated=False, nharm_eff=None,
                        seed_derotate=True, dft_fold=None,
-                       raw_code="i16", pol_sum=False):
+                       raw_code="i16", pol_sum=False, zap_nstd=None):
     """ONE jitted program for a raw bucket: sample decode (scl/offs
     affine per raw_code — ops/decode; pol_sum reduces two-pol payloads
     to Stokes I), min-window baseline subtraction, power-spectrum noise, S/N,
@@ -1118,7 +1124,17 @@ def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
     the scatter-shaped engine even for degenerate phi-only lanes
     (their fixed tau seed still scatters the model) — the complex-free
     fast_scatter_fit_one lane on fast backends, the complex engine
-    otherwise."""
+    otherwise.
+
+    zap_nstd non-None fuses the INLINE RFI excision (ISSUE 12) into
+    the program: the iterative median + nstd cut runs on the freshly
+    computed device-resident noise levels (quality.zap_keep_mask — the
+    whole iteration inside the compiled while_loop, zero host round
+    trips), the flagged channels zero the channel mask BEFORE the S/N,
+    nu_fit seed, and fit consume it, and one extra packed row ('nzap')
+    reports per-subint cut counts.  Fitting an archive whose weights
+    were offline-zapped to the same list is digit-identical — the only
+    difference is where the zeros in cmask came from."""
     ft = {"float32": jnp.float32, "float64": jnp.float64}[ftname]
     scat_engine = (flags[3] or flags[4] or log10_tau
                    or tau_mode != "none" or use_ir)
@@ -1129,7 +1145,22 @@ def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
         x = _raw_decode(raw, scl, offs, nbin, ft, redisp=redisp,
                         redisp_turns=redisp_turns, dft_fold=dft_fold,
                         code=raw_code, pol_sum=pol_sum)
-        noise, snr, nu_fit = _raw_stats(x, cmask, freqs, ft, tiny)
+        nzap = zap_iter = None
+        if zap_nstd is None:
+            noise, snr, nu_fit = _raw_stats(x, cmask, freqs, ft, tiny)
+        else:
+            # inline excision: cut on the device-resident noise, THEN
+            # derive S/N + nu_fit from the post-zap mask — the exact
+            # order an offline-zapped archive's load produces
+            from ..quality.excision import zap_keep_mask
+
+            noise = jnp.maximum(get_noise_PS(x), tiny)
+            keep, zap_iter = zap_keep_mask(noise, cmask > 0, zap_nstd)
+            pre = jnp.sum(cmask, axis=1)
+            cmask = cmask * keep.astype(ft)
+            nzap = pre - jnp.sum(cmask, axis=1)
+            _, snr, nu_fit = _raw_stats(x, cmask, freqs, ft, tiny,
+                                        noise=noise)
         nb = x.shape[0]
         if tau_mode == "none":
             tau0 = jnp.zeros(nb, ft)
@@ -1191,6 +1222,12 @@ def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
             fields += list(_flux_rows(r.scales, r.scale_errs,
                                       jnp.mean(modelx, axis=-1),
                                       cmask, freqs))
+        if nzap is not None:
+            # per-subint inline-zap cut count + in-loop iteration
+            # count (two scalar rows — keeps the one-small-pull design
+            # while the trace still learns channels-cut-per-archive
+            # and proves the iterating happened inside the program)
+            fields += [nzap, zap_iter.astype(ft)]
         return jnp.stack([jnp.asarray(f).astype(ft) for f in fields])
 
     return jax.jit(run)
@@ -1372,7 +1409,7 @@ def _byte_put(device, nbytes):
 
 def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
             tau_mode="none", tau_args=(0.0, 1.0, 0.0), alpha0=0.0,
-            pipeline=None, want_flux=False, seq=0):
+            pipeline=None, want_flux=False, seq=0, zap_nstd=None):
     """Launch ONE fused dispatch for a bucket's pending subints
     through ``pipeline`` (the bucket's _DevicePipeline) and return an
     in-flight record — WITHOUT waiting for the device.  The
@@ -1408,6 +1445,10 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
     keys = _result_keys(flags)
     if want_flux:
         keys = keys + ("flux", "flux_err", "flux_ref_freq")
+    if zap_nstd is not None and bucket.kind == "raw":
+        # the fused inline-zap rows (dec buckets zap at prepare on the
+        # host-side masks instead — their noise lives on host anyway)
+        keys = keys + ("nzap", "zap_iter")
     nu_out = -1.0 if nu_ref_DM is None else float(nu_ref_DM)
     use_fast = use_fast_fit_default()
     ir_FT = bucket.ir_FT
@@ -1438,7 +1479,8 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                          # knows, so the program skips the trig pass
                          seed_derotate=bool(np.any(DMg != 0.0)),
                          raw_code=bucket.raw_code,
-                         pol_sum=bucket.pol_sum)
+                         pol_sum=bucket.pol_sum,
+                         zap_nstd=zap_nstd)
         ft = jnp.float32 if use_fast else jnp.float64
         t_s, t_nu, t_a = tau_args
         # the response ships as TWO REAL arrays (the complex engine
@@ -1683,7 +1725,8 @@ def make_wideband_lane(modelfile, nsub_batch=256, fit_DM=True,
                        instrumental_response_dict=None,
                        addtnl_toa_flags={}, quiet=False,
                        quality_flags=False, tracer=None,
-                       key_prefix=()):
+                       key_prefix=(), zap_inline=False, zap_nstd=None,
+                       zap_channels=None):
     """Build the wideband physics lane + archive loader for a template
     and option set — the per-driver half of the streaming split.
     Returns ``(lane, loader)``: the lane supplies _StreamExecutor's
@@ -1702,11 +1745,22 @@ def make_wideband_lane(modelfile, nsub_batch=256, fit_DM=True,
     stream_wideband_TOAs driver is now a thin client of this factory.
 
     Option semantics follow stream_wideband_TOAs (which documents
-    them); ``tracer`` is the telemetry sink prepare's typed
-    archive_skip events go to."""
+    them, including ``zap_inline``/``zap_nstd``/``zap_channels``);
+    ``tracer`` is the telemetry sink prepare's typed archive_skip
+    events go to."""
     from .toas import DEFAULT_IR_DICT, build_instrumental_response_FT
+    from .zap import resolve_zap_device, resolve_zap_nstd
 
     tracer = NULL_TRACER if tracer is None else tracer
+    # inline excision (ISSUE 12): raw buckets fuse the cut into the
+    # device program (zap_nstd_run rides the compiled-program cache
+    # key), decoded buckets cut at prepare before any mask-derived
+    # quantity; zap_channels feeds PRE-COMPUTED offline lists through
+    # lossless in-memory weight zeroing (quality.zap_bunch) — the
+    # offline-zap digit-oracle arm
+    zap_nstd_run = resolve_zap_nstd(zap_nstd) if zap_inline else None
+    zap_map = {os.path.abspath(k): v
+               for k, v in (zap_channels or {}).items()}
     ird = {**DEFAULT_IR_DICT, **(instrumental_response_dict or {})}
     if len(ird["wids"]) != len(ird["irf_types"]):
         raise ValueError(
@@ -1736,11 +1790,26 @@ def make_wideband_lane(modelfile, nsub_batch=256, fit_DM=True,
             try:
                 # raw lane: undecoded wire samples straight to the
                 # accelerator, decode and statistics on device
-                return _load_raw(f)
+                return _apply_zap_map(f, _load_raw(f))
             except (ValueError, KeyError):
                 pass
-        return load_for_toas(f, tscrunch=tscrunch, quiet=True,
-                             dtype=load_dtype)
+        return _apply_zap_map(f, load_for_toas(
+            f, tscrunch=tscrunch, quiet=True, dtype=load_dtype))
+
+    def _apply_zap_map(f, d):
+        """Offline zap lists applied as in-memory weight zaps at load
+        (runs on the prefetch threads; the tracer is thread-safe).
+        Bit-identical to loading an archive whose DAT_WTS were zeroed
+        — see quality.zap_bunch for why the physical rewrite is not."""
+        z = zap_map.get(os.path.abspath(f))
+        if z is not None and sum(len(c) for c in z):
+            from ..quality.excision import zap_bunch
+
+            zap_bunch(d, z)
+            if tracer.enabled:
+                tracer.emit("zap_apply", datafile=f,
+                            n_channels=sum(len(c) for c in z))
+        return d
 
     # tau seeding mode, resolved once (both lanes)
     default_alpha = (model.gauss.alpha if model.is_gaussian
@@ -1817,9 +1886,37 @@ def make_wideband_lane(modelfile, nsub_batch=256, fit_DM=True,
             else:
                 ir_FT = None
             masks = np.asarray(d.weights[ok] > 0.0, float)
+            raw_mode = bool(d.get("raw_mode", False))
+            if zap_nstd_run is not None and not raw_mode and len(ok):
+                # decoded-lane inline excision: cut BEFORE any
+                # mask-derived quantity (nu_fit seed, tau seeds,
+                # degenerate-geometry flag demotion), so the result is
+                # exactly what an offline-zapped archive's load yields.
+                # (Raw buckets cut inside the fused device program —
+                # their noise levels never visit the host.)
+                from ..quality.excision import (zap_keep_device,
+                                                zap_keep_np)
+
+                noise_z = np.asarray(d.noise_stds[ok, 0])
+                use_dev = resolve_zap_device(None)
+                t0z = time.perf_counter()
+                keep, iters = (zap_keep_device if use_dev
+                               else zap_keep_np)(noise_z, masks > 0,
+                                                 zap_nstd_run)
+                wall_z = time.perf_counter() - t0z
+                n_cut = int(masks.sum() - (masks * keep).sum())
+                masks = masks * keep
+                if tracer.enabled:
+                    tracer.emit("zap_propose", datafile=datafile,
+                                n_channels=n_cut,
+                                n_iter=int(np.max(iters, initial=0)),
+                                device=bool(use_dev),
+                                wall_s=round(wall_z, 6))
+                    if n_cut:
+                        tracer.emit("zap_apply", datafile=datafile,
+                                    n_channels=n_cut)
             masks_b = (np.pad(masks, ((0, 0), (0, pad_c)))
                        if pad_c else masks)
-            raw_mode = bool(d.get("raw_mode", False))
 
             # keep only what TOA assembly needs — NOT the data cube
             m = DataBunch(
@@ -1946,7 +2043,7 @@ def make_wideband_lane(modelfile, nsub_batch=256, fit_DM=True,
                            log10_tau=log10_tau, tau_mode=tau_mode,
                            tau_args=tau_args, alpha0=alpha0_run,
                            pipeline=pipeline, want_flux=print_flux,
-                           seq=seq)
+                           seq=seq, zap_nstd=zap_nstd_run)
 
         def scatter(self, out, owners, keys, results):
             packed = np.asarray(out)
@@ -1955,6 +2052,27 @@ def make_wideband_lane(modelfile, nsub_batch=256, fit_DM=True,
                                   for j, k in enumerate(keys)}
 
         def assemble(self, m, results):
+            if zap_nstd_run is not None and tracer.enabled:
+                # fused raw-lane inline zap: the per-subint cut and
+                # in-loop iteration counts came back in the packed
+                # 'nzap'/'zap_iter' rows (dec archives emitted their
+                # events at prepare instead).  wall_s is 0 by design:
+                # the cut runs inside the fit dispatch, there is no
+                # separate zap wall to charge.
+                rows = [results[(m.iarch, int(isub))] for isub in m.ok
+                        if isinstance(results.get((m.iarch, int(isub))),
+                                      dict)
+                        and "nzap" in results[(m.iarch, int(isub))]]
+                if rows:
+                    nz = sum(int(r["nzap"]) for r in rows)
+                    tracer.emit(
+                        "zap_propose", datafile=m.datafile,
+                        n_channels=int(nz),
+                        n_iter=max(int(r["zap_iter"]) for r in rows),
+                        device=True, wall_s=0.0)
+                    if nz:
+                        tracer.emit("zap_apply", datafile=m.datafile,
+                                    n_channels=int(nz))
             return _assemble_archive(
                 m, results, modelfile, fit_DM, bary, addtnl_toa_flags,
                 log10_tau=log10_tau,
@@ -1978,9 +2096,32 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                          quiet=False, resume=False,
                          skip_archives=None, stream_devices=None,
                          telemetry=None, quality_flags=False,
-                         pipeline_depth=None):
+                         pipeline_depth=None, zap_inline=False,
+                         zap_nstd=None, zap_channels=None):
     """Measure wideband (phi[, DM[, tau, alpha]]) TOAs for many
     archives with cross-archive batched dispatches.
+
+    zap_inline=True runs the ppzap median algorithm INLINE (ISSUE 12):
+    raw buckets fuse the iterative median + ``zap_nstd``*std noise cut
+    into the device program (the cut iterates on the device-resident
+    noise levels inside one compiled while_loop — no host round-trips)
+    and zero the flagged channels' masks before the S/N, nu_fit seed,
+    and fit consume them; decoded-lane archives cut at prepare, before
+    any mask-derived quantity.  Output is digit-identical to offline-
+    zapping the same channel lists first (see ``zap_channels``), with
+    two documented edges: a subint that inline zap empties keeps its
+    (all-masked) TOA row where an offline-zapped load would drop the
+    subint, and a raw-lane subint cut down into degenerate geometry
+    (<= 2 usable channels) keeps its pre-zap fit-flag group.  zap_nstd:
+    threshold in stds (None = config.zap_nstd / PPT_ZAP_NSTD).
+
+    zap_channels: {archive path: [subint][channel indices]} of
+    PRE-COMPUTED zap lists (e.g. from pipeline.zap.get_zap_channels)
+    applied as in-memory weight zaps at load — bit-identical to
+    loading an archive whose DAT_WTS were zeroed, which a physical
+    ppzap --apply rewrite is NOT (the PSRFITS writer re-quantizes
+    DATA).  This is the offline zap-then-fit oracle arm the inline
+    lane's digit gates compare against.
 
     fit_scat/log10_tau/scat_guess/fix_alpha follow GetTOAs.get_TOAs
     (scat_guess may be (tau_s, nu, alpha), "auto" for the data-driven
@@ -2080,7 +2221,9 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
             print_flux=print_flux, print_phase=print_phase,
             instrumental_response_dict=instrumental_response_dict,
             addtnl_toa_flags=addtnl_toa_flags, quiet=quiet,
-            quality_flags=quality_flags, tracer=tracer)
+            quality_flags=quality_flags, tracer=tracer,
+            zap_inline=zap_inline, zap_nstd=zap_nstd,
+            zap_channels=zap_channels)
         ex = _StreamExecutor(lane, datafiles, loader,
                              nsub_batch, max_inflight=max_inflight,
                              prefetch=prefetch, tim_out=tim_out,

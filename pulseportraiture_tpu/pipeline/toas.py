@@ -1203,62 +1203,91 @@ class GetTOAs:
     # ------------------------------------------------------------------
     @on_host
     def get_channels_to_zap(self, SNR_threshold=8.0, rchi2_threshold=1.3,
-                            iterate=True, show=False):
+                            iterate=True, show=False, device=None,
+                            telemetry=None):
         """Flag channels with bad per-channel reduced chi2 or low S/N
         (reference pptoas.py:1266-1343).  Requires get_TOAs() results;
-        fills self.zap_channels as [archive][subint] index lists."""
-        self.zap_channels = []
-        for iarch, datafile in enumerate(self.order):
-            d = load_data(datafile, dedisperse=False, dededisperse=True,
-                          tscrunch=self.tscrunch, pscrunch=True, quiet=True)
-            nbin = d.nbin
-            freqs0 = np.asarray(d.freqs[0], float)
-            P_mean = float(np.mean(d.Ps))
-            modelx = self.model.portrait(freqs0, nbin, P=P_mean)
-            arch_zaps = [[] for _ in range(d.nsub)]
-            for isub in self.ok_isubs[iarch]:
-                okc = np.asarray(d.ok_ichans[isub], int)
-                if not len(okc):
-                    continue
-                port = np.asarray(d.subints[isub, 0])
-                # rotate the model onto the (dispersed) data at the
-                # fitted (phi, DM) and scale per channel
-                from ..ops.rotation import rotate_portrait
+        fills self.zap_channels as [archive][subint] index lists.
 
-                phi = self.phis[iarch][isub]
-                DM = self.DMs[iarch][isub]
-                df = self.doppler_fs[iarch][isub] if self.bary else 1.0
-                aligned = np.asarray(rotate_portrait(
-                    jnp.asarray(modelx), -phi, -DM / df,
-                    float(d.Ps[isub]), jnp.asarray(freqs0),
-                    float(self.nu_refs[iarch][isub][0])))
-                scales = self.scales[iarch][isub]
-                resid = port - scales[:, None] * aligned
-                noise = np.asarray(d.noise_stds[isub, 0])
-                noise = np.where(noise > 0, noise, 1.0)
-                chan_rchi2 = (resid ** 2).sum(axis=1) / noise ** 2 / \
-                    max(nbin - 1, 1)
-                chan_snr = self.channel_snrs[iarch][isub]
-                snr_tot = self.snrs[iarch][isub]
-                nchx = max(len(okc), 1)
-                snr_cut = np.sqrt(max(snr_tot, 0.0) ** 2 / nchx) \
-                    if np.isfinite(snr_tot) else SNR_threshold
-                bad = set()
-                cut = rchi2_threshold
-                for _ in range(8 if iterate else 1):
-                    new_bad = {int(c) for c in okc
-                               if chan_rchi2[c] > cut
-                               or chan_snr[c] < min(SNR_threshold, snr_cut)}
-                    if new_bad == bad:
-                        break
-                    bad = new_bad
-                    good = [c for c in okc if c not in bad]
-                    if not good:
-                        break
-                    cut = max(rchi2_threshold,
-                              np.median(chan_rchi2[good]) * 3.0)
-                arch_zaps[isub] = sorted(bad)
-            self.zap_channels.append(arch_zaps)
+        The iteration core lives in ``quality/postfit.py``: the host
+        NumPy oracle or — ``device`` tri-state, following
+        config.zap_device / PPT_ZAP_DEVICE like the median algorithm —
+        one batched device pass per archive over the (nsub, nchan)
+        quality arrays.  The two lanes are bit-identical (the cut's
+        only statistics are an exact masked median, a multiply, and
+        comparisons).  telemetry: optional tracer/path; emits one
+        ``zap_propose`` per archive."""
+        from ..pipeline.zap import resolve_zap_device
+        from ..quality.postfit import postfit_cut_device, postfit_cut_np
+        from ..telemetry import resolve_tracer
+
+        use_device = resolve_zap_device(device)
+        tracer, own_tracer = resolve_tracer(telemetry,
+                                            run="get_channels_to_zap")
+        self.zap_channels = []
+        try:
+            for iarch, datafile in enumerate(self.order):
+                d = load_data(datafile, dedisperse=False,
+                              dededisperse=True, tscrunch=self.tscrunch,
+                              pscrunch=True, quiet=True)
+                nbin = d.nbin
+                freqs0 = np.asarray(d.freqs[0], float)
+                P_mean = float(np.mean(d.Ps))
+                modelx = self.model.portrait(freqs0, nbin, P=P_mean)
+                ok = np.asarray(self.ok_isubs[iarch], int)
+                nok, nchan = len(ok), d.nchan
+                chan_rchi2 = np.zeros((nok, nchan))
+                chan_snr = np.zeros((nok, nchan))
+                snr_tot = np.full(nok, np.nan)
+                okc_mask = np.zeros((nok, nchan), bool)
+                t0 = time.perf_counter()
+                for j, isub in enumerate(ok):
+                    okc = np.asarray(d.ok_ichans[isub], int)
+                    if not len(okc):
+                        continue
+                    okc_mask[j, okc] = True
+                    port = np.asarray(d.subints[isub, 0])
+                    # rotate the model onto the (dispersed) data at
+                    # the fitted (phi, DM) and scale per channel
+                    from ..ops.rotation import rotate_portrait
+
+                    phi = self.phis[iarch][isub]
+                    DM = self.DMs[iarch][isub]
+                    df = self.doppler_fs[iarch][isub] if self.bary \
+                        else 1.0
+                    aligned = np.asarray(rotate_portrait(
+                        jnp.asarray(modelx), -phi, -DM / df,
+                        float(d.Ps[isub]), jnp.asarray(freqs0),
+                        float(self.nu_refs[iarch][isub][0])))
+                    scales = self.scales[iarch][isub]
+                    resid = port - scales[:, None] * aligned
+                    noise = np.asarray(d.noise_stds[isub, 0])
+                    noise = np.where(noise > 0, noise, 1.0)
+                    chan_rchi2[j] = (resid ** 2).sum(axis=1) / \
+                        noise ** 2 / max(nbin - 1, 1)
+                    chan_snr[j] = self.channel_snrs[iarch][isub]
+                    snr_tot[j] = self.snrs[iarch][isub]
+                cut_fn = postfit_cut_device if use_device \
+                    else postfit_cut_np
+                bad = cut_fn(chan_rchi2, chan_snr, snr_tot, okc_mask,
+                             SNR_threshold=SNR_threshold,
+                             rchi2_threshold=rchi2_threshold,
+                             iterate=iterate) if nok else \
+                    np.zeros((0, nchan), bool)
+                arch_zaps = [[] for _ in range(d.nsub)]
+                for j, isub in enumerate(ok):
+                    arch_zaps[isub] = sorted(
+                        int(c) for c in np.flatnonzero(bad[j]))
+                if tracer.enabled:
+                    tracer.emit(
+                        "zap_propose", datafile=datafile,
+                        n_channels=int(bad.sum()), n_iter=0,
+                        device=bool(use_device),
+                        wall_s=round(time.perf_counter() - t0, 6))
+                self.zap_channels.append(arch_zaps)
+        finally:
+            if own_tracer:
+                tracer.close()
         return self.zap_channels
 
     # ------------------------------------------------------------------

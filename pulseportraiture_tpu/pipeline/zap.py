@@ -7,18 +7,33 @@ levels, and the model-based path using GetTOAs red-chi2/S-N cuts
 `paz` shell commands, this module can also apply the zaps directly
 (weight edits through the archive writer) since there is no external
 PSRCHIVE to delegate to.
+
+Since ISSUE 12 the median algorithm's iterating lives in
+``quality/excision.py`` as ONE batched program: the host lane is the
+reference loop vectorized over subints (the digit oracle), the device
+lane runs every subint's whole iterative cut in a single jitted
+dispatch — zero per-iteration host round-trips (round 14's device lane
+still pulled each iteration's median to host).  The same traceable
+core fuses into the streaming raw-bucket program (pipeline/stream.py
+``zap_inline``), so the offline tool and the inline service lane
+cannot drift.
 """
+
+import time
 
 import numpy as np
 
 from ..io.psrfits import read_archive
+from ..quality.excision import (zap_bunch, zap_keep_device,  # noqa: F401
+                                zap_keep_np, zap_lists_from_masks)
+from ..telemetry import NULL_TRACER
 
 
 def resolve_zap_device(device=None):
     """Tri-state resolution of the zap statistics lane: None follows
     config.zap_device; 'auto' = device on TPU backends (where the
-    streaming lane's noise_stds already live on chip and a host
-    round-trip per iteration is the only cost); True/False force."""
+    streaming lane's noise_stds already live on chip and the batched
+    one-dispatch cut beats a host loop); True/False force."""
     from .. import config
 
     if device is None:
@@ -33,62 +48,74 @@ def resolve_zap_device(device=None):
         f"zap_device must be True, False or 'auto', got {device!r}")
 
 
-def _zap_stats_host(noise_stds):
-    return float(np.median(noise_stds)), float(np.std(noise_stds))
+def resolve_zap_nstd(nstd=None):
+    """None follows ``config.zap_nstd`` (PPT_ZAP_NSTD); explicit
+    values pass through (loud on non-positive)."""
+    from .. import config
+
+    if nstd is None:
+        nstd = getattr(config, "zap_nstd", 3.0)
+    nstd = float(nstd)
+    if not nstd > 0:
+        raise ValueError(f"zap nstd must be > 0, got {nstd}")
+    return nstd
 
 
-def _zap_stats_device(noise_stds):
-    """(median, std) with the MEDIAN — the expensive, sort-shaped
-    statistic — through the device op ops/noise.exact_median_lastaxis
-    (ROADMAP item 4 down payment).  Digit parity with the host path is
-    a hard guarantee, so the std stays on host: exact_median_lastaxis
-    is jnp.median bit-for-bit (f32 by construction, other dtypes fall
-    through to jnp.median) and jnp.median/np.median compute identical
-    order statistics, but jnp.std's reduction order is NOT np.std's —
-    one flipped borderline comparison would cascade through the
-    iterative cut and change the whole zap list."""
-    import jax.numpy as jnp
-
-    from ..ops.noise import exact_median_lastaxis
-
-    x = jnp.asarray(noise_stds)
-    return float(exact_median_lastaxis(x)), float(np.std(noise_stds))
-
-
-def get_zap_channels(data, nstd=3, device=None):
+def get_zap_channels(data, nstd=None, device=None, tracer=None):
     """Iterative median + nstd*std cut on per-channel noise levels
     (reference ppzap.py:24-54).  data: a load_data DataBunch.
-    Returns [subint][channel indices].
+    Returns [subint][channel indices], one row per TRUE subint (empty
+    rows for subints with no usable channels) — the same indexing
+    GetTOAs.get_channels_to_zap uses, and what print_paz_cmds' ``-w``
+    flags and apply_zaps consume.  (The reference returns one row per
+    OK subint, which silently mis-pairs those consumers on any archive
+    with a fully-zapped subint.)
 
+    nstd: threshold in stds (None = config.zap_nstd / PPT_ZAP_NSTD).
     device: tri-state (resolve_zap_device / config.zap_device /
-    PPT_ZAP_DEVICE) — route each iteration's (median, std) through the
-    device op instead of host NumPy; the flagged channel lists are
-    digit-identical either way (guarded by tests)."""
-    stats = (_zap_stats_device if resolve_zap_device(device)
-             else _zap_stats_host)
-    zap_channels = []
-    for isub in data.ok_isubs:
-        ichans = list(np.asarray(data.ok_ichans[isub]).copy())
-        zap_ichans = []
-        while len(ichans):
-            noise_stds = data.noise_stds[isub, 0, ichans]
-            median, std = stats(noise_stds)
-            bad = list(np.where(noise_stds > median + nstd * std)[0])
-            if not bad:
-                break
-            flagged = [ichans[i] for i in bad]
-            zap_ichans.extend(flagged)
-            for ichan in flagged:
-                ichans.remove(ichan)
-        zap_channels.append(sorted(zap_ichans))
+    PPT_ZAP_DEVICE) — route the WHOLE batched iterative cut through
+    one jitted device dispatch instead of the host loop; the flagged
+    channel lists are digit-identical either way (median bit-exact,
+    std within ~1 ulp of accumulation — guarded by tests and
+    bench_zap's list gate).  tracer: optional telemetry sink; emits
+    one ``zap_propose`` event (n_channels, n_iter, device, wall_s)."""
+    nstd = resolve_zap_nstd(nstd)
+    use_device = resolve_zap_device(device)
+    ok = np.asarray(data.ok_isubs, int)
+    nchan = int(data.nchan)
+    noise = np.asarray(data.noise_stds[ok, 0])
+    keep0 = np.zeros((len(ok), nchan), bool)
+    for j, isub in enumerate(ok):
+        keep0[j, np.asarray(data.ok_ichans[isub], int)] = True
+    t0 = time.perf_counter()
+    if use_device:
+        keep, iters = zap_keep_device(noise, keep0, nstd)
+    else:
+        keep, iters = zap_keep_np(noise, keep0, nstd)
+    wall = time.perf_counter() - t0
+    ok_lists = zap_lists_from_masks(keep0, keep)
+    zap_channels = [[] for _ in range(int(data.nsub))]
+    for isub, z in zip(ok, ok_lists):
+        zap_channels[int(isub)] = z
+    if tracer is not None and tracer.enabled:
+        tracer.emit("zap_propose",
+                    datafile=str(data.get("filename", "")),
+                    n_channels=sum(len(z) for z in zap_channels),
+                    n_iter=int(np.max(iters, initial=0)),
+                    device=bool(use_device), wall_s=round(wall, 6))
     return zap_channels
 
 
 def print_paz_cmds(datafiles, zap_list, all_subs=False, modify=True,
-                   outfile=None, quiet=False):
+                   outfile=None, quiet=False, append=False):
     """Emit PSRCHIVE `paz` commands for a zap list (reference
     ppzap.py:57-104) — for users whose downstream tooling is PSRCHIVE.
-    Returns the command lines."""
+    Returns the command lines.
+
+    outfile is WRITTEN (truncated) by default; pass ``append=True`` to
+    add to an existing command file.  (This used to open in append
+    mode unconditionally, so every rerun silently duplicated the whole
+    command set in the file.)"""
     lines = []
     for iarch, datafile in enumerate(datafiles):
         count = sum(len(z) for z in zap_list[iarch])
@@ -113,7 +140,7 @@ def print_paz_cmds(datafiles, zap_list, all_subs=False, modify=True,
                         lines.append(line)
                     last = line
     if outfile is not None:
-        with open(outfile, "a") as f:
+        with open(outfile, "a" if append else "w") as f:
             f.write("".join(line + "\n" for line in lines))
         if not quiet:
             print(f"Wrote {outfile}.")
@@ -124,10 +151,19 @@ def print_paz_cmds(datafiles, zap_list, all_subs=False, modify=True,
 
 
 def apply_zaps(datafile, zap_channels, all_subs=False, outfile=None,
-               quiet=False):
+               quiet=False, tracer=None):
     """Zero the weights of flagged channels directly in the archive —
     the internal replacement for shelling out to `paz`.
-    zap_channels: [subint][channel indices]."""
+    zap_channels: [subint][channel indices].
+
+    NOTE: this rewrites the archive, and the PSRFITS writer
+    re-quantizes DATA from the decoded floats — the weights change
+    losslessly but the data picks up ~half-LSB requantization noise.
+    For a bit-exact offline-zap fit (the inline lane's digit oracle),
+    feed the lists to the streaming drivers' ``zap_channels=`` option
+    (quality.zap_bunch under the hood) instead of round-tripping the
+    file."""
+    tracer = NULL_TRACER if tracer is None else tracer
     arch = read_archive(datafile)
     w = arch.get_weights()
     for isub, chans in enumerate(zap_channels):
@@ -139,7 +175,11 @@ def apply_zaps(datafile, zap_channels, all_subs=False, outfile=None,
             w[isub, np.asarray(chans, int)] = 0.0
     arch.set_weights(w)
     arch.unload(outfile or datafile)
+    n = sum(map(len, zap_channels))
+    if tracer.enabled:
+        tracer.emit("zap_apply", datafile=str(datafile),
+                    n_channels=int(n))
     if not quiet:
-        print(f"Zapped {sum(map(len, zap_channels))} channel entries in "
+        print(f"Zapped {n} channel entries in "
               f"{outfile or datafile}.")
     return w

@@ -1,0 +1,23 @@
+"""Closed-loop data quality (ISSUE 12): inline on-device RFI excision
+for the streaming lanes, the model-based post-fit channel cut, and the
+helpers behind the serving loop's quality-gated zap-and-refit.
+
+- :mod:`.excision` — the iterative median + nstd noise cut, batched
+  into one device program (fused into the raw streaming bucket; one
+  dispatch per archive offline), with the host NumPy oracle and the
+  in-memory weight-zap (:func:`zap_bunch`) the refit loop and the
+  offline ``zap_channels=`` lane apply.
+- :mod:`.postfit` — the reference red-chi^2 / S-N channel cut as a
+  batched device pass over an archive's quality arrays (bit-exact
+  host/device), behind ``GetTOAs.get_channels_to_zap``.
+"""
+
+from .excision import (masked_median_lastaxis, zap_bunch,  # noqa: F401
+                       zap_keep_device, zap_keep_mask, zap_keep_np,
+                       zap_lists_from_masks)
+from .postfit import (postfit_cut_device, postfit_cut_mask,  # noqa: F401
+                      postfit_cut_np)
+
+__all__ = ["masked_median_lastaxis", "zap_bunch", "zap_keep_device",
+           "zap_keep_mask", "zap_keep_np", "zap_lists_from_masks",
+           "postfit_cut_device", "postfit_cut_mask", "postfit_cut_np"]

@@ -1,0 +1,245 @@
+"""Batched RFI excision: the iterative median + nstd noise cut as ONE
+device program (ISSUE 12 tentpole, layer 1).
+
+The reference's median algorithm (ppzap.py:24-54) loops on the host:
+every iteration pulls (median, std) of the surviving channels, flags
+outliers, and repeats — per subint.  Round 14 moved the median onto the
+device but kept the loop on host, so the device lane still paid one
+host round-trip PER ITERATION per subint.  This module batches the
+WHOLE cut — every subint of an archive (or every row of a fused
+bucket) iterating together inside one ``lax.while_loop`` — so the
+device lane costs one dispatch total, and the same traceable core
+(:func:`zap_keep_mask`) fuses directly into the streaming raw-bucket
+program, where the noise levels are computed on device and never visit
+the host at all.
+
+Exactness contract (what "digit oracle" means here):
+
+- the masked MEDIAN — the sort-shaped statistic that centers the cut —
+  is bit-identical to ``np.median`` of the compressed survivor set
+  (:func:`masked_median_lastaxis`: an order-statistic bisection on the
+  order-preserving u32/u64 integer image of the floats, the
+  mask-and-count generalization of ``ops/noise.exact_median_lastaxis``);
+- the masked STD is the two-pass formula in the input dtype.  Its sums
+  reduce in XLA order, not NumPy's pairwise order, so it can differ
+  from ``np.std`` of the survivor set by ~1 ulp of accumulation
+  (~1e-16 relative in f64).  A flagged-channel list can therefore only
+  diverge from the host oracle (:func:`zap_keep_np`) if a channel sits
+  within that margin of ``median + nstd*std`` — a measure-zero
+  borderline that the tests and ``benchmarks/bench_zap.py`` gate on
+  LIST EQUALITY every run, so a divergence fails loudly instead of
+  drifting silently.
+"""
+
+import numpy as np
+
+__all__ = ["masked_median_lastaxis", "zap_keep_mask", "zap_keep_device",
+           "zap_keep_np", "zap_lists_from_masks", "zap_bunch"]
+
+
+def _order_bits(x):
+    """Order-preserving float -> unsigned-int map (radix-sort trick):
+    negatives complement, positives set the top bit; total order as
+    unsigned ints matches the float order.  f32 -> u32, f64 -> u64."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if x.dtype == jnp.float32:
+        utype, top = jnp.uint32, jnp.uint32(0x80000000)
+    elif x.dtype == jnp.float64:
+        utype, top = jnp.uint64, jnp.uint64(0x8000000000000000)
+    else:
+        raise ValueError(f"masked median supports f32/f64, got {x.dtype}")
+    u = lax.bitcast_convert_type(x, utype)
+    return jnp.where(u & top != 0, ~u, u | top), utype, top
+
+
+def _unorder_bits(m, dtype, top):
+    import jax.numpy as jnp
+    from jax import lax
+
+    bits = jnp.where(m & top != 0, m ^ top, ~m)
+    ftype = jnp.float32 if bits.dtype == jnp.uint32 else jnp.float64
+    out = lax.bitcast_convert_type(bits, ftype)
+    return out.astype(dtype)
+
+
+def masked_median_lastaxis(x, keep):
+    """Median over the kept entries of the last axis, bit-identical to
+    ``np.median(x[row][keep[row]])`` per row (same order statistics,
+    same (lo+hi)/2 mean) — traceable, sort-free.
+
+    ``keep``: boolean mask, same shape as ``x``.  Rows with zero kept
+    entries return an arbitrary finite value (callers mask those rows
+    out).  Finite inputs assumed, like every consumer on the streaming
+    path."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    m, utype, top = _order_bits(x)
+    nbits = 32 if utype == jnp.uint32 else 64
+    full = ~utype(0)
+    m = jnp.where(keep, m, full)  # invalid entries sort last
+    n = jnp.sum(keep, axis=-1)
+    k_lo = jnp.maximum(n - 1, 0) // 2
+    k_hi = n // 2
+
+    def kth(k):
+        """Smallest kept value v with count(kept <= v) >= k+1, by
+        bisection on the integer key space — one compare+count pass
+        per bit, no data-dependent gathers."""
+        lo = jnp.zeros(x.shape[:-1], utype)
+        hi = jnp.full(x.shape[:-1], full, utype)
+
+        def body(_, st):
+            lo, hi = st
+            mid = lo + ((hi - lo) >> 1)
+            cnt = jnp.sum((m <= mid[..., None]) & keep, axis=-1)
+            go_hi = cnt <= k
+            return (jnp.where(go_hi, mid + 1, lo),
+                    jnp.where(go_hi, hi, mid))
+
+        lo, hi = lax.fori_loop(0, nbits, body, (lo, hi))
+        return lo
+
+    v_lo = _unorder_bits(kth(k_lo), x.dtype, top)
+    v_hi = _unorder_bits(kth(k_hi), x.dtype, top)
+    return (v_lo + v_hi) / 2
+
+
+def zap_keep_mask(noise, keep, nstd):
+    """The iterative median + ``nstd``*std cut, batched and traceable
+    (the core the fused raw-bucket program inlines): every row iterates
+    inside ONE ``lax.while_loop`` until no row flags a new channel.
+
+    noise: (..., nchan) per-channel noise levels; keep: same-shape
+    boolean (or 0/1) survivor mask — channels already zero-weight
+    enter False and are never counted.  Returns ``(keep_out, n_iter)``:
+    the surviving mask (bool) and, per row, how many passes flagged at
+    least one channel (0 = the row was clean).  Semantics match the
+    host oracle :func:`zap_keep_np` row for row (see the module
+    docstring for the exactness contract)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    noise = jnp.asarray(noise)
+    kb = jnp.asarray(keep) > 0
+    nstd = noise.dtype.type(nstd)
+    it0 = jnp.zeros(noise.shape[:-1], jnp.int32)
+
+    def cond(st):
+        return st[1]
+
+    def body(st):
+        kb, _, it = st
+        n = jnp.sum(kb, axis=-1)
+        nf = jnp.maximum(n, 1).astype(noise.dtype)
+        med = masked_median_lastaxis(noise, kb)
+        m1 = jnp.sum(jnp.where(kb, noise, 0), axis=-1) / nf
+        var = jnp.sum(jnp.where(kb, (noise - m1[..., None]) ** 2, 0),
+                      axis=-1) / nf
+        std = jnp.sqrt(var)
+        bad = kb & (noise > (med + nstd * std)[..., None])
+        row_bad = jnp.any(bad, axis=-1)
+        return (kb & ~bad, jnp.any(row_bad),
+                it + row_bad.astype(jnp.int32))
+
+    kb, _, it = lax.while_loop(cond, body, (kb, jnp.bool_(True), it0))
+    return kb, it
+
+
+def zap_keep_device(noise, keep, nstd):
+    """One jitted dispatch of :func:`zap_keep_mask`; returns host
+    ``(keep, n_iter)`` numpy arrays.  This is the device lane of
+    ``pipeline/zap.get_zap_channels``: the whole iterative cut for
+    every subint of an archive costs ONE dispatch — zero per-iteration
+    host round-trips (the iterating happens inside the compiled
+    while_loop)."""
+    import jax
+
+    fn = _zap_jit_cache.get(None)
+    if fn is None:
+        fn = _zap_jit_cache[None] = jax.jit(
+            zap_keep_mask, static_argnames=("nstd",))
+    kb, it = fn(noise, np.asarray(keep) > 0, float(nstd))
+    return np.asarray(kb), np.asarray(it)
+
+
+_zap_jit_cache = {}
+
+
+def zap_keep_np(noise, keep, nstd):
+    """Host oracle: the reference median algorithm (ppzap.py:24-54)
+    vectorized over rows, exactly — per row: np.median / np.std of the
+    survivor set, flag strictly-greater outliers, repeat until clean.
+    Returns ``(keep, n_iter)`` like the device twin."""
+    noise = np.asarray(noise)
+    keep = np.array(np.asarray(keep) > 0)
+    flat = keep.reshape(-1, keep.shape[-1])
+    nflat = noise.reshape(-1, noise.shape[-1])
+    n_iter = np.zeros(flat.shape[0], int)
+    for i in range(flat.shape[0]):
+        while True:
+            idx = np.flatnonzero(flat[i])
+            if idx.size == 0:
+                break
+            vals = nflat[i, idx]
+            med, std = np.median(vals), np.std(vals)
+            bad = idx[vals > med + nstd * std]
+            if bad.size == 0:
+                break
+            flat[i, bad] = False
+            n_iter[i] += 1
+    return (flat.reshape(keep.shape),
+            n_iter.reshape(keep.shape[:-1]))
+
+
+def zap_lists_from_masks(keep0, keep):
+    """Per-row sorted flagged-channel lists from before/after survivor
+    masks — the ppzap list format ([row][channel indices])."""
+    keep0 = np.asarray(keep0) > 0
+    keep = np.asarray(keep) > 0
+    return [sorted(int(c) for c in np.flatnonzero(k0 & ~k))
+            for k0, k in zip(keep0, keep)]
+
+
+def zap_bunch(d, zap_channels):
+    """Apply a zap list to a LOADED archive bunch in memory — weight
+    zeroing plus the derived ok-index recomputation — so downstream
+    fits see exactly what loading a weight-zapped archive yields.
+
+    This, not ``pipeline/zap.apply_zaps``, is the lossless offline-zap
+    arm: the PSRFITS writer re-quantizes DATA from the decoded floats
+    (write_archive_file recomputes scl/offs), so a physical
+    zap-rewrite-reload round trip perturbs the data in its low bits,
+    while load_data/_load_raw never fold weights into the data — they
+    only derive masks and ok indices from them.  Zeroing the weights
+    here and recomputing those deriveds is therefore bit-identical to
+    having loaded an archive whose DAT_WTS were zeroed, which is what
+    the inline lane's digit gates (and the serve refit loop) compare
+    against.
+
+    ``d``: a ``load_data`` bunch or a raw-mode ``_load_raw`` bunch;
+    ``zap_channels``: [subint][channel indices], indexed by TRUE subint
+    number (rows beyond ``d.nsub`` ignored).  Returns ``d`` (mutated).
+    """
+    w = np.asarray(d.weights)
+    for isub, chans in enumerate(zap_channels):
+        if isub >= w.shape[0] or not len(chans):
+            continue
+        w[isub, np.asarray(chans, int)] = 0.0
+    d.weights = w
+    weights_norm = np.where(w == 0.0, 0.0, 1.0)
+    nsub, nchan = w.shape
+    d.ok_isubs = np.compress(weights_norm.mean(axis=1),
+                             np.arange(nsub)).astype(int)
+    if "ok_ichans" in d:
+        d.ok_ichans = [np.compress(weights_norm[isub],
+                                   np.arange(nchan)).astype(int)
+                       for isub in range(nsub)]
+    if "masks" in d and not d.get("raw_mode", False):
+        npol = int(d.get("npol", 1))
+        nbin = int(d.nbin)
+        d.masks = np.broadcast_to(weights_norm[:, None, :, None],
+                                  (nsub, npol, nchan, nbin))
+    return d

@@ -1,0 +1,146 @@
+"""Model-based post-fit channel cut (ISSUE 12 tentpole, layer 2).
+
+The reference flags channels whose per-channel reduced chi^2 or
+matched-filter S/N disqualify them AFTER a fit (pptoas.py:1266-1343 /
+ppzap's model path): an 8-round loop that re-derives the chi^2 cut
+from the median of the surviving channels each round.  GetTOAs used to
+run it per subint in host Python; this module holds the pure-array
+core — a host NumPy oracle and a batched device twin — so the cut
+runs as ONE cheap device pass over an archive's (nsub, nchan) quality
+arrays, and ``GetTOAs.get_channels_to_zap`` (and with it ``ppzap -m``)
+routes through the shared implementation behind the ``zap_device``
+tri-state.
+
+Unlike the median NOISE cut (quality/excision.py), this cut is fully
+bit-exact across lanes: its only statistics are a median (exact via
+``masked_median_lastaxis``), a multiply by 3, and comparisons — no
+reduction-order-dependent sums — so host and device flag lists are
+identical by construction, not just gated.
+"""
+
+import numpy as np
+
+from .excision import masked_median_lastaxis
+
+__all__ = ["postfit_cut_np", "postfit_cut_mask", "postfit_cut_device"]
+
+_MAX_ROUNDS = 8  # reference pptoas.py:1296 (iterate=True)
+
+
+def _snr_floor(snr_tot, nchx, SNR_threshold):
+    """min(SNR_threshold, sqrt(max(snr_tot, 0)^2 / nchx)) with the
+    reference's non-finite fallback — identical fp ops on both lanes
+    (max, square, divide, sqrt are all correctly rounded)."""
+    snr_tot = np.asarray(snr_tot, float)
+    nchx = np.maximum(np.asarray(nchx, float), 1.0)
+    cut = np.sqrt(np.maximum(snr_tot, 0.0) ** 2 / nchx)
+    cut = np.where(np.isfinite(snr_tot), cut, SNR_threshold)
+    return np.minimum(SNR_threshold, cut)
+
+
+def postfit_cut_np(chan_rchi2, chan_snr, snr_tot, okc_mask,
+                   SNR_threshold=8.0, rchi2_threshold=1.3,
+                   iterate=True):
+    """Host oracle: the reference red-chi^2 / S-N channel cut
+    (pptoas.py:1292-1307) vectorized over rows.
+
+    chan_rchi2 / chan_snr / okc_mask: (nsub, nchan); snr_tot: (nsub,).
+    Returns a (nsub, nchan) boolean BAD mask (True = zap)."""
+    rchi2 = np.asarray(chan_rchi2, float)
+    snr = np.asarray(chan_snr, float)
+    okc = np.asarray(okc_mask) > 0
+    nsub, nchan = rchi2.shape
+    floor = _snr_floor(snr_tot, okc.sum(axis=1), SNR_threshold)
+    bad_out = np.zeros((nsub, nchan), bool)
+    for i in range(nsub):
+        oi = np.flatnonzero(okc[i])
+        if oi.size == 0:
+            continue
+        bad = np.zeros(nchan, bool)
+        cut = float(rchi2_threshold)
+        for _ in range(_MAX_ROUNDS if iterate else 1):
+            with np.errstate(invalid="ignore"):
+                new_bad = okc[i] & ((rchi2[i] > cut)
+                                    | (snr[i] < floor[i]))
+            if np.array_equal(new_bad, bad):
+                break
+            bad = new_bad
+            good = oi[~bad[oi]]
+            if good.size == 0:
+                break
+            cut = max(float(rchi2_threshold),
+                      float(np.median(rchi2[i, good])) * 3.0)
+        bad_out[i] = bad
+    return bad_out
+
+
+def postfit_cut_mask(chan_rchi2, chan_snr, snr_tot, okc_mask,
+                     SNR_threshold=8.0, rchi2_threshold=1.3,
+                     iterate=True):
+    """Traceable batched twin of :func:`postfit_cut_np`: a fixed
+    8-round ``fori_loop`` with per-row done flags (a row freezes once
+    its bad set stops changing or its survivor set empties — the
+    reference's two break conditions).  Bit-identical to the oracle:
+    the re-derived cut is ``max(threshold, exact_median * 3)``."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    rchi2 = jnp.asarray(chan_rchi2)
+    snr = jnp.asarray(chan_snr, rchi2.dtype)
+    okc = jnp.asarray(okc_mask) > 0
+    snr_tot = jnp.asarray(snr_tot, rchi2.dtype)
+    thr = rchi2.dtype.type(rchi2_threshold)
+    snr_th = rchi2.dtype.type(SNR_threshold)
+    nchx = jnp.maximum(jnp.sum(okc, axis=-1), 1).astype(rchi2.dtype)
+    floor_ = jnp.sqrt(jnp.maximum(snr_tot, 0.0) ** 2 / nchx)
+    floor_ = jnp.where(jnp.isfinite(snr_tot), floor_, snr_th)
+    floor_ = jnp.minimum(snr_th, floor_)
+
+    bad0 = jnp.zeros(okc.shape, bool)
+    cut0 = jnp.full(okc.shape[:-1], thr)
+    done0 = jnp.sum(okc, axis=-1) == 0
+
+    def body(_, st):
+        bad, cut, done = st
+        base = okc & ((rchi2 > cut[..., None])
+                      | (snr < floor_[..., None]))
+        same = jnp.all(base == bad, axis=-1)
+        new_bad = jnp.where((done | same)[..., None], bad, base)
+        done = done | same
+        good = okc & ~new_bad
+        empty = jnp.sum(good, axis=-1) == 0
+        med = masked_median_lastaxis(rchi2, good)
+        new_cut = jnp.maximum(thr, med * 3)
+        cut = jnp.where(done | empty, cut, new_cut)
+        return new_bad, cut, done | empty
+
+    bad, _, _ = lax.fori_loop(0, _MAX_ROUNDS if iterate else 1, body,
+                              (bad0, cut0, done0))
+    return bad
+
+
+def postfit_cut_device(chan_rchi2, chan_snr, snr_tot, okc_mask,
+                       SNR_threshold=8.0, rchi2_threshold=1.3,
+                       iterate=True):
+    """One jitted dispatch of :func:`postfit_cut_mask`; host bool
+    array out.  NaN rchi2/snr entries (degenerate fits) compare False
+    against every cut on both lanes, so they are never flagged —
+    matching the host oracle."""
+    import jax
+
+    key = ("postfit", bool(iterate))
+    fn = _jit_cache.get(key)
+    if fn is None:
+        fn = _jit_cache[key] = jax.jit(
+            postfit_cut_mask,
+            static_argnames=("SNR_threshold", "rchi2_threshold",
+                             "iterate"))
+    bad = fn(chan_rchi2, chan_snr, snr_tot,
+             np.asarray(okc_mask) > 0,
+             SNR_threshold=float(SNR_threshold),
+             rchi2_threshold=float(rchi2_threshold),
+             iterate=bool(iterate))
+    return np.asarray(bad)
+
+
+_jit_cache = {}

@@ -80,6 +80,10 @@ class ServeRequest:
         self.assembled = {}
         self.n_skipped = 0
         self.all_admitted = False
+        # archive positions already sent through the quality-gated
+        # zap-and-refit loop (server-side; the EXACTLY-ONCE bound —
+        # a position in here never refits again)
+        self.refit_pos = set()
         self._event = threading.Event()
         self._result = None
         self._error = None

@@ -98,19 +98,48 @@ class ToaServer:
     warmup_model: template whose portrait shapes the warmup programs
     (defaults to a synthetic smooth profile); warmup_options:
     fit-option overrides forwarded to the warmup pass.
+
+    quality_refit (default config.quality_refit / PPT_QUALITY_REFIT):
+    the closed quality loop — an archive whose fitted TOAs trip the
+    quality_max_gof / quality_min_snr thresholds gets exactly ONE
+    automatic zap-and-refit (ppzap median proposal, in-memory weight
+    zap, re-fit through the same warm lane) before its .tim line
+    demuxes; per-request .tim content and ordering are unchanged for
+    anything that never trips a gate, and a refit that cannot help
+    serves the original (or still-tripping zapped) fit LOUDLY.
+    zap_nstd overrides the proposal threshold (config.zap_nstd).
     """
 
     def __init__(self, nsub_batch=64, max_wait_ms=None, queue_depth=None,
                  stream_devices=None, max_inflight=None,
                  pipeline_depth=None, telemetry=None,
                  warmup_manifest=None, warmup_model=None,
-                 warmup_options=None, quiet=True):
+                 warmup_options=None, quiet=True, quality_refit=None,
+                 quality_max_gof=None, quality_min_snr=None,
+                 zap_nstd=None):
         from .. import config
 
         if max_wait_ms is None:
             max_wait_ms = config.serve_max_wait_ms
         if queue_depth is None:
             queue_depth = config.serve_queue_depth
+        # quality-gated zap-and-refit loop (ISSUE 12): a request
+        # archive whose fitted TOAs trip these thresholds gets exactly
+        # one automatic zap-and-refit through the same warm lanes
+        # before its .tim demuxes; None reads the config.quality_* /
+        # PPT_QUALITY_* knobs
+        self.quality_refit = bool(
+            config.quality_refit if quality_refit is None
+            else quality_refit)
+        self.quality_max_gof = float(
+            config.quality_max_gof if quality_max_gof is None
+            else quality_max_gof)
+        self.quality_min_snr = float(
+            config.quality_min_snr if quality_min_snr is None
+            else quality_min_snr)
+        from ..pipeline.zap import resolve_zap_nstd
+
+        self.zap_nstd = resolve_zap_nstd(zap_nstd)
         self.nsub_batch = int(nsub_batch)
         self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
         self.quiet = quiet
@@ -137,6 +166,14 @@ class ToaServer:
         self._stopping = threading.Event()
         self._drain = True
         self._fatal = None
+        # quality loop state (server thread only): gated archives
+        # queued for zap-and-refit (processed from the serving loop,
+        # never from inside an executor drain callback — re-entrant
+        # admits would interleave with a mid-fill bucket), and the
+        # executor iarchs that ARE refits (their completion finalizes
+        # the refit instead of re-entering the gate)
+        self._refits_pending = []
+        self._refit_iarchs = {}
         self._warmup = (warmup_manifest, warmup_model,
                         dict(warmup_options or {}))
 
@@ -265,12 +302,33 @@ class ToaServer:
                     self._admit_request(req)
                 ex.flush_stale(self.max_wait_s)
                 ex._drain_ready()
+                self._process_refits()
                 if self._stopping.is_set() and (
                         not self._drain or len(self.queue) == 0):
                     break
             if self._drain:
                 ex.flush_all()
                 ex.drain_all()
+                # quality loop: drained archives may have queued
+                # refits; each refit admits more work, so flush/drain
+                # until the loop is quiescent (bounded — every
+                # position refits at most once)
+                while True:
+                    self._process_refits()
+                    if not self._refits_pending and \
+                            not self._refit_iarchs:
+                        break
+                    ex.flush_all()
+                    ex.drain_all()
+                    # a refit archive can hit the same never-completes-
+                    # through-the-drain state as originals (a lane
+                    # admitting fewer entries than ok subints) — without
+                    # this it would pin _refit_iarchs and spin this
+                    # loop forever; assemble_leftover fires the
+                    # _archive_done hook, which finalizes the refit
+                    for ia in sorted(set(self._refit_iarchs)
+                                     & set(self._by_iarch)):
+                        ex.assemble_leftover(ia)
                 # archives that never completed through the drain
                 # (lanes admitting fewer entries than ok subints)
                 for ia in sorted(self._by_iarch):
@@ -291,7 +349,7 @@ class ToaServer:
         """How long the queue wait may block before the loop must tick
         again: the oldest bucket's remaining deadline, a short poll
         while dispatches are in flight, a longer idle poll otherwise."""
-        if self._stopping.is_set():
+        if self._stopping.is_set() or self._refits_pending:
             return 0.0
         age = self._ex.oldest_bucket_age()
         if age is not None:
@@ -389,9 +447,171 @@ class ToaServer:
         if ent is None:
             return
         req, pos = ent
+        self._ex.forget(iarch)  # keep the warm executor O(live work)
+        rec = self._refit_iarchs.pop(iarch, None)
+        if rec is not None:
+            self._finish_refit(rec, m, out)
+            return
+        if (self.quality_refit and pos not in req.refit_pos
+                and self._gate_trips(out)):
+            # hold this position open (the request cannot complete
+            # until the refit resolves — demux order is unchanged) and
+            # queue exactly one zap-and-refit; processed from the
+            # serving loop, NOT here — this hook can run inside an
+            # executor drain that an admit triggered, and a re-entrant
+            # admit would interleave with a mid-fill bucket
+            req.refit_pos.add(pos)
+            self._refits_pending.append(dict(
+                req=req, pos=pos, datafile=m.datafile,
+                gof_before=self._gof_worst(out), meta=m, out=out))
+            return
         req.meta[pos] = m
         req.assembled[pos] = out
-        self._ex.forget(iarch)  # keep the warm executor O(live work)
+        self._maybe_complete(req)
+
+    # -- quality-gated zap-and-refit (ISSUE 12) ------------------------
+
+    def _gof_worst(self, out):
+        """Worst (largest finite) per-TOA goodness-of-fit of one
+        archive assembly — the quality rollup the gate reads."""
+        gofs = [t.flags.get("gof") for t in out[0]]
+        gofs = [g for g in gofs if g is not None and np.isfinite(g)]
+        return max(gofs) if gofs else None
+
+    def _gate_trips(self, out):
+        """True when any TOA of the assembly trips the configured
+        thresholds (gof above quality_max_gof, or — when the S/N gate
+        is enabled — snr below quality_min_snr)."""
+        for t in out[0]:
+            gof = t.flags.get("gof")
+            if gof is not None and np.isfinite(gof) \
+                    and gof > self.quality_max_gof:
+                return True
+            if self.quality_min_snr > 0.0:
+                snr = t.flags.get("snr")
+                if snr is not None and np.isfinite(snr) \
+                        and snr < self.quality_min_snr:
+                    return True
+        return False
+
+    def _fallback_refit(self, rec, n_channels, reason):
+        """A refit that cannot run (no channels to zap, empty archive,
+        proposal error): serve the ORIGINAL result, loudly."""
+        req, pos = rec["req"], rec["pos"]
+        if pos in req.assembled:
+            # the refit resolved through a drain callback before the
+            # failure surfaced (admit can complete an archive
+            # synchronously) — its result already demuxed; do not
+            # overwrite it with the original
+            return
+        log(f"quality refit of {rec['datafile']} (request "
+            f"{req.name!r}) not possible: {reason}; serving the "
+            "original fit", level="warn", tracer=None)
+        if self.tracer.enabled:
+            self.tracer.emit(
+                "refit", req=req.name, datafile=rec["datafile"],
+                n_channels=int(n_channels),
+                gof_before=rec["gof_before"],
+                gof_after=rec["gof_before"], improved=False)
+        req.meta[pos] = rec["meta"]
+        req.assembled[pos] = rec["out"]
+        self._maybe_complete(req)
+
+    def _process_refits(self):
+        """Run queued zap-and-refits (server thread, between executor
+        drains): propose zaps with the ppzap median algorithm on the
+        decoded load, apply them as an in-memory weight zap
+        (quality.zap_bunch — bit-identical to loading an offline-
+        zapped archive), and re-admit the archive through the SAME
+        warm lane the original fit used.  Exactly one refit per
+        archive position; failures fall back to the original result,
+        loudly."""
+        from ..io.psrfits import load_data
+        from ..pipeline.zap import get_zap_channels, zap_bunch
+
+        while self._refits_pending:
+            rec = self._refits_pending.pop(0)
+            req, pos = rec["req"], rec["pos"]
+            f = rec["datafile"]
+            ia = None
+            try:
+                lane, loader = self._lane_for(req)
+                # the proposal loads DECODED with the ppzap option set
+                # (the median algorithm needs host noise levels; the
+                # stats themselves follow the zap_device tri-state —
+                # one batched dispatch on the device lane)
+                d_prop = load_data(
+                    f, dedisperse=False, dededisperse=True,
+                    tscrunch=req.options.get("tscrunch", False),
+                    pscrunch=True, quiet=True)
+                # rows come back indexed by true subint number — the
+                # zap_bunch format directly
+                full = get_zap_channels(d_prop, nstd=self.zap_nstd,
+                                        tracer=self.tracer)
+                n_channels = sum(len(z) for z in full)
+                if n_channels == 0:
+                    self._fallback_refit(
+                        rec, 0, "the median algorithm flagged no "
+                        "channels (contamination is not "
+                        "noise-level-separable)")
+                    continue
+                d = zap_bunch(loader(f), full)
+                ok = np.asarray(d.ok_isubs, int)
+                if d.nsub == 0 or len(ok) == 0:
+                    self._fallback_refit(
+                        rec, n_channels,
+                        "zapping left no fittable subints")
+                    continue
+                if self.tracer.enabled:
+                    self.tracer.emit("zap_apply", datafile=f,
+                                     n_channels=int(n_channels))
+                rec["n_channels"] = n_channels
+                ia = self._iarch
+                self._iarch += 1
+                self._by_iarch[ia] = (req, pos)
+                self._refit_iarchs[ia] = rec
+                if self._ex.admit(ia, f, d, ok, lane=lane) is None:
+                    self._by_iarch.pop(ia, None)
+                    self._refit_iarchs.pop(ia, None)
+                    self._fallback_refit(
+                        rec, n_channels,
+                        "the lane skipped the zapped archive")
+                    continue
+            except Exception as e:
+                if ia is not None:
+                    # a failed admit must not leave the registration
+                    # behind: the drain loop would wait on it forever,
+                    # and a partially-enqueued fit's late completion
+                    # must find nothing to resolve
+                    self._by_iarch.pop(ia, None)
+                    self._refit_iarchs.pop(ia, None)
+                self._fallback_refit(rec, rec.get("n_channels", 0),
+                                     f"{type(e).__name__}: {e}")
+
+    def _finish_refit(self, rec, m, out):
+        """A refit's fit completed: record the before/after quality,
+        warn loudly when the archive STILL trips the gate (the bounded
+        loop never refits twice), and demux the zapped fit."""
+        req, pos = rec["req"], rec["pos"]
+        gof_after = self._gof_worst(out)
+        before = rec["gof_before"]
+        improved = (gof_after is not None and before is not None
+                    and gof_after < before)
+        if self.tracer.enabled:
+            self.tracer.emit(
+                "refit", req=req.name, datafile=rec["datafile"],
+                n_channels=int(rec.get("n_channels", 0)),
+                gof_before=before, gof_after=gof_after,
+                improved=bool(improved))
+        if self._gate_trips(out):
+            log(f"quality refit of {rec['datafile']} (request "
+                f"{req.name!r}) still trips the gate after zapping "
+                f"{rec.get('n_channels', 0)} channel(s) "
+                f"(red-chi^2 {before} -> {gof_after}); serving the "
+                "zapped fit — no further refits (the loop is bounded "
+                "to one pass)", level="warn", tracer=None)
+        req.meta[pos] = m
+        req.assembled[pos] = out
         self._maybe_complete(req)
 
     # -- request completion --------------------------------------------

@@ -1,6 +1,8 @@
 from .archive import add_scintillation, make_fake_pulsar
 from .fake import (default_test_model, fake_observation, fake_portrait,
                    fake_timing_campaign)
+from .rfi import inject_rfi
 
 __all__ = ["add_scintillation", "default_test_model", "fake_observation",
-           "fake_portrait", "fake_timing_campaign", "make_fake_pulsar"]
+           "fake_portrait", "fake_timing_campaign", "inject_rfi",
+           "make_fake_pulsar"]

@@ -58,7 +58,8 @@ TRACE_SCHEMA_VERSION = 1
 # trace reader needs to interpret device/queue numbers.
 CONFIG_SNAPSHOT_KEYS = (
     "cross_spectrum_dtype", "dft_precision", "dft_fold", "align_device",
-    "gauss_device", "gls_device", "zap_device",
+    "gauss_device", "gls_device", "zap_device", "zap_nstd",
+    "quality_refit", "quality_max_gof", "quality_min_snr",
     "stream_devices", "stream_max_inflight", "stream_pipeline_depth",
     "compile_cache_dir", "telemetry_path",
     "serve_max_wait_ms", "serve_queue_depth", "bucket_pad",
@@ -151,6 +152,19 @@ EVENT_FIELDS = {
     # report section aggregates exactly these.
     "timing_fit": {"bucket", "rows", "pad", "wall_s", "batched"},
     "fleet_end": {"n_pulsars", "n_dispatches", "wall_s"},
+    # the quality subsystem (quality/ + pipeline/zap.py + the serving
+    # refit loop): zap_propose = one median-algorithm proposal pass
+    # (n_iter = worst per-subint iteration count; device marks the
+    # one-dispatch batched lane; wall_s is the zap wall the report
+    # aggregates); zap_apply = a zap actually applied to weights/masks
+    # (offline apply, the streaming inline lane per archive, or a
+    # refit); refit = one serve-loop zap-and-refit resolution with the
+    # before/after goodness-of-fit the quality section reports
+    "zap_propose": {"datafile", "n_channels", "n_iter", "device",
+                    "wall_s"},
+    "zap_apply": {"datafile", "n_channels"},
+    "refit": {"req", "datafile", "n_channels", "gof_before",
+              "gof_after", "improved"},
     "counters": {"counters", "gauges"},
 }
 
@@ -866,6 +880,60 @@ def report(path, file=None):
                 nd, rw, pd = shapes[key]
                 p(f"    bucket {key}: {nd} dispatch(es), {rw} "
                   f"system(s) + {pd} pad")
+    # ---- data quality (zap + refit) ---------------------------------
+    zprop = by_type.get("zap_propose", [])
+    zapp = by_type.get("zap_apply", [])
+    refits = by_type.get("refit", [])
+    zap_channels_cut = None
+    zap_wall_s = None
+    refit_rate = None
+    n_refit_improved = None
+    if zprop or zapp or refits:
+        p("")
+        p("-- data quality (zap + refit) --")
+        if zprop:
+            zap_wall_s = sum(float(ev["wall_s"]) for ev in zprop)
+            n_dev = sum(1 for ev in zprop if ev.get("device"))
+            worst_iter = max(int(ev["n_iter"]) for ev in zprop)
+            p(f"  {len(zprop)} zap proposal pass(es) "
+              f"({n_dev} on the one-dispatch device lane), zap wall "
+              f"{zap_wall_s:.3f} s, worst iteration count {worst_iter} "
+              "(device lane: iterations run INSIDE the compiled loop — "
+              "zero per-iteration host round-trips)")
+        if zapp:
+            zap_channels_cut = sum(int(ev["n_channels"]) for ev in zapp)
+            p(f"  {len(zapp)} zap application(s), {zap_channels_cut} "
+              "channel entr(ies) cut; per archive:")
+            per_arch = {}
+            for ev in zapp:
+                per_arch[ev["datafile"]] = \
+                    per_arch.get(ev["datafile"], 0) + int(ev["n_channels"])
+            for df in sorted(per_arch, key=per_arch.get,
+                             reverse=True)[:8]:
+                p(f"    {df}: {per_arch[df]} channel entr(ies)")
+        if refits:
+            n_req = len(by_type.get("request_done", []))
+            n_refit_improved = sum(1 for ev in refits
+                                   if ev.get("improved"))
+            refit_rate = len(refits) / max(n_req, 1) if n_req else None
+            gb = [ev["gof_before"] for ev in refits
+                  if ev.get("gof_before") is not None]
+            ga = [ev["gof_after"] for ev in refits
+                  if ev.get("gof_after") is not None]
+            rate = (f"{100 * refit_rate:.1f}% of requests"
+                    if refit_rate is not None else "n/a")
+            p(f"  {len(refits)} refit(s) ({rate}), "
+              f"{n_refit_improved} improved; red-chi^2 "
+              f"before/after mean "
+              f"{np.mean(gb) if gb else float('nan'):.3f} -> "
+              f"{np.mean(ga) if ga else float('nan'):.3f}")
+            for ev in refits:
+                if not ev.get("improved"):
+                    p(f"    NOT improved: {ev['datafile']} "
+                      f"({ev['n_channels']} channel(s) cut, gof "
+                      f"{ev.get('gof_before')} -> "
+                      f"{ev.get('gof_after')})")
+
     # ---- quality ----------------------------------------------------
     qual = by_type.get("quality", [])
     snr = [v for ev in qual for v in ev["snr"]]
@@ -924,6 +992,13 @@ def report(path, file=None):
         "n_template_jobs": len(tjobs),
         "template_pad_frac": template_pad_frac,
         "template_wall_s": template_wall_s,
+        "n_zap_propose": len(zprop),
+        "n_zap_apply": len(zapp),
+        "n_refit": len(refits),
+        "n_refit_improved": n_refit_improved,
+        "refit_rate": refit_rate,
+        "zap_channels_cut": zap_channels_cut,
+        "zap_wall_s": zap_wall_s,
         "n_timing_fit": len(tim_fit),
         "n_timing_pulsars": n_timing_pulsars,
         "timing_dispatches": timing_dispatches,

@@ -78,6 +78,14 @@ TINY_ENV = {
     # every shape (including this one), and the emitted trace's
     # timing_fit/fleet_end events schema-validated
     "bench_gls": {"PPT_NPSR": "4", "PPT_NE": "4", "PPT_TELEMETRY": ""},
+    # ISSUE 12: the inline-device vs host-offline excision A/B — the
+    # flagged-channel-list digit gate, the ground-truth recovery gate,
+    # the inline-vs-oracle .tim byte gate, and the clean-corpus no-op
+    # gate are all ENFORCED inside the bench at every shape, and the
+    # emitted zap_apply ledger is schema-validated
+    "bench_zap": {"PPT_NARCH": "2", "PPT_NSUB": "2",
+                  "PPT_NCHAN": "32", "PPT_NBIN": "128",
+                  "PPT_TELEMETRY": ""},
 }
 
 _CONFIG_KEYS = ("dft_precision", "cross_spectrum_dtype", "dft_fold",

@@ -130,6 +130,37 @@ def test_ppzap_cli(workspace, tmp_path):
     assert rc == 0
 
 
+def test_ppzap_cli_telemetry_and_write_mode(workspace, tmp_path):
+    """ppzap --telemetry emits the zap_propose/zap_apply ledger the
+    inline lane shares (ISSUE 12 satellite), and -o overwrites on
+    rerun instead of silently duplicating (--append opts back in)."""
+    from pulseportraiture_tpu.telemetry import validate_trace
+
+    root, meta, files = workspace
+    model = default_test_model(1500.0)
+    noisy = str(tmp_path / "rfi.fits")
+    make_fake_pulsar(model, PAR, outfile=noisy, nsub=1, nchan=32,
+                     nbin=256, tsub=60.0,
+                     noise_stds=np.where(np.arange(32) == 4, 1.2, 0.06),
+                     dedispersed=False, quiet=True, rng=78)
+    cmds = tmp_path / "paz.sh"
+    trace = str(tmp_path / "zap.jsonl")
+    argv = ["-d", noisy, "-o", str(cmds), "--quiet", "--apply",
+            "--telemetry", trace, "--zap-device", "off"]
+    assert ppzap.main(argv) == 0
+    once = cmds.read_text()
+    assert "-z 4" in once
+    _, evs = validate_trace(trace)
+    props = [e for e in evs if e["type"] == "zap_propose"]
+    apps = [e for e in evs if e["type"] == "zap_apply"]
+    assert len(props) == 1 and props[0]["device"] is False
+    assert len(apps) == 1 and apps[0]["n_channels"] >= 1
+    # rerun: file rewritten, not appended (nothing left to flag after
+    # --apply, so the command file comes back empty)
+    assert ppzap.main(["-d", noisy, "-o", str(cmds), "--quiet"]) == 0
+    assert cmds.read_text() == ""
+
+
 def test_pptoas_cli_stream_matches(workspace, tmp_path):
     """--stream produces the same TOA lines (up to float formatting) as
     the per-archive path for a wideband phi/DM run."""

@@ -1,0 +1,507 @@
+"""Quality subsystem (ISSUE 12): inline on-device RFI excision, the
+model-based post-fit cut, the synth RFI injector's ground truth, and
+the serving loop's quality-gated zap-and-refit.
+
+The digit gates here are the subsystem's contract: device and host zap
+lanes flag identical channel lists, the inline streaming lanes (raw
+fused + decoded prepare-time) produce .tim bytes identical to the
+offline zap-then-fit oracle (pre-computed lists through the lossless
+``zap_channels=`` weight zap), and the serve loop's refit output equals
+the same oracle while clean data rides through byte-identical with the
+loop on or off."""
+
+import os
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu import config
+from pulseportraiture_tpu.io import write_gmodel
+from pulseportraiture_tpu.io.psrfits import load_data
+from pulseportraiture_tpu.pipeline import (get_zap_channels,
+                                           print_paz_cmds,
+                                           stream_wideband_TOAs)
+from pulseportraiture_tpu.quality import (masked_median_lastaxis,
+                                          postfit_cut_device,
+                                          postfit_cut_np, zap_bunch,
+                                          zap_keep_device, zap_keep_np,
+                                          zap_lists_from_masks)
+from pulseportraiture_tpu.synth import (default_test_model, inject_rfi,
+                                        make_fake_pulsar)
+from pulseportraiture_tpu.telemetry import report, validate_trace
+
+PAR = {"PSR": "J1744-1134", "RAJ": "17:44:29.4", "DECJ": "-11:34:54.6",
+       "P0": 0.004074, "PEPOCH": 55000.0, "DM": 3.139}
+
+
+def _full_lists(d, lists):
+    """get_zap_channels rows are indexed by TRUE subint number — the
+    zap_channels= / zap_bunch format directly (this shim documents the
+    invariant and pins the row count)."""
+    assert len(lists) == int(d.nsub)
+    return lists
+
+
+@pytest.fixture(scope="module")
+def rfi_corpus(tmp_path_factory):
+    """3 archives: two contaminated (strong narrowband tones + one
+    broadband burst), one clean — with the injector's ground truth."""
+    root = tmp_path_factory.mktemp("quality")
+    model = default_test_model(1500.0)
+    gmodel = str(root / "model.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files, truths = [], []
+    # contaminated fractions stay <= ~2/32 per cut round: the 3-sigma
+    # iterative cut peels the strongest interferers first (the burst's
+    # 20x channels in round 1, the 8x tones in round 2) — a larger
+    # fraction at one strength would inflate the std past its own
+    # outliers (the classic masking breakdown, faithfully reproduced
+    # by the reference algorithm)
+    specs = [dict(tone_channels=[3, 11], tone_white=8.0,
+                  tone_structured=60.0,
+                  bursts=[(1, [20, 21], 20.0)]),
+             dict(tone_channels=[7, 19], tone_white=8.0,
+                  tone_structured=60.0),
+             None]
+    for i, spec in enumerate(specs):
+        path = str(root / f"ep{i}.fits")
+        make_fake_pulsar(model, PAR, outfile=path, nsub=2, nchan=32,
+                         nbin=128, nu0=1500.0, bw=800.0, tsub=60.0,
+                         phase=0.01 * i, dDM=1e-4 * (i - 1),
+                         noise_stds=0.05, dedispersed=False, quiet=True,
+                         rng=300 + i)
+        truths.append(inject_rfi(path, rng=40 + i, **spec)
+                      if spec else None)
+        files.append(path)
+    return files, gmodel, truths
+
+
+# ---------------------------------------------------------------------------
+# excision core: masked median exactness, host/device list identity
+# ---------------------------------------------------------------------------
+
+def test_masked_median_bit_exact():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    for dtype in (np.float64, np.float32):
+        x = rng.normal(1.0, 0.3, (9, 31)).astype(dtype)
+        keep = rng.random((9, 31)) > 0.3
+        keep[3] = False
+        keep[4, :5] = True
+        keep[4, 5:] = False
+        mm = np.asarray(masked_median_lastaxis(jnp.asarray(x),
+                                               jnp.asarray(keep)))
+        for i in range(9):
+            v = x[i, keep[i]]
+            if v.size:
+                assert mm[i] == np.median(v), (dtype, i)
+
+
+def test_zap_host_matches_reference_loop():
+    """The batched host oracle IS the reference per-subint loop
+    (ppzap.py:24-54) vectorized — verified against a literal
+    transcription of the original algorithm."""
+
+    def reference(noise_row, ichans, nstd):
+        ichans = list(ichans)
+        zap = []
+        while len(ichans):
+            ns = noise_row[ichans]
+            med, std = np.median(ns), np.std(ns)
+            bad = list(np.where(ns > med + nstd * std)[0])
+            if not bad:
+                break
+            flagged = [ichans[i] for i in bad]
+            zap.extend(flagged)
+            for c in flagged:
+                ichans.remove(c)
+        return sorted(zap)
+
+    rng = np.random.default_rng(6)
+    noise = rng.normal(1.0, 0.05, (6, 40))
+    noise[0, [2, 30]] = [5.0, 3.0]
+    noise[2, 11] = 9.0
+    noise[4] = 1.0  # constant row: std 0, everything equal -> no flags
+    keep = rng.random((6, 40)) > 0.1
+    kh, _ = zap_keep_np(noise, keep, 3.0)
+    lists = zap_lists_from_masks(keep, kh)
+    for i in range(6):
+        assert lists[i] == reference(noise[i],
+                                     list(np.flatnonzero(keep[i])), 3.0)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.float32])
+def test_zap_device_matches_host(dtype):
+    """One batched device dispatch == the host loop, f64 and f32 —
+    masks AND per-row iteration counts."""
+    rng = np.random.default_rng(7)
+    noise = rng.normal(1.0, 0.04, (8, 33)).astype(dtype)
+    noise[0, [3, 17]] = [6.0, 3.5]
+    noise[2, 5] = 9.0
+    noise[5, [1, 2, 3]] = [2.0, 4.0, 8.0]  # multi-iteration cascade
+    keep = rng.random((8, 33)) > 0.15
+    kh, ih = zap_keep_np(noise, keep, 3.0)
+    kd, idv = zap_keep_device(noise, keep, 3.0)
+    assert np.array_equal(kh, kd)
+    assert np.array_equal(ih, idv)
+    assert ih.max() >= 2  # the cascade actually iterated
+
+
+def test_zap_device_iterates_in_one_dispatch(rfi_corpus):
+    """The device lane's whole iterative cut is ONE dispatch: the
+    zap_propose event records n_iter >= 1 iterations that ran inside
+    the compiled while_loop — no per-iteration host round-trips to
+    trace (the acceptance criterion's witness)."""
+    files, _, truths = rfi_corpus
+    from pulseportraiture_tpu.telemetry import Tracer
+
+    d = load_data(files[0], dedisperse=False, dededisperse=True,
+                  pscrunch=True, quiet=True)
+    trace = str(os.path.dirname(files[0]) + "/zap_dev.jsonl")
+    with Tracer(trace, run="zap-device") as tr:
+        dev = get_zap_channels(d, device=True, tracer=tr)
+    host = get_zap_channels(d, device=False)
+    assert dev == host
+    _, evs = validate_trace(trace)
+    props = [e for e in evs if e["type"] == "zap_propose"]
+    assert len(props) == 1 and props[0]["device"] is True
+    assert props[0]["n_iter"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# injector ground truth
+# ---------------------------------------------------------------------------
+
+def test_injector_ground_truth_recovered(rfi_corpus):
+    files, _, truths = rfi_corpus
+    for f, truth in zip(files, truths):
+        d = load_data(f, dedisperse=False, dededisperse=True,
+                      pscrunch=True, quiet=True)
+        flagged = _full_lists(d, get_zap_channels(d, device=False))
+        if truth is None:
+            assert sum(len(z) for z in flagged) == 0
+            continue
+        for isub, expect in enumerate(truth.zap_truth):
+            assert set(expect) <= set(flagged[isub]), (f, isub)
+            # no wild over-zapping: at most one spurious channel
+            assert len(flagged[isub]) <= len(expect) + 1, (f, isub)
+
+
+# ---------------------------------------------------------------------------
+# streaming inline zap: digit identity vs the offline oracle
+# ---------------------------------------------------------------------------
+
+def test_stream_inline_zap_matches_offline_oracle(rfi_corpus, tmp_path):
+    """Raw-lane fused inline zap == offline proposal + lossless weight
+    zap + fit, byte-for-byte on .tim — and the zap actually changed
+    the output vs no excision."""
+    files, gmodel, _ = rfi_corpus
+    zap_map = {}
+    for f in files:
+        d = load_data(f, dedisperse=False, dededisperse=True,
+                      pscrunch=True, quiet=True)
+        zap_map[f] = _full_lists(d, get_zap_channels(d, device=False))
+    a = str(tmp_path / "offline.tim")
+    b = str(tmp_path / "inline.tim")
+    c = str(tmp_path / "none.tim")
+    trace = str(tmp_path / "inline.jsonl")
+    stream_wideband_TOAs(files, gmodel, nsub_batch=8, quiet=True,
+                         tim_out=a, zap_channels=zap_map)
+    stream_wideband_TOAs(files, gmodel, nsub_batch=8, quiet=True,
+                         tim_out=b, zap_inline=True, telemetry=trace)
+    stream_wideband_TOAs(files, gmodel, nsub_batch=8, quiet=True,
+                         tim_out=c)
+    assert open(a, "rb").read() == open(b, "rb").read()
+    assert open(a, "rb").read() != open(c, "rb").read()
+    # the fused lane's zap_apply events carry the per-archive cut
+    # (no event for the clean archive — zero-cut applies are not
+    # emitted)
+    _, evs = validate_trace(trace)
+    apps = {e["datafile"]: e["n_channels"] for e in evs
+            if e["type"] == "zap_apply"}
+    for f in files:
+        n = sum(len(z) for z in zap_map[f])
+        assert apps.get(f, 0) == n
+    assert files[2] not in apps
+    # every raw archive's fused proposal is traced: device=True,
+    # wall_s 0 by design (the cut rides the fit dispatch), n_iter from
+    # the packed in-program loop counter — the no-host-round-trips
+    # witness for the fused lane
+    props = {e["datafile"]: e for e in evs
+             if e["type"] == "zap_propose"}
+    assert set(props) == set(files)
+    for f in files:
+        assert props[f]["device"] is True
+        assert props[f]["wall_s"] == 0.0
+    assert max(e["n_iter"] for e in props.values()) >= 1
+    assert props[files[2]]["n_channels"] == 0
+
+
+def test_stream_inline_zap_dec_lane(rfi_corpus, tmp_path):
+    """tscrunch routes the decoded lane: the prepare-time cut matches
+    the offline oracle too (masks zeroed before nu_fit/flag
+    derivation)."""
+    files, gmodel, _ = rfi_corpus
+    zap_map = {}
+    for f in files:
+        d = load_data(f, dedisperse=False, dededisperse=True,
+                      tscrunch=True, pscrunch=True, quiet=True)
+        zap_map[f] = _full_lists(d, get_zap_channels(d, device=False))
+    a = str(tmp_path / "offline.tim")
+    b = str(tmp_path / "inline.tim")
+    stream_wideband_TOAs(files, gmodel, nsub_batch=8, quiet=True,
+                         tscrunch=True, tim_out=a, zap_channels=zap_map)
+    stream_wideband_TOAs(files, gmodel, nsub_batch=8, quiet=True,
+                         tscrunch=True, tim_out=b, zap_inline=True)
+    assert open(a, "rb").read() == open(b, "rb").read()
+
+
+def test_zap_bunch_matches_zapped_load(rfi_corpus):
+    """zap_bunch's derived ok-index recomputation equals load_data's
+    own derivation from zeroed weights."""
+    files, _, _ = rfi_corpus
+    d = load_data(files[0], dedisperse=False, dededisperse=True,
+                  pscrunch=True, quiet=True)
+    zap_bunch(d, [[3, 11], []])
+    assert 3 not in d.ok_ichans[0] and 11 not in d.ok_ichans[0]
+    assert 3 in d.ok_ichans[1]
+    assert list(d.ok_isubs) == [0, 1]
+    # empty a whole subint -> it drops from ok_isubs
+    zap_bunch(d, [list(range(32)), []])
+    assert list(d.ok_isubs) == [1]
+
+
+# ---------------------------------------------------------------------------
+# model-based post-fit cut
+# ---------------------------------------------------------------------------
+
+def test_zap_rows_are_true_subint_indexed(tmp_path):
+    """An archive whose FIRST subint is fully weight-zapped: the
+    flagged rows must still land on the true subint numbers, so
+    print_paz_cmds' -w flags and apply_zaps hit the right subint
+    (per-OK-subint rows — the reference's format — would shift every
+    row down and zap the wrong subint)."""
+    from pulseportraiture_tpu.pipeline import apply_zaps
+
+    path = str(tmp_path / "deadsub.fits")
+    noise = np.where(np.arange(32) == 6, 1.2, 0.06)
+    make_fake_pulsar(default_test_model(1500.0), PAR, outfile=path,
+                     nsub=2, nchan=32, nbin=128, tsub=60.0,
+                     noise_stds=noise,
+                     weights=np.stack([np.zeros(32), np.ones(32)]),
+                     dedispersed=False, quiet=True, rng=91)
+    d = load_data(path, dedisperse=False, dededisperse=True,
+                  pscrunch=True, quiet=True)
+    assert list(d.ok_isubs) == [1]
+    zaps = get_zap_channels(d, device=False)
+    assert zaps == [[], [6]]
+    cmds = print_paz_cmds([path], [zaps], quiet=True)
+    assert any("-z 6 -w 1" in c for c in cmds)
+    assert not any("-w 0" in c for c in cmds)
+    apply_zaps(path, zaps, quiet=True)
+    d2 = load_data(path, dedisperse=False, dededisperse=True,
+                   pscrunch=True, quiet=True)
+    assert 6 not in d2.ok_ichans[1]
+
+
+def test_postfit_cut_device_bit_identical():
+    rng = np.random.default_rng(8)
+    rchi2 = rng.uniform(0.6, 1.25, (6, 24))
+    rchi2[1, [2, 3]] = [40.0, 6.0]
+    rchi2[3, 9] = 2.0
+    snr = rng.uniform(5.0, 60.0, (6, 24))
+    snr[4, 7] = 0.05
+    snr_tot = np.array([50.0, 45.0, np.nan, 55.0, 30.0, 20.0])
+    okc = rng.random((6, 24)) > 0.15
+    okc[5] = False
+    for iterate in (True, False):
+        bh = postfit_cut_np(rchi2, snr, snr_tot, okc, iterate=iterate)
+        bd = postfit_cut_device(rchi2, snr, snr_tot, okc,
+                                iterate=iterate)
+        assert np.array_equal(bh, bd)
+    assert postfit_cut_np(rchi2, snr, snr_tot, okc).any()
+
+
+def test_get_channels_to_zap_device_routing(rfi_corpus):
+    """GetTOAs.get_channels_to_zap routes through the shared core:
+    host and device lanes agree, and the structured tone channels are
+    flagged by the model-based cut."""
+    from pulseportraiture_tpu.pipeline import GetTOAs
+
+    files, gmodel, truths = rfi_corpus
+    gt = GetTOAs(files[:1], gmodel, quiet=True)
+    gt.get_TOAs(quiet=True)
+    host = gt.get_channels_to_zap(device=False)
+    dev = gt.get_channels_to_zap(device=True)
+    assert host == dev
+    for ch in truths[0].contaminated[0]:
+        assert ch in host[0][0]
+
+
+# ---------------------------------------------------------------------------
+# serve: the quality-gated zap-and-refit loop
+# ---------------------------------------------------------------------------
+
+def test_serve_quality_refit_matches_oracle(rfi_corpus, tmp_path):
+    """The closed loop end-to-end: contaminated archives trip the
+    gate, refit once through the warm lanes, post-refit red-chi^2
+    strictly improves, and the served .tim equals the offline
+    zap-then-fit oracle byte-for-byte."""
+    from pulseportraiture_tpu.serve import ToaServer
+
+    files, gmodel, _ = rfi_corpus
+    trace = str(tmp_path / "serve.jsonl")
+    tim = str(tmp_path / "served.tim")
+    srv = ToaServer(nsub_batch=8, telemetry=trace,
+                    quality_refit=True).start()
+    try:
+        res = srv.submit(files, gmodel, tim_out=tim).result(timeout=600)
+    finally:
+        srv.stop()
+    assert len(res.TOA_list) == 6
+    _, evs = validate_trace(trace)
+    refits = [e for e in evs if e["type"] == "refit"]
+    refit_files = {e["datafile"] for e in refits}
+    assert refit_files == set(files[:2])  # both contaminated archives
+    for e in refits:
+        assert e["n_channels"] > 0
+        assert e["gof_after"] < e["gof_before"]  # strictly improves
+        assert e["improved"] is True
+    # oracle: offline host proposals through the lossless weight zap
+    zap_map = {}
+    for f in files[:2]:
+        d = load_data(f, dedisperse=False, dededisperse=True,
+                      pscrunch=True, quiet=True)
+        zap_map[f] = _full_lists(d, get_zap_channels(d, device=False))
+    oracle = str(tmp_path / "oracle.tim")
+    stream_wideband_TOAs(files, gmodel, nsub_batch=8, quiet=True,
+                         tim_out=oracle, zap_channels=zap_map)
+    assert open(tim, "rb").read() == open(oracle, "rb").read()
+    # pptrace quality section summary keys
+    summary = report(trace, file=open(os.devnull, "w"))
+    assert summary["n_refit"] == 2
+    assert summary["n_refit_improved"] == 2
+    assert summary["refit_rate"] == 2.0  # 2 refits / 1 request
+    assert summary["zap_channels_cut"] > 0
+    assert summary["n_zap_propose"] == 2
+
+
+def test_serve_clean_corpus_identical_loop_on_off(rfi_corpus, tmp_path):
+    """Clean data never trips a gate: .tim bytes identical with the
+    quality loop on vs off, zero refits."""
+    from pulseportraiture_tpu.serve import ToaServer
+
+    files, gmodel, _ = rfi_corpus
+    clean = [files[2]]
+    tims = []
+    for qr, name in ((True, "on.tim"), (False, "off.tim")):
+        tim = str(tmp_path / name)
+        trace = str(tmp_path / f"{name}.jsonl")
+        srv = ToaServer(nsub_batch=8, telemetry=trace,
+                        quality_refit=qr).start()
+        try:
+            srv.submit(clean, gmodel, tim_out=tim).result(timeout=600)
+        finally:
+            srv.stop()
+        tims.append(open(tim, "rb").read())
+        _, evs = validate_trace(trace)
+        assert not [e for e in evs if e["type"] == "refit"]
+    assert tims[0] == tims[1]
+
+
+def test_serve_refit_exactly_once_and_loud_fallback(rfi_corpus,
+                                                    tmp_path, capsys):
+    """A doctored gate every archive trips (max_gof ~ 0) with nothing
+    to zap: every archive refits AT MOST once, falls back to the
+    original fit loudly, and the request still completes with the same
+    bytes as the loop-off run."""
+    from pulseportraiture_tpu.serve import ToaServer
+
+    files, gmodel, _ = rfi_corpus
+    clean = [files[2]]
+    tim = str(tmp_path / "forced.tim")
+    ref = str(tmp_path / "ref.tim")
+    trace = str(tmp_path / "forced.jsonl")
+    srv = ToaServer(nsub_batch=8, telemetry=trace, quality_refit=True,
+                    quality_max_gof=1e-6).start()
+    try:
+        srv.submit(clean, gmodel, tim_out=tim).result(timeout=600)
+    finally:
+        srv.stop()
+    err = capsys.readouterr().err
+    assert "not possible" in err  # the loud fallback
+    stream_wideband_TOAs(clean, gmodel, nsub_batch=8, quiet=True,
+                         tim_out=ref)
+    assert open(tim, "rb").read() == open(ref, "rb").read()
+    _, evs = validate_trace(trace)
+    refits = [e for e in evs if e["type"] == "refit"]
+    assert len(refits) == 1  # one archive, exactly one bounded pass
+    assert refits[0]["n_channels"] == 0
+    assert refits[0]["improved"] is False
+
+
+# ---------------------------------------------------------------------------
+# satellites: paz-file write mode, env hooks
+# ---------------------------------------------------------------------------
+
+def test_print_paz_cmds_write_not_append(tmp_path):
+    """Reruns must not silently duplicate the command file (the old
+    unconditional append mode); append stays available explicitly."""
+    out = tmp_path / "paz.sh"
+    zaps = [[[2, 5], []]]
+    print_paz_cmds(["a.fits"], zaps, outfile=str(out), quiet=True)
+    once = out.read_text()
+    print_paz_cmds(["a.fits"], zaps, outfile=str(out), quiet=True)
+    assert out.read_text() == once  # rerun overwrites, not duplicates
+    print_paz_cmds(["a.fits"], zaps, outfile=str(out), quiet=True,
+                   append=True)
+    assert out.read_text() == once * 2
+
+
+def test_quality_env_hooks(monkeypatch, capsys):
+    """PPT_ZAP_NSTD / PPT_QUALITY_*: registered, strict parses,
+    did-you-mean on a typo."""
+    old = (config.zap_nstd, config.quality_refit, config.quality_max_gof,
+           config.quality_min_snr)
+    try:
+        for name in ("PPT_ZAP_NSTD", "PPT_QUALITY_REFIT",
+                     "PPT_QUALITY_MAX_GOF", "PPT_QUALITY_MIN_SNR"):
+            assert name in config.KNOWN_PPT_ENV
+        monkeypatch.setenv("PPT_ZAP_NSTD", "4.5")
+        monkeypatch.setenv("PPT_QUALITY_REFIT", "on")
+        monkeypatch.setenv("PPT_QUALITY_MAX_GOF", "2.0")
+        monkeypatch.setenv("PPT_QUALITY_MIN_SNR", "3.0")
+        changed = config.env_overrides()
+        for key in ("zap_nstd", "quality_refit", "quality_max_gof",
+                    "quality_min_snr"):
+            assert key in changed
+        assert config.zap_nstd == 4.5
+        assert config.quality_refit is True
+        assert config.quality_max_gof == 2.0
+        assert config.quality_min_snr == 3.0
+        monkeypatch.setenv("PPT_ZAP_NSTD", "-1")
+        with pytest.raises(ValueError, match="PPT_ZAP_NSTD"):
+            config.env_overrides()
+        monkeypatch.setenv("PPT_ZAP_NSTD", "3")
+        monkeypatch.setenv("PPT_QUALITY_REFIT", "maybe")
+        with pytest.raises(ValueError, match="PPT_QUALITY_REFIT"):
+            config.env_overrides()
+        monkeypatch.setenv("PPT_QUALITY_REFIT", "off")
+        monkeypatch.setenv("PPT_QUALITY_MAX_GOF", "zero")
+        with pytest.raises(ValueError, match="PPT_QUALITY_MAX_GOF"):
+            config.env_overrides()
+        monkeypatch.setenv("PPT_QUALITY_MAX_GOF", "1.3")
+        monkeypatch.setenv("PPT_QUALITY_MIN_SNR", "-2")
+        with pytest.raises(ValueError, match="PPT_QUALITY_MIN_SNR"):
+            config.env_overrides()
+        monkeypatch.delenv("PPT_QUALITY_MIN_SNR")
+        monkeypatch.setattr(config, "_warned_unknown_ppt", set())
+        monkeypatch.setenv("PPT_ZAP_NSTDS", "3")  # the typo
+        config.env_overrides()
+        err = capsys.readouterr().err
+        assert "PPT_ZAP_NSTDS" in err and "PPT_ZAP_NSTD" in err
+        monkeypatch.delenv("PPT_ZAP_NSTDS")
+    finally:
+        (config.zap_nstd, config.quality_refit, config.quality_max_gof,
+         config.quality_min_snr) = old
