@@ -32,10 +32,26 @@ lost/duplicated requests (``n_route_done`` == requests, TOA totals
 match), and the per-arm telemetry trace schema-validates with the
 router section populated (placement imbalance reported).
 
+Elastic-fleet arms (ISSUE 13, H >= 2):
+  fleet/kill — the SAME campaign with host0 KILLED mid-sweep (its
+              transport raises TransportError, its server aborts):
+              gates zero lost requests, zero duplicated .tim lines
+              (every routed .tim still byte-identical to one-shot),
+              and bounded p99 inflation vs the no-kill router@H arm
+              (``p99_inflation`` <= 10x, ``failover_ok``); the .fleet
+              trace must carry fleet_transition DEAD + route_failover.
+  codec     — the no-shared-fs lane (ToaRouter(write_tim='router')):
+              hosts return full TOA payloads, the router writes every
+              .tim — gated byte-identical (``codec_tim_identical``).
+  hedge     — hedging forced on (hedge_ms=0) over a clean fleet:
+              gated byte-identical to hedging-off
+              (``hedge_tim_identical``) with ``n_hedge`` > 0.
+
 Knobs via env: PPT_NARCH (32), PPT_NSUB (16), PPT_NCHAN (64),
 PPT_NBIN (256), PPT_NREQ (8 requests), PPT_NHOSTS (2),
 PPT_TUNNEL_EMU, PPT_CAMPAIGN_CACHE (shared with bench_campaign),
-PPT_TELEMETRY (traces to <path>.h<H>).  Prints ONE JSON line.
+PPT_TELEMETRY (traces to <path>.h<H>/.fleet/.hedge).  Prints ONE
+JSON line.
 """
 
 import io
@@ -168,6 +184,7 @@ def main():
         # ---- router arms: 1 -> H emulated hosts --------------------
         sweep = []
         tim_identical = True
+        nokill_walls = None
         for H in sorted({1, NHOSTS}):
             trace = f"{trace_base}.h{H}" if trace_base else None
             servers = [
@@ -189,8 +206,14 @@ def main():
             handles = [router.submit(sl, mpath, tim_out=tims[i],
                                      name=f"req{i}")
                        for i, sl in enumerate(slices)]
-            results = [h.result(3600) for h in handles]
+            results, req_walls = [], []
+            import time as _t
+            for h in handles:
+                results.append(h.result(3600))
+                req_walls.append(_t.monotonic() - h._t_submit)
             wall = time.perf_counter() - t0
+            if H == NHOSTS:
+                nokill_walls = req_walls
             placed = router.stats()
             router.close()
             for srv in servers:
@@ -223,6 +246,150 @@ def main():
                 f"router@{H} produced {arm_ntoa} TOAs, one-shot "
                 f"{ntoa} — lost or duplicated work")
             sweep.append(arm)
+
+        # ---- elastic-fleet arms (ISSUE 13) -------------------------
+        import numpy as np
+
+        from pulseportraiture_tpu.pipeline.stream import _DONE_PREFIX
+        from pulseportraiture_tpu.serve.transport import (
+            KillableTransport as _Killable)
+
+        fleet = None
+        codec_tim_identical = None
+        hedge_tim_identical = None
+        n_hedge = None
+        if NHOSTS >= 2 and NREQ >= 2:
+            # --- kill-one-host arm: host0 dies mid-sweep ------------
+            trace = f"{trace_base}.fleet" if trace_base else None
+            servers = [
+                ToaServer(nsub_batch=64, quiet=True,
+                          stream_devices=[jax.local_devices()[h]])
+                .start()
+                for h in range(NHOSTS)]
+            for srv in servers:
+                ToaClient(srv).get_TOAs(files[:1], mpath, timeout=600)
+            transports = [
+                _Killable(InProcTransport(srv, label=f"k{h}"))
+                for h, srv in enumerate(servers)]
+            router = ToaRouter(transports, telemetry=trace)
+            tims = [os.path.join(out_root, f"kill_r{i}.tim")
+                    for i in range(NREQ)]
+            handles = [router.submit(sl, mpath, tim_out=tims[i],
+                                     name=f"req{i}")
+                       for i, sl in enumerate(slices)]
+            killed_reqs = router.stats()["k0"]["n_requests"]
+            # the kill: transport first (the router must see a DEAD
+            # host, never a server-side error), then abort the server
+            # so the dead host stops writing its .tim files
+            transports[0].killed = True
+            servers[0].stop(drain=False)
+            import time as _t
+            kill_results, kill_walls = [], []
+            for h in handles:
+                kill_results.append(h.result(3600))
+                kill_walls.append(_t.monotonic() - h._t_submit)
+            router.close()
+            for srv in servers[1:]:
+                srv.stop()
+            kill_ntoa = sum(len(r.TOA_list) for r in kill_results)
+            lost = NREQ - len(kill_results)
+            dup_lines = 0
+            kill_tim_ok = True
+            for i in range(NREQ):
+                got = open(tims[i], "rb").read()
+                kill_tim_ok = kill_tim_ok and got == open(
+                    ref_tim(i), "rb").read()
+                sent = sum(1 for ln in got.decode().splitlines()
+                           if ln.startswith(_DONE_PREFIX.rstrip()))
+                dup_lines += max(0, sent - len(slices[i]))
+            p99_kill = float(np.percentile(kill_walls, 99))
+            p99_nokill = float(np.percentile(nokill_walls, 99))
+            p99_inflation = p99_kill / max(p99_nokill, 1e-9)
+            # bounded-p99 gate with absolute slack for CI noise at
+            # tiny shapes: a failover costs one detection poll + one
+            # re-fit, never an unbounded stall
+            p99_bounded = p99_kill <= max(10.0 * p99_nokill,
+                                          p99_nokill + 10.0)
+            failover_ok = (lost == 0 and dup_lines == 0
+                           and kill_tim_ok and kill_ntoa == ntoa)
+            assert failover_ok, (
+                f"failover arm lost={lost} dup_lines={dup_lines} "
+                f"tim_ok={kill_tim_ok} toas={kill_ntoa}/{ntoa}")
+            fleet = {
+                "killed_host": "k0",
+                "killed_host_requests": killed_reqs,
+                "lost_requests": lost,
+                "duplicated_tim_lines": dup_lines,
+                "tim_identical": bool(kill_tim_ok),
+                "p99_nokill_s": round(p99_nokill, 3),
+                "p99_kill_s": round(p99_kill, 3),
+                "p99_inflation": round(p99_inflation, 3),
+                "p99_bounded": bool(p99_bounded),
+                "failover_ok": bool(failover_ok),
+            }
+            if trace:
+                summary = telemetry.report(trace, file=io.StringIO())
+                assert summary["fleet_states"].get("k0") == "DEAD", \
+                    summary["fleet_states"]
+                if killed_reqs:
+                    assert summary["n_failover"] >= 1, summary
+                fleet["n_failover"] = summary["n_failover"]
+                fleet["n_failover_collected"] = \
+                    summary["n_failover_collected"]
+
+            # --- codec (no-shared-fs) + hedge arms on a clean fleet -
+            servers = [
+                ToaServer(nsub_batch=64, quiet=True,
+                          stream_devices=[jax.local_devices()[h]])
+                .start()
+                for h in range(NHOSTS)]
+            for srv in servers:
+                ToaClient(srv).get_TOAs(files[:1], mpath, timeout=600)
+            router = ToaRouter(
+                [InProcTransport(srv, label=f"c{h}")
+                 for h, srv in enumerate(servers)],
+                write_tim="router")
+            tims = [os.path.join(out_root, f"codec_r{i}.tim")
+                    for i in range(NREQ)]
+            handles = [router.submit(sl, mpath, tim_out=tims[i],
+                                     name=f"req{i}")
+                       for i, sl in enumerate(slices)]
+            for h in handles:
+                h.result(3600)
+            router.close()
+            codec_tim_identical = all(
+                open(tims[i], "rb").read()
+                == open(ref_tim(i), "rb").read()
+                for i in range(NREQ))
+            assert codec_tim_identical, (
+                "the router-written (no-shared-fs) .tim diverged "
+                "from the shared-fs lane")
+
+            trace = f"{trace_base}.hedge" if trace_base else None
+            router = ToaRouter(
+                [InProcTransport(srv, label=f"g{h}")
+                 for h, srv in enumerate(servers)],
+                hedge_ms=0.0, telemetry=trace)
+            tims = [os.path.join(out_root, f"hedge_r{i}.tim")
+                    for i in range(NREQ)]
+            handles = [router.submit(sl, mpath, tim_out=tims[i],
+                                     name=f"req{i}")
+                       for i, sl in enumerate(slices)]
+            for h in handles:
+                h.result(3600)
+            router.close()
+            for srv in servers:
+                srv.stop()
+            hedge_tim_identical = all(
+                open(tims[i], "rb").read()
+                == open(ref_tim(i), "rb").read()
+                for i in range(NREQ))
+            assert hedge_tim_identical, (
+                "hedging changed .tim bytes on a clean fleet")
+            if trace:
+                summary = telemetry.report(trace, file=io.StringIO())
+                n_hedge = summary["n_hedge"]
+                assert n_hedge >= 1, "hedge_ms=0 never hedged"
     finally:
         for obj, name, val in unpatch:
             setattr(obj, name, val)
@@ -248,6 +415,13 @@ def main():
         "scaling_gate": GATE,
         "tim_identical": bool(tim_identical),
         "sweep": sweep,
+        # elastic-fleet arms (None when NHOSTS < 2): kill-mid-sweep
+        # failover gates, the no-shared-fs codec-lane byte gate, and
+        # the hedging-on-vs-off byte gate
+        "fleet": fleet,
+        "codec_tim_identical": codec_tim_identical,
+        "hedge_tim_identical": hedge_tim_identical,
+        "n_hedge": n_hedge,
         "tunnel_emu": TUNNEL or None,
         "device": str(jax.devices()[0]),
     }))

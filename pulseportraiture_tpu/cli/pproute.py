@@ -11,12 +11,22 @@ exponential backoff, and per-request ``.tim`` files written by
 whichever host served the request — byte-identical to the single-host
 one-shot driver.
 
-Fleet assumptions: archive paths and ``--outdir`` are visible on
-every host (shared filesystem — no bulk data crosses the wire), and
-each endpoint is a running ``ppserve --listen``.  ``--telemetry``
-records the route_submit/route_retry/route_done ledger; read it with
-``tools/pptrace.py report`` (the "router" section: per-host shares,
-retry rate, placement imbalance).
+Fleet assumptions: archive paths are visible on every host, and each
+endpoint is a running ``ppserve --listen``.  With the default
+shared-filesystem lane ``--outdir`` must be host-visible too (the
+serving host writes each ``.tim``); with ``--no-shared-fs`` the full
+TOA payload returns over the wire and THIS process writes the
+``.tim`` (byte-identical, serve/codec.py).
+
+Elastic-fleet controls (ISSUE 13): ``--fleet-file`` watches a
+host-list file for joins/leaves, ``--probe-ms`` bounds liveness
+probes, ``--hedge-ms`` enables tail-latency request hedging,
+``--quality-refit`` routes one zap-and-refit of gate-tripping
+archives to the least-loaded HEALTHY host, and a request line may
+carry ``"tenant"`` for the per-host QoS lanes.  ``--telemetry``
+records the route/fleet ledger; read it with ``tools/pptrace.py
+report`` (the "router" and "fleet" sections: per-host shares, health
+timeline, failover/hedge counts, per-tenant latency split).
 """
 
 import argparse
@@ -38,6 +48,44 @@ def build_parser():
                    help="Fleet endpoints, each a running 'ppserve "
                         "--listen'. [default: config.router_hosts / "
                         "PPT_ROUTER_HOSTS]")
+    p.add_argument("--fleet-file", dest="fleet_file", metavar="FILE",
+                   default=None,
+                   help="WATCHED membership file (one host:port per "
+                        "line, # comments): the router joins/leaves "
+                        "hosts to match whenever the file changes — "
+                        "edit it to grow or shrink the fleet mid-run. "
+                        "Mutually exclusive with --hosts. Also via "
+                        "PPT_ROUTER_FLEET_FILE. [default: "
+                        "config.router_fleet_file]")
+    p.add_argument("--probe-ms", dest="probe_ms", type=float,
+                   default=None, metavar="MS",
+                   help="Deadline on per-host stat liveness probes; a "
+                        "probe past it feeds the host's SUSPECT "
+                        "transition and placement uses the cached "
+                        "load. [default: config.router_probe_ms / "
+                        "PPT_ROUTER_PROBE_MS]")
+    p.add_argument("--hedge-ms", dest="hedge_ms", type=float,
+                   default=None, metavar="MS",
+                   help="Hedged requests: a request unresolved after "
+                        "this long launches one duplicate on the "
+                        "least-loaded other host; first completion "
+                        "wins. [default: config.router_hedge_ms / "
+                        "PPT_ROUTER_HEDGE_MS — off]")
+    p.add_argument("--no-shared-fs", dest="no_shared_fs",
+                   action="store_true", default=False,
+                   help="Codec lane: hosts return the full TOA "
+                        "payload over the wire and THIS process "
+                        "writes each request's .tim (byte-identical "
+                        "to the shared-fs lane) — for fleets without "
+                        "a shared filesystem. [default: hosts write]")
+    p.add_argument("--quality-refit", dest="quality_refit",
+                   action="store_true", default=False,
+                   help="Routed quality loop: a collected request "
+                        "whose TOAs trip config.quality_max_gof gets "
+                        "ONE zap-and-refit placed on the current "
+                        "least-loaded HEALTHY host (enable here OR "
+                        "server-side PPT_QUALITY_REFIT, not both). "
+                        "[default: off]")
     p.add_argument("-O", "--outdir", metavar="DIR", default=".",
                    help="Directory for per-request <name>.tim outputs "
                         "(must be visible to every host). "
@@ -65,17 +113,35 @@ def main(argv=None):
     if args.retry_max is not None and args.retry_max < 1:
         raise SystemExit("--retry-max: must be >= 1, got "
                          f"{args.retry_max}")
+    if args.probe_ms is not None and not args.probe_ms > 0:
+        raise SystemExit("--probe-ms: must be > 0, got "
+                         f"{args.probe_ms}")
+    if args.hedge_ms is not None and args.hedge_ms < 0:
+        raise SystemExit("--hedge-ms: must be >= 0, got "
+                         f"{args.hedge_ms}")
     from .. import config
 
+    if args.hosts is not None and args.fleet_file is not None:
+        raise SystemExit("pproute: --hosts and --fleet-file are "
+                         "mutually exclusive (static list vs watched "
+                         "membership)")
+    fleet_file = args.fleet_file
+    if fleet_file is None and args.hosts is None:
+        fleet_file = config.router_fleet_file
     hosts = args.hosts
     if hosts is not None:
         hosts = [h.strip() for h in str(hosts).split(",") if h.strip()]
-    else:
+    elif fleet_file is None:
         hosts = list(config.router_hosts)
-    if not hosts:
+    else:
+        hosts = []
+        if not os.path.exists(fleet_file):
+            raise SystemExit(
+                f"pproute: --fleet-file not found: {fleet_file}")
+    if not hosts and not fleet_file:
         raise SystemExit("pproute: no fleet endpoints — pass --hosts "
-                         "host:port[,host:port...] or set "
-                         "PPT_ROUTER_HOSTS")
+                         "host:port[,host:port...], --fleet-file, or "
+                         "set PPT_ROUTER_HOSTS")
     for h in hosts:
         try:
             config.parse_hostport(h)
@@ -95,7 +161,13 @@ def main(argv=None):
 
     try:
         router = ToaRouter(hosts, retry_max=args.retry_max,
-                           telemetry=args.telemetry, quiet=args.quiet)
+                           telemetry=args.telemetry, quiet=args.quiet,
+                           probe_ms=args.probe_ms,
+                           hedge_ms=args.hedge_ms,
+                           write_tim=("router" if args.no_shared_fs
+                                      else "host"),
+                           quality_refit=args.quality_refit,
+                           fleet_file=fleet_file)
     except TransportError as e:
         raise SystemExit(f"pproute: {e}")
     failures = 0
@@ -107,7 +179,8 @@ def main(argv=None):
             try:
                 handles.append(router.submit(
                     rec["datafiles"], rec["modelfile"], tim_out=tim,
-                    name=rec["name"], **rec["options"]))
+                    name=rec["name"], tenant=rec.get("tenant"),
+                    **rec["options"]))
             except Exception as e:
                 # a saturated/terminal fleet fails THIS request (the
                 # documented rc=1 path), not the whole batch — the

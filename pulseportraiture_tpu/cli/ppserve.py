@@ -12,9 +12,12 @@ for the same archives.
 
 Request file: one JSON object per line —
     {"name": "J0030+0451", "datafiles": ["a.fits", ...] | "meta.txt",
-     "modelfile": "J0030.spl", "options": {"fit_scat": true, ...}}
+     "modelfile": "J0030.spl", "options": {"fit_scat": true, ...},
+     "tenant": "interactive"}
 ``options`` are stream_wideband_TOAs fit options (lane options);
-requests sharing (modelfile, options) coalesce.
+requests sharing (modelfile, options) coalesce.  ``tenant``
+(optional) labels the request's weighted-fair QoS lane
+(config.serve_tenant_quota / serve_tenant_weight).
 
 ``--warmup-manifest trace.jsonl`` AOT-compiles every dispatch shape a
 prior run's telemetry trace recorded before serving starts
@@ -153,9 +156,14 @@ def parse_requests(path):
                 raise SystemExit(
                     f"ppserve: {path}:{lineno}: options must be an "
                     "object")
+            tenant = rec.get("tenant")
+            if tenant is not None and not isinstance(tenant, str):
+                raise SystemExit(
+                    f"ppserve: {path}:{lineno}: tenant must be a "
+                    "string (the QoS lane label)")
             reqs.append(dict(name=name, datafiles=rec["datafiles"],
                              modelfile=str(rec["modelfile"]),
-                             options=options))
+                             options=options, tenant=tenant))
     if not reqs:
         raise SystemExit(f"ppserve: no requests in {path}")
     return reqs
@@ -278,6 +286,7 @@ def main(argv=None):
                     handles.append(server.submit(
                         rec["datafiles"], rec["modelfile"],
                         tim_out=tim, name=rec["name"],
+                        tenant=rec.get("tenant"),
                         **rec["options"]))
                     break
                 except ServeRejected as e:

@@ -254,6 +254,51 @@ router_retry_max = 16
 # Set via PPT_SERVE_LISTEN=host:port.
 serve_listen = None
 
+# --- Elastic fleet (serve/fleet.py + the ISSUE 13 router rework) -----------
+# Deadline [ms] on the router's per-host ``stat`` liveness probes: a
+# placement pass waits at most this long for a load refresh; a probe
+# still outstanding past the deadline feeds the host's SUSPECT
+# transition and the CACHED last-known load is used, so one hung host
+# can never delay every submit behind its socket timeout.  Set via
+# PPT_ROUTER_PROBE_MS (must be > 0).
+router_probe_ms = 1000.0
+
+# Hedged-request deadline [ms]: a routed request still unresolved
+# after this long launches ONE duplicate attempt on the least-loaded
+# other eligible host — first completion wins, the loser is cancelled
+# at collection.  Tail-latency insurance for fleets with straggling
+# hosts; byte-identity holds because both hosts serve identical .tim
+# content (bench_router gates hedging off-vs-on byte-identical on a
+# clean fleet).  None (default) = hedging off.  Set via
+# PPT_ROUTER_HEDGE_MS=<ms>|off.
+router_hedge_ms = None
+
+# Watched fleet-membership file for ToaRouter / ``pproute
+# --fleet-file``: one host:port per line (# comments); the router
+# add/remove-hosts to match whenever the file changes, so operators
+# grow or shrink a fleet by editing a file — no router restart.  None
+# (default) = static membership only.  Set via PPT_ROUTER_FLEET_FILE.
+router_fleet_file = None
+
+# --- Multi-tenant QoS (serve/queue.AdmissionQueue) -------------------------
+# Per-tenant pending-archive quota inside the admission queue: an int
+# caps EVERY tenant, a dict {tenant: cap} (with optional '*' default)
+# caps named tenants, None (default) applies only the global
+# serve_queue_depth bound.  A tenant at its quota gets the same
+# retryable ServeRejected backpressure as a full queue, but no single
+# tenant can occupy the whole queue.  Set via
+# PPT_SERVE_TENANT_QUOTA="<N>" or "tenantA:4,tenantB:32[,*:8]" or
+# 'off'.
+serve_tenant_quota = None
+
+# Per-tenant weights for the admission queue's weighted-fair
+# scheduler: {tenant: weight} ('*' sets the default; unlisted tenants
+# weigh 1.0).  Lanes are served in proportion to weight, measured in
+# ARCHIVES — a bulk campaign tenant with weight 1 cannot starve an
+# interactive tenant with weight 4.  None (default) = equal weights.
+# Set via PPT_SERVE_TENANT_WEIGHT="interactive:4,bulk:1" or 'off'.
+serve_tenant_weight = None
+
 # Bucket-lattice coarsening (ROADMAP item 5): pad bucket channel
 # layouts up to the next power of two with zero-weight channels so a
 # campaign's (or serving fleet's) shape diversity costs log2 as many
@@ -377,7 +422,12 @@ RCSTRINGS = {
 #   PPT_BUCKET_PAD=off|auto|on      -> bucket_pad
 #   PPT_ROUTER_HOSTS=h:p[,h:p...]|off -> router_hosts
 #   PPT_ROUTER_RETRY_MAX=<N>        -> router_retry_max
+#   PPT_ROUTER_PROBE_MS=<float>     -> router_probe_ms
+#   PPT_ROUTER_HEDGE_MS=<float>|off -> router_hedge_ms
+#   PPT_ROUTER_FLEET_FILE=<path>|off -> router_fleet_file
 #   PPT_SERVE_LISTEN=<host:port>|off -> serve_listen
+#   PPT_SERVE_TENANT_QUOTA=<N>|t:N,...|off -> serve_tenant_quota
+#   PPT_SERVE_TENANT_WEIGHT=t:W,...|off    -> serve_tenant_weight
 #
 # Unset variables leave the module values untouched; a typo in a
 # KNOWN variable's value raises (strict like the config parsers — a
@@ -401,6 +451,9 @@ KNOWN_PPT_ENV = frozenset({
     "PPT_PIPELINE_DEPTH", "PPT_COMPILE_CACHE", "PPT_TELEMETRY",
     "PPT_SERVE_MAX_WAIT_MS", "PPT_SERVE_QUEUE_DEPTH", "PPT_BUCKET_PAD",
     "PPT_ROUTER_HOSTS", "PPT_ROUTER_RETRY_MAX", "PPT_SERVE_LISTEN",
+    "PPT_ROUTER_PROBE_MS", "PPT_ROUTER_HEDGE_MS",
+    "PPT_ROUTER_FLEET_FILE", "PPT_SERVE_TENANT_QUOTA",
+    "PPT_SERVE_TENANT_WEIGHT",
     # benchmark / smoke-test shape and mode knobs
     "PPT_NB", "PPT_NE", "PPT_NPSR", "PPT_NARCH", "PPT_NSUB",
     "PPT_NSUBB", "PPT_NCHAN", "PPT_NBIN", "PPT_NITER", "PPT_K",
@@ -427,6 +480,58 @@ def parse_hostport(spec):
     if not 0 <= port <= 65535:
         raise ValueError(f"port {port} out of range in {spec!r}")
     return host, port
+
+
+def parse_tenant_spec(raw, name, cast=int, allow_bare=True):
+    """Parse a tenant QoS spec: '<N>' (every tenant, needs
+    allow_bare) or 'tenantA:N,tenantB:M[,*:K]' -> int-or-dict, loud on
+    anything else — shared by the PPT_SERVE_TENANT_* env hooks and the
+    ppserve/pproute CLIs (a silently mis-parsed quota would quietly
+    remove a fairness guarantee)."""
+    s = str(raw).strip()
+    if not s:
+        raise ValueError(f"{name}: empty tenant spec")
+    if ":" not in s:
+        if not allow_bare:
+            raise ValueError(
+                f"{name} must be 'tenant:value[,tenant:value...]' "
+                f"pairs, got {s!r} (a bare value is meaningless for "
+                "weights — equal weights are the default)")
+        try:
+            v = cast(s)
+        except ValueError:
+            raise ValueError(
+                f"{name} must be a number or tenant:value pairs, got "
+                f"{s!r}")
+        if not v > 0:
+            raise ValueError(f"{name} must be > 0, got {v}")
+        return v
+    out = {}
+    for part in s.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        tenant, sep, val = part.rpartition(":")
+        if not sep or not tenant:
+            raise ValueError(
+                f"{name}: expected 'tenant:value', got {part!r}")
+        try:
+            v = cast(val)
+        except ValueError:
+            raise ValueError(
+                f"{name}: expected a numeric value in {part!r}, got "
+                f"{val!r}")
+        if not v > 0:
+            raise ValueError(
+                f"{name}: value for tenant {tenant!r} must be > 0, "
+                f"got {v}")
+        if tenant in out:
+            raise ValueError(
+                f"{name}: tenant {tenant!r} listed twice")
+        out[tenant] = v
+    if not out:
+        raise ValueError(f"{name}: no tenant:value pairs in {raw!r}")
+    return out
 
 
 _warned_unknown_ppt = set()  # warn ONCE per process per variable
@@ -675,6 +780,58 @@ def env_overrides():
                 f"PPT_ROUTER_RETRY_MAX must be >= 1, got {n}")
         cfg.router_retry_max = n
         changed.append("router_retry_max")
+    pms = _os.environ.get("PPT_ROUTER_PROBE_MS", "")
+    if pms:
+        try:
+            v = float(pms)
+        except ValueError:
+            raise ValueError(
+                "PPT_ROUTER_PROBE_MS must be a positive number of "
+                f"milliseconds, got {pms!r}")
+        if not v > 0:
+            raise ValueError(
+                f"PPT_ROUTER_PROBE_MS must be > 0, got {v}")
+        cfg.router_probe_ms = v
+        changed.append("router_probe_ms")
+    hms = _os.environ.get("PPT_ROUTER_HEDGE_MS", "")
+    if hms:
+        if hms.lower() in ("off", "none"):
+            cfg.router_hedge_ms = None
+        else:
+            try:
+                v = float(hms)
+            except ValueError:
+                raise ValueError(
+                    "PPT_ROUTER_HEDGE_MS must be a non-negative "
+                    f"number of milliseconds or 'off', got {hms!r}")
+            if v < 0:
+                raise ValueError(
+                    f"PPT_ROUTER_HEDGE_MS must be >= 0, got {v}")
+            cfg.router_hedge_ms = v
+        changed.append("router_hedge_ms")
+    ffile = _os.environ.get("PPT_ROUTER_FLEET_FILE", "")
+    if ffile:
+        cfg.router_fleet_file = (
+            None if ffile.lower() in ("off", "none") else ffile)
+        changed.append("router_fleet_file")
+    tq = _os.environ.get("PPT_SERVE_TENANT_QUOTA", "")
+    if tq:
+        if tq.lower() in ("off", "none"):
+            cfg.serve_tenant_quota = None
+        else:
+            cfg.serve_tenant_quota = parse_tenant_spec(
+                tq, "PPT_SERVE_TENANT_QUOTA", cast=int,
+                allow_bare=True)
+        changed.append("serve_tenant_quota")
+    tw = _os.environ.get("PPT_SERVE_TENANT_WEIGHT", "")
+    if tw:
+        if tw.lower() in ("off", "none"):
+            cfg.serve_tenant_weight = None
+        else:
+            cfg.serve_tenant_weight = parse_tenant_spec(
+                tw, "PPT_SERVE_TENANT_WEIGHT", cast=float,
+                allow_bare=False)
+        changed.append("serve_tenant_weight")
     listen = _os.environ.get("PPT_SERVE_LISTEN", "")
     if listen:
         if listen.lower() in ("off", "none"):

@@ -16,9 +16,22 @@ campaign's requests across N such hosts — least-loaded placement,
 sticky per-template affinity, backpressure retries — with the demux
 still byte-identical to one-shot no matter which host served; see
 docs/GUIDE.md "Routing a campaign across hosts".
+
+Elastic fleet (ISSUE 13): ``fleet.py`` gives the router dynamic
+membership with a per-host health state machine (JOINING -> HEALTHY
+-> SUSPECT -> DEAD -> REJOINED off bounded probes), ``codec.py``
+factors the result wire codec into the no-shared-fs ``.tim`` demux
+and the durable-``.tim`` failover primitives, and the router layers
+exactly-once mid-fit failover, hedged requests, routed quality
+refits, and per-tenant QoS lanes (``queue.AdmissionQueue``) on top;
+see docs/GUIDE.md "Operating an elastic fleet".
 """
 
 from .client import ToaClient  # noqa: F401
+from .codec import (decode_result, encode_result,  # noqa: F401
+                    read_tim_result, tim_complete, write_tim_result)
+from .fleet import (DEAD, HEALTHY, JOINING, REJOINED,  # noqa: F401
+                    SUSPECT, Fleet, FleetFileWatcher, FleetMember)
 from .queue import AdmissionQueue, ServeRejected, ServeRequest  # noqa: F401
 from .router import RouteHandle, ToaRouter  # noqa: F401
 from .server import ToaServer  # noqa: F401

@@ -16,6 +16,19 @@ submit -> admit (the server loads + buckets its archives; subints from
 different requests coalesce into shared fused dispatches) -> done (the
 per-request ``.tim``/result is demultiplexed back out).  ``result()``
 blocks the submitting client; the server thread resolves it.
+
+Multi-tenant QoS (ISSUE 13): requests carry a ``tenant`` label and the
+queue keeps one FIFO lane per tenant.  ``get`` serves lanes
+WEIGHTED-FAIR over archives (each lane's virtual time is archives
+admitted / its ``config.serve_tenant_weight``; the lane furthest
+behind goes next, and a lane waking from idle starts at the current
+virtual time so it cannot burst on banked credit) — a bulk campaign
+tenant can saturate the queue without starving a small interactive
+tenant.  ``config.serve_tenant_quota`` additionally caps any one
+tenant's pending archives below the global bound, so one tenant can
+never occupy the whole admission queue in the first place; a submit
+over its tenant quota is rejected retryably exactly like global
+backpressure, but the message names the tenant and the knob.
 """
 
 import itertools
@@ -53,7 +66,7 @@ class ServeRequest:
     _ids = itertools.count()
 
     def __init__(self, datafiles, modelfile, options=None, tim_out=None,
-                 name=None):
+                 name=None, tenant=None):
         from ..pipeline.toas import _is_metafile, _read_metafile
 
         if isinstance(datafiles, str):
@@ -69,6 +82,9 @@ class ServeRequest:
         self.tim_out = tim_out
         self.name = str(name) if name is not None else \
             f"req{next(ServeRequest._ids)}"
+        # QoS lane label: requests of one tenant share a weighted-fair
+        # admission lane and a pending-archive quota
+        self.tenant = str(tenant) if tenant is not None else "default"
         # lifecycle timestamps (monotonic): submit by the queue, admit/
         # done by the server — what the request_done latency split and
         # the pptrace serve section report
@@ -111,7 +127,8 @@ class ServeRequest:
 
 
 class AdmissionQueue:
-    """Bounded, thread-safe request queue feeding one serving loop.
+    """Bounded, thread-safe request queue feeding one serving loop,
+    with per-tenant weighted-fair lanes and quotas.
 
     ``submit`` (any client thread) appends or REJECTS — it never
     blocks, so a client can tell load-shedding from slowness.  ``get``
@@ -120,40 +137,98 @@ class AdmissionQueue:
     accounting is released as the server admits each archive
     (:meth:`release`), i.e. the bound covers submitted-but-not-yet-
     prepared work.
+
+    tenant_quota: None (global bound only), an int (every tenant's
+    pending-archive cap), or a dict {tenant: cap} with an optional
+    ``'*'`` default — ``config.serve_tenant_quota`` /
+    PPT_SERVE_TENANT_QUOTA.  tenant_weight: {tenant: weight} (``'*'``
+    default; unlisted tenants weigh 1.0) —
+    ``config.serve_tenant_weight`` / PPT_SERVE_TENANT_WEIGHT.
     """
 
-    def __init__(self, max_pending):
+    def __init__(self, max_pending, tenant_quota=None,
+                 tenant_weight=None):
+        from .. import config
+
         self.max_pending = max(1, int(max_pending))
+        if tenant_quota is None:
+            tenant_quota = config.serve_tenant_quota
+        if tenant_weight is None:
+            tenant_weight = config.serve_tenant_weight
+        self.tenant_quota = tenant_quota
+        self.tenant_weight = dict(tenant_weight or {})
         self._cv = threading.Condition()
-        self._q = []
-        self._pending = 0
+        self._lanes = {}           # tenant -> [requests] (FIFO)
+        self._pending = 0          # archives, global
+        self._pending_tenant = {}  # tenant -> archives pending
+        self._served = {}          # tenant -> archives ever popped
         self._closed = False
+
+    # -- QoS resolution ------------------------------------------------
+
+    def _quota_for(self, tenant):
+        q = self.tenant_quota
+        if q is None:
+            return None
+        if isinstance(q, dict):
+            q = q.get(tenant, q.get("*"))
+            return None if q is None else int(q)
+        return int(q)
+
+    def _weight_for(self, tenant):
+        w = self.tenant_weight.get(tenant,
+                                   self.tenant_weight.get("*", 1.0))
+        return max(float(w), 1e-9)
+
+    def _vtime(self, tenant):
+        """A lane's virtual time: archives admitted over its weight —
+        the weighted-fair scheduler serves the lane furthest behind."""
+        return self._served.get(tenant, 0) / self._weight_for(tenant)
 
     def __len__(self):
         with self._cv:
-            return len(self._q)
+            return sum(len(q) for q in self._lanes.values())
 
     @property
     def pending_archives(self):
         with self._cv:
             return self._pending
 
+    def tenant_snapshot(self):
+        """{tenant: {queued, pending_archives}} — the QoS view tests
+        and the fleet report read."""
+        with self._cv:
+            return {t: {"queued": len(self._lanes.get(t, ())),
+                        "pending_archives": self._pending_tenant
+                        .get(t, 0)}
+                    for t in set(self._lanes)
+                    | set(self._pending_tenant)}
+
     def submit(self, request):
-        """Enqueue or raise ServeRejected (queue full / closed)."""
+        """Enqueue or raise ServeRejected (queue full / tenant over
+        quota / closed)."""
         n = len(request.datafiles)
+        tenant = getattr(request, "tenant", None) or "default"
         with self._cv:
             if self._closed:
                 raise ServeRejected(
                     "serving queue is closed (server stopping); "
                     f"request {request.name!r} rejected")
-            if n > self.max_pending:
+            quota = self._quota_for(tenant)
+            if n > self.max_pending or (quota is not None
+                                        and n > quota):
                 # could NEVER fit, even into an idle queue: terminal,
                 # not retryable — a retrying client would spin forever
+                bound = self.max_pending if n > self.max_pending \
+                    else quota
+                knob = ("config.serve_queue_depth"
+                        if n > self.max_pending
+                        else f"tenant {tenant!r} quota "
+                             "(config.serve_tenant_quota)")
                 raise ServeRejected(
                     f"request {request.name!r} holds {n} archives, "
-                    f"more than the whole queue depth "
-                    f"{self.max_pending}; split it or raise "
-                    "config.serve_queue_depth")
+                    f"more than the whole bound {bound} of {knob}; "
+                    "split it or raise the knob")
             if self._pending + n > self.max_pending:
                 raise ServeRejected(
                     f"admission queue full: {self._pending} archive(s) "
@@ -161,24 +236,58 @@ class AdmissionQueue:
                     f"{self.max_pending} (config.serve_queue_depth / "
                     "PPT_SERVE_QUEUE_DEPTH); retry later",
                     retryable=True)
+            t_pending = self._pending_tenant.get(tenant, 0)
+            if quota is not None and t_pending + n > quota:
+                raise ServeRejected(
+                    f"tenant {tenant!r} over quota: {t_pending} "
+                    f"archive(s) pending + {n} submitted > tenant "
+                    f"quota {quota} (config.serve_tenant_quota / "
+                    "PPT_SERVE_TENANT_QUOTA); retry later",
+                    retryable=True)
+            lane = self._lanes.setdefault(tenant, [])
+            if not lane:
+                # a lane waking from idle starts at the CURRENT
+                # virtual time: banked idle credit must not let it
+                # monopolize the scheduler to "catch up"
+                active = [self._vtime(t) for t, q in
+                          self._lanes.items() if q and t != tenant]
+                if active:
+                    floor = min(active) * self._weight_for(tenant)
+                    self._served[tenant] = max(
+                        self._served.get(tenant, 0), int(floor))
             self._pending += n
+            self._pending_tenant[tenant] = t_pending + n
             request.t_submit = time.monotonic()
-            self._q.append(request)
+            lane.append(request)
             self._cv.notify()
 
     def get(self, timeout=None):
-        """Pop the oldest request, waiting up to ``timeout`` seconds;
-        None on timeout (or closed-and-empty)."""
+        """Pop the next request weighted-fair across tenant lanes
+        (FIFO within a lane), waiting up to ``timeout`` seconds; None
+        on timeout (or closed-and-empty)."""
         with self._cv:
-            if not self._q and not self._closed:
+            if not any(self._lanes.values()) and not self._closed:
                 self._cv.wait(timeout)
-            return self._q.pop(0) if self._q else None
+            active = sorted((t for t, q in self._lanes.items() if q),
+                            key=lambda t: (self._vtime(t), t))
+            if not active:
+                return None
+            tenant = active[0]
+            req = self._lanes[tenant].pop(0)
+            self._served[tenant] = self._served.get(tenant, 0) \
+                + len(req.datafiles)
+            return req
 
-    def release(self, n=1):
+    def release(self, n=1, tenant=None):
         """Return ``n`` archives' worth of admission credit (the
-        server admitted or abandoned them)."""
+        server admitted or abandoned them); ``tenant`` releases that
+        lane's quota too."""
         with self._cv:
             self._pending = max(0, self._pending - int(n))
+            if tenant is not None:
+                t = str(tenant)
+                self._pending_tenant[t] = max(
+                    0, self._pending_tenant.get(t, 0) - int(n))
 
     def close(self):
         """Refuse all further submissions (graceful-drain entry);
@@ -188,8 +297,11 @@ class AdmissionQueue:
             self._cv.notify_all()
 
     def drain(self):
-        """Pop everything still queued (abort path) — the caller fails
-        these requests loudly."""
+        """Pop everything still queued, every lane (abort path) — the
+        caller fails these requests loudly."""
         with self._cv:
-            out, self._q = self._q, []
+            out = []
+            for t in sorted(self._lanes):
+                out.extend(self._lanes[t])
+            self._lanes = {}
             return out

@@ -1,59 +1,74 @@
-"""Cross-host campaign router (ISSUE 10 tentpole, second half;
-ROADMAP item 2's scale-out tail).
+"""Cross-host campaign router over an elastic fleet (ISSUE 10 + the
+ISSUE 13 elastic-fleet rework; ROADMAP item 1).
 
-One warm :class:`~.server.ToaServer` saturates one host's chips — and
-at campaign scale the measured bottleneck is that host's host->device
-link (BENCHMARKS 5b/5d: ~90-95% of wall blocked on transfer).  The
-link is exactly the resource that MULTIPLIES across hosts, and pulsar
-archives are embarrassingly parallel with no cross-host traffic until
-the final GLS, so the scale-out shape is the continuous-batching
-inference one: keep every replica warm, route at REQUEST granularity,
-aggregate demuxed results deterministically.
+:class:`ToaRouter` shards TOA requests across N warm serving loops
+(serve/server.ToaServer behind serve/transport.py transports).  The
+R13 router solved placement — least-loaded with sticky per-template
+affinity and backpressure retries — over a STATIC host list; this
+version adds the rest of the production serving story:
 
-:class:`ToaRouter` owns N host endpoints, each a transport
-(serve/transport.py — ``InProcTransport`` or ``SocketTransport``)
-reaching a warm serving loop:
+- **Dynamic membership + health state machine** (serve/fleet.py):
+  hosts :meth:`add_host`/:meth:`remove_host` at runtime (or through a
+  watched ``--fleet-file``), each walking
+  ``JOINING -> HEALTHY -> SUSPECT -> DEAD -> REJOINED`` off bounded
+  ``stat`` probes (``config.router_probe_ms`` — a hung host feeds
+  SUSPECT instead of stalling placement) and submit/transport errors.
+  Placement draws only from HEALTHY/SUSPECT members.
+- **Exactly-once mid-fit failover**: a DEAD transition with requests
+  in flight re-places them on the surviving fleet.  A request whose
+  durable ``.tim`` already carries every completion sentinel is
+  COLLECTED from the file (serve/codec.read_tim_result) and never
+  re-fit; anything else re-dispatches with the dead host in the
+  request's ``excluded`` set — the replacement returns its payload
+  over the wire and the ROUTER writes its ``.tim`` atomically, so a
+  kill-mid-sweep loses zero requests and duplicates zero ``.tim``
+  lines even when the "dead" host turns out to be a zombie that
+  finishes late (it rewrites the same path with identical bytes,
+  fits being deterministic).
+- **Hedged requests** (``config.router_hedge_ms`` / ``hedge_ms=``):
+  an optional tail-latency policy — a request still unresolved after
+  the hedge deadline launches ONE duplicate attempt on the
+  least-loaded other eligible host; first completion wins, the loser
+  is cancelled at collection (its result is reaped-and-discarded in
+  the background so no host pins an abandoned payload).  A
+  hedging-armed router routes every ``.tim`` through its own atomic
+  writer — no host writes request paths — so two writers never share
+  one file.  Byte-identity holds because fits are deterministic —
+  bench_router gates hedging-off-vs-on byte-identical on a clean
+  fleet.
+- **Result-over-the-wire codec lane** (``write_tim='router'``):
+  fleets WITHOUT a shared filesystem return the full TOA payload over
+  the transport and the ROUTER writes the demuxed ``.tim``
+  (serve/codec.write_tim_result) — byte-identical to the shared-fs
+  lane, gated.
+- **Refit-aware routing** (``quality_refit=True``; ROADMAP item 4
+  tail): a collected result that trips the ``config.quality_max_gof``
+  / ``quality_min_snr`` gates gets exactly ONE zap-and-refit routed
+  to the CURRENT least-loaded HEALTHY host instead of pinned to the
+  original lane — the ``refit`` telemetry event carries the host move
+  (``host_from`` -> ``host``).  Enable this OR the server-side loop
+  (``config.quality_refit``), not both.
+- **Multi-tenant QoS plumb**: ``submit(tenant=...)`` rides the wire
+  into the per-host AdmissionQueue's weighted-fair tenant lanes
+  (serve/queue.py; ``config.serve_tenant_quota`` /
+  ``serve_tenant_weight``), and the tenant label lands on
+  route_submit/route_done for pptrace's per-tenant latency split.
 
-- **Load-aware placement**: submits go to the host with the fewest
-  pending archives — the router's own outstanding count (archives
-  submitted through it and not yet collected) plus the host's live
-  AdmissionQueue depth from ``stat()``, so externally-offered load on
-  a shared host is visible too.
-- **Sticky per-modelfile affinity**: requests using a template the
-  router has already placed PREFER that host, so same-template
-  requests keep coalescing into shared fused buckets (the server's
-  per-(modelfile, options) lanes) instead of fragmenting their bucket
-  fills across the fleet.  Affinity yields to balance exactly when it
-  must: the affinity host wins unless its load exceeds the
-  least-loaded host's by at least the incoming request's own archive
-  count — i.e. unless placing the request on the affinity host would
-  leave it strictly more loaded than placing it anywhere else.
-- **Backpressure retries**: a ``ServeRejected(retryable=True)`` (a
-  full admission queue) moves the request to the next-least-loaded
-  host; a ``TransportError`` (host unreachable) does the same.  Each
-  full pass over the fleet backs off exponentially
-  (``ROUTER_BACKOFF_BASE_S`` doubling, capped) up to
-  ``config.router_retry_max`` total attempts; terminal rejections
-  (``retryable=False``) raise immediately.
-- **Deterministic demux**: each request's ``.tim`` is written by the
-  SERVING host through the server's existing per-request demux, so it
-  is byte-identical to the single-host one-shot driver regardless of
-  placement, retries, or completion order; the decoded result
-  DataBunch rides the transport codec.
-
-Telemetry: ``router_start`` once, then per request ``route_submit``
-(chosen host, placement attempt count, affinity flag),
-``route_retry`` (per rejected placement, with the backoff applied),
-and ``route_done`` (serving host, wall, TOA count / error) — the
-pptrace "router" section aggregates per-host shares, retry rate, and
-a placement-imbalance metric from exactly these events.
+Telemetry: ``router_start`` once; per request ``route_submit`` /
+``route_retry`` / ``route_done`` (R13), plus ``fleet_transition`` per
+health edge, ``route_failover`` per dead-host re-placement (action
+``collected`` | ``redispatch``), and ``route_hedge`` per hedge launch
+— the pptrace "router" and "fleet" sections aggregate exactly these.
 """
 
 import os
 import threading
 import time
 
-from ..telemetry import resolve_tracer
+from ..telemetry import log, resolve_tracer
+from . import codec
+from .fleet import (DEAD, HEALTHY, PLACEABLE_STATES, Fleet,
+                    FleetFileWatcher)
 from .queue import ServeRejected
 from .transport import TransportError
 
@@ -65,124 +80,202 @@ __all__ = ["ToaRouter", "RouteHandle", "ROUTER_BACKOFF_BASE_S",
 # unbounded doubling would look like a hang).
 ROUTER_BACKOFF_BASE_S = 0.05
 ROUTER_BACKOFF_CAP_S = 2.0
-
-
-class _Host:
-    """Router-side bookkeeping for one endpoint: the transport plus
-    the outstanding-archives counter placement reads."""
-
-    def __init__(self, transport, index):
-        self.transport = transport
-        self.index = index
-        self.label = getattr(transport, "label", f"host{index}")
-        self.outstanding = 0   # archives submitted, result not collected
-        self.n_requests = 0    # requests ever placed here
-        self.n_archives = 0    # archives ever placed here
-
-    def load(self):
-        """Pending archives from this router (outstanding) plus the
-        host's own admission-queue depth (other clients' submits are
-        visible there).  A host whose stat() is unreachable reports
-        infinite load — placement simply avoids it this round."""
-        try:
-            pending = int(self.transport.stat()["pending_archives"])
-        except TransportError:
-            return float("inf")
-        return self.outstanding + pending
+# Per-attempt result poll slices: the SHORT slice applies while the
+# attempt set can still change (hedging armed, or several attempts
+# racing) so hedge launches and failover swaps are noticed promptly;
+# the LONG slice applies to a settled single attempt — a transport
+# failure interrupts it on its own, so the only cost of a longer
+# slice there is how late a cross-thread local resolution is noticed.
+ROUTER_POLL_S = 0.1
+ROUTER_POLL_SETTLED_S = 0.25
+# Bound on one routed zap-and-refit round trip: a refit rides INSIDE
+# the original request's collection, so an unbounded wait would wedge
+# the client past any timeout it asked for; a refit that cannot
+# finish in this long serves the ORIGINAL result loudly instead.
+ROUTER_REFIT_TIMEOUT_S = 600.0
+# Cadence of the orphan reaper (hedge losers): their results must be
+# collected-and-discarded or the losing host's handle table would pin
+# every abandoned payload for the connection's lifetime.
+ROUTER_REAP_S = 0.25
 
 
 class RouteHandle:
-    """One routed request: which host took it, and the blocking
-    :meth:`result` that demuxes through that host's transport."""
+    """One routed request: its submit spec (kept so the router can
+    re-place it), its live placement attempts (primary + at most one
+    hedge), and the blocking :meth:`result`."""
 
     def __init__(self, router, host, handle, name, n_archives,
-                 t_submit):
+                 t_submit, spec):
         self._router = router
-        self.host = host
+        self.host = host            # current primary member
         self._handle = handle
         self.name = name
         self.n_archives = n_archives
         self._t_submit = t_submit
-        self._collected = False
+        self.spec = spec            # dict: datafiles/modelfile/tim_out/
+        #                                   options/tenant
+        # live attempts: [(member, handle, router_tim)] — router_tim
+        # marks attempts whose .tim the ROUTER writes from the decoded
+        # payload at collection (codec lane, hedges, failover
+        # replacements) instead of the serving host
+        self.attempts = [(host, handle, spec.get("host_tim") is None
+                          and spec.get("tim_out") is not None)]
+        self.excluded = set()       # labels this request must avoid
+        self._collected = False     # accounting/telemetry fired
+        self._done = threading.Event()
+        self._result = None
+        self._error = None
+        self._hedged = False
+        self._redispatching = False
+        self._refit_done = False
+
+    @property
+    def tim_out(self):
+        return self.spec.get("tim_out")
+
+    @property
+    def datafiles(self):
+        return self.spec["datafiles"]
+
+    def done(self):
+        return self._done.is_set()
 
     def result(self, timeout=None):
         """Block for the per-request DataBunch (the one-shot driver's
         result shape) or raise the request's failure; either way the
         router's load accounting and route_done telemetry fire exactly
-        once."""
-        try:
-            res = self.host.transport.result(self._handle, timeout)
-        except TimeoutError:
-            raise  # not resolved: keep the load accounted, retryable
-        except Exception as e:
-            self._router._collected(self, error=e)
-            raise
-        self._router._collected(self, result=res)
-        return res
+        once.  A TimeoutError leaves the request collectable."""
+        return self._router._await(self, timeout)
 
 
 class ToaRouter:
-    """Shard TOA requests across a fleet of warm serving loops.
+    """Shard TOA requests across an elastic fleet of warm serving
+    loops.
 
-    transports: sequence of transport objects (InProcTransport /
-    SocketTransport), or 'host:port' strings (each opens a
-    SocketTransport).  retry_max: total placement attempts per request
-    before the last retryable rejection is raised (None =
-    ``config.router_retry_max``).  telemetry: trace path or shared
-    Tracer (route_* events land there).
+    transports: transports (InProcTransport / SocketTransport) or
+    'host:port' strings; may be empty when ``fleet_file`` supplies the
+    membership.  retry_max: total placement attempts per request
+    (None = ``config.router_retry_max``).  probe_ms: stat-probe
+    deadline (None = ``config.router_probe_ms``).  hedge_ms: hedge
+    launch deadline in ms (None = ``config.router_hedge_ms``; that
+    default is None = off).  write_tim: 'host' (serving host writes
+    each request's .tim — the shared-filesystem lane) or 'router'
+    (the codec lane: the ROUTER writes the .tim from the decoded
+    payload).  quality_refit: route ONE zap-and-refit of gate-tripping
+    archives to the least-loaded HEALTHY host.  fleet_file: watched
+    host list (serve/fleet.FleetFileWatcher).  telemetry: trace path
+    or shared Tracer.
 
     Thread model: ``submit`` and ``RouteHandle.result`` are safe from
-    any thread (one lock guards placement state); each host's own
-    thread-safety is the transport's (SocketTransport serializes
-    frames, ToaServer.submit is thread-safe).
+    any thread (one lock guards placement/handle state; probes and
+    transport I/O run outside it); each host's own thread-safety is
+    the transport's.
     """
 
-    def __init__(self, transports, retry_max=None, telemetry=None,
-                 quiet=True):
+    def __init__(self, transports=(), retry_max=None, telemetry=None,
+                 quiet=True, probe_ms=None, hedge_ms=None,
+                 write_tim="host", quality_refit=False,
+                 fleet_file=None, fleet_poll_s=1.0):
         from .. import config
-        from .transport import SocketTransport
 
         transports = list(transports)
-        if not transports:
+        if not transports and not fleet_file:
             raise ValueError("ToaRouter: no host endpoints")
-        self.hosts = [
-            _Host(SocketTransport(t) if isinstance(t, str) else t, i)
-            for i, t in enumerate(transports)]
-        labels = [h.label for h in self.hosts]
-        if len(set(labels)) != len(labels):
+        if write_tim not in ("host", "router"):
             raise ValueError(
-                f"ToaRouter: duplicate host endpoints: {labels}")
+                f"ToaRouter: write_tim must be 'host' (shared "
+                f"filesystem) or 'router' (codec lane), got "
+                f"{write_tim!r}")
         if retry_max is None:
             retry_max = config.router_retry_max
         self.retry_max = max(1, int(retry_max))
+        if hedge_ms is None:
+            hedge_ms = config.router_hedge_ms
+        self.hedge_s = None if hedge_ms is None \
+            else max(0.0, float(hedge_ms)) / 1e3
+        self.write_tim = write_tim
+        self.quality_refit = bool(quality_refit)
         self.quiet = quiet
         self.tracer, self._own_tracer = resolve_tracer(telemetry,
                                                        run="pproute")
         self._lock = threading.Lock()
-        self._affinity = {}  # abspath(modelfile) -> _Host
+        self._affinity = {}   # abspath(modelfile) -> FleetMember
+        self._inflight = {}   # label -> set of RouteHandle
+        self._orphans = []    # (member, handle): hedge losers to reap
+        self._reaper = None
         self._closed = False
+        self.fleet = Fleet(tracer=self.tracer, probe_ms=probe_ms,
+                           on_dead=self._failover_host, quiet=quiet)
+        for t in transports:
+            self.fleet.add(t)
+        self._watcher = None
+        if fleet_file:
+            self._watcher = FleetFileWatcher(self, fleet_file,
+                                             poll_s=fleet_poll_s,
+                                             quiet=quiet)
+            self._watcher.resync()
+            self._watcher.start()
         if self.tracer.enabled:
-            self.tracer.emit("router_start", n_hosts=len(self.hosts),
-                             hosts=labels,
+            self.tracer.emit("router_start",
+                             n_hosts=len(self.fleet.members()),
+                             hosts=self.host_labels(),
                              retry_max=self.retry_max)
+
+    # ------------------------------------------------------------------
+    # membership surface
+    # ------------------------------------------------------------------
+
+    @property
+    def hosts(self):
+        """Current members (any state) — kept for R13 callers."""
+        return self.fleet.members()
+
+    def host_labels(self):
+        return [m.label for m in self.fleet.members()]
+
+    def add_host(self, transport_or_address, label=None):
+        """Join one endpoint at runtime (JOINING; promoted by its
+        first successful probe).  Returns the member's label."""
+        if self._closed:
+            raise RuntimeError("ToaRouter is closed")
+        return self.fleet.add(transport_or_address, label=label).label
+
+    def remove_host(self, label):
+        """Leave one endpoint gracefully: no new placements; requests
+        already in flight there keep collecting.  True when the label
+        was a member."""
+        member = self.fleet.remove(label)
+        if member is None:
+            return False
+        with self._lock:
+            for key in [k for k, v in self._affinity.items()
+                        if v is member]:
+                del self._affinity[key]
+        return True
 
     # ------------------------------------------------------------------
     # placement
     # ------------------------------------------------------------------
 
-    def _rank(self, modelfile, n_archives):
-        """Hosts to try, best first: the affinity host for this
-        template leads while placing there would not leave it strictly
-        more loaded than the least-loaded alternative; then
-        least-loaded order.  The stat() RPCs run OUTSIDE the router
-        lock — a hung host must stall only its own probe (until the
-        transport's socket timeout), never every other thread's
-        submit/result bookkeeping — so the loads are a snapshot; the
-        lock guards only the affinity read."""
-        loads = {h: h.load() for h in self.hosts}
+    def _rank(self, modelfile, n_archives, excluded=frozenset(),
+              use_affinity=True):
+        """Placeable hosts to try, best first: the affinity host for
+        this template leads while placing there would not leave it
+        strictly more loaded than the least-loaded alternative; then
+        least-loaded order.  use_affinity=False ranks purely by load
+        (failover replacements and routed refits must move OFF the
+        original lane, not stick to it).  Loads come from the fleet's
+        BOUNDED probe pass (cached while a probe is outstanding) so a
+        hung host can never stall a placement; the lock guards only
+        the affinity read."""
+        loads = self.fleet.probe_all()
+        loads = {m: v for m, v in loads.items()
+                 if m.label not in excluded}
         if not loads:
             return [], False
-        by_load = sorted(loads, key=lambda h: (loads[h], h.index))
+        by_load = sorted(loads, key=lambda m: (loads[m], m.index))
+        if not use_affinity:
+            return by_load, False
         with self._lock:
             aff = self._affinity.get(modelfile)
         if aff is not None and aff in loads and by_load[0] is not aff \
@@ -192,64 +285,49 @@ class ToaRouter:
             return by_load, True
         return by_load, aff is not None and by_load[0] is aff
 
-    def submit(self, datafiles, modelfile, tim_out=None, name=None,
-               **options):
-        """Place one request on the fleet (thread-safe); returns a
-        :class:`RouteHandle`.  Retries retryable backpressure and
-        unreachable hosts up to ``retry_max`` placements with capped
-        exponential backoff between full fleet passes; raises the last
-        failure when the budget is exhausted, and terminal
-        ``ServeRejected`` (retryable=False) immediately."""
-        from ..pipeline.toas import _is_metafile, _read_metafile
-
-        if self._closed:
-            raise RuntimeError("ToaRouter is closed")
-        if isinstance(datafiles, str):
-            datafiles = (_read_metafile(datafiles)
-                         if _is_metafile(datafiles) else [datafiles])
-        datafiles = list(datafiles)
+    def _place(self, datafiles, modelfile, tim_out, name, options,
+               tenant, excluded=frozenset(), attempt0=0,
+               affinity=True):
+        """The placement loop: try ranked hosts, retry retryable
+        backpressure / unreachable hosts up to retry_max attempts with
+        capped exponential backoff between full fleet passes; feed the
+        health machine on transport errors.  Returns (member, handle,
+        attempt, sticky) or raises the last failure."""
         n_archives = len(datafiles)
         mkey = os.path.abspath(str(modelfile))
-        attempt = 0
+        attempt = attempt0
         backoff = ROUTER_BACKOFF_BASE_S
         last_err = None
         while attempt < self.retry_max:
-            ranked, sticky = self._rank(mkey, n_archives)
+            ranked, sticky = self._rank(mkey, n_archives,
+                                        excluded=excluded,
+                                        use_affinity=affinity)
             if not ranked:
-                raise RuntimeError("ToaRouter: no reachable hosts")
+                # an empty pass still consumes an attempt, or an
+                # all-DEAD fleet would spin here forever
+                attempt += 1
+                last_err = RuntimeError(
+                    "ToaRouter: no placeable hosts (fleet: "
+                    f"{self.fleet.snapshot()})")
             for host in ranked:
                 if attempt >= self.retry_max:
                     break
                 attempt += 1
-                t0 = time.monotonic()
                 try:
                     handle = host.transport.submit(
                         datafiles, modelfile, tim_out=tim_out,
-                        name=name, options=options)
+                        name=name, options=options, tenant=tenant)
                 except ServeRejected as e:
                     if not e.retryable:
                         raise  # could never fit anywhere: caller's bug
                     last_err = e
                 except TransportError as e:
                     last_err = e
+                    self.fleet.record_error(host, f"submit: {e}")
                 else:
-                    with self._lock:
-                        host.outstanding += n_archives
-                        host.n_requests += 1
-                        host.n_archives += n_archives
-                        self._affinity[mkey] = host
-                    rh = RouteHandle(self, host, handle,
-                                     name if name is not None
-                                     else getattr(handle, "name", None),
-                                     n_archives, t0)
-                    if self.tracer.enabled:
-                        self.tracer.emit(
-                            "route_submit", req=rh.name,
-                            host=host.label, n_archives=n_archives,
-                            attempt=attempt,
-                            affinity=bool(sticky
-                                          and host is ranked[0]))
-                    return rh
+                    self.fleet.record_ok(host)
+                    return (host, handle, attempt,
+                            bool(sticky and host is ranked[0]))
                 if self.tracer.enabled:
                     self.tracer.emit(
                         "route_retry", req=name, host=host.label,
@@ -265,13 +343,63 @@ class ToaRouter:
         raise last_err if last_err is not None else RuntimeError(
             "ToaRouter: submit failed with no recorded error")
 
+    def submit(self, datafiles, modelfile, tim_out=None, name=None,
+               tenant=None, **options):
+        """Place one request on the fleet (thread-safe); returns a
+        :class:`RouteHandle`.  Retries retryable backpressure and
+        unreachable hosts up to ``retry_max`` placements with capped
+        exponential backoff between full fleet passes; raises the last
+        failure when the budget is exhausted, and terminal
+        ``ServeRejected`` (retryable=False) immediately.  ``tenant``
+        labels the request for the per-host QoS lanes."""
+        from ..pipeline.toas import _is_metafile, _read_metafile
+
+        if self._closed:
+            raise RuntimeError("ToaRouter is closed")
+        if isinstance(datafiles, str):
+            datafiles = (_read_metafile(datafiles)
+                         if _is_metafile(datafiles) else [datafiles])
+        datafiles = list(datafiles)
+        n_archives = len(datafiles)
+        mkey = os.path.abspath(str(modelfile))
+        # codec lane: the serving host never writes — the router
+        # demuxes the decoded payload at collection.  Hedging-armed
+        # routers route EVERY .tim through the router too: the losing
+        # primary of a hedged request would otherwise truncate-rewrite
+        # the path after the winner's file was already read back
+        host_tim = tim_out if (self.write_tim == "host"
+                               and self.hedge_s is None) else None
+        t0 = time.monotonic()
+        host, handle, attempt, sticky = self._place(
+            datafiles, modelfile, host_tim, name, options, tenant)
+        spec = dict(datafiles=datafiles, modelfile=str(modelfile),
+                    tim_out=tim_out, options=dict(options),
+                    tenant=tenant, host_tim=host_tim)
+        rh = RouteHandle(self, host, handle,
+                         name if name is not None
+                         else getattr(handle, "name", None),
+                         n_archives, t0, spec)
+        with self._lock:
+            host.outstanding += n_archives
+            host.n_requests += 1
+            host.n_archives += n_archives
+            self._affinity[mkey] = host
+            self._inflight.setdefault(host.label, set()).add(rh)
+        if self.tracer.enabled:
+            self.tracer.emit(
+                "route_submit", req=rh.name, host=host.label,
+                n_archives=n_archives, attempt=attempt,
+                affinity=bool(sticky), tenant=tenant)
+        return rh
+
     # blocking conveniences mirroring serve.ToaClient -----------------
 
     def get_TOAs(self, datafiles, modelfile, timeout=None,
-                 tim_out=None, name=None, **options):
+                 tim_out=None, name=None, tenant=None, **options):
         """Submit and wait (the one-shot driver's return shape)."""
         return self.submit(datafiles, modelfile, tim_out=tim_out,
-                           name=name, **options).result(timeout)
+                           name=name, tenant=tenant,
+                           **options).result(timeout)
 
     def map(self, specs, timeout=None, return_errors=False):
         """Submit many, then wait for all, in spec order.  specs:
@@ -290,34 +418,479 @@ class ToaRouter:
         return collect_results(handles, timeout, return_errors)
 
     # ------------------------------------------------------------------
-    # completion accounting (RouteHandle calls back)
+    # collection: poll loop with hedging + failover awareness
     # ------------------------------------------------------------------
 
-    def _collected(self, rh, result=None, error=None):
-        with self._lock:
-            if rh._collected:
+    def _await(self, rh, timeout):
+        deadline = None if timeout is None \
+            else time.monotonic() + float(timeout)
+        while True:
+            if rh._done.is_set():
+                if rh._error is not None:
+                    raise rh._error
+                return rh._result
+            if (self.hedge_s is not None and not rh._hedged
+                    and time.monotonic() - rh._t_submit
+                    >= self.hedge_s):
+                self._launch_hedge(rh)
+            with self._lock:
+                attempts = list(rh.attempts)
+            if not attempts:
+                # a failover is re-placing this request on another
+                # thread; yield briefly and re-check
+                time.sleep(0.01)
+            settled = len(attempts) == 1 and self.hedge_s is None
+            slice_s = ROUTER_POLL_SETTLED_S if settled \
+                else ROUTER_POLL_S
+            for host, handle, router_tim in attempts:
+                left = None if deadline is None \
+                    else max(0.0, deadline - time.monotonic())
+                wait = slice_s if left is None \
+                    else min(slice_s, left)
+                try:
+                    res = host.transport.result(handle, wait)
+                except TimeoutError:
+                    continue  # not resolved: keep it accounted
+                except TransportError as e:
+                    self.fleet.record_error(host, f"result: {e}")
+                    self._failover_attempt(rh, host, handle, e)
+                    break  # attempts changed: re-snapshot
+                except Exception as e:
+                    # request-level failure ON the host: deterministic,
+                    # terminal (the failing handle was already evicted
+                    # by its transport)
+                    self._finish(rh, host, error=e, win_handle=handle)
+                    raise
+                else:
+                    return self._finish(rh, host, result=res,
+                                        router_tim=router_tim,
+                                        win_handle=handle)
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"{rh.name}: no result within {timeout} s")
+
+    def _unaccount(self, rh, win_handle=None):
+        """Release load accounting for every live attempt of ``rh``;
+        losing attempts (anything but ``win_handle``) go to the
+        orphan reaper so their completed server-side results are
+        collected-and-discarded instead of pinned forever (caller
+        holds the lock)."""
+        for host, handle, _rt in rh.attempts:
+            host.outstanding = max(0, host.outstanding
+                                   - rh.n_archives)
+            self._inflight.get(host.label, set()).discard(rh)
+            if handle != win_handle:
+                self._orphans.append((host, handle))
+        rh.attempts = []
+        if self._orphans and not self._closed and (
+                self._reaper is None or not self._reaper.is_alive()):
+            self._reaper = threading.Thread(target=self._reap_loop,
+                                            name="ppt-route-reap",
+                                            daemon=True)
+            self._reaper.start()
+
+    def _reap_loop(self):
+        """Collect-and-discard abandoned attempts (hedge losers) in
+        the background: eviction from the transports' handle tables
+        happens at result-collection, so an uncollected loser would
+        pin its whole payload for the connection's lifetime."""
+        while not self._closed:
+            with self._lock:
+                orphans = list(self._orphans)
+            if not orphans:
                 return
-            rh._collected = True
-            rh.host.outstanding = max(
-                0, rh.host.outstanding - rh.n_archives)
+            for host, handle in orphans:
+                try:
+                    host.transport.result(handle, 0.05)
+                except TimeoutError:
+                    continue  # still running: keep reaping
+                except Exception:
+                    pass      # dead host / failed request: forget it
+                with self._lock:
+                    try:
+                        self._orphans.remove((host, handle))
+                    except ValueError:
+                        pass
+            time.sleep(ROUTER_REAP_S)
+
+    def _finish(self, rh, winner, result=None, error=None,
+                router_tim=False, action=None, win_handle=None):
+        """Resolve one request exactly once: release accounting for
+        every attempt, reconcile the ``.tim`` (the router writes the
+        winner's file — atomically — whenever the winning attempt did
+        not carry the host-side path: the codec lane, hedge winners,
+        failover replacements), run the optional routed refit, emit
+        route_done."""
+        with self._lock:
+            already = rh._collected
+            if not already:
+                rh._collected = True
+                self._unaccount(rh, win_handle=win_handle)
+        if already:
+            # lost the race (hedge twin resolved first): hand the
+            # recorded outcome back once it lands
+            rh._done.wait()
+            if rh._error is not None:
+                raise rh._error
+            return rh._result
+        hedged = rh._hedged
+        if result is not None and router_tim and rh.tim_out:
+            try:
+                codec.write_tim_result(result, rh.tim_out)
+                result.tim_out = rh.tim_out
+            except (OSError, ValueError) as e:
+                error, result = RuntimeError(
+                    f"{rh.name}: result collected but its .tim could "
+                    f"not be written at {rh.tim_out}: {e}"), None
+        if result is not None and self.quality_refit and winner:
+            try:
+                result = self._maybe_refit(rh, winner, result)
+            except Exception as e:
+                # the refit is best-effort: a broken refit serves the
+                # ORIGINAL result loudly, never wedges the request
+                log(f"routed refit of {rh.name!r} failed: "
+                    f"{type(e).__name__}: {e}; serving the original "
+                    "fit", quiet=False, level="warn", tracer=None)
+        rh._result = result
+        rh._error = error
         if self.tracer.enabled:
             self.tracer.emit(
-                "route_done", req=rh.name, host=rh.host.label,
+                "route_done", req=rh.name,
+                host=winner.label if winner is not None else None,
                 wall_s=round(time.monotonic() - rh._t_submit, 6),
                 n_toas=len(result.TOA_list) if result else 0,
-                error=str(error) if error else None)
+                error=str(error) if error else None,
+                tenant=rh.spec.get("tenant"), hedged=bool(hedged),
+                failover=action)
+        rh._done.set()
+        if error is not None:
+            raise error
+        return result
+
+    # ------------------------------------------------------------------
+    # hedging
+    # ------------------------------------------------------------------
+
+    def _launch_hedge(self, rh):
+        """One duplicate attempt on the least-loaded other eligible
+        host (best-effort: a fleet with nowhere else to place simply
+        does not hedge).  A hedge attempt NEVER writes host-side: its
+        payload returns over the wire and the router writes the
+        winner's .tim atomically at collection, so two hosts cannot
+        interleave writes on one path (the slow primary may still
+        rewrite the same path later — with identical bytes, fits
+        being deterministic)."""
+        with self._lock:
+            if rh._hedged or rh._collected or not rh.attempts:
+                return
+            rh._hedged = True   # one hedge per request, even on failure
+            primary = rh.attempts[0][0]
+        loads = self.fleet.probe_all()
+        cands = [m for m in sorted(loads,
+                                   key=lambda m: (loads[m], m.index))
+                 if m is not primary and m.label not in rh.excluded]
+        if not cands:
+            return
+        host = cands[0]
+        try:
+            handle = host.transport.submit(
+                rh.datafiles, rh.spec["modelfile"], tim_out=None,
+                name=rh.name, options=rh.spec["options"],
+                tenant=rh.spec.get("tenant"))
+        except (ServeRejected, TransportError) as e:
+            log(f"hedge of {rh.name!r} on {host.label} not placed: "
+                f"{e}", quiet=self.quiet, level="warn", tracer=None)
+            if isinstance(e, TransportError):
+                self.fleet.record_error(host, f"hedge submit: {e}")
+            return
+        with self._lock:
+            if rh._collected:
+                return  # resolved while we were placing: abandon it
+            rh.attempts.append((host, handle, True))
+            host.outstanding += rh.n_archives
+            self._inflight.setdefault(host.label, set()).add(rh)
+        if self.tracer.enabled:
+            self.tracer.emit("route_hedge", req=rh.name,
+                             primary=primary.label, host=host.label)
+
+    # ------------------------------------------------------------------
+    # failover
+    # ------------------------------------------------------------------
+
+    def _failover_host(self, member):
+        """Fleet callback: ``member`` went DEAD.  Re-place every
+        request in flight on it (exactly once each)."""
+        with self._lock:
+            handles = list(self._inflight.get(member.label, ()))
+        for rh in handles:
+            handle = None
+            with self._lock:
+                for h, k, _s in rh.attempts:
+                    if h is member:
+                        handle = k
+                        break
+            if handle is not None:
+                self._failover_attempt(rh, member, handle,
+                                       TransportError(
+                                           f"{member.label} is DEAD"))
+
+    def _failover_attempt(self, rh, member, handle, err):
+        """One attempt of ``rh`` died with its host.  Idempotent: the
+        awaiting client thread and the fleet's on_dead callback may
+        both arrive here.  Collect from the durable .tim when every
+        sentinel landed; otherwise re-dispatch with the dead host
+        excluded; resolve the request with the error only when neither
+        is possible."""
+        with self._lock:
+            live = [(h, k, s) for h, k, s in rh.attempts
+                    if h is member and k == handle]
+            if not live or rh._collected:
+                return
+            rh.attempts.remove(live[0])
+            rh.excluded.add(member.label)
+            member.outstanding = max(0, member.outstanding
+                                     - rh.n_archives)
+            self._inflight.get(member.label, set()).discard(rh)
+            if rh.attempts:
+                return  # the hedge twin races on
+            if rh._redispatching:
+                return
+            rh._redispatching = True
+        try:
+            # exactly-once: work whose .tim sentinels all landed is
+            # durable — collect it, never re-fit
+            if (self.write_tim == "host" and rh.tim_out
+                    and codec.tim_complete(rh.tim_out, rh.datafiles)):
+                res = codec.read_tim_result(rh.tim_out)
+                if self.tracer.enabled:
+                    self.tracer.emit("route_failover", req=rh.name,
+                                     dead_host=member.label,
+                                     action="collected", host=None)
+                log(f"failover: {rh.name!r} collected from its "
+                    f"durable .tim after {member.label} died "
+                    "(no re-fit)", quiet=self.quiet, level="warn",
+                    tracer=None)
+                self._finish(rh, None, result=res, action="collected")
+                return
+            # the replacement never writes host-side: if the "dead"
+            # host is actually alive and still serving the original
+            # attempt, two hosts must not interleave writes on one
+            # path — the router writes the replacement's .tim from
+            # the decoded payload at collection instead (and a zombie
+            # completion later rewrites the same path with IDENTICAL
+            # bytes, fits being deterministic)
+            host, handle2, attempt, _sticky = self._place(
+                rh.datafiles, rh.spec["modelfile"], None, rh.name,
+                rh.spec["options"], rh.spec.get("tenant"),
+                excluded=frozenset(rh.excluded), affinity=False)
+            with self._lock:
+                rh.attempts.append((host, handle2,
+                                    rh.tim_out is not None))
+                rh.host = host
+                rh._handle = handle2
+                host.outstanding += rh.n_archives
+                host.n_requests += 1
+                host.n_archives += rh.n_archives
+                self._inflight.setdefault(host.label, set()).add(rh)
+                rh._redispatching = False
+            if self.tracer.enabled:
+                self.tracer.emit("route_failover", req=rh.name,
+                                 dead_host=member.label,
+                                 action="redispatch", host=host.label,
+                                 attempt=attempt)
+            log(f"failover: {rh.name!r} re-dispatched to "
+                f"{host.label} after {member.label} died "
+                f"(excluded: {sorted(rh.excluded)})",
+                quiet=self.quiet, level="warn", tracer=None)
+        except Exception as e:
+            if self.tracer.enabled:
+                self.tracer.emit("route_failover", req=rh.name,
+                                 dead_host=member.label,
+                                 action="failed", host=None)
+            try:
+                self._finish(rh, None, error=e, action="failed")
+            except Exception:
+                pass  # the awaiting client re-raises from rh._error
+
+    # ------------------------------------------------------------------
+    # refit-aware routing (ROADMAP item 4 tail)
+    # ------------------------------------------------------------------
+
+    def _gate_trips(self, toas):
+        from .. import config
+
+        import numpy as np
+
+        for t in toas:
+            gof = t.flags.get("gof")
+            if gof is not None and np.isfinite(gof) \
+                    and float(gof) > config.quality_max_gof:
+                return True
+            if config.quality_min_snr > 0.0:
+                snr = t.flags.get("snr")
+                if snr is not None and np.isfinite(snr) \
+                        and float(snr) < config.quality_min_snr:
+                    return True
+        return False
+
+    def _worst_gof(self, toas):
+        import numpy as np
+
+        gofs = [float(t.flags["gof"]) for t in toas
+                if t.flags.get("gof") is not None
+                and np.isfinite(t.flags["gof"])]
+        return max(gofs) if gofs else None
+
+    def _maybe_refit(self, rh, winner, res):
+        """Routed quality loop: archives of a collected result that
+        trip the gate get exactly ONE zap-and-refit request, placed on
+        the current least-loaded HEALTHY host (affinity ignored — the
+        point is to move OFF the original lane when it is loaded);
+        the refit TOAs replace the originals in the demux and the
+        request .tim is rewritten.  Every fallback serves the original
+        result LOUDLY."""
+        if rh._refit_done:
+            return res
+        rh._refit_done = True
+        try:
+            groups = list(codec.iter_archive_toas(res))
+        except ValueError as e:
+            log(f"routed refit of {rh.name!r} skipped: {e}",
+                quiet=False, level="warn", tracer=None)
+            return res
+        trips = [f for f, toas in groups
+                 if toas and self._gate_trips(toas)]
+        if not trips:
+            return res
+        from ..io.psrfits import load_data
+        from ..pipeline.zap import get_zap_channels, resolve_zap_nstd
+
+        gof_before = {f: self._worst_gof(dict(groups)[f])
+                      for f in trips}
+        zap_map = {}
+        for f in trips:
+            try:
+                d = load_data(f, dedisperse=False, dededisperse=True,
+                              tscrunch=rh.spec["options"].get(
+                                  "tscrunch", False),
+                              pscrunch=True, quiet=True)
+                lists = get_zap_channels(
+                    d, nstd=resolve_zap_nstd(None),
+                    tracer=self.tracer)
+            except Exception as e:
+                log(f"routed refit of {f} (request {rh.name!r}) not "
+                    f"possible: {type(e).__name__}: {e}; serving the "
+                    "original fit", quiet=False, level="warn",
+                    tracer=None)
+                continue
+            if sum(len(z) for z in lists):
+                zap_map[f] = lists
+            else:
+                if self.tracer.enabled:
+                    self.tracer.emit(
+                        "refit", req=rh.name, datafile=f,
+                        n_channels=0, gof_before=gof_before[f],
+                        gof_after=gof_before[f], improved=False,
+                        host_from=winner.label, host=winner.label)
+                log(f"routed refit of {f} (request {rh.name!r}) not "
+                    "possible: the median algorithm flagged no "
+                    "channels; serving the original fit",
+                    quiet=False, level="warn", tracer=None)
+        if not zap_map:
+            return res
+        # least-loaded HEALTHY placement, affinity OFF — the re-place-
+        # off-the-original-lane rule this satellite exists for
+        loads = self.fleet.probe_all()
+        healthy = [m for m in sorted(loads,
+                                     key=lambda m: (loads[m], m.index))
+                   if m.state == HEALTHY]
+        if not healthy:
+            log(f"routed refit of {rh.name!r}: no HEALTHY host to "
+                "re-place on; serving the original fit", quiet=False,
+                level="warn", tracer=None)
+            return res
+        host2 = healthy[0]
+        refit_files = sorted(zap_map)
+        try:
+            with self._lock:
+                host2.outstanding += len(refit_files)
+                host2.n_requests += 1
+                host2.n_archives += len(refit_files)
+            try:
+                handle = host2.transport.submit(
+                    refit_files, rh.spec["modelfile"], tim_out=None,
+                    name=f"{rh.name}:refit",
+                    options={**rh.spec["options"],
+                             "zap_channels": zap_map},
+                    tenant=rh.spec.get("tenant"))
+                # BOUNDED: the refit rides inside the original
+                # request's collection — a hung refit host must fall
+                # back to serving the original, never wedge the client
+                res2 = host2.transport.result(
+                    handle, ROUTER_REFIT_TIMEOUT_S)
+            finally:
+                with self._lock:
+                    host2.outstanding = max(
+                        0, host2.outstanding - len(refit_files))
+        except Exception as e:
+            log(f"routed refit of {rh.name!r} on {host2.label} "
+                f"failed: {type(e).__name__}: {e}; serving the "
+                "original fit", quiet=False, level="warn", tracer=None)
+            return res
+        new_groups = dict(codec.iter_archive_toas(res2))
+        pos2 = {f: i for i, f in enumerate(res2.order)}
+        TOA_list = []
+        for i, (f, toas) in enumerate(groups):
+            if f in new_groups:
+                toas = new_groups[f]
+                j = pos2[f]
+                res.DM0s[i] = res2.DM0s[j]
+                res.DeltaDM_means[i] = res2.DeltaDM_means[j]
+                res.DeltaDM_errs[i] = res2.DeltaDM_errs[j]
+                gof_after = self._worst_gof(toas)
+                n_ch = sum(len(z) for z in zap_map[f])
+                improved = (gof_after is not None
+                            and gof_before[f] is not None
+                            and gof_after < gof_before[f])
+                if self.tracer.enabled:
+                    self.tracer.emit(
+                        "refit", req=rh.name, datafile=f,
+                        n_channels=int(n_ch),
+                        gof_before=gof_before[f],
+                        gof_after=gof_after,
+                        improved=bool(improved),
+                        host_from=winner.label, host=host2.label)
+                if self._gate_trips(toas):
+                    log(f"routed refit of {f} (request {rh.name!r}) "
+                        "still trips the gate after zapping "
+                        f"{n_ch} channel(s) (red-chi^2 "
+                        f"{gof_before[f]} -> {gof_after}); serving "
+                        "the zapped fit — no further refits",
+                        quiet=False, level="warn", tracer=None)
+            TOA_list.extend(toas)
+        res.TOA_list = TOA_list
+        if rh.tim_out:
+            try:
+                codec.write_tim_result(res, rh.tim_out)
+            except OSError as e:
+                log(f"routed refit of {rh.name!r}: merged result "
+                    f"could not rewrite {rh.tim_out}: {e} (the "
+                    "original host-written .tim remains)",
+                    quiet=False, level="warn", tracer=None)
+        return res
 
     # ------------------------------------------------------------------
 
     def stats(self):
         """Per-host placement snapshot: {label: {outstanding,
-        n_requests, n_archives}} — what the dryrun witness and tests
-        assert placement against without reading the trace."""
+        n_requests, n_archives, state}} — what the dryrun witness and
+        tests assert placement against without reading the trace."""
         with self._lock:
-            return {h.label: {"outstanding": h.outstanding,
-                              "n_requests": h.n_requests,
-                              "n_archives": h.n_archives}
-                    for h in self.hosts}
+            return {m.label: {"outstanding": m.outstanding,
+                              "n_requests": m.n_requests,
+                              "n_archives": m.n_archives,
+                              "state": m.state}
+                    for m in self.fleet.members()}
 
     def close(self):
         """Close every transport (idempotent).  The router never owns
@@ -326,11 +899,9 @@ class ToaRouter:
         if self._closed:
             return
         self._closed = True
-        for h in self.hosts:
-            try:
-                h.transport.close()
-            except Exception:
-                pass
+        if self._watcher is not None:
+            self._watcher.stop()
+        self.fleet.close()
         if self._own_tracer:
             self.tracer.close()
 

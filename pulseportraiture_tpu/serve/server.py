@@ -116,7 +116,7 @@ class ToaServer:
                  warmup_manifest=None, warmup_model=None,
                  warmup_options=None, quiet=True, quality_refit=None,
                  quality_max_gof=None, quality_min_snr=None,
-                 zap_nstd=None):
+                 zap_nstd=None, tenant_quota=None, tenant_weight=None):
         from .. import config
 
         if max_wait_ms is None:
@@ -145,7 +145,11 @@ class ToaServer:
         self.quiet = quiet
         self.tracer, self._own_tracer = resolve_tracer(telemetry,
                                                        run="ppserve")
-        self.queue = AdmissionQueue(queue_depth)
+        # multi-tenant QoS (ISSUE 13): per-tenant weighted-fair lanes
+        # + quotas; None reads config.serve_tenant_quota/_weight
+        self.queue = AdmissionQueue(queue_depth,
+                                    tenant_quota=tenant_quota,
+                                    tenant_weight=tenant_weight)
         self._ex = _StreamExecutor(
             None, [], None, self.nsub_batch, max_inflight=max_inflight,
             prefetch=False, tim_out=None, quiet=quiet,
@@ -182,14 +186,16 @@ class ToaServer:
     # ------------------------------------------------------------------
 
     def submit(self, datafiles, modelfile, tim_out=None, name=None,
-               **options):
+               tenant=None, **options):
         """Enqueue one request (thread-safe).  Raises
         :class:`ServeRejected` when the admission queue is full
-        (backpressure) or the server is stopping; returns a
-        :class:`ServeRequest` whose ``result()`` blocks for the
-        per-request DataBunch."""
+        (backpressure), the request's tenant is over its quota, or the
+        server is stopping; returns a :class:`ServeRequest` whose
+        ``result()`` blocks for the per-request DataBunch.  ``tenant``
+        labels the request's weighted-fair QoS lane (None =
+        'default')."""
         req = ServeRequest(datafiles, modelfile, options=options,
-                           tim_out=tim_out, name=name)
+                           tim_out=tim_out, name=name, tenant=tenant)
         if self._stopping.is_set():
             raise ServeRejected(
                 f"server is stopping; request {req.name!r} rejected")
@@ -200,7 +206,8 @@ class ToaServer:
         self.queue.submit(req)
         if self.tracer.enabled:
             self.tracer.emit("request_submit", req=req.name,
-                             n_archives=len(req.datafiles))
+                             n_archives=len(req.datafiles),
+                             tenant=req.tenant)
         return req
 
     def stats(self):
@@ -388,7 +395,7 @@ class ToaServer:
         except Exception as e:
             # a bad modelfile/option set fails ITS request, not the
             # server
-            self.queue.release(len(req.datafiles))
+            self.queue.release(len(req.datafiles), tenant=req.tenant)
             self._complete(req, error=e)
             return
         self._live[id(req)] = req
@@ -413,7 +420,7 @@ class ToaServer:
                 self.tracer.counter("archives_skipped")
                 log(f"Skipping {f}: {skip}", level="warn", tracer=None)
                 req.n_skipped += 1
-                self.queue.release(1)
+                self.queue.release(1, tenant=req.tenant)
                 continue
             ia = self._iarch
             self._iarch += 1
@@ -423,7 +430,7 @@ class ToaServer:
             if ex.admit(ia, f, d, ok, lane=lane) is None:
                 del self._by_iarch[ia]
                 req.n_skipped += 1
-            self.queue.release(1)
+            self.queue.release(1, tenant=req.tenant)
             # keep latency honest while a long request streams in
             ex.flush_stale(self.max_wait_s)
             ex._drain_ready()
@@ -664,7 +671,8 @@ class ToaServer:
                 n_archives=len(result.order) if result else 0,
                 wall_s=round(req.t_done - t_sub, 6),
                 queue_s=round(t_adm - t_sub, 6),
-                error=str(error) if error else None)
+                error=str(error) if error else None,
+                tenant=getattr(req, "tenant", None))
         req._event.set()
 
     def _fail_requests(self, requests, error):

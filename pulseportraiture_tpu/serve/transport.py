@@ -33,7 +33,8 @@ Wire protocol (SocketTransport <-> TransportServer): every frame is a
 4-byte big-endian length followed by a UTF-8 JSON object.  Ops:
 
   {"op": "submit", "datafiles": [...], "modelfile": m,
-   "tim_out": p|null, "name": n|null, "options": {...}}
+   "tim_out": p|null, "name": n|null, "tenant": t|null,
+   "options": {...}}
       -> {"ok": true, "handle": k}
       -> {"ok": false, "error": msg, "rejected": true,
           "retryable": bool}                 (ServeRejected)
@@ -60,15 +61,12 @@ import socket
 import struct
 import threading
 
-import numpy as np
-
 from ..telemetry import log
-from ..utils.bunch import DataBunch
 from .queue import ServeRejected
 
 __all__ = ["TransportError", "RemoteRequestError", "InProcTransport",
-           "SocketTransport", "TransportServer", "parse_hostport",
-           "encode_result", "decode_result"]
+           "SocketTransport", "TransportServer", "KillableTransport",
+           "parse_hostport", "encode_result", "decode_result"]
 
 # A frame above this is a protocol violation, not a big request: the
 # largest legitimate payload is a result frame (~200 bytes per TOA).
@@ -106,67 +104,13 @@ def parse_hostport(spec):
 
 
 # ---------------------------------------------------------------------------
-# result codec: the per-request DataBunch <-> JSON-safe dicts
+# result codec: factored into serve/codec.py (ISSUE 13 — the codec is
+# also the no-shared-fs lane's .tim demux and the durable-.tim
+# failover primitive); re-exported here so R13 call sites keep working
 # ---------------------------------------------------------------------------
 
-def _flag_value(v):
-    """Narrow a flag value to what JSON round-trips: the
-    bool/int/float/str distinction matters downstream (.tim
-    formatting branches on it), and numpy scalars (incl. np.bool_,
-    which json.dumps rejects outright) must narrow to the builtin."""
-    import numbers
-
-    if isinstance(v, (bool, np.bool_)):
-        return bool(v)
-    if isinstance(v, numbers.Integral):
-        return int(v)
-    if isinstance(v, numbers.Real):
-        return float(v)
-    return v
-
-
-def _encode_toa(t):
-    # MJD ships as (int day, float64 frac) — json round-trips float64
-    # by shortest-repr exactly, so epoch precision survives the wire
-    return {"archive": t.archive, "frequency": float(t.frequency),
-            "mjd": [int(t.MJD.day), float(t.MJD.frac)],
-            "toa_error": float(t.TOA_error), "telescope": t.telescope,
-            "telescope_code": t.telescope_code,
-            "dm": None if t.DM is None else float(t.DM),
-            "dm_error": (None if t.DM_error is None
-                         else float(t.DM_error)),
-            "flags": {k: _flag_value(v) for k, v in t.flags.items()}}
-
-
-def _decode_toa(d):
-    from ..io.tim import TOA
-    from ..utils.mjd import MJD
-
-    day, frac = d["mjd"]
-    return TOA(d["archive"], d["frequency"], MJD(int(day), float(frac)),
-               d["toa_error"], d["telescope"], d["telescope_code"],
-               DM=d["dm"], DM_error=d["dm_error"], flags=d["flags"])
-
-
-def encode_result(res):
-    """Per-request DataBunch (serve/server._maybe_complete's shape) ->
-    a JSON-safe dict."""
-    return {"toas": [_encode_toa(t) for t in res.TOA_list],
-            "order": list(res.order),
-            "DM0s": [None if v is None else float(v)
-                     for v in res.DM0s],
-            "DeltaDM_means": [float(v) for v in res.DeltaDM_means],
-            "DeltaDM_errs": [float(v) for v in res.DeltaDM_errs],
-            "tim_out": res.tim_out, "n_skipped": int(res.n_skipped)}
-
-
-def decode_result(d):
-    return DataBunch(TOA_list=[_decode_toa(t) for t in d["toas"]],
-                     order=list(d["order"]), DM0s=list(d["DM0s"]),
-                     DeltaDM_means=list(d["DeltaDM_means"]),
-                     DeltaDM_errs=list(d["DeltaDM_errs"]),
-                     tim_out=d["tim_out"],
-                     n_skipped=int(d["n_skipped"]))
+from .codec import decode_result, encode_result  # noqa: E402,F401
+from .codec import roundtrip_result as _roundtrip_result  # noqa: E402
 
 
 # ---------------------------------------------------------------------------
@@ -214,9 +158,10 @@ class InProcTransport:
         self._lock = threading.Lock()
 
     def submit(self, datafiles, modelfile, tim_out=None, name=None,
-               options=None):
+               options=None, tenant=None):
         req = self.server.submit(datafiles, modelfile, tim_out=tim_out,
-                                 name=name, **dict(options or {}))
+                                 name=name, tenant=tenant,
+                                 **dict(options or {}))
         with self._lock:
             self._handles.append(req)
         return req
@@ -233,8 +178,7 @@ class InProcTransport:
         # round-trip the codec so both transports return IDENTICAL
         # result shapes (and the codec is exercised wherever the
         # router is) — the bytes never leave the process
-        return decode_result(json.loads(
-            json.dumps(encode_result(res), separators=(",", ":"))))
+        return _roundtrip_result(res)
 
     def _evict(self, handle):
         # collect-once, like the socket lane's per-connection handle
@@ -269,6 +213,42 @@ class InProcTransport:
 
     def close(self):
         pass
+
+
+class KillableTransport:
+    """Fault-injection wrapper: delegates to ``inner`` until
+    :meth:`kill`, after which every transport call raises
+    TransportError — the router's host-unreachable signal.  This is
+    the dead-host emulation bench_router's kill arm and the fleet
+    tests share (a real fleet exercises the same path when a host's
+    socket resets)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.label = inner.label
+        self.killed = False
+
+    def kill(self):
+        self.killed = True
+
+    def _check(self):
+        if self.killed:
+            raise TransportError(f"{self.label} killed")
+
+    def submit(self, *a, **kw):
+        self._check()
+        return self.inner.submit(*a, **kw)
+
+    def result(self, handle, timeout=None):
+        self._check()
+        return self.inner.result(handle, timeout)
+
+    def stat(self):
+        self._check()
+        return self.inner.stat()
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
 
 
 class SocketTransport:
@@ -322,13 +302,14 @@ class SocketTransport:
         return reply
 
     def submit(self, datafiles, modelfile, tim_out=None, name=None,
-               options=None):
+               options=None, tenant=None):
         reply = self._call({"op": "submit",
                             "datafiles": list(datafiles)
                             if not isinstance(datafiles, str)
                             else datafiles,
                             "modelfile": str(modelfile),
                             "tim_out": tim_out, "name": name,
+                            "tenant": tenant,
                             "options": dict(options or {})})
         if reply.get("ok"):
             return reply["handle"]
@@ -460,6 +441,7 @@ class TransportServer:
                             msg["datafiles"], msg["modelfile"],
                             tim_out=msg.get("tim_out"),
                             name=msg.get("name"),
+                            tenant=msg.get("tenant"),
                             **dict(msg.get("options") or {}))
                     except ServeRejected as e:
                         _send_frame(conn, {

@@ -64,6 +64,8 @@ CONFIG_SNAPSHOT_KEYS = (
     "compile_cache_dir", "telemetry_path",
     "serve_max_wait_ms", "serve_queue_depth", "bucket_pad",
     "router_hosts", "router_retry_max", "serve_listen",
+    "router_probe_ms", "router_hedge_ms", "router_fleet_file",
+    "serve_tenant_quota", "serve_tenant_weight",
     "use_fast_fit", "use_matmul_dft", "fit_harmonic_window",
     "scatter_compensated",
 )
@@ -130,6 +132,19 @@ EVENT_FIELDS = {
                      "affinity"},
     "route_retry": {"req", "host", "attempt", "backoff_s", "error"},
     "route_done": {"req", "host", "wall_s", "n_toas", "error"},
+    # the elastic fleet (serve/fleet.py + the router's failover/hedge
+    # layer, ISSUE 13): fleet_transition per health-state edge
+    # (JOINING/HEALTHY/SUSPECT/DEAD/REJOINED + LEFT on removal);
+    # route_failover per dead-host re-placement (action 'collected' =
+    # served from the durable .tim with no re-fit, 'redispatch' =
+    # placed on a surviving host with the dead one excluded, 'failed'
+    # = nowhere to go); route_hedge per hedge launch (primary = the
+    # host the request was first placed on).  route_submit/route_done
+    # and request_submit/request_done additionally carry a 'tenant'
+    # label for the fleet section's per-tenant latency split.
+    "fleet_transition": {"host", "from_state", "to_state", "reason"},
+    "route_failover": {"req", "dead_host", "action"},
+    "route_hedge": {"req", "primary", "host"},
     # the template factory (pipeline/factory.build_templates): one
     # template_fit per bucket dispatch — stage 'profile'|'portrait',
     # the bucket's shape key, rows (real problems), pad (padded rows:
@@ -796,6 +811,74 @@ def report(path, file=None):
               f"{float(np.percentile(walls, 50)):.3f} s  p99 "
               f"{float(np.percentile(walls, 99)):.3f} s")
 
+    # ---- fleet (membership / failover / hedging / tenant QoS) -------
+    ftrans = by_type.get("fleet_transition", [])
+    fover = by_type.get("route_failover", [])
+    hedges = by_type.get("route_hedge", [])
+    tenant_evs = [ev for ev in (r_done or req_done)
+                  if ev.get("tenant") is not None]
+    fleet_states = {}
+    n_failover_collected = None
+    tenant_latency = {}
+    if ftrans or fover or hedges or tenant_evs:
+        p("")
+        p("-- fleet (membership / failover / QoS) --")
+        if ftrans:
+            per_host_edges = {}
+            for ev in ftrans:
+                per_host_edges.setdefault(ev["host"], []).append(ev)
+                fleet_states[ev["host"]] = ev["to_state"]
+            p(f"  {len(ftrans)} health transition(s); state timeline:")
+            for host in sorted(per_host_edges):
+                edges = per_host_edges[host]
+                path = " -> ".join(
+                    f"{ev['to_state']}@{ev['t']:.2f}s"
+                    for ev in edges[-6:])
+                lead = "... -> " if len(edges) > 6 else ""
+                p(f"    {host}: {lead}{path}")
+            degraded = [h for h, s in fleet_states.items()
+                        if s in ("SUSPECT", "DEAD")]
+            if degraded:
+                p(f"    degraded at end of trace: "
+                  f"{', '.join(sorted(degraded))}")
+        if fover:
+            by_action = {}
+            for ev in fover:
+                by_action[ev["action"]] = \
+                    by_action.get(ev["action"], 0) + 1
+            n_failover_collected = by_action.get("collected", 0)
+            parts = ", ".join(f"{n} {a}"
+                              for a, n in sorted(by_action.items()))
+            p(f"  {len(fover)} in-flight failover(s) ({parts}); "
+              "'collected' requests were served from their durable "
+              ".tim with no re-fit")
+        if hedges:
+            wins = sum(1 for ev in r_done if ev.get("hedged")
+                       and not ev.get("error"))
+            p(f"  {len(hedges)} hedged request(s) "
+              f"({wins} resolved with a hedge outstanding); first "
+              "completion wins, the loser is cancelled at collection")
+        if tenant_evs:
+            by_tenant = {}
+            for ev in tenant_evs:
+                by_tenant.setdefault(ev["tenant"], []).append(ev)
+            p(f"  per-tenant latency split "
+              f"({len(by_tenant)} tenant(s)):")
+            p(f"  {'tenant':>16} {'requests':>9} {'errors':>7} "
+              f"{'p50_s':>8} {'p99_s':>8}")
+            for tenant in sorted(by_tenant):
+                evs = by_tenant[tenant]
+                walls = np.asarray([ev["wall_s"] for ev in evs], float)
+                n_err = sum(1 for ev in evs if ev.get("error"))
+                tenant_latency[tenant] = {
+                    "n": len(evs),
+                    "p50_s": float(np.percentile(walls, 50)),
+                    "p99_s": float(np.percentile(walls, 99)),
+                }
+                p(f"  {tenant:>16} {len(evs):>9} {n_err:>7} "
+                  f"{tenant_latency[tenant]['p50_s']:>8.3f} "
+                  f"{tenant_latency[tenant]['p99_s']:>8.3f}")
+
     # ---- template factory (batched Gaussian/spline model building) --
     tfit = by_type.get("template_fit", [])
     tjobs = by_type.get("template_job", [])
@@ -988,6 +1071,12 @@ def report(path, file=None):
         "n_route_done": len(r_done),
         "router_imbalance": router_imbalance,
         "router_host_counts": router_host_counts,
+        "n_fleet_transition": len(ftrans),
+        "fleet_states": fleet_states,
+        "n_failover": len(fover),
+        "n_failover_collected": n_failover_collected,
+        "n_hedge": len(hedges),
+        "tenant_latency": tenant_latency,
         "n_template_fit": len(tfit),
         "n_template_jobs": len(tjobs),
         "template_pad_frac": template_pad_frac,

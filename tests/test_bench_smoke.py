@@ -67,7 +67,11 @@ TINY_ENV = {
     # retry ledger, and per-request .tim identity vs the one-shot
     # references all assert inside the bench; the traces are
     # re-validated here so route-event drift fails in CI (the 1.8x
-    # link-scaling gate belongs to real PPT_TUNNEL_EMU bench runs)
+    # link-scaling gate belongs to real PPT_TUNNEL_EMU bench runs).
+    # ISSUE 13 rides along at H=2: the kill-one-host failover arm
+    # (zero lost requests, zero duplicated .tim lines, bounded p99),
+    # the no-shared-fs codec-lane byte gate, and the hedging on/off
+    # byte gate — all ENFORCED inside the bench at every shape
     "bench_router": {"PPT_NARCH": "2", "PPT_NSUB": "2",
                      "PPT_NCHAN": "16", "PPT_NBIN": "128",
                      "PPT_NREQ": "2", "PPT_NHOSTS": "2",
@@ -214,6 +218,31 @@ def test_bench_smoke(name, monkeypatch, capsys, tmp_path):
             hosts = {e["host"]
                      for e in events if e["type"] == "route_submit"}
             assert len(hosts) == int(H)
+        # ISSUE 13: the elastic-fleet arms' gates (enforced inside
+        # the bench too — re-checked structurally here so a silently
+        # skipped arm fails CI)
+        fleet = out["fleet"]
+        assert fleet is not None
+        assert fleet["failover_ok"] is True
+        assert fleet["lost_requests"] == 0
+        assert fleet["duplicated_tim_lines"] == 0
+        assert fleet["tim_identical"] is True
+        assert fleet["p99_bounded"] is True
+        assert out["codec_tim_identical"] is True
+        assert out["hedge_tim_identical"] is True
+        assert out["n_hedge"] >= 1
+        # the .fleet trace must carry the health/failover ledger with
+        # a schema-valid event stream
+        trace = str(tmp_path / "trace.jsonl") + ".fleet"
+        assert os.path.exists(trace), "no fleet trace"
+        manifest, events = telemetry.validate_trace(trace)
+        etypes = {e["type"] for e in events}
+        assert "fleet_transition" in etypes
+        dead = [e for e in events if e["type"] == "fleet_transition"
+                and e["to_state"] == "DEAD"]
+        assert dead and dead[0]["host"] == "k0"
+        if fleet["killed_host_requests"]:
+            assert "route_failover" in etypes
     if name == "bench_gauss":
         # ISSUE 9: both A/B arms must report, the in-memory oracle
         # digit gate must HOLD even at tiny shapes (engine drift fails

@@ -324,6 +324,26 @@ def test_ppserve_listen_and_pproute_validation(tmp_path):
     with pytest.raises(SystemExit, match="cannot reach"):
         pproute.main(["-r", str(good), "-H", "127.0.0.1:9",
                       "--quiet"])
+    # elastic-fleet flags (ISSUE 13) are validated before the network
+    with pytest.raises(SystemExit, match="probe-ms"):
+        pproute.main(["-r", str(good), "-H", "h:1",
+                      "--probe-ms", "0"])
+    with pytest.raises(SystemExit, match="hedge-ms"):
+        pproute.main(["-r", str(good), "-H", "h:1",
+                      "--hedge-ms", "-5"])
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        pproute.main(["-r", str(good), "-H", "h:1",
+                      "--fleet-file", "fleet.txt"])
+    with pytest.raises(SystemExit, match="fleet-file not found"):
+        pproute.main(["-r", str(good),
+                      "--fleet-file", str(tmp_path / "no.txt")])
+    # a request line's tenant must be a string (the QoS lane label)
+    bad_tenant = tmp_path / "tenant.jsonl"
+    bad_tenant.write_text(json.dumps(
+        {"name": "A", "datafiles": ["a.fits"],
+         "modelfile": "m.gmodel", "tenant": 7}) + "\n")
+    with pytest.raises(SystemExit, match="tenant"):
+        pproute.main(["-r", str(bad_tenant), "-H", "h:1"])
 
 
 def test_pproute_routes_across_listening_fleet(workspace, tmp_path):

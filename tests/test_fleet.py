@@ -1,0 +1,714 @@
+"""Elastic fleet subsystem (ISSUE 13): the health state machine and
+bounded probes, dynamic membership (add/remove + the watched fleet
+file), exactly-once mid-fit failover off the durable-.tim property,
+hedged requests, the no-shared-fs codec lane, per-tenant QoS lanes,
+and refit-aware routing — each gated against the one-shot driver's
+byte-identical .tim output."""
+
+import io
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu import config, telemetry
+from pulseportraiture_tpu.io import write_gmodel
+from pulseportraiture_tpu.pipeline import stream_wideband_TOAs
+from pulseportraiture_tpu.serve import (DEAD, HEALTHY, JOINING,
+                                        REJOINED, SUSPECT,
+                                        AdmissionQueue, Fleet,
+                                        InProcTransport, ServeRequest,
+                                        SocketTransport, ToaRouter,
+                                        ToaServer, TransportError,
+                                        TransportServer,
+                                        read_tim_result, tim_complete,
+                                        write_tim_result)
+from pulseportraiture_tpu.serve.codec import (decode_result,
+                                              encode_result)
+from pulseportraiture_tpu.synth import default_test_model, make_fake_pulsar
+from pulseportraiture_tpu.utils.bunch import DataBunch
+from pulseportraiture_tpu.utils.mjd import MJD
+
+PAR = {"PSR": "J1744-1134", "RAJ": "17:44:29.4", "DECJ": "-11:34:54.6",
+       "P0": 0.004074, "PEPOCH": 55000.0, "DM": 3.139}
+
+
+@pytest.fixture(scope="module")
+def campaign(tmp_path_factory):
+    """4 archives, two bucket shapes (the test_router corpus)."""
+    root = tmp_path_factory.mktemp("fleet")
+    model = default_test_model(1500.0)
+    gmodel = str(root / "model.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files = []
+    for i in range(4):
+        path = str(root / f"ep{i}.fits")
+        make_fake_pulsar(model, PAR, outfile=path, nsub=2,
+                         nchan=16 if i < 2 else 12, nbin=128,
+                         nu0=1500.0, bw=400.0, tsub=60.0,
+                         phase=0.01 * i, dDM=1e-4,
+                         start_MJD=MJD(55100 + i, 0.1), noise_stds=0.08,
+                         dedispersed=False, quiet=True, rng=200 + i)
+        files.append(path)
+    ref = str(root / "ref01.tim")
+    stream_wideband_TOAs(files[:2], gmodel, nsub_batch=8, tim_out=ref,
+                         quiet=True)
+    return files, gmodel, open(ref, "rb").read()
+
+
+# dead-host emulation: the shared fault-injection wrapper
+# (serve/transport.KillableTransport) — one definition for tests AND
+# bench_router's kill arm, so both exercise the same failure semantics
+from pulseportraiture_tpu.serve.transport import (  # noqa: E402
+    KillableTransport as _Killable)
+
+
+class _FakeTransport:
+    """stat-only stub for state-machine units; scripted to succeed or
+    raise."""
+
+    def __init__(self, label):
+        self.label = label
+        self.fail = False
+        self.n_stats = 0
+
+    def stat(self):
+        self.n_stats += 1
+        if self.fail:
+            raise TransportError(f"{self.label} down")
+        return {"pending_archives": 0, "queue_len": 0, "n_live": 0}
+
+    def close(self):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# health state machine + probes
+# ---------------------------------------------------------------------------
+
+def test_fleet_state_machine_walks_every_edge(tmp_path):
+    """JOINING -> HEALTHY -> SUSPECT -> DEAD -> REJOINED -> HEALTHY,
+    with a loud fleet_transition event per edge and the DEAD callback
+    firing exactly once per death."""
+    trace = str(tmp_path / "fsm.jsonl")
+    tracer = telemetry.Tracer(trace, run="fsm")
+    deaths = []
+    fleet = Fleet(tracer=tracer, probe_ms=200,
+                  on_dead=deaths.append, quiet=True)
+    from pulseportraiture_tpu.serve.fleet import PLACEABLE_STATES
+
+    t = _FakeTransport("h0")
+    m = fleet.add(t)
+    assert m.state == JOINING
+    assert JOINING not in PLACEABLE_STATES
+    assert fleet.probe_all() == {m: 0}  # the probe promoted it...
+    assert m.state == HEALTHY           # ...inside the bounded pass
+    t.fail = True
+    fleet.record_error(m, "submit: boom")
+    assert m.state == SUSPECT
+    assert SUSPECT in PLACEABLE_STATES  # degraded but placeable
+    fleet.probe_all()
+    assert m.state == DEAD              # second failure -> DEAD
+    assert deaths == [m]
+    assert fleet.probe_all() == {}
+    t.fail = False
+    time.sleep(1.1)                # DEAD reprobe throttle
+    fleet.probe_all()
+    assert m.state == REJOINED     # one success steps DEAD forward
+    fleet.probe_all()
+    assert m.state == HEALTHY      # the next confirms the rejoin
+    assert deaths == [m]
+    fleet.close()
+    tracer.close()
+    _, events = telemetry.validate_trace(trace)
+    edges = [(e["from_state"], e["to_state"]) for e in events
+             if e["type"] == "fleet_transition"]
+    assert (None, "JOINING") == edges[0]
+    for edge in [("JOINING", "HEALTHY"), ("HEALTHY", "SUSPECT"),
+                 ("SUSPECT", "DEAD"), ("DEAD", "REJOINED"),
+                 ("REJOINED", "HEALTHY")]:
+        assert edge in edges, (edge, edges)
+
+
+def test_probe_timeout_bounds_placement_and_feeds_suspect(campaign):
+    """The probe-deadline fix: a host whose stat() hangs must not
+    delay a placement pass past config.router_probe_ms — the cached
+    load is used and the hung host transitions to SUSPECT instead of
+    blocking submit."""
+    files, gmodel, _ = campaign
+
+    class _Hung:
+        def __init__(self, inner):
+            self.inner = inner
+            self.label = inner.label
+            self.hang = threading.Event()
+
+        def stat(self):
+            if self.hang.is_set():
+                self.hang.wait(5.0)  # far beyond the probe deadline
+            return self.inner.stat()
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+    with ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h0, \
+            ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h1:
+        hung = _Hung(InProcTransport(h0, label="p0"))
+        router = ToaRouter([hung, InProcTransport(h1, label="p1")],
+                           probe_ms=100)
+        router.get_TOAs(files[:1], gmodel, timeout=300, name="warm")
+        hung.hang.set()
+        t0 = time.monotonic()
+        res = router.get_TOAs(files[1:2], gmodel, timeout=300,
+                              name="bounded")
+        placement_wall = time.monotonic() - t0
+        states = {k: v["state"] for k, v in router.stats().items()}
+        hung.hang.clear()
+        router.close()
+    assert len(res.TOA_list) == 2
+    # the fit itself costs ~1 s; the probe must not add its 5 s hang
+    assert placement_wall < 4.0, placement_wall
+    assert states["p0"] in (SUSPECT, HEALTHY)  # HEALTHY if the
+    # follow-up submit landed on p0 (a successful submit is itself
+    # health evidence); either way the hang never blocked placement
+
+
+def test_membership_add_remove_and_fleet_file(campaign, tmp_path):
+    """Dynamic membership: hosts join/leave at runtime, placement
+    follows, and the watched fleet file reconciles membership
+    (unreachable entries warn and retry instead of failing the
+    router)."""
+    files, gmodel, _ = campaign
+    with ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h0, \
+            ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h1:
+        router = ToaRouter([InProcTransport(h0, label="m0")])
+        assert router.host_labels() == ["m0"]
+        router.add_host(InProcTransport(h1, label="m1"))
+        with pytest.raises(ValueError, match="duplicate"):
+            router.add_host(InProcTransport(h1, label="m1"))
+        router.get_TOAs(files[:2], gmodel, timeout=300, name="A")
+        assert router.stats()["m1"]["state"] == HEALTHY
+        assert router.remove_host("m0") is True
+        assert router.remove_host("m0") is False
+        assert router.host_labels() == ["m1"]
+        res = router.get_TOAs(files[2:], gmodel, timeout=300, name="B")
+        assert len(res.TOA_list) == 4
+        assert router.stats()["m1"]["n_requests"] >= 1
+        router.close()
+
+    # fleet file over REAL listeners: initial join, then an edit
+    # removes one and an unreachable entry is retried, not fatal
+    with ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as srv:
+        with TransportServer(srv, port=0) as lis_a, \
+                TransportServer(srv, port=0) as lis_b:
+            ffile = tmp_path / "fleet.txt"
+            ffile.write_text(
+                f"# fleet\n127.0.0.1:{lis_a.port}\n"
+                f"127.0.0.1:{lis_b.port}\n127.0.0.1:9\n")
+            router = ToaRouter(fleet_file=str(ffile), probe_ms=500)
+            labels = set(router.host_labels())
+            assert f"127.0.0.1:{lis_a.port}" in labels
+            assert f"127.0.0.1:{lis_b.port}" in labels
+            assert "127.0.0.1:9" not in labels  # unreachable: retried
+            ffile.write_text(f"127.0.0.1:{lis_a.port}\n")
+            router._watcher.resync()
+            assert router.host_labels() == [f"127.0.0.1:{lis_a.port}"]
+            router.close()
+    with pytest.raises(ValueError, match="no host endpoints"):
+        ToaRouter([])
+
+
+# ---------------------------------------------------------------------------
+# exactly-once failover
+# ---------------------------------------------------------------------------
+
+def test_failover_redispatches_mid_fit(campaign, tmp_path):
+    """Kill a host with a request in flight: the router re-places it
+    on the survivor with the dead host excluded, the .tim is
+    byte-identical to one-shot, and zero requests are lost or
+    duplicated."""
+    files, gmodel, refb = campaign
+    trace = str(tmp_path / "kill.jsonl")
+    with ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h0, \
+            ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h1:
+        k0 = _Killable(InProcTransport(h0, label="k0"))
+        router = ToaRouter([k0, InProcTransport(h1, label="k1")],
+                           telemetry=trace)
+        tim = str(tmp_path / "killed.tim")
+        rh = router.submit(files[:2], gmodel, tim_out=tim, name="F0")
+        assert rh.host.label == "k0"
+        k0.killed = True   # dies before the result is collected
+        res = rh.result(300)
+        stats = router.stats()
+        router.close()
+    assert len(res.TOA_list) == 4
+    assert open(tim, "rb").read() == refb
+    assert stats["k0"]["state"] == DEAD
+    assert all(st["outstanding"] == 0 for st in stats.values())
+    _, events = telemetry.validate_trace(trace)
+    fo = [e for e in events if e["type"] == "route_failover"]
+    assert len(fo) == 1 and fo[0]["dead_host"] == "k0"
+    done = [e for e in events if e["type"] == "route_done"]
+    assert len(done) == 1 and done[0]["error"] is None
+    summary = telemetry.report(trace, file=io.StringIO())
+    assert summary["n_failover"] == 1
+    assert summary["fleet_states"]["k0"] == "DEAD"
+
+
+def test_failover_collects_durable_tim_without_refit(campaign,
+                                                     tmp_path):
+    """The exactly-once core: a request whose .tim sentinels all
+    landed before its host died is COLLECTED from the file — the
+    survivor fits nothing, the bytes are untouched, and the recovered
+    result re-serializes byte-identically (with the documented NaN
+    DeltaDM summary and recovered_from_tim marker)."""
+    files, gmodel, refb = campaign
+    trace = str(tmp_path / "durable.jsonl")
+    with ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h0, \
+            ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h1:
+        k0 = _Killable(InProcTransport(h0, label="k0"))
+        router = ToaRouter([k0, InProcTransport(h1, label="k1")],
+                           telemetry=trace)
+        tim = str(tmp_path / "durable.tim")
+        rh = router.submit(files[:2], gmodel, tim_out=tim, name="D0")
+        deadline = time.monotonic() + 120
+        while not tim_complete(tim, files[:2]):
+            assert time.monotonic() < deadline, "tim never landed"
+            time.sleep(0.05)
+        k0.killed = True   # dies AFTER completion, BEFORE collection
+        res = rh.result(300)
+        survivor = router.stats()["k1"]
+        router.close()
+    assert res.recovered_from_tim is True
+    assert len(res.TOA_list) == 4
+    assert res.DM0s == [None, None]
+    assert all(np.isnan(v) for v in res.DeltaDM_means)
+    assert open(tim, "rb").read() == refb  # untouched
+    assert survivor["n_requests"] == 0     # NEVER re-fit
+    # the recovered payload re-serializes byte-identically
+    tim2 = str(tmp_path / "reserialized.tim")
+    write_tim_result(res, tim2)
+    assert open(tim2, "rb").read() == refb
+    _, events = telemetry.validate_trace(trace)
+    fo = [e for e in events if e["type"] == "route_failover"]
+    assert [e["action"] for e in fo] == ["collected"]
+    summary = telemetry.report(trace, file=io.StringIO())
+    assert summary["n_failover_collected"] == 1
+
+
+# ---------------------------------------------------------------------------
+# hedged requests
+# ---------------------------------------------------------------------------
+
+def test_hedged_requests_byte_identical_and_accounted(campaign,
+                                                      tmp_path):
+    """hedge_ms=0 forces a hedge on every request: first completion
+    wins, .tim bytes match the one-shot reference exactly (the loser's
+    side file is discarded), loads drain to zero, and the route ledger
+    records the hedge."""
+    files, gmodel, refb = campaign
+    trace = str(tmp_path / "hedge.jsonl")
+    with ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h0, \
+            ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h1:
+        router = ToaRouter([InProcTransport(h0, label="g0"),
+                            InProcTransport(h1, label="g1")],
+                           hedge_ms=0.0, telemetry=trace)
+        tim = str(tmp_path / "hedged.tim")
+        res = router.get_TOAs(files[:2], gmodel, timeout=300,
+                              tim_out=tim, name="H0")
+        stats = router.stats()
+    # read the .tim AFTER the servers drained: a slow primary may
+    # rewrite it post-collection — with identical bytes
+    router.close()
+    assert len(res.TOA_list) == 4
+    assert open(tim, "rb").read() == refb
+    # the hedge loser writes NOTHING host-side (no side files, no
+    # two-writers-on-one-path window)
+    assert not os.path.exists(tim + ".hedge")
+    assert not os.path.exists(tim + ".tmp~")
+    assert all(st["outstanding"] == 0 for st in stats.values())
+    _, events = telemetry.validate_trace(trace)
+    hedges = [e for e in events if e["type"] == "route_hedge"]
+    assert len(hedges) == 1
+    assert hedges[0]["primary"] != hedges[0]["host"]
+    done = [e for e in events if e["type"] == "route_done"]
+    assert done[0]["hedged"] is True and done[0]["error"] is None
+    summary = telemetry.report(trace, file=io.StringIO())
+    assert summary["n_hedge"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the codec (no-shared-fs) lane + codec roundtrip properties
+# ---------------------------------------------------------------------------
+
+def test_codec_lane_router_writes_tim_over_socket(campaign, tmp_path):
+    """write_tim='router' over the REAL wire: the serving host writes
+    nothing, the full payload crosses the socket, and the
+    router-written .tim is byte-identical to the shared-fs lane."""
+    files, gmodel, refb = campaign
+    with ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as srv:
+        with TransportServer(srv, port=0) as listener:
+            router = ToaRouter(
+                [SocketTransport(f"127.0.0.1:{listener.port}")],
+                write_tim="router")
+            tim = str(tmp_path / "codec.tim")
+            res = router.get_TOAs(files[:2], gmodel, timeout=300,
+                                  tim_out=tim, name="C0")
+            router.close()
+    assert res.tim_out == tim
+    assert open(tim, "rb").read() == refb
+    with pytest.raises(ValueError, match="write_tim"):
+        ToaRouter([InProcTransport(object(), label="x")],
+                  write_tim="nowhere")
+
+
+def test_codec_roundtrip_property(campaign, tmp_path):
+    """Property-style roundtrip of the full TOA result payload
+    (ISSUE 13 satellite): randomized MJD (int day, f64 frac)
+    exactness, inf frequency, the int/float/str/bool flag trichotomy
+    with numpy scalar narrowing, and empty-archive results — every
+    trial must re-serialize to identical .tim bytes through
+    write_tim_result."""
+    from pulseportraiture_tpu.io.tim import TOA, toa_string
+
+    rng = np.random.default_rng(1234)
+    flag_makers = [
+        lambda r: int(r.integers(-5, 2000)),
+        lambda r: np.int64(r.integers(0, 1 << 40)),
+        lambda r: float(r.normal() * 10.0 ** int(r.integers(-6, 6))),
+        lambda r: np.float32(r.normal()),
+        lambda r: np.float64(r.normal()),
+        lambda r: "GUPPI_" + str(r.integers(0, 9)),
+        lambda r: bool(r.integers(0, 2)),
+        lambda r: np.bool_(r.integers(0, 2)),
+    ]
+    for trial in range(50):
+        n_arch = int(rng.integers(1, 4))
+        order, toas = [], []
+        for a in range(n_arch):
+            datafile = f"/data/ep{trial}_{a}.fits"
+            order.append(datafile)
+            for _s in range(int(rng.integers(0, 3))):
+                flags = {f"f{k}": flag_makers[
+                    int(rng.integers(0, len(flag_makers)))](rng)
+                    for k in range(int(rng.integers(0, 5)))}
+                freq = (np.inf if rng.random() < 0.2
+                        else float(rng.uniform(100, 3000)))
+                toas.append(TOA(
+                    datafile, freq,
+                    MJD(int(rng.integers(40000, 60000)),
+                        float(rng.random())),
+                    float(abs(rng.normal()) + 1e-3), "GBT", "1",
+                    DM=(None if rng.random() < 0.3
+                        else float(rng.uniform(0, 300))),
+                    DM_error=(None if rng.random() < 0.3
+                              else float(abs(rng.normal()) * 1e-2)),
+                    flags=flags))
+        res = DataBunch(
+            TOA_list=toas, order=order,
+            DM0s=[None if rng.random() < 0.5
+                  else float(rng.uniform(0, 300))
+                  for _ in order],
+            DeltaDM_means=[float(rng.normal()) for _ in order],
+            DeltaDM_errs=[float(abs(rng.normal())) for _ in order],
+            tim_out=None, n_skipped=0)
+        wire = json.dumps(encode_result(res),
+                          separators=(",", ":"))
+        back = decode_result(json.loads(wire))
+        assert back.order == order
+        assert back.DM0s == res.DM0s
+        assert back.DeltaDM_means == res.DeltaDM_means
+        for ta, tb in zip(res.TOA_list, back.TOA_list):
+            assert (ta.MJD.day, ta.MJD.frac) == (tb.MJD.day,
+                                                 tb.MJD.frac)
+            assert tb.frequency == ta.frequency  # incl. inf
+            assert toa_string(tb) == toa_string(ta)
+            for k, v in ta.flags.items():
+                w = tb.flags[k]
+                if isinstance(v, (bool, np.bool_)):
+                    assert isinstance(w, bool)
+                elif isinstance(v, (int, np.integer)):
+                    assert isinstance(w, int) and w == int(v)
+                elif isinstance(v, (float, np.floating)):
+                    assert isinstance(w, float)
+                else:
+                    assert w == v
+        # codec-lane .tim bytes == shared-fs-lane bytes: the server
+        # writes per-archive write_TOAs + sentinel, and so must the
+        # router's writer from the DECODED payload
+        a = str(tmp_path / f"srv{trial}.tim")
+        b = str(tmp_path / f"rtr{trial}.tim")
+        from pulseportraiture_tpu.io.tim import write_TOAs
+        from pulseportraiture_tpu.pipeline.stream import _DONE_PREFIX
+
+        open(a, "w").close()
+        groups = {d: [t for t in toas if t.archive == d]
+                  for d in order}
+        for d in order:
+            write_TOAs(groups[d], outfile=a, append=True)
+            with open(a, "a") as fh:
+                fh.write(_DONE_PREFIX + os.path.abspath(d) + "\n")
+        write_tim_result(back, b)
+        assert open(b, "rb").read() == open(a, "rb").read(), trial
+    # the durable-.tim reader inverts the writer, empty archives incl.
+    assert read_tim_result(b).order == order
+    # a real campaign result survives the recover-and-reserialize loop
+    files, gmodel, refb = campaign
+    one = stream_wideband_TOAs(files[:2], gmodel, nsub_batch=8,
+                               quiet=True)
+    tim = str(tmp_path / "real.tim")
+    one.tim_out = None
+    write_tim_result(one, tim)
+    assert open(tim, "rb").read() == refb
+    rec = read_tim_result(tim)
+    tim2 = str(tmp_path / "real2.tim")
+    write_tim_result(rec, tim2)
+    assert open(tim2, "rb").read() == refb
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant QoS
+# ---------------------------------------------------------------------------
+
+def test_admission_queue_tenant_qos_units():
+    """Per-tenant quotas reject retryably (naming the tenant and the
+    knob), oversize-for-quota requests are terminal, the weighted-fair
+    scheduler serves lanes in weight proportion, and an idle lane
+    cannot bank credit."""
+    q = AdmissionQueue(100, tenant_quota={"bulk": 4},
+                       tenant_weight={"fast": 4.0, "bulk": 1.0})
+    for i in range(4):
+        q.submit(ServeRequest([f"b{i}.fits"], "m", tenant="bulk"))
+    with pytest.raises(Exception, match="over quota") as ei:
+        q.submit(ServeRequest(["b4.fits"], "m", tenant="bulk"))
+    assert ei.value.retryable is True
+    assert "bulk" in str(ei.value)
+    with pytest.raises(Exception, match="split it") as ei:
+        q.submit(ServeRequest([f"x{i}.fits" for i in range(5)], "m",
+                              tenant="bulk"))
+    assert ei.value.retryable is False
+    # other tenants are unaffected by bulk's quota
+    for i in range(4):
+        q.submit(ServeRequest([f"f{i}.fits"], "m", tenant="fast"))
+    snap = q.tenant_snapshot()
+    assert snap["bulk"]["queued"] == 4
+    assert snap["fast"]["pending_archives"] == 4
+    # weighted-fair: fast (weight 4) gets ~4 pops per bulk pop
+    order = [q.get(0.01).tenant for _ in range(8)]
+    assert order.count("fast") == 4 and order.count("bulk") == 4
+    assert order[1:5] == ["fast"] * 4, order  # fast never starved
+    # quota credit returns per-tenant via release
+    assert q.pending_archives == 8
+    q.release(4, tenant="bulk")
+    q.submit(ServeRequest(["b5.fits"], "m", tenant="bulk"))
+    # an idle lane waking up starts at the CURRENT virtual time: it
+    # must not monopolize the scheduler to catch up
+    q2 = AdmissionQueue(100, tenant_weight={"a": 1.0, "b": 1.0})
+    for i in range(4):
+        q2.submit(ServeRequest([f"a{i}.fits"], "m", tenant="a"))
+    assert [q2.get(0.01).tenant for _ in range(2)] == ["a", "a"]
+    for i in range(2):
+        q2.submit(ServeRequest([f"b{i}.fits"], "m", tenant="b"))
+    order = [q2.get(0.01).tenant for _ in range(4)]
+    # without the wake-up clamp this would be ['b','b','a','a'] (b
+    # "catching up" from vtime 0); with it the lanes interleave
+    assert order == ["a", "b", "a", "b"], order
+
+
+def test_tenant_qos_end_to_end_with_trace(campaign, tmp_path):
+    """tenant= rides submit -> wire -> AdmissionQueue lane -> the
+    request_done/route_done events, and the pptrace fleet section
+    reports the per-tenant latency split."""
+    files, gmodel, _ = campaign
+    trace = str(tmp_path / "tenant.jsonl")
+    with ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True,
+                   telemetry=trace,
+                   tenant_quota={"bulk": 8}) as srv:
+        with TransportServer(srv, port=0) as listener:
+            router = ToaRouter(
+                [SocketTransport(f"127.0.0.1:{listener.port}")])
+            ha = router.submit(files[:2], gmodel, name="big",
+                               tenant="bulk")
+            hb = router.submit(files[2:3], gmodel, name="small",
+                               tenant="interactive")
+            ha.result(300)
+            hb.result(300)
+            router.close()
+    _, events = telemetry.validate_trace(trace)
+    sub = {e["req"]: e.get("tenant") for e in events
+           if e["type"] == "request_submit"}
+    assert sub == {"big": "bulk", "small": "interactive"}
+    done = {e["req"]: e.get("tenant") for e in events
+            if e["type"] == "request_done"}
+    assert done == {"big": "bulk", "small": "interactive"}
+    summary = telemetry.report(trace, file=io.StringIO())
+    assert set(summary["tenant_latency"]) == {"bulk", "interactive"}
+    for rec in summary["tenant_latency"].values():
+        assert rec["n"] == 1 and rec["p99_s"] >= rec["p50_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# refit-aware routing (ROADMAP item 4 tail)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def rfi_pair(tmp_path_factory):
+    """One contaminated + one clean archive (the test_quality
+    injector recipe) plus their zap-then-fit oracle .tim."""
+    from pulseportraiture_tpu.io.psrfits import load_data
+    from pulseportraiture_tpu.pipeline.zap import get_zap_channels
+    from pulseportraiture_tpu.synth import inject_rfi
+
+    root = tmp_path_factory.mktemp("fleet_rfi")
+    model = default_test_model(1500.0)
+    gmodel = str(root / "model.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files = []
+    specs = [dict(tone_channels=[3, 11], tone_white=8.0,
+                  tone_structured=60.0,
+                  bursts=[(1, [20, 21], 20.0)]), None]
+    for i, spec in enumerate(specs):
+        path = str(root / f"ep{i}.fits")
+        make_fake_pulsar(model, PAR, outfile=path, nsub=2, nchan=32,
+                         nbin=128, nu0=1500.0, bw=800.0, tsub=60.0,
+                         phase=0.01 * i, dDM=1e-4 * (i - 1),
+                         noise_stds=0.05, dedispersed=False,
+                         quiet=True, rng=300 + i)
+        if spec:
+            inject_rfi(path, rng=40 + i, **spec)
+        files.append(path)
+    d = load_data(files[0], dedisperse=False, dededisperse=True,
+                  pscrunch=True, quiet=True)
+    zl = get_zap_channels(d, device=False)
+    oracle = str(root / "oracle.tim")
+    stream_wideband_TOAs(files, gmodel, nsub_batch=8, quiet=True,
+                         tim_out=oracle, zap_channels={files[0]: zl})
+    return files, gmodel, open(oracle, "rb").read()
+
+
+def test_refit_aware_routing_moves_host_and_matches_oracle(rfi_pair,
+                                                           tmp_path):
+    """A gate-tripping archive collected through the router is
+    zap-and-refit EXACTLY once on the least-loaded HEALTHY host (the
+    refit event carries the host move), the merged .tim equals the
+    offline zap-then-fit oracle byte-for-byte, and a clean corpus is
+    untouched with the loop on."""
+    files, gmodel, oracleb = rfi_pair
+    trace = str(tmp_path / "refit.jsonl")
+    with ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h0, \
+            ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as h1:
+        router = ToaRouter([InProcTransport(h0, label="r0"),
+                            InProcTransport(h1, label="r1")],
+                           quality_refit=True, telemetry=trace)
+        tim = str(tmp_path / "routed.tim")
+        res = router.get_TOAs(files, gmodel, timeout=600,
+                              tim_out=tim, name="R")
+        # clean request: no refit, bytes as served
+        clean_tim = str(tmp_path / "clean.tim")
+        router.get_TOAs(files[1:], gmodel, timeout=600,
+                        tim_out=clean_tim, name="CL")
+        router.close()
+    assert len(res.TOA_list) == 4
+    assert open(tim, "rb").read() == oracleb
+    ref_clean = str(tmp_path / "ref_clean.tim")
+    stream_wideband_TOAs(files[1:], gmodel, nsub_batch=8, quiet=True,
+                         tim_out=ref_clean)
+    assert open(clean_tim, "rb").read() == \
+        open(ref_clean, "rb").read()
+    _, events = telemetry.validate_trace(trace)
+    refits = [e for e in events if e["type"] == "refit"]
+    assert len(refits) == 1      # exactly once, contaminated only
+    ev = refits[0]
+    assert ev["datafile"] == files[0]
+    assert ev["n_channels"] > 0
+    assert ev["improved"] is True and ev["gof_after"] < \
+        ev["gof_before"]
+    # the host move rides the event (host_from -> host); with both
+    # hosts idle the least-loaded HEALTHY host is a valid target
+    # either way — the fields must exist and name fleet members
+    assert ev["host_from"] in ("r0", "r1")
+    assert ev["host"] in ("r0", "r1")
+
+
+# ---------------------------------------------------------------------------
+# env hooks
+# ---------------------------------------------------------------------------
+
+def test_fleet_env_hooks(monkeypatch):
+    """PPT_ROUTER_PROBE_MS / PPT_ROUTER_HEDGE_MS /
+    PPT_ROUTER_FLEET_FILE / PPT_SERVE_TENANT_QUOTA /
+    PPT_SERVE_TENANT_WEIGHT: registered in KNOWN_PPT_ENV, strict
+    parses, loud errors, did-you-mean on typos."""
+    old = (config.router_probe_ms, config.router_hedge_ms,
+           config.router_fleet_file, config.serve_tenant_quota,
+           config.serve_tenant_weight)
+    try:
+        for name in ("PPT_ROUTER_PROBE_MS", "PPT_ROUTER_HEDGE_MS",
+                     "PPT_ROUTER_FLEET_FILE",
+                     "PPT_SERVE_TENANT_QUOTA",
+                     "PPT_SERVE_TENANT_WEIGHT"):
+            assert name in config.KNOWN_PPT_ENV
+        monkeypatch.setenv("PPT_ROUTER_PROBE_MS", "250")
+        monkeypatch.setenv("PPT_ROUTER_HEDGE_MS", "1500")
+        monkeypatch.setenv("PPT_ROUTER_FLEET_FILE", "/tmp/fleet.txt")
+        monkeypatch.setenv("PPT_SERVE_TENANT_QUOTA",
+                           "bulk:32,interactive:8,*:16")
+        monkeypatch.setenv("PPT_SERVE_TENANT_WEIGHT",
+                           "interactive:4,bulk:1")
+        changed = config.env_overrides()
+        for key in ("router_probe_ms", "router_hedge_ms",
+                    "router_fleet_file", "serve_tenant_quota",
+                    "serve_tenant_weight"):
+            assert key in changed
+        assert config.router_probe_ms == 250.0
+        assert config.router_hedge_ms == 1500.0
+        assert config.router_fleet_file == "/tmp/fleet.txt"
+        assert config.serve_tenant_quota == {"bulk": 32,
+                                             "interactive": 8,
+                                             "*": 16}
+        assert config.serve_tenant_weight == {"interactive": 4.0,
+                                              "bulk": 1.0}
+        monkeypatch.setenv("PPT_SERVE_TENANT_QUOTA", "12")
+        config.env_overrides()
+        assert config.serve_tenant_quota == 12
+        for name, off in (("PPT_ROUTER_HEDGE_MS", None),
+                          ("PPT_ROUTER_FLEET_FILE", None),
+                          ("PPT_SERVE_TENANT_QUOTA", None),
+                          ("PPT_SERVE_TENANT_WEIGHT", None)):
+            monkeypatch.setenv(name, "off")
+        config.env_overrides()
+        assert config.router_hedge_ms is None
+        assert config.router_fleet_file is None
+        assert config.serve_tenant_quota is None
+        assert config.serve_tenant_weight is None
+        for name, bad in (("PPT_ROUTER_PROBE_MS", "0"),
+                          ("PPT_ROUTER_PROBE_MS", "soon"),
+                          ("PPT_ROUTER_HEDGE_MS", "-1"),
+                          ("PPT_SERVE_TENANT_QUOTA", "bulk:0"),
+                          ("PPT_SERVE_TENANT_QUOTA", "bulk:x"),
+                          ("PPT_SERVE_TENANT_QUOTA", "a:1,a:2"),
+                          ("PPT_SERVE_TENANT_WEIGHT", "3.0"),
+                          ("PPT_SERVE_TENANT_WEIGHT", ":2")):
+            monkeypatch.setenv(name, bad)
+            with pytest.raises(ValueError, match=name):
+                config.env_overrides()
+            monkeypatch.delenv(name)
+        # did-you-mean: a typo'd knob warns with the close match
+        import pulseportraiture_tpu.config as cfgmod
+
+        cfgmod._warned_unknown_ppt.discard("PPT_ROUTER_PROBE_M")
+        monkeypatch.setenv("PPT_ROUTER_PROBE_M", "100")
+        import contextlib
+        import io as _io
+
+        err = _io.StringIO()
+        with contextlib.redirect_stderr(err):
+            config.env_overrides()
+        assert "PPT_ROUTER_PROBE_MS" in err.getvalue()
+    finally:
+        (config.router_probe_ms, config.router_hedge_ms,
+         config.router_fleet_file, config.serve_tenant_quota,
+         config.serve_tenant_weight) = old

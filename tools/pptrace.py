@@ -11,7 +11,13 @@ Traces are written by the campaign drivers when telemetry is enabled
 --telemetry PATH``, or ``ppserve --telemetry PATH``); see
 docs/GUIDE.md "Tracing a campaign".  Serving-loop traces add a
 "serve" report section: request-latency percentiles, queue-wait vs
-serve split, batch occupancy, and the AOT warmup ledger.
+serve split, batch occupancy, and the AOT warmup ledger.  Routed
+traces add the "router" section (per-host shares, retry rate,
+placement imbalance) and — for elastic fleets — the "fleet" section:
+per-host health-state timeline (JOINING/HEALTHY/SUSPECT/DEAD/
+REJOINED transitions), failover counts split collected-vs-
+redispatched, hedge counts, and the per-tenant latency split; see
+docs/GUIDE.md "Operating an elastic fleet".
 """
 
 import os
