@@ -295,16 +295,21 @@ def align_stage_profile(cube, noise, masks, freqs, P_s, acc_dt,
 
 
 def gauss_stage_profile(resid_fn, aux, x0, lo, hi, kind, vary,
-                        K=3, nrun=2):
+                        K=3, nrun=2, jac_fn=None):
     """Attribution of the batched template-LM bucket dispatch
     (fit/lm.levenberg_marquardt_batched, the template factory's
     portrait stage — ISSUE 9): one vmapped LM iteration decomposed as
 
       resid    (prefix)  batched residual evaluation at the current
                          internal parameters (model gen + weighting)
-      jacobian (prefix)  + the vmapped jacfwd (nparam forward passes
-                         through the model — the dominant per-step
-                         cost)
+      jacobian (prefix)  + the Jacobian source under profile: the
+                         vmapped jacfwd (nparam forward passes through
+                         the model — the AD lane's dominant per-step
+                         cost), or, with ``jac_fn`` (ISSUE 14), the
+                         ANALYTIC residual-Jacobian companion chained
+                         through the bound transform — the same
+                         evaluator fit/lm._make_jac builds, so the
+                         profile times exactly what the engine runs
       solve    (prefix)  + normal equations (g, JTJ, damped A) and the
                          batched linear solve for the step
       select   (piece)   the accept/convergence bookkeeping (f_new,
@@ -314,14 +319,17 @@ def gauss_stage_profile(resid_fn, aux, x0, lo, hi, kind, vary,
     The full program is exactly the iteration the vmapped while_loop
     body runs (under vmap the lax.cond Jacobian skip becomes a select,
     so jac IS evaluated every iteration — the decomposition matches
-    the real batched program, not the single-problem one).  Arrays
-    ship as ARGUMENTS, never jit-closed-over constants (XLA would
-    constant-fold the stage at compile time — the exp_breakdown
-    lesson)."""
+    the real batched program, not the single-problem one).  Run it
+    once per lane (jac_fn None / provided) for the analytic-vs-AD
+    stage A/B bench_gauss reports.  Arrays ship as ARGUMENTS, never
+    jit-closed-over constants (XLA would constant-fold the stage at
+    compile time — the exp_breakdown lesson)."""
     import jax
     import jax.numpy as jnp
 
-    from pulseportraiture_tpu.fit.lm import _to_external, _to_internal
+    from pulseportraiture_tpu.fit.lm import (_to_external,
+                                             _to_external_grad,
+                                             _to_internal)
     from pulseportraiture_tpu.profiling import Stage, profile_stages
 
     x0 = jnp.asarray(x0)
@@ -333,8 +341,15 @@ def gauss_stage_profile(resid_fn, aux, x0, lo, hi, kind, vary,
     def rfun_one(u, lo1, hi1, k1, aux1):
         return resid_fn(_to_external(u, lo1, hi1, k1), *aux1)
 
-    def jac_one(u, lo1, hi1, k1, v1, aux1):
-        return jax.jacfwd(rfun_one)(u, lo1, hi1, k1, aux1) * v1[None, :]
+    if jac_fn is None:
+        def jac_one(u, lo1, hi1, k1, v1, aux1):
+            return (jax.jacfwd(rfun_one)(u, lo1, hi1, k1, aux1)
+                    * v1[None, :])
+    else:
+        def jac_one(u, lo1, hi1, k1, v1, aux1):
+            Jx = jac_fn(_to_external(u, lo1, hi1, k1), *aux1)
+            D = _to_external_grad(u, lo1, hi1, k1)
+            return Jx * (D * v1)[None, :]
 
     @jax.jit
     def resid_prefix(u, lo, hi, kind, aux):

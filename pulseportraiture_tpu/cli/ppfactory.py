@@ -26,6 +26,33 @@ def parse_gauss_device(value, error=None):
     return _GAUSS_DEVICE_TABLE[v]
 
 
+LM_JACOBIAN_CHOICES = ("auto", "analytic", "ad")
+
+
+def parse_lm_jacobian(value, error=None):
+    """Strict --lm-jacobian parse shared by ppfactory/ppgauss:
+    'auto' | 'analytic' | 'ad' -> config.lm_jacobian; anything else
+    dies loudly BEFORE any file IO."""
+    v = str(value).lower()
+    if v not in LM_JACOBIAN_CHOICES:
+        raise SystemExit(f"--lm-jacobian expected one of "
+                         f"{'/'.join(LM_JACOBIAN_CHOICES)}, got "
+                         f"{value!r}")
+    return v
+
+
+def apply_lm_jacobian(value):
+    """Apply a parsed --lm-jacobian to config (the knob is resolved
+    inside fit/lm per call, so setting the module value routes every
+    LM fit of this process — exactly the A/B the flag exists for)."""
+    if value is None:
+        return None
+    from .. import config
+
+    config.lm_jacobian = parse_lm_jacobian(value)
+    return config.lm_jacobian
+
+
 def build_parser():
     p = argparse.ArgumentParser(
         prog="ppfactory", description=__doc__.splitlines()[0])
@@ -59,6 +86,11 @@ def build_parser():
                    help="LM lane: 'off' (host-serial oracle), 'auto' "
                         "(batched on TPU), 'on' (force batched) "
                         "[default: config.gauss_device].")
+    p.add_argument("--lm-jacobian", dest="lm_jacobian", default=None,
+                   help="LM Jacobian source: 'auto' (analytic when the "
+                        "model provides one), 'analytic' (require it), "
+                        "'ad' (force jax.jacfwd — the digit oracle) "
+                        "[default: config.lm_jacobian].")
     p.add_argument("--telemetry", default=None,
                    help="Write a JSONL event trace (template_fit "
                         "events; analyze with tools/pptrace.py).")
@@ -73,6 +105,7 @@ def main(argv=None):
     gauss_device = None
     if args.gauss_device is not None:
         gauss_device = parse_gauss_device(args.gauss_device)
+    apply_lm_jacobian(args.lm_jacobian)
     if args.max_ngauss < 1:
         raise SystemExit(f"--max-ngauss must be >= 1, got "
                          f"{args.max_ngauss}")

@@ -66,6 +66,11 @@ def build_parser():
                    help="LM lane: 'off' (host-serial oracle), 'auto' "
                         "(batched on TPU), 'on' (force batched) "
                         "[default: config.gauss_device].")
+    p.add_argument("--lm-jacobian", dest="lm_jacobian", default=None,
+                   help="LM Jacobian source: 'auto' (analytic when the "
+                        "model provides one), 'analytic' (require it), "
+                        "'ad' (force jax.jacfwd — the digit oracle) "
+                        "[default: config.lm_jacobian].")
     p.add_argument("--verbose", dest="quiet", action="store_false",
                    default=True)
     return p
@@ -76,11 +81,12 @@ def main(argv=None):
     args = parser.parse_args(argv)
     if not args.datafile and not args.metafile:
         parser.error("need -d datafile or -M metafile")
-    from .ppfactory import parse_gauss_device
+    from .ppfactory import apply_lm_jacobian, parse_gauss_device
 
     gauss_device = None
     if args.gauss_device is not None:
         gauss_device = parse_gauss_device(args.gauss_device)
+    apply_lm_jacobian(args.lm_jacobian)
     if args.max_ngauss < 1:
         raise SystemExit(f"--max-ngauss must be >= 1, got "
                          f"{args.max_ngauss}")

@@ -90,6 +90,16 @@ def build_parser():
                         "identical for any value). [default: "
                         "config.stream_pipeline_depth / "
                         "PPT_PIPELINE_DEPTH]")
+    p.add_argument("--fit-fused", dest="fit_fused", default=None,
+                   metavar="off|auto|on",
+                   help="Fused (hand-blocked single-program) DFT -> "
+                        "cross-spectrum hot path for the fast fit "
+                        "lanes (ops/fused.py; active only with the "
+                        "harmonic window, where .tim output is byte-"
+                        "identical fused vs unfused): 'off', 'auto' "
+                        "(TPU backends), 'on'.  Also via "
+                        "PPT_FIT_FUSED / config.fit_fused. [default: "
+                        "config.fit_fused]")
     p.add_argument("--compile-cache", dest="compile_cache",
                    default=None, metavar="DIR",
                    help="Persistent jax compilation cache directory: "
@@ -203,6 +213,18 @@ def main(argv=None):
         if args.pipeline_depth < 1:
             raise SystemExit("--pipeline-depth: depth must be >= 1, "
                              f"got {args.pipeline_depth}")
+    if args.fit_fused is not None:
+        table = {"off": False, "auto": "auto", "on": True}
+        v = str(args.fit_fused).lower()
+        if v not in table:
+            raise SystemExit("--fit-fused expected one of off/auto/on, "
+                             f"got {args.fit_fused!r}")
+        # resolved per trace by the fast lanes (fit.portrait
+        # .use_fit_fused), so the config value routes every fit of
+        # this process
+        from .. import config
+
+        config.fit_fused = table[v]
     if args.compile_cache:
         # applies to EVERY lane (GetTOAs compiles too); also sets the
         # config default so spawned helpers resolve the same cache

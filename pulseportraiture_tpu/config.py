@@ -116,6 +116,47 @@ quality_max_gof = 1.3
 # when RFI is known to suppress the matched filter).
 quality_min_snr = 0.0
 
+# Jacobian source for the Levenberg-Marquardt template engine
+# (fit/lm.py).  The Gaussian profile/portrait models have CLOSED-FORM
+# derivatives (the reference's analytic-gradient heritage, SURVEY
+# §L3); when the model supplies its analytic residual-companion
+# (fit/gauss._profile_resid_jac and the portrait twin), the engine can
+# call it instead of jax.jacfwd — pure matmuls/elementwise work
+# instead of nparam forward-mode passes re-tracing the model, and the
+# win compounds under vmap where the lax.cond Jacobian-reuse degrades
+# to jac-every-iteration (attrib.py gauss measured the AD jacobian at
+# 443 of 503 ms/iteration, 0.97 attributed).
+#   'auto' (default): analytic whenever the caller provides a
+#          companion; jacfwd otherwise (powlaw and any external
+#          resid_fn keep autodiff).
+#   'analytic': require the companion — a fit without one refuses
+#          loudly (an A/B run must not silently fall back to AD).
+#   'ad': force jacfwd even when a companion exists — the digit
+#          oracle lane (bench_gauss gates analytic-vs-AD <= 1e-10).
+lm_jacobian = "auto"
+
+# Fuse the wideband fit's windowed hot path (split-real DFT ->
+# cross-spectrum -> per-channel power reductions,
+# fit/portrait.prepare_portrait_fit_real and its scattering twin)
+# into a hand-blocked single-program pass (ops/fused.py): the DFT
+# spectra dr/di/mr/mi are never materialized at full (nchan, nharm) —
+# each channel block flows DFT -> cross-spectrum -> S0/M2w inside one
+# lax.scan step, so the prepare stage's HBM traffic drops from six
+# full-size intermediates to the two the Newton loop actually reads
+# (Xr, Xi).  Only active when the harmonic window is on (nharm_eff
+# set): the windowed lane's full-spectrum data power already comes
+# from the exact time-domain Parseval form, which is what keeps the
+# fused program BYTE-identical to the unfused one (.tim gates in
+# tests/test_stream.py and bench.py every run).  The Pallas kernel
+# variant (ops/fused.fused_cross_spectrum_pallas) is stubbed for the
+# chip session — on TPU today 'auto' takes the same hand-blocked XLA
+# program.
+#   False: unfused (the round-5 program, bit-stable across releases).
+#   'auto' (default): fused on TPU backends; unfused elsewhere (CPU CI
+#          exercises the fused lane explicitly via tests/bench).
+#   True:  force the fused program everywhere.
+fit_fused = "auto"
+
 # Matmul-DFT precision (ops/fourier.py) on accelerators:
 # 'highest' = 6-pass bf16 (f32-exact to ~1e-7), 'high' = 3-pass
 # (~1e-6 relative, ~20% faster end-to-end at bench shapes), 'default' =
@@ -401,6 +442,8 @@ RCSTRINGS = {
 # import; scripts that set their own config defaults re-apply with
 # env_overrides() afterwards so the environment always wins:
 #
+#   PPT_LM_JACOBIAN=auto|analytic|ad -> lm_jacobian
+#   PPT_FIT_FUSED=off|auto|on       -> fit_fused
 #   PPT_XSPEC=float32|bfloat16      -> cross_spectrum_dtype
 #   PPT_DFT_PRECISION=highest|high|default -> dft_precision
 #   PPT_DFT_FOLD=off|auto|on        -> dft_fold
@@ -443,6 +486,7 @@ RCSTRINGS = {
 # env_overrides() warns about it.
 KNOWN_PPT_ENV = frozenset({
     # config hooks (this module)
+    "PPT_LM_JACOBIAN", "PPT_FIT_FUSED",
     "PPT_XSPEC", "PPT_DFT_PRECISION", "PPT_DFT_FOLD",
     "PPT_ALIGN_DEVICE", "PPT_GAUSS_DEVICE",
     "PPT_GLS_DEVICE", "PPT_ZAP_DEVICE", "PPT_ZAP_NSTD",
@@ -461,7 +505,7 @@ KNOWN_PPT_ENV = frozenset({
     "PPT_ALIGN_CACHE",
     "PPT_GAUSS_CACHE", "PPT_NGAUSS",
     "PPT_TEMPLATE_NOISE", "PPT_STREAM_SPEEDUP_GATE",
-    "PPT_HARMONIC_WINDOW", "PPT_TUNNEL_EMU",
+    "PPT_HARMONIC_WINDOW", "PPT_TUNNEL_EMU", "PPT_RETUNE",
 })
 
 def parse_hostport(spec):
@@ -566,6 +610,24 @@ def env_overrides():
     cfg = _sys.modules[__name__]
     changed = []
     _warn_unknown_ppt_vars(_os.environ)
+    lmjac = _os.environ.get("PPT_LM_JACOBIAN", "").lower()
+    if lmjac:
+        if lmjac not in ("auto", "analytic", "ad"):
+            raise ValueError(
+                f"PPT_LM_JACOBIAN must be 'auto', 'analytic' or 'ad', "
+                f"got {lmjac!r}")
+        cfg.lm_jacobian = lmjac
+        changed.append("lm_jacobian")
+    ffused = _os.environ.get("PPT_FIT_FUSED", "").lower()
+    if ffused:
+        table = {"off": False, "false": False, "auto": "auto",
+                 "on": True, "true": True}
+        if ffused not in table:
+            raise ValueError(
+                f"PPT_FIT_FUSED must be 'off', 'auto' or 'on', got "
+                f"{ffused!r}")
+        cfg.fit_fused = table[ffused]
+        changed.append("fit_fused")
     xspec = _os.environ.get("PPT_XSPEC", "").lower()
     if xspec:
         table = {"float32": None, "none": None, "bfloat16": "bfloat16"}

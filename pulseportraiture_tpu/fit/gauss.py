@@ -23,9 +23,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import Dconst, wid_max
-from ..ops.gaussian import gaussian_profile_FT
+from ..ops.gaussian import gaussian_profile_FT, gaussian_profile_FT_jac
 from ..ops.phasor import cexp
-from ..ops.scattering import scattering_profile_FT
+from ..ops.scattering import (scattering_portrait_FT,
+                              scattering_portrait_FT_dtau,
+                              scattering_profile_FT,
+                              scattering_profile_FT_dtau)
 from ..utils.bunch import DataBunch
 from .lm import levenberg_marquardt, levenberg_marquardt_batched
 
@@ -87,6 +90,42 @@ def _profile_resid(theta, data, errs):
     return (data - jnp.fft.irfft(_profile_FT_flat(theta, nbin), n=nbin)) / errs
 
 
+def _profile_FT_flat_jac(theta, nbin):
+    """Closed-form d(_profile_FT_flat)/dtheta -> (nparam, nharm)
+    complex (ISSUE 14).  Component blocks come from
+    ops.gaussian.gaussian_profile_FT_jac, the scattering chain from
+    ops.scattering.scattering_profile_FT_dtau (tau is in BINS in this
+    layout, hence the /nbin)."""
+    nharm = nbin // 2 + 1
+    dc, tau = theta[0], theta[1]
+    locs, wids, amps = theta[2::3], theta[3::3], theta[4::3]
+    G, dloc, dwid, damp = gaussian_profile_FT_jac(
+        nharm, locs[:, None], wids[:, None], amps[:, None])
+    A = jnp.sum(G, axis=0).at[0].add(dc * nbin)
+    B = scattering_profile_FT(tau / nbin, nharm)
+    dB_dbins = scattering_profile_FT_dtau(tau / nbin, nharm) / nbin
+    n = theta.shape[0]
+    out = jnp.zeros((n, nharm), B.dtype)
+    out = out.at[0].set(jnp.zeros(nharm, B.dtype).at[0].set(
+        nbin * B[0]))                       # B(0) = 1 exactly
+    out = out.at[1].set(A * dB_dbins)
+    out = out.at[2::3].set(dloc * B[None, :])
+    out = out.at[3::3].set(dwid * B[None, :])
+    out = out.at[4::3].set(damp * B[None, :])
+    return out
+
+
+def _profile_resid_jac(theta, data, errs):
+    """Analytic residual-Jacobian companion of _profile_resid:
+    (nres, nparam) in external space.  The irfft is linear, so each
+    column is -irfft(dpFT_j)/errs — one batched inverse DFT instead of
+    nparam forward-mode passes re-tracing the model."""
+    nbin = data.shape[-1]
+    dmodel = jnp.fft.irfft(_profile_FT_flat_jac(theta, nbin), n=nbin,
+                           axis=-1)         # (nparam, nbin)
+    return -(dmodel / errs[None, :]).T
+
+
 def fit_gaussian_profile(data, init_params, errs, fit_flags=None,
                          fit_scattering=False, quiet=True):
     """Fit DC + ngauss Gaussians (+ scattering tau) to a profile.
@@ -121,7 +160,8 @@ def fit_gaussian_profile(data, init_params, errs, fit_flags=None,
     upper[3::3] = wid_max
     lower[4::3] = 0.0  # amps
     res = levenberg_marquardt(_profile_resid, x0, aux=(data, errs_arr),
-                              lower=lower, upper=upper, vary=vary)
+                              lower=lower, upper=upper, vary=vary,
+                              jacobian=_profile_resid_jac)
     residuals = np.asarray(_profile_resid(res.x, data, errs_arr)) * \
         np.asarray(errs_arr)
     dof = int(res.dof)
@@ -211,7 +251,95 @@ def _make_portrait_resid(code, nbin, njoin, nmain):
     return resid
 
 
+def _make_portrait_resid_jac(code, nbin, njoin, nmain):
+    """Analytic residual-Jacobian companion of _make_portrait_resid
+    over the same concatenated [theta, join.flat, alpha_s] vector
+    (ISSUE 14): component blocks from
+    models.gaussian.gaussian_components_FT_jac, the per-channel
+    scattering chain tau_n = (tau_bins/nbin) (nu/nu_ref)^alpha through
+    ops.scattering.scattering_portrait_FT_dtau, and JOIN rotations
+    handled exactly — every base column is rotated on the masked
+    channels (the rotation multiplies the whole spectrum) and the
+    (phase, DM) columns fall out of the final rotated model itself
+    (d rot/dphi = -2 pi i k rot, linear in the delay)."""
+    from ..models.gaussian import gaussian_components_FT_jac
+
+    def resid_jac(x, data, errs, freqs, nu_ref, P, join_mask):
+        nharm = nbin // 2 + 1
+        theta = x[:nmain]
+        join_theta = x[nmain:nmain + 2 * njoin].reshape(njoin, 2)
+        alpha_s = x[-1]
+        params = {
+            "dc": theta[0],
+            "locs": theta[2::6], "mlocs": theta[3::6],
+            "wids": theta[4::6], "mwids": theta[5::6],
+            "amps": theta[6::6], "mamps": theta[7::6],
+        }
+        pFT_u, d = gaussian_components_FT_jac(params, freqs, nu_ref,
+                                              nharm, code)
+        r = freqs / nu_ref
+        ra = r ** alpha_s
+        taus = (theta[1] / nbin) * ra
+        B = scattering_portrait_FT(taus, nharm)
+        dB = scattering_portrait_FT_dtau(taus, nharm)
+        # (ngauss, 6, nchan, nharm) -> (6*ngauss, nchan, nharm) in the
+        # flat layout's per-component (loc, mloc, wid, mwid, amp, mamp)
+        # interleave
+        comp = jnp.stack([d["locs"], d["mlocs"], d["wids"], d["mwids"],
+                          d["amps"], d["mamps"]], axis=2)
+        ngauss = comp.shape[1]
+        comp = comp.transpose(1, 2, 0, 3).reshape(
+            6 * ngauss, comp.shape[0], nharm)
+        # base columns in [theta..., alpha] order — alpha rides at the
+        # end so one masked-rotate pass covers every pre-join column
+        base = jnp.concatenate([
+            (d["dc"] * B)[None],
+            (pFT_u * dB * (ra / nbin)[:, None])[None],
+            comp * B[None],
+            (pFT_u * dB * (taus * jnp.log(r))[:, None])[None],
+        ], axis=0)                          # (nmain + 1, nchan, nharm)
+        full = pFT_u * B
+        k = jnp.arange(nharm, dtype=freqs.dtype)
+        for ij in range(njoin):
+            phi, DM = join_theta[ij, 0], join_theta[ij, 1]
+            delays = phi + (Dconst * DM / P) * (freqs**-2.0
+                                                - nu_ref**-2.0)
+            rot = jnp.conj(cexp(2.0 * jnp.pi * delays[:, None] * k))
+            base = jnp.where(join_mask[ij][None, :, None],
+                             base * rot[None], base)
+            full = jnp.where(join_mask[ij][:, None], full * rot, full)
+        mk = jax.lax.complex(jnp.zeros_like(k), -2.0 * jnp.pi * k)
+        jcols = []
+        for ij in range(njoin):
+            dphi = jnp.where(join_mask[ij][:, None], full * mk, 0.0)
+            ddm = dphi * ((Dconst / P) * (freqs**-2.0
+                                          - nu_ref**-2.0))[:, None]
+            jcols += [dphi[None], ddm[None]]
+        dpFT = jnp.concatenate([base[:nmain]] + jcols + [base[nmain:]],
+                               axis=0)
+        dmodel = jnp.fft.irfft(dpFT, n=nbin, axis=-1)
+        nx = dmodel.shape[0]
+        return -(dmodel / errs[None, :, None]).reshape(nx, -1).T
+
+    return resid_jac
+
+
 _PORTRAIT_RESID_CACHE = {}
+_PORTRAIT_JAC_CACHE = {}
+
+
+def _portrait_fns(code, nbin, njoin, nmain):
+    """(resid, resid_jac) for a portrait layout, cached so the SAME
+    function objects key every jit/vmap cache (fit/lm's batched-core
+    caches key on function identity)."""
+    key = (code, nbin, njoin, nmain)
+    if key not in _PORTRAIT_RESID_CACHE:
+        _PORTRAIT_RESID_CACHE[key] = _make_portrait_resid(
+            code, nbin, njoin, nmain)
+    if key not in _PORTRAIT_JAC_CACHE:
+        _PORTRAIT_JAC_CACHE[key] = _make_portrait_resid_jac(
+            code, nbin, njoin, nmain)
+    return _PORTRAIT_RESID_CACHE[key], _PORTRAIT_JAC_CACHE[key]
 
 
 def fit_gaussian_portrait(data, init_params, scattering_index, errs,
@@ -260,17 +388,14 @@ def fit_gaussian_portrait(data, init_params, scattering_index, errs,
     upper[4:nmain:6] = wid_max
     lower[6:nmain:6] = 0.0       # amps
 
-    key = (model_code, nbin, njoin, nmain)
-    if key not in _PORTRAIT_RESID_CACHE:
-        _PORTRAIT_RESID_CACHE[key] = _make_portrait_resid(
-            model_code, nbin, njoin, nmain)
-    resid = _PORTRAIT_RESID_CACHE[key]
+    resid, resid_jac = _portrait_fns(model_code, nbin, njoin, nmain)
 
     aux = (data, errs, freqs, jnp.asarray(nu_ref, float),
            jnp.asarray(1.0 if P is None else P, float),
            jnp.asarray(join_mask))
     res = levenberg_marquardt(resid, x0, aux=aux, lower=lower, upper=upper,
-                              vary=vary, max_iter=200)
+                              vary=vary, max_iter=200,
+                              jacobian=resid_jac)
     x = np.asarray(res.x)
     x_err = np.asarray(res.x_err)
     residuals = np.asarray(resid(res.x, *aux)).reshape(nchan, nbin) * \
@@ -296,17 +421,20 @@ def fit_gaussian_portrait(data, init_params, scattering_index, errs,
 
 
 def _serial_lm(resid_fn, aux_of, x0s, lower, upper, varys, max_iter,
-               nres_valid=None):
+               nres_valid=None, jacobian=None):
     """The host-serial oracle lane shared by both batched front-ends:
     the SAME padded problems through the single-problem engine one at a
     time, results stacked into an LMResult with a leading B axis (host
-    numpy)."""
+    numpy).  The Jacobian source follows config.lm_jacobian exactly
+    like the batched lane, so serial-vs-batched A/Bs compare engines,
+    not derivative sources."""
     from .lm import LMResult
 
     outs = [levenberg_marquardt(
         resid_fn, x0s[b], aux=aux_of(b), lower=lower, upper=upper,
         vary=varys[b], max_iter=max_iter,
-        nres_valid=(None if nres_valid is None else int(nres_valid[b])))
+        nres_valid=(None if nres_valid is None else int(nres_valid[b])),
+        jacobian=jacobian)
         for b in range(len(x0s))]
     return LMResult(*[np.stack([np.asarray(getattr(o, f))
                                 for o in outs])
@@ -519,10 +647,12 @@ def fit_gaussian_profiles_batched(data, x0s, errs, varys, nbin=None,
         return _serial_lm(_profile_resid,
                           lambda b: (jnp.asarray(data[b]),
                                      jnp.asarray(errs[b])),
-                          x0s, lower, upper, varys, max_iter)
+                          x0s, lower, upper, varys, max_iter,
+                          jacobian=_profile_resid_jac)
     return levenberg_marquardt_batched(
         _profile_resid, x0s, aux=(data, errs), lower=lower, upper=upper,
         vary=np.asarray(varys), max_iter=max_iter,
+        jacobian=_profile_resid_jac,
         # min_rows=1: template stragglers (underfit trials) routinely
         # run alone for many chunks, and the narrow-width run programs
         # compile once per process — measured a net win over the
@@ -602,11 +732,7 @@ def fit_gaussian_portraits_batched(data, x0s, errs, varys, freqs,
         nres_valid = None
     else:
         nres_valid = np.asarray(nchan_valid, int) * nbin
-    key = (model_code, nbin, 0, nmain)
-    if key not in _PORTRAIT_RESID_CACHE:
-        _PORTRAIT_RESID_CACHE[key] = _make_portrait_resid(
-            model_code, nbin, 0, nmain)
-    resid = _PORTRAIT_RESID_CACHE[key]
+    resid, resid_jac = _portrait_fns(model_code, nbin, 0, nmain)
     join_mask = np.zeros((B, 0, nchan), bool)
     if serial:
         return _serial_lm(resid,
@@ -617,10 +743,10 @@ def fit_gaussian_portraits_batched(data, x0s, errs, varys, freqs,
                                      jnp.asarray(Ps[b]),
                                      jnp.asarray(join_mask[b])),
                           x0s, lower, upper, varys, max_iter,
-                          nres_valid=nres_valid)
+                          nres_valid=nres_valid, jacobian=resid_jac)
     return levenberg_marquardt_batched(
         resid, x0s, aux=(data, errs, freqs, nu_refs, Ps, join_mask),
         lower=lower, upper=upper, vary=np.asarray(varys),
-        max_iter=max_iter, nres_valid=nres_valid,
+        max_iter=max_iter, nres_valid=nres_valid, jacobian=resid_jac,
         # min_rows=1: see fit_gaussian_profiles_batched
         compact_every=compact_every, compact_min_rows=1)

@@ -5,10 +5,16 @@ fit_gaussian_profile pplib.py:1922-2002, fit_gaussian_portrait
 pplib.py:2005-2133, fit_powlaw pplib.py:1841-1880).  Bounds are handled
 with the same MINUIT-style parameter transforms lmfit uses, so bounded
 parameters stay strictly inside their intervals and the Jacobian is
-taken in the unbounded internal space by autodiff.  The loop is a
+taken in the unbounded internal space — by autodiff (jax.jacfwd), or,
+when the caller provides an analytic external-space residual-Jacobian
+companion (``jacobian=``), by the closed form chained through the
+transform's elementwise dx/du (ISSUE 14; config.lm_jacobian selects
+'auto'/'analytic'/'ad' — 'ad' is the digit oracle).  The loop is a
 fixed-shape `lax.while_loop`; frozen parameters (vary=False) have their
 Jacobian columns masked rather than changing the parameter vector's
-shape, keeping everything jittable.
+shape, keeping everything jittable.  The vary mask is applied in ONE
+place (_make_jac) for every Jacobian source and both evaluation sites
+(init + in-loop) — one masking rule, three consumers.
 
 Error bars follow lmfit's default convention: covariance scaled by
 reduced chi^2 (scale_covar=True), reported in external space via the
@@ -34,7 +40,43 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["LMResult", "levenberg_marquardt", "levenberg_marquardt_batched"]
+__all__ = ["LMResult", "levenberg_marquardt", "levenberg_marquardt_batched",
+           "use_lm_jacobian", "resolve_lm_jacobian"]
+
+
+def use_lm_jacobian(setting=None):
+    """The engine's Jacobian-source knob: config.lm_jacobian
+    ('auto' | 'analytic' | 'ad'), strict like the other tri-states (a
+    typo must not silently mean 'auto').  Read per call so in-process
+    A/B flips take effect.  setting: explicit per-call override
+    (the CLIs' --lm-jacobian); None -> config."""
+    if setting is None:
+        from .. import config
+
+        setting = getattr(config, "lm_jacobian", "auto")
+    if setting not in ("auto", "analytic", "ad"):
+        raise ValueError(
+            f"lm_jacobian must be 'auto', 'analytic' or 'ad'; got "
+            f"{setting!r}")
+    return setting
+
+
+def resolve_lm_jacobian(jacobian, setting=None):
+    """Resolve the provided analytic companion against the knob:
+    returns the jacobian function to use, or None for jacfwd.
+    'analytic' with no companion refuses loudly — an A/B run forcing
+    the analytic lane must not silently fall back to autodiff."""
+    mode = use_lm_jacobian(setting)
+    if mode == "ad":
+        return None
+    if jacobian is None:
+        if mode == "analytic":
+            raise ValueError(
+                "lm_jacobian='analytic' but this fit's residual "
+                "function provides no analytic Jacobian companion; "
+                "use 'auto' (analytic when available) or 'ad'")
+        return None
+    return jacobian
 
 
 # --- bound transforms (lmfit/MINUIT convention) ---------------------------
@@ -65,6 +107,47 @@ def _to_internal(x, lo, hi, kind):
         kind == 0, x,
         jnp.where(kind == 1, xl, jnp.where(kind == 2, -xu, jnp.arcsin(frac))),
     )
+
+
+def _to_external_grad(u, lo, hi, kind):
+    """Elementwise dx/du of _to_external in closed form (the analytic
+    Jacobian's chain factor; _lm_finalize's jax.grad-vmap computes the
+    same values for the covariance transform)."""
+    s = jnp.sqrt(u**2.0 + 1.0)
+    return jnp.where(
+        kind == 0, jnp.ones_like(u),
+        jnp.where(
+            kind == 1, u / s,
+            jnp.where(kind == 2, -u / s,
+                      0.5 * (hi - lo) * jnp.cos(u)),
+        ),
+    )
+
+
+def _make_jac(resid_fn, jacobian, aux, lo, hi, kind, vary):
+    """THE Jacobian evaluator — and the single place the vary mask is
+    applied (both the initial Jacobian in _lm_init and the in-loop one
+    in _lm_run call this; historically each site masked on its own).
+
+    jacobian None: forward-mode autodiff through residual-of-transform.
+    jacobian given: the analytic external-space residual Jacobian
+    J_x(x, *aux) -> (nres, nparam), chained to internal space by the
+    transform's elementwise dx/du.  ``vary`` must already be cast to
+    the working float dtype."""
+
+    def rfun(u):
+        return resid_fn(_to_external(u, lo, hi, kind), *aux)
+
+    if jacobian is None:
+        def jac(u):
+            J = jax.jacfwd(rfun)(u)  # (nres, nparam)
+            return J * vary[None, :]
+    else:
+        def jac(u):
+            Jx = jacobian(_to_external(u, lo, hi, kind), *aux)
+            D = _to_external_grad(u, lo, hi, kind)
+            return Jx * (D * vary)[None, :]
+    return jac
 
 
 def _bounds_spec(lower, upper, shape, dtype):
@@ -117,7 +200,7 @@ class _LMState(NamedTuple):
 
 
 def _lm_run(resid_fn, aux, s0, lo, hi, kind, vary, it_cap,
-            ftol=1e-10, lam0=1e-3):
+            ftol=1e-10, lam0=1e-3, jacobian=None):
     """Advance an _LMState until convergence or ``it == it_cap`` (the
     shared while_loop; ``it_cap`` is a traced operand so chunked
     execution reuses one compiled program).  Splitting the loop at an
@@ -130,9 +213,7 @@ def _lm_run(resid_fn, aux, s0, lo, hi, kind, vary, it_cap,
     def rfun(u):
         return resid_fn(_to_external(u, lo, hi, kind), *aux)
 
-    def jac(u):
-        J = jax.jacfwd(rfun)(u)  # (nres, nparam)
-        return J * vary[None, :]
+    jac = _make_jac(resid_fn, jacobian, aux, lo, hi, kind, vary)
 
     def cond(s):
         return jnp.logical_and(s.it < it_cap, jnp.logical_not(s.done))
@@ -198,8 +279,11 @@ def _lm_run(resid_fn, aux, s0, lo, hi, kind, vary, it_cap,
     return jax.lax.while_loop(cond, body, s0)
 
 
-def _lm_init(resid_fn, aux, x0, lo, hi, kind, vary, lam0=1e-3):
-    """Initial _LMState at x0 (one residual + one Jacobian eval)."""
+def _lm_init(resid_fn, aux, x0, lo, hi, kind, vary, lam0=1e-3,
+             jacobian=None):
+    """Initial _LMState at x0 (one residual + one Jacobian eval; the
+    Jacobian — and its vary mask — comes from the same _make_jac the
+    loop body uses)."""
     dt = x0.dtype
     u0 = _to_internal(x0, lo, hi, kind)
     vary = vary.astype(dt)
@@ -208,7 +292,7 @@ def _lm_init(resid_fn, aux, x0, lo, hi, kind, vary, lam0=1e-3):
         return resid_fn(_to_external(u, lo, hi, kind), *aux)
 
     r0 = rfun(u0)
-    J0 = jax.jacfwd(rfun)(u0) * vary[None, :]
+    J0 = _make_jac(resid_fn, jacobian, aux, lo, hi, kind, vary)(u0)
     return _LMState(
         u=u0,
         f=jnp.sum(r0**2.0),
@@ -253,14 +337,16 @@ def _lm_finalize(s, lo, hi, kind, vary, nres_valid, max_iter):
 
 
 def _lm_core_impl(resid_fn, aux, x0, lo, hi, kind, vary, nres_valid=None,
-                  max_iter=100, ftol=1e-10, lam0=1e-3):
-    s0 = _lm_init(resid_fn, aux, x0, lo, hi, kind, vary, lam0=lam0)
+                  max_iter=100, ftol=1e-10, lam0=1e-3, jacobian=None):
+    s0 = _lm_init(resid_fn, aux, x0, lo, hi, kind, vary, lam0=lam0,
+                  jacobian=jacobian)
     s = _lm_run(resid_fn, aux, s0, lo, hi, kind, vary, max_iter,
-                ftol=ftol, lam0=lam0)
+                ftol=ftol, lam0=lam0, jacobian=jacobian)
     return _lm_finalize(s, lo, hi, kind, vary, nres_valid, max_iter)
 
 
-_lm_core = partial(jax.jit, static_argnames=("resid_fn", "max_iter"))(
+_lm_core = partial(jax.jit,
+                   static_argnames=("resid_fn", "max_iter", "jacobian"))(
     _lm_core_impl)
 
 
@@ -290,7 +376,7 @@ def _nudge_into_bounds(x0, lo, hi, kind, vary):
 
 def levenberg_marquardt(resid_fn, x0, aux=(), lower=None, upper=None,
                         vary=None, max_iter=100, ftol=1e-10,
-                        nres_valid=None):
+                        nres_valid=None, jacobian=None):
     """Minimize sum(resid_fn(x, *aux)**2) over x with optional bounds.
 
     resid_fn: callable (x, *aux) -> residual vector; must be
@@ -301,6 +387,11 @@ def levenberg_marquardt(resid_fn, x0, aux=(), lower=None, upper=None,
     lower/upper: (n,) bounds with +-inf for unbounded; vary: (n,) bool.
     nres_valid: true residual count for dof when some residual entries
     are structural zero-weight padding (see levenberg_marquardt_batched).
+    jacobian: optional ANALYTIC residual-Jacobian companion
+    (x, *aux) -> (nres, nparam) in external space, hashable like
+    resid_fn; config.lm_jacobian routes between it and jacfwd
+    ('auto' = use it when given, 'ad' = the autodiff digit oracle,
+    'analytic' = require it).
     """
     x0 = jnp.asarray(x0, float)
     n = x0.shape[0]
@@ -312,7 +403,8 @@ def levenberg_marquardt(resid_fn, x0, aux=(), lower=None, upper=None,
     return _lm_core(resid_fn, tuple(aux), x0, lo, hi, kind, vary,
                     nres_valid=(None if nres_valid is None
                                 else jnp.asarray(nres_valid)),
-                    max_iter=max_iter, ftol=ftol)
+                    max_iter=max_iter, ftol=ftol,
+                    jacobian=resolve_lm_jacobian(jacobian))
 
 
 # one compiled batched program per (resid_fn, max_iter, dof source);
@@ -320,13 +412,14 @@ def levenberg_marquardt(resid_fn, x0, aux=(), lower=None, upper=None,
 _BATCHED_CORE_CACHE = {}
 
 
-def _batched_core(resid_fn, max_iter, has_nres):
-    key = (resid_fn, max_iter, has_nres)
+def _batched_core(resid_fn, max_iter, has_nres, jacobian=None):
+    key = (resid_fn, max_iter, has_nres, jacobian)
     if key not in _BATCHED_CORE_CACHE:
         def run(aux, x0, lo, hi, kind, vary, nres_valid, ftol):
             return _lm_core_impl(resid_fn, aux, x0, lo, hi, kind, vary,
                                  nres_valid=nres_valid,
-                                 max_iter=max_iter, ftol=ftol)
+                                 max_iter=max_iter, ftol=ftol,
+                                 jacobian=jacobian)
 
         axes = (0, 0, 0, 0, 0, 0, 0 if has_nres else None, None)
         _BATCHED_CORE_CACHE[key] = jax.jit(jax.vmap(run, in_axes=axes))
@@ -336,19 +429,20 @@ def _batched_core(resid_fn, max_iter, has_nres):
 _BATCHED_PIECE_CACHE = {}
 
 
-def _batched_pieces(resid_fn, has_nres):
+def _batched_pieces(resid_fn, has_nres, jacobian=None):
     """jitted vmapped (init, run-chunk, finalize) programs for the
     compacting front-end.  The run chunk takes ``it_cap`` as a traced
     operand, so every chunk of every problem subset reuses one
     compiled program per batch-width class."""
-    key = (resid_fn, has_nres)
+    key = (resid_fn, has_nres, jacobian)
     if key not in _BATCHED_PIECE_CACHE:
         def init(aux, x0, lo, hi, kind, vary):
-            return _lm_init(resid_fn, aux, x0, lo, hi, kind, vary)
+            return _lm_init(resid_fn, aux, x0, lo, hi, kind, vary,
+                            jacobian=jacobian)
 
         def run(aux, s, lo, hi, kind, vary, it_cap, ftol):
             return _lm_run(resid_fn, aux, s, lo, hi, kind, vary,
-                           it_cap, ftol=ftol)
+                           it_cap, ftol=ftol, jacobian=jacobian)
 
         def fin(s, lo, hi, kind, vary, nres_valid, max_iter):
             return _lm_finalize(s, lo, hi, kind, vary, nres_valid,
@@ -372,7 +466,8 @@ def _pow2ceil(n):
 def levenberg_marquardt_batched(resid_fn, x0, aux=(), lower=None,
                                 upper=None, vary=None, max_iter=100,
                                 ftol=1e-10, nres_valid=None,
-                                compact_every=None, compact_min_rows=4):
+                                compact_every=None, compact_min_rows=4,
+                                jacobian=None):
     """Minimize B independent problems in ONE dispatch: `_lm_core`
     vmapped over the leading problem axis, all problems sharing one
     `lax.while_loop` whose per-problem `done` flags let converged
@@ -391,6 +486,11 @@ def levenberg_marquardt_batched(resid_fn, x0, aux=(), lower=None,
     scale_covar error bars then match the unpadded problems.
     Returns an LMResult whose every field has a leading B axis;
     nfev/success keep their per-problem single-fit semantics.
+    jacobian: analytic residual-Jacobian companion, as in
+    levenberg_marquardt — vmapped alongside resid_fn, so each problem
+    row gets its closed-form (nres, nparam) block instead of nparam
+    forward-mode passes (under vmap the lax.cond Jacobian-reuse is a
+    both-branches select, so this is the dominant per-iteration cost).
 
     compact_every: with an int K, the shared while_loop runs in chunks
     of K iterations with host-side COMPACTION between chunks: problems
@@ -416,13 +516,15 @@ def levenberg_marquardt_batched(resid_fn, x0, aux=(), lower=None,
     aux = tuple(jnp.asarray(a) for a in aux)
     if nres_valid is not None:
         nres_valid = jnp.asarray(nres_valid)
+    jacobian = resolve_lm_jacobian(jacobian)
     if compact_every is None:
         fn = _batched_core(resid_fn, int(max_iter),
-                           nres_valid is not None)
+                           nres_valid is not None, jacobian)
         return fn(aux, x0, lo, hi, kind, vary, nres_valid, ftol)
 
     init_fn, run_fn, fin_fn = _batched_pieces(resid_fn,
-                                              nres_valid is not None)
+                                              nres_valid is not None,
+                                              jacobian)
     lo_j, hi_j = jnp.asarray(lo), jnp.asarray(hi)
     kind_j, vary_j = jnp.asarray(kind), jnp.asarray(vary)
     state = init_fn(aux, x0, lo_j, hi_j, kind_j, vary_j)

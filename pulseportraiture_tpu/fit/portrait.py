@@ -173,6 +173,28 @@ def use_bf16_cross_spectrum():
     return str(getattr(config, "cross_spectrum_dtype", None)) == "bfloat16"
 
 
+def use_fit_fused(setting=None):
+    """Whether the fast lanes' prepare stage should run the fused
+    hand-blocked DFT -> cross-spectrum program (ops/fused.py):
+    config.fit_fused (True/False force; 'auto' = TPU backends, where
+    the HBM round-trips between the unfused stages are the measured
+    mfu ceiling — BENCH_r04/r05).  Strict like the other tri-states.
+    Only takes effect when the harmonic window is active (the batch
+    wrappers normalize the dead fused+unwindowed combination onto the
+    unfused program so it never compiles twice); callers that don't
+    thread it explicitly (the sharded path) resolve config at trace
+    time with the usual already-traced caveat."""
+    if setting is None:
+        setting = getattr(config, "fit_fused", "auto")
+    if setting is True or setting is False:
+        return setting
+    if setting != "auto":
+        raise ValueError(
+            f"fit_fused must be True, False, or 'auto'; got "
+            f"{setting!r}")
+    return jax.default_backend() == "tpu"
+
+
 def use_scatter_compensated():
     """Whether scattering fits run the Dot2-compensated reductions
     (config.scatter_compensated) — the single parse point, shared by
@@ -1311,7 +1333,7 @@ def _parseval_Sd(port, w_full):
 def prepare_portrait_fit_real(port, model, w, freqs, P, nu_fit, theta0,
                               seed_phi=True, seed_derotate=True,
                               x_dtype=None, nharm_eff=None,
-                              dft_fold=None):
+                              dft_fold=None, fit_fused=None):
     """Everything before the Newton loop, in pure real arithmetic:
     matmul DFTs (ops/fourier.py — XLA's TPU FFT is ~2000x slower at
     these shapes), weighted cross-spectrum as a real pair, model/data
@@ -1331,24 +1353,39 @@ def prepare_portrait_fit_real(port, model, w, freqs, P, nu_fit, theta0,
     dft_fold: the fold-symmetry DFT knob, resolved by the BATCH
     wrappers and carried in their program-cache keys (None = read
     config at trace time, with the usual already-traced caveat).
+    fit_fused: route the DFT -> cross-spectrum stage through the
+    hand-blocked fused program (ops/fused.py; windowed lanes only —
+    the full-spectrum Sd already comes from the time-domain Parseval
+    form there, which is what keeps fused byte-identical to unfused).
+    Resolved by the batch wrappers like dft_fold.
     """
     from ..ops.fourier import rfft_mm
 
     dt = w.dtype
-    dr, di = rfft_mm(port, nharm=nharm_eff, fold=dft_fold)
-    mr, mi = rfft_mm(model, nharm=nharm_eff, fold=dft_fold)
-    if nharm_eff is not None:
-        w_full, w = w, w[..., :nharm_eff]
-    # X = dFT * conj(mFT) * w, split into parts
-    Xr = (dr * mr + di * mi) * w
-    Xi = (di * mr - dr * mi) * w
+    if fit_fused is None:
+        fit_fused = use_fit_fused()
     cvec, _ = _t_coeffs(freqs, P, nu_fit)
     cvec = cvec.astype(dt)
-    S0 = jnp.sum((mr**2 + mi**2) * w, axis=-1)
-    if nharm_eff is None:
-        Sd = jnp.sum((dr**2 + di**2) * w)
-    else:
+    if fit_fused and nharm_eff is not None:
+        from ..ops.fused import fused_cross_spectrum
+
+        w_full = w
+        Xr, Xi, S0 = fused_cross_spectrum(
+            port, model, w[..., :nharm_eff], nharm_eff, fold=dft_fold)
         Sd = _parseval_Sd(port, w_full)
+    else:
+        dr, di = rfft_mm(port, nharm=nharm_eff, fold=dft_fold)
+        mr, mi = rfft_mm(model, nharm=nharm_eff, fold=dft_fold)
+        if nharm_eff is not None:
+            w_full, w = w, w[..., :nharm_eff]
+        # X = dFT * conj(mFT) * w, split into parts
+        Xr = (dr * mr + di * mi) * w
+        Xi = (di * mr - dr * mi) * w
+        S0 = jnp.sum((mr**2 + mi**2) * w, axis=-1)
+        if nharm_eff is None:
+            Sd = jnp.sum((dr**2 + di**2) * w)
+        else:
+            Sd = _parseval_Sd(port, w_full)
     if seed_phi:
         phi0 = _initial_phase_guess_real(Xr, Xi, cvec, theta0[1],
                                          derotate=seed_derotate,
@@ -1527,7 +1564,7 @@ def prepare_scatter_fit_real(port, model, noise_stds, chan_mask, freqs,
                              fit_flags, log10_tau=False,
                              compensated=False, x_bf16=None,
                              nharm_eff=None, seed_derotate=True,
-                             dft_fold=None):
+                             dft_fold=None, fit_fused=None):
     """Everything before the scattering Newton loop, in pure real
     arithmetic: weights, matmul DFTs (band-limited when nharm_eff is
     set), cross-spectrum/model-power assembly with the instrumental
@@ -1553,19 +1590,33 @@ def prepare_scatter_fit_real(port, model, noise_stds, chan_mask, freqs,
     nbin = port.shape[-1]
     dt = port.dtype
     w = make_weights(noise_stds, nbin, chan_mask, dtype=dt)
-    dr, di = rfft_mm(port, precision=prec, nharm=nharm_eff,
-                     fold=dft_fold)
-    mr, mi = rfft_mm(model.astype(dt), precision=prec, nharm=nharm_eff,
-                     fold=dft_fold)
-    if nharm_eff is not None:
-        w_full, w = w, w[..., :nharm_eff]
-    Xr = (dr * mr + di * mi) * w
-    Xi = (di * mr - dr * mi) * w
-    M2w = (mr**2 + mi**2) * w
-    if nharm_eff is None:
-        Sd = jnp.sum((dr**2 + di**2) * w)
-    else:
+    if fit_fused is None:
+        fit_fused = use_fit_fused()
+    if fit_fused and nharm_eff is not None:
+        # fused hand-blocked DFT -> cross-spectrum (ops/fused.py);
+        # windowed lanes only — Sd is the exact time-domain Parseval
+        # form either way, so fused-vs-unfused stays byte-identical
+        from ..ops.fused import fused_cross_spectrum
+
+        w_full = w
+        Xr, Xi, M2w = fused_cross_spectrum(
+            port, model.astype(dt), w[..., :nharm_eff], nharm_eff,
+            precision=prec, fold=dft_fold, want_m2=True)
         Sd = _parseval_Sd(port, w_full)
+    else:
+        dr, di = rfft_mm(port, precision=prec, nharm=nharm_eff,
+                         fold=dft_fold)
+        mr, mi = rfft_mm(model.astype(dt), precision=prec,
+                         nharm=nharm_eff, fold=dft_fold)
+        if nharm_eff is not None:
+            w_full, w = w, w[..., :nharm_eff]
+        Xr = (dr * mr + di * mi) * w
+        Xi = (di * mr - dr * mi) * w
+        M2w = (mr**2 + mi**2) * w
+        if nharm_eff is None:
+            Sd = jnp.sum((dr**2 + di**2) * w)
+        else:
+            Sd = _parseval_Sd(port, w_full)
     if ir_r is not None:
         # X' = X conj(ir) with X = Xr + i Xi, ir = ir_r + i ir_i
         Xr, Xi = Xr * ir_r + Xi * ir_i, Xi * ir_r - Xr * ir_i
@@ -1595,7 +1646,8 @@ def fast_scatter_fit_one(port, model, noise_stds, chan_mask, freqs, P,
                          nu_fit, nu_out, theta0, ir_r=None, ir_i=None,
                          bounds=None, *, fit_flags, log10_tau, max_iter,
                          compensated=False, x_bf16=None, nharm_eff=None,
-                         seed_derotate=True, dft_fold=None):
+                         seed_derotate=True, dft_fold=None,
+                         fit_fused=None):
     """One complex-free SCATTERING fit: weights, matmul DFTs + the
     tau-matched CCF seed (prepare_scatter_fit_real), the real
     _cgh_scatter Newton loop — the per-element body for scattering
@@ -1618,7 +1670,8 @@ def fast_scatter_fit_one(port, model, noise_stds, chan_mask, freqs, P,
         port, model, noise_stds, chan_mask, freqs, P, nu_fit, theta0,
         ir_r, ir_i, fit_flags=fit_flags, log10_tau=log10_tau,
         compensated=compensated, x_bf16=x_bf16, nharm_eff=nharm_eff,
-        seed_derotate=seed_derotate, dft_fold=dft_fold)
+        seed_derotate=seed_derotate, dft_fold=dft_fold,
+        fit_fused=fit_fused)
     return _fit_portrait_core_real_scatter.__wrapped__(
         Xr, Xi, M2w, Sd, freqs, P, nu_fit,
         nu_out, theta0, fit_flags=fit_flags, log10_tau=log10_tau,
@@ -1726,10 +1779,13 @@ def fit_portrait_batch_fast(
 
     x_bf16 = use_bf16_cross_spectrum()
     bounds, b_ax = _resolve_bounds_axis(bounds, dt)
+    # dead-knob normalization: fused is a no-op without the harmonic
+    # window, so it must not key a second bit-identical program
+    fit_fused = use_fit_fused() and nharm_eff is not None
     fit = _fast_batch_fn(
         FitFlags(*[bool(f) for f in fit_flags]), int(max_iter),
         m_ax, f_ax, p_ax, nf_ax, seed_derotate, x_bf16,
-        nharm_eff, b_ax, use_dft_fold())
+        nharm_eff, b_ax, use_dft_fold(), fit_fused)
     args = (ports, models, jnp.asarray(noise_stds), chan_masks,
             freqs, P, nu_fit, nu_out_val, theta0)
     if b_ax != "off":
@@ -1740,7 +1796,7 @@ def fit_portrait_batch_fast(
 def fast_fit_one(port, model, noise_stds, chan_mask, freqs, P, nu_fit,
                  nu_out, theta0, bounds=None, *, fit_flags, max_iter,
                  seed_derotate=True, x_bf16=None, nharm_eff=None,
-                 dft_fold=None):
+                 dft_fold=None, fit_fused=None):
     """One complex-free fast fit: weights, matmul DFTs + CCF seed, real
     Newton core — the per-element body shared by the vmapped batch
     (_fast_batch_fn) and the sharded scale-out path
@@ -1766,7 +1822,8 @@ def fast_fit_one(port, model, noise_stds, chan_mask, freqs, P, nu_fit,
     Xr, Xi, S0, Sd, th0 = prepare_portrait_fit_real(
         port, model.astype(port.dtype), w, freqs, P, nu_fit, theta0,
         seed_phi=bool(fit_flags[0]), seed_derotate=seed_derotate,
-        x_dtype=x_dtype, nharm_eff=nharm_eff, dft_fold=dft_fold)
+        x_dtype=x_dtype, nharm_eff=nharm_eff, dft_fold=dft_fold,
+        fit_fused=fit_fused)
     return _fit_portrait_core_real.__wrapped__(
         Xr, Xi, S0, Sd, freqs, P, nu_fit, nu_out, th0,
         fit_flags=fit_flags, max_iter=max_iter,
@@ -1798,17 +1855,19 @@ def reject_fixed_tau_seed(theta0, caller):
 @lru_cache(maxsize=None)
 def _fast_batch_fn(fit_flags, max_iter, m_ax, f_ax, p_ax, nf_ax,
                    seed_derotate=True, x_bf16=False, nharm_eff=None,
-                   b_ax="off", dft_fold=None):
+                   b_ax="off", dft_fold=None, fit_fused=None):
     """Cached jitted end-to-end fast fit — a fresh jit per call would
     recompile every invocation.  One program: matmul DFTs, real
     cross-spectrum, CCF seed, Newton loop, finalize — no complex types
-    anywhere.  dft_fold rides the cache key (resolved by callers via
-    use_dft_fold) so flipping config.dft_fold mid-process retraces
-    instead of silently reusing the other arm's program."""
+    anywhere.  dft_fold and fit_fused ride the cache key (resolved by
+    callers via use_dft_fold / use_fit_fused, the latter normalized
+    onto False when no harmonic window is active) so flipping either
+    knob mid-process retraces instead of silently reusing the other
+    arm's program."""
     one = partial(fast_fit_one, fit_flags=fit_flags, max_iter=max_iter,
                   seed_derotate=seed_derotate,
                   x_bf16=x_bf16, nharm_eff=nharm_eff,
-                  dft_fold=dft_fold)
+                  dft_fold=dft_fold, fit_fused=fit_fused)
     # "off" (a string, NOT False) marks no-bounds: False == 0 in
     # Python, so a boolean sentinel would collide with per-element
     # bounds (b_ax=0) in the lru_cache key and return the wrong
@@ -1875,7 +1934,8 @@ def _fit_batch_fast_scatter(ports, models, noise_stds, freqs, P, nu_fit,
         int(max_iter), bool(compensated),
         effective_x_bf16(compensated),
         m_ax, f_ax, p_ax, nf_ax, use_ir, nharm_eff, b_ax,
-        seed_derotate, use_dft_fold())
+        seed_derotate, use_dft_fold(),
+        use_fit_fused() and nharm_eff is not None)
     args = (ports, models, jnp.asarray(noise_stds),
             jnp.asarray(chan_masks, dt), freqs, P, nu_fit,
             nu_out_arr, jnp.asarray(theta0), ir_r, ir_i)
@@ -1888,15 +1948,16 @@ def _fit_batch_fast_scatter(ports, models, noise_stds, freqs, P, nu_fit,
 def _fast_scatter_batch_fn(fit_flags, log10_tau, max_iter, compensated,
                            x_bf16, m_ax, f_ax, p_ax, nf_ax, use_ir,
                            nharm_eff=None, b_ax="off",
-                           seed_derotate=True, dft_fold=None):
+                           seed_derotate=True, dft_fold=None,
+                           fit_fused=None):
     """Cached jitted end-to-end complex-free scattering batch fit.
-    dft_fold rides the cache key like seed_derotate/x_bf16 (see
-    _fast_batch_fn)."""
+    dft_fold and fit_fused ride the cache key like
+    seed_derotate/x_bf16 (see _fast_batch_fn)."""
     one = partial(fast_scatter_fit_one, fit_flags=fit_flags,
                   log10_tau=log10_tau, max_iter=max_iter,
                   compensated=compensated, x_bf16=x_bf16,
                   nharm_eff=nharm_eff, seed_derotate=seed_derotate,
-                  dft_fold=dft_fold)
+                  dft_fold=dft_fold, fit_fused=fit_fused)
     ir_ax = None  # shared response across the batch
     axes = (0, m_ax, 0, 0, f_ax, p_ax, nf_ax, 0, 0, ir_ax, ir_ax)
     if b_ax != "off":

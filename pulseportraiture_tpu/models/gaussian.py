@@ -36,6 +36,27 @@ def linear_evolution(value, slope, freqs, nu_ref):
 _EVOLUTION = {"0": power_law_evolution, "1": linear_evolution}
 
 
+def power_law_evolution_grads(value, mod_index, freqs, nu_ref):
+    """(dp/dvalue, dp/dmod) of power_law_evolution:
+    p = v (nu/nu_ref)^m => (r^m, v r^m ln r)."""
+    r = freqs / nu_ref
+    rm = r ** mod_index
+    return rm, value * rm * jnp.log(r)
+
+
+def linear_evolution_grads(value, mod_index, freqs, nu_ref):
+    """(dp/dvalue, dp/dmod) of linear_evolution: (1, nu - nu_ref)."""
+    one = jnp.ones(jnp.broadcast_shapes(jnp.shape(value),
+                                        jnp.shape(mod_index),
+                                        jnp.shape(freqs)),
+                   jnp.result_type(value, freqs))
+    return one, jnp.broadcast_to(freqs - nu_ref, one.shape)
+
+
+_EVOLUTION_GRADS = {"0": power_law_evolution_grads,
+                    "1": linear_evolution_grads}
+
+
 def evolve_parameter(value, mod, freqs, nu_ref, code_digit="0"):
     """Dispatch on the .gmodel CODE digit (reference pplib.py:1068-1084)."""
     return _EVOLUTION[code_digit](value, mod, freqs, nu_ref)
@@ -106,6 +127,45 @@ def gaussian_components_FT(params, freqs, nu_ref, nharm, code="000"):
     gFT = gaussian_profile_FT(nharm, locs[..., None], wids[..., None], amps[..., None])
     pFT = jnp.sum(gFT, axis=1)
     return pFT.at[..., 0].add(params["dc"] * nbin)
+
+
+def gaussian_components_FT_jac(params, freqs, nu_ref, nharm, code="000"):
+    """Closed-form derivatives of gaussian_components_FT (ISSUE 14):
+    returns (pFT, derivs) where pFT is the forward (nchan, nharm)
+    model rFFT and derivs maps each flat-parameter family —
+    'dc' (nchan, nharm), and 'locs'/'mlocs'/'wids'/'mwids'/'amps'/
+    'mamps' each (nchan, ngauss, nharm) — to d pFT / d(that scalar of
+    component g).  Evolution chain rules ride the per-family
+    (dp/dvalue, dp/dmod) pairs (_EVOLUTION_GRADS); the Gaussian-kernel
+    block comes from ops.gaussian.gaussian_profile_FT_jac (the
+    sigma-multiplied NaN-free form, safe for frozen zero-amplitude
+    pads)."""
+    from ..ops.gaussian import gaussian_profile_FT_jac
+
+    locs, wids, amps = evolved_components(params, freqs, nu_ref, code)
+    f = freqs[:, None]
+    vgrad_loc = _EVOLUTION_GRADS[code[0]](
+        params["locs"][None, :], params["mlocs"][None, :], f, nu_ref)
+    vgrad_wid = _EVOLUTION_GRADS[code[1]](
+        params["wids"][None, :], params["mwids"][None, :], f, nu_ref)
+    vgrad_amp = _EVOLUTION_GRADS[code[2]](
+        params["amps"][None, :], params["mamps"][None, :], f, nu_ref)
+    nbin = 2 * (nharm - 1)
+    G, dloc, dwid, damp = gaussian_profile_FT_jac(
+        nharm, locs[..., None], wids[..., None], amps[..., None])
+    pFT = jnp.sum(G, axis=1).at[..., 0].add(params["dc"] * nbin)
+    dc_col = jnp.zeros_like(pFT).at[..., 0].set(
+        jnp.asarray(nbin, pFT.real.dtype))
+    derivs = {
+        "dc": dc_col,
+        "locs": dloc * vgrad_loc[0][..., None],
+        "mlocs": dloc * vgrad_loc[1][..., None],
+        "wids": dwid * vgrad_wid[0][..., None],
+        "mwids": dwid * vgrad_wid[1][..., None],
+        "amps": damp * vgrad_amp[0][..., None],
+        "mamps": damp * vgrad_amp[1][..., None],
+    }
+    return pFT, derivs
 
 
 def apply_scattering_FT(pFT, tau_rot, alpha, freqs, nu_ref):

@@ -1,0 +1,134 @@
+"""Fused (hand-blocked) DFT -> cross-spectrum hot path (ISSUE 14).
+
+The wideband fit's prepare stage historically ran as separate XLA ops
+with full-size intermediates between them: two (nchan, nharm) DFT
+pairs for data and model (dr/di/mr/mi), then the elementwise
+cross-spectrum, then the per-channel power reductions — six
+(nchan, nharm) HBM-resident arrays to produce the two the Newton loop
+actually reads (Xr, Xi).  On an MXU that is the difference between a
+roofline matmul and a pipeline of HBM round-trips (BENCH_r04/r05: the
+fit lane flat at 22.1-22.4k TOAs/s, mfu 0.121, since round 4).
+
+`fused_cross_spectrum` blocks the channel axis through ONE lax.scan:
+each step DFTs a channel block (reusing ops.fourier.rfft_mm — the
+matmul-DFT single source of truth, so precision/fold semantics are
+shared), forms the block's weighted cross-spectrum and model power in
+registers/VMEM-sized tiles, and emits only the persistent outputs.
+Per-row matmul results and per-row reductions are BITWISE identical to
+the unblocked program (blocking never re-associates a row's
+contraction; guarded by tests/test_fastpath.py and the .tim byte gates
+in tests/test_stream.py), which is what lets config.fit_fused flip
+with zero behavior drift.
+
+Scope: the fused program is the WINDOWED hot path — the caller's
+full-spectrum data power must come from the exact time-domain Parseval
+form (fit/portrait._parseval_Sd), which the harmonic-window lane
+already uses; fit/portrait only activates fusion when nharm_eff is
+set.  The Pallas kernel variant (fusing the per-Newton-pass moment
+reductions into the same VMEM-resident tiles) is stubbed below for the
+chip session; on TPU today config.fit_fused='auto' takes this same
+hand-blocked XLA program.
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fused_cross_spectrum", "fused_cross_spectrum_pallas",
+           "HAVE_PALLAS_FUSED"]
+
+# The chip-session Pallas kernel is not implemented yet; when it lands
+# this flips and fused_cross_spectrum dispatches to it on TPU backends.
+HAVE_PALLAS_FUSED = False
+
+# Channel-block target: big enough that the block DFT matmul amortizes
+# loop overhead, small enough that a block's (cb, nbin) input tile and
+# (cb, nharm) output tiles stay cache/VMEM-resident at production
+# shapes (512ch x 2048bin f32: 32 x 2048 x 4B = 256 KB in, 4 x 32 x
+# nharm out).
+_BLOCK_TARGET = 32
+
+
+def _block_size(nchan, target=_BLOCK_TARGET):
+    """Block size for the channel tiling: the target, clamped to
+    nchan.  A ragged channel count is ZERO-PADDED up to a block
+    multiple rather than degrading the block (a degenerate 1-row
+    block would lower the DFT matmul to a gemv, whose contraction
+    order differs from the gemm rows the unfused program computes —
+    measured non-bitwise on CPU; zero pad rows cost their flops but
+    keep every real row's kernel identical)."""
+    return min(int(target), int(nchan))
+
+
+def fused_cross_spectrum(port, model, w, nharm, precision=None,
+                         fold=None, want_m2=False, block=None):
+    """One blocked pass: windowed split-real DFT of data + model ->
+    weighted cross-spectrum (+ model power), never materializing the
+    full (nchan, nharm) DFT intermediates.
+
+    port/model: (nchan, nbin) time-domain portraits (model may be the
+    shared template — under vmap with in_axes=None its per-block DFT
+    stays unbatched and hoists).  w: (nchan, nharm) weights already
+    sliced to the harmonic window.  nharm: the window (static).
+    want_m2=False returns (Xr, Xi, S0) with S0 the per-channel model
+    power (the no-scattering lane); want_m2=True returns (Xr, Xi, M2w)
+    with the full weighted model power spectrum (the scattering lane,
+    which needs it per harmonic).
+
+    Every output row is bitwise identical to the unfused program's —
+    the per-row DFT contraction and the per-row harmonic reduction are
+    untouched by channel blocking."""
+    if HAVE_PALLAS_FUSED and jax.default_backend() == "tpu":
+        return fused_cross_spectrum_pallas(port, model, w, nharm,
+                                           precision=precision,
+                                           fold=fold, want_m2=want_m2)
+    from .fourier import rfft_mm
+
+    nchan, nbin = port.shape[-2], port.shape[-1]
+    cb = _block_size(nchan, _BLOCK_TARGET if block is None else block)
+    nblk = -(-nchan // cb)
+    pad = nblk * cb - nchan
+
+    def tile(x, width):
+        if pad:
+            x = jnp.concatenate(
+                [x, jnp.zeros((pad, width), x.dtype)], axis=0)
+        return x.reshape(nblk, cb, width)
+
+    pb = tile(port, nbin)
+    mb = tile(model, nbin)
+    wb = tile(w, nharm)
+
+    def step(carry, xs):
+        p, m, wk = xs
+        drb, dib = rfft_mm(p, precision=precision, nharm=nharm,
+                           fold=fold)
+        mrb, mib = rfft_mm(m, precision=precision, nharm=nharm,
+                           fold=fold)
+        Xrb = (drb * mrb + dib * mib) * wk
+        Xib = (dib * mrb - drb * mib) * wk
+        m2b = (mrb**2 + mib**2) * wk
+        out2 = m2b if want_m2 else jnp.sum(m2b, axis=-1)
+        return carry, (Xrb, Xib, out2)
+
+    _, (Xr, Xi, o2) = jax.lax.scan(step, 0, (pb, mb, wb))
+    Xr = Xr.reshape(nblk * cb, nharm)[:nchan]
+    Xi = Xi.reshape(nblk * cb, nharm)[:nchan]
+    o2 = (o2.reshape(nblk * cb, nharm)[:nchan] if want_m2
+          else o2.reshape(nblk * cb)[:nchan])
+    return Xr, Xi, o2
+
+
+def fused_cross_spectrum_pallas(port, model, w, nharm, precision=None,
+                                fold=None, want_m2=False):
+    """Pallas kernel variant — STUB, pre-scoped for the next chip
+    session (BENCHMARKS.md config 6/2): one VMEM-resident kernel per
+    channel tile computing DFT matmul + cross-spectrum + the first
+    moment pass without touching HBM between stages, the step the
+    hand-blocked XLA program cannot express (XLA will not fuse a dot
+    into its consumers).  Guarded by HAVE_PALLAS_FUSED so nothing
+    dispatches here until the kernel exists."""
+    raise NotImplementedError(
+        "the Pallas fused cross-spectrum kernel is pre-scoped for the "
+        "next chip session (HAVE_PALLAS_FUSED is False); "
+        "fused_cross_spectrum runs the hand-blocked XLA program on "
+        "every backend today")

@@ -12,6 +12,7 @@ pptoaslib.py:22-58 (gaussian_profile_FT), pptoaslib.py:124-192
 
 import math
 
+import jax
 import jax.numpy as jnp
 
 from .phasor import cexp
@@ -64,6 +65,44 @@ def gaussian_profile_FT(nharm, loc, wid, amp=1.0):
         * jnp.exp(-2.0 * (jnp.pi * k * sigma) ** 2.0)
     )
     return mag * cexp(-2.0 * jnp.pi * k * loc)
+
+
+def gaussian_profile_FT_jac(nharm, loc, wid, amp):
+    """Analytic (G, dG/dloc, dG/dwid, dG/damp) of gaussian_profile_FT
+    — the closed-form Jacobian block the LM template engine uses
+    instead of autodiff (ISSUE 14; the reference's analytic-gradient
+    heritage, SURVEY §L3).  Broadcasts like gaussian_profile_FT (pass
+    loc/wid/amp with a trailing singleton axis for per-component
+    stacks).
+
+    With U(k) = nbin sqrt(2 pi) exp(-2 (pi k sigma)^2) e^{-2 pi i k loc}
+    (the amp- and sigma-stripped kernel) and sigma = |wid| * FWHM2SIGMA:
+
+        G        = amp * sigma * U
+        dG/dloc  = G * (-2 pi i k)
+        dG/dwid  = amp * U * (1 - (2 pi k sigma)^2)
+                   * FWHM2SIGMA * sign(wid)
+        dG/damp  = sigma * U
+
+    The dwid form multiplies through by sigma (never divides), so a
+    zero-width (or zero-amplitude padded) component yields exact
+    finite zeros instead of inf*0 — the batched engine's frozen pads
+    stay poison-free.  sign(wid) follows autodiff's |.|' convention
+    (+1 at exactly 0) so the 'ad' digit-oracle lane agrees there too.
+    """
+    nbin = 2 * (nharm - 1)
+    k = jnp.arange(nharm, dtype=jnp.result_type(loc, jnp.float32))
+    sigma = jnp.abs(wid) * FWHM2SIGMA
+    mag = nbin * jnp.sqrt(2.0 * jnp.pi) * jnp.exp(
+        -2.0 * (jnp.pi * k * sigma) ** 2.0)
+    U = mag * cexp(-2.0 * jnp.pi * k * loc)
+    G = amp * sigma * U
+    two_pi_k = 2.0 * jnp.pi * k
+    dloc = G * jax.lax.complex(jnp.zeros_like(two_pi_k), -two_pi_k)
+    dwid = (amp * U * (1.0 - (two_pi_k * sigma) ** 2.0)
+            * FWHM2SIGMA * jnp.where(wid >= 0.0, 1.0, -1.0))
+    damp = sigma * U
+    return G, dloc, dwid, damp
 
 
 def instrumental_response_FT(width, nharm, kind="rect"):

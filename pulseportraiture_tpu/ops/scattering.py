@@ -41,6 +41,28 @@ def scattering_profile_FT(tau, nharm):
     return 1.0 / jax.lax.complex(jnp.ones_like(t), t)
 
 
+def scattering_profile_FT_dtau(tau, nharm):
+    """Analytic dH/dtau of scattering_profile_FT:
+    H = 1/(1 + 2 pi i k tau) => dH/dtau = -2 pi i k H^2 — the
+    closed-form companion the LM template engine's analytic Jacobian
+    uses (ISSUE 14; the reference's hand-derived chain,
+    pptoaslib.py:266-418, restored as an op instead of jax.grad)."""
+    k = jnp.arange(nharm, dtype=jnp.result_type(tau, jnp.float32))
+    H = scattering_profile_FT(tau, nharm)
+    two_pi_k = 2.0 * jnp.pi * k
+    return jax.lax.complex(jnp.zeros_like(two_pi_k), -two_pi_k) * H * H
+
+
+def scattering_portrait_FT_dtau(taus, nharm):
+    """Per-channel dH/dtau_n of scattering_portrait_FT; taus
+    (..., nchan) -> (..., nchan, nharm) complex (same broadcast shape
+    as the forward op)."""
+    k = jnp.arange(nharm, dtype=jnp.result_type(taus, jnp.float32))
+    H = scattering_portrait_FT(taus, nharm)
+    two_pi_k = 2.0 * jnp.pi * k
+    return jax.lax.complex(jnp.zeros_like(two_pi_k), -two_pi_k) * H * H
+
+
 def scattering_portrait_FT(taus, nharm):
     """Per-channel scattering kernels; taus (..., nchan) in rotations ->
     (..., nchan, nharm) complex.

@@ -51,7 +51,7 @@ from ..fit.gauss import (fit_gaussian_portraits_batched,
                          portrait_vary, profile_trial_seeds,
                          profile_vary, select_best_trial,
                          use_gauss_device)
-from ..fit.lm import _pow2ceil
+from ..fit.lm import _pow2ceil, use_lm_jacobian
 from ..io.gmodel import write_gmodel
 from ..io.psrfits import noise_std_ps
 from ..telemetry import log, resolve_tracer
@@ -119,6 +119,14 @@ class TemplateJob:
         return len(self.dp.ok_ichans)
 
 
+def _resolved_jac_mode():
+    """The Jacobian source the factory's dispatches actually use:
+    every gauss residual ships its analytic companion, so 'auto'
+    resolves to 'analytic' and only an explicit 'ad' keeps autodiff —
+    carried on every template_fit event so a trace names its lane."""
+    return "ad" if use_lm_jacobian() == "ad" else "analytic"
+
+
 def _profile_bucket_key(nbin, ngauss):
     return (int(nbin), _pow2ceil(ngauss))
 
@@ -167,7 +175,8 @@ def _dispatch_profiles(bucket_key, rows, batched, max_iter, tracer):
         tracer.emit("template_fit", stage="profile",
                     bucket=f"prof:{nbin}b:{gclass}g", rows=B,
                     pad=B_pad - B, nfev_max=int(out["nfev"].max()),
-                    wall_s=round(wall, 6), batched=bool(batched))
+                    wall_s=round(wall, 6), batched=bool(batched),
+                    jac=_resolved_jac_mode())
     return out, wall
 
 
@@ -200,7 +209,8 @@ def _dispatch_portraits(bucket_key, rows, batched, max_iter, tracer):
         tracer.emit("template_fit", stage="portrait",
                     bucket=f"port:{cclass}c:{nbin}b:{gclass}g", rows=B,
                     pad=B_pad - B, nfev_max=int(out["nfev"].max()),
-                    wall_s=round(wall, 6), batched=bool(batched))
+                    wall_s=round(wall, 6), batched=bool(batched),
+                    jac=_resolved_jac_mode())
     return out, wall
 
 

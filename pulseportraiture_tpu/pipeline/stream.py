@@ -1081,6 +1081,7 @@ def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
     derotation phasor is then the identity and the trig pass over the
     cross-spectrum is skipped — same packed output to the bit, one
     fewer moment-sized pass per subint."""
+    from ..fit.portrait import use_fit_fused
     from ..ops.fourier import use_dft_fold
 
     scat_engine = (flags[3] or flags[4] or log10_tau
@@ -1092,14 +1093,19 @@ def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
     if not use_fast:
         nharm_eff = None  # the complex engine is never band-limited
         seed_derotate = True  # only the fast lanes thread the knob
-    # dft_fold resolves HERE and rides the cache key (like x_bf16 /
-    # seed_derotate): an in-process config flip must retrace, not
-    # silently reuse the other arm's program
+    # dft_fold and fit_fused resolve HERE and ride the cache key (like
+    # x_bf16 / seed_derotate): an in-process config flip must retrace,
+    # not silently reuse the other arm's program.  fit_fused is
+    # normalized onto False wherever it is a no-op (complex engine, no
+    # harmonic window) so a dead knob never keys a second bit-identical
+    # program.
+    fit_fused = (use_fit_fused() and use_fast
+                 and nharm_eff is not None)
     return _raw_fit_fn_cached(
         nchan, nbin, flags, max_iter, log10_tau, tau_mode, use_fast,
         ftname, x_bf16, redisp, want_flux, use_ir, compensated,
         nharm_eff, seed_derotate, use_dft_fold(), raw_code, pol_sum,
-        zap_nstd)
+        zap_nstd, fit_fused)
 
 
 @lru_cache(maxsize=None)
@@ -1108,7 +1114,8 @@ def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
                        redisp=False, want_flux=False, use_ir=False,
                        compensated=False, nharm_eff=None,
                        seed_derotate=True, dft_fold=None,
-                       raw_code="i16", pol_sum=False, zap_nstd=None):
+                       raw_code="i16", pol_sum=False, zap_nstd=None,
+                       fit_fused=False):
     """ONE jitted program for a raw bucket: sample decode (scl/offs
     affine per raw_code — ops/decode; pol_sum reduces two-pol payloads
     to Stokes I), min-window baseline subtraction, power-spectrum noise, S/N,
@@ -1181,7 +1188,8 @@ def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
                                  None, None, 0, 0,
                                  seed_derotate=seed_derotate,
                                  x_bf16=x_bf16, nharm_eff=nharm_eff,
-                                 dft_fold=dft_fold)
+                                 dft_fold=dft_fold,
+                                 fit_fused=fit_fused)
             r = fit(x, modelx, noise, cmask, freqs, Ps, nu_fit,
                     nu_out_arr, theta0)
         elif use_fast:
@@ -1197,7 +1205,7 @@ def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
                 log10_tau=log10_tau, max_iter=max_iter,
                 compensated=compensated, x_bf16=x_bf16,
                 nharm_eff=nharm_eff, seed_derotate=seed_derotate,
-                dft_fold=dft_fold)
+                dft_fold=dft_fold, fit_fused=fit_fused)
             r = jax.vmap(one, in_axes=(0, None, 0, 0, None, 0, 0, 0, 0,
                                        None, None))(
                 x, modelx, noise, cmask, freqs, Ps, nu_fit,

@@ -67,7 +67,7 @@ CONFIG_SNAPSHOT_KEYS = (
     "router_probe_ms", "router_hedge_ms", "router_fleet_file",
     "serve_tenant_quota", "serve_tenant_weight",
     "use_fast_fit", "use_matmul_dft", "fit_harmonic_window",
-    "scatter_compensated",
+    "scatter_compensated", "lm_jacobian", "fit_fused",
 )
 
 # The event vocabulary: type -> fields REQUIRED beyond (type, t).
@@ -149,10 +149,11 @@ EVENT_FIELDS = {
     # template_fit per bucket dispatch — stage 'profile'|'portrait',
     # the bucket's shape key, rows (real problems), pad (padded rows:
     # B rounded to its power-of-two class + frozen pad components),
-    # worst per-problem nfev, wall seconds, and whether the batched
-    # lane ran (False = host-serial oracle)
+    # worst per-problem nfev, wall seconds, whether the batched
+    # lane ran (False = host-serial oracle), and the Jacobian source
+    # the dispatch resolved ('analytic' | 'ad' — the ISSUE 14 A/B axis)
     "template_fit": {"stage", "bucket", "rows", "pad", "nfev_max",
-                     "wall_s", "batched"},
+                     "wall_s", "batched", "jac"},
     # one per finished template job (pulsar)
     "template_job": {"datafile", "kind", "ngauss", "converged",
                      "iters"},

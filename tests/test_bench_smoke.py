@@ -94,7 +94,7 @@ TINY_ENV = {
 
 _CONFIG_KEYS = ("dft_precision", "cross_spectrum_dtype", "dft_fold",
                 "scatter_compensated", "fit_harmonic_window",
-                "telemetry_path")
+                "telemetry_path", "fit_fused", "lm_jacobian")
 
 
 def test_all_bench_scripts_covered():
@@ -247,8 +247,9 @@ def test_bench_smoke(name, monkeypatch, capsys, tmp_path):
         # ISSUE 9: both A/B arms must report, the in-memory oracle
         # digit gate must HOLD even at tiny shapes (engine drift fails
         # here, in CI), and the one-iteration LM attribution must
-        # carry all four stages (the >= 3x and >= 0.9 gates belong to
-        # real bench runs at the config-6 shape, not 2-pulsar smoke)
+        # carry all four stages for BOTH Jacobian lanes (ISSUE 14; the
+        # >= 3x, >= 1.5x and >= 0.9 gates belong to real bench runs at
+        # the config-6 shape, not 2-pulsar smoke)
         assert out["digit_ok"] is True
         assert out["gmodel_max_delta"] <= out["digit_gate"]
         assert out["production_wall_s"] > 0
@@ -257,10 +258,21 @@ def test_bench_smoke(name, monkeypatch, capsys, tmp_path):
         assert out["ab_speedup_vs_oracle_warm"] > 0
         assert out["gmodel_max_delta_vs_production"] <= 1e-6
         assert out["n_production_select_mismatch"] == 0
-        for stage in ("resid", "jacobian", "solve", "select"):
-            assert f"stage_{stage}_ms" in out, stage
-        assert out["attributed_frac"] > 0
-        assert out["dominant_stage"]
+        for lane in ("ad", "analytic"):
+            for stage in ("resid", "jacobian", "solve", "select"):
+                assert f"{lane}_stage_{stage}_ms" in out, (lane, stage)
+            assert out[f"{lane}_attributed_frac"] > 0
+        assert out["dominant_stage_ad"]
+        assert out["dominant_stage_analytic"]
+        # ISSUE 14 digit gates, enforced in CI at tiny shapes: the
+        # analytic-vs-jacfwd Jacobian on the real bucket problem, and
+        # zero component-count selection flips between the lanes
+        assert out["jac_digit_ok"] is True
+        assert out["jac_rel_delta"] <= 1e-10
+        assert out["jac_selection_flips_ok"] is True
+        assert out["n_jac_selection_flips"] == 0
+        assert out["iter_speedup_analytic_vs_ad"] > 0
+        assert out["ab_speedup_analytic_vs_ad"] > 0
     if name == "bench_gls":
         # ISSUE 11: the serial arm pays one dispatch per pulsar, the
         # batched arm one per pow2 bucket — the reduction is the
@@ -310,3 +322,36 @@ def test_bench_smoke(name, monkeypatch, capsys, tmp_path):
             for ev in h2d_done:
                 assert ev["bytes"] > 0 and ev["h2d_s"] >= 0
                 assert isinstance(ev["overlap"], bool)
+
+
+def test_bench_root_fused_arm(monkeypatch, capsys):
+    """ISSUE 14: the headline fit bench (repo-root bench.py) carries a
+    fused-vs-unfused A/B whose bitwise gate is ENFORCED in-bench
+    (SystemExit on drift) — run it at a tiny windowed shape so fusion
+    drift fails in CI.  config.fit_fused flips inside the bench; the
+    knob is restored by the bench itself."""
+    import importlib.util
+
+    monkeypatch.setenv("PPT_NB", "8")
+    monkeypatch.setenv("PPT_NCHAN", "8")
+    monkeypatch.setenv("PPT_NBIN", "1024")
+    saved = {k: getattr(config, k) for k in _CONFIG_KEYS}
+    spec = importlib.util.spec_from_file_location(
+        "bench_root", os.path.join(BENCH_DIR, "..", "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    try:
+        mod.main()
+    finally:
+        for k, v in saved.items():
+            setattr(config, k, v)
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.strip().startswith("{")]
+    assert lines, "bench.py printed no JSON line"
+    out = json.loads(lines[-1])
+    # the window must be active or the fused arm never ran (the A/B is
+    # windowed-only by design)
+    assert out["harmonic_window"] is not None
+    assert out["fused_identical"] is True
+    assert out["fused_vs_unfused"] > 0
+    assert out["accuracy_gate_1e-4"] is True

@@ -461,6 +461,50 @@ def test_ppfactory_flag_validation(tmp_path):
     empty.write_text("")
     with pytest.raises(SystemExit, match="no archives"):
         ppfactory.main(["-M", str(empty)])
+    # ISSUE 14: the Jacobian-source flag is strict on both CLIs
+    with pytest.raises(SystemExit, match="lm-jacobian"):
+        ppfactory.main(base + ["--lm-jacobian", "symbolic"])
+    with pytest.raises(SystemExit, match="lm-jacobian"):
+        ppgauss.main(["-d", "x.fits", "--lm-jacobian", "numeric"])
+
+
+def test_lm_jacobian_flag_applies_config(tmp_path):
+    """--lm-jacobian sets config.lm_jacobian (the knob every LM fit of
+    the process resolves) before any file IO; the metafile error fires
+    AFTER, proving the parse ran first."""
+    from pulseportraiture_tpu import config
+
+    saved = config.lm_jacobian
+    try:
+        config.lm_jacobian = "auto"
+        with pytest.raises(SystemExit, match="not found"):
+            ppfactory.main(["-M", str(tmp_path / "missing.txt"),
+                            "--lm-jacobian", "ad"])
+        assert config.lm_jacobian == "ad"
+    finally:
+        config.lm_jacobian = saved
+
+
+def test_pptoas_fit_fused_flag_validation(tmp_path):
+    """--fit-fused parses the strict tri-state and applies it to
+    config before any file IO."""
+    from pulseportraiture_tpu import config
+
+    with pytest.raises(SystemExit, match="fit-fused"):
+        pptoas.main(["-d", "x.fits", "-m", "m.gmodel",
+                     "--fit-fused", "sometimes"])
+    saved = config.fit_fused
+    try:
+        config.fit_fused = "auto"
+        with pytest.raises((SystemExit, FileNotFoundError)):
+            # the missing datafile dies later in main — after the
+            # knob applied
+            pptoas.main(["-d", str(tmp_path / "none.fits"),
+                         "-m", str(tmp_path / "none.gmodel"),
+                         "--fit-fused", "on"])
+        assert config.fit_fused is True
+    finally:
+        config.fit_fused = saved
 
 
 def test_ppgauss_gauss_device_and_batch_validation():

@@ -134,3 +134,175 @@ def test_fast_batch_routes_scattering_to_real_lane():
         fit_portrait_batch_fast(
             *args, use_scatter=False,
             ir_FT=np.ones((4, 33), complex))
+
+
+class TestFusedCrossSpectrum:
+    """ISSUE 14 tentpole (b): the hand-blocked fused DFT ->
+    cross-spectrum program (ops/fused.py) — bitwise identity to the
+    unfused stages, routing through prepare, and dead-knob
+    normalization."""
+
+    def _problem(self, nchan=24, nbin=256, seed=9):
+        rng = np.random.default_rng(seed)
+        port = jnp.asarray(rng.standard_normal((nchan, nbin)),
+                           jnp.float32)
+        model = jnp.asarray(rng.standard_normal((nchan, nbin)),
+                            jnp.float32)
+        w = jnp.asarray(rng.random((nchan, nbin // 2 + 1)) + 0.5,
+                        jnp.float32)
+        return port, model, w
+
+    def test_block_size_invariance(self):
+        """Channel blocking never changes a row's result: every block
+        size — including non-divisor targets, where the channel axis
+        is zero-padded up to a block multiple — produces
+        bitwise-identical outputs.  (A 1-row block is excluded by
+        design: it would lower to a gemv whose contraction order
+        differs from the gemm rows — the reason ragged counts pad
+        instead of degrading the block.)"""
+        from pulseportraiture_tpu.ops.fused import fused_cross_spectrum
+
+        port, model, w = self._problem()
+        K = 64
+        wk = w[:, :K]
+        ref = None
+        for block in (None, 24, 8, 7, 5):
+            out = jax.jit(
+                lambda p, m, w, b=block: fused_cross_spectrum(
+                    p, m, w, K, fold=False, want_m2=True, block=b))(
+                port, model, wk)
+            out = tuple(np.asarray(o) for o in out)
+            if ref is None:
+                ref = out
+                continue
+            for x, y in zip(ref, out):
+                assert np.array_equal(x, y), block
+
+    def test_prepare_fused_vs_unfused_bitwise(self):
+        """The real contract: prepare_portrait_fit_real and
+        prepare_scatter_fit_real produce BITWISE-identical outputs
+        fused vs unfused (both compiled — the only context the lanes
+        ever run in; XLA's f32 FMA contraction makes an eager
+        stage-by-stage reference a different program, not a valid
+        oracle)."""
+        from pulseportraiture_tpu.fit.portrait import (
+            FitFlags, make_weights, prepare_portrait_fit_real,
+            prepare_scatter_fit_real)
+
+        port, model, _ = self._problem()
+        K = 64
+        nchan = port.shape[0]
+        freqs = jnp.asarray(np.linspace(1300.0, 1900.0, nchan),
+                            jnp.float32)
+        w = make_weights(jnp.full(nchan, 0.1, jnp.float32),
+                         port.shape[1])
+        th0 = jnp.zeros(5, jnp.float32)
+
+        def prep(fused):
+            return jax.jit(
+                lambda p, m, w, f, t: prepare_portrait_fit_real(
+                    p, m, w, f, 0.003, 1500.0, t, nharm_eff=K,
+                    fit_fused=fused))(port, model, w, freqs, th0)
+
+        for x, y in zip(prep(False), prep(True)):
+            assert np.array_equal(np.asarray(x), np.asarray(y))
+
+        stds = jnp.full(nchan, 0.1, jnp.float32)
+        cmask = jnp.ones(nchan, jnp.float32)
+        th0s = jnp.asarray([0.0, 0.0, 0.0, -3.0, -4.0], jnp.float32)
+        flags = FitFlags(True, True, False, True, False)
+
+        def prep_sc(fused):
+            return jax.jit(
+                lambda p, m, s, c, f, t: prepare_scatter_fit_real(
+                    p, m, s, c, f, 0.003, 1500.0, t, fit_flags=flags,
+                    log10_tau=True, nharm_eff=K, fit_fused=fused))(
+                port, model, stds, cmask, freqs, th0s)
+
+        for x, y in zip(prep_sc(False), prep_sc(True)):
+            assert np.array_equal(
+                np.asarray(x.astype(jnp.float32)),
+                np.asarray(y.astype(jnp.float32)))
+
+    def test_bitwise_under_jit_and_vmap(self):
+        from pulseportraiture_tpu.ops.fourier import rfft_mm
+        from pulseportraiture_tpu.ops.fused import fused_cross_spectrum
+
+        port, model, w = self._problem()
+        K = 64
+        wk = w[:, :K]
+        ports = jnp.stack([port, port * 0.5 + 1.0])
+
+        @jax.jit
+        def unfused(p):
+            dr, di = rfft_mm(p, nharm=K, fold=False)
+            mr, mi = rfft_mm(model, nharm=K, fold=False)
+            return ((dr * mr + di * mi) * wk,
+                    (di * mr - dr * mi) * wk)
+
+        @jax.jit
+        def fused(p):
+            Xr, Xi, _ = fused_cross_spectrum(p, model, wk, K,
+                                             fold=False)
+            return Xr, Xi
+
+        a = jax.vmap(unfused)(ports)
+        b = jax.vmap(fused)(ports)
+        for x, y in zip(a, b):
+            assert np.array_equal(np.asarray(x), np.asarray(y))
+
+    def test_prepare_routes_through_fused(self, monkeypatch):
+        """prepare_portrait_fit_real takes the fused path exactly when
+        fit_fused resolves on AND a harmonic window is active (the
+        dead-knob normalization)."""
+        import pulseportraiture_tpu.ops.fused as fused_mod
+        from pulseportraiture_tpu.fit.portrait import (
+            make_weights, prepare_portrait_fit_real)
+
+        port, model, _ = self._problem()
+        freqs24 = jnp.asarray(
+            np.linspace(1300.0, 1900.0, port.shape[0]), jnp.float32)
+        w = make_weights(jnp.full(port.shape[0], 0.1, jnp.float32),
+                         port.shape[1])
+        th0 = jnp.zeros(5, jnp.float32)
+        calls = []
+        orig = fused_mod.fused_cross_spectrum
+
+        def spy(*a, **k):
+            calls.append(1)
+            return orig(*a, **k)
+
+        monkeypatch.setattr(fused_mod, "fused_cross_spectrum", spy)
+        prepare_portrait_fit_real(port, model, w, freqs24, 0.003,
+                                  1500.0, th0, nharm_eff=64,
+                                  fit_fused=True)
+        assert calls  # fused path taken
+        calls.clear()
+        # no window -> normalized onto the unfused program
+        prepare_portrait_fit_real(port, model, w, freqs24, 0.003,
+                                  1500.0, th0, nharm_eff=None,
+                                  fit_fused=True)
+        assert not calls
+        # knob off -> unfused even with the window
+        prepare_portrait_fit_real(port, model, w, freqs24, 0.003,
+                                  1500.0, th0, nharm_eff=64,
+                                  fit_fused=False)
+        assert not calls
+
+    def test_use_fit_fused_strict(self):
+        from pulseportraiture_tpu.fit.portrait import use_fit_fused
+
+        assert use_fit_fused(True) is True
+        assert use_fit_fused(False) is False
+        assert use_fit_fused("auto") in (True, False)
+        with pytest.raises(ValueError, match="fit_fused"):
+            use_fit_fused("sometimes")
+
+    def test_pallas_stub_is_loud(self):
+        from pulseportraiture_tpu.ops import fused
+
+        assert fused.HAVE_PALLAS_FUSED is False
+        port, model, w = self._problem(nchan=4, nbin=32)
+        with pytest.raises(NotImplementedError, match="chip session"):
+            fused.fused_cross_spectrum_pallas(port, model,
+                                              w[:, :8], 8)

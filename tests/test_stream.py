@@ -752,3 +752,26 @@ def test_stream_bf16_guard_estimate_tracks_exact_channel_snr(campaign):
         assert exact_max <= 4.0 * est, (est, exact_max)
         checked += 1
     assert checked == len(res.TOA_list) > 0
+
+
+def test_stream_fused_tim_byte_identical(campaign, tmp_path,
+                                         monkeypatch):
+    """ISSUE 14: the fused hand-blocked DFT->cross-spectrum program
+    (config.fit_fused) is BYTE-identical to the unfused one on both
+    payload lanes — raw buckets and the decoded/tscrunch lane — with
+    the harmonic window forced on (fusion is windowed-only; without a
+    window the knob normalizes onto the unfused program)."""
+    from pulseportraiture_tpu import config
+
+    files, gmodel = campaign
+    monkeypatch.setattr(config, "fit_harmonic_window", 128)
+    for lane, kw in (("raw", {}), ("dec", {"tscrunch": True})):
+        tims = {}
+        for fused in (False, True):
+            monkeypatch.setattr(config, "fit_fused", fused)
+            tim = tmp_path / f"{lane}_fused{int(fused)}.tim"
+            stream_wideband_TOAs(files, gmodel, nsub_batch=8,
+                                 tim_out=str(tim), quiet=True, **kw)
+            tims[fused] = tim.read_bytes()
+        assert tims[False] == tims[True], lane
+        assert len(tims[False]) > 0
