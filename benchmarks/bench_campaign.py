@@ -1,10 +1,24 @@
 """Campaign-scale streaming benchmark (BASELINE.md config 5 shape):
 NARCH archives x NSUB subints of NCHAN x NBIN through
 stream_wideband_TOAs, end-to-end (PSRFITS IO -> raw h2d -> on-device
-decode/stats/fit -> .tim assembly) — now an A/B over the transfer
+decode/stats/fit -> .tim assembly) — an A/B over the transfer
 pipeline (ISSUE 6): depth 1 (copy serialized against fit-enqueue, the
 pre-pipeline behavior) vs depth N (double-buffered h2d, default 2 or
 PPT_PIPELINE_DEPTH), asserting byte-identical .tim output across arms.
+
+ISSUE 15 adds the bytes-on-the-wire ladder: a SUB-BYTE arm (a 2-bit
+NBIT corpus of the same synthetic data, streamed packed-raw vs its
+decoded-f64 fallback via the PPT_RAW_SUBBYTE escape hatch — byte
+accounting per arm, digit gate on the .tim, and the >= 8x
+byte-reduction acceptance gate enforced IN-BENCH every run) and a
+COMPRESSION arm (a coarsely-quantized byte corpus streamed with
+config.transport_compress off / on / auto — 'on' must shrink shipped
+bytes at identical .tim; 'auto' must never engage when the cost model
+predicts a loss, which on a bare-CPU link is always).  Under
+PPT_TUNNEL_EMU, where bytes are proportional to wall, the sub-byte
+arm's throughput gain tracks its byte reduction — that is the
+production claim; bare-CPU runs report the byte ratios with an honest
+~1x wall.
 
 When PPT_TELEMETRY is set, each arm writes its own trace
 (<path>.d<depth>) and the emitted h2d_start/h2d_done events are
@@ -162,6 +176,125 @@ def main():
         "pipeline depth changed .tim content — the transfer pipeline "
         "must only reorder WHEN bytes move")
 
+    # ---- ISSUE 15 arm 1: sub-byte (2-bit) corpus, packed-raw vs the
+    # decoded-f64 fallback — byte accounting + digit gate + the >= 8x
+    # acceptance gate, all enforced here at every shape
+    sub_root = os.path.join(root, "nbit2")
+    os.makedirs(sub_root, exist_ok=True)
+    sub_files = []
+    for i in range(NARCH):
+        path = os.path.join(sub_root, f"s{i:04d}.fits")
+        if not os.path.exists(path):
+            make_fake_pulsar(mpath, PAR, outfile=path, nsub=NSUB,
+                             nchan=NCHAN, nbin=NBIN, nu0=1500.0,
+                             bw=600.0, phase=0.01 * (i % 50),
+                             dDM=1e-4 * (i % 40), noise_stds=0.05,
+                             quiet=True, rng=i, nbit=2)
+        sub_files.append(path)
+    unpatch2 = []
+    if TUNNEL:
+        jax.device_put = throttled_put
+        S._raw_fit_fn = sync_fit_fn
+        unpatch2 = unpatch
+    try:
+        t0 = time.perf_counter()
+        tim_p = os.path.join(sub_root, "packed.tim")
+        res_p = stream_wideband_TOAs(sub_files, mpath, nsub_batch=64,
+                                     quiet=True, tim_out=tim_p)
+        wall_p = time.perf_counter() - t0
+        config.raw_subbyte = False
+        t0 = time.perf_counter()
+        tim_f = os.path.join(sub_root, "fallback.tim")
+        res_f = stream_wideband_TOAs(sub_files, mpath, nsub_batch=64,
+                                     quiet=True, tim_out=tim_f)
+        wall_f = time.perf_counter() - t0
+        config.raw_subbyte = True
+    finally:
+        config.raw_subbyte = True
+        for obj, name, val in unpatch2:
+            setattr(obj, name, val)
+    subbyte_ratio = res_f.h2d_bytes / max(res_p.h2d_bytes, 1)
+    assert open(tim_p).read() == open(tim_f).read(), (
+        "sub-byte packed lane drifted from the decoded-f64 oracle")
+    assert subbyte_ratio >= 8.0, (
+        f"2-bit corpus shipped only {subbyte_ratio:.2f}x fewer bytes "
+        "than the decoded fallback (acceptance gate: >= 8x)")
+    subbyte = {
+        "packed_bytes": int(res_p.h2d_bytes),
+        "fallback_bytes": int(res_f.h2d_bytes),
+        "byte_ratio": round(subbyte_ratio, 2),
+        "packed_toas_per_sec": round(len(res_p.TOA_list) / wall_p, 2),
+        "fallback_toas_per_sec": round(len(res_f.TOA_list) / wall_f,
+                                       2),
+        "speedup": round(wall_f / max(wall_p, 1e-9), 3),
+        "tim_identical": True,
+    }
+
+    # ---- ISSUE 15 arm 2: transport compression on a coarsely-
+    # quantized byte corpus — off / on / auto ladder with the digit
+    # gate and the never-engages-at-a-loss gate enforced here
+    cmp_root = os.path.join(root, "q4")
+    os.makedirs(cmp_root, exist_ok=True)
+    cmp_files = []
+    for i in range(NARCH):
+        path = os.path.join(cmp_root, f"q{i:04d}.fits")
+        if not os.path.exists(path):
+            make_fake_pulsar(mpath, PAR, outfile=path, nsub=NSUB,
+                             nchan=NCHAN, nbin=NBIN, nu0=1500.0,
+                             bw=600.0, phase=0.01 * (i % 50),
+                             dDM=1e-4 * (i % 40), noise_stds=0.05,
+                             quiet=True, rng=i, nbit=8, levels=4)
+        cmp_files.append(path)
+    unpatch3 = []
+    if TUNNEL:
+        jax.device_put = throttled_put
+        S._raw_fit_fn = sync_fit_fn
+        unpatch3 = unpatch
+    comp = {}
+    comp_tims = {}
+    try:
+        for mode in (False, True, "auto"):
+            config.transport_compress = mode
+            tim = os.path.join(cmp_root, f"c_{mode}.tim")
+            t0 = time.perf_counter()
+            r = stream_wideband_TOAs(cmp_files, mpath, nsub_batch=64,
+                                     quiet=True, tim_out=tim)
+            wall = time.perf_counter() - t0
+            comp[str(mode)] = {
+                "h2d_bytes": int(r.h2d_bytes),
+                "h2d_bytes_logical": int(r.h2d_bytes_logical),
+                "codec_s": round(float(r.codec_duration), 3),
+                "toas_per_sec": round(len(r.TOA_list) / wall, 2),
+            }
+            comp_tims[str(mode)] = open(tim).read()
+    finally:
+        config.transport_compress = False
+        for obj, name, val in unpatch3:
+            setattr(obj, name, val)
+    assert comp_tims["False"] == comp_tims["True"] \
+        == comp_tims["auto"], (
+        "transport compression changed .tim content — the codec must "
+        "be lossless before any arithmetic the fit sees")
+    assert comp["True"]["h2d_bytes"] < comp["False"]["h2d_bytes"], (
+        "transport_compress=on did not shrink shipped bytes on the "
+        "4-level corpus")
+    if not TUNNEL:
+        # bare CPU: the cost model must never engage (memcpy-class
+        # link -> predicted loss) — the acceptance gate
+        assert comp["auto"]["h2d_bytes"] \
+            == comp["auto"]["h2d_bytes_logical"], (
+            "transport_compress=auto engaged on a bare-CPU link "
+            "(cost model predicted a loss)")
+    compression = {
+        **{k: v for k, v in comp.items()},
+        "compress_ratio_on": round(
+            comp["True"]["h2d_bytes_logical"]
+            / max(comp["True"]["h2d_bytes"], 1), 2),
+        "auto_engaged": comp["auto"]["h2d_bytes"]
+        != comp["auto"]["h2d_bytes_logical"],
+        "tim_identical": True,
+    }
+
     print(json.dumps({
         "metric": f"streamed campaign TOAs incl. PSRFITS IO, {NARCH} "
                   f"archives x {NSUB}sub x {NCHAN}ch x {NBIN}bin, "
@@ -176,6 +309,8 @@ def main():
             arms[DEEP]["toas_per_sec"]
             / max(arms[1]["toas_per_sec"], 1e-9), 3),
         "tim_identical": True,
+        "subbyte": subbyte,
+        "compression": compression,
         "tunnel_emu": TUNNEL or None,
         "device": str(jax.devices()[0]),
     }))

@@ -99,6 +99,15 @@ def build_parser():
     p.add_argument("--timeout", type=float, default=None, metavar="S",
                    help="Per-request result timeout in seconds. "
                         "[default: none]")
+    p.add_argument("--transport-compress", dest="transport_compress",
+                   default=None, metavar="off|auto|on",
+                   help="zlib-compress large socket frames to the "
+                        "fleet (the no-shared-fs result payloads are "
+                        "the big ones): 'off', 'auto' (size/saving "
+                        "rule), 'on'.  Peers decode transparently; "
+                        "payload content is byte-identical.  Also via "
+                        "PPT_TRANSPORT_COMPRESS / "
+                        "config.transport_compress. [default: off]")
     p.add_argument("--telemetry", metavar="trace.jsonl", default=None,
                    help="Write the routing trace (route_submit/"
                         "route_retry/route_done) here; analyze with "
@@ -121,6 +130,14 @@ def main(argv=None):
                          f"{args.hedge_ms}")
     from .. import config
 
+    if args.transport_compress is not None:
+        table = {"off": False, "auto": "auto", "on": True}
+        v = str(args.transport_compress).lower()
+        if v not in table:
+            raise SystemExit("pproute: --transport-compress expected "
+                             "one of off/auto/on, got "
+                             f"{args.transport_compress!r}")
+        config.transport_compress = table[v]
     if args.hosts is not None and args.fleet_file is not None:
         raise SystemExit("pproute: --hosts and --fleet-file are "
                          "mutually exclusive (static list vs watched "

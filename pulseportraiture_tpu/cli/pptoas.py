@@ -100,6 +100,18 @@ def build_parser():
                         "(TPU backends), 'on'.  Also via "
                         "PPT_FIT_FUSED / config.fit_fused. [default: "
                         "config.fit_fused]")
+    p.add_argument("--transport-compress", dest="transport_compress",
+                   default=None, metavar="off|auto|on",
+                   help="With --stream: lossless transport codec for "
+                        "the h2d copy stage (io/blockcodec width "
+                        "reduction, decoded on device inside the "
+                        "fused program): 'off', 'auto' (a cost model "
+                        "fed from live h2d MB/s telemetry engages it "
+                        "only when predicted to win), 'on' (always "
+                        "when compressible — the A/B arm).  .tim "
+                        "output is digit-identical either way.  Also "
+                        "via PPT_TRANSPORT_COMPRESS / "
+                        "config.transport_compress. [default: off]")
     p.add_argument("--compile-cache", dest="compile_cache",
                    default=None, metavar="DIR",
                    help="Persistent jax compilation cache directory: "
@@ -213,6 +225,20 @@ def main(argv=None):
         if args.pipeline_depth < 1:
             raise SystemExit("--pipeline-depth: depth must be >= 1, "
                              f"got {args.pipeline_depth}")
+    if args.transport_compress is not None:
+        if not args.stream:
+            raise SystemExit("--transport-compress requires --stream "
+                             "(the codec rides the streaming copy "
+                             "stage)")
+        table = {"off": False, "auto": "auto", "on": True}
+        v = str(args.transport_compress).lower()
+        if v not in table:
+            raise SystemExit("--transport-compress expected one of "
+                             "off/auto/on, got "
+                             f"{args.transport_compress!r}")
+        from .. import config
+
+        config.transport_compress = table[v]
     if args.fit_fused is not None:
         table = {"off": False, "auto": "auto", "on": True}
         v = str(args.fit_fused).lower()

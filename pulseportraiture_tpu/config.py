@@ -340,6 +340,36 @@ serve_tenant_quota = None
 # Set via PPT_SERVE_TENANT_WEIGHT="interactive:4,bulk:1" or 'off'.
 serve_tenant_weight = None
 
+# --- The link war (ISSUE 15): sub-byte raw transport + compression --------
+# Raw-transport sub-byte NBIT lane: 1/2/4-bit packed DATA columns ship
+# their PACKED bytes to the accelerator (raw codes 'p1'/'p2'/'p4') and
+# the fused bucket program unpacks the bit planes with integer
+# shifts/masks on device — a 2-bit archive ships 32x fewer bytes than
+# the decoded-float64 fallback on the link that bottlenecks campaigns.
+# False is the escape hatch: read_archive(decode=False) refuses
+# sub-byte layouts again and the streaming loaders fall back to the
+# host-decoded lane per archive (the digit oracle arm).
+raw_subbyte = True
+
+# Compressed transport for the streaming copy stage and the serve
+# socket frames.  The h2d lane uses the lossless width-reduction block
+# codec (io/blockcodec.py): integer raw payloads whose per-dispatch
+# dynamic range fits a narrower bit width ship bit-plane packed (the
+# device decode is the same unpack op the sub-byte lane uses, inside
+# the fused program); the socket lane compresses large frames with
+# zlib.  Tri-state:
+#   False (default): never compress — bit-stable byte accounting.
+#   'auto': a COST MODEL decides per dispatch, fed from the live
+#          h2d_start/h2d_done MB/s telemetry — compress only when the
+#          predicted codec wall is below the predicted link savings,
+#          so a fast local link (bare CPU memcpy) never pays the codec
+#          and a tunneled link engages it automatically.
+#   True:  always compress when the payload is compressible (the
+#          deterministic A/B arm; the cost model is bypassed).
+# .tim output is digit-identical compressed or not — the codec is
+# lossless and the decode runs before any arithmetic the fit sees.
+transport_compress = False
+
 # Bucket-lattice coarsening (ROADMAP item 5): pad bucket channel
 # layouts up to the next power of two with zero-weight channels so a
 # campaign's (or serving fleet's) shape diversity costs log2 as many
@@ -471,6 +501,8 @@ RCSTRINGS = {
 #   PPT_SERVE_LISTEN=<host:port>|off -> serve_listen
 #   PPT_SERVE_TENANT_QUOTA=<N>|t:N,...|off -> serve_tenant_quota
 #   PPT_SERVE_TENANT_WEIGHT=t:W,...|off    -> serve_tenant_weight
+#   PPT_RAW_SUBBYTE=on|off          -> raw_subbyte
+#   PPT_TRANSPORT_COMPRESS=off|auto|on -> transport_compress
 #
 # Unset variables leave the module values untouched; a typo in a
 # KNOWN variable's value raises (strict like the config parsers — a
@@ -498,6 +530,7 @@ KNOWN_PPT_ENV = frozenset({
     "PPT_ROUTER_PROBE_MS", "PPT_ROUTER_HEDGE_MS",
     "PPT_ROUTER_FLEET_FILE", "PPT_SERVE_TENANT_QUOTA",
     "PPT_SERVE_TENANT_WEIGHT",
+    "PPT_RAW_SUBBYTE", "PPT_TRANSPORT_COMPRESS",
     # benchmark / smoke-test shape and mode knobs
     "PPT_NB", "PPT_NE", "PPT_NPSR", "PPT_NARCH", "PPT_NSUB",
     "PPT_NSUBB", "PPT_NCHAN", "PPT_NBIN", "PPT_NITER", "PPT_K",
@@ -905,6 +938,24 @@ def env_overrides():
                 raise ValueError(f"PPT_SERVE_LISTEN: {e}")
             cfg.serve_listen = listen
         changed.append("serve_listen")
+    rsb = _os.environ.get("PPT_RAW_SUBBYTE", "").lower()
+    if rsb:
+        table = {"off": False, "false": False, "on": True, "true": True}
+        if rsb not in table:
+            raise ValueError(
+                f"PPT_RAW_SUBBYTE must be 'on' or 'off', got {rsb!r}")
+        cfg.raw_subbyte = table[rsb]
+        changed.append("raw_subbyte")
+    tcomp = _os.environ.get("PPT_TRANSPORT_COMPRESS", "").lower()
+    if tcomp:
+        table = {"off": False, "false": False, "auto": "auto",
+                 "on": True, "true": True}
+        if tcomp not in table:
+            raise ValueError(
+                "PPT_TRANSPORT_COMPRESS must be 'off', 'auto' or "
+                f"'on', got {tcomp!r}")
+        cfg.transport_compress = table[tcomp]
+        changed.append("transport_compress")
     tel = _os.environ.get("PPT_TELEMETRY", "")
     if tel:
         # 'off'/'none'/'0' disable explicitly (so a wrapper script can

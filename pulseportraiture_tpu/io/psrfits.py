@@ -550,8 +550,8 @@ class Archive:
         arch._par_angs_from_file = self._par_angs_from_file
         return arch
 
-    def unload(self, path):
-        write_archive_file(path, self)
+    def unload(self, path, nbit=16, levels=None):
+        write_archive_file(path, self, nbit=nbit, levels=levels)
 
     def refresh(self):
         """Reload from disk if this archive came from a file."""
@@ -575,16 +575,43 @@ def read_archive(path, dtype=np.float64, decode=True):
     decode=False (raw streaming mode): requires a DATA column in one
     of the raw-transportable sample types — int16 (TFORM 'I'),
     unsigned byte ('B'), signed byte ('B' + the FITS TZERO=-128
-    convention), or float32 ('E').  The Archive's ``amps`` becomes a
-    read-only zero placeholder and the undecoded samples are attached
-    as ``raw_data`` (nsub, npol, nchan, nbin) in the native-endian
-    wire dtype with ``raw_scl``/``raw_offs`` (nsub, npol, nchan)
-    float32 and ``raw_code`` naming the sample type for the device
-    decode (ops/decode.RAW_CODES) — the streaming driver ships these
-    to the accelerator and decodes there, cutting host->device bytes
-    2-4x vs decoded float32.  Raises ValueError for layouts raw mode
-    cannot represent (sub-byte NBIT packing, general TSCAL/TZERO
-    scaling); the caller falls back to decoding.
+    convention), float32 ('E'), or sub-byte packed samples ('B' with
+    an NBIT=1/2/4 card, MSB-first per the PSRFITS convention).  The
+    Archive's ``amps`` becomes a read-only zero placeholder and the
+    undecoded samples are attached as ``raw_data`` — (nsub, npol,
+    nchan, nbin) in the native-endian wire dtype, or (nsub, npol,
+    plane_bytes) PACKED bytes for sub-byte NBIT (row byte-pad already
+    trimmed; each pol plane must byte-align, i.e. nchan*nbin*NBIT
+    divisible by 8) — with ``raw_scl``/``raw_offs`` (nsub, npol,
+    nchan) float32 and ``raw_code`` naming the sample type for the
+    device decode (ops/decode.RAW_CODES; packed codes 'p1'/'p2'/'p4').
+    General FITS column scaling (TSCAL/TZERO beyond the signed-byte
+    convention) attaches as ``raw_tscal``/``raw_tzero`` scalars the
+    device decode applies before DAT_SCL/DAT_OFFS, in the exact host
+    order.  The streaming driver ships all of this to the accelerator
+    and decodes there, cutting host->device bytes 2x (int16) to 32x
+    (2-bit packed) vs decoded float64.
+
+    Coverage matrix (raw mode ships -> device decodes):
+
+      =========================  ==========  =======================
+      DATA layout                raw_code    bytes vs decoded f64
+      =========================  ==========  =======================
+      TFORM 'I' int16            'i16'       4x fewer
+      TFORM 'B' unsigned byte    'u8'        8x fewer
+      TFORM 'B' + TZERO=-128     'i8'        8x fewer
+      TFORM 'E' float32          'f32'       2x fewer
+      NBIT=4 packed              'p4'        16x fewer
+      NBIT=2 packed              'p2'        32x fewer
+      NBIT=1 packed              'p1'        64x fewer
+      any above + TSCAL/TZERO    (same)      (same; 2 extra scalars)
+      =========================  ==========  =======================
+
+    Raises ValueError for the remaining unrepresentable layouts
+    (sub-byte planes that do not byte-align, packed + FITS-scaled
+    columns, or config.raw_subbyte / PPT_RAW_SUBBYTE=off — the escape
+    hatch forcing the decoded lane); the caller falls back to
+    decoding.
 
     When the native decoder (io/native.py) is available, the DATA
     column is decoded straight from the wire bytes with DAT_SCL /
@@ -626,14 +653,17 @@ def read_archive(path, dtype=np.float64, decode=True):
     data_scaling = subint.col_scaling.get("DATA")
     raw_data = None
     raw_code = None
+    raw_tscal = raw_tzero = None
     if not decode:
         col_off, code, repeat = subint.layout["DATA"]
         nbin = int(hdr.get("NBIN", 0)) or repeat // (npol * nchan)
+        nbit = int(hdr.get("NBIT", 8) or 8)
         # wire dtype + device sample code per TFORM (ops/decode).  'B'
         # with the FITS signed-byte convention (TSCAL 1, TZERO -128)
         # ships as-is and the device decode removes the bias exactly;
-        # any OTHER TSCAL/TZERO scaling needs the scaling-aware host
-        # path.
+        # any OTHER TSCAL/TZERO scaling ships its two scalars and the
+        # device decode applies them before DAT_SCL/DAT_OFFS, in the
+        # exact host order.
         wire = {"I": (">i2", np.int16, "i16"),
                 "B": ("u1", np.uint8, "u8"),
                 "E": (">f4", np.float32, "f32")}.get(code)
@@ -642,24 +672,68 @@ def read_archive(path, dtype=np.float64, decode=True):
                 and float(data_scaling[1]) == -128.0:
             wire = ("u1", np.uint8, "i8")
             data_scaling = None
-        samp = np.dtype(wire[0]).itemsize if wire else 0
-        if (wire is None or npol * nchan * nbin != repeat
-                or data_scaling is not None
-                or col_off + repeat * samp > subint.row_stride
-                or len(subint.raw) < nsub * subint.row_stride):
-            raise ValueError(
-                f"{path}: raw streaming mode needs a consistent "
-                "int16/byte/float32 DATA column (unscaled, or the "
-                "signed-byte TZERO convention)")
-        rows = np.frombuffer(subint.raw, np.uint8)[
-            : nsub * subint.row_stride].reshape(nsub, subint.row_stride)
-        col = np.ascontiguousarray(
-            rows[:, col_off:col_off + repeat * samp])
-        # one byteswap/memcpy pass; no float decode anywhere on host
-        raw_data = col.view(wire[0]).astype(wire[1]).reshape(
-            nsub, npol, nchan, nbin)
-        raw_code = wire[2]
-        amps = np.broadcast_to(np.float32(0.0), raw_data.shape)
+        if code == "B" and nbit in (1, 2, 4):
+            # sub-byte packed samples ship PACKED (raw codes
+            # 'p1'/'p2'/'p4'); the device unpacks the bit planes
+            # inside the fused program (ops/decode.unpack_bitplanes).
+            # Per-pol slicing on host is a byte index, so each pol
+            # plane must byte-align; the row byte-pad is trimmed here.
+            from .. import config as _cfg
+
+            if not getattr(_cfg, "raw_subbyte", True):
+                raise ValueError(
+                    f"{path}: sub-byte raw transport disabled "
+                    "(config.raw_subbyte / PPT_RAW_SUBBYTE=off); "
+                    "decode on host instead")
+            per = 8 // nbit
+            plane = nchan * nbin
+            row_bytes = (npol * plane + per - 1) // per
+            if (data_scaling is not None or plane % per != 0
+                    or repeat != row_bytes
+                    or not int(hdr.get("NBIN", 0))
+                    or col_off + row_bytes > subint.row_stride
+                    or len(subint.raw) < nsub * subint.row_stride):
+                raise ValueError(
+                    f"{path}: NBIT={nbit} DATA column is FITS-scaled, "
+                    "inconsistent, or its pol planes do not "
+                    "byte-align; raw streaming mode cannot ship it "
+                    "packed")
+            rows = np.frombuffer(subint.raw, np.uint8)[
+                : nsub * subint.row_stride].reshape(nsub,
+                                                    subint.row_stride)
+            plane_bytes = plane // per
+            col = np.ascontiguousarray(
+                rows[:, col_off:col_off + npol * plane_bytes])
+            raw_data = col.reshape(nsub, npol, plane_bytes)
+            raw_code = f"p{nbit}"
+            amps = np.broadcast_to(np.float32(0.0),
+                                   (nsub, npol, nchan, nbin))
+        else:
+            if wire is not None and data_scaling is not None:
+                # general TSCAL/TZERO: stored values ship as-is plus
+                # the two column-scaling scalars
+                raw_tscal = float(data_scaling[0])
+                raw_tzero = float(data_scaling[1])
+                data_scaling = None
+            samp = np.dtype(wire[0]).itemsize if wire else 0
+            if (wire is None or npol * nchan * nbin != repeat
+                    or data_scaling is not None
+                    or col_off + repeat * samp > subint.row_stride
+                    or len(subint.raw) < nsub * subint.row_stride):
+                raise ValueError(
+                    f"{path}: raw streaming mode needs a consistent "
+                    "int16/byte/float32 (or packed NBIT) DATA column")
+            rows = np.frombuffer(subint.raw, np.uint8)[
+                : nsub * subint.row_stride].reshape(nsub,
+                                                    subint.row_stride)
+            col = np.ascontiguousarray(
+                rows[:, col_off:col_off + repeat * samp])
+            # one byteswap/memcpy pass; no float decode anywhere on
+            # host
+            raw_data = col.view(wire[0]).astype(wire[1]).reshape(
+                nsub, npol, nchan, nbin)
+            raw_code = wire[2]
+            amps = np.broadcast_to(np.float32(0.0), raw_data.shape)
     elif use_native:
         col_off, code, repeat = subint.layout["DATA"]
         nbin = int(hdr.get("NBIN", 0)) or repeat // (npol * nchan)
@@ -762,6 +836,8 @@ def read_archive(path, dtype=np.float64, decode=True):
         arch.raw_code = raw_code
         arch.raw_scl = scl.astype(np.float32)
         arch.raw_offs = offs.astype(np.float32)
+        arch.raw_tscal = raw_tscal
+        arch.raw_tzero = raw_tzero
     if polyco is not None and "PERIOD" not in cols:
         arch.periods = arch.folding_periods()
     return arch
@@ -817,17 +893,67 @@ def parse_parfile(path_or_lines):
 # Writing
 # --------------------------------------------------------------------------
 
-def write_archive_file(path, arch):
+def write_archive_file(path, arch, nbit=16, levels=None):
     """Serialize an Archive to a PSRFITS fold-mode file (16-bit scaled
-    DATA; PSRPARAM/POLYCO HDUs preserved)."""
+    DATA by default; PSRPARAM/POLYCO HDUs preserved).
+
+    nbit: DATA sample width.  16 (default, byte-stable): scaled int16.
+    8: scaled unsigned bytes.  1/2/4: sub-byte packed samples,
+    MSB-first with the PSRFITS row byte-pad and an NBIT card — the
+    search/fold-era layout the raw streaming lane ships packed.
+    levels: quantize to this many amplitude levels instead of the full
+    2**nbit range (must fit the width) — what a coarsely-quantizing
+    backend stores, and the corpus knob the transport-compression
+    bench uses (a 4-level byte column packs 4x)."""
     nsub, npol, nchan, nbin = arch.amps.shape
-    # per-(sub, pol, chan) scaling to int16
+    if nbit not in (1, 2, 4, 8, 16):
+        raise ValueError(f"write_archive_file: nbit must be one of "
+                         f"1, 2, 4, 8, 16; got {nbit}")
+    # the signed int16 container holds q in [0, 32767]; past that the
+    # unsigned quantized values would wrap negative silently
+    max_levels = 2 ** 15 if nbit == 16 else 2 ** nbit
+    if levels is not None and not 2 <= int(levels) <= max_levels:
+        raise ValueError(
+            f"write_archive_file: levels={levels} does not fit "
+            f"nbit={nbit} (need 2 <= levels <= {max_levels})")
     lo = arch.amps.min(axis=-1)
     hi = arch.amps.max(axis=-1)
-    offs = 0.5 * (hi + lo)
-    scl = np.maximum((hi - lo) / 65530.0, 1e-30)
-    data = np.round((arch.amps - offs[..., None]) / scl[..., None])
-    data = np.clip(data, -32768, 32767).astype(">i2")
+    nbit_card = None
+    if nbit == 16 and levels is None:
+        # the historical exact path — golden archives stay
+        # byte-identical: per-(sub, pol, chan) scaling to int16
+        offs = 0.5 * (hi + lo)
+        scl = np.maximum((hi - lo) / 65530.0, 1e-30)
+        data = np.round((arch.amps - offs[..., None]) / scl[..., None])
+        data = np.clip(data, -32768, 32767).astype(">i2")
+    else:
+        # unsigned quantization to `span` levels: q in [0, span],
+        # DAT_SCL/DAT_OFFS restore the physics exactly like any
+        # integer-quantized archive
+        span = float((levels or 2 ** nbit) - 1)
+        offs = lo
+        scl = np.maximum((hi - lo) / span, 1e-30)
+        q = np.clip(np.round((arch.amps - offs[..., None])
+                             / scl[..., None]), 0, span)
+        if nbit == 16:
+            data = q.astype(">i2")
+        elif nbit == 8:
+            data = q.astype("u1")
+        else:
+            # MSB-first packing, each ROW padded to whole bytes (the
+            # PSRFITS convention readers trim)
+            per = 8 // nbit
+            row_samp = npol * nchan * nbin
+            row_bytes = (row_samp + per - 1) // per
+            flat = q.astype(np.uint8).reshape(nsub, row_samp)
+            padded = np.zeros((nsub, row_bytes * per), np.uint8)
+            padded[:, :row_samp] = flat
+            grp = padded.reshape(nsub, row_bytes, per)
+            data = np.zeros((nsub, row_bytes), np.uint8)
+            for j in range(per):
+                data |= (grp[:, :, j] & ((1 << nbit) - 1)) \
+                    << np.uint8((per - 1 - j) * nbit)
+            nbit_card = nbit
 
     cols = OrderedDict()
     cols["TSUBINT"] = arch.tsubints.astype(">f8")
@@ -855,6 +981,8 @@ def write_archive_file(path, arch):
     hdr["NSBLK"] = 1
     hdr["INT_TYPE"] = "TIME"
     hdr["DEDISP"] = bool(arch.get_dedispersed())
+    if nbit_card is not None:
+        hdr["NBIT"] = nbit_card
 
     prim_cards = [(k, v, c) for (k, v, c) in arch.primary.cards
                   if k not in ("SIMPLE", "BITPIX", "NAXIS", "EXTEND")]
@@ -878,7 +1006,11 @@ def write_archive_file(path, arch):
         fitsio.write_bintable(
             f, "SUBINT", cols,
             header_cards=[(k, v, c) for (k, v, c) in hdr.cards],
-            tdims={"DATA": (nbin, nchan, npol)})
+            # a packed DATA column is a flat byte run per row — its
+            # sample geometry lives in the NBIT/NBIN/NCHAN/NPOL cards,
+            # not a TDIM (which would misdescribe the byte count)
+            tdims=({} if nbit_card is not None
+                   else {"DATA": (nbin, nchan, npol)}))
 
 
 def new_archive(amps, freqs, Ps, epochs_mjd, tsubints, weights=None,

@@ -60,9 +60,11 @@ from .ism import (
     DMc_from_GM,
 )
 from .decode import (
+    PACKED_BITS,
     RAW_CODES,
     affine_decode,
     decode_stokes_I,
+    unpack_bitplanes,
 )
 
 __all__ = [
@@ -104,7 +106,9 @@ __all__ = [
     "dDM",
     "GM_from_DMc",
     "DMc_from_GM",
+    "PACKED_BITS",
     "RAW_CODES",
     "affine_decode",
     "decode_stokes_I",
+    "unpack_bitplanes",
 ]

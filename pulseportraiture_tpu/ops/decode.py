@@ -1,14 +1,16 @@
 """On-device PSRFITS sample decode (the raw streaming lane's stage 1).
 
 The streaming campaign drivers ship the UNDECODED DATA column payload
-to the accelerator — 2-4x fewer bytes than decoded float32 on a link
+to the accelerator — 2-32x fewer bytes than decoded float64 on a link
 that bottlenecks the whole campaign — and decode there, inside the
 fused bucket program.  These kernels are the single source of truth
-for that decode: the affine sample reconstruction per TFORM sample
-type, and the polarization reduction to Stokes I for multi-pol
-archives.  The host-side oracle is ``io/psrfits.read_archive`` /
-``io/native.decode_fused`` (the FITS fuzz corpus pins its semantics);
-tests assert the two lanes produce digit-identical TOAs.
+for that decode: the bit-plane unpack for sub-byte packed samples, the
+affine sample reconstruction per TFORM sample type (including general
+FITS column TSCAL/TZERO scaling), and the polarization reduction to
+Stokes I for multi-pol archives.  The host-side oracle is
+``io/psrfits.read_archive`` / ``io/native.decode_fused`` (the FITS
+fuzz corpus pins its semantics); tests assert the two lanes produce
+digit-identical TOAs.
 
 Sample-type codes (``RAW_CODES``) name the wire format the host
 shipped, after any endian normalization (``io/psrfits`` byteswaps
@@ -22,24 +24,70 @@ int16/float32 to native order — a memcpy pass, no float decode):
          matching the host decode order bit-for-bit)
   'f32'  float32 samples      (TFORM 'E'; DAT_SCL/DAT_OFFS usually
          identity but applied uniformly anyway)
+  'p1'/'p2'/'p4'  sub-byte packed unsigned samples (NBIT=1/2/4, the
+         search/fold-era backends): the wire payload is the PACKED
+         bytes, MSB-first per the PSRFITS convention, row byte-pad
+         already trimmed on host; :func:`unpack_bitplanes` restores
+         the unsigned sample values with integer shifts/masks HERE —
+         a 2-bit archive ships 32x fewer bytes than decoded f64.
+
+General FITS column scaling (TSCAL/TZERO beyond the signed-byte
+convention) ships as two extra per-subint scalars and folds into
+:func:`affine_decode` as one more fused multiply-add, in the exact
+host order: physical = (stored*TSCAL + TZERO)*DAT_SCL + DAT_OFFS.
 """
 
 import jax.numpy as jnp
 
 from .noise import min_window_baseline
 
-RAW_CODES = ("i16", "u8", "i8", "f32")
+RAW_CODES = ("i16", "u8", "i8", "f32", "p1", "p2", "p4")
+
+# packed sub-byte codes -> bits per sample
+PACKED_BITS = {"p1": 1, "p2": 2, "p4": 4}
 
 
-def affine_decode(raw, scl, offs, ft, code="i16"):
+def unpack_bitplanes(packed, nbit, nsamp):
+    """Unpack MSB-first ``nbit``-wide samples from a packed byte
+    payload: (..., nbytes) uint8 -> (..., nsamp) uint8 sample values.
+
+    The PSRFITS packing order (io/psrfits.py host unpack, forge
+    corpus): within each byte the FIRST sample occupies the most
+    significant bits.  ``nsamp`` trims any trailing byte padding
+    (static, so the program shape is fixed).  Integer shifts and masks
+    only — this is the jittable mirror of the host unpack, bit-exact
+    by construction."""
+    if nbit not in (1, 2, 4):
+        raise ValueError(f"unpack_bitplanes: nbit must be 1, 2 or 4, "
+                         f"got {nbit}")
+    per = 8 // nbit
+    shifts = jnp.arange(per - 1, -1, -1, dtype=jnp.uint8) * jnp.uint8(nbit)
+    mask = jnp.uint8((1 << nbit) - 1)
+    samples = (packed[..., :, None] >> shifts) & mask
+    samples = samples.reshape(packed.shape[:-1]
+                              + (packed.shape[-1] * per,))
+    return samples[..., :nsamp]
+
+
+def _bcast_row(v, x):
+    """Broadcast a per-subint (nb,) scalar vector against the payload
+    x of shape (nb, [npol,] nchan, nbin)."""
+    return jnp.reshape(v, v.shape + (1,) * (x.ndim - v.ndim))
+
+
+def affine_decode(raw, scl, offs, ft, code="i16", tscal=None, tzero=None):
     """Decode raw samples to physical amplitudes: ``x * scl + offs``
     per channel, in dtype ``ft``, with the signed-byte bias removed
-    first for code 'i8'.
+    first for code 'i8' and any general FITS column scaling
+    (``tscal``/``tzero``, per-subint scalars) applied first for the
+    other integer codes.
 
-    raw: (..., nchan, nbin) integer or float samples; scl/offs:
-    (..., nchan) per-channel DAT_SCL/DAT_OFFS.  The operation order
-    (cast, bias, scale, offset) mirrors the host decode exactly so the
-    two lanes agree to the bit in matching precision."""
+    raw: (..., nchan, nbin) integer or float SAMPLE VALUES (packed
+    codes must be unpacked with :func:`unpack_bitplanes` first);
+    scl/offs: (..., nchan) per-channel DAT_SCL/DAT_OFFS.  The
+    operation order (cast, column scaling, scale, offset) mirrors the
+    host decode exactly so the two lanes agree to the bit in matching
+    precision."""
     if code not in RAW_CODES:
         raise ValueError(f"unknown raw sample code {code!r}; "
                          f"known: {RAW_CODES}")
@@ -47,21 +95,41 @@ def affine_decode(raw, scl, offs, ft, code="i16"):
     if code == "i8":
         # stored unsigned, TZERO = -128: exact for all 0..255 values
         x = x - jnp.asarray(128.0, ft)
+    if tscal is not None:
+        # general column scaling, the host's apply_column_scaling
+        # order: stored*TSCAL + TZERO happens BEFORE DAT_SCL/DAT_OFFS
+        x = x * _bcast_row(tscal.astype(ft), x) \
+            + _bcast_row(tzero.astype(ft), x)
     return x * scl[..., None] + offs[..., None]
 
 
-def decode_stokes_I(raw, scl, offs, ft, code="i16", pol_sum=False):
-    """Full decode stage of the fused bucket program: affine sample
-    decode, min-window baseline subtraction, and the polarization
-    reduction to Stokes I.
+def decode_stokes_I(raw, scl, offs, ft, code="i16", pol_sum=False,
+                    nbin=None, tscal=None, tzero=None):
+    """Full decode stage of the fused bucket program: sub-byte
+    bit-plane unpack (packed codes), affine sample decode, min-window
+    baseline subtraction, and the polarization reduction to Stokes I.
 
     pol_sum=False: raw is (nb, nchan, nbin) — a single-pol payload
     (Intensity data, or the host-sliced Stokes I plane of an IQUV
-    archive, which ships no extra bytes).  pol_sum=True: raw is
+    archive, which ships no extra bytes) — or, for packed codes,
+    (nb, plane_bytes) packed bytes.  pol_sum=True: raw is
     (nb, 2, nchan, nbin) — the two summand pols of an AA+BB/Coherence
-    archive, decoded and baselined PER POL then summed, matching the
-    host lane's remove_baseline-then-pscrunch order bit-for-bit."""
-    x = affine_decode(raw, scl, offs, ft, code=code)
+    archive ((nb, 2, plane_bytes) packed), decoded and baselined PER
+    POL then summed, matching the host lane's
+    remove_baseline-then-pscrunch order bit-for-bit.  ``nbin`` is
+    required for packed codes (the unpack target geometry; nchan
+    comes from scl)."""
+    nbit = PACKED_BITS.get(code)
+    if nbit is not None:
+        if nbin is None:
+            raise ValueError(
+                f"decode_stokes_I: packed code {code!r} needs nbin "
+                "for the unpack geometry")
+        nchan = scl.shape[-1]
+        raw = unpack_bitplanes(raw, nbit, nchan * nbin)
+        raw = raw.reshape(raw.shape[:-1] + (nchan, nbin))
+    x = affine_decode(raw, scl, offs, ft, code=code, tscal=tscal,
+                      tzero=tzero)
     x = x - min_window_baseline(x)[..., None]
     if pol_sum:
         if x.ndim < 4:

@@ -31,14 +31,24 @@ levels of overlap keep every resource busy:
   simultaneously (h2d_start/h2d_done trace events measure it).
 
 Raw mode is UNIVERSAL over the PSRFITS sample types (int16, unsigned/
-signed byte, float32 — ops/decode.RAW_CODES) and polarization states:
+signed byte, float32, and sub-byte NBIT=1/2/4 packed samples — which
+ship their PACKED bytes and are bit-plane-unpacked on device, 32x
+fewer bytes than decoded f64 for a 2-bit archive —
+ops/decode.RAW_CODES), general FITS column TSCAL/TZERO scaling (two
+scalars ride the payload and fold into the device affine), and
+polarization states:
 npol == 1 ships as-is, IQUV ships only its Stokes-I plane (a host
 index, no extra bytes), AA+BB/Coherence ship their two summand pols
 and the device decode reduces them to Stokes I.  Dedispersed-on-disk
 archives are re-dispersed ON DEVICE by the stored DM (host-wrapped
-f64 turns, matmul-DFT rotation).  Sub-byte NBIT packing, general
-TSCAL/TZERO column scaling, or tscrunch fall back to the decoded
-(host-side load_data) lane per archive.
+f64 turns, matmul-DFT rotation).  The remaining fallbacks to the
+decoded (host-side load_data) lane: tscrunch, misaligned sub-byte pol
+planes, packed + FITS-scaled columns, and the PPT_RAW_SUBBYTE escape
+hatch.  An optional LOSSLESS transport codec
+(config.transport_compress; io/blockcodec.py) can width-reduce
+integer payloads further on the copy worker, chosen per dispatch by a
+cost model fed from the live h2d telemetry — .tim output is
+digit-identical compressed or not.
 
 Scope: campaign configurations — wideband (phi[, DM[, GM]]) fits,
 scattering (fit_scat/log10_tau/scat_guess/fix_alpha as in GetTOAs),
@@ -158,7 +168,8 @@ class _Bucket:
     nbin) each) and the device decode reduces them to Stokes I."""
 
     def __init__(self, freqs, nbin, modelx, flags, kind="dec",
-                 ir_FT=None, raw_code="i16", pol_sum=False):
+                 ir_FT=None, raw_code="i16", pol_sum=False,
+                 col_scaled=False):
         self.freqs = freqs          # (nchan,)
         self.nbin = int(nbin)
         self.modelx = modelx        # (nchan, nbin) template
@@ -171,13 +182,19 @@ class _Bucket:
         # warm executor from many concurrent requests/templates)
         self.raw_code = raw_code    # 'raw': wire sample type
         self.pol_sum = bool(pol_sum)  # 'raw': device pol0+pol1 sum
+        self.col_scaled = bool(col_scaled)  # 'raw': general FITS
+        # column TSCAL/TZERO ride the payload (its own compiled
+        # program: one extra fused multiply-add in the decode)
         self.ir_FT = ir_FT          # (nchan, nharm) complex or None
         self._hwin = None
         self._hwin_key = object()   # never equals a config value
         self.ports = []             # 'dec': (nchan, nbin) float
-        self.raw = []               # 'raw': (nchan, nbin) int16
+        self.raw = []               # 'raw': (nchan, nbin) wire samples
+        # ((plane_bytes,) packed bytes for sub-byte codes)
         self.scl = []               # 'raw': (nchan,) f32
         self.offs = []              # 'raw': (nchan,) f32
+        self.tscal = []             # 'raw'+col_scaled: scalar TSCAL
+        self.tzero = []             # 'raw'+col_scaled: scalar TZERO
         self.dedisp = []            # 'raw': (DM, nu0) to re-disperse by
         self.noise = []             # 'dec': (nchan,)
         self.masks = []             # each (nchan,)
@@ -207,7 +224,8 @@ class _Bucket:
         return len(self.owners)
 
     def clear(self):
-        for lst in (self.ports, self.raw, self.scl, self.offs, self.dedisp,
+        for lst in (self.ports, self.raw, self.scl, self.offs,
+                    self.tscal, self.tzero, self.dedisp,
                     self.noise, self.masks, self.Ps, self.nu_fits,
                     self.theta0, self.DM_guess, self.owners):
             lst.clear()
@@ -225,6 +243,8 @@ def _bucket_shape(b):
         shape += f":{b.raw_code}"
         if b.pol_sum:
             shape += ":sum2"
+        if b.col_scaled:
+            shape += ":tz"
     if b.flags:
         shape += ":" + "".join("1" if f else "0" for f in b.flags)
     return shape
@@ -247,10 +267,12 @@ def parse_shape_key(shape):
         raise ValueError(f"unparseable dispatch shape {shape!r}")
     if kind not in ("raw", "dec") or nchan < 1 or nbin < 1:
         raise ValueError(f"unparseable dispatch shape {shape!r}")
-    raw_code, pol_sum, flags = "i16", False, None
+    raw_code, pol_sum, col_scaled, flags = "i16", False, False, None
     for tok in parts[2:]:
         if kind == "raw" and tok == "sum2":
             pol_sum = True
+        elif kind == "raw" and tok == "tz":
+            col_scaled = True
         elif kind == "raw" and tok in RAW_CODES:
             raw_code = tok
         elif tok and set(tok) <= {"0", "1"}:
@@ -259,7 +281,7 @@ def parse_shape_key(shape):
             raise ValueError(
                 f"unknown token {tok!r} in dispatch shape {shape!r}")
     return dict(nchan=nchan, nbin=nbin, kind=kind, raw_code=raw_code,
-                pol_sum=pol_sum, flags=flags)
+                pol_sum=pol_sum, col_scaled=col_scaled, flags=flags)
 
 
 def bucket_pad_to(nchan):
@@ -745,6 +767,19 @@ class _StreamExecutor:
         return sum(pl.h2d_bytes for pl in self.pipelines)
 
     @property
+    def h2d_logical_bytes(self):
+        """Total LOGICAL bytes behind those copies — what would have
+        shipped without transport compression (equal to h2d_bytes when
+        the codec never engaged)."""
+        return sum(pl.h2d_logical_bytes for pl in self.pipelines)
+
+    @property
+    def codec_duration(self):
+        """Total seconds the copy stages spent probing/encoding the
+        transport codec."""
+        return sum(pl.codec_s for pl in self.pipelines)
+
+    @property
     def h2d_duration(self):
         """Total seconds the copy stages spent moving bytes."""
         return sum(pl.h2d_s for pl in self.pipelines)
@@ -954,12 +989,21 @@ def _load_raw(f):
     """Raw streaming load: undecoded DATA samples + the small per-
     archive metadata TOA assembly needs.
 
-    Sample types: int16, unsigned/signed byte, or float32 DATA columns
-    (ops/decode RAW_CODES; read_archive(decode=False) refuses anything
-    else — sub-byte NBIT packing, general TSCAL/TZERO — and the caller
-    falls back to the decoded lane).  Polarization is universal: npol
+    Sample types: int16, unsigned/signed byte, float32, or sub-byte
+    NBIT=1/2/4 packed DATA columns (ops/decode RAW_CODES; packed
+    payloads ship their PACKED bytes — codes 'p1'/'p2'/'p4' — and the
+    fused program unpacks the bit planes on device: a 2-bit archive
+    ships 32x fewer bytes than the decoded-f64 fallback).  General
+    FITS column TSCAL/TZERO scaling ships as two scalars the device
+    decode folds in before DAT_SCL/DAT_OFFS.
+    read_archive(decode=False) refuses the remaining unrepresentable
+    layouts (misaligned sub-byte pol planes, packed + FITS-scaled, or
+    the PPT_RAW_SUBBYTE escape hatch) and the caller falls back to
+    the decoded lane.  Polarization is universal: npol
     == 1 ships as-is; an IQUV state ships only its Stokes-I plane
-    (pol 0 — a host INDEX into the undecoded payload, no extra bytes);
+    (pol 0 — a host INDEX into the undecoded payload, no extra bytes;
+    for packed payloads the pol planes are byte-aligned by the reader,
+    so the slice stays an index);
     any other multi-pol state (AA+BB, Coherence) ships its TWO summand
     pols and the device decode baselines each pol then sums — the same
     remove_baseline-then-pscrunch order as load_data, so the lanes
@@ -968,6 +1012,14 @@ def _load_raw(f):
     stored DM) before fitting, mirroring load_data's dededisperse-on-
     load."""
     arch = read_archive(f, decode=False)
+    if arch.raw_code in ("p1", "p2", "p4") \
+            and bucket_pad_to(arch.nchan) != arch.nchan:
+        # bucket-lattice coarsening pads CHANNELS, which has no
+        # byte-aligned meaning inside a packed bit stream — decoded
+        # fallback (loud, so pptrace's skip ledger names it)
+        raise ValueError(
+            f"{f}: sub-byte raw payloads cannot channel-pad "
+            f"(config.bucket_pad); decoding on host instead")
     if arch.npol == 1 or arch.get_state() == "Stokes":
         # Stokes I is pol 0: index the wire payload, ship one pol
         raw = arch.raw_data[:, 0]
@@ -992,6 +1044,7 @@ def _load_raw(f):
     return DataBunch(
         raw_mode=True, raw=raw, scl=scl, offs=offs,
         raw_code=arch.raw_code, pol_sum=pol_sum,
+        tscal=arch.raw_tscal, tzero=arch.raw_tzero,
         weights=weights, ok_isubs=ok_isubs,
         nsub=nsub, nchan=arch.nchan, nbin=arch.nbin,
         freqs=arch.freqs_table, Ps=arch.folding_periods(),
@@ -1010,18 +1063,36 @@ def _load_raw(f):
 
 def _raw_decode(raw, scl, offs, nbin, ft, redisp=False,
                 redisp_turns=None, dft_fold=None, code="i16",
-                pol_sum=False):
-    """Stage 1 of the fused raw-bucket program: sample decode (scl/offs
-    affine per the wire sample type — ops/decode.decode_stokes_I),
-    min-window baseline subtraction, the Stokes-I pol reduction for
-    two-pol payloads, and (for dedispersed-on-disk archives) the
-    on-device re-dispersion rotation.  Split out of _raw_fit_fn so the
-    stage-attribution profiler (benchmarks/attrib.py) times prefixes
-    of the REAL program — this is the single source of truth for the
-    decode stage."""
-    from ..ops.decode import decode_stokes_I
+                pol_sum=False, tscal=None, tzero=None, pack_w=None,
+                vmin=None):
+    """Stage 1 of the fused raw-bucket program: the transport-codec
+    unpack when the copy stage shipped a width-reduced payload
+    (``pack_w``/``vmin`` — io/blockcodec; the inverse is the same
+    bit-plane op the sub-byte NBIT lane uses), sample decode (scl/offs
+    affine per the wire sample type — ops/decode.decode_stokes_I,
+    which also unpacks sub-byte packed codes and folds in general
+    column TSCAL/TZERO), min-window baseline subtraction, the Stokes-I
+    pol reduction for two-pol payloads, and (for dedispersed-on-disk
+    archives) the on-device re-dispersion rotation.  Split out of
+    _raw_fit_fn so the stage-attribution profiler (benchmarks/
+    attrib.py) times prefixes of the REAL program — this is the single
+    source of truth for the decode stage."""
+    from ..ops.decode import decode_stokes_I, unpack_bitplanes
 
-    x = decode_stokes_I(raw, scl, offs, ft, code=code, pol_sum=pol_sum)
+    if pack_w is not None:
+        # transport codec: (nb, nbytes) packed residuals + per-subint
+        # minima -> the original integer sample values, exactly (every
+        # integer here is far below 2**24, exact in f32)
+        nchan = scl.shape[-1]
+        nsamp = (2 if pol_sum else 1) * nchan * nbin
+        v = raw if pack_w == 8 else unpack_bitplanes(raw, pack_w, nsamp)
+        shape = raw.shape[:1] + ((2, nchan, nbin) if pol_sum
+                                 else (nchan, nbin))
+        raw = v.reshape(shape).astype(ft) \
+            + jnp.reshape(vmin.astype(ft),
+                          (-1,) + (1,) * (len(shape) - 1))
+    x = decode_stokes_I(raw, scl, offs, ft, code=code, pol_sum=pol_sum,
+                        nbin=nbin, tscal=tscal, tzero=tzero)
     if redisp:
         # dedispersed-on-disk archives: restore the dispersion
         # delays of the stored DM (load_data's dededisperse, here
@@ -1068,7 +1139,8 @@ def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
                 use_fast, ftname, x_bf16, redisp=False,
                 want_flux=False, use_ir=False, compensated=False,
                 nharm_eff=None, seed_derotate=True, raw_code="i16",
-                pol_sum=False, zap_nstd=None):
+                pol_sum=False, zap_nstd=None, col_scaled=False,
+                pack_w=None):
     """Cache-key normalizing front for _raw_fit_fn_cached: dead knob
     combinations collapse onto one compiled program — compensated is
     meaningless without the scatter engine, and under compensated mode
@@ -1105,7 +1177,7 @@ def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
         nchan, nbin, flags, max_iter, log10_tau, tau_mode, use_fast,
         ftname, x_bf16, redisp, want_flux, use_ir, compensated,
         nharm_eff, seed_derotate, use_dft_fold(), raw_code, pol_sum,
-        zap_nstd, fit_fused)
+        zap_nstd, fit_fused, col_scaled, pack_w)
 
 
 @lru_cache(maxsize=None)
@@ -1115,12 +1187,18 @@ def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
                        compensated=False, nharm_eff=None,
                        seed_derotate=True, dft_fold=None,
                        raw_code="i16", pol_sum=False, zap_nstd=None,
-                       fit_fused=False):
+                       fit_fused=False, col_scaled=False,
+                       pack_w=None):
     """ONE jitted program for a raw bucket: sample decode (scl/offs
-    affine per raw_code — ops/decode; pol_sum reduces two-pol payloads
+    affine per raw_code — ops/decode; packed sub-byte codes bit-plane
+    unpack first; col_scaled folds the general TSCAL/TZERO scalars in
+    as one extra fused multiply-add; pack_w selects the
+    transport-codec unpack for a width-reduced payload; pol_sum
+    reduces two-pol payloads
     to Stokes I), min-window baseline subtraction, power-spectrum noise, S/N,
     nu_fit seeding, the batched fit, and result packing into a single
-    (nfield, nb) array — so a bucket costs one h2d of int16 bytes, one
+    (nfield, nb) array — so a bucket costs one h2d of wire-format
+    bytes, one
     dispatch, and one small d2h pull.  The decode and stats stages live
     in _raw_decode/_raw_stats (shared with benchmarks/attrib.py's
     prefix programs).
@@ -1148,10 +1226,14 @@ def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
     tiny = float(np.finfo(ftname).tiny)
 
     def run(raw, scl, offs, cmask, modelx, freqs, Ps, DMg, nu_out,
-            tau_s, tau_nu, tau_a, alpha0, redisp_turns, ir_r, ir_i):
+            tau_s, tau_nu, tau_a, alpha0, redisp_turns, ir_r, ir_i,
+            tscal=None, tzero=None, vmin=None):
         x = _raw_decode(raw, scl, offs, nbin, ft, redisp=redisp,
                         redisp_turns=redisp_turns, dft_fold=dft_fold,
-                        code=raw_code, pol_sum=pol_sum)
+                        code=raw_code, pol_sum=pol_sum,
+                        tscal=tscal if col_scaled else None,
+                        tzero=tzero if col_scaled else None,
+                        pack_w=pack_w, vmin=vmin)
         nzap = zap_iter = None
         if zap_nstd is None:
             noise, snr, nu_fit = _raw_stats(x, cmask, freqs, ft, tiny)
@@ -1338,6 +1420,8 @@ class _DevicePipeline:
     def __init__(self, device, idev, depth, tracer, inflight_fn):
         from concurrent.futures import ThreadPoolExecutor
 
+        from ..io.blockcodec import CostModel
+
         self.device = device
         self.idev = idev
         self.depth = max(1, int(depth))
@@ -1347,8 +1431,14 @@ class _DevicePipeline:
         self.copy_ex = ThreadPoolExecutor(max_workers=1)
         self.fit_ex = ThreadPoolExecutor(max_workers=1)
         self.h2d_bytes = 0
+        self.h2d_logical_bytes = 0
         self.h2d_s = 0.0
         self.h2d_overlap_s = 0.0
+        self.codec_s = 0.0
+        # per-device transport cost model (ISSUE 15): fed the live
+        # link rate from this pipeline's own copies; the raw copy
+        # closures consult it when config.transport_compress='auto'
+        self.cost = CostModel()
 
     def submit(self, copy_fn, fit_fn, seq):
         """Admit one bucket: ``copy_fn() -> (dev_args, nbytes)`` runs
@@ -1376,16 +1466,37 @@ class _DevicePipeline:
             tr.emit("h2d_start", seq=seq, device=self.idev,
                     overlap=overlap)
         t0 = time.perf_counter()
-        dev_args, nbytes = copy_fn()
+        out = copy_fn()
         dt = time.perf_counter() - t0
+        # copy closures return (args, bytes) or, from the codec-aware
+        # raw lanes, (args, bytes, extras) with the logical-byte and
+        # codec-wall accounting the compression ledger reports
+        if len(out) == 3:
+            dev_args, nbytes, extras = out
+        else:
+            dev_args, nbytes = out
+            extras = {}
+        logical = int(extras.get("bytes_logical", nbytes))
+        codec_s = float(extras.get("codec_s", 0.0))
         self.h2d_bytes += nbytes
+        self.h2d_logical_bytes += logical
         self.h2d_s += dt
+        self.codec_s += codec_s
         if overlap:
             self.h2d_overlap_s += dt
+        # the cost model learns THIS link from every copy (shipped
+        # bytes over copy wall — conservative: stacking rides in)
+        self.cost.observe_link(nbytes, dt)
         if tr.enabled:
-            tr.emit("h2d_done", seq=seq, device=self.idev,
-                    bytes=int(nbytes), h2d_s=round(dt, 6),
-                    overlap=overlap)
+            ev = dict(seq=seq, device=self.idev, bytes=int(nbytes),
+                      h2d_s=round(dt, 6), overlap=overlap,
+                      bytes_logical=logical,
+                      codec_s=round(codec_s, 6))
+            if extras.get("codec") is not None:
+                # the cost-model decision ledger: 'engaged' | 'cost'
+                # (model declined) | 'ratio' (payload incompressible)
+                ev["codec"] = extras["codec"]
+            tr.emit("h2d_done", **ev)
         return dev_args
 
     def _run_fit(self, copy_fut, fit_fn):
@@ -1465,6 +1576,11 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
     if bucket.kind == "raw":
         rows, dedisp, redisp = _raw_rows(bucket, idx0)
         DMg = np.asarray([bucket.DM_guess[i] for i in idx0])
+        col_scaled = bucket.col_scaled
+        tscal_h = (np.asarray([bucket.tscal[i] for i in idx0])
+                   if col_scaled else None)
+        tzero_h = (np.asarray([bucket.tzero[i] for i in idx0])
+                   if col_scaled else None)
         ftname = "float32" if use_fast else "float64"
         # bf16/compensated config read per call (cache-key args,
         # mirroring _fast_batch_fn): mid-process toggles take effect
@@ -1474,23 +1590,44 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
         # per-bucket memoized window (fit.portrait) — only the fast
         # lanes band-limit; the complex engine never does
         hwin = bucket.harmonic_window() if use_fast else None
-        fn = _raw_fit_fn(len(np.asarray(freqs)), bucket.nbin,
-                         tuple(bool(f) for f in bucket.flags),
-                         int(max_iter), bool(log10_tau), tau_mode,
-                         use_fast, ftname,
-                         use_bf16_cross_spectrum(), redisp=redisp,
-                         want_flux=want_flux, use_ir=use_ir,
-                         compensated=use_scatter_compensated(),
-                         nharm_eff=hwin,
-                         # all-zero DM guesses make the CCF seed's
-                         # derotation phasor the identity; the host
-                         # knows, so the program skips the trig pass
-                         seed_derotate=bool(np.any(DMg != 0.0)),
-                         raw_code=bucket.raw_code,
-                         pol_sum=bucket.pol_sum,
-                         zap_nstd=zap_nstd)
+
+        def make_fn(pack_w):
+            return _raw_fit_fn(
+                len(np.asarray(freqs)), bucket.nbin,
+                tuple(bool(f) for f in bucket.flags),
+                int(max_iter), bool(log10_tau), tau_mode,
+                use_fast, ftname,
+                use_bf16_cross_spectrum(), redisp=redisp,
+                want_flux=want_flux, use_ir=use_ir,
+                compensated=use_scatter_compensated(),
+                nharm_eff=hwin,
+                # all-zero DM guesses make the CCF seed's
+                # derotation phasor the identity; the host
+                # knows, so the program skips the trig pass
+                seed_derotate=bool(np.any(DMg != 0.0)),
+                raw_code=bucket.raw_code,
+                pol_sum=bucket.pol_sum,
+                zap_nstd=zap_nstd, col_scaled=col_scaled,
+                pack_w=pack_w)
+
+        fn = make_fn(None)
         ft = jnp.float32 if use_fast else jnp.float64
         t_s, t_nu, t_a = tau_args
+        # compressed transport (ISSUE 15): the copy worker may ship a
+        # width-reduced payload — the decision depends on the stacked
+        # payload's dynamic range and the live link/codec rates, both
+        # known only on the copy worker, so `sel` carries the choice
+        # to the fit stage (which runs strictly AFTER the copy for
+        # this bucket: the pipeline's _run_fit waits on copy_fut).
+        # Packed sub-byte codes are already minimal and f32 payloads
+        # carry no integer residual structure — integers only.
+        from ..io.blockcodec import (encode_rows, probe_width,
+                                     resolve_transport_compress)
+
+        compress_mode = resolve_transport_compress()
+        can_compress = (compress_mode is not False
+                        and bucket.raw_code in ("i16", "u8", "i8"))
+        sel = {}
         # the response ships as TWO REAL arrays (the complex engine
         # reassembles them device-side inside the program — complex
         # buffers cannot cross some tunneled transports).  A
@@ -1509,24 +1646,68 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
             raw, scl, offs, turns = _stack_rows(rows, dedisp, redisp,
                                                 Ps, freqs)
             masks = np.stack(masks_rows)
+            payload, vmin_h, codec_s, decision = raw, None, 0.0, None
+            if can_compress:
+                t0c = time.perf_counter()
+                vmin_w, w = probe_width(raw)
+                decision = "ratio"  # no width below the wire dtype
+                if w is not None:
+                    shipped_est = raw.shape[0] * (
+                        (raw.size // raw.shape[0]) * w // 8 + 4)
+                    if compress_mode is True or \
+                            pipeline.cost.predict(raw.nbytes,
+                                                  shipped_est):
+                        payload = encode_rows(raw, vmin_w, w)
+                        vmin_h = vmin_w
+                        sel["pack"] = int(w)
+                        decision = "engaged"
+                    else:
+                        decision = "cost"
+                codec_s = time.perf_counter() - t0c
+                if "pack" in sel:
+                    # learn the real encode rate from full encodes
+                    # only (a probe-only pass is ~half the wall and
+                    # would flatter the model)
+                    pipeline.cost.observe_codec(raw.nbytes, codec_s)
             nbytes = [0]
             put = _byte_put(device, nbytes)
             with _on_device(device):
+                # payload (+ its vmin sidecar) first, so the byte
+                # counter can split shipped-payload from the shared
+                # arguments for the logical-bytes accounting below
+                payload_d = put(payload)
+                vmin_d = put(vmin_h, ft) if vmin_h is not None else None
+                shipped_payload = nbytes[0]
                 ir_r = put(ir_r_h, ft) if use_ir else None
                 ir_i = put(ir_i_h, ft) if use_ir else None
-                args = (put(raw), put(scl, ft), put(offs, ft),
+                tscal_d = put(tscal_h, ft) if col_scaled else None
+                tzero_d = put(tzero_h, ft) if col_scaled else None
+                args = (payload_d, put(scl, ft), put(offs, ft),
                         put(masks, ft), put(modelx, ft),
                         put(freqs, ft), put(Ps, ft), put(DMg, ft),
-                        put(turns, ft), ir_r, ir_i)
-            return args, nbytes[0]
+                        put(turns, ft), ir_r, ir_i, tscal_d, tzero_d,
+                        vmin_d)
+            # logical bytes: what the dispatch would have shipped
+            # uncompressed — only the payload (and its vmin sidecar)
+            # differ between the lanes
+            logical = nbytes[0] - shipped_payload + raw.nbytes
+            return args, nbytes[0], {"bytes_logical": int(logical),
+                                     "codec_s": codec_s,
+                                     "codec": decision}
 
         def fit(raw_d, scl_d, offs_d, masks_d, modelx_d, freqs_d,
-                Ps_d, DMg_d, turns_d, ir_r, ir_i):
+                Ps_d, DMg_d, turns_d, ir_r, ir_i, tscal_d, tzero_d,
+                vmin_d):
+            # the copy stage has resolved by now; a compressed payload
+            # selects the width-keyed program (lru-cached like every
+            # other variant)
+            fn_use = make_fn(sel["pack"]) if "pack" in sel else fn
             with _on_device(device):
-                return fn(raw_d, scl_d, offs_d, masks_d, modelx_d,
-                          freqs_d, Ps_d, DMg_d, ft(nu_out), ft(t_s),
-                          ft(t_nu), ft(t_a), ft(alpha0), turns_d,
-                          ir_r, ir_i)
+                return fn_use(raw_d, scl_d, offs_d, masks_d, modelx_d,
+                              freqs_d, Ps_d, DMg_d, ft(nu_out),
+                              ft(t_s), ft(t_nu), ft(t_a), ft(alpha0),
+                              turns_d, ir_r, ir_i, tscal_d, tzero_d,
+                              vmin_d)
     else:
         ports_rows = [bucket.ports[i] for i in idx0]
         noise_rows = [bucket.noise[i] for i in idx0]
@@ -1966,12 +2147,16 @@ def make_wideband_lane(modelfile, nsub_batch=256, fit_DM=True,
                           bool(fit_scat),
                           bool(fit_scat and not fix_alpha))
             kind = "raw" if raw_mode else "dec"
-            # raw payloads bucket by wire sample type and pol
-            # reduction too: each combination is its own compiled
-            # decode stage, and mixing them would stack incompatible
-            # row shapes/dtypes
+            # raw payloads bucket by wire sample type, pol reduction,
+            # and column-scaling presence too: each combination is its
+            # own compiled decode stage, and mixing them would stack
+            # incompatible row shapes/dtypes (or drop a scaling)
             raw_code = str(d.get("raw_code") or "i16")
             pol_sum = bool(d.get("pol_sum", False))
+            col_scaled = raw_mode and (d.get("tscal") is not None
+                                       or d.get("tzero") is not None)
+            tscal_val = float(d.get("tscal") or 1.0) if raw_mode else 1.0
+            tzero_val = float(d.get("tzero") or 0.0) if raw_mode else 0.0
             per_subint = []
             for j, isub in enumerate(ok):
                 # degenerate-geometry demotion — the SAME helper
@@ -1980,23 +2165,29 @@ def make_wideband_lane(modelfile, nsub_batch=256, fit_DM=True,
                 eff_flags = effective_fit_flags(nchx[j], base_flags)
                 key = base_key + (eff_flags, kind)
                 if raw_mode:
-                    key += (raw_code, pol_sum)
+                    key += (raw_code, pol_sum, col_scaled)
 
                 def factory(freqs_b=freqs_b, nbin=nbin, modelx=modelx,
                             eff_flags=eff_flags, kind=kind,
                             ir_FT=ir_FT, raw_code=raw_code,
-                            pol_sum=pol_sum):
+                            pol_sum=pol_sum, col_scaled=col_scaled):
                     return _Bucket(freqs_b, nbin, modelx, eff_flags,
                                    kind=kind, ir_FT=ir_FT,
-                                   raw_code=raw_code, pol_sum=pol_sum)
+                                   raw_code=raw_code, pol_sum=pol_sum,
+                                   col_scaled=col_scaled)
 
                 def fill(b, j=j, isub=int(isub), d=d, masks_b=masks_b,
                          DM_guess=DM_guess, raw_mode=raw_mode,
-                         iarch=iarch, pad_c=pad_c):
+                         iarch=iarch, pad_c=pad_c,
+                         col_scaled=col_scaled, tscal_val=tscal_val,
+                         tzero_val=tzero_val):
                     if raw_mode:
                         raw_row = d.raw[isub]
                         scl_row = d.scl[isub]
                         offs_row = d.offs[isub]
+                        if col_scaled:
+                            b.tscal.append(tscal_val)
+                            b.tzero.append(tzero_val)
                         if pad_c:
                             # pad channels REPLICATE the edge channel
                             # (samples and scl/offs), not zeros: the
@@ -2264,6 +2455,8 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                         scatter_s=round(ex.scatter_duration, 6),
                         h2d_s=round(ex.h2d_duration, 6),
                         h2d_bytes=int(ex.h2d_bytes),
+                        h2d_bytes_logical=int(ex.h2d_logical_bytes),
+                        codec_s=round(ex.codec_duration, 6),
                         h2d_overlap_s=round(ex.h2d_overlap_duration, 6),
                         wall_s=round(tot, 6),
                         devices_used=len(ex.devices_used),
@@ -2279,6 +2472,8 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                      devices_used=len(ex.devices_used),
                      peak_inflight=ex.peak_inflight,
                      h2d_bytes=int(ex.h2d_bytes),
+                     h2d_bytes_logical=int(ex.h2d_logical_bytes),
+                     codec_duration=ex.codec_duration,
                      h2d_duration=ex.h2d_duration)
 
 
@@ -2342,10 +2537,12 @@ def _nb_fit_fields(x, modelx, noise, cmask, freqs, Ps, ft, nbin,
 
 @lru_cache(maxsize=None)
 def _raw_nb_fn(nchan, nbin, fit_scat, log10_tau, tau_mode, max_iter,
-               ftname, redisp, raw_code="i16", pol_sum=False):
+               ftname, redisp, raw_code="i16", pol_sum=False,
+               col_scaled=False):
     """ONE jitted program for a narrowband raw bucket: sample decode
     (_raw_decode — shared with the wideband program, so the two lanes
-    cannot drift on sample types or the pol reduction), baseline,
+    cannot drift on sample types, sub-byte unpack, column scaling, or
+    the pol reduction), baseline,
     optional re-dispersion, then per-channel 1-D fits —
     fit_phase_shift_batch (no scattering) or the 5-param engine with
     (phi, tau) per single-channel portrait (get_narrowband_TOAs'
@@ -2357,10 +2554,13 @@ def _raw_nb_fn(nchan, nbin, fit_scat, log10_tau, tau_mode, max_iter,
     tiny = float(np.finfo(ftname).tiny)
 
     def run(raw, scl, offs, cmask, modelx, freqs, Ps,
-            tau_s, tau_nu, tau_a, redisp_turns):
+            tau_s, tau_nu, tau_a, redisp_turns, tscal=None,
+            tzero=None):
         x = _raw_decode(raw, scl, offs, nbin, ft, redisp=redisp,
                         redisp_turns=redisp_turns, code=raw_code,
-                        pol_sum=pol_sum)
+                        pol_sum=pol_sum,
+                        tscal=tscal if col_scaled else None,
+                        tzero=tzero if col_scaled else None)
         noise = jnp.maximum(get_noise_PS(x), tiny)
         fields = _nb_fit_fields(x, modelx, noise, cmask, freqs, Ps,
                                 ft, nbin, fit_scat, log10_tau, tau_mode,
@@ -2385,9 +2585,10 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
     (get_narrowband_TOAs semantics; the reference left the narrowband
     scattering fit "NOT YET IMPLEMENTED", pptoas.py:1046-1049).
 
-    Non-raw-compatible archives (sub-byte NBIT packing, general
-    TSCAL/TZERO scaling) fall back to a host-decoded dispatch of the
-    same device fits.
+    Raw mode covers the full sample-type matrix (sub-byte NBIT packed
+    payloads and general TSCAL/TZERO included — see _load_raw); the
+    remaining non-raw-representable layouts fall back to a
+    host-decoded dispatch of the same device fits.
     tim_out / resume / skip_archives / stream_devices / max_inflight /
     pipeline_depth / telemetry follow stream_wideband_TOAs
     (per-archive completion sentinels; round-robin multi-device
@@ -2489,10 +2690,16 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
         nbin = b.nbin
         if b.kind == "raw":
             rows, dedisp, redisp = _raw_rows(b, idx0)
+            col_scaled = b.col_scaled
+            tscal_h = (np.asarray([b.tscal[i] for i in idx0])
+                       if col_scaled else None)
+            tzero_h = (np.asarray([b.tzero[i] for i in idx0])
+                       if col_scaled else None)
             fn = _raw_nb_fn(len(np.asarray(freqs)), nbin,
                             bool(fit_scat), bool(log10_tau), tau_mode,
                             int(max_iter), ftname, redisp,
-                            raw_code=b.raw_code, pol_sum=b.pol_sum)
+                            raw_code=b.raw_code, pol_sum=b.pol_sum,
+                            col_scaled=col_scaled)
 
             def copy():
                 raw, scl, offs, turns = _stack_rows(rows, dedisp,
@@ -2501,18 +2708,20 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
                 nbytes = [0]
                 put = _byte_put(device, nbytes)
                 with _on_device(device):
+                    tscal_d = put(tscal_h, ft) if col_scaled else None
+                    tzero_d = put(tzero_h, ft) if col_scaled else None
                     args = (put(raw), put(scl, ft), put(offs, ft),
                             put(masks, ft), put(modelx, ft),
                             put(freqs, ft), put(Ps, ft),
-                            put(turns, ft))
+                            put(turns, ft), tscal_d, tzero_d)
                 return args, nbytes[0]
 
             def fit(raw_d, scl_d, offs_d, masks_d, modelx_d, freqs_d,
-                    Ps_d, turns_d):
+                    Ps_d, turns_d, tscal_d, tzero_d):
                 with _on_device(device):
                     return fn(raw_d, scl_d, offs_d, masks_d, modelx_d,
                               freqs_d, Ps_d, ft(t_s), ft(t_nu),
-                              ft(t_a), turns_d)
+                              ft(t_a), turns_d, tscal_d, tzero_d)
         else:
             ports_rows = [b.ports[i] for i in idx0]
             noise_rows = [b.noise[i] for i in idx0]
@@ -2564,10 +2773,15 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
             raw_mode = bool(d.get("raw_mode", False))
             raw_code = str(d.get("raw_code") or "i16")
             pol_sum = bool(d.get("pol_sum", False))
+            col_scaled = raw_mode and (d.get("tscal") is not None
+                                       or d.get("tzero") is not None)
+            tscal_val = float(d.get("tscal") or 1.0) if raw_mode else 1.0
+            tzero_val = float(d.get("tzero") or 0.0) if raw_mode else 0.0
             masks = np.asarray(d.weights[ok] > 0.0, float)
             key = (nchan, nbin, freqs0.tobytes(),
                    "raw" if raw_mode else "dec") + (
-                       (raw_code, pol_sum) if raw_mode else ()) + (
+                       (raw_code, pol_sum, col_scaled)
+                       if raw_mode else ()) + (
                        (round(P_mean, 12),) if p_dependent else ())
             m = DataBunch(
                 datafile=datafile, iarch=iarch, ok=ok, nbin=nbin,
@@ -2583,20 +2797,26 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
 
             def factory(freqs0=freqs0, nbin=nbin, modelx=modelx,
                         raw_mode=raw_mode, raw_code=raw_code,
-                        pol_sum=pol_sum):
+                        pol_sum=pol_sum, col_scaled=col_scaled):
                 return _Bucket(freqs0, nbin, modelx, (),
                                kind="raw" if raw_mode else "dec",
-                               raw_code=raw_code, pol_sum=pol_sum)
+                               raw_code=raw_code, pol_sum=pol_sum,
+                               col_scaled=col_scaled)
 
             per_subint = []
             for j, isub in enumerate(ok):
 
                 def fill(b, j=j, isub=int(isub), d=d, masks=masks,
-                         raw_mode=raw_mode, iarch=iarch):
+                         raw_mode=raw_mode, iarch=iarch,
+                         col_scaled=col_scaled, tscal_val=tscal_val,
+                         tzero_val=tzero_val):
                     if raw_mode:
                         b.raw.append(d.raw[isub])
                         b.scl.append(d.scl[isub])
                         b.offs.append(d.offs[isub])
+                        if col_scaled:
+                            b.tscal.append(tscal_val)
+                            b.tzero.append(tzero_val)
                         # reference frequency honors the REF_FREQ card
                         b.dedisp.append(
                             (float(d.DM) if d.get("dmc") else 0.0,
@@ -2661,6 +2881,8 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
                         scatter_s=round(ex.scatter_duration, 6),
                         h2d_s=round(ex.h2d_duration, 6),
                         h2d_bytes=int(ex.h2d_bytes),
+                        h2d_bytes_logical=int(ex.h2d_logical_bytes),
+                        codec_s=round(ex.codec_duration, 6),
                         h2d_overlap_s=round(ex.h2d_overlap_duration, 6),
                         wall_s=round(tot, 6),
                         devices_used=len(ex.devices_used),
@@ -2674,4 +2896,5 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
                      devices_used=len(ex.devices_used),
                      peak_inflight=ex.peak_inflight,
                      h2d_bytes=int(ex.h2d_bytes),
+                     h2d_bytes_logical=int(ex.h2d_logical_bytes),
                      h2d_duration=ex.h2d_duration)

@@ -30,7 +30,10 @@ Design constraints, in order:
   bytes.
 
 Wire protocol (SocketTransport <-> TransportServer): every frame is a
-4-byte big-endian length followed by a UTF-8 JSON object.  Ops:
+4-byte big-endian length followed by a UTF-8 JSON object; a set top
+bit in the length marks a zlib-compressed body
+(config.transport_compress — big result frames shrink severalfold,
+and plain frames stay bit-identical to prior releases).  Ops:
 
   {"op": "submit", "datafiles": [...], "modelfile": m,
    "tim_out": p|null, "name": n|null, "tenant": t|null,
@@ -60,6 +63,7 @@ import json
 import socket
 import struct
 import threading
+import zlib
 
 from ..telemetry import log
 from .queue import ServeRejected
@@ -71,6 +75,19 @@ __all__ = ["TransportError", "RemoteRequestError", "InProcTransport",
 # A frame above this is a protocol violation, not a big request: the
 # largest legitimate payload is a result frame (~200 bytes per TOA).
 MAX_FRAME = 256 * 1024 * 1024
+# Compressed-frame marker (ISSUE 15): the top bit of the 4-byte length
+# prefix is free (MAX_FRAME < 2**31), so a set bit means "the body is
+# zlib-compressed JSON" — both peers in this repo understand it; plain
+# frames are bit-identical to every prior release.
+_FRAME_ZLIB = 0x80000000
+# A frame smaller than this never compresses: the zlib call costs more
+# than any conceivable link saving (result frames are ~200 bytes/TOA,
+# so only multi-hundred-TOA results cross it).
+COMPRESS_MIN_FRAME = 64 * 1024
+# Static socket cost model for 'auto': engage only when zlib saves at
+# least this fraction of the frame — below it the decompress wall on
+# the peer rivals the wire saving on any LAN-class link.
+COMPRESS_MIN_SAVING = 0.125
 # Per-poll server-side block in the result op; the client loops.
 RESULT_POLL_S = 0.25
 # Per-round-trip server-side block in the drain op — must stay well
@@ -118,7 +135,24 @@ from .codec import roundtrip_result as _roundtrip_result  # noqa: E402
 # ---------------------------------------------------------------------------
 
 def _send_frame(sock, obj):
+    """Send one length-prefixed JSON frame, zlib-compressing the body
+    when ``config.transport_compress`` allows and the frame is big
+    enough to pay for it ('auto' = the static size/saving rule above;
+    True = whenever smaller; False = never — byte-identical to every
+    prior release).  The receiver keys on the length prefix's top bit,
+    so mixed traffic on one connection is fine."""
+    from ..io.blockcodec import resolve_transport_compress
+
     body = json.dumps(obj, separators=(",", ":")).encode()
+    mode = resolve_transport_compress()
+    if mode is not False and len(body) >= COMPRESS_MIN_FRAME:
+        comp = zlib.compress(body, 1)
+        saving = 1.0 - len(comp) / len(body)
+        if (mode is True and len(comp) < len(body)) or \
+                (mode == "auto" and saving >= COMPRESS_MIN_SAVING):
+            sock.sendall(struct.pack(
+                ">I", len(comp) | _FRAME_ZLIB) + comp)
+            return
     sock.sendall(struct.pack(">I", len(body)) + body)
 
 
@@ -135,10 +169,27 @@ def _recv_exact(sock, n):
 def _recv_frame(sock):
     head = _recv_exact(sock, 4)
     (n,) = struct.unpack(">I", head)
+    compressed = bool(n & _FRAME_ZLIB)
+    n &= ~_FRAME_ZLIB
     if n > MAX_FRAME:
         raise TransportError(f"frame of {n} bytes exceeds the "
                              f"{MAX_FRAME}-byte protocol limit")
-    return json.loads(_recv_exact(sock, n).decode())
+    body = _recv_exact(sock, n)
+    if compressed:
+        # bounded inflate: the limit must be enforced DURING
+        # decompression (a hostile frame within MAX_FRAME compressed
+        # can inflate ~1000x — a plain zlib.decompress would attempt
+        # the full allocation before any post-hoc size check runs)
+        try:
+            d = zlib.decompressobj()
+            body = d.decompress(body, MAX_FRAME + 1)
+        except zlib.error as e:
+            raise TransportError(f"bad compressed frame: {e}")
+        if len(body) > MAX_FRAME or d.unconsumed_tail:
+            raise TransportError(
+                f"compressed frame inflates past the {MAX_FRAME}-byte "
+                "protocol limit")
+    return json.loads(body.decode())
 
 
 # ---------------------------------------------------------------------------

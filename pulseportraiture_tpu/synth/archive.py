@@ -69,7 +69,7 @@ def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
                      alpha=scattering_alpha, scint=False, xs=None, Cs=None,
                      nu_DM=np.inf, state="Stokes", telescope="GBT",
                      quiet=False, rng=None, barycentred=True,
-                     spin_coherent=False):
+                     spin_coherent=False, nbit=16, levels=None):
     """Generate a fake fold-mode PSRFITS archive with known injected
     parameters and write it to ``outfile``.  Returns the Archive.
 
@@ -103,6 +103,12 @@ def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
     (e.g. A1 = 0.05 lt-s, PB = 1 d, P = 4 ms leaves < 0.01 us).
     Binary keys without spin_coherent=True are
     ignored (grid-aligned archives carry no absolute phase at all).
+
+    ``nbit``/``levels`` select the written DATA sample width and
+    quantization depth (io/psrfits.write_archive_file): nbit=2 forges
+    the sub-byte packed archives the raw streaming lane ships 32x
+    smaller; levels=4 with nbit=8 forges the coarsely-quantized byte
+    archives the transport-compression cost model packs on the fly.
     """
     rng = np.random.default_rng(rng)
     model = read_gmodel(modelfile, quiet=True) \
@@ -215,7 +221,7 @@ def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
         arch.primary["PPTBARY"] = True
     if not dedispersed:
         arch.dededisperse()
-    arch.unload(outfile)
+    arch.unload(outfile, nbit=nbit, levels=levels)
     if not quiet:
         print(f"\nUnloaded {outfile}.\n")
     return arch

@@ -68,6 +68,7 @@ CONFIG_SNAPSHOT_KEYS = (
     "serve_tenant_quota", "serve_tenant_weight",
     "use_fast_fit", "use_matmul_dft", "fit_harmonic_window",
     "scatter_compensated", "lm_jacobian", "fit_fused",
+    "raw_subbyte", "transport_compress",
 )
 
 # The event vocabulary: type -> fields REQUIRED beyond (type, t).
@@ -90,9 +91,15 @@ EVENT_FIELDS = {
     # worker as the bucket's host->device move begins (overlap = a fit
     # was in flight on that device, i.e. the link is hidden behind
     # compute); h2d_done carries the byte count and duration pptrace's
-    # link-utilization section aggregates
+    # link-utilization section aggregates, plus the compression
+    # accounting (ISSUE 15): bytes_logical = what the copy would have
+    # shipped without the transport codec (== bytes when it never
+    # engaged), codec_s = the probe/encode wall, and an optional
+    # 'codec' decision tag ('engaged' | 'cost' | 'ratio') forming the
+    # cost-model decision ledger
     "h2d_start": {"seq", "device", "overlap"},
-    "h2d_done": {"seq", "device", "bytes", "h2d_s", "overlap"},
+    "h2d_done": {"seq", "device", "bytes", "h2d_s", "overlap",
+                 "bytes_logical", "codec_s"},
     "drain": {"seq", "device", "wait_s", "scatter_s"},
     "quality": {"snr", "gof", "nfev"},
     "archive_done": {"iarch", "datafile"},
@@ -634,10 +641,22 @@ def report(path, file=None):
     # ---- h2d link utilization ---------------------------------------
     h2d = by_type.get("h2d_done", [])
     h2d_bytes = sum(int(ev["bytes"]) for ev in h2d)
+    # pre-compression traces (schema < this release) lack the logical
+    # fields; shipped == logical there
+    h2d_bytes_logical = sum(int(ev.get("bytes_logical", ev["bytes"]))
+                            for ev in h2d)
+    codec_s_total = sum(float(ev.get("codec_s", 0.0)) for ev in h2d)
+    codec_decisions = {}
+    for ev in h2d:
+        dec = ev.get("codec")
+        if dec is not None:
+            codec_decisions[dec] = codec_decisions.get(dec, 0) + 1
     h2d_s = sum(float(ev["h2d_s"]) for ev in h2d)
     h2d_overlap_s = sum(float(ev["h2d_s"]) for ev in h2d
                         if ev.get("overlap"))
     h2d_stall_frac = (1.0 - h2d_overlap_s / h2d_s) if h2d_s > 0 else None
+    h2d_compression = (h2d_bytes_logical / h2d_bytes
+                       if h2d_bytes else None)
     p("")
     p("-- h2d link (copy stage) --")
     if h2d:
@@ -657,16 +676,36 @@ def report(path, file=None):
           "stage could not hide; lower pipeline stalls = raise "
           "stream_pipeline_depth only if this is high AND devices "
           "idle)")
+        # transport-compression accounting (ISSUE 15): shipped vs
+        # logical bytes, codec wall, and the cost-model decision
+        # ledger — a trace with no decisions recorded compressed
+        # nothing (transport_compress off, or no eligible payloads)
+        if h2d_bytes_logical != h2d_bytes or codec_decisions:
+            saved = h2d_bytes_logical - h2d_bytes
+            ratio = h2d_compression or 1.0
+            p(f"  compression: shipped {h2d_bytes / 1e6:.2f} MB of "
+              f"{h2d_bytes_logical / 1e6:.2f} MB logical "
+              f"({ratio:.2f}x, {saved / 1e6:.2f} MB saved), codec "
+              f"wall {codec_s_total:.3f} s")
+            if codec_decisions:
+                parts = ", ".join(
+                    f"{n} {k}" for k, n in sorted(
+                        codec_decisions.items()))
+                p(f"  cost-model decisions: {parts} ('engaged' = "
+                  "packed; 'cost' = model predicted a loss; 'ratio' "
+                  "= payload incompressible)")
         per_dev_h2d = {}
         for ev in h2d:
-            d = per_dev_h2d.setdefault(ev["device"], [0, 0.0, 0.0])
+            d = per_dev_h2d.setdefault(ev["device"], [0, 0.0, 0.0, 0])
             d[0] += int(ev["bytes"])
             d[1] += float(ev["h2d_s"])
             d[2] += float(ev["h2d_s"]) if ev.get("overlap") else 0.0
+            d[3] += int(ev.get("bytes_logical", ev["bytes"]))
         for dev in sorted(per_dev_h2d):
-            b, s, o = per_dev_h2d[dev]
+            b, s, o, lg = per_dev_h2d[dev]
+            comp = (f", {lg / b:.2f}x compression" if lg != b else "")
             p(f"  dev{dev}: {b / 1e6:.2f} MB, {s:.3f} s, "
-              f"{100 * (o / s if s else 0.0):.1f}% overlapped")
+              f"{100 * (o / s if s else 0.0):.1f}% overlapped{comp}")
     else:
         p("  (no h2d events — pre-pipeline trace, or no dispatches)")
 
@@ -1056,6 +1095,10 @@ def report(path, file=None):
         "cold_s": cold_s,
         "n_h2d": len(h2d),
         "h2d_bytes": h2d_bytes,
+        "h2d_bytes_logical": h2d_bytes_logical,
+        "h2d_compression": h2d_compression,
+        "codec_s": codec_s_total,
+        "codec_decisions": codec_decisions,
         "h2d_s": h2d_s,
         "h2d_stall_frac": h2d_stall_frac,
         "n_quality": len(snr),

@@ -142,8 +142,11 @@ def warmup_from_manifest(manifest_path, modelfile=None, devices=None,
         tau_mode, tau_args = "none", (0.0, 1.0, 0.0)
     if not fit_scat:
         log10_tau = False
+    from ..ops.decode import PACKED_BITS as packed_bits
+
     wire = {"i16": np.int16, "u8": np.uint8, "i8": np.uint8,
-            "f32": np.float32}
+            "f32": np.float32, "p1": np.uint8, "p2": np.uint8,
+            "p4": np.uint8}
 
     rng = np.random.default_rng(0)
     warmed = []
@@ -176,18 +179,32 @@ def warmup_from_manifest(manifest_path, modelfile=None, devices=None,
             prof = np.exp(-0.5 * ((ph - 0.3) / 0.02) ** 2)
             modelx = np.broadcast_to(prof, (nchan, nbin)).copy()
 
+        if spec["raw_code"] in packed_bits \
+                and (nchan * nbin * packed_bits[spec["raw_code"]]) \
+                % 8 != 0:
+            log(f"warmup: skipping {shape!r} (sub-byte plane does "
+                "not byte-align)", level="warn")
+            continue
         for idev, dev in enumerate(devices):
             b = S._Bucket(freqs, nbin, modelx, spec["flags"],
                           kind=spec["kind"],
                           raw_code=spec["raw_code"],
-                          pol_sum=spec["pol_sum"])
+                          pol_sum=spec["pol_sum"],
+                          col_scaled=spec.get("col_scaled", False))
             # ONE row; _launch pads to nsub_batch — the real batch
             # shape class.  Values are arbitrary (compiles key on
             # shape/dtype); the DM guess is NONZERO so the general
             # seed-derotation program compiles, matching real archives
             if spec["kind"] == "raw":
-                rshape = ((2, nchan, nbin) if spec["pol_sum"]
-                          else (nchan, nbin))
+                nbit = packed_bits.get(spec["raw_code"])
+                if nbit is not None:
+                    # packed payload rows: the byte-aligned pol plane
+                    plane_bytes = nchan * nbin * nbit // 8
+                    rshape = ((2, plane_bytes) if spec["pol_sum"]
+                              else (plane_bytes,))
+                else:
+                    rshape = ((2, nchan, nbin) if spec["pol_sum"]
+                              else (nchan, nbin))
                 cshape = (2, nchan) if spec["pol_sum"] else (nchan,)
                 if spec["raw_code"] == "f32":
                     b.raw.append(rng.standard_normal(rshape)
@@ -197,6 +214,9 @@ def warmup_from_manifest(manifest_path, modelfile=None, devices=None,
                                  .astype(wire[spec["raw_code"]]))
                 b.scl.append(np.ones(cshape, np.float32))
                 b.offs.append(np.zeros(cshape, np.float32))
+                if spec.get("col_scaled"):
+                    b.tscal.append(0.5)
+                    b.tzero.append(1.0)
                 b.DM_guess.append(1.0)
                 b.dedisp.append((0.0, 0.0))
             else:

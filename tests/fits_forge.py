@@ -124,7 +124,8 @@ def forge_archive(path, nsub=2, nchan=8, nbin=64, npol=1,
                   tdim_style=None, ragged_freqs=False, freq0=1400.0,
                   chan_bw=25.0, period=0.005, dm=12.5, dedisp=0,
                   polyco_rows=0, extra_primary=(), src="FORGE",
-                  extra_subint_cards=(), omit_dm_card=False):
+                  extra_subint_cards=(), omit_dm_card=False,
+                  data_tscal=None, data_tzero=None, quant_span=None):
     """Write a hand-forged PSRFITS fold-mode archive and return the
     float64 data cube a correct loader should produce (after DAT_SCL /
     DAT_OFFS application, before any baseline removal).
@@ -135,6 +136,13 @@ def forge_archive(path, nsub=2, nchan=8, nbin=64, npol=1,
     unsigned, physical = stored - 128), '>f4' (float samples, unit
     scale), or 'nbit1'/'nbit2'/'nbit4' (sub-byte packed unsigned
     samples, MSB-first, NBIT card written).
+    data_tscal/data_tzero: GENERAL FITS column scaling on the integer
+    DATA column (TSCALn/TZEROn cards beyond the signed-byte
+    convention): physical = (stored*TSCAL + TZERO)*DAT_SCL + DAT_OFFS
+    — the layout the raw lane ships with its two scaling scalars.
+    quant_span: quantize to this many stored levels instead of the
+    dtype's full range (a coarsely-quantizing backend; the dynamic
+    range the transport codec packs).
     extra_subint_cards: appended to the SUBINT header (CHAN_DM,
     REF_FREQ, EPOCHS, ...).  omit_dm_card drops the SUBINT DM card so
     fallback chains (CHAN_DM, PSRPARAM) are exercised.
@@ -206,14 +214,39 @@ def forge_archive(path, nsub=2, nchan=8, nbin=64, npol=1,
         hi = true.max(axis=-1)
         span = {1: 250.0, 2: 65000.0}[dt.itemsize]
         zero = {1: 125.0, 2: 0.0}[dt.itemsize]  # u1 is offset-binary
+        if quant_span is not None:
+            # coarse quantization: fewer stored levels than the dtype
+            # allows — the stored values' dynamic range is quant_span
+            span = float(quant_span)
         s_ = np.maximum((hi - lo) / span, 1e-12)
         o_ = (hi + lo) / 2.0
         q = np.round((true - o_[..., None]) / s_[..., None] + zero)
         data[:] = q.astype(dt)
-        scl[:] = s_.astype(">f4")
-        offs[:] = (o_ - zero * s_).astype(">f4")
-        stored = q.astype(np.float64) * s_[..., None] + \
-            (o_ - zero * s_)[..., None]
+        dat_scl = s_
+        dat_offs = o_ - zero * s_
+        if data_tscal is not None or data_tzero is not None:
+            # general column scaling: the host decode is
+            # (q*TSCAL + TZERO)*DAT_SCL + DAT_OFFS, so fold the
+            # inverse into the written DAT_SCL/DAT_OFFS — the stored
+            # integers (and the returned truth) are unchanged
+            ts = 1.0 if data_tscal is None else float(data_tscal)
+            tz = 0.0 if data_tzero is None else float(data_tzero)
+            if signed_byte:
+                raise ValueError("data_tscal/tzero cannot combine "
+                                 "with the signed-byte convention")
+            dat_scl = s_ / ts
+            dat_offs = dat_offs - tz * dat_scl
+        scl[:] = dat_scl.astype(">f4")
+        offs[:] = dat_offs.astype(">f4")
+        if data_tscal is not None or data_tzero is not None:
+            # truth through the f32 DAT_SCL/DAT_OFFS the file carries
+            # (the folded inverse is not exactly representable in f32)
+            sclf = dat_scl.astype(">f4").astype(np.float64)
+            offf = dat_offs.astype(">f4").astype(np.float64)
+            stored = (q * ts + tz) * sclf[..., None] + offf[..., None]
+        else:
+            stored = q.astype(np.float64) * s_[..., None] + \
+                (o_ - zero * s_)[..., None]
     if not with_scl_offs and dt.kind != "f":
         raise ValueError("integer DATA without DAT_SCL makes no sense")
 
@@ -264,6 +297,13 @@ def forge_archive(path, nsub=2, nchan=8, nbin=64, npol=1,
     prim += list(extra_primary)
 
     ccards = {"DATA": {"TZERO": -128.0}} if signed_byte else None
+    if data_tscal is not None or data_tzero is not None:
+        dc = {}
+        if data_tscal is not None:
+            dc["TSCAL"] = float(data_tscal)
+        if data_tzero is not None:
+            dc["TZERO"] = float(data_tzero)
+        ccards = {"DATA": dc}
     blobs = [primary_hdu(prim),
              bintable_hdu("SUBINT", cols, extra_cards=sub_cards,
                           tdim_overrides=tdims, col_cards=ccards)]

@@ -94,7 +94,8 @@ TINY_ENV = {
 
 _CONFIG_KEYS = ("dft_precision", "cross_spectrum_dtype", "dft_fold",
                 "scatter_compensated", "fit_harmonic_window",
-                "telemetry_path", "fit_fused", "lm_jacobian")
+                "telemetry_path", "fit_fused", "lm_jacobian",
+                "raw_subbyte", "transport_compress")
 
 
 def test_all_bench_scripts_covered():
@@ -322,6 +323,25 @@ def test_bench_smoke(name, monkeypatch, capsys, tmp_path):
             for ev in h2d_done:
                 assert ev["bytes"] > 0 and ev["h2d_s"] >= 0
                 assert isinstance(ev["overlap"], bool)
+                # ISSUE 15: the compression-accounting fields are
+                # schema-required on every h2d_done now
+                assert ev["bytes_logical"] >= ev["bytes"]
+                assert ev["codec_s"] >= 0
+        # ISSUE 15: the sub-byte arm's >= 8x byte gate and digit gate
+        # are enforced INSIDE the bench at every shape; re-checked
+        # structurally here so a silently skipped arm fails CI
+        sub = out["subbyte"]
+        assert sub["tim_identical"] is True
+        assert sub["byte_ratio"] >= 8.0
+        assert sub["packed_bytes"] < sub["fallback_bytes"]
+        # the compression arm: 'on' shrinks shipped bytes at identical
+        # .tim; 'auto' never engages on the bare-CPU smoke link
+        cmp_arm = out["compression"]
+        assert cmp_arm["tim_identical"] is True
+        assert cmp_arm["compress_ratio_on"] > 1.0
+        assert cmp_arm["True"]["h2d_bytes"] < \
+            cmp_arm["False"]["h2d_bytes"]
+        assert cmp_arm["auto_engaged"] is False
 
 
 def test_bench_root_fused_arm(monkeypatch, capsys):

@@ -421,3 +421,80 @@ def test_fuzz_malformed_refuses_in_native_lane(kind, seed, tmp_path):
     path = _forge_malformed(kind, rng, tmp_path)
     with pytest.raises((ValueError, KeyError)):
         _native_decode_tables(str(path))
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15: sub-byte NBIT packed layouts + general TSCAL/TZERO DATA,
+# fuzzed through the full archive loader AND the raw transport lane
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fuzz_packed_nbit_layouts(seed, tmp_path):
+    """Randomized sub-byte NBIT archives (width, geometry, subint
+    count) decode EXACTLY through the archive loader, and the packed
+    raw lane's payload unpacks bit-identically to the host unpack
+    wherever its byte-alignment contract holds."""
+    from fits_forge import forge_archive
+
+    from pulseportraiture_tpu.io.psrfits import read_archive
+
+    rng = np.random.default_rng(3000 + seed)
+    nbit = int(rng.choice([1, 2, 4]))
+    nsub = int(rng.integers(1, 4))
+    nchan = int(rng.integers(2, 10))
+    # nbin a multiple of 8: every real fold-mode archive is, and it
+    # keeps the plane byte-aligned for the raw-lane half below
+    nbin = 8 * int(rng.integers(2, 9))
+    path = str(tmp_path / "packed.fits")
+    stored, freqs = forge_archive(path, nsub=nsub, nchan=nchan,
+                                  nbin=nbin, data_dtype=f"nbit{nbit}")
+    arch = read_archive(path)
+    np.testing.assert_allclose(arch.amps, stored, rtol=1e-6, atol=1e-7)
+
+    raw = read_archive(path, decode=False)
+    assert raw.raw_code == f"p{nbit}"
+    per = 8 // nbit
+    assert raw.raw_data.shape == (nsub, 1, nchan * nbin // per)
+    # bit identity: host-side unpack of the shipped payload must
+    # reproduce the loader's decode exactly through DAT_SCL/DAT_OFFS
+    shifts = (np.arange(per - 1, -1, -1) * nbit).astype(np.uint8)
+    v = (raw.raw_data[..., :, None] >> shifts) & ((1 << nbit) - 1)
+    v = v.reshape(nsub, 1, nchan, nbin).astype(np.float64)
+    dec = v * raw.raw_scl[..., None] + raw.raw_offs[..., None]
+    np.testing.assert_allclose(dec, arch.amps, rtol=0, atol=1e-5)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_general_tscal_tzero_data(seed, tmp_path):
+    """Randomized general TSCAL/TZERO DATA columns (beyond the
+    signed-byte convention) decode exactly on the host loader and
+    attach their scalars in raw mode — the host order
+    (stored*TSCAL + TZERO)*DAT_SCL + DAT_OFFS is the contract the
+    device decode mirrors."""
+    from fits_forge import forge_archive
+
+    from pulseportraiture_tpu.io.psrfits import read_archive
+
+    rng = np.random.default_rng(4000 + seed)
+    dt = str(rng.choice([">i2", "u1"]))
+    # exactly-representable scalings so the truth comparison is exact
+    tscal = float(rng.choice([0.5, 0.25, 2.0]))
+    tzero = float(rng.choice([-3.0, 0.0, 7.5]))
+    nsub = int(rng.integers(1, 4))
+    nchan = int(rng.integers(2, 8))
+    nbin = 8 * int(rng.integers(2, 6))
+    path = str(tmp_path / "tscal.fits")
+    stored, freqs = forge_archive(path, nsub=nsub, nchan=nchan,
+                                  nbin=nbin, data_dtype=dt,
+                                  data_tscal=tscal, data_tzero=tzero)
+    arch = read_archive(path)
+    np.testing.assert_allclose(arch.amps, stored, rtol=0, atol=1e-9)
+
+    raw = read_archive(path, decode=False)
+    assert raw.raw_tscal == tscal
+    assert raw.raw_tzero == tzero
+    # host-order reconstruction from the shipped pieces is exact
+    dec = (raw.raw_data.astype(np.float64) * tscal + tzero) \
+        * raw.raw_scl.astype(np.float64)[..., None] \
+        + raw.raw_offs.astype(np.float64)[..., None]
+    np.testing.assert_allclose(dec, arch.amps, rtol=0, atol=1e-9)

@@ -259,10 +259,18 @@ def test_signed_byte_data_tzero(tmp_path):
         * np.asarray(raw.raw_scl, np.float64)[..., None] \
         + np.asarray(raw.raw_offs, np.float64)[..., None]
     np.testing.assert_allclose(dec, stored, rtol=0, atol=1e-6)
-    # layouts raw mode still cannot represent refuse cleanly
+    # sub-byte layouts ship PACKED since r18 (raw code 'p4'); the
+    # PPT_RAW_SUBBYTE escape hatch restores the decoded fallback
     forge_archive(str(tmp_path / "nbit.fits"), data_dtype="nbit4")
-    with pytest.raises(ValueError, match="int16/byte/float32"):
-        read_archive(str(tmp_path / "nbit.fits"), decode=False)
+    assert read_archive(str(tmp_path / "nbit.fits"),
+                        decode=False).raw_code == "p4"
+    from pulseportraiture_tpu import config
+    try:
+        config.raw_subbyte = False
+        with pytest.raises(ValueError, match="sub-byte"):
+            read_archive(str(tmp_path / "nbit.fits"), decode=False)
+    finally:
+        config.raw_subbyte = True
 
 
 def test_chan_dm_fallback_and_dedispersion(tmp_path):

@@ -65,6 +65,16 @@ RAW_CASES = {
               "i16", False),
     "aabb": (dict(data_dtype=">i2", npol=2, pol_type="AA+BB"),
              "i16", True),
+    # ISSUE 15: sub-byte packed payloads ship PACKED and unpack on
+    # device; general TSCAL/TZERO ships its scalars
+    "nbit2": (dict(data_dtype="nbit2"), "p2", False),
+    "nbit4": (dict(data_dtype="nbit4"), "p4", False),
+    "nbit2_aabb": (dict(data_dtype="nbit2", npol=2,
+                        pol_type="AA+BB"), "p2", True),
+    "tscal_i16": (dict(data_dtype=">i2", data_tscal=0.5,
+                       data_tzero=2.0), "i16", False),
+    "tscal_u8": (dict(data_dtype="u1", data_tscal=0.25,
+                      data_tzero=-3.0), "u8", False),
 }
 
 
@@ -82,6 +92,9 @@ def test_raw_lane_universal_digit_identical(case, tmp_path,
     assert d.pol_sum is want_sum
     if want_sum:
         assert d.raw.shape[1] == 2  # two summand pols ship
+    if "data_tscal" in kw:
+        assert d.tscal == kw["data_tscal"]
+        assert d.tzero == kw["data_tzero"]
 
     tim_raw = str(tmp_path / "raw.tim")
     r1 = S.stream_wideband_TOAs([f], tmpl, nsub_batch=4, quiet=True,
@@ -101,15 +114,71 @@ def test_raw_lane_universal_digit_identical(case, tmp_path,
     assert open(tim_raw).read() == open(tim_dec).read()
 
 
-def test_raw_refuses_sub_byte_and_scaled(tmp_path):
-    """Layouts raw mode cannot represent keep refusing loudly (the
-    loader then falls back to the decoded lane)."""
-    nchan, nbin = 8, 64
-    f = str(tmp_path / "nbit4.fits")
-    forge_archive(f, nsub=1, nchan=nchan, nbin=nbin,
-                  data_dtype="nbit4")
+def test_raw_narrowband_packed_digit_identical(tmp_path, monkeypatch):
+    """The NARROWBAND streaming lane's raw path must engage for a
+    packed archive and match its decoded-fallback oracle per channel
+    (the 'both streaming lanes' digit gate)."""
+    f, tmpl = _forge_and_template(tmp_path, "nbpacked",
+                                  data_dtype="nbit4")
+    tim_raw = str(tmp_path / "nb_raw.tim")
+    r1 = S.stream_narrowband_TOAs([f], tmpl, nsub_batch=4, quiet=True,
+                                  tim_out=tim_raw)
+    assert len(r1.TOA_list) > 0
+
+    def refuse(path):
+        raise ValueError("forced decode for the oracle arm")
+
+    monkeypatch.setattr(S, "_load_raw", refuse)
+    tim_dec = str(tmp_path / "nb_dec.tim")
+    r2 = S.stream_narrowband_TOAs([f], tmpl, nsub_batch=4, quiet=True,
+                                  tim_out=tim_dec)
+    assert len(r2.TOA_list) == len(r1.TOA_list)
+    assert open(tim_raw).read() == open(tim_dec).read()
+
+
+def test_raw_subbyte_byte_reduction(tmp_path, monkeypatch):
+    """A 2-bit corpus must ship MUCH less than its decoded-f64
+    fallback — >= 8x at a padded bucket shape (the acceptance gate;
+    the full-size claim rides bench_campaign's tunnel-emu arm)."""
+    f, tmpl = _forge_and_template(tmp_path, "ratio2",
+                                  data_dtype="nbit2")
+    # nsub_batch 64 pads the dispatch like a campaign bucket, so the
+    # payload (not the shared model/mask args) dominates both lanes
+    r1 = S.stream_wideband_TOAs([f], tmpl, nsub_batch=64, quiet=True)
+    monkeypatch.setattr(config, "raw_subbyte", False)
     with pytest.raises(ValueError):
-        S._load_raw(f)
+        S._load_raw(f)  # the escape hatch forces the decoded lane
+    r2 = S.stream_wideband_TOAs([f], tmpl, nsub_batch=64, quiet=True)
+    assert [t.MJD.tim_string() for t in r1.TOA_list] == \
+        [t.MJD.tim_string() for t in r2.TOA_list]
+    assert r2.h2d_bytes / r1.h2d_bytes >= 8.0
+
+
+def test_raw_refuses_unrepresentable_layouts(tmp_path, monkeypatch):
+    """Layouts raw mode still cannot represent keep refusing loudly
+    (the loader then falls back to the decoded lane): packed +
+    FITS-scaled columns, misaligned sub-byte pol planes, and the
+    PPT_RAW_SUBBYTE escape hatch."""
+    nchan, nbin = 8, 64
+    ok = str(tmp_path / "nbit4_ok.fits")
+    forge_archive(ok, nsub=1, nchan=nchan, nbin=nbin,
+                  data_dtype="nbit4")
+    assert S._load_raw(ok).raw_code == "p4"  # engages by default
+    monkeypatch.setattr(config, "raw_subbyte", False)
+    with pytest.raises(ValueError):
+        S._load_raw(ok)
+    monkeypatch.setattr(config, "raw_subbyte", True)
+    # a 2-bit plane of 30 samples does not byte-align (30*2 % 8 != 0)
+    mis = str(tmp_path / "nbit2_misaligned.fits")
+    forge_archive(mis, nsub=1, nchan=5, nbin=6, data_dtype="nbit2")
+    with pytest.raises(ValueError):
+        S._load_raw(mis)
+    # packed payloads cannot channel-pad (config.bucket_pad)
+    monkeypatch.setattr(config, "bucket_pad", True)
+    forge_archive(str(tmp_path / "nbit4_pad.fits"), nsub=1, nchan=6,
+                  nbin=64, data_dtype="nbit4")
+    with pytest.raises(ValueError):
+        S._load_raw(str(tmp_path / "nbit4_pad.fits"))
 
 
 # ---------------------------------------------------------------------------
@@ -261,9 +330,10 @@ def test_compile_cache_populates(tmp_path, monkeypatch):
 
 
 def test_pptoas_pipeline_flags_validate():
-    """--pipeline-depth needs --stream and a sane value (cheap parse-
-    level checks; the e2e plumbing rides test_cli's stream runs)."""
-    from pulseportraiture_tpu.cli import pptoas
+    """--pipeline-depth / --transport-compress need --stream and sane
+    values (cheap parse-level checks; the e2e plumbing rides
+    test_cli's stream runs)."""
+    from pulseportraiture_tpu.cli import pproute, pptoas
 
     with pytest.raises(SystemExit):
         pptoas.main(["-d", "x.fits", "-m", "m.gmodel",
@@ -271,6 +341,19 @@ def test_pptoas_pipeline_flags_validate():
     with pytest.raises(SystemExit):
         pptoas.main(["-d", "x.fits", "-m", "m.gmodel", "--stream",
                      "--pipeline-depth", "0"])
+    with pytest.raises(SystemExit):
+        pptoas.main(["-d", "x.fits", "-m", "m.gmodel",
+                     "--transport-compress", "auto"])  # needs --stream
+    with pytest.raises(SystemExit):
+        pptoas.main(["-d", "x.fits", "-m", "m.gmodel", "--stream",
+                     "--transport-compress", "zlib"])
+    saved = config.transport_compress
+    try:
+        with pytest.raises(SystemExit):
+            pproute.main(["-r", "nope.jsonl",
+                          "--transport-compress", "bad"])
+    finally:
+        config.transport_compress = saved
 
 
 def test_ops_decode_units():
@@ -320,3 +403,271 @@ def test_ops_decode_units():
         decode_stokes_I(jnp.asarray(raw2[:, 0]), jnp.asarray(scl2[:, 0]),
                         jnp.asarray(offs2[:, 0]), jnp.float64,
                         code="u8", pol_sum=True)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15: sub-byte decode exactness, the transport codec, and the
+# cost model
+# ---------------------------------------------------------------------------
+
+def _np_unpack(packed, nbit, nsamp):
+    """Independent numpy reference for the MSB-first unpack."""
+    per = 8 // nbit
+    shifts = (np.arange(per - 1, -1, -1) * nbit).astype(np.uint8)
+    v = (packed[..., :, None] >> shifts) & ((1 << nbit) - 1)
+    return v.reshape(packed.shape[:-1] + (-1,))[..., :nsamp]
+
+
+@pytest.mark.parametrize("nbit", [1, 2, 4])
+@pytest.mark.parametrize("variant", ["plain", "datscl", "tscal"])
+def test_unpack_bit_identity(nbit, variant):
+    """Packed-vs-host-unpack bit identity across all three NBIT
+    widths x {plain u8 interpretation, DAT_SCL/DAT_OFFS, general
+    TSCAL/TZERO}: the device decode must reproduce the host decode
+    EXACTLY (every value here is an exact f64)."""
+    import jax.numpy as jnp
+
+    from pulseportraiture_tpu.ops.decode import (decode_stokes_I,
+                                                 unpack_bitplanes)
+    from pulseportraiture_tpu.ops.noise import min_window_baseline
+
+    rng = np.random.default_rng(nbit)
+    nb, nchan, nbin = 2, 4, 64
+    packed = rng.integers(0, 256, (nb, nchan * nbin * nbit // 8)) \
+        .astype(np.uint8)
+    want_samples = _np_unpack(packed, nbit, nchan * nbin) \
+        .reshape(nb, nchan, nbin).astype(np.float64)
+    got_samples = np.asarray(unpack_bitplanes(
+        jnp.asarray(packed), nbit, nchan * nbin))
+    assert np.array_equal(
+        got_samples.reshape(nb, nchan, nbin), want_samples)
+
+    scl = (np.ones((nb, nchan)) if variant == "plain"
+           else rng.uniform(0.5, 2.0, (nb, nchan)))
+    offs = (np.zeros((nb, nchan)) if variant == "plain"
+            else rng.uniform(-1.0, 1.0, (nb, nchan)))
+    tscal = tzero = None
+    x = want_samples
+    if variant == "tscal":
+        tscal = np.full(nb, 0.25)
+        tzero = np.full(nb, -3.0)
+        x = x * tscal[:, None, None] + tzero[:, None, None]
+    x = x * scl[..., None] + offs[..., None]
+    want = x - np.asarray(
+        min_window_baseline(jnp.asarray(x)))[..., None]
+    got = np.asarray(decode_stokes_I(
+        jnp.asarray(packed), jnp.asarray(scl), jnp.asarray(offs),
+        jnp.float64, code=f"p{nbit}", nbin=nbin,
+        tscal=None if tscal is None else jnp.asarray(tscal),
+        tzero=None if tzero is None else jnp.asarray(tzero)))
+    np.testing.assert_allclose(got, want, rtol=0, atol=1e-12)
+
+
+def test_blockcodec_roundtrip_property():
+    """Codec encode . decode round-trip property: random integer
+    payloads across dtypes, spans, and row counts come back
+    bit-identical, and incompressible payloads decline."""
+    from pulseportraiture_tpu.io.blockcodec import (decode_rows,
+                                                    encode_rows,
+                                                    probe_width)
+
+    rng = np.random.default_rng(7)
+    for trial in range(20):
+        nb = int(rng.integers(1, 5))
+        nsamp = int(rng.integers(1, 8)) * 8
+        dtype = rng.choice([np.uint8, np.int16])
+        width_target = int(rng.choice([1, 2, 4, 8]))
+        base = rng.integers(-200 if dtype == np.int16 else 0, 100,
+                            nb)
+        arr = (base[:, None]
+               + rng.integers(0, 1 << width_target, (nb, nsamp))) \
+            .astype(dtype)
+        vmin, w = probe_width(arr)
+        if dtype == np.uint8 and width_target == 8:
+            assert w is None  # no width below the wire dtype
+            continue
+        assert w is not None and w <= width_target
+        packed = encode_rows(arr, vmin, w)
+        assert packed.nbytes < arr.nbytes
+        back = decode_rows(packed, vmin, w, arr.shape, dtype)
+        assert np.array_equal(back, arr)
+    # full-range payloads are incompressible
+    full = rng.integers(-30000, 30000, (2, 64)).astype(np.int16)
+    assert probe_width(full) == (None, None)
+    # float payloads are ineligible
+    assert probe_width(full.astype(np.float32)) == (None, None)
+
+
+def test_cost_model_never_engages_blind():
+    """The cost model must never speculate: no link observation ->
+    False; a fast (memcpy) link -> False; a slow (tunnel) link ->
+    True for a worthwhile reduction."""
+    from pulseportraiture_tpu.io.blockcodec import CostModel
+
+    m = CostModel()
+    assert not m.predict(1 << 20, 1 << 18)  # no link measured yet
+    m.observe_link(1 << 20, 1e-4)  # ~10 GB/s memcpy-class link
+    assert not m.predict(1 << 20, 1 << 18)
+    m2 = CostModel()
+    m2.observe_link(1 << 20, 0.5)  # ~2 MB/s tunnel-class link
+    assert m2.predict(1 << 20, 1 << 18)
+    # no saving -> never
+    assert not m2.predict(1 << 20, 1 << 20)
+
+
+def test_transport_compress_e2e(tmp_path, monkeypatch):
+    """The h2d codec end to end on a coarsely-quantized byte corpus:
+    'on' ships fewer bytes with digit-identical .tim; 'auto' on a
+    bare-CPU link NEVER engages (the cost model predicts a loss); the
+    telemetry ledger carries the decision trail."""
+    from pulseportraiture_tpu.synth import (default_test_model,
+                                            make_fake_pulsar)
+    from pulseportraiture_tpu.io import write_gmodel
+    from pulseportraiture_tpu.utils.mjd import MJD
+
+    model = default_test_model(1500.0)
+    gmodel = str(tmp_path / "m.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files = []
+    for i in range(2):
+        p = str(tmp_path / f"c{i}.fits")
+        make_fake_pulsar(model, {"PSR": "TC", "P0": 0.003, "DM": 10.0,
+                                 "PEPOCH": 55000.0},
+                         outfile=p, nsub=2, nchan=16, nbin=128,
+                         start_MJD=MJD(55100 + i, 0.1),
+                         noise_stds=0.05, dedispersed=False,
+                         quiet=True, rng=i, nbit=8, levels=4)
+        files.append(p)
+    tims, res = {}, {}
+    for mode in (False, True, "auto"):
+        monkeypatch.setattr(config, "transport_compress", mode)
+        tim = str(tmp_path / f"tc_{mode}.tim")
+        trace = str(tmp_path / f"tc_{mode}.jsonl")
+        res[mode] = S.stream_wideband_TOAs(
+            files, gmodel, nsub_batch=4, quiet=True, tim_out=tim,
+            telemetry=trace)
+        tims[mode] = open(tim).read()
+    assert tims[False] == tims[True] == tims["auto"]
+    assert res[True].h2d_bytes < res[False].h2d_bytes
+    assert res[True].h2d_bytes_logical == res[False].h2d_bytes
+    # 'auto' on a bare-CPU link: the first copy has no link estimate
+    # and later ones predict a loss — zero engagement, ever
+    assert res["auto"].h2d_bytes == res["auto"].h2d_bytes_logical
+    # the decision ledger: every 'on' copy engaged, every 'auto' copy
+    # declined on cost (or had no estimate)
+    import io as _io
+
+    summary = telemetry.report(str(tmp_path / "tc_True.jsonl"),
+                               file=_io.StringIO())
+    assert summary["codec_decisions"].get("engaged", 0) == \
+        summary["n_h2d"]
+    assert summary["h2d_bytes_logical"] > summary["h2d_bytes"]
+    assert summary["h2d_compression"] > 1.0
+    summary_auto = telemetry.report(str(tmp_path / "tc_auto.jsonl"),
+                                    file=_io.StringIO())
+    assert summary_auto["codec_decisions"].get("engaged", 0) == 0
+    assert summary_auto["codec_decisions"].get("cost", 0) > 0
+    assert summary_auto["h2d_bytes_logical"] == \
+        summary_auto["h2d_bytes"]
+
+
+def test_socket_frame_compression_roundtrip(monkeypatch):
+    """Socket frames round-trip the zlib lane bit-exactly: a big
+    compressible frame ships with the top-bit marker and decodes to
+    the same object; small frames stay plain."""
+    import socket as _socket
+    import struct as _struct
+
+    from pulseportraiture_tpu.serve import transport as T
+
+    a, b = _socket.socketpair()
+    try:
+        # big enough to cross COMPRESS_MIN_FRAME, small enough that
+        # the PLAIN send below fits the socketpair buffer (both ends
+        # live on this one thread — a frame past the kernel buffer
+        # would deadlock sendall against the unread peer)
+        big = {"op": "result", "payload": ["x" * 64] * 1200}
+        monkeypatch.setattr(config, "transport_compress", True)
+        T._send_frame(a, big)
+        # peek the length prefix: the marker bit must be set and the
+        # wire body must be smaller than the JSON
+        import json as _json
+
+        body_len = len(_json.dumps(big,
+                                   separators=(",", ":")).encode())
+        head = T._recv_exact(b, 4)
+        (n,) = _struct.unpack(">I", head)
+        assert n & T._FRAME_ZLIB
+        assert (n & ~T._FRAME_ZLIB) < body_len
+        payload = T._recv_exact(b, n & ~T._FRAME_ZLIB)
+        import zlib as _zlib
+
+        assert _json.loads(_zlib.decompress(payload)) == big
+        # and through the real receive path
+        T._send_frame(a, big)
+        assert T._recv_frame(b) == big
+        # small frames stay plain even when compression is on
+        T._send_frame(a, {"op": "stat"})
+        head = T._recv_exact(b, 4)
+        (n,) = _struct.unpack(">I", head)
+        assert not n & T._FRAME_ZLIB
+        _ = T._recv_exact(b, n)
+        # off: byte-identical to prior releases
+        monkeypatch.setattr(config, "transport_compress", False)
+        T._send_frame(a, big)
+        head = T._recv_exact(b, 4)
+        (n,) = _struct.unpack(">I", head)
+        assert not n & T._FRAME_ZLIB and n == body_len
+        _ = T._recv_exact(b, n)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_linkwar_env_knobs(monkeypatch):
+    """PPT_RAW_SUBBYTE / PPT_TRANSPORT_COMPRESS: registered in
+    KNOWN_PPT_ENV, strict parses, loud errors, snapshot in the
+    telemetry manifest."""
+    for name in ("PPT_RAW_SUBBYTE", "PPT_TRANSPORT_COMPRESS"):
+        assert name in config.KNOWN_PPT_ENV
+    for key in ("raw_subbyte", "transport_compress"):
+        assert key in telemetry.CONFIG_SNAPSHOT_KEYS
+    saved = (config.raw_subbyte, config.transport_compress)
+    try:
+        monkeypatch.setenv("PPT_RAW_SUBBYTE", "off")
+        monkeypatch.setenv("PPT_TRANSPORT_COMPRESS", "auto")
+        changed = config.env_overrides()
+        assert "raw_subbyte" in changed
+        assert "transport_compress" in changed
+        assert config.raw_subbyte is False
+        assert config.transport_compress == "auto"
+        monkeypatch.setenv("PPT_RAW_SUBBYTE", "on")
+        monkeypatch.setenv("PPT_TRANSPORT_COMPRESS", "on")
+        config.env_overrides()
+        assert config.raw_subbyte is True
+        assert config.transport_compress is True
+        monkeypatch.setenv("PPT_RAW_SUBBYTE", "maybe")
+        with pytest.raises(ValueError):
+            config.env_overrides()
+        monkeypatch.setenv("PPT_RAW_SUBBYTE", "on")
+        monkeypatch.setenv("PPT_TRANSPORT_COMPRESS", "sometimes")
+        with pytest.raises(ValueError):
+            config.env_overrides()
+    finally:
+        config.raw_subbyte, config.transport_compress = saved
+
+
+def test_shape_key_roundtrip_new_tokens():
+    """_bucket_shape <-> parse_shape_key stays an exact inverse for
+    the new packed codes and the column-scaling token (the AOT warmup
+    contract)."""
+    for code in ("p1", "p2", "p4", "i16"):
+        for col_scaled in (False, True):
+            b = S._Bucket(np.linspace(1.0, 2.0, 8), 64, None,
+                          (True, True, False, False, False),
+                          kind="raw", raw_code=code,
+                          col_scaled=col_scaled)
+            spec = S.parse_shape_key(S._bucket_shape(b))
+            assert spec["raw_code"] == code
+            assert spec["col_scaled"] is col_scaled
+            assert spec["nchan"] == 8 and spec["nbin"] == 64
