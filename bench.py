@@ -109,8 +109,17 @@ def main():
     # PPT_HARMONIC_WINDOW=off reverts to the full spectrum for A/B.
     from pulseportraiture_tpu.fit.portrait import model_harmonic_window
 
-    if _os.environ.get("PPT_HARMONIC_WINDOW", "").lower() == "off":
+    _hw = _os.environ.get("PPT_HARMONIC_WINDOW", "").lower()
+    if _hw == "off":
         hwin = None
+    elif _hw:
+        # forced integer window (tile-rounded): lets the fused/Pallas
+        # A/B arms run at shapes where the content-derived window
+        # would refuse — the CI interpret-mode smoke arm
+        from pulseportraiture_tpu.fit.portrait import (
+            resolve_harmonic_window)
+
+        hwin = resolve_harmonic_window(int(_hw), None, NBIN)
     else:
         hwin = model_harmonic_window(np.asarray(model_clean), NBIN)
 
@@ -223,6 +232,33 @@ def main():
             raise SystemExit(
                 "bench: fused-vs-unfused phi NOT bitwise identical — "
                 "the fused program drifted (ops/fused.py)")
+        # --- Pallas arm (ISSUE 16): the hand-placed channel-tile
+        # kernel vs the fused scan program.  Runs when the kernel lane
+        # resolves on (TPU 'auto', or PPT_FIT_PALLAS=on forcing
+        # interpret mode off-TPU — the CI smoke arm).  Same bitwise
+        # gate as the scan: interpret or compiled, phi must not drift.
+        from pulseportraiture_tpu.ops.fused import use_fit_pallas
+
+        if use_fit_pallas():
+            pallas_prev = config.fit_pallas
+            try:
+                config.fit_fused = True
+                config.fit_pallas = True
+                t_pal, phi_pal = timed_arm()
+            finally:
+                config.fit_fused = fused_prev
+                config.fit_pallas = pallas_prev
+            pallas_identical = bool(np.array_equal(phi_fus, phi_pal))
+            fused_keys.update({
+                "pallas_toas_per_sec": round(NB / t_pal, 2),
+                "pallas_vs_fused": round(t_fus / t_pal, 3),
+                "pallas_interpret": bool(not on_tpu),
+                "pallas_identical": pallas_identical,
+            })
+            if not pallas_identical:
+                raise SystemExit(
+                    "bench: pallas-vs-fused phi NOT bitwise identical "
+                    "— the Pallas kernel drifted (ops/fused.py)")
         # optional re-tune sweep of (harmonic_window,
         # cross_spectrum_dtype) against the FUSED program
         # (PPT_RETUNE=1; the decision table lives in BENCHMARKS.md) —

@@ -147,15 +147,41 @@ lm_jacobian = "auto"
 # set): the windowed lane's full-spectrum data power already comes
 # from the exact time-domain Parseval form, which is what keeps the
 # fused program BYTE-identical to the unfused one (.tim gates in
-# tests/test_stream.py and bench.py every run).  The Pallas kernel
-# variant (ops/fused.fused_cross_spectrum_pallas) is stubbed for the
-# chip session — on TPU today 'auto' takes the same hand-blocked XLA
-# program.
+# tests/test_stream.py and bench.py every run).
 #   False: unfused (the round-5 program, bit-stable across releases).
 #   'auto' (default): fused on TPU backends; unfused elsewhere (CPU CI
 #          exercises the fused lane explicitly via tests/bench).
 #   True:  force the fused program everywhere.
 fit_fused = "auto"
+
+# Which IMPLEMENTATION the fused lane runs (only meaningful when
+# fit_fused is active): the hand-blocked lax.scan, or the Pallas
+# kernel (ops/fused.fused_cross_spectrum_pallas) that runs each
+# channel tile's DFT matmuls + cross-spectrum + power reduction
+# VMEM-resident in ONE kernel — the below-XLA fusion the scan cannot
+# express (XLA will not fuse a dot into its consumers; R17 measured
+# the scan CPU-honest 0.84x).  On the raw streaming lane the kernel
+# additionally absorbs the sub-byte decode chain
+# (ops/fused.fused_decode_cross_spectrum_pallas) so the decoded f64
+# portrait never materializes in HBM.  Outputs are BITWISE identical
+# to the scan at any block size (tests/test_pallas_interpret.py; .tim
+# byte gates unchanged when this flips).
+#   False: always the scan (bit-stable across releases).
+#   'auto' (default): the compiled kernel on TPU backends when Pallas
+#          is importable; the scan elsewhere (CPU never silently pays
+#          interpret-mode overhead).
+#   True:  force the kernel everywhere — non-TPU backends run it
+#          under pallas_call(interpret=True), the CPU development and
+#          gating mode; loud RuntimeError if Pallas is unavailable.
+fit_pallas = "auto"
+
+# Channel-block override for BOTH fused implementations (scan tile and
+# Pallas grid tile).  None (default): ops/fused._BLOCK_TARGET (32).
+# Set a positive int to sweep the block size without code edits — the
+# chip-session tuning lattice (benchmarks/BENCHMARKS.md config 6/2)
+# drives this via PPT_FUSED_BLOCK.  Resolved at trace time and carried
+# in the fit program cache keys, so a mid-process change retraces.
+fused_block = None
 
 # Matmul-DFT precision (ops/fourier.py) on accelerators:
 # 'highest' = 6-pass bf16 (f32-exact to ~1e-7), 'high' = 3-pass
@@ -474,6 +500,8 @@ RCSTRINGS = {
 #
 #   PPT_LM_JACOBIAN=auto|analytic|ad -> lm_jacobian
 #   PPT_FIT_FUSED=off|auto|on       -> fit_fused
+#   PPT_FIT_PALLAS=off|auto|on      -> fit_pallas
+#   PPT_FUSED_BLOCK=<N>             -> fused_block
 #   PPT_XSPEC=float32|bfloat16      -> cross_spectrum_dtype
 #   PPT_DFT_PRECISION=highest|high|default -> dft_precision
 #   PPT_DFT_FOLD=off|auto|on        -> dft_fold
@@ -519,6 +547,7 @@ RCSTRINGS = {
 KNOWN_PPT_ENV = frozenset({
     # config hooks (this module)
     "PPT_LM_JACOBIAN", "PPT_FIT_FUSED",
+    "PPT_FIT_PALLAS", "PPT_FUSED_BLOCK",
     "PPT_XSPEC", "PPT_DFT_PRECISION", "PPT_DFT_FOLD",
     "PPT_ALIGN_DEVICE", "PPT_GAUSS_DEVICE",
     "PPT_GLS_DEVICE", "PPT_ZAP_DEVICE", "PPT_ZAP_NSTD",
@@ -661,6 +690,28 @@ def env_overrides():
                 f"{ffused!r}")
         cfg.fit_fused = table[ffused]
         changed.append("fit_fused")
+    fpallas = _os.environ.get("PPT_FIT_PALLAS", "").lower()
+    if fpallas:
+        table = {"off": False, "false": False, "auto": "auto",
+                 "on": True, "true": True}
+        if fpallas not in table:
+            raise ValueError(
+                f"PPT_FIT_PALLAS must be 'off', 'auto' or 'on', got "
+                f"{fpallas!r}")
+        cfg.fit_pallas = table[fpallas]
+        changed.append("fit_pallas")
+    fblock = _os.environ.get("PPT_FUSED_BLOCK", "")
+    if fblock:
+        try:
+            v = int(fblock)
+        except ValueError:
+            raise ValueError(
+                "PPT_FUSED_BLOCK must be a positive integer channel "
+                f"block size, got {fblock!r}")
+        if not v > 0:
+            raise ValueError(f"PPT_FUSED_BLOCK must be > 0, got {v}")
+        cfg.fused_block = v
+        changed.append("fused_block")
     xspec = _os.environ.get("PPT_XSPEC", "").lower()
     if xspec:
         table = {"float32": None, "none": None, "bfloat16": "bfloat16"}

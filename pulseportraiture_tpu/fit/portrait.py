@@ -195,6 +195,43 @@ def use_fit_fused(setting=None):
     return jax.default_backend() == "tpu"
 
 
+def resolve_fit_fused(nharm_eff):
+    """The batch wrappers' single resolution point for the fused-lane
+    program-cache token: False when the fused lane is off or dead (no
+    harmonic window — it must not key a second bit-identical program),
+    else a token naming the implementation the prepare stage should
+    take, so flipping config.fit_pallas or config.fused_block
+    mid-process retraces instead of silently reusing the other arm:
+
+      True          hand-blocked scan, default block
+      'pallas'      Pallas kernel, default block
+      'fused:<b>'   scan, config.fused_block = b
+      'pallas:<b>'  Pallas kernel, config.fused_block = b
+
+    Every token is truthy, so existing `if fit_fused` gates behave
+    unchanged; _parse_fit_fused recovers (pallas, block) at the
+    fused_cross_spectrum call site."""
+    if not (use_fit_fused() and nharm_eff is not None):
+        return False
+    from ..ops.fused import use_fit_pallas
+
+    pallas = use_fit_pallas()
+    blk = getattr(config, "fused_block", None)
+    if blk is None:
+        return "pallas" if pallas else True
+    return f"{'pallas' if pallas else 'fused'}:{int(blk)}"
+
+
+def _parse_fit_fused(fit_fused):
+    """Token -> (pallas, block) for the fused_cross_spectrum call (see
+    resolve_fit_fused).  Plain True (legacy callers) means the scan at
+    the default block."""
+    if isinstance(fit_fused, str):
+        mode, _, blk = fit_fused.partition(":")
+        return mode == "pallas", (int(blk) if blk else None)
+    return False, None
+
+
 def use_scatter_compensated():
     """Whether scattering fits run the Dot2-compensated reductions
     (config.scatter_compensated) — the single parse point, shared by
@@ -1363,15 +1400,17 @@ def prepare_portrait_fit_real(port, model, w, freqs, P, nu_fit, theta0,
 
     dt = w.dtype
     if fit_fused is None:
-        fit_fused = use_fit_fused()
+        fit_fused = resolve_fit_fused(nharm_eff)
     cvec, _ = _t_coeffs(freqs, P, nu_fit)
     cvec = cvec.astype(dt)
     if fit_fused and nharm_eff is not None:
         from ..ops.fused import fused_cross_spectrum
 
+        pallas, blk = _parse_fit_fused(fit_fused)
         w_full = w
         Xr, Xi, S0 = fused_cross_spectrum(
-            port, model, w[..., :nharm_eff], nharm_eff, fold=dft_fold)
+            port, model, w[..., :nharm_eff], nharm_eff, fold=dft_fold,
+            block=blk, pallas=pallas)
         Sd = _parseval_Sd(port, w_full)
     else:
         dr, di = rfft_mm(port, nharm=nharm_eff, fold=dft_fold)
@@ -1591,17 +1630,20 @@ def prepare_scatter_fit_real(port, model, noise_stds, chan_mask, freqs,
     dt = port.dtype
     w = make_weights(noise_stds, nbin, chan_mask, dtype=dt)
     if fit_fused is None:
-        fit_fused = use_fit_fused()
+        fit_fused = resolve_fit_fused(nharm_eff)
     if fit_fused and nharm_eff is not None:
-        # fused hand-blocked DFT -> cross-spectrum (ops/fused.py);
-        # windowed lanes only — Sd is the exact time-domain Parseval
-        # form either way, so fused-vs-unfused stays byte-identical
+        # fused DFT -> cross-spectrum (ops/fused.py; scan or Pallas
+        # kernel per the fit_fused token); windowed lanes only — Sd is
+        # the exact time-domain Parseval form either way, so
+        # fused-vs-unfused stays byte-identical
         from ..ops.fused import fused_cross_spectrum
 
+        pallas, blk = _parse_fit_fused(fit_fused)
         w_full = w
         Xr, Xi, M2w = fused_cross_spectrum(
             port, model.astype(dt), w[..., :nharm_eff], nharm_eff,
-            precision=prec, fold=dft_fold, want_m2=True)
+            precision=prec, fold=dft_fold, want_m2=True,
+            block=blk, pallas=pallas)
         Sd = _parseval_Sd(port, w_full)
     else:
         dr, di = rfft_mm(port, precision=prec, nharm=nharm_eff,
@@ -1781,7 +1823,7 @@ def fit_portrait_batch_fast(
     bounds, b_ax = _resolve_bounds_axis(bounds, dt)
     # dead-knob normalization: fused is a no-op without the harmonic
     # window, so it must not key a second bit-identical program
-    fit_fused = use_fit_fused() and nharm_eff is not None
+    fit_fused = resolve_fit_fused(nharm_eff)
     fit = _fast_batch_fn(
         FitFlags(*[bool(f) for f in fit_flags]), int(max_iter),
         m_ax, f_ax, p_ax, nf_ax, seed_derotate, x_bf16,
@@ -1878,6 +1920,98 @@ def _fast_batch_fn(fit_flags, max_iter, m_ax, f_ax, p_ax, nf_ax,
     return jax.jit(jax.vmap(one, in_axes=axes))
 
 
+def prepare_portrait_fit_real_packed(raw, scl, offs, model, w, freqs, P,
+                                     nu_fit, theta0, *, raw_code, nbin,
+                                     seed_phi=True, seed_derotate=True,
+                                     x_dtype=None, nharm_eff=None,
+                                     dft_fold=None, fused_block=None):
+    """prepare_portrait_fit_real for a sub-byte PACKED raw payload: the
+    decode chain (bit-plane unpack, affine decode, min-window baseline)
+    and the windowed DFT -> cross-spectrum run inside ONE Pallas
+    channel-tile kernel (ops/fused.fused_decode_cross_spectrum_pallas),
+    so the decoded f64 portrait is never materialized in HBM between
+    the decode stage and the fit.
+
+    raw: (nchan, bpc) uint8 per-channel packed bytes (the stream front
+    guarantees nbin*nbit % 8 == 0 before routing here).  w: the FULL
+    make_weights array — the kernel gets the harmonic window slice,
+    and the full-spectrum Sd is assembled from the kernel's exact
+    per-channel time-domain Parseval rows with _parseval_Sd's outer
+    ops, so every output is bitwise identical to the decode-then-
+    prepare program (the .tim byte gate vs the decoded oracle).
+    Windowed lanes only: nharm_eff must be set."""
+    from ..ops.fused import fused_decode_cross_spectrum_pallas
+
+    dt = w.dtype
+    cvec, _ = _t_coeffs(freqs, P, nu_fit)
+    cvec = cvec.astype(dt)
+    Xr, Xi, S0, pwr, x0 = fused_decode_cross_spectrum_pallas(
+        raw, scl, offs, model, w[..., :nharm_eff], nharm_eff,
+        code=raw_code, nbin=nbin, fold=dft_fold, block=fused_block)
+    # _parseval_Sd's outer reductions on the kernel's per-channel rows
+    Sd = jnp.sum(w[..., 1] * (0.5 * pwr))
+    if float(F0_fact) != 0.0:
+        Sd = Sd + jnp.sum(w[..., 0] * x0**2)
+    if seed_phi:
+        phi0 = _initial_phase_guess_real(Xr, Xi, cvec, theta0[1],
+                                         derotate=seed_derotate,
+                                         nbin=nbin)
+        theta0 = jnp.where(jnp.arange(5) == 0, phi0, theta0).astype(dt)
+    else:
+        theta0 = theta0.astype(dt)
+    xdt = x_dtype or dt
+    return Xr.astype(xdt), Xi.astype(xdt), S0, Sd, theta0
+
+
+def fast_fit_one_packed(raw, scl, offs, model, noise_stds, chan_mask,
+                        freqs, P, nu_fit, nu_out, theta0, *, raw_code,
+                        nbin, fit_flags, max_iter, seed_derotate=True,
+                        x_bf16=None, nharm_eff=None, dft_fold=None,
+                        fused_block=None):
+    """fast_fit_one for a sub-byte packed raw payload: decode+DFT in
+    one Pallas kernel (prepare_portrait_fit_real_packed), then the same
+    real Newton core — the per-element body of the raw streaming lane's
+    decode-fused program (pipeline/stream._raw_fit_fn)."""
+    if x_bf16 is None:
+        x_bf16 = use_bf16_cross_spectrum()
+    dt = noise_stds.dtype
+    w = make_weights(noise_stds, nbin, chan_mask, dtype=dt)
+    x_dtype = (jnp.bfloat16
+               if (x_bf16 and dt == jnp.float32)
+               else None)
+    Xr, Xi, S0, Sd, th0 = prepare_portrait_fit_real_packed(
+        raw, scl, offs, model.astype(dt), w, freqs, P, nu_fit, theta0,
+        raw_code=raw_code, nbin=nbin, seed_phi=bool(fit_flags[0]),
+        seed_derotate=seed_derotate, x_dtype=x_dtype,
+        nharm_eff=nharm_eff, dft_fold=dft_fold,
+        fused_block=fused_block)
+    return _fit_portrait_core_real.__wrapped__(
+        Xr, Xi, S0, Sd, freqs, P, nu_fit, nu_out, th0,
+        fit_flags=fit_flags, max_iter=max_iter,
+        nharm_total=nbin // 2 + 1, bounds=None)
+
+
+@lru_cache(maxsize=None)
+def _fast_batch_packed_fn(fit_flags, max_iter, raw_code, nbin,
+                          seed_derotate=True, x_bf16=False,
+                          nharm_eff=None, dft_fold=None,
+                          fused_block=None):
+    """Cached jitted batch wrapper for the decode-fused raw fit
+    (fast_fit_one_packed): model and freqs shared across the batch
+    (the raw bucket program's layout), everything else per-subint.
+    raw_code/nbin/fused_block ride the cache key like the other
+    resolved statics."""
+    one = partial(fast_fit_one_packed, fit_flags=fit_flags,
+                  max_iter=max_iter, raw_code=raw_code, nbin=nbin,
+                  seed_derotate=seed_derotate, x_bf16=x_bf16,
+                  nharm_eff=nharm_eff, dft_fold=dft_fold,
+                  fused_block=fused_block)
+    # (raw, scl, offs, model, noise, cmask, freqs, P, nu_fit, nu_out,
+    #  theta0)
+    axes = (0, 0, 0, None, 0, 0, None, 0, 0, 0, 0)
+    return jax.jit(jax.vmap(one, in_axes=axes))
+
+
 def _fit_batch_fast_scatter(ports, models, noise_stds, freqs, P, nu_fit,
                             nu_out=None, theta0=None,
                             fit_flags=FitFlags(), chan_masks=None,
@@ -1934,8 +2068,7 @@ def _fit_batch_fast_scatter(ports, models, noise_stds, freqs, P, nu_fit,
         int(max_iter), bool(compensated),
         effective_x_bf16(compensated),
         m_ax, f_ax, p_ax, nf_ax, use_ir, nharm_eff, b_ax,
-        seed_derotate, use_dft_fold(),
-        use_fit_fused() and nharm_eff is not None)
+        seed_derotate, use_dft_fold(), resolve_fit_fused(nharm_eff))
     args = (ports, models, jnp.asarray(noise_stds),
             jnp.asarray(chan_masks, dt), freqs, P, nu_fit,
             nu_out_arr, jnp.asarray(theta0), ir_r, ir_i)

@@ -61,9 +61,15 @@ def unpack_bitplanes(packed, nbit, nsamp):
         raise ValueError(f"unpack_bitplanes: nbit must be 1, 2 or 4, "
                          f"got {nbit}")
     per = 8 // nbit
-    shifts = jnp.arange(per - 1, -1, -1, dtype=jnp.uint8) * jnp.uint8(nbit)
-    mask = jnp.uint8((1 << nbit) - 1)
-    samples = (packed[..., :, None] >> shifts) & mask
+    mask = (1 << nbit) - 1
+    # Python-int shifts (weak-typed scalars) rather than an arange
+    # vector: scalar constants are legal inside Pallas kernel bodies
+    # (ops.fused.fused_decode_cross_spectrum_pallas calls this per
+    # channel tile), captured array constants are not.  Identical
+    # integer ops either way — bit-exact.
+    parts = [(packed[..., :, None] >> ((per - 1 - k) * nbit)) & mask
+             for k in range(per)]
+    samples = jnp.concatenate(parts, axis=-1)
     samples = samples.reshape(packed.shape[:-1]
                               + (packed.shape[-1] * per,))
     return samples[..., :nsamp]

@@ -202,6 +202,8 @@ class _Bucket:
         self.nu_fits = []           # 'dec' only
         self.theta0 = []            # 'dec': each (5,)
         self.DM_guess = []          # 'raw': scalar per subint
+        self.dfs = []               # doppler factor per subint (the
+        # in-stream postfit cut rotates by the doppler-corrected DM)
         self.owners = []            # (archive_index, isub)
 
     def harmonic_window(self):
@@ -227,7 +229,8 @@ class _Bucket:
         for lst in (self.ports, self.raw, self.scl, self.offs,
                     self.tscal, self.tzero, self.dedisp,
                     self.noise, self.masks, self.Ps, self.nu_fits,
-                    self.theta0, self.DM_guess, self.owners):
+                    self.theta0, self.DM_guess, self.dfs,
+                    self.owners):
             lst.clear()
 
 
@@ -1135,12 +1138,46 @@ def _raw_stats(x, cmask, freqs, ft, tiny, noise=None):
     return noise, snr, nu_fit
 
 
+def _postfit_bad_mask(x, r, noise, cmask, modelx, freqs, Ps, dfs, bary,
+                      fit_DM, nbin):
+    """In-stream twin of toas.GetTOAs.get_channels_to_zap's per-subint
+    loop (reference pptoas.py:1266-1343), traceable: rotate the model
+    onto the dispersed data at the fitted (phi, DM), scale per channel,
+    form the per-channel reduced chi2, and run the iterative
+    median-based cut (quality.postfit.postfit_cut_mask — bit-identical
+    to the host oracle).  Returns (nb, nchan) bool bad-channel mask.
+
+    The DM the offline pass rotates by is self.DMs — the
+    DOPPLER-CORRECTED value (DM_fit * df when barycentered and the RUN
+    fit_DM flag is set) — divided back by df inside the rotation call.
+    The multiply-then-divide is kept literally (not simplified to
+    DM_fit) so the rotation phasor matches the offline lane bit for
+    bit.  fit_DM here is the RUN-level flag: a flag-demoted bucket
+    still gets the run-level correction offline."""
+    from ..ops.rotation import rotate_portrait
+    from ..quality.postfit import postfit_cut_mask
+
+    ft = x.dtype
+    df = dfs.astype(ft) if bary else jnp.ones_like(Ps)
+    DM_corr = r.DM * df if (bary and fit_DM) else r.DM
+    aligned = jax.vmap(
+        lambda ph, dm, P, nr: rotate_portrait(modelx, -ph, -dm, P,
+                                              freqs, nr))(
+        r.phi, DM_corr / df, Ps, r.nu_DM)
+    nz = jnp.where(noise > 0, noise, jnp.ones_like(noise))
+    resid = x - r.scales[..., None] * aligned
+    chan_rchi2 = (jnp.sum(resid**2, axis=-1) / nz**2
+                  / max(nbin - 1, 1))
+    return postfit_cut_mask(chan_rchi2, r.channel_snrs, r.snr,
+                            cmask > 0)
+
+
 def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
                 use_fast, ftname, x_bf16, redisp=False,
                 want_flux=False, use_ir=False, compensated=False,
                 nharm_eff=None, seed_derotate=True, raw_code="i16",
                 pol_sum=False, zap_nstd=None, col_scaled=False,
-                pack_w=None):
+                pack_w=None, postfit=None):
     """Cache-key normalizing front for _raw_fit_fn_cached: dead knob
     combinations collapse onto one compiled program — compensated is
     meaningless without the scatter engine, and under compensated mode
@@ -1153,7 +1190,8 @@ def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
     derotation phasor is then the identity and the trig pass over the
     cross-spectrum is skipped — same packed output to the bit, one
     fewer moment-sized pass per subint."""
-    from ..fit.portrait import use_fit_fused
+    from ..fit.portrait import resolve_fit_fused
+    from ..ops.decode import PACKED_BITS
     from ..ops.fourier import use_dft_fold
 
     scat_engine = (flags[3] or flags[4] or log10_tau
@@ -1170,14 +1208,28 @@ def _raw_fit_fn(nchan, nbin, flags, max_iter, log10_tau, tau_mode,
     # not silently reuse the other arm's program.  fit_fused is
     # normalized onto False wherever it is a no-op (complex engine, no
     # harmonic window) so a dead knob never keys a second bit-identical
-    # program.
-    fit_fused = (use_fit_fused() and use_fast
-                 and nharm_eff is not None)
+    # program; the resolved token also carries the Pallas-kernel and
+    # block-size knobs (fit/portrait.resolve_fit_fused).
+    fit_fused = resolve_fit_fused(nharm_eff) if use_fast else False
+    # decode-fused (Pallas decode+DFT tile): only the plain sub-byte
+    # no-scatter windowed lane qualifies — per-channel byte tiling
+    # needs nbin*nbit % 8 == 0, and redisp/pol_sum/transport-packing/
+    # column-scaling all need the materialized portrait.  (Packed raw
+    # never bucket-channel-pads — _load_raw refuses that combination —
+    # so the kernel's channel geometry is exact.)
+    nbit = PACKED_BITS.get(raw_code)
+    pallas_mode = isinstance(fit_fused, str) \
+        and fit_fused.startswith("pallas")
+    decode_fused = bool(
+        pallas_mode and use_fast and not scat_engine
+        and nbit is not None and (nbin * nbit) % 8 == 0
+        and not pol_sum and not col_scaled and not redisp
+        and pack_w is None and nharm_eff is not None)
     return _raw_fit_fn_cached(
         nchan, nbin, flags, max_iter, log10_tau, tau_mode, use_fast,
         ftname, x_bf16, redisp, want_flux, use_ir, compensated,
         nharm_eff, seed_derotate, use_dft_fold(), raw_code, pol_sum,
-        zap_nstd, fit_fused, col_scaled, pack_w)
+        zap_nstd, fit_fused, col_scaled, pack_w, decode_fused, postfit)
 
 
 @lru_cache(maxsize=None)
@@ -1188,7 +1240,7 @@ def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
                        seed_derotate=True, dft_fold=None,
                        raw_code="i16", pol_sum=False, zap_nstd=None,
                        fit_fused=False, col_scaled=False,
-                       pack_w=None):
+                       pack_w=None, decode_fused=False, postfit=None):
     """ONE jitted program for a raw bucket: sample decode (scl/offs
     affine per raw_code — ops/decode; packed sub-byte codes bit-plane
     unpack first; col_scaled folds the general TSCAL/TZERO scalars in
@@ -1227,7 +1279,7 @@ def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
 
     def run(raw, scl, offs, cmask, modelx, freqs, Ps, DMg, nu_out,
             tau_s, tau_nu, tau_a, alpha0, redisp_turns, ir_r, ir_i,
-            tscal=None, tzero=None, vmin=None):
+            tscal=None, tzero=None, vmin=None, dfs=None):
         x = _raw_decode(raw, scl, offs, nbin, ft, redisp=redisp,
                         redisp_turns=redisp_turns, dft_fold=dft_fold,
                         code=raw_code, pol_sum=pol_sum,
@@ -1265,7 +1317,33 @@ def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
             [zeros, DMg.astype(ft), zeros, th3,
              jnp.broadcast_to(jnp.asarray(alpha0, ft), (nb,))], axis=1)
         nu_out_arr = jnp.broadcast_to(jnp.asarray(nu_out, ft), (nb,))
-        if use_fast and not scat_engine:
+        if use_fast and not scat_engine and decode_fused:
+            # decode-fused Pallas lane: the fit's prepare re-decodes
+            # the packed bytes INSIDE the channel-tile kernel
+            # (fit/portrait.fast_fit_one_packed), so the big
+            # (nb, nchan, nbin) portrait read the DFT prep used to do
+            # comes straight from wire bytes; the stats pass above
+            # still decodes once (its reductions fuse, and zap/tau
+            # seeding need the time-domain portrait).  Bit-identical
+            # to the materialized lane: the in-kernel decode chain is
+            # per-channel exact and the gemm tiles are shape-identical.
+            from ..fit.portrait import (_fast_batch_packed_fn,
+                                        _parse_fit_fused)
+            from ..ops.decode import PACKED_BITS
+
+            _, blk = _parse_fit_fused(fit_fused)
+            bpc = (nbin * PACKED_BITS[raw_code]) // 8
+            fit = _fast_batch_packed_fn(FitFlags(*flags), max_iter,
+                                        raw_code, nbin,
+                                        seed_derotate=seed_derotate,
+                                        x_bf16=x_bf16,
+                                        nharm_eff=nharm_eff,
+                                        dft_fold=dft_fold,
+                                        fused_block=blk)
+            r = fit(raw.reshape(raw.shape[0], nchan, bpc), scl, offs,
+                    modelx, noise, cmask, freqs, Ps, nu_fit,
+                    nu_out_arr, theta0)
+        elif use_fast and not scat_engine:
             fit = _fast_batch_fn(FitFlags(*flags), max_iter,
                                  None, None, 0, 0,
                                  seed_derotate=seed_derotate,
@@ -1318,7 +1396,17 @@ def _raw_fit_fn_cached(nchan, nbin, flags, max_iter, log10_tau,
             # while the trace still learns channels-cut-per-archive
             # and proves the iterating happened inside the program)
             fields += [nzap, zap_iter.astype(ft)]
-        return jnp.stack([jnp.asarray(f).astype(ft) for f in fields])
+        packed = jnp.stack([jnp.asarray(f).astype(ft) for f in fields])
+        if postfit is not None:
+            # in-stream post-fit red-chi2/S-N cut (ISSUE 16 satellite):
+            # nchan extra packed rows carry the per-channel bad mask —
+            # still one small pull (nchan << nbin)
+            bary_pf, fit_DM_run = postfit
+            bad = _postfit_bad_mask(x, r, noise, cmask, modelx, freqs,
+                                    Ps, dfs, bary_pf, fit_DM_run, nbin)
+            packed = jnp.concatenate(
+                [packed, jnp.swapaxes(bad, 0, 1).astype(ft)], axis=0)
+        return packed
 
     return jax.jit(run)
 
@@ -1528,7 +1616,8 @@ def _byte_put(device, nbytes):
 
 def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
             tau_mode="none", tau_args=(0.0, 1.0, 0.0), alpha0=0.0,
-            pipeline=None, want_flux=False, seq=0, zap_nstd=None):
+            pipeline=None, want_flux=False, seq=0, zap_nstd=None,
+            postfit=None):
     """Launch ONE fused dispatch for a bucket's pending subints
     through ``pipeline`` (the bucket's _DevicePipeline) and return an
     in-flight record — WITHOUT waiting for the device.  The
@@ -1560,6 +1649,10 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
     # the expensive np.stack passes run on the copy worker
     masks_rows = [bucket.masks[i] for i in idx0]
     Ps = np.asarray([bucket.Ps[i] for i in idx0])
+    # doppler factors ride only when the in-stream postfit cut needs
+    # the doppler-corrected DM for its model rotation
+    dfs_h = (np.asarray([bucket.dfs[i] for i in idx0])
+             if postfit is not None else None)
     flags = FitFlags(*bucket.flags)
     keys = _result_keys(flags)
     if want_flux:
@@ -1608,7 +1701,7 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                 raw_code=bucket.raw_code,
                 pol_sum=bucket.pol_sum,
                 zap_nstd=zap_nstd, col_scaled=col_scaled,
-                pack_w=pack_w)
+                pack_w=pack_w, postfit=postfit)
 
         fn = make_fn(None)
         ft = jnp.float32 if use_fast else jnp.float64
@@ -1686,7 +1779,8 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                         put(masks, ft), put(modelx, ft),
                         put(freqs, ft), put(Ps, ft), put(DMg, ft),
                         put(turns, ft), ir_r, ir_i, tscal_d, tzero_d,
-                        vmin_d)
+                        vmin_d,
+                        put(dfs_h, ft) if dfs_h is not None else None)
             # logical bytes: what the dispatch would have shipped
             # uncompressed — only the payload (and its vmin sidecar)
             # differ between the lanes
@@ -1697,7 +1791,7 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
 
         def fit(raw_d, scl_d, offs_d, masks_d, modelx_d, freqs_d,
                 Ps_d, DMg_d, turns_d, ir_r, ir_i, tscal_d, tzero_d,
-                vmin_d):
+                vmin_d, dfs_d=None):
             # the copy stage has resolved by now; a compressed payload
             # selects the width-keyed program (lru-cached like every
             # other variant)
@@ -1707,7 +1801,7 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                               freqs_d, Ps_d, DMg_d, ft(nu_out),
                               ft(t_s), ft(t_nu), ft(t_a), ft(alpha0),
                               turns_d, ir_r, ir_i, tscal_d, tzero_d,
-                              vmin_d)
+                              vmin_d, dfs_d)
     else:
         ports_rows = [bucket.ports[i] for i in idx0]
         noise_rows = [bucket.noise[i] for i in idx0]
@@ -1737,10 +1831,12 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                         put(noise, dt), put(freqs, dt), put(Ps, dt),
                         put(nu_fit, dt), put(theta0, dt),
                         put(masks, dt))
+                if dfs_h is not None:
+                    args = args + (put(dfs_h, dt),)
             return args, nbytes[0]
 
         def fit(ports_d, modelx_d, noise_d, freqs_d, Ps_d, nu_fit_d,
-                theta0_d, masks_d):
+                theta0_d, masks_d, dfs_d=None):
             with _on_device(device):
                 if use_fast:
                     # both regimes share the complex-free matmul-DFT
@@ -1773,7 +1869,18 @@ def _launch(bucket, nu_ref_DM, max_iter, nsub_batch, log10_tau=False,
                         r.scales, r.scale_errs,
                         jnp.mean(modelx_d, axis=-1),
                         masks_d, freqs_d)]
-                return jnp.stack(fields)
+                packed = jnp.stack(fields)
+                if postfit is not None:
+                    # in-stream postfit cut: nchan extra rows with the
+                    # per-channel bad mask (see _raw_fit_fn_cached)
+                    bad = _postfit_bad_mask(
+                        ports_d, r, noise_d, masks_d, modelx_d,
+                        freqs_d, Ps_d, dfs_d, postfit[0], postfit[1],
+                        int(ports_d.shape[-1]))
+                    packed = jnp.concatenate(
+                        [packed, jnp.swapaxes(bad, 0, 1).astype(
+                            packed.dtype)], axis=0)
+                return packed
 
     handle = pipeline.submit(copy, fit, seq)
     rec = (handle, list(bucket.owners), keys)
@@ -1915,7 +2022,7 @@ def make_wideband_lane(modelfile, nsub_batch=256, fit_DM=True,
                        addtnl_toa_flags={}, quiet=False,
                        quality_flags=False, tracer=None,
                        key_prefix=(), zap_inline=False, zap_nstd=None,
-                       zap_channels=None):
+                       zap_channels=None, postfit_cut=False):
     """Build the wideband physics lane + archive loader for a template
     and option set — the per-driver half of the streaming split.
     Returns ``(lane, loader)``: the lane supplies _StreamExecutor's
@@ -1948,6 +2055,11 @@ def make_wideband_lane(modelfile, nsub_batch=256, fit_DM=True,
     # lossless in-memory weight zeroing (quality.zap_bunch) — the
     # offline-zap digit-oracle arm
     zap_nstd_run = resolve_zap_nstd(zap_nstd) if zap_inline else None
+    # post-fit quality cut (ISSUE 16): the bucket program appends a
+    # per-channel bad mask built from model residuals (quality/postfit
+    # thresholds) — the tuple carries the two run-level knobs the
+    # residual rotation needs (barycentering and whether DM was fit)
+    postfit_run = (bool(bary), bool(fit_DM)) if postfit_cut else None
     zap_map = {os.path.abspath(k): v
                for k, v in (zap_channels or {}).items()}
     ird = {**DEFAULT_IR_DICT, **(instrumental_response_dict or {})}
@@ -2019,6 +2131,12 @@ def make_wideband_lane(modelfile, nsub_batch=256, fit_DM=True,
 
     class _WidebandLane:
         """The wideband physics hooks for _StreamExecutor."""
+
+        def __init__(self):
+            # {datafile: {subint: [bad channel indices]}} when
+            # postfit_cut is on — the in-stream analogue of
+            # GetTOAs.get_channels_to_zap's self.zap_channels
+            self.postfit_zaps = {}
 
         def prepare(self, iarch, datafile, d, ok):
             nchan, nbin = d.nchan, d.nbin
@@ -2232,6 +2350,7 @@ def make_wideband_lane(modelfile, nsub_batch=256, fit_DM=True,
                         b.theta0.append(th)
                     b.masks.append(masks_b[j])
                     b.Ps.append(float(d.Ps[isub]))
+                    b.dfs.append(float(d.doppler_factors[isub]))
                     b.owners.append((iarch, isub))
 
                 per_subint.append((key, factory, fill))
@@ -2242,13 +2361,19 @@ def make_wideband_lane(modelfile, nsub_batch=256, fit_DM=True,
                            log10_tau=log10_tau, tau_mode=tau_mode,
                            tau_args=tau_args, alpha0=alpha0_run,
                            pipeline=pipeline, want_flux=print_flux,
-                           seq=seq, zap_nstd=zap_nstd_run)
+                           seq=seq, zap_nstd=zap_nstd_run,
+                           postfit=postfit_run)
 
         def scatter(self, out, owners, keys, results):
             packed = np.asarray(out)
+            nk = len(keys)
             for i, owner in enumerate(owners):  # pad lanes discarded
-                results[owner] = {k: packed[j, i]
-                                  for j, k in enumerate(keys)}
+                res = {k: packed[j, i] for j, k in enumerate(keys)}
+                if packed.shape[0] > nk:
+                    # post-fit quality rows (ISSUE 16): per-channel
+                    # bad-channel mask appended past the named fields
+                    res["postfit_bad"] = packed[nk:, i]
+                results[owner] = res
 
         def assemble(self, m, results):
             if zap_nstd_run is not None and tracer.enabled:
@@ -2272,6 +2397,26 @@ def make_wideband_lane(modelfile, nsub_batch=256, fit_DM=True,
                     if nz:
                         tracer.emit("zap_apply", datafile=m.datafile,
                                     n_channels=int(nz))
+            if postfit_run is not None:
+                # post-fit model-based cut (ISSUE 16): the device
+                # program appended a per-channel bad mask; collect it
+                # into ppzap-style {subint: [channels]} lists.  The
+                # TOAs themselves are NOT modified — the lists are the
+                # same artifact GetTOAs + get_channels_to_zap produce
+                # offline, ready to feed back as ``zap_channels``.
+                zaps = {}
+                for isub in m.ok:
+                    r = results.get((m.iarch, int(isub)))
+                    if isinstance(r, dict) and "postfit_bad" in r:
+                        zaps[int(isub)] = sorted(
+                            int(c) for c in np.flatnonzero(
+                                r["postfit_bad"][:m.nchan] > 0))
+                self.postfit_zaps[m.datafile] = zaps
+                if tracer.enabled:
+                    tracer.emit(
+                        "zap_propose", datafile=m.datafile,
+                        n_channels=sum(len(v) for v in zaps.values()),
+                        n_iter=0, device=True, wall_s=0.0)
             return _assemble_archive(
                 m, results, modelfile, fit_DM, bary, addtnl_toa_flags,
                 log10_tau=log10_tau,
@@ -2296,7 +2441,8 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                          skip_archives=None, stream_devices=None,
                          telemetry=None, quality_flags=False,
                          pipeline_depth=None, zap_inline=False,
-                         zap_nstd=None, zap_channels=None):
+                         zap_nstd=None, zap_channels=None,
+                         postfit_cut=False):
     """Measure wideband (phi[, DM[, tau, alpha]]) TOAs for many
     archives with cross-archive batched dispatches.
 
@@ -2321,6 +2467,15 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
     ppzap --apply rewrite is NOT (the PSRFITS writer re-quantizes
     DATA).  This is the offline zap-then-fit oracle arm the inline
     lane's digit gates compare against.
+
+    postfit_cut=True runs the POST-fit model-based quality cut inside
+    the streaming path (ISSUE 16): each bucket program appends
+    per-channel bad-channel rows built from the fitted model's
+    residual reduced chi2 and the channel S/N (quality/postfit
+    thresholds, same recipe as GetTOAs + get_channels_to_zap), and
+    the returned DataBunch carries ``postfit_zaps`` — {archive path:
+    {subint: [channel indices]}} ready to feed back as
+    ``zap_channels`` on a re-run.  TOAs are NOT modified.
 
     fit_scat/log10_tau/scat_guess/fix_alpha follow GetTOAs.get_TOAs
     (scat_guess may be (tau_s, nu, alpha), "auto" for the data-driven
@@ -2422,7 +2577,7 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
             addtnl_toa_flags=addtnl_toa_flags, quiet=quiet,
             quality_flags=quality_flags, tracer=tracer,
             zap_inline=zap_inline, zap_nstd=zap_nstd,
-            zap_channels=zap_channels)
+            zap_channels=zap_channels, postfit_cut=postfit_cut)
         ex = _StreamExecutor(lane, datafiles, loader,
                              nsub_batch, max_inflight=max_inflight,
                              prefetch=prefetch, tim_out=tim_out,
@@ -2474,7 +2629,8 @@ def stream_wideband_TOAs(datafiles, modelfile, nsub_batch=256,
                      h2d_bytes=int(ex.h2d_bytes),
                      h2d_bytes_logical=int(ex.h2d_logical_bytes),
                      codec_duration=ex.codec_duration,
-                     h2d_duration=ex.h2d_duration)
+                     h2d_duration=ex.h2d_duration,
+                     postfit_zaps=lane.postfit_zaps)
 
 
 # --------------------------------------------------------------------------
@@ -2538,7 +2694,7 @@ def _nb_fit_fields(x, modelx, noise, cmask, freqs, Ps, ft, nbin,
 @lru_cache(maxsize=None)
 def _raw_nb_fn(nchan, nbin, fit_scat, log10_tau, tau_mode, max_iter,
                ftname, redisp, raw_code="i16", pol_sum=False,
-               col_scaled=False):
+               col_scaled=False, zap_nstd=None):
     """ONE jitted program for a narrowband raw bucket: sample decode
     (_raw_decode — shared with the wideband program, so the two lanes
     cannot drift on sample types, sub-byte unpack, column scaling, or
@@ -2547,7 +2703,16 @@ def _raw_nb_fn(nchan, nbin, fit_scat, log10_tau, tau_mode, max_iter,
     fit_phase_shift_batch (no scattering) or the 5-param engine with
     (phi, tau) per single-channel portrait (get_narrowband_TOAs'
     flattened path, pipeline/toas.py:786-835).  Returns a packed
-    (nfield, nb, nchan) array."""
+    (nfield, nb, nchan) array.
+
+    zap_nstd non-None fuses the inline median noise cut (ISSUE 16
+    satellite — the narrowband twin of the wideband raw program's
+    ISSUE 12 excision): the iterative cut runs on the device-resident
+    noise, the post-zap keep mask zeroes cmask, and one extra packed
+    (nb, nchan) 'keep' row tells assembly which per-channel TOAs to
+    drop.  The surviving channels' 1-D fits are bit-identical to the
+    offline zap-then-fit oracle: each channel's fit reads only its own
+    row, so zeroing a NEIGHBOR'S weight cannot perturb it."""
     from ..fit.phase_shift import fit_phase_shift_batch
 
     ft = {"float32": jnp.float32, "float64": jnp.float64}[ftname]
@@ -2562,9 +2727,17 @@ def _raw_nb_fn(nchan, nbin, fit_scat, log10_tau, tau_mode, max_iter,
                         tscal=tscal if col_scaled else None,
                         tzero=tzero if col_scaled else None)
         noise = jnp.maximum(get_noise_PS(x), tiny)
+        keep = None
+        if zap_nstd is not None:
+            from ..quality.excision import zap_keep_mask
+
+            keep, _ = zap_keep_mask(noise, cmask > 0, zap_nstd)
+            cmask = cmask * keep.astype(ft)
         fields = _nb_fit_fields(x, modelx, noise, cmask, freqs, Ps,
                                 ft, nbin, fit_scat, log10_tau, tau_mode,
                                 max_iter, tau_s, tau_nu, tau_a)
+        if keep is not None:
+            fields = list(fields) + [keep]
         return jnp.stack([jnp.asarray(f).astype(ft) for f in fields])
 
     return jax.jit(run)
@@ -2578,7 +2751,8 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
                            addtnl_toa_flags={}, tim_out=None,
                            quiet=False, resume=False,
                            skip_archives=None, stream_devices=None,
-                           telemetry=None, pipeline_depth=None):
+                           telemetry=None, pipeline_depth=None,
+                           zap_inline=False, zap_nstd=None):
     """Campaign-scale narrowband TOAs: per-channel 1-D fits with the
     same raw-int16 device pipeline, bucketing, and asynchronous
     dispatch as stream_wideband_TOAs — one TOA per unzapped channel
@@ -2589,6 +2763,18 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
     payloads and general TSCAL/TZERO included — see _load_raw); the
     remaining non-raw-representable layouts fall back to a
     host-decoded dispatch of the same device fits.
+
+    zap_inline=True runs the ppzap median noise cut INLINE (ISSUE 16
+    satellite — the narrowband twin of stream_wideband_TOAs' ISSUE 12
+    excision): raw buckets fuse the iterative median + ``zap_nstd``*std
+    cut into the device program and a packed 'keep' row drops the
+    flagged channels' TOAs at assembly; decoded-lane archives cut at
+    prepare, before the ok-channel lists are derived.  Because every
+    narrowband fit is per-channel independent, surviving channels'
+    TOAs are BIT-identical to offline-zapping the same lists first —
+    the only difference is which channels emit lines.  zap_nstd:
+    threshold in stds (None = config.zap_nstd / PPT_ZAP_NSTD).
+
     tim_out / resume / skip_archives / stream_devices / max_inflight /
     pipeline_depth / telemetry follow stream_wideband_TOAs
     (per-archive completion sentinels; round-robin multi-device
@@ -2634,20 +2820,40 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
     tracer, own_tracer = resolve_tracer(telemetry,
                                         run="stream_narrowband_TOAs")
     t_start = time.time()
+    # inline excision (ISSUE 16 satellite): raw buckets fuse the cut
+    # into the device program (an extra packed 'keep' row), decoded
+    # buckets cut at prepare — mirroring the wideband lane's split
+    from .zap import resolve_zap_device, resolve_zap_nstd
+
+    zap_nstd_run = resolve_zap_nstd(zap_nstd) if zap_inline else None
     keys = _NB_SCAT_KEYS if fit_scat else _NB_KEYS
+    if zap_nstd_run is not None:
+        # raw buckets append the keep row; decoded buckets' packed
+        # stacks are one row shorter and zip() below just ignores the
+        # missing key
+        keys = keys + ("keep",)
     ftname = "float32" if use_fast_fit_default() else "float64"
     ft = jnp.float32 if use_fast_fit_default() else jnp.float64
 
     def assemble(m, results):
         """Per-channel TOA objects for one archive."""
         toas = []
+        n_cut = 0
+        saw_keep = False
         for j, isub in enumerate(m.ok):
             r = results.get((m.iarch, int(isub)))
             if r is None:
                 continue
             vals = dict(zip(keys, r))
+            saw_keep = saw_keep or "keep" in vals
             P = m.Ps[j]
             for ichan in m.okc[j]:
+                if "keep" in vals and not vals["keep"][ichan] > 0:
+                    # raw-lane inline zap: the device program flagged
+                    # this channel — its TOA line is dropped exactly
+                    # as an offline-zapped load would never emit it
+                    n_cut += 1
+                    continue
                 toa_mjd = m.epochs[j].add_seconds(
                     float(vals["phase"][ichan]) * P + m.backend_delay)
                 flags = {
@@ -2672,6 +2878,18 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
                     m.datafile, float(m.freqs0[ichan]), toa_mjd,
                     float(vals["phase_err"][ichan]) * P * 1e6,
                     m.telescope, m.telescope_code, None, None, flags))
+        if saw_keep and tracer.enabled:
+            # fused raw-lane inline zap (dec archives emitted their
+            # events at prepare).  One proposal per raw archive — 0
+            # channels for clean data, matching the wideband lane's
+            # contract; wall_s is 0 by design: the cut runs inside the
+            # fit dispatch.
+            tracer.emit("zap_propose", datafile=m.datafile,
+                        n_channels=n_cut, n_iter=0, device=True,
+                        wall_s=0.0)
+            if n_cut:
+                tracer.emit("zap_apply", datafile=m.datafile,
+                            n_channels=n_cut)
         return toas
 
     def launch_nb(b, pipeline, seq):
@@ -2699,7 +2917,8 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
                             bool(fit_scat), bool(log10_tau), tau_mode,
                             int(max_iter), ftname, redisp,
                             raw_code=b.raw_code, pol_sum=b.pol_sum,
-                            col_scaled=col_scaled)
+                            col_scaled=col_scaled,
+                            zap_nstd=zap_nstd_run)
 
             def copy():
                 raw, scl, offs, turns = _stack_rows(rows, dedisp,
@@ -2778,6 +2997,31 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
             tscal_val = float(d.get("tscal") or 1.0) if raw_mode else 1.0
             tzero_val = float(d.get("tzero") or 0.0) if raw_mode else 0.0
             masks = np.asarray(d.weights[ok] > 0.0, float)
+            if zap_nstd_run is not None and not raw_mode and len(ok):
+                # decoded-lane inline excision: cut BEFORE the
+                # ok-channel lists are derived, so assembly emits
+                # exactly the TOA set an offline-zapped load would
+                from ..quality.excision import (zap_keep_device,
+                                                zap_keep_np)
+
+                noise_z = np.asarray(d.noise_stds[ok, 0])
+                use_dev = resolve_zap_device(None)
+                t0z = time.perf_counter()
+                keep, iters = (zap_keep_device if use_dev
+                               else zap_keep_np)(noise_z, masks > 0,
+                                                 zap_nstd_run)
+                wall_z = time.perf_counter() - t0z
+                n_cut = int(masks.sum() - (masks * keep).sum())
+                masks = masks * keep
+                if tracer.enabled:
+                    tracer.emit("zap_propose", datafile=datafile,
+                                n_channels=n_cut,
+                                n_iter=int(np.max(iters, initial=0)),
+                                device=bool(use_dev),
+                                wall_s=round(wall_z, 6))
+                    if n_cut:
+                        tracer.emit("zap_apply", datafile=datafile,
+                                    n_channels=n_cut)
             key = (nchan, nbin, freqs0.tobytes(),
                    "raw" if raw_mode else "dec") + (
                        (raw_code, pol_sum, col_scaled)
@@ -2786,8 +3030,8 @@ def stream_narrowband_TOAs(datafiles, modelfile, nsub_batch=64,
             m = DataBunch(
                 datafile=datafile, iarch=iarch, ok=ok, nbin=nbin,
                 freqs0=freqs0,
-                okc=[np.flatnonzero(np.asarray(d.weights[isub] > 0.0))
-                     for isub in ok],
+                okc=[np.flatnonzero(masks[j] > 0)
+                     for j in range(len(ok))],
                 epochs=[d.epochs[isub] for isub in ok],
                 Ps=[float(d.Ps[isub]) for isub in ok],
                 subtimes=[float(d.subtimes[isub]) for isub in ok],

@@ -94,8 +94,15 @@ TINY_ENV = {
 
 _CONFIG_KEYS = ("dft_precision", "cross_spectrum_dtype", "dft_fold",
                 "scatter_compensated", "fit_harmonic_window",
-                "telemetry_path", "fit_fused", "lm_jacobian",
+                "telemetry_path", "fit_fused", "fit_pallas",
+                "fused_block", "lm_jacobian",
                 "raw_subbyte", "transport_compress")
+
+# the heavyweight smoke shapes (tier-1 lives under a wall-clock cap on
+# a single-core runner; these four dominated the suite's durations
+# report) — still exercised in the full `-m slow` run
+_HEAVY_BENCHES = {"bench_gauss", "bench_scatter", "bench_zap",
+                  "bench_campaign"}
 
 
 def test_all_bench_scripts_covered():
@@ -105,7 +112,10 @@ def test_all_bench_scripts_covered():
         set(BENCH_MODULES) ^ set(TINY_ENV))
 
 
-@pytest.mark.parametrize("name", BENCH_MODULES)
+@pytest.mark.parametrize(
+    "name",
+    [pytest.param(n, marks=pytest.mark.slow) if n in _HEAVY_BENCHES
+     else n for n in BENCH_MODULES])
 def test_bench_smoke(name, monkeypatch, capsys, tmp_path):
     for k, v in TINY_ENV[name].items():
         if k in ("PPT_CAMPAIGN_CACHE", "PPT_GAUSS_CACHE"):
@@ -344,6 +354,7 @@ def test_bench_smoke(name, monkeypatch, capsys, tmp_path):
         assert cmp_arm["auto_engaged"] is False
 
 
+@pytest.mark.slow
 def test_bench_root_fused_arm(monkeypatch, capsys):
     """ISSUE 14: the headline fit bench (repo-root bench.py) carries a
     fused-vs-unfused A/B whose bitwise gate is ENFORCED in-bench
@@ -374,4 +385,40 @@ def test_bench_root_fused_arm(monkeypatch, capsys):
     assert out["harmonic_window"] is not None
     assert out["fused_identical"] is True
     assert out["fused_vs_unfused"] > 0
+    assert out["accuracy_gate_1e-4"] is True
+
+
+def test_bench_root_pallas_arm(monkeypatch, capsys):
+    """ISSUE 16: with PPT_FIT_PALLAS=on the headline bench adds the
+    Pallas-kernel arm, interpret mode on CPU, with the same ENFORCED
+    bitwise gate (SystemExit on drift) — the fast CI witness that a
+    kernel edit cannot land with phi drift.  The forced window
+    (PPT_HARMONIC_WINDOW) keeps the shape tiny: the content-derived
+    window refuses 256-bin templates."""
+    import importlib.util
+
+    monkeypatch.setenv("PPT_NB", "8")
+    monkeypatch.setenv("PPT_NCHAN", "8")
+    monkeypatch.setenv("PPT_NBIN", "256")
+    monkeypatch.setenv("PPT_HARMONIC_WINDOW", "128")
+    monkeypatch.setenv("PPT_FIT_PALLAS", "on")
+    saved = {k: getattr(config, k) for k in _CONFIG_KEYS}
+    spec = importlib.util.spec_from_file_location(
+        "bench_root_pallas", os.path.join(BENCH_DIR, "..", "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    try:
+        mod.main()
+    finally:
+        for k, v in saved.items():
+            setattr(config, k, v)
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.strip().startswith("{")]
+    assert lines, "bench.py printed no JSON line"
+    out = json.loads(lines[-1])
+    assert out["harmonic_window"] == 128
+    assert out["fused_identical"] is True
+    assert out["pallas_identical"] is True
+    assert out["pallas_interpret"] is True  # CPU = interpret mode
+    assert out["pallas_toas_per_sec"] > 0
     assert out["accuracy_gate_1e-4"] is True

@@ -101,6 +101,7 @@ def test_infeasible_seed_projected_into_box(data):
     assert 0.1 - 1e-9 <= float(r.DM[0]) <= 0.2 + 1e-9
 
 
+@pytest.mark.slow
 def test_scatter_lane_tau_upper_bound():
     """The scattering lane honors a log10-tau upper bound: tau pins at
     the bound with rc 0."""
@@ -127,6 +128,7 @@ def test_scatter_lane_tau_upper_bound():
     assert int(r1.return_code[0]) == 0
 
 
+@pytest.mark.slow
 def test_gettoas_bounds_plumbing(tmp_path):
     """bounds reach the fits through GetTOAs: a DM box excluding the
     injected dDM pins every subint's DM at the bound with rc 0, and

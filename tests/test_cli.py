@@ -60,8 +60,15 @@ def test_ppgauss_cli(workspace):
     assert (root / "avg.gmodel_errs").exists()
 
 
+@pytest.mark.slow
 def test_ppspline_cli(workspace):
     root, meta, files = workspace
+    # self-sufficient under -m slow, where the tier-1 ppalign test
+    # that normally writes avg.fits into the module workspace is
+    # deselected
+    if not (root / "avg.fits").exists():
+        assert ppalign.main(["-M", meta, "--niter", "2", "-o",
+                             str(root / "avg.fits")]) == 0
     rc = ppspline.main(["-d", str(root / "avg.fits"),
                         "-o", str(root / "avg.spl"),
                         "-S", "50.0", "--quiet"])
@@ -69,7 +76,12 @@ def test_ppspline_cli(workspace):
     assert (root / "avg.spl").exists()
 
 
-@pytest.mark.parametrize("template", ["avg.gmodel", "avg.spl"])
+@pytest.mark.parametrize("template", [
+    "avg.gmodel",
+    # rides with test_ppspline_cli (slow), which writes avg.spl into
+    # the shared workspace
+    pytest.param("avg.spl", marks=pytest.mark.slow),
+])
 def test_pptoas_cli_recovers_ddms(workspace, template):
     root, meta, files = workspace
     tim = root / f"out_{template}.tim"
@@ -536,6 +548,7 @@ def test_ppgauss_gauss_device_and_batch_validation():
     assert args.gauss_device == "off"
 
 
+@pytest.mark.slow
 def test_ppspline_gauss_device_smooths_mean(tiny_fleet):
     """ppspline -s --gauss-device routes the MEAN smoothing through
     the template factory's Gaussian LM lane (the injected

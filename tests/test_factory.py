@@ -142,6 +142,7 @@ class TestFactoryGauss:
 
 
 class TestFactorySpline:
+    @pytest.mark.slow
     def test_spline_jobs_ride_the_batched_profile_lane(self, fleet):
         """kind='spline': the S/N-weighted mean profile is smoothed by
         the fleet's batched Gaussian fit and injected through

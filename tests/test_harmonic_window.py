@@ -304,6 +304,7 @@ def test_window_noisy_template_bf16_calibration(key, rng):
     assert np.allclose(rf.phi_err, rt.phi_err, rtol=5e-3)
 
 
+@pytest.mark.slow
 def test_window_engages_on_pipeline_built_spline_model(tmp_path):
     """End-to-end: a spline model built by the ACTUAL pipeline from a
     noisy synthetic archive (ppspline path, smoothing off so the
@@ -351,6 +352,7 @@ def test_window_engages_on_pipeline_built_spline_model(tmp_path):
     assert np.allclose(rf.phi_err, rt.phi_err, rtol=1e-2)
 
 
+@pytest.mark.slow
 def test_window_engages_on_pipeline_built_gauss_model(tmp_path):
     """The OTHER template factory: a ppgauss-built model is analytic
     (generated from fitted Gaussian parameters), so the absolute

@@ -42,6 +42,7 @@ def campaign(tmp_path_factory):
     return root, jobs
 
 
+@pytest.mark.slow
 def test_ipta_campaign_matches_per_pulsar_gettoas(campaign, tmp_path):
     """The campaign's TOAs equal what per-pulsar GetTOAs runs produce
     (the VERDICT round-2 done criterion for config 5)."""

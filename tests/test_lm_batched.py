@@ -287,6 +287,7 @@ class TestAnalyticJacobian:
         assert np.all(J_ad[:, frozen] == 0.0)
         assert np.all(J_an[:, frozen] == 0.0)
 
+    @pytest.mark.slow
     def test_profile_lattice(self, rng):
         from pulseportraiture_tpu.fit.gauss import (_profile_resid,
                                                     _profile_resid_jac,
@@ -312,6 +313,7 @@ class TestAnalyticJacobian:
                                (data, errs), padded, lower, upper,
                                vary)
 
+    @pytest.mark.slow
     def test_portrait_lattice(self, rng):
         from pulseportraiture_tpu.fit.gauss import (_portrait_fns,
                                                     pad_portrait_params,

@@ -100,6 +100,7 @@ def _free_port():
     return port
 
 
+@pytest.mark.slow
 def test_two_real_processes_run_a_sharded_campaign(tmp_path):
     from pulseportraiture_tpu.io import write_gmodel
     from pulseportraiture_tpu.pipeline.stream import stream_wideband_TOAs
@@ -377,6 +378,7 @@ os._exit(7)  # campaign outlived the killer: test setup failed
 """
 
 
+@pytest.mark.slow
 def test_worker_death_and_resume(tmp_path):
     """SURVEY S5 elastic recovery at campaign scale: two workers die
     mid-IPTA-campaign (each leaving a torn checkpoint tail after its

@@ -1,0 +1,418 @@
+"""Pallas interpret-mode gates (ISSUE 16): the fused-fit kernel and the
+raw-lane decode+DFT kernel, run under ``pallas_call(interpret=True)`` on
+CPU, must be BITWISE identical to the hand-blocked scan programs they
+replace — same twiddles, same tiling, same op order.  The lattice here
+is the merge gate for any kernel edit; the compiled-TPU arm of the same
+comparisons runs in the chip-session sweep (benchmarks/BENCHMARKS.md).
+
+Everything compares jit-vs-jit: eager and jit execution differ by FMA /
+reduction-order contraction (~1e-12), and the streaming bucket programs
+are always jitted, so jit-vs-jit is both the strict and the deployed
+comparison."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pulseportraiture_tpu import config
+from pulseportraiture_tpu.ops import fused as F
+from pulseportraiture_tpu.ops.decode import PACKED_BITS, decode_stokes_I
+
+from fits_forge import forge_archive, gaussian_portrait
+
+
+pytestmark = pytest.mark.skipif(
+    not F.HAVE_PALLAS_FUSED, reason="jax.experimental.pallas unavailable")
+
+
+def _problem(nchan, nbin, dt, seed=0):
+    rng = np.random.default_rng(seed)
+    port = jnp.asarray(rng.normal(size=(nchan, nbin)), dt)
+    model = jnp.asarray(rng.normal(size=(nchan, nbin)), dt)
+    nharm = nbin // 4
+    w = jnp.asarray(rng.uniform(0.5, 2.0, size=(nchan, nharm)), dt)
+    return port, model, w, nharm
+
+
+def _assert_kernel_matches_scan(nchan, nbin, dt, fold, want_m2, block):
+    port, model, w, nharm = _problem(nchan, nbin, dt)
+
+    @jax.jit
+    def scan(p, m, wk):
+        return F.fused_cross_spectrum(p, m, wk, nharm, fold=fold,
+                                      want_m2=want_m2, block=block,
+                                      pallas=False)
+
+    @jax.jit
+    def kernel(p, m, wk):
+        return F.fused_cross_spectrum_pallas(p, m, wk, nharm, fold=fold,
+                                             want_m2=want_m2,
+                                             block=block)
+
+    ref = scan(port, model, w)
+    got = kernel(port, model, w)
+    for r, g, name in zip(ref, got, ("Xr", "Xi", "o2")):
+        assert np.array_equal(np.asarray(r), np.asarray(g)), (
+            f"{name} not bitwise at {nchan}x{nbin} {dt} fold={fold} "
+            f"m2={want_m2} block={block}: maxdiff="
+            f"{np.max(np.abs(np.asarray(r) - np.asarray(g)))}")
+
+
+class TestFitKernelParity:
+    """fused_cross_spectrum_pallas vs the scan, bitwise."""
+
+    # One directed row per independent axis flip off a ragged-channel
+    # base case (13 channels never divides the block): dtype, fold,
+    # want_m2, block override, block-not-dividing-nchan, tiny shape.
+    DIRECTED = [
+        (13, 128, "float64", True, False, None),
+        (13, 128, "float32", True, False, None),
+        (13, 128, "float64", False, True, None),
+        (13, 128, "float64", True, True, 5),
+        (24, 256, "float32", False, False, 8),
+        (8, 64, "float64", True, False, None),
+    ]
+
+    @pytest.mark.parametrize("nchan,nbin,dt,fold,want_m2,block", DIRECTED)
+    def test_parity_directed(self, nchan, nbin, dt, fold, want_m2,
+                             block):
+        _assert_kernel_matches_scan(nchan, nbin, dt, fold, want_m2,
+                                    block)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("nchan,nbin", [(24, 256), (13, 128),
+                                            (8, 64)])
+    @pytest.mark.parametrize("dt", ["float64", "float32"])
+    def test_parity_full_lattice(self, nchan, nbin, dt):
+        for fold in (False, True):
+            for want_m2 in (False, True):
+                for block in (None, 8, 5):
+                    _assert_kernel_matches_scan(nchan, nbin, dt, fold,
+                                                want_m2, block)
+
+    def test_vmap_shared_model_parity(self):
+        """The deployed shape: vmapped over subints with the template
+        model unbatched (in_axes=None hoists its per-block DFT)."""
+        rng = np.random.default_rng(7)
+        nb, nchan, nbin, nharm = 3, 16, 128, 32
+        port = jnp.asarray(rng.normal(size=(nb, nchan, nbin)))
+        model = jnp.asarray(rng.normal(size=(nchan, nbin)))
+        w = jnp.asarray(rng.uniform(0.5, 2.0, size=(nb, nchan, nharm)))
+
+        scan = jax.jit(jax.vmap(
+            lambda p, wk: F.fused_cross_spectrum(p, model, wk, nharm,
+                                                 pallas=False),
+            (0, 0)))
+        kern = jax.jit(jax.vmap(
+            lambda p, wk: F.fused_cross_spectrum_pallas(p, model, wk,
+                                                        nharm),
+            (0, 0)))
+        for r, g, name in zip(scan(port, w), kern(port, w),
+                              ("Xr", "Xi", "S0")):
+            assert np.array_equal(np.asarray(r), np.asarray(g)), name
+
+    def test_dispatch_routes_and_threads_block(self, monkeypatch):
+        """fused_cross_spectrum(pallas=True) reaches the kernel AND
+        forwards the block override (the stub used to drop it)."""
+        seen = {}
+        orig = F.fused_cross_spectrum_pallas
+
+        def spy(*a, **k):
+            seen.update(k)
+            return orig(*a, **k)
+
+        monkeypatch.setattr(F, "fused_cross_spectrum_pallas", spy)
+        port, model, w, nharm = _problem(8, 64, "float64")
+        F.fused_cross_spectrum(port, model, w, nharm, block=5,
+                               pallas=True)
+        assert seen.get("block") == 5
+
+
+class TestDecodeKernelParity:
+    """fused_decode_cross_spectrum_pallas vs decode_stokes_I + scan +
+    host Parseval rows (the materialized raw lane), bitwise."""
+
+    @pytest.mark.parametrize("code", ["p1", "p2", "p4"])
+    def test_decode_parity(self, code):
+        rng = np.random.default_rng(3)
+        nbit = PACKED_BITS[code]
+        nchan, nbin = 13, 128
+        bpc = (nbin * nbit) // 8
+        packed = jnp.asarray(rng.integers(0, 256, size=(nchan * bpc,)),
+                             jnp.uint8)
+        scl = jnp.asarray(rng.uniform(0.5, 2.0, size=(nchan,)))
+        offs = jnp.asarray(rng.normal(size=(nchan,)))
+        model = jnp.asarray(rng.normal(size=(nchan, nbin)))
+        nharm = nbin // 4
+        w = jnp.asarray(rng.uniform(0.5, 2.0, size=(nchan, nharm)))
+
+        for fold in (False, True):
+            for block in (None, 7):
+
+                @jax.jit
+                def ref(p, s, o, m, wk):
+                    # decode_stokes_I already removes the min-window
+                    # baseline — the kernel mirrors its full chain
+                    x = decode_stokes_I(p[None], s[None], o[None],
+                                        jnp.float64, code=code,
+                                        nbin=nbin)[0]
+                    Xr, Xi, S0 = F.fused_cross_spectrum(
+                        x, m, wk, nharm, fold=fold, block=block,
+                        pallas=False)
+                    x0 = jnp.sum(x, axis=-1)
+                    mu = x0 / nbin
+                    pwr = nbin * jnp.sum((x - mu[..., None]) ** 2,
+                                         axis=-1)
+                    if nbin % 2 == 0:
+                        sg = jnp.asarray((-1.0) ** jnp.arange(nbin),
+                                         x.dtype)
+                        pwr = pwr + jnp.sum(x * sg, axis=-1) ** 2
+                    return Xr, Xi, S0, pwr, x0
+
+                @jax.jit
+                def kern(p, s, o, m, wk):
+                    return F.fused_decode_cross_spectrum_pallas(
+                        p.reshape(nchan, bpc), s, o, m, wk, nharm,
+                        code=code, nbin=nbin, fold=fold, block=block)
+
+                refs = ref(packed, scl, offs, model, w)
+                got = kern(packed, scl, offs, model, w)
+                for r, g, name in zip(refs, got,
+                                      ("Xr", "Xi", "S0", "pwr", "x0")):
+                    assert np.array_equal(np.asarray(r),
+                                          np.asarray(g)), (
+                        f"{name} not bitwise for {code} fold={fold} "
+                        f"block={block}")
+
+    def test_decode_kernel_rejects_bad_inputs(self):
+        model = jnp.zeros((4, 100))
+        w = jnp.ones((4, 25))
+        raw = jnp.zeros((4, 25), jnp.uint8)
+        one = jnp.ones((4,))
+        with pytest.raises(ValueError, match="packed sub-byte"):
+            F.fused_decode_cross_spectrum_pallas(
+                raw, one, one, model, w, 25, code="i16", nbin=100)
+        with pytest.raises(ValueError, match="byte-aligned"):
+            # 100 bins x 1 bit = 100 bits: not a whole byte count
+            F.fused_decode_cross_spectrum_pallas(
+                raw, one, one, model, w, 25, code="p1", nbin=100)
+
+
+class TestKnobs:
+    """Tri-state / block-size knob semantics and the PPT_* env hooks."""
+
+    def test_use_fit_pallas_strict(self, monkeypatch):
+        assert F.use_fit_pallas(False) is False
+        # forcing on either runs the kernel or refuses loudly — never a
+        # silent fallback to the scan
+        assert F.use_fit_pallas(True) is True
+        # 'auto' never pays interpret overhead off-TPU
+        if jax.default_backend() != "tpu":
+            assert F.use_fit_pallas("auto") is False
+        with pytest.raises(ValueError, match="fit_pallas"):
+            F.use_fit_pallas("sometimes")
+        monkeypatch.setattr(F, "HAVE_PALLAS_FUSED", False)
+        with pytest.raises(RuntimeError, match="pallas"):
+            F.use_fit_pallas(True)
+        assert F.use_fit_pallas("auto") is False
+
+    def test_fused_block_knob(self, monkeypatch):
+        monkeypatch.setattr(config, "fused_block", None)
+        assert F.fused_block_default() == 32
+        monkeypatch.setattr(config, "fused_block", 8)
+        assert F.fused_block_default() == 8
+        assert F._block_size(4) == 4  # clamped to nchan
+        monkeypatch.setattr(config, "fused_block", 0)
+        with pytest.raises(ValueError, match="fused_block"):
+            F.fused_block_default()
+
+    def test_resolve_fit_fused_tokens(self, monkeypatch):
+        from pulseportraiture_tpu.fit.portrait import (
+            _parse_fit_fused, resolve_fit_fused)
+
+        monkeypatch.setattr(config, "fit_fused", True)
+        monkeypatch.setattr(config, "fit_pallas", False)
+        monkeypatch.setattr(config, "fused_block", None)
+        assert resolve_fit_fused(128) is True
+        assert resolve_fit_fused(None) is False  # dead knob normalizes
+        monkeypatch.setattr(config, "fit_pallas", True)
+        assert resolve_fit_fused(128) == "pallas"
+        monkeypatch.setattr(config, "fused_block", 8)
+        assert resolve_fit_fused(128) == "pallas:8"
+        monkeypatch.setattr(config, "fit_pallas", False)
+        assert resolve_fit_fused(128) == "fused:8"
+        assert _parse_fit_fused("pallas") == (True, None)
+        assert _parse_fit_fused("pallas:8") == (True, 8)
+        assert _parse_fit_fused("fused:8") == (False, 8)
+        assert _parse_fit_fused(True) == (False, None)
+
+    def test_env_hooks(self, monkeypatch):
+        monkeypatch.setattr(config, "fit_pallas", "auto")
+        monkeypatch.setattr(config, "fused_block", None)
+        monkeypatch.setenv("PPT_FIT_PALLAS", "on")
+        monkeypatch.setenv("PPT_FUSED_BLOCK", "16")
+        changed = config.env_overrides()
+        assert config.fit_pallas is True
+        assert config.fused_block == 16
+        assert "fit_pallas" in changed and "fused_block" in changed
+        monkeypatch.setenv("PPT_FIT_PALLAS", "off")
+        config.env_overrides()
+        assert config.fit_pallas is False
+        monkeypatch.setenv("PPT_FIT_PALLAS", "maybe")
+        with pytest.raises(ValueError, match="PPT_FIT_PALLAS"):
+            config.env_overrides()
+        monkeypatch.setenv("PPT_FIT_PALLAS", "auto")
+        monkeypatch.setenv("PPT_FUSED_BLOCK", "0")
+        with pytest.raises(ValueError, match="PPT_FUSED_BLOCK"):
+            config.env_overrides()
+        monkeypatch.setenv("PPT_FUSED_BLOCK", "wide")
+        with pytest.raises(ValueError, match="PPT_FUSED_BLOCK"):
+            config.env_overrides()
+
+
+# ---------------------------------------------------------------------
+# Streaming .tim byte gates: flipping fit_pallas must not move a single
+# byte of the timing product, raw lane and decoded lane alike, and the
+# decode-fused kernel must actually ENGAGE for the sub-byte codes (a
+# gate that silently measures the fallback is no gate).
+# ---------------------------------------------------------------------
+
+def _noisy_maker(nchan, nbin, nsub, npol, seed=3, sigma=0.08):
+    base = gaussian_portrait(nchan, nbin)
+    rng = np.random.default_rng(seed)
+    noise = {(s, p): rng.normal(0.0, sigma, (nchan, nbin))
+             for s in range(nsub) for p in range(npol)}
+    return lambda s, p: base * (1.0 + 0.1 * p) + 0.1 * s + noise[(s, p)]
+
+
+# nbin=256 is the smallest shape where a harmonic window can engage at
+# all (resolve_harmonic_window tile-rounds to 128 and needs
+# K < nbin//2 + 1), which both the fused lane and the decode-fused gate
+# require.
+_NSUB, _NCHAN, _NBIN = 2, 8, 256
+_HWIN = 128
+
+
+@pytest.fixture(scope="module")
+def pallas_archives(tmp_path_factory):
+    """One forged archive + tscrunched template per data dtype: i16
+    (the decoded/materialized raw path) and the three packed sub-byte
+    codes the decode-fused kernel covers."""
+    from pulseportraiture_tpu.io.psrfits import (read_archive,
+                                                 unload_new_archive)
+
+    tmp = tmp_path_factory.mktemp("pallas_tim")
+    out = {}
+    for dtype in ("int16", "nbit1", "nbit2", "nbit4"):
+        f = str(tmp / f"{dtype}.fits")
+        forge_archive(f, nsub=_NSUB, nchan=_NCHAN, nbin=_NBIN, dedisp=0,
+                      data_maker=_noisy_maker(_NCHAN, _NBIN, _NSUB, 1),
+                      data_dtype=dtype)
+        arch = read_archive(f)
+        arch.tscrunch()
+        tmpl = str(tmp / f"{dtype}_tmpl.fits")
+        unload_new_archive(np.asarray(arch.amps), arch, tmpl, DM=0.0,
+                           dmc=1, quiet=True)
+        out[dtype] = (f, tmpl)
+    return tmp, out
+
+
+def _pallas_config(monkeypatch):
+    """The CPU gating configuration: fast fit forced on (the 'auto'
+    default is TPU-only), fused lane on, window engaged, so the
+    fit_pallas flip is the ONLY moving part."""
+    monkeypatch.setattr(config, "use_fast_fit", True)
+    monkeypatch.setattr(config, "fit_fused", True)
+    monkeypatch.setattr(config, "fit_harmonic_window", _HWIN)
+
+
+def _stream_tim(files, tmpl, out, **kw):
+    from pulseportraiture_tpu.pipeline import stream as S
+
+    S.stream_wideband_TOAs(files, tmpl, nsub_batch=4, quiet=True,
+                           tim_out=out, **kw)
+    with open(out, "rb") as fh:
+        return fh.read()
+
+
+def test_stream_raw_tim_byte_identical_on_pallas_flip(
+        pallas_archives, monkeypatch):
+    """i16 raw lane: the fused-fit kernel (kernel A) rides the bucket
+    program; flipping fit_pallas retraces and the .tim bytes must not
+    move.  The spy proves the kernel arm actually traced."""
+    tmp, out = pallas_archives
+    f, tmpl = out["int16"]
+    _pallas_config(monkeypatch)
+    calls = []
+    orig = F.fused_cross_spectrum_pallas
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(F, "fused_cross_spectrum_pallas", spy)
+    monkeypatch.setattr(config, "fit_pallas", False)
+    a = _stream_tim([f], tmpl, str(tmp / "i16_off.tim"))
+    assert not calls
+    monkeypatch.setattr(config, "fit_pallas", True)
+    b = _stream_tim([f], tmpl, str(tmp / "i16_on.tim"))
+    assert calls, "Pallas fused kernel never engaged"
+    assert a and a == b
+
+
+def test_stream_dec_tim_byte_identical_on_pallas_flip(
+        pallas_archives, monkeypatch):
+    """Decoded-lane twin: refuse _load_raw so the stream runs the
+    host-decoded buckets, where kernel A is the only Pallas surface."""
+    from pulseportraiture_tpu.pipeline import stream as S
+
+    tmp, out = pallas_archives
+    f, tmpl = out["int16"]
+    _pallas_config(monkeypatch)
+
+    def refuse(path, **kw):
+        raise ValueError("forced decoded lane")
+
+    monkeypatch.setattr(S, "_load_raw", refuse)
+    monkeypatch.setattr(config, "fit_pallas", False)
+    a = _stream_tim([f], tmpl, str(tmp / "dec_off.tim"))
+    monkeypatch.setattr(config, "fit_pallas", True)
+    b = _stream_tim([f], tmpl, str(tmp / "dec_on.tim"))
+    assert a and a == b
+
+
+@pytest.mark.parametrize("dtype,code", [("nbit1", "p1"),
+                                        ("nbit2", "p2"),
+                                        ("nbit4", "p4")])
+def test_stream_decode_fused_tim_byte_identical(pallas_archives,
+                                                monkeypatch, dtype,
+                                                code):
+    """Sub-byte raw lane: with fit_pallas on the decode-fused kernel
+    (kernel B) replaces decode_stokes_I + prepare, and the .tim bytes
+    must match the fit_pallas=False run of the SAME device-decoded
+    lane.  The spy proves kernel B engaged (trace-time call)."""
+    from pulseportraiture_tpu.pipeline import stream as S
+
+    tmp, out = pallas_archives
+    f, tmpl = out[dtype]
+    assert S._load_raw(f).raw_code == code
+    _pallas_config(monkeypatch)
+    calls = []
+    orig = F.fused_decode_cross_spectrum_pallas
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(F, "fused_decode_cross_spectrum_pallas", spy)
+    monkeypatch.setattr(config, "fit_pallas", False)
+    a = _stream_tim([f], tmpl, str(tmp / f"{code}_off.tim"))
+    assert not calls
+    monkeypatch.setattr(config, "fit_pallas", True)
+    b = _stream_tim([f], tmpl, str(tmp / f"{code}_on.tim"))
+    assert calls, f"decode-fused kernel never engaged for {code}"
+    assert a and a == b

@@ -505,3 +505,154 @@ def test_quality_env_hooks(monkeypatch, capsys):
     finally:
         (config.zap_nstd, config.quality_refit, config.quality_max_gof,
          config.quality_min_snr) = old
+
+# ---------------------------------------------------------------------------
+# narrowband streaming inline zap (ISSUE 16 satellite)
+# ---------------------------------------------------------------------------
+
+def _nb_tim_lines(path):
+    """Parse a narrowband .tim into (key, line) with key =
+    (archive, subint, chan) for TOA lines and key = None for
+    headers/sentinels."""
+    import re
+
+    out = []
+    for line in open(path).read().splitlines(keepends=True):
+        m = re.search(r"-subint (\d+)\b.*-chan (\d+)\b", line)
+        if m:
+            arch = line.split()[0]
+            out.append(((arch, int(m.group(1)), int(m.group(2))), line))
+        else:
+            out.append((None, line))
+    return out
+
+
+def test_stream_nb_inline_zap_drops_flagged_lines(rfi_corpus, tmp_path):
+    """Raw-lane narrowband inline zap: because every narrowband fit is
+    per-channel independent, the zapped run's .tim must equal the
+    unzapped run's MINUS exactly the offline-proposed channels' lines —
+    surviving lines bit-identical, nothing else touched."""
+    from pulseportraiture_tpu.pipeline.stream import (
+        stream_narrowband_TOAs)
+
+    files, gmodel, truths = rfi_corpus
+    zap_map = {}
+    for f in files:
+        d = load_data(f, dedisperse=False, dededisperse=True,
+                      pscrunch=True, quiet=True)
+        zap_map[f] = _full_lists(d, get_zap_channels(d, device=False))
+    a = str(tmp_path / "none.tim")
+    b = str(tmp_path / "inline.tim")
+    trace = str(tmp_path / "nb_inline.jsonl")
+    stream_narrowband_TOAs(files, gmodel, nsub_batch=8, quiet=True,
+                           tim_out=a)
+    stream_narrowband_TOAs(files, gmodel, nsub_batch=8, quiet=True,
+                           tim_out=b, zap_inline=True, telemetry=trace)
+
+    def flagged(key):
+        if key is None:
+            return False
+        arch, isub, chan = key
+        for f in files:
+            if arch in (f, os.path.basename(f)):
+                return chan in zap_map[f][isub]
+        raise AssertionError(f"unmatched tim archive {arch!r}")
+
+    expect = [ln for key, ln in _nb_tim_lines(a) if not flagged(key)]
+    got = [ln for _, ln in _nb_tim_lines(b)]
+    assert got == expect
+    n_zap = sum(len(z) for zs in zap_map.values() for z in zs)
+    assert n_zap > 0  # the cut did something
+    assert len(_nb_tim_lines(a)) - len(got) == n_zap
+    # traced like the wideband lane: device proposal rides the fit
+    # dispatch (wall_s 0), applies only for archives that lost lines
+    _, evs = validate_trace(trace)
+    props = {e["datafile"]: e for e in evs if e["type"] == "zap_propose"}
+    assert set(props) == set(files)
+    for e in props.values():
+        assert e["device"] is True and e["wall_s"] == 0.0
+    apps = {e["datafile"]: e["n_channels"] for e in evs
+            if e["type"] == "zap_apply"}
+    for f in files:
+        n = sum(len(z) for z in zap_map[f])
+        assert apps.get(f, 0) == n
+    assert files[2] not in apps
+
+
+def test_stream_nb_inline_zap_dec_lane(rfi_corpus, tmp_path):
+    """tscrunch routes the decoded narrowband lane: the prepare-time
+    cut drops the same offline-proposed channels' lines, survivors
+    bit-identical."""
+    from pulseportraiture_tpu.pipeline.stream import (
+        stream_narrowband_TOAs)
+
+    files, gmodel, _ = rfi_corpus
+    zap_map = {}
+    for f in files:
+        d = load_data(f, dedisperse=False, dededisperse=True,
+                      tscrunch=True, pscrunch=True, quiet=True)
+        zap_map[f] = _full_lists(d, get_zap_channels(d, device=False))
+    a = str(tmp_path / "none.tim")
+    b = str(tmp_path / "inline.tim")
+    stream_narrowband_TOAs(files, gmodel, nsub_batch=8, quiet=True,
+                           tscrunch=True, tim_out=a)
+    stream_narrowband_TOAs(files, gmodel, nsub_batch=8, quiet=True,
+                           tscrunch=True, tim_out=b, zap_inline=True)
+
+    def flagged(key):
+        if key is None:
+            return False
+        arch, isub, chan = key
+        for f in files:
+            if arch in (f, os.path.basename(f)):
+                return chan in zap_map[f][isub]
+        raise AssertionError(f"unmatched tim archive {arch!r}")
+
+    expect = [ln for key, ln in _nb_tim_lines(a) if not flagged(key)]
+    got = [ln for _, ln in _nb_tim_lines(b)]
+    assert got == expect
+    assert len(got) < len(expect) + sum(
+        len(z) for zs in zap_map.values() for z in zs)
+
+
+# ---------------------------------------------------------------------------
+# wideband streaming post-fit cut (ISSUE 16 satellite)
+# ---------------------------------------------------------------------------
+
+def test_stream_postfit_cut_matches_offline(rfi_corpus, tmp_path):
+    """stream_wideband_TOAs(postfit_cut=True) reports the SAME
+    per-archive channel lists as the offline
+    GetTOAs.get_TOAs + get_channels_to_zap recipe, and the cut is
+    report-only: .tim bytes identical with the knob on or off."""
+    from pulseportraiture_tpu.pipeline import GetTOAs
+
+    files, gmodel, truths = rfi_corpus
+    a = str(tmp_path / "off.tim")
+    b = str(tmp_path / "on.tim")
+    trace = str(tmp_path / "postfit.jsonl")
+    stream_wideband_TOAs(files, gmodel, nsub_batch=8, quiet=True,
+                         tim_out=a)
+    res = stream_wideband_TOAs(files, gmodel, nsub_batch=8, quiet=True,
+                               tim_out=b, postfit_cut=True,
+                               telemetry=trace)
+    assert open(a, "rb").read() == open(b, "rb").read()
+
+    gt = GetTOAs(files, gmodel, quiet=True)
+    gt.get_TOAs(quiet=True)
+    offline = gt.get_channels_to_zap(device=False)
+    assert set(res.postfit_zaps) == set(files)
+    for f, rows in zip(files, offline):
+        zaps = res.postfit_zaps[f]
+        for isub, expect in enumerate(rows):
+            assert zaps.get(isub, []) == sorted(expect), (f, isub)
+    # the structured tones are model-detected on the contaminated
+    # archives; the clean archive reports nothing
+    n0 = sum(len(z) for z in res.postfit_zaps[files[0]].values())
+    assert n0 > 0
+    assert sum(len(z) for z in res.postfit_zaps[files[2]].values()) == 0
+    # proposal events ride the fit dispatch, one per archive
+    _, evs = validate_trace(trace)
+    props = {e["datafile"]: e for e in evs if e["type"] == "zap_propose"}
+    assert set(props) == set(files)
+    for e in props.values():
+        assert e["device"] is True and e["wall_s"] == 0.0

@@ -203,6 +203,7 @@ def test_toa_client_map_error_isolated(campaign, tmp_path):
                                    timeout=300).TOA_list) == 2
 
 
+@pytest.mark.slow
 def test_serve_warmup_manifest_kills_cold_starts(campaign, tmp_path):
     """ROADMAP item 5's tail: AOT warmup from a prior run's trace
     compiles every recorded dispatch shape at server start, and the

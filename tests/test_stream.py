@@ -481,6 +481,7 @@ def test_stream_resume_skips_completed_and_drops_torn_tail(campaign,
                   if l.strip()) == full_lines
 
 
+@pytest.mark.slow
 def test_stream_narrowband_midrun_flush_no_duplicates(campaign,
                                                       tmp_path):
     """A narrowband bucket that fills MID-campaign (nsub_batch smaller
@@ -665,6 +666,7 @@ def test_stream_env_hooks(monkeypatch):
         config.stream_devices, config.stream_max_inflight = old
 
 
+@pytest.mark.slow
 def test_stream_ckpt_staleness_horizon(tmp_path, monkeypatch):
     """In-order checkpoint writes must not let an early archive stuck
     in a never-filling rare-shape bucket defer later archives' .tim
