@@ -1,0 +1,275 @@
+"""Content-addressed result-cache benchmark (ISSUE 17 acceptance
+gate): Zipfian repeat-heavy campaign replay against a warm
+``ToaServer`` with the cache on vs off, plus the 2-host router arm
+proving a hit never touches a host.
+
+Real timing campaigns re-fit the same (archive, template, options)
+triples constantly — nightly re-runs, pipeline restarts, shared
+archives across users.  The cache (serve/cache.py) keys completed
+``.tim`` payloads by SHA-256 over the archive/template BYTES and the
+frozen fit options; a hit is an O(1) atomic byte copy of the stored
+entry, byte-identical to a fresh fit by construction.
+
+Arms (one process, bench_router's virtual-device discipline):
+  references — warm cache-OFF server fits each unique archive once:
+              the fresh-fit ``.tim`` bytes every hit is gated against.
+  off       — the Zipf(s) request replay (PPT_NREQ draws over
+              PPT_NARCH archives) on the cache-off server: the
+              baseline wall.
+  on        — a cache-ON server: one populate pass over the unique
+              archives (all misses, all stored), then the SAME Zipf
+              replay — every request must HIT (``all_hits``), every
+              hit ``.tim`` must be byte-identical to its fresh-fit
+              reference (``hit_identical``), and at high skew the
+              replay must run >= PPT_CACHE_SPEEDUP_GATE x faster than
+              the off arm (``speedup_ok``; gate 5.0, 0 disables for
+              smoke runs).
+  perturb   — one archive copied and ONE byte of its data payload
+              flipped: the submit MUST miss (``perturb_missed``) and
+              fit fresh — content addressing, not path addressing.
+  router@H  — H emulated hosts behind a ToaRouter holding its OWN
+              cache: populate pass places fits on hosts, the Zipf
+              replay resolves entirely router-side — per-host
+              ``n_requests`` must NOT move during the hit replay
+              (``router_hits_bypass_hosts``), bytes gated identical.
+
+Telemetry traces (PPT_TELEMETRY base) for the on/router arms must
+schema-validate with the cache section populated (n_cache_hit,
+cache_hit_rate, cache_bytes_served).
+
+Knobs via env: PPT_NARCH (8 unique archives), PPT_NSUB (4), PPT_NCHAN
+(32), PPT_NBIN (128), PPT_NREQ (40 Zipf draws), PPT_ZIPF_S (1.1),
+PPT_CACHE_SPEEDUP_GATE (5.0), PPT_NHOSTS (2), PPT_CAMPAIGN_CACHE,
+PPT_TELEMETRY.  Prints ONE JSON line.
+"""
+
+import io
+import json
+import os
+import shutil
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _ensure_devices(n):
+    """Force >= n virtual CPU devices BEFORE jax initializes (the
+    bench_stream discipline) so each emulated router host owns its
+    own device."""
+    if "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+
+
+def main():
+    NHOSTS = max(1, int(os.environ.get("PPT_NHOSTS", 2)))
+    _ensure_devices(NHOSTS)
+    import pulseportraiture_tpu  # noqa: F401
+    from pulseportraiture_tpu import config
+    config.dft_precision = "default"
+    config.cross_spectrum_dtype = "bfloat16"
+    config.env_overrides()
+
+    import jax
+    import numpy as np
+
+    from pulseportraiture_tpu import telemetry
+    from pulseportraiture_tpu.io.gmodel import write_gmodel
+    from pulseportraiture_tpu.serve import (InProcTransport, ToaClient,
+                                            ToaRouter, ToaServer)
+    from pulseportraiture_tpu.synth import default_test_model
+    from pulseportraiture_tpu.synth.archive import make_fake_pulsar
+
+    NARCH = max(2, int(os.environ.get("PPT_NARCH", 8)))
+    NSUB = int(os.environ.get("PPT_NSUB", 4))
+    NCHAN = int(os.environ.get("PPT_NCHAN", 32))
+    NBIN = int(os.environ.get("PPT_NBIN", 128))
+    NREQ = max(4, int(os.environ.get("PPT_NREQ", 40)))
+    ZIPF_S = float(os.environ.get("PPT_ZIPF_S", 1.1))
+    GATE = float(os.environ.get("PPT_CACHE_SPEEDUP_GATE", 5.0))
+    PAR = {"PSR": "FAKE", "P0": 0.003, "DM": 50.0, "PEPOCH": 56000.0}
+    cache = os.environ.get("PPT_CAMPAIGN_CACHE", "/tmp/ppt_campaign")
+    tag = f"rc{NARCH}x{NSUB}x{NCHAN}x{NBIN}"
+    root = os.path.join(cache, tag)
+    os.makedirs(root, exist_ok=True)
+    trace_base = config.telemetry_path  # PPT_TELEMETRY (or None)
+
+    mpath = os.path.join(root, "model.gmodel")
+    if not os.path.exists(mpath):
+        write_gmodel(default_test_model(1500.0), mpath, quiet=True)
+    files = []
+    for i in range(NARCH):
+        path = os.path.join(root, f"a{i:04d}.fits")
+        if not os.path.exists(path):
+            make_fake_pulsar(mpath, PAR, outfile=path, nsub=NSUB,
+                             nchan=NCHAN, nbin=NBIN, nu0=1500.0, bw=600.0,
+                             phase=0.01 * (i % 50), dDM=1e-4 * (i % 40),
+                             noise_stds=0.05, quiet=True, rng=i)
+        files.append(path)
+
+    # the Zipf(s) replay sequence: rank-r archive drawn with weight
+    # 1/r^s — the repeat-heavy access pattern the cache exists for
+    rng = np.random.default_rng(0)
+    w = 1.0 / np.arange(1, NARCH + 1, dtype=float) ** ZIPF_S
+    w /= w.sum()
+    seq = [int(k) for k in rng.choice(NARCH, size=NREQ, p=w)]
+    uniq_hot = len(set(seq))
+
+    out_root = os.path.join(root, "cache_out")
+    shutil.rmtree(out_root, ignore_errors=True)
+    os.makedirs(out_root, exist_ok=True)
+
+    def tim(arm, j):
+        return os.path.join(out_root, f"{arm}_{j}.tim")
+
+    def run_replay(submit, arm):
+        """Submit the full Zipf sequence, then collect; returns wall."""
+        t0 = time.perf_counter()
+        handles = [submit([files[k]], mpath, tim_out=tim(arm, j),
+                          name=f"{arm}{j}")
+                   for j, k in enumerate(seq)]
+        for h in handles:
+            h.result(3600)
+        return time.perf_counter() - t0
+
+    # ---- references + cache-off baseline (one warm server) --------
+    srv = ToaServer(nsub_batch=64, quiet=True).start()
+    client = ToaClient(srv)
+    client.get_TOAs([files[0]], mpath, timeout=600)  # warm jit caches
+    for i in range(NARCH):
+        client.get_TOAs([files[i]], mpath, tim_out=tim("ref", i),
+                        timeout=600)
+    off_wall = run_replay(srv.submit, "off")
+    assert srv.stats()["cache_hits"] == 0, "cache-off server hit?"
+    srv.stop()
+
+    # ---- cache-ON server: populate, then an all-hit replay --------
+    trace = f"{trace_base}.cache" if trace_base else None
+    cdir = os.path.join(out_root, "rcache_server")
+    srv = ToaServer(nsub_batch=64, quiet=True, telemetry=trace,
+                    result_cache=True, cache_dir=cdir).start()
+    client = ToaClient(srv)
+    client.get_TOAs([files[0]], mpath, timeout=600)  # warm (+ stores)
+    for i in range(NARCH):  # populate pass: every unique archive
+        client.get_TOAs([files[i]], mpath, tim_out=tim("pop", i),
+                        timeout=600)
+    hits0 = srv.stats()["cache_hits"]
+    on_wall = run_replay(srv.submit, "on")
+    stats = srv.stats()
+    n_hits = stats["cache_hits"] - hits0
+    all_hits = n_hits == NREQ
+    assert all_hits, (
+        f"warm replay expected {NREQ} cache hits, got {n_hits} — "
+        "the populate pass or the content key is broken")
+    hit_identical = all(
+        open(tim("on", j), "rb").read()
+        == open(tim("ref", k), "rb").read()
+        for j, k in enumerate(seq))
+    assert hit_identical, (
+        "a cache hit's .tim diverged from its fresh-fit reference — "
+        "the byte-identity contract is broken")
+    speedup = off_wall / max(on_wall, 1e-9)
+    speedup_ok = bool(speedup >= GATE) if GATE > 0 else None
+    assert speedup_ok is not False, (
+        f"repeat-heavy replay sped up only {speedup:.2f}x with the "
+        f"cache on (gate {GATE}x) — hits are not O(1)")
+
+    # ---- one-byte perturbation MUST miss ---------------------------
+    pert = os.path.join(out_root, "perturbed.fits")
+    shutil.copyfile(files[0], pert)
+    with open(pert, "r+b") as fh:
+        fh.seek(os.path.getsize(pert) - 64)  # inside the data payload
+        b = fh.read(1)
+        fh.seek(-1, os.SEEK_CUR)
+        fh.write(bytes([b[0] ^ 0x01]))
+    misses0 = srv.cache.misses
+    client.get_TOAs([pert], mpath, tim_out=tim("pert", 0), timeout=600)
+    perturb_missed = (srv.cache.misses == misses0 + 1
+                      and srv.stats()["cache_hits"] == stats["cache_hits"])
+    assert perturb_missed, (
+        "a one-byte archive perturbation was served from cache — "
+        "content addressing is broken")
+    srv.stop()
+    if trace:
+        summary = telemetry.report(trace, file=io.StringIO())
+        # NREQ replay hits + the populate pass re-hitting the entry
+        # the warmup fit already stored
+        assert summary["n_cache_hit"] >= NREQ, summary["n_cache_hit"]
+        assert summary["cache_bytes_served"] > 0, summary
+        assert summary["n_cache_store"] >= NARCH, summary
+
+    # ---- router arm: hits never touch a host -----------------------
+    router_arm = None
+    if NHOSTS >= 2:
+        trace = f"{trace_base}.rcache" if trace_base else None
+        rdir = os.path.join(out_root, "rcache_router")
+        servers = [
+            ToaServer(nsub_batch=64, quiet=True,
+                      stream_devices=[jax.local_devices()[h]]).start()
+            for h in range(NHOSTS)]
+        for s in servers:
+            ToaClient(s).get_TOAs([files[0]], mpath, timeout=600)
+        router = ToaRouter(
+            [InProcTransport(s, label=f"host{h}")
+             for h, s in enumerate(servers)],
+            telemetry=trace, result_cache=True, cache_dir=rdir)
+        for i in range(NARCH):  # populate: fits placed on hosts
+            router.submit([files[i]], mpath, tim_out=tim("rpop", i),
+                          name=f"rpop{i}").result(3600)
+        placed0 = {lbl: st["n_requests"]
+                   for lbl, st in router.stats().items()}
+        r_wall = run_replay(router.submit, "rtr")
+        placed1 = {lbl: st["n_requests"]
+                   for lbl, st in router.stats().items()}
+        bypass = placed0 == placed1 and router.cache_hits == NREQ
+        assert bypass, (
+            f"router hit replay touched a host: {placed0} -> "
+            f"{placed1}, cache_hits={router.cache_hits}")
+        r_identical = all(
+            open(tim("rtr", j), "rb").read()
+            == open(tim("ref", k), "rb").read()
+            for j, k in enumerate(seq))
+        assert r_identical, "a router-side hit diverged from one-shot"
+        router.close()
+        for s in servers:
+            s.stop()
+        router_arm = {
+            "hosts": NHOSTS,
+            "replay_wall_s": round(r_wall, 3),
+            "router_hits_bypass_hosts": bool(bypass),
+            "tim_identical": bool(r_identical),
+        }
+        if trace:
+            summary = telemetry.report(trace, file=io.StringIO())
+            assert summary["n_cache_hit"] == NREQ, summary
+            assert summary["n_route_done"] == NARCH + NREQ, summary
+            router_arm["cache_hit_rate"] = round(
+                summary["cache_hit_rate"], 3)
+
+    print(json.dumps({
+        "metric": f"Zipf(s={ZIPF_S}) replay of {NREQ} requests over "
+                  f"{NARCH} archives x {NSUB}sub x {NCHAN}ch x "
+                  f"{NBIN}bin, warm server, result cache on vs off",
+        "value": round(NREQ / max(on_wall, 1e-9), 2),
+        "unit": "requests/sec",
+        "off_requests_per_sec": round(NREQ / max(off_wall, 1e-9), 2),
+        "cache_speedup": round(speedup, 3),
+        "speedup_ok": speedup_ok,
+        "speedup_gate": GATE,
+        "zipf_s": ZIPF_S,
+        "unique_archives_drawn": uniq_hot,
+        "all_hits": bool(all_hits),
+        "hit_identical": bool(hit_identical),
+        "perturb_missed": bool(perturb_missed),
+        "cache_bytes_served": stats["cache_bytes"],
+        "router": router_arm,
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,11 @@ Elastic-fleet arms (ISSUE 13, H >= 2):
   hedge     — hedging forced on (hedge_ms=0) over a clean fleet:
               gated byte-identical to hedging-off
               (``hedge_tim_identical``) with ``n_hedge`` > 0.
+  kill-during-hit — (ISSUE 17) the request set replayed from the
+              router's RESULT CACHE after host0 dies: every request
+              must resolve as a settled cache hit (no re-placement,
+              no failover, zero lost, byte-identical) — the .chit
+              trace must show n_cache_hit == requests, n_failover 0.
 
 Knobs via env: PPT_NARCH (32), PPT_NSUB (16), PPT_NCHAN (64),
 PPT_NBIN (256), PPT_NREQ (8 requests), PPT_NHOSTS (2),
@@ -258,6 +263,7 @@ def main():
         codec_tim_identical = None
         hedge_tim_identical = None
         n_hedge = None
+        kill_during_hit = None
         if NHOSTS >= 2 and NREQ >= 2:
             # --- kill-one-host arm: host0 dies mid-sweep ------------
             trace = f"{trace_base}.fleet" if trace_base else None
@@ -390,6 +396,72 @@ def main():
                 summary = telemetry.report(trace, file=io.StringIO())
                 n_hedge = summary["n_hedge"]
                 assert n_hedge >= 1, "hedge_ms=0 never hedged"
+
+            # --- kill-during-hit arm (ISSUE 17): requests served
+            # from the router's result cache while a host is DEAD —
+            # a hit is settled on arrival, so failover/hedge must
+            # never re-place it and nothing may stall on the corpse -
+            trace = f"{trace_base}.chit" if trace_base else None
+            cache_dir = os.path.join(out_root, "kill_hit_cache")
+            servers = [
+                ToaServer(nsub_batch=64, quiet=True,
+                          stream_devices=[jax.local_devices()[h]])
+                .start()
+                for h in range(NHOSTS)]
+            for srv in servers:
+                ToaClient(srv).get_TOAs(files[:1], mpath, timeout=600)
+            transports = [
+                _Killable(InProcTransport(srv, label=f"ch{h}"))
+                for h, srv in enumerate(servers)]
+            router = ToaRouter(transports, telemetry=trace,
+                               result_cache=True, cache_dir=cache_dir)
+            for i, sl in enumerate(slices):  # populate: real fits
+                router.submit(
+                    sl, mpath,
+                    tim_out=os.path.join(out_root, f"chp_r{i}.tim"),
+                    name=f"req{i}").result(3600)
+            placed0 = {lbl: st["n_requests"]
+                       for lbl, st in router.stats().items()}
+            transports[0].killed = True
+            servers[0].stop(drain=False)
+            tims = [os.path.join(out_root, f"chit_r{i}.tim")
+                    for i in range(NREQ)]
+            t0 = time.perf_counter()
+            handles = [router.submit(sl, mpath, tim_out=tims[i],
+                                     name=f"req{i}")
+                       for i, sl in enumerate(slices)]
+            chit_results = [h.result(60) for h in handles]
+            chit_wall = time.perf_counter() - t0
+            placed1 = {lbl: st["n_requests"]
+                       for lbl, st in router.stats().items()}
+            router.close()
+            for srv in servers[1:]:
+                srv.stop()
+            chit_ok = (len(chit_results) == NREQ
+                       and router.cache_hits == NREQ
+                       and placed0 == placed1)
+            assert chit_ok, (
+                f"kill-during-hit re-placed work: {placed0} -> "
+                f"{placed1}, cache_hits={router.cache_hits}")
+            chit_tim_ok = all(
+                open(tims[i], "rb").read()
+                == open(ref_tim(i), "rb").read()
+                for i in range(NREQ))
+            assert chit_tim_ok, (
+                "a cache hit served over a dead host diverged from "
+                "its one-shot reference")
+            kill_during_hit = {
+                "lost_requests": NREQ - len(chit_results),
+                "cache_hits": router.cache_hits,
+                "replaced_work": placed0 != placed1,
+                "tim_identical": bool(chit_tim_ok),
+                "replay_wall_s": round(chit_wall, 3),
+            }
+            if trace:
+                summary = telemetry.report(trace, file=io.StringIO())
+                assert summary["n_cache_hit"] == NREQ, summary
+                assert summary["n_failover"] == 0, (
+                    "failover fired for settled cache hits")
     finally:
         for obj, name, val in unpatch:
             setattr(obj, name, val)
@@ -422,6 +494,10 @@ def main():
         "codec_tim_identical": codec_tim_identical,
         "hedge_tim_identical": hedge_tim_identical,
         "n_hedge": n_hedge,
+        # ISSUE 17: the whole request set served from the router's
+        # result cache AFTER host0 died — hits are settled on
+        # arrival, so nothing re-places and nothing stalls
+        "kill_during_hit": kill_during_hit,
         "tunnel_emu": TUNNEL or None,
         "device": str(jax.devices()[0]),
     }))

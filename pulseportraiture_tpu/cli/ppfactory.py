@@ -94,6 +94,9 @@ def build_parser():
     p.add_argument("--telemetry", default=None,
                    help="Write a JSONL event trace (template_fit "
                         "events; analyze with tools/pptrace.py).")
+    from .ppserve import add_cache_flags
+
+    add_cache_flags(p)
     p.add_argument("--verbose", dest="quiet", action="store_false",
                    default=True)
     return p
@@ -121,15 +124,68 @@ def main(argv=None):
         raise SystemExit(f"ppfactory: no archives listed in "
                          f"{args.metafile}")
     from ..pipeline.factory import build_templates
+    from ..serve.cache import content_key, resolve_result_cache
+    from .ppserve import apply_cache_flags
 
-    build_templates(
-        files, kind=args.kind, outdir=args.outdir,
-        max_ngauss=args.max_ngauss, niter=args.niter,
+    apply_cache_flags(args, "ppfactory")
+    # template-factory artifacts cache through the same content-
+    # addressed store as TOA results (ISSUE 17): key = the archive's
+    # bytes + the full factory option vector (any flag change
+    # invalidates), value = the finished .gmodel/.spl bytes.  A hit
+    # writes the stored artifact and skips the whole LM build.
+    cache = resolve_result_cache()
+    factory_opts = dict(
+        kind=args.kind, max_ngauss=args.max_ngauss, niter=args.niter,
         model_code=args.model_code, fixloc=args.fixloc,
         fixwid=args.fixwid, fixamp=args.fixamp, fixscat=args.fixscat,
         fixalpha=args.fixalpha, normalize=args.normalize,
-        gauss_device=gauss_device, telemetry=args.telemetry,
-        quiet=args.quiet)
+        gauss_device=gauss_device)
+    ext = ".gmodel" if args.kind == "gauss" else ".spl"
+
+    def outfile_for(f):
+        # mirrors build_templates' derivation exactly
+        if args.outdir:
+            return os.path.join(args.outdir, os.path.basename(f) + ext)
+        return f + ext
+
+    build, keys, n_hits = list(files), {}, 0
+    if cache is not None:
+        if args.outdir:
+            os.makedirs(args.outdir, exist_ok=True)
+        build = []
+        for f in files:
+            try:
+                keys[f] = content_key([f], factory_opts)
+            except OSError:
+                keys[f] = None  # unreadable: the build reports it
+            blob = cache.get_blob(keys[f]) if keys[f] else None
+            if blob is None:
+                build.append(f)
+                continue
+            out = outfile_for(f)
+            tmp = out + ".tmp~"
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+            os.replace(tmp, out)
+            n_hits += 1
+    if build:
+        build_templates(
+            build, kind=args.kind, outdir=args.outdir,
+            max_ngauss=args.max_ngauss, niter=args.niter,
+            model_code=args.model_code, fixloc=args.fixloc,
+            fixwid=args.fixwid, fixamp=args.fixamp,
+            fixscat=args.fixscat, fixalpha=args.fixalpha,
+            normalize=args.normalize, gauss_device=gauss_device,
+            telemetry=args.telemetry, quiet=args.quiet)
+    if cache is not None:
+        for f in build:
+            out = outfile_for(f)
+            if keys.get(f) and os.path.exists(out):
+                with open(out, "rb") as fh:
+                    cache.put_blob(keys[f], fh.read())
+        if not args.quiet:
+            print(f"ppfactory: {n_hits}/{len(files)} template(s) "
+                  "served from the result cache")
     return 0
 
 

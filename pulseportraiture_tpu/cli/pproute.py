@@ -113,6 +113,9 @@ def build_parser():
                         "route_retry/route_done) here; analyze with "
                         "tools/pptrace.py. Also via PPT_TELEMETRY. "
                         "[default: off]")
+    from .ppserve import add_cache_flags
+
+    add_cache_flags(p)
     p.add_argument("--quiet", action="store_true", default=False)
     return p
 
@@ -138,6 +141,9 @@ def main(argv=None):
                              "one of off/auto/on, got "
                              f"{args.transport_compress!r}")
         config.transport_compress = table[v]
+    from .ppserve import apply_cache_flags
+
+    apply_cache_flags(args, "pproute")
     if args.hosts is not None and args.fleet_file is not None:
         raise SystemExit("pproute: --hosts and --fleet-file are "
                          "mutually exclusive (static list vs watched "

@@ -116,8 +116,60 @@ def build_parser():
     p.add_argument("--timeout", type=float, default=None, metavar="S",
                    help="Per-request result timeout in seconds. "
                         "[default: none]")
+    add_cache_flags(p)
     p.add_argument("--quiet", action="store_true", default=False)
     return p
+
+
+def add_cache_flags(p):
+    """The content-addressed result-cache flags (ISSUE 17), shared by
+    ppserve / pproute / ppfactory."""
+    p.add_argument("--result-cache", dest="result_cache", default=None,
+                   metavar="off|auto|on",
+                   help="Content-addressed result cache: 'off', "
+                        "'auto' (on iff a cache dir is set — the "
+                        "default), or 'on' (requires --cache-dir). "
+                        "Hits are byte-identical to fresh fits. Also "
+                        "via PPT_RESULT_CACHE. [default: auto]")
+    p.add_argument("--cache-dir", dest="cache_dir", default=None,
+                   metavar="DIR",
+                   help="On-disk store directory (created on demand). "
+                        "Also via PPT_CACHE_DIR. [default: off]")
+    p.add_argument("--cache-max-mb", dest="cache_max_mb", type=float,
+                   default=None, metavar="MB",
+                   help="Store size bound; least-recently-used "
+                        "entries evict beyond it. Also via "
+                        "PPT_CACHE_MAX_MB. [default: "
+                        "config.cache_max_mb]")
+
+
+def apply_cache_flags(args, prog):
+    """Validate the cache flags LOUDLY and apply them to config before
+    any server/router/factory construction (the tri-state resolves at
+    construction time)."""
+    from .. import config
+
+    if args.result_cache is not None:
+        table = {"off": False, "auto": "auto", "on": True}
+        v = str(args.result_cache).lower()
+        if v not in table:
+            raise SystemExit(
+                f"{prog}: --result-cache: expected 'off', 'auto' or "
+                f"'on', got {args.result_cache!r}")
+        config.result_cache = table[v]
+    if args.cache_max_mb is not None:
+        if args.cache_max_mb <= 0:
+            raise SystemExit(
+                f"{prog}: --cache-max-mb: must be > 0, got "
+                f"{args.cache_max_mb}")
+        config.cache_max_mb = args.cache_max_mb
+    if args.cache_dir is not None:
+        config.cache_dir = args.cache_dir
+    if config.result_cache is True and not config.cache_dir:
+        raise SystemExit(
+            f"{prog}: --result-cache on requires --cache-dir (or "
+            "PPT_CACHE_DIR): an explicitly-on cache with nowhere to "
+            "store entries would silently serve nothing")
 
 
 def parse_requests(path):
@@ -232,6 +284,7 @@ def main(argv=None):
 
         config.compile_cache_dir = args.compile_cache
         enable_compile_cache(args.compile_cache)
+    apply_cache_flags(args, "ppserve")
     os.makedirs(args.outdir, exist_ok=True)
 
     from ..serve import ServeRejected, ToaServer

@@ -396,6 +396,26 @@ raw_subbyte = True
 # lossless and the decode runs before any arithmetic the fit sees.
 transport_compress = False
 
+# --- Result cache (ISSUE 17; ROADMAP item 5a) -----------------------------
+# Content-addressed cache of completed .tim results (serve/cache.py):
+# key = SHA-256 over (archive bytes, template bytes, frozen fit
+# options, byte-relevant numeric knobs), value = the codec's byte-
+# exact .tim payload, so a hit is byte-identical to a fresh fit by
+# construction.  The router checks it before placement (a hit never
+# touches a host); the server checks at submit and populates on
+# request_done; ppfactory stores .gmodel/.spl artifacts through the
+# same store.  Tri-state:
+#   False (default spelling 'auto' below): off;
+#   'auto': on iff cache_dir is set — the cache is OFF out of the box;
+#   True:  on; raises loudly when cache_dir is unset.
+result_cache = "auto"
+# Directory holding the on-disk store (created on demand).  None
+# (default) = no store, which with result_cache='auto' means OFF.
+cache_dir = None
+# Store size bound in MB: least-recently-used entries evict (with
+# cache_evict telemetry) once the directory exceeds this.
+cache_max_mb = 512.0
+
 # Bucket-lattice coarsening (ROADMAP item 5): pad bucket channel
 # layouts up to the next power of two with zero-weight channels so a
 # campaign's (or serving fleet's) shape diversity costs log2 as many
@@ -531,6 +551,9 @@ RCSTRINGS = {
 #   PPT_SERVE_TENANT_WEIGHT=t:W,...|off    -> serve_tenant_weight
 #   PPT_RAW_SUBBYTE=on|off          -> raw_subbyte
 #   PPT_TRANSPORT_COMPRESS=off|auto|on -> transport_compress
+#   PPT_RESULT_CACHE=off|auto|on    -> result_cache
+#   PPT_CACHE_DIR=<dir>|off         -> cache_dir
+#   PPT_CACHE_MAX_MB=<float>        -> cache_max_mb
 #
 # Unset variables leave the module values untouched; a typo in a
 # KNOWN variable's value raises (strict like the config parsers — a
@@ -560,6 +583,7 @@ KNOWN_PPT_ENV = frozenset({
     "PPT_ROUTER_FLEET_FILE", "PPT_SERVE_TENANT_QUOTA",
     "PPT_SERVE_TENANT_WEIGHT",
     "PPT_RAW_SUBBYTE", "PPT_TRANSPORT_COMPRESS",
+    "PPT_RESULT_CACHE", "PPT_CACHE_DIR", "PPT_CACHE_MAX_MB",
     # benchmark / smoke-test shape and mode knobs
     "PPT_NB", "PPT_NE", "PPT_NPSR", "PPT_NARCH", "PPT_NSUB",
     "PPT_NSUBB", "PPT_NCHAN", "PPT_NBIN", "PPT_NITER", "PPT_K",
@@ -568,6 +592,7 @@ KNOWN_PPT_ENV = frozenset({
     "PPT_GAUSS_CACHE", "PPT_NGAUSS",
     "PPT_TEMPLATE_NOISE", "PPT_STREAM_SPEEDUP_GATE",
     "PPT_HARMONIC_WINDOW", "PPT_TUNNEL_EMU", "PPT_RETUNE",
+    "PPT_ZIPF_S", "PPT_CACHE_SPEEDUP_GATE",
 })
 
 def parse_hostport(spec):
@@ -1007,6 +1032,34 @@ def env_overrides():
                 f"'on', got {tcomp!r}")
         cfg.transport_compress = table[tcomp]
         changed.append("transport_compress")
+    rcache = _os.environ.get("PPT_RESULT_CACHE", "").lower()
+    if rcache:
+        table = {"off": False, "false": False, "auto": "auto",
+                 "on": True, "true": True}
+        if rcache not in table:
+            raise ValueError(
+                "PPT_RESULT_CACHE must be 'off', 'auto' or 'on', got "
+                f"{rcache!r}")
+        cfg.result_cache = table[rcache]
+        changed.append("result_cache")
+    cdir = _os.environ.get("PPT_CACHE_DIR", "")
+    if cdir:
+        cfg.cache_dir = (None if cdir.lower() in ("off", "none", "0")
+                         else cdir)
+        changed.append("cache_dir")
+    cmb = _os.environ.get("PPT_CACHE_MAX_MB", "")
+    if cmb:
+        try:
+            mb = float(cmb)
+        except ValueError:
+            raise ValueError(
+                "PPT_CACHE_MAX_MB must be a positive number of "
+                f"megabytes, got {cmb!r}")
+        if mb <= 0:
+            raise ValueError(
+                f"PPT_CACHE_MAX_MB must be > 0, got {mb}")
+        cfg.cache_max_mb = mb
+        changed.append("cache_max_mb")
     tel = _os.environ.get("PPT_TELEMETRY", "")
     if tel:
         # 'off'/'none'/'0' disable explicitly (so a wrapper script can

@@ -25,11 +25,25 @@ and the durable-``.tim`` failover primitives, and the router layers
 exactly-once mid-fit failover, hedged requests, routed quality
 refits, and per-tenant QoS lanes (``queue.AdmissionQueue``) on top;
 see docs/GUIDE.md "Operating an elastic fleet".
+
+Content-addressed result cache (ISSUE 17): ``cache.py`` keys
+completed ``.tim`` payloads by SHA-256 over (archive bytes, template
+bytes, frozen fit options) in a bounded on-disk LRU — a hit is
+byte-identical to a fresh fit by construction (the codec's byte-exact
+serialization) and O(1).  The router checks it before placement (a
+hit never touches a host), the server checks at submit and populates
+on completion, and per-tenant accounting sees hits without billing
+them as fits.  Off by default: ``config.result_cache='auto'`` engages
+only when ``config.cache_dir`` is set; see docs/GUIDE.md "The result
+cache".
 """
 
+from .cache import (ResultCache, content_key,  # noqa: F401
+                    resolve_result_cache)
 from .client import ToaClient  # noqa: F401
-from .codec import (decode_result, encode_result,  # noqa: F401
-                    read_tim_result, tim_complete, write_tim_result)
+from .codec import (copy_tim_atomic, decode_result,  # noqa: F401
+                    encode_result, read_tim_result, tim_complete,
+                    write_tim_result)
 from .fleet import (DEAD, HEALTHY, JOINING, REJOINED,  # noqa: F401
                     SUSPECT, Fleet, FleetFileWatcher, FleetMember)
 from .queue import AdmissionQueue, ServeRejected, ServeRequest  # noqa: F401

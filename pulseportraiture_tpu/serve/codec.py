@@ -46,7 +46,8 @@ import numpy as np
 from ..utils.bunch import DataBunch
 
 __all__ = ["encode_result", "decode_result", "iter_archive_toas",
-           "write_tim_result", "tim_complete", "read_tim_result"]
+           "write_tim_result", "copy_tim_atomic", "tim_complete",
+           "read_tim_result"]
 
 
 def _flag_value(v):
@@ -167,6 +168,24 @@ def write_tim_result(result, tim_out):
             fh.write(_DONE_PREFIX + os.path.abspath(datafile) + "\n")
     os.replace(tmp, tim_out)
     return tim_out
+
+
+def copy_tim_atomic(src, dst):
+    """Byte-copy a durable ``.tim`` (or any completed payload file) to
+    ``dst`` with the same temp-then-``os.replace`` discipline as
+    :func:`write_tim_result`.  The result-cache hit path serves stored
+    entries through this — a hit's output is the stored bytes EXACTLY,
+    never a re-serialization, so hit == fresh fit at the byte level
+    holds by construction rather than by round-trip proof."""
+    tmp = dst + ".tmp~"
+    with open(src, "rb") as fin, open(tmp, "wb") as fout:
+        while True:
+            chunk = fin.read(1 << 20)
+            if not chunk:
+                break
+            fout.write(chunk)
+    os.replace(tmp, dst)
+    return dst
 
 
 # ---------------------------------------------------------------------------

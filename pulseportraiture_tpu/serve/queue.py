@@ -162,6 +162,7 @@ class AdmissionQueue:
         self._pending = 0          # archives, global
         self._pending_tenant = {}  # tenant -> archives pending
         self._served = {}          # tenant -> archives ever popped
+        self._hits = {}            # tenant -> cache-hit archives
         self._closed = False
 
     # -- QoS resolution ------------------------------------------------
@@ -195,14 +196,29 @@ class AdmissionQueue:
             return self._pending
 
     def tenant_snapshot(self):
-        """{tenant: {queued, pending_archives}} — the QoS view tests
-        and the fleet report read."""
+        """{tenant: {queued, pending_archives, cache_hits}} — the QoS
+        view tests and the fleet report read.  ``cache_hits`` counts
+        result-cache hits recorded on the tenant's ledger: visible
+        traffic that was never billed against the quota or the
+        weighted-fair vtime."""
         with self._cv:
             return {t: {"queued": len(self._lanes.get(t, ())),
                         "pending_archives": self._pending_tenant
-                        .get(t, 0)}
+                        .get(t, 0),
+                        "cache_hits": self._hits.get(t, 0)}
                     for t in set(self._lanes)
-                    | set(self._pending_tenant)}
+                    | set(self._pending_tenant) | set(self._hits)}
+
+    def record_hit(self, tenant, n=1):
+        """Ledger a result-cache hit for ``tenant`` (ISSUE 17): the
+        hit is O(1) work served outside the queue, so it must be SEEN
+        (per-tenant accounting, the fleet/cache report) but charged to
+        neither the global admission bound, the tenant quota, nor the
+        weighted-fair virtual time — billing hits as fits would starve
+        a repeat-heavy tenant for traffic that costs nothing."""
+        t = str(tenant) if tenant else "default"
+        with self._cv:
+            self._hits[t] = self._hits.get(t, 0) + int(n)
 
     def submit(self, request):
         """Enqueue or raise ServeRejected (queue full / tenant over

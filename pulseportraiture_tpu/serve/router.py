@@ -67,6 +67,7 @@ import time
 
 from ..telemetry import log, resolve_tracer
 from . import codec
+from .cache import content_key, resolve_result_cache
 from .fleet import (DEAD, HEALTHY, PLACEABLE_STATES, Fleet,
                     FleetFileWatcher)
 from .queue import ServeRejected
@@ -175,7 +176,8 @@ class ToaRouter:
     def __init__(self, transports=(), retry_max=None, telemetry=None,
                  quiet=True, probe_ms=None, hedge_ms=None,
                  write_tim="host", quality_refit=False,
-                 fleet_file=None, fleet_poll_s=1.0):
+                 fleet_file=None, fleet_poll_s=1.0,
+                 result_cache=None, cache_dir=None):
         from .. import config
 
         transports = list(transports)
@@ -198,6 +200,15 @@ class ToaRouter:
         self.quiet = quiet
         self.tracer, self._own_tracer = resolve_tracer(telemetry,
                                                        run="pproute")
+        # content-addressed result cache (ISSUE 17): a router-side hit
+        # short-circuits placement entirely — the request never
+        # touches a host.  Resolved from the config tri-state (off by
+        # default; 'auto' engages only when a cache_dir is set).
+        self.cache = resolve_result_cache(tracer=self.tracer,
+                                          cache_dir=cache_dir,
+                                          mode=result_cache)
+        self.cache_hits = 0
+        self.cache_bytes = 0
         self._lock = threading.Lock()
         self._affinity = {}   # abspath(modelfile) -> FleetMember
         self._inflight = {}   # label -> set of RouteHandle
@@ -370,6 +381,13 @@ class ToaRouter:
         host_tim = tim_out if (self.write_tim == "host"
                                and self.hedge_s is None) else None
         t0 = time.monotonic()
+        cache_key = None
+        if self.cache is not None:
+            hit_rh, cache_key = self._cache_lookup(
+                datafiles, modelfile, tim_out, name, tenant, options,
+                n_archives, t0)
+            if hit_rh is not None:
+                return hit_rh
         host, handle, attempt, sticky = self._place(
             datafiles, modelfile, host_tim, name, options, tenant)
         spec = dict(datafiles=datafiles, modelfile=str(modelfile),
@@ -379,6 +397,7 @@ class ToaRouter:
                          name if name is not None
                          else getattr(handle, "name", None),
                          n_archives, t0, spec)
+        rh._cache_key = cache_key
         with self._lock:
             host.outstanding += n_archives
             host.n_requests += 1
@@ -391,6 +410,60 @@ class ToaRouter:
                 n_archives=n_archives, attempt=attempt,
                 affinity=bool(sticky), tenant=tenant)
         return rh
+
+    def _cache_lookup(self, datafiles, modelfile, tim_out, name,
+                      tenant, options, n_archives, t0):
+        """Content-addressed lookup before placement (ISSUE 17).
+        Returns ``(hit_handle, key)``: on a hit, a PRE-RESOLVED
+        :class:`RouteHandle` — result set, ``_done`` set,
+        ``_collected`` marked, NO attempts, never registered in
+        ``_inflight`` — so ``_await`` returns on its first done-check
+        and the failover/hedge machinery can never find (let alone
+        re-place) an already-served request.  On a miss, ``(None,
+        key)`` so the placed request populates the store at
+        collection.  The request's ``.tim`` is served as an atomic
+        byte copy of the stored entry: hit bytes == fresh-fit bytes by
+        construction."""
+        try:
+            key = content_key(list(datafiles) + [modelfile], options)
+        except OSError:
+            # unreadable input: the placement path raises the real
+            # error through the normal channel
+            return None, None
+        ent = self.cache.get_result(key, datafiles)
+        if ent is None:
+            if self.tracer.enabled:
+                self.tracer.emit("cache_miss", req=name,
+                                 source="router", tenant=tenant)
+            return None, key
+        result, entry_path, n_bytes = ent
+        if tim_out:
+            codec.copy_tim_atomic(entry_path, tim_out)
+        result.tim_out = tim_out
+        spec = dict(datafiles=list(datafiles),
+                    modelfile=str(modelfile), tim_out=tim_out,
+                    options=dict(options), tenant=tenant,
+                    host_tim=None)
+        rh = RouteHandle(self, None, None, name, n_archives, t0, spec)
+        rh.attempts = []
+        rh._collected = True
+        rh._result = result
+        self.cache_hits += 1
+        self.cache_bytes += n_bytes
+        if self.tracer.enabled:
+            self.tracer.emit("route_submit", req=name, host=None,
+                             n_archives=n_archives, attempt=0,
+                             affinity=False, tenant=tenant)
+            self.tracer.emit("cache_hit", req=name, bytes=n_bytes,
+                             source="router", tenant=tenant)
+            self.tracer.counter("cache_hit")
+            self.tracer.emit("route_done", req=name, host=None,
+                             wall_s=round(time.monotonic() - t0, 6),
+                             n_toas=len(result.TOA_list), error=None,
+                             tenant=tenant, hedged=False,
+                             failover=None)
+        rh._done.set()
+        return rh, key
 
     # blocking conveniences mirroring serve.ToaClient -----------------
 
@@ -439,7 +512,11 @@ class ToaRouter:
                 # a failover is re-placing this request on another
                 # thread; yield briefly and re-check
                 time.sleep(0.01)
-            settled = len(attempts) == 1 and self.hedge_s is None
+            # a collected request (incl. a cache hit, which resolves
+            # pre-placed with no attempts) is SETTLED: the slow poll
+            # suffices and nothing here may re-place it
+            settled = rh._collected or (len(attempts) == 1
+                                        and self.hedge_s is None)
             slice_s = ROUTER_POLL_SETTLED_S if settled \
                 else ROUTER_POLL_S
             for host, handle, router_tim in attempts:
@@ -551,6 +628,16 @@ class ToaRouter:
                 log(f"routed refit of {rh.name!r} failed: "
                     f"{type(e).__name__}: {e}; serving the original "
                     "fit", quiet=False, level="warn", tracer=None)
+        if (result is not None and error is None
+                and self.cache is not None
+                and getattr(rh, "_cache_key", None)):
+            # populate the content-addressed store with the final
+            # (post-refit) result; put_result itself refuses partial
+            # or tim-recovered payloads
+            stored = self.cache.put_result(rh._cache_key, result)
+            if stored and self.tracer.enabled:
+                self.tracer.emit("cache_store", key=rh._cache_key,
+                                 bytes=stored)
         rh._result = result
         rh._error = error
         if self.tracer.enabled:

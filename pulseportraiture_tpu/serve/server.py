@@ -50,6 +50,7 @@ from ..pipeline.stream import (_DONE_PREFIX, _StreamExecutor,
                                _collect_wideband, make_wideband_lane)
 from ..telemetry import log, resolve_tracer
 from ..utils.bunch import DataBunch
+from . import codec
 from .queue import AdmissionQueue, ServeRejected, ServeRequest
 
 __all__ = ["ToaServer"]
@@ -64,16 +65,11 @@ __all__ = ["ToaServer"]
 LANE_CACHE_MAX = 32
 
 
-def _freeze(v):
-    """Hashable canonical form of an option value (lists/dicts arrive
-    from JSON request specs) for the lane-cache key."""
-    if isinstance(v, dict):
-        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
-    if isinstance(v, (list, tuple)):
-        return tuple(_freeze(x) for x in v)
-    if isinstance(v, np.ndarray):
-        return (v.shape, v.tobytes())
-    return v
+# Canonical option freezing is shared with the content-addressed
+# result cache so the lane key and the cache key can never disagree
+# about what an "option change" is.
+from .cache import (_freeze, content_key,  # noqa: E402
+                    resolve_result_cache)
 
 
 class ToaServer:
@@ -116,7 +112,8 @@ class ToaServer:
                  warmup_manifest=None, warmup_model=None,
                  warmup_options=None, quiet=True, quality_refit=None,
                  quality_max_gof=None, quality_min_snr=None,
-                 zap_nstd=None, tenant_quota=None, tenant_weight=None):
+                 zap_nstd=None, tenant_quota=None, tenant_weight=None,
+                 result_cache=None, cache_dir=None):
         from .. import config
 
         if max_wait_ms is None:
@@ -145,6 +142,15 @@ class ToaServer:
         self.quiet = quiet
         self.tracer, self._own_tracer = resolve_tracer(telemetry,
                                                        run="ppserve")
+        # content-addressed result cache (ISSUE 17): resolved from the
+        # config tri-state (off by default — 'auto' engages only when a
+        # cache_dir is set); a submit-time hit bypasses the admission
+        # queue entirely and is never billed as a fit
+        self.cache = resolve_result_cache(tracer=self.tracer,
+                                          cache_dir=cache_dir,
+                                          mode=result_cache)
+        self._cache_hits = 0
+        self._cache_bytes = 0
         # multi-tenant QoS (ISSUE 13): per-tenant weighted-fair lanes
         # + quotas; None reads config.serve_tenant_quota/_weight
         self.queue = AdmissionQueue(queue_depth,
@@ -203,12 +209,57 @@ class ToaServer:
             raise ServeRejected(
                 f"server died: {self._fatal!r}; request {req.name!r} "
                 "rejected")
+        if self.cache is not None and self._cache_try_hit(req):
+            return req
         self.queue.submit(req)
         if self.tracer.enabled:
             self.tracer.emit("request_submit", req=req.name,
                              n_archives=len(req.datafiles),
                              tenant=req.tenant)
         return req
+
+    def _cache_try_hit(self, req):
+        """Content-addressed lookup at submit time (ISSUE 17).  On a
+        hit the request resolves here — the stored ``.tim`` bytes are
+        served verbatim (atomic byte copy when the request wants a
+        ``.tim``), the request never enters the admission queue, and
+        the hit is recorded on the tenant's ledger WITHOUT consuming
+        quota or weighted-fair vtime.  Returns True iff the request
+        was resolved from the cache.  On a miss the content key is
+        stashed on the request so a clean completion populates the
+        store without re-hashing."""
+        try:
+            key = content_key(
+                list(req.datafiles) + [req.modelfile], req.options)
+        except OSError:
+            # unreadable input: fall through to the fit path, which
+            # reports the real error through the normal channel
+            return False
+        req._cache_key = key
+        ent = self.cache.get_result(key, req.datafiles)
+        if ent is None:
+            if self.tracer.enabled:
+                self.tracer.emit("cache_miss", req=req.name,
+                                 source="server", tenant=req.tenant)
+            return False
+        result, entry_path, n_bytes = ent
+        if req.tim_out:
+            codec.copy_tim_atomic(entry_path, req.tim_out)
+        result.tim_out = req.tim_out
+        req._cache_hit = True
+        req.t_submit = req.t_admit = time.monotonic()
+        self.queue.record_hit(req.tenant, len(req.datafiles))
+        self._cache_hits += 1
+        self._cache_bytes += n_bytes
+        if self.tracer.enabled:
+            self.tracer.emit("request_submit", req=req.name,
+                             n_archives=len(req.datafiles),
+                             tenant=req.tenant)
+            self.tracer.emit("cache_hit", req=req.name, bytes=n_bytes,
+                             source="server", tenant=req.tenant)
+            self.tracer.counter("cache_hit")
+        self._complete(req, result=result)
+        return True
 
     def stats(self):
         """Load snapshot (thread-safe): pending_archives is the
@@ -219,7 +270,12 @@ class ToaServer:
         placement and the transport ``stat`` op read."""
         return {"pending_archives": self.queue.pending_archives,
                 "queue_len": len(self.queue),
-                "n_live": len(self._live)}
+                "n_live": len(self._live),
+                # hit traffic is O(1) and never occupies the executor,
+                # so it rides OUTSIDE the load signal above — a
+                # hit-heavy host must not look busy to the router
+                "cache_hits": self._cache_hits,
+                "cache_bytes": self._cache_bytes}
 
     def start(self):
         """Run the optional AOT warmup, then start the serving thread.
@@ -656,6 +712,16 @@ class ToaServer:
             self._complete(req, error=e)
 
     def _complete(self, req, result=None, error=None):
+        if (self.cache is not None and result is not None
+                and getattr(req, "_cache_key", None)
+                and not getattr(req, "_cache_hit", False)):
+            # populate on request_done: a clean fresh fit lands in the
+            # store under the key hashed at submit (put_result refuses
+            # partial/recovered results itself)
+            stored = self.cache.put_result(req._cache_key, result)
+            if stored and self.tracer.enabled:
+                self.tracer.emit("cache_store", key=req._cache_key,
+                                 bytes=stored)
         req._result = result
         req._error = error
         req.t_done = time.monotonic()

@@ -48,7 +48,9 @@ and plain frames stay bit-identical to prior releases).  Ops:
       -> {"ok": false, "error": msg, "etype": "TypeError", ...}
   {"op": "stat"}
       -> {"ok": true, "pending_archives": n, "queue_len": n,
-          "n_live": n}
+          "n_live": n, "cache_hits": n, "cache_bytes": n}
+         (cache_* count result-cache hit traffic served OUTSIDE the
+          load signal; absent on pre-cache hosts — readers default 0)
   {"op": "drain"}
       -> {"ok": true, "n_done": n}          (this connection's handles
                                              all resolved)
@@ -396,8 +398,13 @@ class SocketTransport:
         if not reply.get("ok"):
             raise TransportError(
                 f"stat on {self.label} failed: {reply.get('error')}")
-        return {k: reply[k] for k in ("pending_archives", "queue_len",
-                                      "n_live")}
+        out = {k: reply[k] for k in ("pending_archives", "queue_len",
+                                     "n_live")}
+        # cache counters (ISSUE 17): .get with a 0 default so a newer
+        # router can probe a pre-cache host without tripping
+        for k in ("cache_hits", "cache_bytes"):
+            out[k] = reply.get(k, 0)
+        return out
 
     def drain(self, timeout=None):
         """Wait for this connection's outstanding requests.  The

@@ -69,6 +69,7 @@ CONFIG_SNAPSHOT_KEYS = (
     "use_fast_fit", "use_matmul_dft", "fit_harmonic_window",
     "scatter_compensated", "lm_jacobian", "fit_fused",
     "raw_subbyte", "transport_compress",
+    "result_cache", "cache_dir", "cache_max_mb",
 )
 
 # The event vocabulary: type -> fields REQUIRED beyond (type, t).
@@ -188,6 +189,18 @@ EVENT_FIELDS = {
     "zap_apply": {"datafile", "n_channels"},
     "refit": {"req", "datafile", "n_channels", "gof_before",
               "gof_after", "improved"},
+    # the content-addressed result cache (serve/cache.py, ISSUE 17):
+    # cache_hit per lookup served from the store (bytes = stored .tim
+    # payload size, source = 'router' | 'server' — a router hit never
+    # touched a host); cache_miss per lookup that fell through to the
+    # fit path; cache_store per fresh fit persisted into the store;
+    # cache_evict per LRU eviction under the cache_max_mb bound.
+    # hit/miss additionally carry a 'tenant' label for the cache
+    # section's per-tenant hit split.
+    "cache_hit": {"req", "bytes", "source"},
+    "cache_miss": {"req", "source"},
+    "cache_store": {"key", "bytes"},
+    "cache_evict": {"key", "bytes"},
     "counters": {"counters", "gauges"},
 }
 
@@ -781,6 +794,54 @@ def report(path, file=None):
             p(f"  AOT warmup: {len(warmups)} (shape x device) "
               f"program(s) compiled in {w_s:.3f} s before serving")
 
+    # ---- result cache (content-addressed .tim store) ----------------
+    c_hit = by_type.get("cache_hit", [])
+    c_miss = by_type.get("cache_miss", [])
+    c_store = by_type.get("cache_store", [])
+    c_evict = by_type.get("cache_evict", [])
+    cache_hit_rate = None
+    cache_bytes_served = None
+    cache_bytes_stored = None
+    cache_tenant_hits = {}
+    if c_hit or c_miss or c_store or c_evict:
+        p("")
+        p("-- result cache (content-addressed) --")
+        n_lookup = len(c_hit) + len(c_miss)
+        cache_hit_rate = len(c_hit) / max(n_lookup, 1)
+        cache_bytes_served = sum(int(ev["bytes"]) for ev in c_hit)
+        cache_bytes_stored = sum(int(ev["bytes"]) for ev in c_store)
+        p(f"  {len(c_hit)}/{n_lookup} lookup(s) hit "
+          f"({100 * cache_hit_rate:.1f}%): {cache_bytes_served} bytes "
+          f"served from the store vs {cache_bytes_stored} bytes "
+          f"fitted-and-stored ({len(c_store)} fresh fit(s) cached)")
+        by_source = {}
+        for ev in c_hit:
+            by_source[ev["source"]] = by_source.get(ev["source"], 0) + 1
+        if by_source:
+            p("  hit split by layer: " + ", ".join(
+                f"{src}={n}" for src, n in sorted(by_source.items()))
+              + " (router hits never touched a host)")
+        for ev in c_hit:
+            t = ev.get("tenant")
+            if t is not None:
+                cache_tenant_hits[t] = cache_tenant_hits.get(t, 0) + 1
+        if cache_tenant_hits:
+            miss_by_tenant = {}
+            for ev in c_miss:
+                t = ev.get("tenant")
+                if t is not None:
+                    miss_by_tenant[t] = miss_by_tenant.get(t, 0) + 1
+            for t in sorted(cache_tenant_hits):
+                n_h = cache_tenant_hits[t]
+                n_m = miss_by_tenant.get(t, 0)
+                p(f"  tenant {t!r}: {n_h} hit(s) / {n_m} fit(s) — hits "
+                  "are not billed against the tenant quota")
+        if c_evict:
+            ev_bytes = sum(int(ev["bytes"]) for ev in c_evict)
+            p(f"  eviction pressure: {len(c_evict)} entrie(s) evicted, "
+              f"{ev_bytes} bytes released (store bounded by "
+              "cache_max_mb; least-recently-used first)")
+
     # ---- router (cross-host request sharding) -----------------------
     r_starts = by_type.get("router_start", [])
     r_sub = by_type.get("route_submit", [])
@@ -794,6 +855,8 @@ def report(path, file=None):
         n_hosts = max((ev["n_hosts"] for ev in r_starts), default=0)
         per_host = {}
         for ev in r_sub:
+            if ev["host"] is None:
+                continue  # router-side cache hit: no host touched
             d = per_host.setdefault(ev["host"],
                                     {"requests": 0, "archives": 0,
                                      "affinity": 0})
@@ -803,6 +866,8 @@ def report(path, file=None):
         done_by_host = {}
         err_by_host = {}
         for ev in r_done:
+            if ev["host"] is None:
+                continue  # cache hit: counted in the cache section
             done_by_host[ev["host"]] = \
                 done_by_host.get(ev["host"], 0) + 1
             if ev.get("error"):
@@ -1110,6 +1175,14 @@ def report(path, file=None):
         "n_coalesce": len(coalesce),
         "batch_occupancy": occupancy,
         "n_warmup": len(warmups),
+        "n_cache_hit": len(c_hit),
+        "n_cache_miss": len(c_miss),
+        "n_cache_store": len(c_store),
+        "n_cache_evict": len(c_evict),
+        "cache_hit_rate": cache_hit_rate,
+        "cache_bytes_served": cache_bytes_served,
+        "cache_bytes_stored": cache_bytes_stored,
+        "cache_tenant_hits": cache_tenant_hits,
         "n_route_submit": len(r_sub),
         "n_route_retry": len(r_retry),
         "n_route_done": len(r_done),

@@ -82,6 +82,16 @@ TINY_ENV = {
     # every shape (including this one), and the emitted trace's
     # timing_fit/fleet_end events schema-validated
     "bench_gls": {"PPT_NPSR": "4", "PPT_NE": "4", "PPT_TELEMETRY": ""},
+    # ISSUE 17: the content-addressed result cache — the hit-identity,
+    # all-hits, and one-byte-perturbation-miss gates are ENFORCED
+    # inside the bench at every shape (the >= 5x Zipf-replay speedup
+    # gate belongs to real bench runs: PPT_CACHE_SPEEDUP_GATE=0 here),
+    # and the server + router cache traces are re-validated below
+    "bench_cache": {"PPT_NARCH": "3", "PPT_NSUB": "2",
+                    "PPT_NCHAN": "16", "PPT_NBIN": "64",
+                    "PPT_NREQ": "6", "PPT_NHOSTS": "2",
+                    "PPT_CACHE_SPEEDUP_GATE": "0",
+                    "PPT_CAMPAIGN_CACHE": "", "PPT_TELEMETRY": ""},
     # ISSUE 12: the inline-device vs host-offline excision A/B — the
     # flagged-channel-list digit gate, the ground-truth recovery gate,
     # the inline-vs-oracle .tim byte gate, and the clean-corpus no-op
@@ -96,7 +106,8 @@ _CONFIG_KEYS = ("dft_precision", "cross_spectrum_dtype", "dft_fold",
                 "scatter_compensated", "fit_harmonic_window",
                 "telemetry_path", "fit_fused", "fit_pallas",
                 "fused_block", "lm_jacobian",
-                "raw_subbyte", "transport_compress")
+                "raw_subbyte", "transport_compress",
+                "result_cache", "cache_dir", "cache_max_mb")
 
 # the heavyweight smoke shapes (tier-1 lives under a wall-clock cap on
 # a single-core runner; these four dominated the suite's durations
@@ -254,6 +265,54 @@ def test_bench_smoke(name, monkeypatch, capsys, tmp_path):
         assert dead and dead[0]["host"] == "k0"
         if fleet["killed_host_requests"]:
             assert "route_failover" in etypes
+        # ISSUE 17: the kill-during-hit arm — the whole request set
+        # served from the router's result cache after host0 died, no
+        # re-placement, no failover, byte-identical (enforced in the
+        # bench; re-checked structurally so a skipped arm fails CI)
+        chit = out["kill_during_hit"]
+        assert chit is not None
+        assert chit["lost_requests"] == 0
+        assert chit["replaced_work"] is False
+        assert chit["tim_identical"] is True
+        assert chit["cache_hits"] == 2  # == PPT_NREQ
+        trace = str(tmp_path / "trace.jsonl") + ".chit"
+        assert os.path.exists(trace), "no kill-during-hit trace"
+        manifest, events = telemetry.validate_trace(trace)
+        etypes = {e["type"] for e in events}
+        assert "cache_hit" in etypes
+        assert "route_failover" not in etypes
+    if name == "bench_cache":
+        # ISSUE 17: the hit-identity + all-hits + perturbation-miss
+        # gates are enforced inside the bench at every shape; the
+        # speedup number must exist (its >= 5x gate is disabled at
+        # smoke shapes) and both cache traces must schema-validate
+        # with the cache ledger populated
+        assert out["all_hits"] is True
+        assert out["hit_identical"] is True
+        assert out["perturb_missed"] is True
+        assert out["cache_speedup"] > 0
+        assert out["speedup_ok"] is None  # gate disabled for smoke
+        assert out["cache_bytes_served"] > 0
+        assert out["router"] is not None
+        assert out["router"]["router_hits_bypass_hosts"] is True
+        assert out["router"]["tim_identical"] is True
+        import io
+
+        from pulseportraiture_tpu import telemetry
+
+        for suffix, run in ((".cache", "ppserve"),
+                            (".rcache", "pproute")):
+            trace = str(tmp_path / "trace.jsonl") + suffix
+            assert os.path.exists(trace), f"no {suffix} trace"
+            manifest, events = telemetry.validate_trace(trace)
+            assert manifest["run"] == run
+            etypes = {e["type"] for e in events}
+            for needed in ("cache_hit", "cache_miss", "cache_store"):
+                assert needed in etypes, (suffix, needed)
+            summary = telemetry.report(trace, file=io.StringIO())
+            assert summary["n_cache_hit"] >= 6  # == PPT_NREQ
+            assert summary["cache_hit_rate"] > 0
+            assert summary["cache_bytes_served"] > 0
     if name == "bench_gauss":
         # ISSUE 9: both A/B arms must report, the in-memory oracle
         # digit gate must HOLD even at tiny shapes (engine drift fails

@@ -1,0 +1,388 @@
+"""Content-addressed result cache (ISSUE 17): hits must be
+byte-identical to fresh fits on both data lanes, content (not path)
+addressing must miss on any input perturbation, the on-disk LRU must
+evict oldest-first and treat torn entries as misses, per-tenant
+accounting must see hits without billing them as fits, and the new
+config knobs must parse strictly."""
+
+import io
+import os
+import shutil
+
+import pytest
+
+from pulseportraiture_tpu import config, telemetry
+from pulseportraiture_tpu.io import write_gmodel
+from pulseportraiture_tpu.pipeline import stream_wideband_TOAs
+from pulseportraiture_tpu.serve import (InProcTransport, ResultCache,
+                                        ToaClient, ToaRouter, ToaServer,
+                                        content_key,
+                                        resolve_result_cache)
+from pulseportraiture_tpu.synth import default_test_model, make_fake_pulsar
+from pulseportraiture_tpu.utils.mjd import MJD
+
+PAR = {"PSR": "J1744-1134", "RAJ": "17:44:29.4", "DECJ": "-11:34:54.6",
+       "P0": 0.004074, "PEPOCH": 55000.0, "DM": 3.139}
+
+
+@pytest.fixture(scope="module")
+def campaign(tmp_path_factory):
+    root = tmp_path_factory.mktemp("cache")
+    model = default_test_model(1500.0)
+    gmodel = str(root / "model.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files = []
+    for i in range(2):
+        path = str(root / f"ep{i}.fits")
+        make_fake_pulsar(model, PAR, outfile=path, nsub=2, nchan=16,
+                         nbin=128, nu0=1500.0, bw=400.0, tsub=60.0,
+                         phase=0.01 * i, dDM=1e-4,
+                         start_MJD=MJD(55100 + i, 0.1), noise_stds=0.08,
+                         dedispersed=False, quiet=True, rng=100 + i)
+        files.append(path)
+    return files, gmodel
+
+
+def test_cache_hit_byte_identical_both_lanes_and_torn(campaign,
+                                                      tmp_path):
+    """The acceptance core, on the raw AND decoded lanes: the first
+    (cache-off-equivalent) fit through a cache-on server writes the
+    SAME bytes as the one-shot driver (off-vs-on identity on a clean
+    corpus), the repeat is served from cache byte-identically, and a
+    truncated store entry is a MISS that refits — never a crash."""
+    files, gmodel = campaign
+    cdir = str(tmp_path / "store")
+    trace = str(tmp_path / "trace.jsonl")
+    srv = ToaServer(nsub_batch=8, quiet=True, telemetry=trace,
+                    result_cache=True, cache_dir=cdir).start()
+    client = ToaClient(srv)
+    for tscrunch, tag in ((False, "raw"), (True, "dec")):
+        ref = str(tmp_path / f"{tag}_ref.tim")
+        stream_wideband_TOAs(files, gmodel, nsub_batch=8, tim_out=ref,
+                             tscrunch=tscrunch, quiet=True)
+        t1 = str(tmp_path / f"{tag}_1.tim")
+        hits0 = srv.stats()["cache_hits"]
+        r1 = client.get_TOAs(files, gmodel, tim_out=t1, timeout=300,
+                             name=f"{tag}1", tscrunch=tscrunch)
+        assert open(t1, "rb").read() == open(ref, "rb").read()
+        assert srv.stats()["cache_hits"] == hits0  # a fit, not a hit
+
+        t2 = str(tmp_path / f"{tag}_2.tim")
+        r2 = client.get_TOAs(files, gmodel, tim_out=t2, timeout=300,
+                             name=f"{tag}2", tscrunch=tscrunch)
+        assert srv.stats()["cache_hits"] == hits0 + 1
+        assert open(t2, "rb").read() == open(ref, "rb").read()
+        assert len(r2.TOA_list) == len(r1.TOA_list)
+        # the recovered in-memory result re-parses the decimal .tim
+        # text (the recovered_from_tim contract) — the BYTES above are
+        # the exactness gate, the objects agree to text precision
+        for ta, tb in zip(r1.TOA_list, r2.TOA_list):
+            assert ta.MJD.day == tb.MJD.day
+            assert ta.MJD.frac == pytest.approx(tb.MJD.frac,
+                                                abs=1e-12)
+            assert ta.DM == pytest.approx(tb.DM, rel=1e-6)
+
+    # torn entry: truncate every stored .tim mid-payload — the next
+    # lookup must MISS (and refit to the same bytes), not crash
+    entries = [fn for fn in os.listdir(cdir) if fn.endswith(".tim")]
+    assert entries, "cache-on server stored nothing"
+    for fn in entries:
+        p = os.path.join(cdir, fn)
+        data = open(p, "rb").read()
+        with open(p, "wb") as fh:
+            fh.write(data[:max(1, len(data) // 2)])
+    hits0 = srv.stats()["cache_hits"]
+    misses0 = srv.cache.misses
+    t3 = str(tmp_path / "torn.tim")
+    client.get_TOAs(files, gmodel, tim_out=t3, timeout=300,
+                    name="torn")
+    assert srv.stats()["cache_hits"] == hits0
+    assert srv.cache.misses == misses0 + 1
+    assert (open(t3, "rb").read()
+            == open(str(tmp_path / "raw_ref.tim"), "rb").read())
+    srv.stop()
+
+    summary = telemetry.report(trace, file=io.StringIO())
+    assert summary["n_cache_hit"] == 2
+    assert summary["n_cache_store"] >= 2
+    assert summary["cache_bytes_served"] > 0
+
+
+def test_cache_content_not_path_addressed(campaign, tmp_path):
+    """A one-byte archive perturbation MUST miss, and identical bytes
+    under a DIFFERENT path must miss too (the .tim payload embeds
+    absolute datafile paths — aliasing would serve wrong sentinels)."""
+    files, gmodel = campaign
+    srv = ToaServer(nsub_batch=8, quiet=True, result_cache=True,
+                    cache_dir=str(tmp_path / "store")).start()
+    client = ToaClient(srv)
+    client.get_TOAs([files[0]], gmodel, timeout=300, name="seed")
+    assert srv.stats()["cache_hits"] == 0
+
+    pert = str(tmp_path / "perturbed.fits")
+    shutil.copyfile(files[0], pert)
+    with open(pert, "r+b") as fh:
+        fh.seek(os.path.getsize(pert) - 64)
+        b = fh.read(1)
+        fh.seek(-1, os.SEEK_CUR)
+        fh.write(bytes([b[0] ^ 0x01]))
+    client.get_TOAs([pert], gmodel, timeout=300, name="pert")
+    assert srv.stats()["cache_hits"] == 0  # perturbation missed
+
+    alias = str(tmp_path / "alias.fits")
+    shutil.copyfile(files[0], alias)
+    client.get_TOAs([alias], gmodel, timeout=300, name="alias")
+    assert srv.stats()["cache_hits"] == 0  # same bytes, new path: miss
+
+    client.get_TOAs([files[0]], gmodel, timeout=300, name="rehit")
+    assert srv.stats()["cache_hits"] == 1  # the original still hits
+    srv.stop()
+
+
+def test_router_hit_short_circuits_placement(campaign, tmp_path):
+    """A router-side hit never touches a host: per-host request counts
+    stay frozen, the handle arrives pre-settled (no attempts — nothing
+    for failover/hedge to re-place), and the trace shows the hit."""
+    files, gmodel = campaign
+    trace = str(tmp_path / "route.jsonl")
+    srv = ToaServer(nsub_batch=8, quiet=True).start()
+    router = ToaRouter([InProcTransport(srv, label="h0")],
+                       telemetry=trace, result_cache=True,
+                       cache_dir=str(tmp_path / "store"))
+    t1 = str(tmp_path / "r1.tim")
+    router.submit(files, gmodel, tim_out=t1, name="r1").result(300)
+    placed0 = {lbl: st["n_requests"]
+               for lbl, st in router.stats().items()}
+    t2 = str(tmp_path / "r2.tim")
+    rh = router.submit(files, gmodel, tim_out=t2, name="r2")
+    res = rh.result(300)
+    assert rh.attempts == []  # settled on arrival, never placed
+    assert router.cache_hits == 1
+    assert {lbl: st["n_requests"]
+            for lbl, st in router.stats().items()} == placed0
+    assert open(t2, "rb").read() == open(t1, "rb").read()
+    assert len(res.TOA_list) == 4
+    router.close()
+    srv.stop()
+
+    summary = telemetry.report(trace, file=io.StringIO())
+    assert summary["n_cache_hit"] == 1
+    assert summary["n_cache_miss"] == 1
+    assert summary["n_route_done"] == 2
+
+
+def test_tenant_sees_hits_without_billing(campaign, tmp_path):
+    """Per-tenant split: a hit lands on the tenant's hit ledger
+    (visible in tenant_snapshot) but is never billed against the
+    quota or the weighted-fair vtime — only real fits queue."""
+    files, gmodel = campaign
+    old = config.serve_tenant_quota
+    try:
+        # quota of ONE archive: a billed repeat would be rejected,
+        # a cache hit sails through without touching the ledger
+        config.serve_tenant_quota = {"bulk": 1, "*": 8}
+        srv = ToaServer(nsub_batch=8, quiet=True, result_cache=True,
+                        cache_dir=str(tmp_path / "store")).start()
+        client = ToaClient(srv)
+        client.get_TOAs([files[0]], gmodel, timeout=300, name="f1",
+                        tenant="bulk")
+        for i in range(3):  # repeats: all hits, quota never consulted
+            client.get_TOAs([files[0]], gmodel, timeout=300,
+                            name=f"h{i}", tenant="bulk")
+        snap = srv.queue.tenant_snapshot()
+        assert snap["bulk"]["cache_hits"] == 3
+        assert snap["bulk"]["pending_archives"] == 0
+        assert srv.stats()["cache_hits"] == 3
+        srv.stop()
+    finally:
+        config.serve_tenant_quota = old
+
+
+def test_lru_eviction_order_and_torn_blob(tmp_path):
+    """Direct store semantics: least-recently-USED evicts first (a hit
+    refreshes recency), an entry larger than the whole bound is
+    refused, and a torn blob (bad length header) is a deleted miss."""
+    rc = ResultCache(str(tmp_path / "s"), max_mb=0.003)  # 3000 bytes
+    for k in ("k1", "k2", "k3"):
+        assert rc.put_blob(k, bytes(900)) is not None
+    assert rc.evictions == 0
+    assert rc.get_blob("k1") is not None  # refresh k1's recency
+    assert rc.put_blob("k4", bytes(900)) is not None  # -> evict k2
+    assert rc.evictions == 1
+    assert rc.get_blob("k2") is None  # the LRU victim
+    assert rc.get_blob("k1") is not None  # survived via the refresh
+    assert rc.get_blob("k3") is not None
+
+    assert rc.put_blob("big", bytes(5000)) is None  # can never fit
+    assert rc.get_blob("big") is None
+    # the refused oversize entry must NOT have flushed the store
+    assert rc.get_blob("k1") is not None
+    assert rc.get_blob("k3") is not None
+
+    # torn blob: corrupt the stored length header
+    path = os.path.join(rc.dir, "k3.blob")
+    data = open(path, "rb").read()
+    open(path, "wb").write(data[:-10])
+    misses0 = rc.misses
+    assert rc.get_blob("k3") is None
+    assert rc.misses == misses0 + 1
+    assert not os.path.exists(path)  # dropped, cannot mislead again
+
+    # a reopened store inherits the entries (mtime-seeded LRU)
+    rc2 = ResultCache(str(tmp_path / "s"), max_mb=0.003)
+    assert rc2.get_blob("k1") is not None
+
+
+def test_content_key_sensitivity(campaign):
+    """The key moves with file bytes, file path, options, and the
+    byte-relevant config knobs — and with nothing else."""
+    files, gmodel = campaign
+    k0 = content_key([files[0], gmodel], {"fit_scat": False})
+    assert k0 == content_key([files[0], gmodel], {"fit_scat": False})
+    assert k0 != content_key([files[1], gmodel], {"fit_scat": False})
+    assert k0 != content_key([files[0], gmodel], {"fit_scat": True})
+    old = config.dft_precision
+    try:
+        # flip AWAY from whatever the harness set it to
+        config.dft_precision = ("default" if old == "highest"
+                                else "highest")
+        assert k0 != content_key([files[0], gmodel],
+                                 {"fit_scat": False})
+    finally:
+        config.dft_precision = old
+    with pytest.raises(OSError):
+        content_key(["/nonexistent/archive.fits"], {})
+
+
+def test_resolve_tri_state(tmp_path):
+    """off -> None; auto -> None WITHOUT a dir (the shipped default:
+    off out of the box) and a live cache WITH one; on -> loud
+    ValueError without a dir; junk mode -> loud ValueError."""
+    assert resolve_result_cache(mode=False) is None
+    assert resolve_result_cache(mode="off") is None
+    old = (config.result_cache, config.cache_dir)
+    try:
+        config.result_cache, config.cache_dir = "auto", None
+        assert resolve_result_cache() is None  # the shipped default
+    finally:
+        config.result_cache, config.cache_dir = old
+    assert resolve_result_cache(mode="auto", cache_dir=None) is None
+    rc = resolve_result_cache(mode="auto",
+                              cache_dir=str(tmp_path / "a"))
+    assert isinstance(rc, ResultCache)
+    rc = resolve_result_cache(mode=True,
+                              cache_dir=str(tmp_path / "b"))
+    assert isinstance(rc, ResultCache)
+    with pytest.raises(ValueError, match="cache_dir"):
+        resolve_result_cache(mode=True, cache_dir=None)
+    with pytest.raises(ValueError, match="result_cache"):
+        resolve_result_cache(mode="sometimes")
+
+
+def test_cache_env_hooks(monkeypatch):
+    """PPT_RESULT_CACHE / PPT_CACHE_DIR / PPT_CACHE_MAX_MB: registered
+    in KNOWN_PPT_ENV, strict parses, loud errors, did-you-mean."""
+    old = (config.result_cache, config.cache_dir, config.cache_max_mb)
+    try:
+        for name in ("PPT_RESULT_CACHE", "PPT_CACHE_DIR",
+                     "PPT_CACHE_MAX_MB"):
+            assert name in config.KNOWN_PPT_ENV
+        monkeypatch.setenv("PPT_RESULT_CACHE", "on")
+        monkeypatch.setenv("PPT_CACHE_DIR", "/tmp/ppt_rc")
+        monkeypatch.setenv("PPT_CACHE_MAX_MB", "128")
+        changed = config.env_overrides()
+        for key in ("result_cache", "cache_dir", "cache_max_mb"):
+            assert key in changed
+        assert config.result_cache is True
+        assert config.cache_dir == "/tmp/ppt_rc"
+        assert config.cache_max_mb == 128.0
+        monkeypatch.setenv("PPT_RESULT_CACHE", "auto")
+        monkeypatch.setenv("PPT_CACHE_DIR", "off")
+        config.env_overrides()
+        assert config.result_cache == "auto"
+        assert config.cache_dir is None
+        monkeypatch.setenv("PPT_RESULT_CACHE", "off")
+        config.env_overrides()
+        assert config.result_cache is False
+        for name, bad in (("PPT_RESULT_CACHE", "sometimes"),
+                          ("PPT_CACHE_MAX_MB", "0"),
+                          ("PPT_CACHE_MAX_MB", "-3"),
+                          ("PPT_CACHE_MAX_MB", "big")):
+            monkeypatch.setenv(name, bad)
+            with pytest.raises(ValueError, match=name):
+                config.env_overrides()
+            monkeypatch.delenv(name)
+        # did-you-mean on a typo'd knob
+        import contextlib
+
+        import pulseportraiture_tpu.config as cfgmod
+
+        cfgmod._warned_unknown_ppt.discard("PPT_RESULT_CACH")
+        monkeypatch.setenv("PPT_RESULT_CACH", "on")
+        err = io.StringIO()
+        with contextlib.redirect_stderr(err):
+            config.env_overrides()
+        assert "PPT_RESULT_CACHE" in err.getvalue()
+    finally:
+        (config.result_cache, config.cache_dir,
+         config.cache_max_mb) = old
+
+
+def test_cli_cache_flags_strict(tmp_path):
+    """apply_cache_flags (shared by ppserve/pproute/ppfactory) dies
+    loudly on junk and on 'on' without a dir — before any IO."""
+    from pulseportraiture_tpu.cli.ppserve import (apply_cache_flags,
+                                                  build_parser)
+    old = (config.result_cache, config.cache_dir, config.cache_max_mb)
+    try:
+        p = build_parser()
+        args = p.parse_args(["-r", "x.jsonl", "--result-cache",
+                             "banana"])
+        with pytest.raises(SystemExit, match="result-cache"):
+            apply_cache_flags(args, "ppserve")
+        args = p.parse_args(["-r", "x.jsonl", "--cache-max-mb", "-1"])
+        with pytest.raises(SystemExit, match="cache-max-mb"):
+            apply_cache_flags(args, "ppserve")
+        config.cache_dir = None
+        args = p.parse_args(["-r", "x.jsonl", "--result-cache", "on"])
+        with pytest.raises(SystemExit, match="cache-dir"):
+            apply_cache_flags(args, "ppserve")
+        args = p.parse_args(["-r", "x.jsonl", "--result-cache", "on",
+                             "--cache-dir", str(tmp_path / "c"),
+                             "--cache-max-mb", "64"])
+        apply_cache_flags(args, "ppserve")
+        assert config.result_cache is True
+        assert config.cache_max_mb == 64.0
+    finally:
+        (config.result_cache, config.cache_dir,
+         config.cache_max_mb) = old
+
+
+def test_ppfactory_artifact_cache(campaign, tmp_path, capsys):
+    """Template-factory artifacts ride the same store: a second
+    ppfactory run over the same metafile + options serves every
+    .gmodel from cache, byte-identical to the built one."""
+    files, _ = campaign
+    from pulseportraiture_tpu.cli.ppfactory import main as ppfactory
+    meta = str(tmp_path / "jobs.meta")
+    with open(meta, "w") as fh:
+        fh.write(files[0] + "\n")
+    outdir = str(tmp_path / "out")
+    old = (config.result_cache, config.cache_dir, config.cache_max_mb)
+    try:
+        argv = ["-M", meta, "-O", outdir, "--max-ngauss", "1",
+                "--cache-dir", str(tmp_path / "store"), "--verbose"]
+        assert ppfactory(argv) == 0
+        out1 = capsys.readouterr().out
+        assert "0/1 template(s) served from the result cache" in out1
+        built = os.path.join(outdir,
+                             os.path.basename(files[0]) + ".gmodel")
+        ref = open(built, "rb").read()
+        os.unlink(built)
+        assert ppfactory(argv) == 0
+        out2 = capsys.readouterr().out
+        assert "1/1 template(s) served from the result cache" in out2
+        assert open(built, "rb").read() == ref
+    finally:
+        (config.result_cache, config.cache_dir,
+         config.cache_max_mb) = old

@@ -94,6 +94,9 @@ def test_parseval_sd_survives_baseline_offset(key):
     assert abs(got - want) < 1e-5 * want, (got, want)
 
 
+@pytest.mark.slow  # ~26 s windowed-vs-full parity sweep (tier-1
+# budget, r19): the window also carries in-bench chi2 gates and the
+# lighter truncated-fit tests above stay in tier-1
 def test_truncated_fit_parity_with_moderate_offset(key):
     """Fit-level chi2 parity with a baseline offset within the full
     lane's own f32 accuracy envelope (~100x the noise)."""
@@ -153,6 +156,9 @@ def test_truncated_fit_parity(key):
     assert abs(float(rt.phi[0]) - 0.123) < 1e-3
 
 
+@pytest.mark.slow  # ~24 s scattering-lane window parity (tier-1
+# budget, r19): bench_scatter gates the windowed scattering fit
+# in-bench; the cheap window-shape tests above stay in tier-1
 def test_truncated_scatter_fit_parity(key):
     """The scattering lane honors the window too (the scattering
     kernel only multiplies the template spectrum — never widens it —

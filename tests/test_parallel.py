@@ -69,6 +69,9 @@ def test_data_x_chan_mesh_matches_batch(batch):
     _check(res, ref)
 
 
+@pytest.mark.slow  # ~11 s two-mesh sharded parity (tier-1 budget,
+# r19): test_sharded_fast_scatter_matches_batch keeps the sharded
+# fast lane's parity gate in tier-1
 def test_sharded_fast_matches_batch(batch):
     """The complex-free sharded core (the real-TPU-pod path) matches
     the batch reference on both mesh shapes, incl. a shared template."""

@@ -299,6 +299,9 @@ def test_stream_flux_matches_gettoas(tmp_path):
             2.5 * float(np.mean(np.asarray(model.amps))), rel=1.0)
 
 
+@pytest.mark.slow  # ~14 s (tier-1 budget, r19): the IRF plumbing
+# keeps tier-1 coverage in test_pipeline_toas.py::
+# test_instrumental_response_plumbed
 def test_stream_instrumental_response_matches_gettoas(tmp_path):
     """Streamed fits with an instrumental-response kernel (achromatic
     Gaussian + DM smearing) reproduce GetTOAs' results."""
@@ -333,6 +336,9 @@ def test_stream_instrumental_response_matches_gettoas(tmp_path):
                                  "irf_types": []}, quiet=True)
 
 
+@pytest.mark.slow  # ~22 s narrowband parity sweep (tier-1 budget,
+# r19): test_stream_narrowband_multidevice_digit_identical keeps the
+# NB streamed lane's digit gate in tier-1
 def test_stream_narrowband_matches_gettoas(tmp_path):
     """Streamed narrowband (per-channel 1-D) TOAs reproduce
     get_narrowband_TOAs — both plain and with the per-channel
@@ -388,6 +394,9 @@ def test_stream_narrowband_matches_gettoas(tmp_path):
             t_ref.flags["log10_scat_time"], abs=1e-3)
 
 
+@pytest.mark.slow  # ~18 s fast-lane scattering parity (tier-1
+# budget, r19): test_stream_scattering_matches_gettoas keeps the
+# streamed scattering parity in tier-1 on the default lane
 def test_stream_fast_lane_scattering_parity(tmp_path):
     """With config.use_fast_fit forced on (the TPU setting), scattering
     buckets route through the complex-free _cgh_scatter lane in f32 —

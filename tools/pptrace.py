@@ -17,7 +17,11 @@ placement imbalance) and — for elastic fleets — the "fleet" section:
 per-host health-state timeline (JOINING/HEALTHY/SUSPECT/DEAD/
 REJOINED transitions), failover counts split collected-vs-
 redispatched, hedge counts, and the per-tenant latency split; see
-docs/GUIDE.md "Operating an elastic fleet".
+docs/GUIDE.md "Operating an elastic fleet".  Cache-enabled runs add
+the "cache" section: hit rate over lookups, bytes served-from-cache
+vs fitted-and-stored, the router/server hit split, per-tenant
+hits-vs-fits, and eviction pressure; see docs/GUIDE.md "The result
+cache".
 """
 
 import os
