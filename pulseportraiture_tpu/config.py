@@ -299,6 +299,48 @@ serve_max_wait_ms = 50.0
 # ToaServer(queue_depth=...) / ppserve --queue-depth.
 serve_queue_depth = 64
 
+# --- Online ingest (ingest/: the observatory pipeline) --------------------
+# Poll cadence [ms] of the watch-folder ingest source
+# (ingest/source.WatchFolderSource): how often the directory is
+# re-scanned for new archives.  Shorter polls shave admit latency at
+# the cost of directory stat traffic; the bench gates admit->TOA p99
+# against this.  Per-source override via WatchFolderSource(poll_ms=).
+ingest_poll_ms = 200.0
+
+# Size-stability window [ms] for watch-folder admission: a file whose
+# size (or mtime) changed within the last this-many milliseconds is
+# presumed still being written and is NOT admitted yet — the guard
+# that keeps half-written PSRFITS out of the loaders.  A '<name>.done'
+# completion sentinel next to the file bypasses the wait (the writer
+# declares completeness explicitly).  Per-source override via
+# WatchFolderSource(stable_ms=).
+ingest_stable_ms = 500.0
+
+# CUSUM reference value k for the residual-stream anomaly detectors
+# (ingest/alerts.py), in units of the standardized residual's sigma:
+# drifts smaller than k per sample accumulate nothing, so k sets the
+# smallest step the detector is sensitive to (classic choice: half the
+# step size you care about).  Per-detector override via
+# CusumDetector(k=).
+alert_cusum_k = 0.5
+
+# CUSUM decision threshold h (same sigma units): an alert fires when
+# the accumulated one-sided sum crosses h.  Larger h trades detection
+# delay for false-alarm rate; the bench gates zero false alarms on a
+# clean control corpus at the default.  Per-detector override via
+# CusumDetector(h=).
+alert_cusum_h = 5.0
+
+# Full-resolve cadence of the incremental GLS lane
+# (timing/incremental.IncrementalGLS): every this-many sequential TOA
+# updates the lane rebuilds the whole system through the batch solver
+# (the digit oracle) and REFUSES loudly if the incremental solution
+# drifted beyond its tolerance — the guard that keeps O(params^2)
+# rank updates honest against float accumulation.  0 disables the
+# periodic resolve (structural resolves on new DMX epochs still
+# happen).  Per-lane override via IncrementalGLS(resolve_every=).
+gls_resolve_every = 64
+
 # --- Cross-host routing (serve/router.py + serve/transport.py) ------------
 # Default fleet for ToaRouter / the pproute CLI: a tuple of
 # 'host:port' endpoints, each a ``ppserve --listen`` serving loop.
@@ -540,6 +582,11 @@ RCSTRINGS = {
 #   PPT_TELEMETRY=<path>|off        -> telemetry_path
 #   PPT_SERVE_MAX_WAIT_MS=<float>   -> serve_max_wait_ms
 #   PPT_SERVE_QUEUE_DEPTH=<N>       -> serve_queue_depth
+#   PPT_INGEST_POLL_MS=<float>      -> ingest_poll_ms
+#   PPT_INGEST_STABLE_MS=<float>    -> ingest_stable_ms
+#   PPT_ALERT_CUSUM_K=<float>       -> alert_cusum_k
+#   PPT_ALERT_CUSUM_H=<float>       -> alert_cusum_h
+#   PPT_GLS_RESOLVE_EVERY=<N>       -> gls_resolve_every
 #   PPT_BUCKET_PAD=off|auto|on      -> bucket_pad
 #   PPT_ROUTER_HOSTS=h:p[,h:p...]|off -> router_hosts
 #   PPT_ROUTER_RETRY_MAX=<N>        -> router_retry_max
@@ -578,6 +625,8 @@ KNOWN_PPT_ENV = frozenset({
     "PPT_STREAM_DEVICES", "PPT_MAX_INFLIGHT",
     "PPT_PIPELINE_DEPTH", "PPT_COMPILE_CACHE", "PPT_TELEMETRY",
     "PPT_SERVE_MAX_WAIT_MS", "PPT_SERVE_QUEUE_DEPTH", "PPT_BUCKET_PAD",
+    "PPT_INGEST_POLL_MS", "PPT_INGEST_STABLE_MS",
+    "PPT_ALERT_CUSUM_K", "PPT_ALERT_CUSUM_H", "PPT_GLS_RESOLVE_EVERY",
     "PPT_ROUTER_HOSTS", "PPT_ROUTER_RETRY_MAX", "PPT_SERVE_LISTEN",
     "PPT_ROUTER_PROBE_MS", "PPT_ROUTER_HEDGE_MS",
     "PPT_ROUTER_FLEET_FILE", "PPT_SERVE_TENANT_QUOTA",
@@ -593,6 +642,7 @@ KNOWN_PPT_ENV = frozenset({
     "PPT_TEMPLATE_NOISE", "PPT_STREAM_SPEEDUP_GATE",
     "PPT_HARMONIC_WINDOW", "PPT_TUNNEL_EMU", "PPT_RETUNE",
     "PPT_ZIPF_S", "PPT_CACHE_SPEEDUP_GATE",
+    "PPT_NSEEDS", "PPT_INGEST_P99_GATE",
 })
 
 def parse_hostport(spec):
@@ -901,6 +951,71 @@ def env_overrides():
                 f"PPT_SERVE_QUEUE_DEPTH must be >= 1, got {n}")
         cfg.serve_queue_depth = n
         changed.append("serve_queue_depth")
+    ipoll = _os.environ.get("PPT_INGEST_POLL_MS", "")
+    if ipoll:
+        try:
+            v = float(ipoll)
+        except ValueError:
+            raise ValueError(
+                "PPT_INGEST_POLL_MS must be a positive number of "
+                f"milliseconds, got {ipoll!r}")
+        if not v > 0:
+            raise ValueError(
+                f"PPT_INGEST_POLL_MS must be > 0, got {v}")
+        cfg.ingest_poll_ms = v
+        changed.append("ingest_poll_ms")
+    istab = _os.environ.get("PPT_INGEST_STABLE_MS", "")
+    if istab:
+        try:
+            v = float(istab)
+        except ValueError:
+            raise ValueError(
+                "PPT_INGEST_STABLE_MS must be a non-negative number "
+                f"of milliseconds, got {istab!r}")
+        if v < 0:
+            raise ValueError(
+                f"PPT_INGEST_STABLE_MS must be >= 0, got {v}")
+        cfg.ingest_stable_ms = v
+        changed.append("ingest_stable_ms")
+    ck = _os.environ.get("PPT_ALERT_CUSUM_K", "")
+    if ck:
+        try:
+            v = float(ck)
+        except ValueError:
+            raise ValueError(
+                "PPT_ALERT_CUSUM_K must be a non-negative number (in "
+                f"sigma units), got {ck!r}")
+        if v < 0:
+            raise ValueError(
+                f"PPT_ALERT_CUSUM_K must be >= 0, got {v}")
+        cfg.alert_cusum_k = v
+        changed.append("alert_cusum_k")
+    ch = _os.environ.get("PPT_ALERT_CUSUM_H", "")
+    if ch:
+        try:
+            v = float(ch)
+        except ValueError:
+            raise ValueError(
+                "PPT_ALERT_CUSUM_H must be a positive number (in "
+                f"sigma units), got {ch!r}")
+        if not v > 0:
+            raise ValueError(
+                f"PPT_ALERT_CUSUM_H must be > 0, got {v}")
+        cfg.alert_cusum_h = v
+        changed.append("alert_cusum_h")
+    rev = _os.environ.get("PPT_GLS_RESOLVE_EVERY", "")
+    if rev:
+        try:
+            n = int(rev)
+        except ValueError:
+            raise ValueError(
+                "PPT_GLS_RESOLVE_EVERY must be a non-negative "
+                f"integer (0 disables), got {rev!r}")
+        if n < 0:
+            raise ValueError(
+                f"PPT_GLS_RESOLVE_EVERY must be >= 0, got {n}")
+        cfg.gls_resolve_every = n
+        changed.append("gls_resolve_every")
     bpad = _os.environ.get("PPT_BUCKET_PAD", "").lower()
     if bpad:
         table = {"off": False, "false": False, "auto": "auto",

@@ -1,0 +1,36 @@
+"""Online observatory pipeline (ISSUE 18): continuous ingest into the
+warm serving loop, incremental GLS timing, and anomaly alerting.
+
+Everything upstream of this package is request/response over finished
+archives; an observatory wants wideband TOAs AS DATA ARRIVES.  This
+package adds the always-on lane without adding a new executor
+(ROADMAP: "add an ingest driver, not a new executor"):
+
+* ``source.py`` — where archives come from: a watch-folder source
+  with size-stability + completion-sentinel admission (half-written
+  PSRFITS never reach the loaders) and a socket source reusing the
+  serve/transport.py framing for push-style announcement.
+* ``driver.py`` — the ingest driver: probes each candidate for
+  truncation (io.scan_fits -> typed retry-on-stable), submits
+  single-archive requests into the warm ToaServer (backpressure rides
+  ServeRejected(retryable)), and appends each result to the streaming
+  per-pulsar ``.tim`` IN ADMISSION ORDER with the same durable
+  completion sentinels the one-shot driver writes — the streamed file
+  is byte-identical to running the whole corpus offline.
+* ``alerts.py`` — CUSUM change detection on the timing-residual
+  stream: glitches (achromatic phase/F0 step), DM steps (the nu^-2
+  chromatic signature riding the wideband DM stream), and profile
+  changes (persistent red-chi^2 excess over the quality gate), each
+  emitting the ``alert`` telemetry event pptrace's alerts section
+  reports.
+
+The ``ppwatch`` CLI (cli/ppwatch.py) wires folder -> TOAs ->
+timing.IncrementalGLS -> alerts end-to-end.
+"""
+
+from .alerts import AlertMonitor, CusumDetector  # noqa: F401
+from .driver import IngestDriver  # noqa: F401
+from .source import SocketSource, WatchFolderSource, announce  # noqa: F401
+
+__all__ = ["WatchFolderSource", "SocketSource", "announce",
+           "IngestDriver", "AlertMonitor", "CusumDetector"]

@@ -1,6 +1,7 @@
 """Data access & formats (SURVEY §2.2 L1): PSRFITS archives without
 PSRCHIVE, model-file formats, TOA/tim writers, telescope codes."""
 
+from .fitsio import TruncatedFits, scan_fits  # noqa: F401
 from .psrfits import (  # noqa: F401
     Archive,
     load_data,

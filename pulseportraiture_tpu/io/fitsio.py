@@ -20,6 +20,19 @@ import numpy as np
 BLOCK = 2880
 CARDLEN = 80
 
+
+class TruncatedFits(ValueError):
+    """A FITS file ended mid-header or mid-data: the bytes on disk are
+    shorter than the structure the headers promise.  The classic cause
+    is reading a file that is STILL BEING WRITTEN (an observatory
+    watch-folder racing the telescope backend), so this error is typed
+    and marked retryable — the ingest driver catches it and re-admits
+    the file once its size stabilizes instead of poisoning the source.
+    A torn file that never completes keeps raising; it is still a
+    loud ValueError for every non-ingest caller."""
+
+    retryable = True
+
 # TFORM letter -> (numpy big-endian dtype, bytes per element)
 _TFORM2DTYPE = {
     "L": ("u1", 1),  # logical, stored as 'T'/'F' bytes
@@ -195,7 +208,9 @@ def _read_header(buf, off):
     while True:
         block = buf[off:off + BLOCK]
         if len(block) < BLOCK:
-            raise ValueError("truncated FITS header")
+            raise TruncatedFits(
+                f"truncated FITS header: block at offset {off} holds "
+                f"{len(block)} of {BLOCK} bytes")
         off += BLOCK
         done = False
         for i in range(0, BLOCK, CARDLEN):
@@ -267,6 +282,12 @@ def _read_hdu(buf, off, defer=()):
     header, off = _read_header(buf, off)
     size = _data_size(header)
     raw = buf[off:off + size]
+    if len(raw) < size:
+        # a short DATA payload would otherwise surface as an opaque
+        # np.frombuffer count mismatch far from the real cause
+        raise TruncatedFits(
+            f"truncated FITS data: HDU at offset {off} promises "
+            f"{size} bytes, file holds {len(raw)}")
     off += ((size + BLOCK - 1) // BLOCK) * BLOCK
     xt = str(header.get("XTENSION", "")).strip()
     data = None
@@ -318,6 +339,33 @@ def _read_hdu(buf, off, defer=()):
         data = np.frombuffer(raw, dtype=dt).reshape(shape)
         data = data.astype(np.dtype(dt).newbyteorder("="))
     return HDU(header, data), off
+
+
+def scan_fits(path):
+    """Walk a FITS file's HDU boundaries WITHOUT decoding any data —
+    the cheap completeness probe the ingest driver runs before handing
+    an archive to the loaders.  Raises :class:`TruncatedFits` when the
+    bytes on disk end before the structure the headers promise (the
+    half-written-file signature); returns the HDU count otherwise.
+    Costs header parsing only, so it is safe to run on every poll."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    n = 0
+    off = 0
+    while off < len(buf):
+        if not buf[off:off + BLOCK].strip():
+            break
+        header, off = _read_header(buf, off)
+        size = _data_size(header)
+        if len(buf) < off + size:
+            raise TruncatedFits(
+                f"truncated FITS data: HDU {n} at offset {off} "
+                f"promises {size} bytes, file holds {len(buf) - off}")
+        off += ((size + BLOCK - 1) // BLOCK) * BLOCK
+        n += 1
+    if n == 0:
+        raise TruncatedFits(f"{path}: no complete HDU")
+    return n
 
 
 def read_fits(path, defer=()):

@@ -132,7 +132,8 @@ def fake_portrait(
 
 def fake_timing_campaign(par, truth=None, n_epochs=10, toas_per_epoch=2,
                          span_days=90.0, toa_err_us=0.1, dm_err=2e-4,
-                         dmx=0.0, start_mjd=None, rng=None, site="@"):
+                         dmx=0.0, start_mjd=None, rng=None, site="@",
+                         glitch=None, dm_step=None):
     """Synthesize a phase-connected wideband TOA campaign directly
     from a parfile — no archives, no portrait fits (ISSUE 11).
 
@@ -163,6 +164,23 @@ def fake_timing_campaign(par, truth=None, n_epochs=10, toas_per_epoch=2,
     Returns (toas, truth_bunch) with truth_bunch carrying the truth
     par, the per-epoch DMX draws, and the injected correction dict
     {name: truth - nominal} for every spin/binary fit parameter.
+
+    Anomaly injection (ISSUE 18 — ground truth for ingest/alerts.py):
+
+    glitch:  {'epoch': k[, 'dphi': turns][, 'df0': Hz]} — from epoch
+             k onward every arrival picks up the ACHROMATIC time step
+             of a pulsar glitch: -dphi/F0 seconds (the phase jump)
+             plus -df0*(t - t_glitch)/F0 (the frequency step's growing
+             ramp).  Sign convention: a spun-UP pulsar (positive
+             dphi/df0) arrives EARLY.
+    dm_step: {'epoch': k, 'ddm': pc cm^-3} — the per-epoch DM offsets
+             gain a persistent step of ddm from epoch k onward (the
+             nu^-2 chromatic signature; at these infinite-frequency
+             TOAs it rides the wideband DM measurements directly).
+
+    Both events are recorded in the truth bunch as ``glitch`` /
+    ``dm_step`` dicts with their epoch index and epoch MJD, so
+    detection tests can score localization against ground truth.
     """
     from fractions import Fraction
 
@@ -188,6 +206,32 @@ def fake_timing_campaign(par, truth=None, n_epochs=10, toas_per_epoch=2,
             f"{n_epochs}, got shape {dmx_arr.shape}")
 
     step = span_days / max(n_epochs - 1, 1)
+
+    def _event(spec, name, keys):
+        if spec is None:
+            return None
+        spec = dict(spec)
+        bad = set(spec) - ({"epoch"} | set(keys))
+        if bad or "epoch" not in spec:
+            raise ValueError(
+                f"fake_timing_campaign: {name} must be a dict with "
+                f"'epoch' and any of {sorted(keys)}, got {spec!r}")
+        ep = int(spec["epoch"])
+        if not 0 <= ep < n_epochs:
+            raise ValueError(
+                f"fake_timing_campaign: {name} epoch {ep} outside "
+                f"[0, {n_epochs})")
+        spec["epoch"] = ep
+        spec["mjd"] = start_mjd + ep * step
+        return spec
+
+    glitch = _event(glitch, "glitch", ("dphi", "df0"))
+    dm_step = _event(dm_step, "dm_step", ("ddm",))
+    if dm_step is not None:
+        dmx_arr = dmx_arr.copy()
+        dmx_arr[dm_step["epoch"]:] += float(dm_step["ddm"])
+
+    F0 = float(F0r)
     toas = []
     for k in range(n_epochs):
         for j in range(toas_per_epoch):
@@ -207,7 +251,12 @@ def fake_timing_campaign(par, truth=None, n_epochs=10, toas_per_epoch=2,
                 delay = float(binary_delay_np(
                     bp, int(d1 // 1), float(d1 - int(d1 // 1))))
             noise_s = float(toa_err_us) * 1e-6 * rng.standard_normal()
-            t_obs = t_bary + Fraction(delay + noise_s) / 86400
+            event_s = 0.0
+            if glitch is not None and k >= glitch["epoch"]:
+                dt_g = (e - glitch["mjd"]) * 86400.0
+                event_s = -(float(glitch.get("dphi", 0.0))
+                            + float(glitch.get("df0", 0.0)) * dt_g) / F0
+            t_obs = t_bary + Fraction(delay + noise_s + event_s) / 86400
             day = int(t_obs // 1)
             frac = float(t_obs - day)
             toas.append(TimTOA(
@@ -229,7 +278,8 @@ def fake_timing_campaign(par, truth=None, n_epochs=10, toas_per_epoch=2,
         if par.get(key) is not None or tpar.get(key) is not None:
             injected[key] = _f(tpar, key) - _f(par, key)
     return toas, DataBunch(par=tpar, nominal=par, dmx=dmx_arr,
-                           injected=injected, binary=bp)
+                           injected=injected, binary=bp,
+                           glitch=glitch, dm_step=dm_step)
 
 
 def fake_observation(

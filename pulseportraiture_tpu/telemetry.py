@@ -70,6 +70,8 @@ CONFIG_SNAPSHOT_KEYS = (
     "scatter_compensated", "lm_jacobian", "fit_fused",
     "raw_subbyte", "transport_compress",
     "result_cache", "cache_dir", "cache_max_mb",
+    "ingest_poll_ms", "ingest_stable_ms",
+    "alert_cusum_k", "alert_cusum_h", "gls_resolve_every",
 )
 
 # The event vocabulary: type -> fields REQUIRED beyond (type, t).
@@ -201,6 +203,21 @@ EVENT_FIELDS = {
     "cache_miss": {"req", "source"},
     "cache_store": {"key", "bytes"},
     "cache_evict": {"key", "bytes"},
+    # the online observatory pipeline (ingest/, ISSUE 18):
+    # ingest_admit = one archive admitted from a source into the warm
+    # serve loop (wait_s = discovery->admission wall, the
+    # size-stability + backpressure wait); ingest_skip = a discovered
+    # file NOT admitted this pass with the reason ('unstable' = still
+    # being written, 'truncated' = typed torn-file retry,
+    # 'backpressure' = ServeRejected(retryable), 'error' = poisoned);
+    # alert = one anomaly detection on the residual stream — kind is
+    # 'glitch' | 'dm_step' | 'profile_change', pulsar/mjd locate it,
+    # score is the CUSUM sum (or red-chi2) that crossed, threshold is
+    # the h it crossed.  The "alerts" report section and the
+    # n_alert/ingest_p99_s summary keys aggregate exactly these.
+    "ingest_admit": {"datafile", "source", "wait_s"},
+    "ingest_skip": {"datafile", "source", "reason"},
+    "alert": {"kind", "pulsar", "mjd", "score", "threshold"},
     "counters": {"counters", "gauges"},
 }
 
@@ -1135,6 +1152,56 @@ def report(path, file=None):
         for row in _hist_lines(vals):
             p(row)
 
+    # ---- online ingest + alerts (ingest/, ISSUE 18) -----------------
+    admits = by_type.get("ingest_admit", [])
+    iskips = by_type.get("ingest_skip", [])
+    alerts = by_type.get("alert", [])
+    ingest_p50_s = ingest_p99_s = None
+    alert_fp_rate = None
+    incremental_resolves = None
+    if by_type.get("counters"):
+        incremental_resolves = (by_type["counters"][-1]["counters"]
+                                .get("incremental_resolves"))
+    if admits or iskips or alerts:
+        p("")
+        p("-- online ingest + alerts --")
+        if admits:
+            waits = [float(ev["wait_s"]) for ev in admits
+                     if ev.get("wait_s") is not None]
+            if waits:
+                ingest_p50_s = float(np.percentile(waits, 50))
+                ingest_p99_s = float(np.percentile(waits, 99))
+                p(f"  {len(admits)} archive(s) admitted; "
+                  f"discovery->admission wait p50 {ingest_p50_s:.3f} s  "
+                  f"p99 {ingest_p99_s:.3f} s")
+            else:
+                p(f"  {len(admits)} archive(s) admitted")
+        if iskips:
+            reasons = {}
+            for ev in iskips:
+                reasons[ev["reason"]] = reasons.get(ev["reason"], 0) + 1
+            detail = ", ".join(f"{k}: {v}"
+                               for k, v in sorted(reasons.items()))
+            p(f"  {len(iskips)} admission deferral(s)/skip(s) "
+              f"({detail})")
+        if alerts:
+            # a false positive is an alert the emitter flagged as not
+            # matching any known injected/true event ('fp': true) —
+            # synthetic corpora set it, live traces leave it absent
+            n_fp = sum(1 for ev in alerts if ev.get("fp"))
+            alert_fp_rate = n_fp / len(alerts)
+            p(f"  {len(alerts)} alert(s) "
+              f"({n_fp} flagged false-positive):")
+            for ev in alerts[:10]:
+                p(f"    {ev['kind']}: {ev['pulsar']} @ MJD "
+                  f"{ev['mjd']:.4f}  score {ev['score']:.2f} "
+                  f"(threshold {ev['threshold']:.2f})")
+        elif admits:
+            alert_fp_rate = 0.0
+        if incremental_resolves is not None:
+            p(f"  incremental GLS: {incremental_resolves} full "
+              "resolve(s) against the batch oracle")
+
     skips = by_type.get("archive_skip", [])
     if skips:
         p("")
@@ -1210,6 +1277,13 @@ def report(path, file=None):
         "timing_dispatches": timing_dispatches,
         "timing_pad_frac": timing_pad_frac,
         "timing_wall_s": timing_wall_s,
+        "n_ingest_admit": len(admits),
+        "n_ingest_skip": len(iskips),
+        "ingest_p50_s": ingest_p50_s,
+        "ingest_p99_s": ingest_p99_s,
+        "n_alert": len(alerts),
+        "alert_fp_rate": alert_fp_rate,
+        "incremental_resolves": incremental_resolves,
         "counters": counters,
         "gauges": gauges,
     }

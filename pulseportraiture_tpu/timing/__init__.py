@@ -16,8 +16,10 @@ timing_pars= ride it).
 from .binary import BinaryParams, parse_binary
 from .fleet import TimingJob, fleet_gls_fit, toas_from_measurements
 from .gls import WidebandGLSResult, wideband_gls_fit
+from .incremental import GLSDriftError, IncrementalGLS
 from .tim import TimTOA, read_tim
 
 __all__ = ["read_tim", "TimTOA", "wideband_gls_fit",
            "WidebandGLSResult", "BinaryParams", "parse_binary",
-           "TimingJob", "fleet_gls_fit", "toas_from_measurements"]
+           "TimingJob", "fleet_gls_fit", "toas_from_measurements",
+           "IncrementalGLS", "GLSDriftError"]

@@ -22,6 +22,7 @@ setup(
             "ppfactory=pulseportraiture_tpu.cli.ppfactory:main",
             "ppspline=pulseportraiture_tpu.cli.ppspline:main",
             "ppzap=pulseportraiture_tpu.cli.ppzap:main",
+            "ppwatch=pulseportraiture_tpu.cli.ppwatch:main",
         ]
     },
 )

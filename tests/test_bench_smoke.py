@@ -100,6 +100,16 @@ TINY_ENV = {
     "bench_zap": {"PPT_NARCH": "2", "PPT_NSUB": "2",
                   "PPT_NCHAN": "32", "PPT_NBIN": "128",
                   "PPT_TELEMETRY": ""},
+    # ISSUE 18: the online observatory pipeline e2e — streamed-vs-
+    # offline .tim byte identity, both injected events alerted at
+    # their true epochs, zero false alarms on the clean control, and
+    # the <= 1e-10 incremental-vs-batch parity are all ENFORCED inside
+    # the bench at every shape (the admit->TOA p99 latency gate
+    # belongs to real bench runs: PPT_INGEST_P99_GATE unset here)
+    "bench_ingest": {"PPT_NARCH": "6", "PPT_NSUB": "2",
+                     "PPT_NCHAN": "16", "PPT_NBIN": "128",
+                     "PPT_NSEEDS": "2", "PPT_CAMPAIGN_CACHE": "",
+                     "PPT_TELEMETRY": ""},
 }
 
 _CONFIG_KEYS = ("dft_precision", "cross_spectrum_dtype", "dft_fold",
@@ -110,10 +120,12 @@ _CONFIG_KEYS = ("dft_precision", "cross_spectrum_dtype", "dft_fold",
                 "result_cache", "cache_dir", "cache_max_mb")
 
 # the heavyweight smoke shapes (tier-1 lives under a wall-clock cap on
-# a single-core runner; these four dominated the suite's durations
-# report) — still exercised in the full `-m slow` run
+# a single-core runner; these dominated the suite's durations report)
+# — still exercised in the full `-m slow` run.  bench_ingest's e2e
+# gates are mirrored in tier-1 by tests/test_ingest.py +
+# tests/test_incremental.py; bench_cache's by tests/test_cache.py.
 _HEAVY_BENCHES = {"bench_gauss", "bench_scatter", "bench_zap",
-                  "bench_campaign"}
+                  "bench_campaign", "bench_ingest", "bench_cache"}
 
 
 def test_all_bench_scripts_covered():
@@ -313,6 +325,40 @@ def test_bench_smoke(name, monkeypatch, capsys, tmp_path):
             assert summary["n_cache_hit"] >= 6  # == PPT_NREQ
             assert summary["cache_hit_rate"] > 0
             assert summary["cache_bytes_served"] > 0
+    if name == "bench_ingest":
+        # ISSUE 18: every e2e gate is enforced inside the bench
+        # (SystemExit on violation) — re-checked structurally here so
+        # a silently skipped arm fails CI, and both pipeline traces
+        # must schema-validate with the ingest/alert ledger
+        assert out["tim_identical"] is True
+        assert out["incremental_parity_ok"] is True
+        assert out["incremental_max_rel"] <= 1e-10
+        assert out["incremental_resolves"] >= 1
+        assert out["n_alerts"] == 2
+        assert out["glitch_mjd_err_d"] <= 1.0
+        assert out["dm_step_mjd_err_d"] <= 1.0
+        assert out["clean_alerts"] == 0
+        assert out["detection_rate"] == 1.0
+        assert out["fp_rate"] == 0.0
+        assert out["admit_to_toa_p99_s"] >= \
+            out["admit_to_toa_p50_s"] > 0
+        assert out["p99_ok"] is None  # latency gate off for smoke
+        import io as _io
+
+        from pulseportraiture_tpu import telemetry
+
+        for suffix, n_alert in ((".ingest", 2), (".clean", 0)):
+            trace = str(tmp_path / "trace.jsonl") + suffix
+            assert os.path.exists(trace), f"no {suffix} trace"
+            _manifest, events = telemetry.validate_trace(trace)
+            etypes = {e["type"] for e in events}
+            for needed in ("ingest_admit", "request_done",
+                           "batch_coalesce"):
+                assert needed in etypes, (suffix, needed)
+            summary = telemetry.report(trace, file=_io.StringIO())
+            assert summary["n_ingest_admit"] == 6
+            assert summary["n_alert"] == n_alert
+            assert summary["incremental_resolves"] >= 1
     if name == "bench_gauss":
         # ISSUE 9: both A/B arms must report, the in-memory oracle
         # digit gate must HOLD even at tiny shapes (engine drift fails

@@ -173,6 +173,9 @@ def test_ppzap_cli_telemetry_and_write_mode(workspace, tmp_path):
     assert cmds.read_text() == ""
 
 
+@pytest.mark.slow  # ~14 s; the stream-vs-get_TOAs parity stays tier-1
+# via tests/test_stream.py::test_stream_matches_gettoas and the CLI
+# surface keeps test_pptoas_cli_recovers_ddms
 def test_pptoas_cli_stream_matches(workspace, tmp_path):
     """--stream produces the same TOA lines (up to float formatting) as
     the per-archive path for a wideband phi/DM run."""

@@ -1,0 +1,387 @@
+"""Online observatory pipeline (ISSUE 18): watch-folder admission,
+truncation-safe ingest, streamed-vs-offline byte identity, anomaly
+ground truth, and the new env knobs."""
+
+import io as _io
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu import config, telemetry
+from pulseportraiture_tpu.cli import ppwatch
+from pulseportraiture_tpu.ingest import (AlertMonitor, CusumDetector,
+                                         IngestDriver, SocketSource,
+                                         WatchFolderSource, announce)
+from pulseportraiture_tpu.io import TruncatedFits, scan_fits, write_gmodel
+from pulseportraiture_tpu.pipeline import stream_wideband_TOAs
+from pulseportraiture_tpu.synth import default_test_model, make_fake_pulsar
+from pulseportraiture_tpu.synth.fake import fake_timing_campaign
+from pulseportraiture_tpu.timing import IncrementalGLS
+from pulseportraiture_tpu.utils.mjd import MJD
+
+PAR = {"PSR": "J1744-1134", "RAJ": "17:44:29.4", "DECJ": "-11:34:54.6",
+       "P0": 0.004074, "PEPOCH": 55150.0, "DM": 3.139}
+FPAR = {"PSR": "FAKE", "F0": "218.8", "PEPOCH": "55500", "DM": "15.9"}
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    """Watch-folder corpus: 3 spin-coherent archives (a common
+    achromatic offset, NOT per-archive phase jumps — the clean corpus
+    must not look like a glitching pulsar), a template, and a parfile
+    for the incremental lane."""
+    root = tmp_path_factory.mktemp("ingest")
+    folder = root / "in"
+    folder.mkdir()
+    model = default_test_model(1500.0)
+    gmodel = str(root / "model.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files = []
+    for i in range(3):
+        path = str(folder / f"ep{i}.fits")
+        make_fake_pulsar(model, PAR, outfile=path, nsub=2, nchan=16,
+                         nbin=128, nu0=1500.0, bw=400.0, tsub=60.0,
+                         phase=0.017, dDM=2e-4 * (i - 1),
+                         start_MJD=MJD(55100 + 30 * i, 0.2),
+                         noise_stds=0.05, dedispersed=False,
+                         quiet=True, rng=100 + i, spin_coherent=True)
+        files.append(path)
+    parfile = str(root / "pulsar.par")
+    with open(parfile, "w") as fh:
+        for k, v in PAR.items():
+            fh.write(f"{k} {v}\n")
+    return str(folder), files, gmodel, parfile
+
+
+# -- satellite: the new env knobs ---------------------------------------
+
+
+def test_ingest_env_hooks(monkeypatch):
+    """The five ISSUE-18 knobs: registered, strict parses, loud
+    refusals."""
+    names = ("PPT_INGEST_POLL_MS", "PPT_INGEST_STABLE_MS",
+             "PPT_ALERT_CUSUM_K", "PPT_ALERT_CUSUM_H",
+             "PPT_GLS_RESOLVE_EVERY")
+    for name in names:
+        assert name in config.KNOWN_PPT_ENV
+    old = (config.ingest_poll_ms, config.ingest_stable_ms,
+           config.alert_cusum_k, config.alert_cusum_h,
+           config.gls_resolve_every)
+    try:
+        monkeypatch.setenv("PPT_INGEST_POLL_MS", "75.5")
+        monkeypatch.setenv("PPT_INGEST_STABLE_MS", "0")
+        monkeypatch.setenv("PPT_ALERT_CUSUM_K", "0.75")
+        monkeypatch.setenv("PPT_ALERT_CUSUM_H", "6.5")
+        monkeypatch.setenv("PPT_GLS_RESOLVE_EVERY", "17")
+        changed = config.env_overrides()
+        for attr in ("ingest_poll_ms", "ingest_stable_ms",
+                     "alert_cusum_k", "alert_cusum_h",
+                     "gls_resolve_every"):
+            assert attr in changed
+        assert config.ingest_poll_ms == 75.5
+        assert config.ingest_stable_ms == 0.0
+        assert config.alert_cusum_k == 0.75
+        assert config.alert_cusum_h == 6.5
+        assert config.gls_resolve_every == 17
+        for name, bad in (("PPT_INGEST_POLL_MS", "0"),
+                          ("PPT_INGEST_STABLE_MS", "-1"),
+                          ("PPT_ALERT_CUSUM_K", "-0.1"),
+                          ("PPT_ALERT_CUSUM_H", "0"),
+                          ("PPT_GLS_RESOLVE_EVERY", "1.5")):
+            monkeypatch.setenv(name, bad)
+            with pytest.raises(ValueError, match=name):
+                config.env_overrides()
+            monkeypatch.delenv(name)
+    finally:
+        (config.ingest_poll_ms, config.ingest_stable_ms,
+         config.alert_cusum_k, config.alert_cusum_h,
+         config.gls_resolve_every) = old
+
+
+# -- watch-folder admission ---------------------------------------------
+
+
+def test_watch_folder_stability_and_sentinel(tmp_path):
+    """A still-warm file is NOT admitted until its (size, mtime) holds
+    for stable_ms; the .done sentinel bypasses the wait; defer()
+    restarts the clock but keeps the discovery time."""
+    f = tmp_path / "a.fits"
+    f.write_bytes(b"x" * 100)
+    src = WatchFolderSource(str(tmp_path), stable_ms=10_000)
+    assert src.poll() == []           # discovery pass: not stable yet
+    assert src.pending() == [str(f)]
+    # a growing file restarts the stability clock
+    f.write_bytes(b"x" * 200)
+    assert src.poll() == []
+    # the explicit sentinel bypasses the wait entirely
+    (tmp_path / "a.fits.done").touch()
+    out = src.poll()
+    assert [p for p, _ in out] == [str(f)]
+    assert out[0][1] >= 0.0           # wait_s: discovery -> admission
+    assert src.poll() == []           # admitted once
+    # defer: back on the watch list, sentinel re-admits immediately
+    src.defer(str(f))
+    assert src.pending() == [str(f)]
+    assert [p for p, _ in src.poll()] == [str(f)]
+    # sentinels themselves are never candidates
+    src2 = WatchFolderSource(str(tmp_path), stable_ms=0)
+    time.sleep(0.01)
+    assert [p for p, _ in src2.poll()] == [str(f)]
+
+
+def test_socket_source_announce_roundtrip(tmp_path):
+    """Push-style ingest: announce() delivers paths over the serve
+    framing; defer re-queues; unknown ops refuse loudly."""
+    with SocketSource() as src:
+        ep = f"{src.endpoint[0]}:{src.endpoint[1]}"
+        assert announce(ep, ["/data/a.fits", "/data/b.fits"]) == 2
+        got = src.poll()
+        assert [p for p, _ in got] == ["/data/a.fits", "/data/b.fits"]
+        assert all(w >= 0 for _, w in got)
+        src.defer("/data/a.fits")
+        assert src.pending() == ["/data/a.fits"]
+        assert [p for p, _ in src.poll()] == ["/data/a.fits"]
+        import socket as _socket
+
+        from pulseportraiture_tpu.serve.transport import (
+            _recv_frame, _send_frame)
+        with _socket.create_connection(src.endpoint) as s:
+            _send_frame(s, {"op": "nope"})
+            reply = _recv_frame(s)
+        assert not reply["ok"] and "unknown op" in reply["error"]
+
+
+# -- truncation safety --------------------------------------------------
+
+
+def test_scan_fits_truncated_two_chunks(corpus, tmp_path):
+    """The regression the typed error exists for: a PSRFITS written in
+    two chunks is TruncatedFits (retryable) after the first chunk and
+    clean after the second."""
+    _folder, files, _gmodel, _par = corpus
+    whole = open(files[0], "rb").read()
+    part = tmp_path / "partial.fits"
+    part.write_bytes(whole[:len(whole) // 2])
+    with pytest.raises(TruncatedFits) as ei:
+        scan_fits(str(part))
+    assert ei.value.retryable
+    assert isinstance(ei.value, ValueError)  # still a loud bad-input
+    # the loaders hit the same typed error, not a cryptic shape crash
+    from pulseportraiture_tpu.io import read_archive
+
+    with pytest.raises(TruncatedFits):
+        read_archive(str(part))
+    with open(part, "ab") as fh:
+        fh.write(whole[len(whole) // 2:])
+    assert scan_fits(str(part)) >= 2  # header + subint HDUs
+
+
+def test_driver_defers_truncated_then_admits(corpus, tmp_path):
+    """End-to-end retry-on-stable: the driver defers a half-written
+    archive (ingest_skip reason='truncated'), then admits and times it
+    once the second chunk lands."""
+
+    class FakeRequest:
+        def __init__(self):
+            class R:
+                TOA_list = []
+            self._r = R()
+
+        def wait(self, timeout=None):
+            return True
+
+        def result(self, timeout=None):
+            return self._r
+
+    class FakeServer:
+        def __init__(self):
+            self.submitted = []
+
+        def submit(self, datafiles, modelfile, **kw):
+            self.submitted.extend(datafiles)
+            return FakeRequest()
+
+    _folder, files, gmodel, _par = corpus
+    whole = open(files[0], "rb").read()
+    part = tmp_path / "in"
+    part.mkdir()
+    dest = part / "x.fits"
+    dest.write_bytes(whole[:len(whole) // 2])
+    trace = str(tmp_path / "trace.jsonl")
+    tracer = telemetry.Tracer(trace, run="ingest-retry")
+    src = WatchFolderSource(str(part), stable_ms=0)
+    server = FakeServer()
+    drv = IngestDriver(server, gmodel, [src],
+                       tim_out=str(tmp_path / "out.tim"),
+                       tracer=tracer, quiet=True)
+    drv.run_once()            # discovery pass registers the file
+    time.sleep(0.01)
+    assert drv.run_once() == 0  # stable but HALF-WRITTEN: deferred
+    assert drv.stats()["deferred"] == 1 and not server.submitted
+    with open(dest, "ab") as fh:
+        fh.write(whole[len(whole) // 2:])
+    drv.run_once()            # growth re-registers (stability clock)
+    time.sleep(0.01)
+    assert drv.run_once() == 1
+    assert drv.drain(10)
+    assert server.submitted == [str(dest)]
+    tracer.close()
+    _m, events = telemetry.validate_trace(trace)
+    skips = [e for e in events if e["type"] == "ingest_skip"]
+    admits = [e for e in events if e["type"] == "ingest_admit"]
+    assert len(skips) == 1 and skips[0]["reason"] == "truncated"
+    assert len(admits) == 1 and admits[0]["wait_s"] >= 0
+    # the sentinel landed even for an empty fake result
+    tim = open(tmp_path / "out.tim").read()
+    assert f"C ppt-done {dest}" in tim
+
+
+# -- the end-to-end acceptance corpus -----------------------------------
+
+
+def test_ppwatch_drain_byte_identical_to_offline(corpus, tmp_path):
+    """The tentpole's e2e gate: ppwatch --drain over a finished
+    watch-folder corpus produces a streaming .tim BYTE-IDENTICAL to
+    the offline one-shot over the same archives, zero alerts on the
+    clean corpus, and a trace whose summary carries the new keys."""
+    folder, files, gmodel, parfile = corpus
+    for f in files:
+        sentinel = f + ".done"
+        if not os.path.exists(sentinel):
+            open(sentinel, "w").close()
+    tim = str(tmp_path / "streamed.tim")
+    trace = str(tmp_path / "watch.jsonl")
+    rc = ppwatch.main(["-w", folder, "-m", gmodel, "-t", tim,
+                       "-p", parfile, "--drain", "--stable-ms", "0",
+                       "--resolve-every", "2",
+                       "--telemetry", trace, "--quiet"])
+    assert rc == 0
+    offline = str(tmp_path / "offline.tim")
+    stream_wideband_TOAs(sorted(files), gmodel, nsub_batch=8,
+                         tim_out=offline, quiet=True)
+    assert open(tim, "rb").read() == open(offline, "rb").read()
+    summary = telemetry.report(trace, file=_io.StringIO())
+    assert summary["n_ingest_admit"] == 3
+    assert summary["n_alert"] == 0
+    assert summary["ingest_p99_s"] is not None
+    assert summary["incremental_resolves"] >= 1
+
+
+# -- anomaly ground truth (synthetic TOA-level corpora) -----------------
+
+
+def _run_monitor(glitch=None, dm_step=None, rng=0, tracer=None):
+    toas, truth = fake_timing_campaign(
+        FPAR, n_epochs=12, toas_per_epoch=2, span_days=120.0,
+        dmx=2e-4, rng=rng, glitch=glitch, dm_step=dm_step)
+    known = []
+    if glitch:
+        known.append({"kind": "glitch", "mjd": truth.glitch["mjd"]})
+    if dm_step:
+        known.append({"kind": "dm_step", "mjd": truth.dm_step["mjd"]})
+    inc = IncrementalGLS(FPAR, fit_binary=False, resolve_every=0)
+    mon = AlertMonitor("FAKE", tracer=tracer,
+                       known_events=known or None)
+    result = None
+    for toa in toas:
+        result = inc.update(toa)
+        mon.observe(result, toa)
+    mon.finish()
+    return mon.alerts, truth, result
+
+
+def test_alert_clean_control_zero_false_alarms():
+    alerts, _, _ = _run_monitor(rng=3)
+    assert alerts == []
+
+
+def test_alert_glitch_recovered_within_one_epoch(tmp_path):
+    """A glitch (achromatic phase step) fires exactly one alert whose
+    MJD matches the injected epoch to within one epoch spacing — and
+    the alert telemetry event validates."""
+    trace = str(tmp_path / "alerts.jsonl")
+    tracer = telemetry.Tracer(trace, run="glitch")
+    alerts, truth, _ = _run_monitor(
+        glitch={"epoch": 9, "dphi": 218.8 * 50e-6}, rng=5,
+        tracer=tracer)
+    tracer.close()
+    assert [a["kind"] for a in alerts] == ["glitch"]
+    assert not alerts[0]["fp"]
+    spacing = 120.0 / 11
+    assert abs(alerts[0]["mjd"] - truth.glitch["mjd"]) <= spacing
+    _m, events = telemetry.validate_trace(trace)
+    evs = [e for e in events if e["type"] == "alert"]
+    assert len(evs) == 1 and evs[0]["kind"] == "glitch"
+    assert evs[0]["threshold"] == config.alert_cusum_h
+
+
+def test_alert_dm_step_amplitude_within_3_sigma():
+    """A DM step fires exactly one dm_step alert localized at the
+    injected epoch whose amplitude recovers the injected ddm within 3
+    sigma of the fitted epoch error."""
+    ddm = 4e-3
+    alerts, truth, result = _run_monitor(
+        dm_step={"epoch": 6, "ddm": ddm}, rng=7)
+    assert [a["kind"] for a in alerts] == ["dm_step"]
+    a = alerts[0]
+    assert not a["fp"]
+    assert a["epoch"] == 6
+    assert abs(a["mjd"] - truth.dm_step["mjd"]) <= 1e-6
+    sig = float(result.dmx_errs[6])
+    assert abs(a["amp"] - ddm) <= 3 * sig
+
+
+def test_alert_combined_corpus_both_events_no_fp():
+    """One glitch + one DM step in the same stream: both alerted at
+    their true epochs, neither tagged fp, nothing else fires."""
+    alerts, truth, _ = _run_monitor(
+        glitch={"epoch": 9, "dphi": 218.8 * 50e-6},
+        dm_step={"epoch": 4, "ddm": 4e-3}, rng=8)
+    assert sorted(a["kind"] for a in alerts) == ["dm_step", "glitch"]
+    assert all(not a["fp"] for a in alerts)
+
+
+def test_alert_profile_change_and_refractory():
+    """The gof arm: persistent reduced-chi^2 excess fires ONE
+    profile_change alert (the refractory window collapses the
+    re-crossings of a persistent condition)."""
+    mon = AlertMonitor("X", warmup=2, max_gof=1.5)
+
+    class T:
+        flags = {}
+
+        def __init__(self, mjd):
+            self.mjd_int, self.mjd_frac = int(mjd), mjd - int(mjd)
+            self.dm = self.dm_err = None
+
+    for i in range(30):
+        mon.observe(None, T(55000 + i), gof=1.1 if i < 10 else 9.0)
+    kinds = [a["kind"] for a in mon.alerts]
+    assert kinds == ["profile_change"]
+    assert mon.alerts[0]["mjd"] >= 55009
+
+
+def test_cusum_detector_units():
+    """CUSUM mechanics: quiet stream never alarms; a step alarms with
+    the onset localized at the step, not the crossing."""
+    det = CusumDetector(k=0.5, h=5.0)
+    for _ in range(100):
+        assert det.update(0.0) is None
+    rng = np.random.default_rng(0)
+    det2 = CusumDetector(k=0.5, h=5.0)
+    fired = None
+    for i in range(50):
+        z = float(rng.normal()) + (4.0 if i >= 30 else 0.0)
+        s = det2.update(z)
+        if s is not None:
+            fired = (i, s, det2.last_lag)
+            break
+    assert fired is not None
+    i, s, lag = fired
+    assert s > 5.0
+    assert i - (lag - 1) in (30, 31)  # onset at the step
+    with pytest.raises(ValueError, match="h must be"):
+        CusumDetector(h=0.0)

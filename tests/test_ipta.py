@@ -81,6 +81,8 @@ def test_ipta_campaign_matches_per_pulsar_gettoas(campaign, tmp_path):
             [ln for ln in lines.splitlines() if ln.strip()]) >= 6
 
 
+@pytest.mark.slow  # ~15 s; per-job option plumbing stays tier-1 via
+# the serve lane-key coalescing tests (tests/test_serve.py)
 def test_ipta_per_job_option_overrides(campaign, tmp_path):
     """Per-job kwargs override campaign-wide defaults (e.g. one
     scattered pulsar fits tau while the rest do not)."""

@@ -423,6 +423,9 @@ class TestAnalyticJacobian:
         with pytest.raises(ValueError, match="lm_jacobian"):
             use_lm_jacobian()
 
+    @pytest.mark.slow  # ~20 s; the AD-vs-analytic digit gate also runs
+    # in-bench (bench_gauss) and tier-1 keeps test_portrait_join_columns
+    # + test_init_and_loop_share_the_jac on the analytic lane
     def test_batched_ad_vs_analytic_same_selection(self, rng):
         """The whole batched trial pipeline under both Jacobian
         sources: identical nfev trajectories at these well-conditioned

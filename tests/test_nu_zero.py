@@ -184,6 +184,9 @@ def _per_channel_hessian(d, theta, log10_tau):
     return np.asarray(jax.jacfwd(jax.jacrev(per_chan))(theta))
 
 
+@pytest.mark.slow  # ~29 s; the nu_DM zeroing property stays tier-1 via
+# test_nu_zero_property[phi-DM], and the closed-form reference family
+# keeps test_closed_form_phi_gm / test_closed_form_tau_alpha there
 def test_closed_form_phi_dm(data):
     """Reference {phi, DM} weighted-mean form (pptoaslib.py:789-795):
     nu0 = (sum(nu^-2 W) / sum(W))^-1/2, W = H_phiDM_n/(nu^-2-nu_fit^-2)."""
@@ -197,6 +200,8 @@ def test_closed_form_phi_dm(data):
     assert float(r.nu_DM) == pytest.approx(nu0, rel=1e-6)
 
 
+@pytest.mark.slow  # ~12 s; the closed-form family keeps
+# test_closed_form_tau_alpha tier-1 and the property tests cover GM
 def test_closed_form_phi_gm(data):
     """Reference {phi, GM} form (pptoaslib.py:796-803): nu^-4 weighted
     mean, power -1/4."""

@@ -169,8 +169,14 @@ FAST_COMBOS = [
 ]
 
 
-@pytest.mark.parametrize("combo", FAST_COMBOS,
-                         ids=["-".join(c) for c in FAST_COMBOS])
+# the compensated-scattering combo is the heaviest directed case
+# (~25 s); it rides the @slow full lattice, and tier-1 keeps the comp
+# lane via the scatter-compensated fits in tests/test_fit.py
+@pytest.mark.parametrize(
+    "combo",
+    [pytest.param(c, id="-".join(c),
+                  marks=([pytest.mark.slow] if c[4] == "comp" else []))
+     for c in FAST_COMBOS])
 def test_option_lattice_directed(combo):
     _check_combo(*combo)
 

@@ -106,6 +106,8 @@ def test_spline_model_recovery(avg_file, tmp_path):
         np.testing.assert_allclose(got, dp.modelx, atol=1e-8)
 
 
+@pytest.mark.slow  # ~15 s spline build; the spline pipeline stays
+# tier-1 via test_built_templates_feed_pptoas
 def test_spline_model_zero_components(avg_file, tmp_path):
     """With an impossible S/N cutoff the model degrades to the mean
     profile (reference ncomp == 0 branch)."""

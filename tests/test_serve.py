@@ -120,6 +120,39 @@ def test_serve_deadline_flush_partial_bucket(campaign, tmp_path):
     assert co[0]["rows"] == 2 and co[0]["pad"] == 62  # padded partial
 
 
+def test_serve_trickle_partial_flushes_byte_identical(campaign, tmp_path):
+    """The observatory-ingest arrival shape (ISSUE 18): archives
+    trickle in ONE AT A TIME against a bucket they can never fill.
+    Every request must launch as its own flush_stale partial bucket
+    within the deadline — no cross-archive coalescing to wait for —
+    and the admission-ordered concatenation of the per-request .tim
+    files must be byte-identical to the one-shot batched driver over
+    the finished corpus."""
+    files, gmodel = campaign
+    ref = tmp_path / "batched.tim"
+    stream_wideband_TOAs(files, gmodel, nsub_batch=8,
+                         tim_out=str(ref), quiet=True)
+    trace = str(tmp_path / "trickle.jsonl")
+    tims = [tmp_path / f"t{i}.tim" for i in range(len(files))]
+    with ToaServer(nsub_batch=64, max_wait_ms=30,
+                   telemetry=trace) as srv:
+        client = ToaClient(srv)
+        for i, (f, tim) in enumerate(zip(files, tims)):
+            # wait for each result before offering the next archive:
+            # a genuine trickle, never two archives in one bucket
+            res = client.get_TOAs([f], gmodel, timeout=300,
+                                  tim_out=str(tim), name=f"t{i}")
+            assert len(res.TOA_list) == 2
+    streamed = b"".join(t.read_bytes() for t in tims)
+    assert streamed == ref.read_bytes()
+    _, events = telemetry.validate_trace(trace)
+    co = [e for e in events if e["type"] == "batch_coalesce"]
+    # one partial bucket per archive, each deadline-flushed solo
+    assert len(co) == len(files)
+    assert all(e["n_requests"] == 1 and e["rows"] == 2
+               and e["pad"] == 62 for e in co)
+
+
 def test_serve_backpressure_and_closed_rejection(campaign, tmp_path):
     """The admission bound is LOUD: a submit beyond queue_depth
     archives raises ServeRejected with retryable=True (nothing

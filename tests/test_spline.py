@@ -55,6 +55,8 @@ class TestWavelet:
         sm = np.asarray(wavelet_smooth(noisy, nlevel=5, fact=1.0))
         assert np.mean((sm - clean) ** 2) < 0.5 * np.mean((noisy - clean) ** 2)
 
+    @pytest.mark.slow  # ~12 s; wavelet shrinkage basics stay tier-1 in
+    # the surrounding TestWavelet cases
     def test_smart_smooth_zeroes_pure_noise_keeps_signal(self, rng):
         nbin = 256
         t = np.linspace(0, 1, nbin, endpoint=False)

@@ -135,6 +135,8 @@ def test_stream_incremental_tim(campaign, tmp_path):
     assert len(li) == len(res.TOA_list)
 
 
+@pytest.mark.slow  # ~13 s; the streamed-vs-get_TOAs parity core stays
+# tier-1 via test_stream_matches_gettoas (phi-DM lane)
 def test_stream_scattering_matches_gettoas(tmp_path):
     """Streamed scattering fits (fit_scat + auto seed) must reproduce
     GetTOAs' scattering results and emit the same TOA flag set."""
@@ -228,6 +230,7 @@ def test_stream_raw_lane_dedispersed_and_iquv(tmp_path):
         assert t.DM == pytest.approx(t_ref.DM, abs=1e-7)
 
 
+@pytest.mark.slow  # ~13 s; same rationale as the scattering variant
 def test_stream_gm_matches_gettoas(tmp_path):
     """Streamed (phi, DM, GM) fits reproduce GetTOAs' GM results and
     flags, including the 2-usable-channel no-GM demotion."""
@@ -611,6 +614,8 @@ def test_stream_multidevice_resume_out_of_order(campaign, tmp_path):
     assert tim_part.read_bytes() == tim_full.read_bytes()
 
 
+@pytest.mark.slow  # ~15 s; the inflight bound is also asserted by the
+# serve executor's queue-depth gates in tests/test_serve.py
 def test_stream_inflight_bound_exact(campaign):
     """The per-device in-flight bound is EXACT: with max_inflight=1 a
     device's queue never holds two pending dispatches (the old

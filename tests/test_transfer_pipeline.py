@@ -211,6 +211,9 @@ def pipeline_corpus(tmp_path_factory):
     return tmp, files, gmodel
 
 
+@pytest.mark.slow  # ~16 s; the depth-1-vs-N byte identity is gated
+# in-bench every bench_campaign run, and test_h2d_telemetry_schema_and
+# _report keeps the pipelined lane's schema tier-1
 def test_pipeline_depth_byte_identical_and_bounded(pipeline_corpus):
     """depth=1 (serialized copy/fit, the pre-pipeline arm) and
     depth=2 (double-buffered) must produce byte-identical .tim and
