@@ -1,0 +1,315 @@
+"""Per-backend autotune benchmark (ISSUE 19 acceptance gates).
+
+Arms (all in ONE process):
+  sweep     — a COLD tuning DB: the identity-tier sweep runs on the
+              first archive (tune/autotune.ensure_tuned), winners
+              persist to the DB keyed (backend fingerprint, shape
+              class).  Gates: ``tuned_speedup`` >= 1.0 (the harness's
+              own combined no-regression gate — a tuned campaign is
+              never slower than default), and the FULL campaign's
+              per-request ``.tim`` bytes under the tuned knobs are
+              identical to the default config's (``tim_identical``) —
+              the identity tier must never change output.
+  reuse     — a WARM DB: ensure_tuned again on the same (fingerprint,
+              shape class).  Gates: the workload fn is NEVER called
+              (zero re-sweeps, counted), and the trace witnesses it as
+              one ``tune_apply`` with ``db_hit=true`` and ZERO
+              ``tune_sweep`` events (``db_reuse_ok``).
+  fleet     — backend-aware routing (tentpole layer 3): a 2-host
+              fast/slow fleet emulated with virtual devices — host1's
+              fits pay a per-dispatch sleep, so its server-measured
+              TOAs/s EMA (serve/server.py) genuinely drops and the
+              ``stat`` wire op reports it.  The same request set runs
+              with the router cost model OFF (exact least-loaded) and
+              ON (cost = archives / measured relative speed).  Gates:
+              cost-model makespan <= least-loaded makespan * 1.05
+              (``cost_ok``), zero lost/duplicated requests, and every
+              routed .tim byte-identical to its one-shot reference
+              (``fleet_tim_identical``).
+
+Knobs via env: PPT_NARCH (8), PPT_NSUB (4), PPT_NCHAN (16), PPT_NBIN
+(128), PPT_NREQ (4 requests), PPT_TUNE_NRUN (2 timing reps),
+PPT_SLOW_MS (150 per-dispatch penalty on the slow host),
+PPT_CAMPAIGN_CACHE (corpus dir, shared with bench_campaign),
+PPT_TELEMETRY (traces to <path>.tune1/.tune2/.fleet).  The tuning DB
+is recreated under the corpus dir every run (the reuse arm needs a
+same-process warm hit, not a stale file).  Prints ONE JSON line.
+"""
+
+import io
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _ensure_devices(n):
+    """Force >= n virtual CPU devices BEFORE jax initializes
+    (bench_router's discipline): each emulated host pins its own
+    device so its dispatches — and the slow host's penalty — run in
+    its own worker."""
+    if "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+
+
+def main():
+    NHOSTS = 2
+    _ensure_devices(NHOSTS)
+    import pulseportraiture_tpu  # noqa: F401
+    from pulseportraiture_tpu import config
+    config.env_overrides()
+
+    import jax
+
+    from pulseportraiture_tpu import telemetry
+    from pulseportraiture_tpu.io.gmodel import write_gmodel
+    from pulseportraiture_tpu.pipeline.stream import stream_wideband_TOAs
+    from pulseportraiture_tpu.serve import (InProcTransport, ToaClient,
+                                            ToaRouter, ToaServer)
+    from pulseportraiture_tpu.synth import default_test_model
+    from pulseportraiture_tpu.synth.archive import make_fake_pulsar
+    from pulseportraiture_tpu.tune import (TuningStore, ensure_tuned,
+                                           shape_class_for, tuned_config)
+    from pulseportraiture_tpu.tune.capability import backend_fingerprint
+
+    NARCH = int(os.environ.get("PPT_NARCH", 8))
+    NSUB = int(os.environ.get("PPT_NSUB", 4))
+    NCHAN = int(os.environ.get("PPT_NCHAN", 16))
+    NBIN = int(os.environ.get("PPT_NBIN", 128))
+    NREQ = max(2, int(os.environ.get("PPT_NREQ", 4)))
+    NRUN = max(1, int(os.environ.get("PPT_TUNE_NRUN", 2)))
+    SLOW_MS = float(os.environ.get("PPT_SLOW_MS", 150.0))
+    PAR = {"PSR": "FAKE", "P0": 0.003, "DM": 50.0, "PEPOCH": 56000.0}
+    cache = os.environ.get("PPT_CAMPAIGN_CACHE", "/tmp/ppt_campaign")
+    tag = f"{NARCH}x{NSUB}x{NCHAN}x{NBIN}"
+    root = os.path.join(cache, tag)
+    os.makedirs(root, exist_ok=True)
+    trace_base = config.telemetry_path  # PPT_TELEMETRY (or None)
+
+    mpath = os.path.join(root, "model.gmodel")
+    if not os.path.exists(mpath):
+        write_gmodel(default_test_model(1500.0), mpath, quiet=True)
+    files = []
+    for i in range(NARCH):
+        path = os.path.join(root, f"a{i:04d}.fits")
+        if not os.path.exists(path):
+            make_fake_pulsar(mpath, PAR, outfile=path, nsub=NSUB,
+                             nchan=NCHAN, nbin=NBIN, nu0=1500.0, bw=600.0,
+                             phase=0.01 * (i % 50), dDM=1e-4 * (i % 40),
+                             noise_stds=0.05, quiet=True, rng=i)
+        files.append(path)
+    slices = [files[i::NREQ] for i in range(NREQ)]
+
+    out_root = os.path.join(root, "tune_out")
+    os.makedirs(out_root, exist_ok=True)
+    db = os.path.join(out_root, "tune_db.json")
+    if os.path.exists(db):
+        os.remove(db)  # the reuse arm witnesses THIS process's put
+
+    # ---- sweep arm: cold DB ----------------------------------------
+    shape_class = shape_class_for(NCHAN, NBIN)
+    probe_tim = os.path.join(out_root, "probe.tim")
+    n_workload_calls = [0]
+
+    def run_fn(overrides):
+        n_workload_calls[0] += 1
+        with tuned_config(overrides):
+            stream_wideband_TOAs(files[:1], mpath, tim_out=probe_tim,
+                                 quiet=True)
+        with open(probe_tim, "rb") as fh:
+            return fh.read()
+
+    run_fn({})  # warm the jit caches out of the swept window
+    trace1 = f"{trace_base}.tune1" if trace_base else None
+    tracer1, owned1 = telemetry.resolve_tracer(trace1, run="tune1")
+    winners = ensure_tuned(run_fn, shape_class, db_path=db, nrun=NRUN,
+                           tracer=tracer1, apply=False)
+    if owned1:
+        tracer1.close()
+    ent = TuningStore(db).get(shape_class)
+    assert ent is not None, "sweep arm persisted nothing"
+    default_s, tuned_s = ent["default_s"], ent["tuned_s"]
+    speedup = default_s / max(tuned_s, 1e-12)
+    # the harness's combined no-regression gate guarantees this; a
+    # violation means the gate itself broke
+    speedup_ok = speedup >= 1.0
+    assert speedup_ok, (default_s, tuned_s)
+
+    # full-campaign byte gate: default refs vs tuned reruns
+    def ref_tim(i):
+        return os.path.join(out_root, f"ref{i}.tim")
+
+    t0 = time.perf_counter()
+    ntoa = 0
+    for i, sl in enumerate(slices):
+        res = stream_wideband_TOAs(sl, mpath, tim_out=ref_tim(i),
+                                   quiet=True)
+        ntoa += len(res.TOA_list)
+    default_wall = time.perf_counter() - t0
+    tims = [os.path.join(out_root, f"tuned{i}.tim") for i in range(NREQ)]
+    t0 = time.perf_counter()
+    with tuned_config(winners):
+        for i, sl in enumerate(slices):
+            stream_wideband_TOAs(sl, mpath, tim_out=tims[i], quiet=True)
+    tuned_wall = time.perf_counter() - t0
+    tim_identical = all(
+        open(ref_tim(i), "rb").read() == open(tims[i], "rb").read()
+        for i in range(NREQ))
+    assert tim_identical, (
+        "identity-tier winners changed campaign .tim bytes: "
+        f"{winners}")
+
+    # ---- reuse arm: warm DB, zero re-sweeps ------------------------
+    trace2 = f"{trace_base}.tune2" if trace_base else None
+    tracer2, owned2 = telemetry.resolve_tracer(trace2, run="tune2")
+    calls_before = n_workload_calls[0]
+    winners2 = ensure_tuned(run_fn, shape_class, db_path=db, nrun=NRUN,
+                            tracer=tracer2, apply=False)
+    if owned2:
+        tracer2.close()
+    resweeps = n_workload_calls[0] - calls_before
+    db_reuse_ok = resweeps == 0 and winners2 == winners
+    assert db_reuse_ok, (
+        f"warm DB re-swept: {resweeps} workload calls, "
+        f"{winners2} != {winners}")
+    if trace2:
+        man, evs = telemetry.load_trace(trace2)
+        applies = [e for e in evs if e["type"] == "tune_apply"]
+        sweeps = [e for e in evs if e["type"] == "tune_sweep"]
+        assert applies and applies[0]["db_hit"] is True, applies
+        assert not sweeps, "warm DB still emitted tune_sweep events"
+        telemetry.validate_trace(trace2)
+
+    # ---- fleet arm: fast/slow 2-host cost-model placement ----------
+    ndev = len(jax.local_devices())
+    if ndev < NHOSTS:
+        raise SystemExit(
+            f"bench_autotune: {NHOSTS} emulated hosts need {NHOSTS} "
+            f"virtual devices, got {ndev} (jax initialized before the "
+            "device-count flag could apply?)")
+    from pulseportraiture_tpu.pipeline import stream as S
+
+    slow_dev = jax.local_devices()[1]
+    real_fit_fn = S._raw_fit_fn
+
+    def hobbled_fit_fn(*a, **kw):
+        fn = real_fit_fn(*a, **kw)
+
+        def run(*args):
+            out = jax.block_until_ready(fn(*args))
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            try:
+                on_slow = slow_dev in leaf.devices()
+            except Exception:
+                on_slow = False
+            if on_slow:
+                time.sleep(SLOW_MS / 1e3)
+            return out
+
+        return run
+
+    S._raw_fit_fn = hobbled_fit_fn
+    fleet = None
+    try:
+        servers = [
+            ToaServer(quiet=True,
+                      stream_devices=[jax.local_devices()[h]]).start()
+            for h in range(NHOSTS)]
+        # warm EVERY host's jit caches AND its measured-TOAs/s EMA —
+        # the slow host's per-dispatch penalty lands in its rate, so
+        # the stat op reports genuinely different speeds
+        for srv in servers:
+            for _ in range(2):
+                ToaClient(srv).get_TOAs(files[:1], mpath, timeout=600)
+        rates = [srv.stats()["toas_per_s"] for srv in servers]
+        assert all(r is not None and r > 0 for r in rates), rates
+        walls = {}
+        shares = {}
+        fleet_tim_ok = True
+        lost = 0
+        for cm in (False, True):
+            label = "cost" if cm else "ll"
+            trace = f"{trace_base}.fleet.{label}" if trace_base else None
+            router = ToaRouter(
+                [InProcTransport(srv, label=f"{label}{h}")
+                 for h, srv in enumerate(servers)],
+                telemetry=trace, cost_model=cm)
+            arm_tims = [os.path.join(out_root, f"{label}_r{i}.tim")
+                        for i in range(NREQ)]
+            t0 = time.perf_counter()
+            handles = [router.submit(sl, mpath, tim_out=arm_tims[i],
+                                     name=f"req{i}")
+                       for i, sl in enumerate(slices)]
+            results = [h.result(3600) for h in handles]
+            walls[label] = time.perf_counter() - t0
+            shares[label] = {lbl: st["n_archives"]
+                             for lbl, st in router.stats().items()}
+            router.close()
+            lost += NREQ - len(results)
+            arm_ntoa = sum(len(r.TOA_list) for r in results)
+            assert arm_ntoa == ntoa, (
+                f"{label} arm produced {arm_ntoa} TOAs, one-shot "
+                f"{ntoa} — lost or duplicated work")
+            for i in range(NREQ):
+                fleet_tim_ok = fleet_tim_ok and (
+                    open(ref_tim(i), "rb").read()
+                    == open(arm_tims[i], "rb").read())
+            if trace:
+                summary = telemetry.report(trace, file=io.StringIO())
+                assert summary["n_route_done"] == NREQ, summary
+        # the gate: backend-aware placement must never lose to blind
+        # least-loaded (1.05 tolerance for scheduling noise at tiny
+        # shapes)
+        cost_ok = walls["cost"] <= walls["ll"] * 1.05
+        assert cost_ok, (
+            f"cost-model makespan {walls['cost']:.3f}s > least-loaded "
+            f"{walls['ll']:.3f}s * 1.05")
+        assert lost == 0 and fleet_tim_ok, (lost, fleet_tim_ok)
+        fleet = {
+            "slow_ms": SLOW_MS,
+            "toas_per_s": [round(r, 2) for r in rates],
+            "makespan_ll_s": round(walls["ll"], 3),
+            "makespan_cost_s": round(walls["cost"], 3),
+            "placement_ll": shares["ll"],
+            "placement_cost": shares["cost"],
+            "cost_ok": bool(cost_ok),
+            "lost_requests": lost,
+            "fleet_tim_identical": bool(fleet_tim_ok),
+        }
+    finally:
+        S._raw_fit_fn = real_fit_fn
+        for srv in servers:
+            srv.stop()
+
+    print(json.dumps({
+        "metric": f"identity-tier autotune sweep + campaign, {NARCH} "
+                  f"archives x {NSUB}sub x {NCHAN}ch x {NBIN}bin, "
+                  f"shape class {shape_class}",
+        "value": round(speedup, 4),
+        "unit": "x tuned speedup (workload min-of-N, >= 1.0 by the "
+                "no-regression gate)",
+        "fingerprint": backend_fingerprint(),
+        "winners": {k: repr(v) for k, v in winners.items()},
+        "n_swept": ent["n_swept"],
+        "default_s": round(default_s, 4),
+        "tuned_s": round(tuned_s, 4),
+        "speedup_ok": bool(speedup_ok),
+        "campaign_default_wall_s": round(default_wall, 3),
+        "campaign_tuned_wall_s": round(tuned_wall, 3),
+        "tim_identical": bool(tim_identical),
+        "db_reuse_ok": bool(db_reuse_ok),
+        "resweeps_on_warm_db": resweeps,
+        "fleet": fleet,
+        "toas": ntoa,
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -113,9 +113,10 @@ def build_parser():
                         "route_retry/route_done) here; analyze with "
                         "tools/pptrace.py. Also via PPT_TELEMETRY. "
                         "[default: off]")
-    from .ppserve import add_cache_flags
+    from .ppserve import add_cache_flags, add_tune_flags
 
     add_cache_flags(p)
+    add_tune_flags(p)
     p.add_argument("--quiet", action="store_true", default=False)
     return p
 
@@ -141,9 +142,10 @@ def main(argv=None):
                              "one of off/auto/on, got "
                              f"{args.transport_compress!r}")
         config.transport_compress = table[v]
-    from .ppserve import apply_cache_flags
+    from .ppserve import apply_cache_flags, apply_tune_flags
 
     apply_cache_flags(args, "pproute")
+    apply_tune_flags(args, "pproute")
     if args.hosts is not None and args.fleet_file is not None:
         raise SystemExit("pproute: --hosts and --fleet-file are "
                          "mutually exclusive (static list vs watched "

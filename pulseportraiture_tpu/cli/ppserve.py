@@ -117,8 +117,38 @@ def build_parser():
                    help="Per-request result timeout in seconds. "
                         "[default: none]")
     add_cache_flags(p)
+    add_tune_flags(p)
     p.add_argument("--quiet", action="store_true", default=False)
     return p
+
+
+def add_tune_flags(p):
+    """The tuning-DB flag (ISSUE 19), shared by ppserve / pproute /
+    pptoas: point the process at a persisted per-backend tuning DB
+    (tune/store.TuningStore)."""
+    p.add_argument("--tune-db", dest="tune_db", default=None,
+                   metavar="PATH",
+                   help="Persisted per-backend tuning DB (JSON): "
+                        "stored knob winners for this backend "
+                        "fingerprint are applied at startup; a DB "
+                        "from a different backend is refused with a "
+                        "warning. Also via PPT_TUNE_DB. "
+                        "[default: off]")
+
+
+def apply_tune_flags(args, prog, tracer=None):
+    """Apply --tune-db to config and load any stored winners for this
+    backend (LOUD warnings on stale/corrupt DBs come from the
+    store)."""
+    from .. import config
+    from ..telemetry import NULL_TRACER
+    from ..tune import apply_from_db
+
+    if getattr(args, "tune_db", None) is not None:
+        config.tune_db = args.tune_db
+    if config.tune_db:
+        apply_from_db(tracer=tracer if tracer is not None
+                      else NULL_TRACER)
 
 
 def add_cache_flags(p):
@@ -285,6 +315,7 @@ def main(argv=None):
         config.compile_cache_dir = args.compile_cache
         enable_compile_cache(args.compile_cache)
     apply_cache_flags(args, "ppserve")
+    apply_tune_flags(args, "ppserve")
     os.makedirs(args.outdir, exist_ok=True)
 
     from ..serve import ServeRejected, ToaServer

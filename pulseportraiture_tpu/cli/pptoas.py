@@ -119,6 +119,21 @@ def build_parser():
                         "XLA compile cold start.  Also via "
                         "PPT_COMPILE_CACHE / config.compile_cache_dir."
                         " [default: off]")
+    p.add_argument("--autotune", action="store_true", default=False,
+                   help="Before the campaign, resolve this backend's "
+                        "measured knob winners from the tuning DB "
+                        "(--tune-db / PPT_TUNE_DB); with no stored "
+                        "entry, sweep the output-identity-preserving "
+                        "knob tier on the first archive and persist "
+                        "the winners.  .tim output is byte-identical "
+                        "tuned vs default.  Also via PPT_AUTOTUNE.")
+    p.add_argument("--tune-db", dest="tune_db", default=None,
+                   metavar="PATH",
+                   help="Persisted per-backend tuning DB (JSON, "
+                        "tune/store.py).  A DB measured on a "
+                        "different backend fingerprint is refused "
+                        "with a warning.  Also via PPT_TUNE_DB. "
+                        "[default: config.tune_db]")
     p.add_argument("--bound", action="append", default=[],
                    metavar="PARAM:LO,HI",
                    help="Box bound on a fit parameter (repeatable): "
@@ -175,6 +190,88 @@ def parse_bounds(specs):
                 f"--bound: lower bound exceeds upper in {spec!r}")
         bounds[idx] = (lo_v, hi_v)
     return bounds
+
+
+def _tune_workload(args):
+    """Representative --autotune sweep workload: fit the FIRST archive
+    through the same streaming lane the campaign will use, returning
+    the .tim bytes as the identity artifact the sweep's byte gate
+    compares (tune/autotune.py).  The shape class is the archive's
+    (nchan, nbin) — the same key the benches persist under."""
+    import os
+    import tempfile
+
+    from ..io.psrfits import load_data
+    from ..pipeline.toas import _is_metafile, _read_metafile
+    from ..tune import shape_class_for, tuned_config
+
+    datafiles = args.datafiles
+    if isinstance(datafiles, str):
+        datafiles = (_read_metafile(datafiles)
+                     if _is_metafile(datafiles) else [datafiles])
+    first = datafiles[0]
+    d = load_data(first, quiet=True)
+    shape_class = shape_class_for(d.nchan, d.nbin)
+    tmpdir = tempfile.mkdtemp(prefix="ppt_tune_")
+    tim = os.path.join(tmpdir, "probe.tim")
+
+    def run_fn(overrides):
+        with tuned_config(overrides):
+            if args.narrowband:
+                from ..pipeline.stream import stream_narrowband_TOAs
+
+                stream_narrowband_TOAs(
+                    [first], args.modelfile,
+                    tscrunch=args.tscrunch, tim_out=tim, quiet=True)
+            else:
+                from ..pipeline.stream import stream_wideband_TOAs
+
+                stream_wideband_TOAs(
+                    [first], args.modelfile,
+                    tscrunch=args.tscrunch, tim_out=tim, quiet=True)
+        with open(tim, "rb") as fh:
+            return fh.read()
+
+    return run_fn, shape_class
+
+
+def _apply_autotune(args):
+    """Resolve tuned knob winners BEFORE the campaign (--autotune /
+    --tune-db): stored DB winners for this backend apply directly;
+    with --autotune and no stored entry the output-identity-preserving
+    knob tier is swept on the first archive and the winners persisted.
+
+    Returns ``(tracer, owned)``.  When tuning is active and telemetry
+    is on, ONE tracer is resolved here so the tune_probe/tune_apply
+    witness lands in the SAME trace the campaign driver writes —
+    main() hands the driver the tracer object (not the path; a second
+    Tracer on the path would rotate the tune events away) and closes
+    it after the lane returns."""
+    from .. import config
+
+    if args.tune_db is not None:
+        config.tune_db = args.tune_db
+    if args.autotune:
+        config.autotune = True
+    from ..telemetry import NULL_TRACER
+
+    if not (config.autotune or config.tune_db):
+        return NULL_TRACER, False
+    from ..telemetry import resolve_tracer
+
+    tracer, owned = resolve_tracer(args.telemetry, run="pptoas")
+    if config.autotune:
+        from ..tune import ensure_tuned
+
+        run_fn, shape_class = _tune_workload(args)
+        ensure_tuned(run_fn, shape_class, tracer=tracer)
+    else:
+        # --tune-db without --autotune: apply stored winners, never
+        # sweep — a cold/foreign DB is a no-op (the store warns)
+        from ..tune import apply_from_db
+
+        apply_from_db(tracer=tracer)
+    return tracer, owned
 
 
 def main(argv=None):
@@ -281,6 +378,15 @@ def main(argv=None):
                 "--narrowband for a traced per-channel campaign",
                 level="warn")
 
+    # --autotune / --tune-db: resolve this backend's tuned knob
+    # winners before any lane compiles; when tuning is active the
+    # campaign shares the tracer resolved here (tune events + campaign
+    # events, one trace)
+    telemetry = args.telemetry
+    tune_tracer, tune_owned = _apply_autotune(args)
+    if tune_owned:
+        telemetry = tune_tracer
+
     if args.stream and args.narrowband:
         if (args.psrchive or args.one_DM or args.print_flux
                 or args.print_parangle or args.fit_GM or args.showplot):
@@ -296,13 +402,15 @@ def main(argv=None):
             tscrunch=args.tscrunch, stream_devices=stream_devices,
             pipeline_depth=args.pipeline_depth,
             print_phase=args.print_phase, addtnl_toa_flags=addtnl,
-            telemetry=args.telemetry, quiet=args.quiet)
+            telemetry=telemetry, quiet=args.quiet)
         if args.format == "princeton":
             write_princeton_TOAs(res.TOA_list, outfile=args.outfile,
                                  dDMs=[0.0] * len(res.TOA_list))
         else:
             write_TOAs(res.TOA_list, SNR_cutoff=args.snr_cutoff,
                        outfile=args.outfile, append=True)
+        if tune_owned:
+            tune_tracer.close()
         return 0
 
     if args.stream:
@@ -327,7 +435,7 @@ def main(argv=None):
             fix_alpha=args.fix_alpha, addtnl_toa_flags=addtnl,
             stream_devices=stream_devices,
             pipeline_depth=args.pipeline_depth,
-            telemetry=args.telemetry,
+            telemetry=telemetry,
             quality_flags=args.quality_flags, quiet=args.quiet)
         if args.format == "princeton":
             dDMs = [toa.DM - res.DM0s[res.order.index(toa.archive)]
@@ -343,6 +451,8 @@ def main(argv=None):
         else:
             write_TOAs(res.TOA_list, SNR_cutoff=args.snr_cutoff,
                        outfile=args.outfile, append=True)
+        if tune_owned:
+            tune_tracer.close()
         return 0
 
     gt = GetTOAs(args.datafiles, args.modelfile, quiet=args.quiet)
@@ -364,7 +474,7 @@ def main(argv=None):
                     addtnl_toa_flags=addtnl, prefetch=args.prefetch,
                     quiet=args.quiet, bounds=bounds,
                     quality_flags=args.quality_flags,
-                    telemetry=args.telemetry)
+                    telemetry=telemetry)
         if args.one_DM:
             gt.apply_one_DM()
     if args.format == "princeton":
@@ -379,6 +489,8 @@ def main(argv=None):
     else:
         write_TOAs(gt.TOA_list, SNR_cutoff=args.snr_cutoff,
                    outfile=args.outfile, append=True)
+    if tune_owned:
+        tune_tracer.close()
     return 0
 
 

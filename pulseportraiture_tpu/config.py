@@ -528,6 +528,36 @@ harmonic_window_tail = 1e-12
 # None or 0 disables floor awareness (round-4 behavior).
 harmonic_window_floor_sigma = 20.0
 
+# --- Autotune (tune/: the per-backend autotune subsystem) ------------------
+# Straggler-compaction chunk length of the BATCHED template-factory LM
+# fits (fit/gauss.py -> fit/lm.levenberg_marquardt_batched): re-batch
+# the still-iterating problems every this many iterations.  Output is
+# digit-identical for any value (compaction splits the loop at exact
+# iteration boundaries), so this sits in the autotune identity tier.
+# None = one uninterrupted dispatch.  16 was the round-12 hand-tuned
+# winner on TPU v5e; the tuning DB overrides it per backend.
+lm_compact_every = 16
+# Path of the persisted JSON tuning DB (tune/store.TuningStore): the
+# autotune sweep's accepted winners, keyed by (backend fingerprint,
+# shape class).  None (default) = no persistence — every
+# tune.ensure_tuned call re-sweeps.  A DB written on a different
+# backend fingerprint is refused with a loud warning (never applied,
+# never fatal).  Env: PPT_TUNE_DB=<path>|off.  CLI: pptoas/ppserve/
+# pproute --tune-db.
+tune_db = None
+# Whether campaign entry points (pptoas --autotune) run the autotune
+# sweep when the tuning DB has no entry for this backend + shape
+# class.  False (default): tuning is explicit — a campaign never pays
+# sweep time unasked.  Env: PPT_AUTOTUNE=off|on.
+autotune = False
+# Opt-in for the NUMERICS knob tier (tune/autotune.NUMERICS_TIER:
+# cross_spectrum_dtype, dft_precision).  These change output digits —
+# that is their point — so they are NEVER swept silently: False
+# (default) sweeps only the output-identity-preserving tier (and every
+# candidate there is still byte-gated against the default).  Env:
+# PPT_TUNE_NUMERICS=off|on.
+tune_numerics = False
+
 # --- Model evolution codes ------------------------------------------------
 # Per-parameter evolution function code string for .gmodel files:
 # one digit each for (loc, wid, amp); '0' = power law, '1' = linear
@@ -601,6 +631,9 @@ RCSTRINGS = {
 #   PPT_RESULT_CACHE=off|auto|on    -> result_cache
 #   PPT_CACHE_DIR=<dir>|off         -> cache_dir
 #   PPT_CACHE_MAX_MB=<float>        -> cache_max_mb
+#   PPT_TUNE_DB=<path>|off          -> tune_db
+#   PPT_AUTOTUNE=off|on             -> autotune
+#   PPT_TUNE_NUMERICS=off|on        -> tune_numerics
 #
 # Unset variables leave the module values untouched; a typo in a
 # KNOWN variable's value raises (strict like the config parsers — a
@@ -633,6 +666,7 @@ KNOWN_PPT_ENV = frozenset({
     "PPT_SERVE_TENANT_WEIGHT",
     "PPT_RAW_SUBBYTE", "PPT_TRANSPORT_COMPRESS",
     "PPT_RESULT_CACHE", "PPT_CACHE_DIR", "PPT_CACHE_MAX_MB",
+    "PPT_TUNE_DB", "PPT_AUTOTUNE", "PPT_TUNE_NUMERICS",
     # benchmark / smoke-test shape and mode knobs
     "PPT_NB", "PPT_NE", "PPT_NPSR", "PPT_NARCH", "PPT_NSUB",
     "PPT_NSUBB", "PPT_NCHAN", "PPT_NBIN", "PPT_NITER", "PPT_K",
@@ -643,6 +677,7 @@ KNOWN_PPT_ENV = frozenset({
     "PPT_HARMONIC_WINDOW", "PPT_TUNNEL_EMU", "PPT_RETUNE",
     "PPT_ZIPF_S", "PPT_CACHE_SPEEDUP_GATE",
     "PPT_NSEEDS", "PPT_INGEST_P99_GATE",
+    "PPT_TUNE_NRUN", "PPT_SLOW_MS",
 })
 
 def parse_hostport(spec):
@@ -1175,6 +1210,30 @@ def env_overrides():
                 f"PPT_CACHE_MAX_MB must be > 0, got {mb}")
         cfg.cache_max_mb = mb
         changed.append("cache_max_mb")
+    tdb = _os.environ.get("PPT_TUNE_DB", "")
+    if tdb:
+        cfg.tune_db = (None if tdb.lower() in ("off", "none", "0")
+                       else tdb)
+        changed.append("tune_db")
+    atune = _os.environ.get("PPT_AUTOTUNE", "").lower()
+    if atune:
+        table = {"off": False, "false": False, "0": False,
+                 "on": True, "true": True, "1": True}
+        if atune not in table:
+            raise ValueError(
+                f"PPT_AUTOTUNE must be 'off' or 'on', got {atune!r}")
+        cfg.autotune = table[atune]
+        changed.append("autotune")
+    tnum = _os.environ.get("PPT_TUNE_NUMERICS", "").lower()
+    if tnum:
+        table = {"off": False, "false": False, "0": False,
+                 "on": True, "true": True, "1": True}
+        if tnum not in table:
+            raise ValueError(
+                "PPT_TUNE_NUMERICS must be 'off' or 'on', got "
+                f"{tnum!r}")
+        cfg.tune_numerics = table[tnum]
+        changed.append("tune_numerics")
     tel = _os.environ.get("PPT_TELEMETRY", "")
     if tel:
         # 'off'/'none'/'0' disable explicitly (so a wrapper script can

@@ -30,7 +30,8 @@ from ..ops.scattering import (scattering_portrait_FT,
                               scattering_profile_FT,
                               scattering_profile_FT_dtau)
 from ..utils.bunch import DataBunch
-from .lm import levenberg_marquardt, levenberg_marquardt_batched
+from .lm import (COMPACT_EVERY_CONFIG, levenberg_marquardt,
+                 levenberg_marquardt_batched, resolve_compact_every)
 
 __all__ = ["fit_gaussian_profile", "fit_gaussian_portrait",
            "gen_gaussian_profile_flat", "gen_gaussian_portrait_flat",
@@ -54,15 +55,11 @@ def use_gauss_device(setting=None):
         from .. import config
 
         setting = getattr(config, "gauss_device", "auto")
-    if setting is True or setting is False:
-        return setting
-    if setting != "auto":
-        # strict like config's other tri-state knobs — a typo must not
-        # silently mean 'auto'
-        raise ValueError(
-            f"gauss_device must be True, False, or 'auto'; got "
-            f"{setting!r}")
-    return jax.default_backend() == "tpu"
+    from ..tune.capability import resolve_auto
+
+    # strict like config's other tri-state knobs — a typo must not
+    # silently mean 'auto'; resolve_auto enforces it
+    return resolve_auto("gauss_device", setting)
 
 
 def _profile_FT_flat(theta, nbin):
@@ -622,7 +619,7 @@ def profile_vary(ngauss, ngauss_pad, fit_flags=None,
 
 def fit_gaussian_profiles_batched(data, x0s, errs, varys, nbin=None,
                                   max_iter=100, serial=False,
-                                  compact_every=16):
+                                  compact_every=COMPACT_EVERY_CONFIG):
     """Fit B padded profile problems.  data (B, nbin); x0s (B, n) padded
     flat layouts; errs (B,) or (B, nbin); varys (B, n).
 
@@ -657,7 +654,8 @@ def fit_gaussian_profiles_batched(data, x0s, errs, varys, nbin=None,
         # run alone for many chunks, and the narrow-width run programs
         # compile once per process — measured a net win over the
         # engine's recompile-bounding default of 4 (BENCHMARKS r12)
-        compact_every=compact_every, compact_min_rows=1)
+        compact_every=resolve_compact_every(compact_every),
+        compact_min_rows=1)
 
 
 def pad_portrait_params(params, ngauss_pad):
@@ -706,7 +704,8 @@ def portrait_vary(fit_flags, ngauss_pad, fit_scattering_index=False):
 def fit_gaussian_portraits_batched(data, x0s, errs, varys, freqs,
                                    nu_refs, Ps, model_code="000",
                                    nchan_valid=None, max_iter=200,
-                                   serial=False, compact_every=16):
+                                   serial=False,
+                                   compact_every=COMPACT_EVERY_CONFIG):
     """Fit B padded joinless portrait problems (the template factory's
     bucket dispatch).
 
@@ -749,4 +748,5 @@ def fit_gaussian_portraits_batched(data, x0s, errs, varys, freqs,
         lower=lower, upper=upper, vary=np.asarray(varys),
         max_iter=max_iter, nres_valid=nres_valid, jacobian=resid_jac,
         # min_rows=1: see fit_gaussian_profiles_batched
-        compact_every=compact_every, compact_min_rows=1)
+        compact_every=resolve_compact_every(compact_every),
+        compact_min_rows=1)

@@ -463,6 +463,32 @@ def _pow2ceil(n):
     return 1 << max(int(n) - 1, 0).bit_length()
 
 
+# Sentinel for "resolve compact_every from config.lm_compact_every":
+# callers (fit/gauss.py's batched template fits) must keep None
+# meaning "one uninterrupted dispatch", so the config indirection —
+# which the autotune sweep retunes per backend — needs its own token.
+COMPACT_EVERY_CONFIG = "config"
+
+
+def resolve_compact_every(setting):
+    """Resolve a compact_every argument: the COMPACT_EVERY_CONFIG
+    sentinel reads ``config.lm_compact_every`` (PPT-tunable, autotune
+    identity tier); None and positive ints pass through; loud on
+    anything else."""
+    if setting == COMPACT_EVERY_CONFIG:
+        from .. import config
+
+        setting = getattr(config, "lm_compact_every", 16)
+    if setting is None:
+        return None
+    k = int(setting)
+    if k < 1:
+        raise ValueError(
+            f"compact_every must be a positive int or None; got "
+            f"{setting!r}")
+    return k
+
+
 def levenberg_marquardt_batched(resid_fn, x0, aux=(), lower=None,
                                 upper=None, vary=None, max_iter=100,
                                 ftol=1e-10, nres_valid=None,

@@ -186,13 +186,9 @@ def use_fit_fused(setting=None):
     time with the usual already-traced caveat."""
     if setting is None:
         setting = getattr(config, "fit_fused", "auto")
-    if setting is True or setting is False:
-        return setting
-    if setting != "auto":
-        raise ValueError(
-            f"fit_fused must be True, False, or 'auto'; got "
-            f"{setting!r}")
-    return jax.default_backend() == "tpu"
+    from ..tune.capability import resolve_auto
+
+    return resolve_auto("fit_fused", setting)
 
 
 def resolve_fit_fused(nharm_eff):
@@ -1880,7 +1876,12 @@ def use_fast_fit_default():
     setting = getattr(config, "use_fast_fit", "auto")
     if setting is False:
         return False
-    return setting is True or jax.default_backend() == "tpu"
+    if setting is True:
+        return True
+    from ..tune.capability import resolve_auto
+
+    # historically NON-strict: any non-True/False value means 'auto'
+    return resolve_auto("fast_fit", "auto")
 
 
 def reject_fixed_tau_seed(theta0, caller):
@@ -2152,7 +2153,9 @@ def _canonical_real_dtype(x):
     TPU session (jax.default_device pinned to a CPU device), where the
     ops execute on host and c128 is fine: callers like align's batched
     phase-guess rely on keeping f64 there."""
-    if x.dtype != jnp.float64 or jax.default_backend() != "tpu":
+    from ..tune.capability import resolve_auto
+
+    if x.dtype != jnp.float64 or not resolve_auto("device_f32", "auto"):
         return x
     dd = getattr(jax.config, "jax_default_device", None)
     if dd is not None and getattr(dd, "platform", None) == "cpu":

@@ -104,14 +104,10 @@ def use_dft_fold():
     time.  The default is False: folding re-associates the DFT sums, so
     lanes that guarantee bit-stable output (the raw-campaign bucket
     program) keep the direct matmul unless the deployment opts in."""
+    from ..tune.capability import resolve_auto
+
     setting = getattr(config, "dft_fold", False)
-    if setting is True or setting is False:
-        return setting
-    if setting != "auto":
-        raise ValueError(
-            f"config.dft_fold must be True, False, or 'auto'; got "
-            f"{setting!r}")
-    return jax.default_backend() != "tpu"
+    return resolve_auto("dft_fold", setting, label="config.dft_fold")
 
 
 def rfft_mm(x, precision=None, nharm=None, fold=None):
@@ -161,16 +157,13 @@ def use_matmul_dft():
     weights: config.use_matmul_dft (True/False force; 'auto' = TPU
     backends, where XLA's native FFT lowering is ~2000x slower at this
     workload's shapes).  Read at trace time."""
+    from ..tune.capability import resolve_auto
+
     setting = getattr(config, "use_matmul_dft", "auto")
-    if setting is True or setting is False:
-        return setting
-    if setting != "auto":
-        # strict like _default_precision: a typo ('true', 'ture', ...)
-        # must not silently mean 'auto'
-        raise ValueError(
-            f"config.use_matmul_dft must be True, False, or 'auto'; "
-            f"got {setting!r}")
-    return jax.default_backend() == "tpu"
+    # strict like _default_precision: a typo ('true', 'ture', ...)
+    # must not silently mean 'auto' — resolve_auto enforces it
+    return resolve_auto("use_matmul_dft", setting,
+                        label="config.use_matmul_dft")
 
 
 def rfft_c(x, precision=None):

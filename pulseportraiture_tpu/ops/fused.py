@@ -143,11 +143,9 @@ def use_fit_pallas(setting=None):
                 "config.fit_pallas=True but jax.experimental.pallas "
                 f"failed to import: {_PALLAS_IMPORT_ERROR!r}")
         return True
-    if setting != "auto":
-        raise ValueError(
-            f"fit_pallas must be True, False, or 'auto'; got "
-            f"{setting!r}")
-    return HAVE_PALLAS_FUSED and jax.default_backend() == "tpu"
+    from ..tune.capability import resolve_auto
+
+    return HAVE_PALLAS_FUSED and resolve_auto("fit_pallas", setting)
 
 
 def fused_cross_spectrum(port, model, w, nharm, precision=None,
@@ -239,7 +237,9 @@ def _resolve_kernel_opts(nbin, precision, fold, interpret):
         fold = use_dft_fold()
     fold = bool(fold) and nbin % 2 == 0 and nbin >= 8
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from ..tune.capability import resolve_auto
+
+        interpret = resolve_auto("pallas_interpret", "auto")
     return precision, fold, bool(interpret)
 
 

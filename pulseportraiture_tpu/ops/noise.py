@@ -113,12 +113,12 @@ def min_window_baseline(profiles, frac=0.15):
     mask: XLA lowers jnp.cumsum to a scan that costs ~5 s at campaign
     shapes, while the MXU does the O(nbin^2) triangular product in
     ~1 ms."""
-    import jax
+    from ..tune.capability import resolve_auto
 
     p = jnp.asarray(profiles)
     nbin = p.shape[-1]
     w = max(1, int(round(frac * nbin)))
-    if jax.default_backend() == "tpu":
+    if resolve_auto("noise_matmul_cumsum", "auto"):
         iota = jnp.arange(nbin)
         tri = (iota[:, None] <= iota[None, :]).astype(p.dtype)
         cs = jnp.matmul(p, tri, precision="highest")

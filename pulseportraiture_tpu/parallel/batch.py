@@ -308,15 +308,11 @@ def use_align_device(setting=None):
         from .. import config
 
         setting = getattr(config, "align_device", "auto")
-    if setting is True or setting is False:
-        return setting
-    if setting != "auto":
-        # strict like config's other tri-state knobs — a typo must not
-        # silently mean 'auto'
-        raise ValueError(
-            f"align_device must be True, False, or 'auto'; got "
-            f"{setting!r}")
-    return jax.default_backend() == "tpu"
+    from ..tune.capability import resolve_auto
+
+    # strict like config's other tri-state knobs — a typo must not
+    # silently mean 'auto'; resolve_auto enforces it
+    return resolve_auto("align_device", setting)
 
 
 def _align_rotate_real(cube_r, cube_i, delays):

@@ -179,7 +179,9 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
     # the device accumulate runs f32 on TPU (no f64 there; alignment
     # phasors stay accurate via the mod-1 wrap) and f64 elsewhere —
     # a CPU-forced device lane is the host path's digit-exactness peer
-    dev_dt = jnp.float32 if jax.default_backend() == "tpu" \
+    from ..tune.capability import resolve_auto
+
+    dev_dt = jnp.float32 if resolve_auto("device_f32", "auto") \
         else jnp.float64
 
     skip_these = set()

@@ -300,13 +300,14 @@ def bucket_pad_to(nchan):
     exact (tests/test_serve.py guards it)."""
     from .. import config
 
+    from ..tune.capability import resolve_auto
+
     v = getattr(config, "bucket_pad", False)
-    if isinstance(v, str):
-        if v.strip().lower() != "auto":
-            raise ValueError(
-                f"config.bucket_pad must be False, 'auto' or True; "
-                f"got {v!r}")
-        v = jax.default_backend() == "tpu"
+    if isinstance(v, str) and v.strip().lower() != "auto":
+        raise ValueError(
+            f"config.bucket_pad must be False, 'auto' or True; "
+            f"got {v!r}")
+    v = resolve_auto("bucket_pad", v, label="config.bucket_pad")
     if not v or nchan <= 1:
         return int(nchan)
     return 1 << (int(nchan) - 1).bit_length()

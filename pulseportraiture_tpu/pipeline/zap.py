@@ -38,14 +38,9 @@ def resolve_zap_device(device=None):
 
     if device is None:
         device = getattr(config, "zap_device", "auto")
-    if device == "auto":
-        import jax
+    from ..tune.capability import resolve_auto
 
-        return jax.default_backend() == "tpu"
-    if device in (True, False):
-        return bool(device)
-    raise ValueError(
-        f"zap_device must be True, False or 'auto', got {device!r}")
+    return resolve_auto("zap_device", device)
 
 
 def resolve_zap_nstd(nstd=None):

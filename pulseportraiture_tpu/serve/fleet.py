@@ -103,6 +103,13 @@ class FleetMember:
         self.n_requests = 0    # requests ever placed here
         self.n_archives = 0    # archives ever placed here
         self.cached_pending = None  # last stat()['pending_archives']
+        # backend-aware routing signals (ISSUE 19), refreshed by every
+        # successful probe: the host's smoothed measured fit
+        # throughput (None until its first real fit — the router's
+        # cost model then treats it as fleet-fast, i.e. degrades to
+        # least-loaded) and its static capability record
+        self.toas_per_s = None
+        self.capability = None
         self._probe = None
         self._last_probe_t = 0.0
 
@@ -212,8 +219,10 @@ class Fleet:
         log(f"fleet: {member.label} {old or '-'} -> {new} ({reason})",
             quiet=self.quiet, level=level, tracer=None)
 
-    def record_ok(self, member, pending=None):
-        """A probe or submit succeeded: refresh the cached load and
+    def record_ok(self, member, pending=None, toas_per_s=None,
+                  capability=None):
+        """A probe or submit succeeded: refresh the cached load (and
+        the throughput/capability signals a stat probe carries) and
         advance the recovery edges (JOINING/SUSPECT -> HEALTHY, DEAD
         -> REJOINED, REJOINED -> HEALTHY)."""
         with self._lock:
@@ -221,6 +230,10 @@ class Fleet:
                 return  # removed while the probe was in flight
             if pending is not None:
                 member.cached_pending = int(pending)
+            if toas_per_s is not None:
+                member.toas_per_s = float(toas_per_s)
+            if capability is not None:
+                member.capability = capability
             old = member.state
             if old in (JOINING, SUSPECT):
                 member.state = HEALTHY
@@ -277,7 +290,9 @@ class Fleet:
                 except Exception:
                     pass
                 st = fresh.stat()
-            self.record_ok(member, pending=st["pending_archives"])
+            self.record_ok(member, pending=st["pending_archives"],
+                           toas_per_s=st.get("toas_per_s"),
+                           capability=st.get("capability"))
         except Exception as e:
             # one probe EPISODE charges one strike: if the deadline
             # already fed SUSPECT for this probe (_probe_timeout), its

@@ -165,7 +165,10 @@ class ToaRouter:
     payload).  quality_refit: route ONE zap-and-refit of gate-tripping
     archives to the least-loaded HEALTHY host.  fleet_file: watched
     host list (serve/fleet.FleetFileWatcher).  telemetry: trace path
-    or shared Tracer.
+    or shared Tracer.  cost_model: placement cost = archives / each
+    host's measured TOAs/s (True, the default — degrades exactly to
+    least-loaded while throughput is unmeasured); False forces raw
+    least-loaded (the A/B arm).
 
     Thread model: ``submit`` and ``RouteHandle.result`` are safe from
     any thread (one lock guards placement/handle state; probes and
@@ -177,7 +180,7 @@ class ToaRouter:
                  quiet=True, probe_ms=None, hedge_ms=None,
                  write_tim="host", quality_refit=False,
                  fleet_file=None, fleet_poll_s=1.0,
-                 result_cache=None, cache_dir=None):
+                 result_cache=None, cache_dir=None, cost_model=None):
         from .. import config
 
         transports = list(transports)
@@ -197,6 +200,14 @@ class ToaRouter:
             else max(0.0, float(hedge_ms)) / 1e3
         self.write_tim = write_tim
         self.quality_refit = bool(quality_refit)
+        # backend-aware placement cost (ISSUE 19): True (default)
+        # divides each host's load by its measured TOAs/s from the
+        # stat wire, so a heterogeneous fleet stops assigning equal
+        # shares to unequal machines; False is the raw least-loaded
+        # ordering (the A/B arm benchmarks/bench_autotune.py runs).
+        # With no throughput measured anywhere the cost model degrades
+        # EXACTLY to least-loaded, so the default is safe on any fleet.
+        self.cost_model = True if cost_model is None else bool(cost_model)
         self.quiet = quiet
         self.tracer, self._own_tracer = resolve_tracer(telemetry,
                                                        run="pproute")
@@ -268,33 +279,58 @@ class ToaRouter:
     # placement
     # ------------------------------------------------------------------
 
+    def _costs(self, loads):
+        """Placement costs from raw archive loads (ISSUE 19): cost =
+        load / relative host speed, where speed is the host's measured
+        TOAs/s normalized by the fastest measured member (so a host
+        half as fast carries twice the cost per queued archive).
+        Hosts with no measurement yet — cold, or pre-ISSUE-19 peers —
+        count as fleet-fast, and with NO measurement anywhere (or
+        cost_model off) the costs ARE the loads: exact least-loaded
+        degradation.  Returns (costs, speeds); speeds convert an
+        archive count into cost units (the affinity-yield
+        threshold)."""
+        speeds = {m: 1.0 for m in loads}
+        if self.cost_model:
+            rates = {m: m.toas_per_s for m in loads
+                     if m.toas_per_s is not None and m.toas_per_s > 0}
+            if rates:
+                top = max(rates.values())
+                for m, r in rates.items():
+                    speeds[m] = max(r / top, 1e-6)
+        costs = {m: loads[m] / speeds[m] for m in loads}
+        return costs, speeds
+
     def _rank(self, modelfile, n_archives, excluded=frozenset(),
               use_affinity=True):
         """Placeable hosts to try, best first: the affinity host for
         this template leads while placing there would not leave it
-        strictly more loaded than the least-loaded alternative; then
-        least-loaded order.  use_affinity=False ranks purely by load
-        (failover replacements and routed refits must move OFF the
-        original lane, not stick to it).  Loads come from the fleet's
-        BOUNDED probe pass (cached while a probe is outstanding) so a
-        hung host can never stall a placement; the lock guards only
-        the affinity read."""
+        strictly more costly than the cheapest alternative; then
+        cheapest-cost order (cost = load / measured relative speed —
+        raw least-loaded when the cost model is off or unmeasured).
+        use_affinity=False ranks purely by cost (failover replacements
+        and routed refits must move OFF the original lane, not stick
+        to it).  Loads come from the fleet's BOUNDED probe pass
+        (cached while a probe is outstanding) so a hung host can never
+        stall a placement; the lock guards only the affinity read."""
         loads = self.fleet.probe_all()
         loads = {m: v for m, v in loads.items()
                  if m.label not in excluded}
         if not loads:
             return [], False
-        by_load = sorted(loads, key=lambda m: (loads[m], m.index))
+        costs, speeds = self._costs(loads)
+        by_cost = sorted(costs, key=lambda m: (costs[m], m.index))
         if not use_affinity:
-            return by_load, False
+            return by_cost, False
         with self._lock:
             aff = self._affinity.get(modelfile)
-        if aff is not None and aff in loads and by_load[0] is not aff \
-                and loads[aff] - loads[by_load[0]] < n_archives:
-            by_load.remove(aff)
-            by_load.insert(0, aff)
-            return by_load, True
-        return by_load, aff is not None and by_load[0] is aff
+        if aff is not None and aff in costs and by_cost[0] is not aff \
+                and costs[aff] - costs[by_cost[0]] \
+                < n_archives / speeds[aff]:
+            by_cost.remove(aff)
+            by_cost.insert(0, aff)
+            return by_cost, True
+        return by_cost, aff is not None and by_cost[0] is aff
 
     def _place(self, datafiles, modelfile, tim_out, name, options,
                tenant, excluded=frozenset(), attempt0=0,
@@ -673,8 +709,9 @@ class ToaRouter:
             rh._hedged = True   # one hedge per request, even on failure
             primary = rh.attempts[0][0]
         loads = self.fleet.probe_all()
-        cands = [m for m in sorted(loads,
-                                   key=lambda m: (loads[m], m.index))
+        costs, _speeds = self._costs(loads)
+        cands = [m for m in sorted(costs,
+                                   key=lambda m: (costs[m], m.index))
                  if m is not primary and m.label not in rh.excluded]
         if not cands:
             return
@@ -888,8 +925,9 @@ class ToaRouter:
         # least-loaded HEALTHY placement, affinity OFF — the re-place-
         # off-the-original-lane rule this satellite exists for
         loads = self.fleet.probe_all()
-        healthy = [m for m in sorted(loads,
-                                     key=lambda m: (loads[m], m.index))
+        costs, _speeds = self._costs(loads)
+        healthy = [m for m in sorted(costs,
+                                     key=lambda m: (costs[m], m.index))
                    if m.state == HEALTHY]
         if not healthy:
             log(f"routed refit of {rh.name!r}: no HEALTHY host to "
@@ -976,7 +1014,8 @@ class ToaRouter:
             return {m.label: {"outstanding": m.outstanding,
                               "n_requests": m.n_requests,
                               "n_archives": m.n_archives,
-                              "state": m.state}
+                              "state": m.state,
+                              "toas_per_s": m.toas_per_s}
                     for m in self.fleet.members()}
 
     def close(self):

@@ -151,6 +151,12 @@ class ToaServer:
                                           mode=result_cache)
         self._cache_hits = 0
         self._cache_bytes = 0
+        # smoothed measured fit throughput (TOAs/s) over completed
+        # requests — the backend-aware routing signal (ISSUE 19):
+        # an EMA so one odd request can't whipsaw placement, and
+        # None until the first real fit (cache hits never count —
+        # they say nothing about this host's compute speed)
+        self._toa_rate = None
         # multi-tenant QoS (ISSUE 13): per-tenant weighted-fair lanes
         # + quotas; None reads config.serve_tenant_quota/_weight
         self.queue = AdmissionQueue(queue_depth,
@@ -268,6 +274,8 @@ class ToaServer:
         request count, n_live the admitted-but-unresolved requests.
         This is the signal the cross-host router's least-loaded
         placement and the transport ``stat`` op read."""
+        from ..tune.capability import capability_summary
+
         return {"pending_archives": self.queue.pending_archives,
                 "queue_len": len(self.queue),
                 "n_live": len(self._live),
@@ -275,7 +283,13 @@ class ToaServer:
                 # so it rides OUTSIDE the load signal above — a
                 # hit-heavy host must not look busy to the router
                 "cache_hits": self._cache_hits,
-                "cache_bytes": self._cache_bytes}
+                "cache_bytes": self._cache_bytes,
+                # backend-aware routing signals (ISSUE 19): the host's
+                # capability record (static fields only — a stat
+                # handler must not pay probe latency) and the smoothed
+                # measured TOAs/s the router's cost model divides by
+                "toas_per_s": self._toa_rate,
+                "capability": capability_summary()}
 
     def start(self):
         """Run the optional AOT warmup, then start the serving thread.
@@ -726,6 +740,21 @@ class ToaServer:
         req._error = error
         req.t_done = time.monotonic()
         self._live.pop(id(req), None)
+        if (result is not None and error is None
+                and not getattr(req, "_cache_hit", False)
+                and result.TOA_list):
+            # measured-throughput EMA (the stat wire's toas_per_s):
+            # admission->done wall of a REAL fit; alpha 0.3 smooths
+            # over bucket-shape variance without going stale
+            t_adm = req.t_admit if req.t_admit is not None \
+                else req.t_submit
+            wall = req.t_done - (t_adm if t_adm is not None
+                                 else req.t_done)
+            if wall > 0:
+                rate = len(result.TOA_list) / wall
+                self._toa_rate = (rate if self._toa_rate is None
+                                  else 0.7 * self._toa_rate
+                                  + 0.3 * rate)
         if self.tracer.enabled:
             t_sub = req.t_submit if req.t_submit is not None \
                 else req.t_done

@@ -404,6 +404,10 @@ class SocketTransport:
         # router can probe a pre-cache host without tripping
         for k in ("cache_hits", "cache_bytes"):
             out[k] = reply.get(k, 0)
+        # backend-aware routing signals (ISSUE 19): None-default so a
+        # newer router degrades to least-loaded against an older host
+        for k in ("toas_per_s", "capability"):
+            out[k] = reply.get(k)
         return out
 
     def drain(self, timeout=None):

@@ -72,6 +72,7 @@ CONFIG_SNAPSHOT_KEYS = (
     "result_cache", "cache_dir", "cache_max_mb",
     "ingest_poll_ms", "ingest_stable_ms",
     "alert_cusum_k", "alert_cusum_h", "gls_resolve_every",
+    "tune_db", "autotune", "tune_numerics", "lm_compact_every",
 )
 
 # The event vocabulary: type -> fields REQUIRED beyond (type, t).
@@ -218,6 +219,21 @@ EVENT_FIELDS = {
     "ingest_admit": {"datafile", "source", "wait_s"},
     "ingest_skip": {"datafile", "source", "reason"},
     "alert": {"kind", "pulsar", "mjd", "score", "threshold"},
+    # the per-backend autotune subsystem (tune/, ISSUE 19): tune_probe
+    # = one capability-record derivation (the backend fingerprint plus
+    # the measured dispatch-floor/throughput probes); tune_sweep = one
+    # knob swept (n_rejected counts candidates the byte-identity gate
+    # refused before timing; winner == default means no candidate
+    # beat it); tune_apply = one knob-set application with the DB-hit
+    # witness — db_hit=true is the zero-re-sweep proof the "tuning"
+    # report section and bench_autotune.py gate on.
+    "tune_probe": {"backend", "device_kind", "fingerprint",
+                   "dispatch_floor_s", "matmul_gflops", "dft_gflops"},
+    "tune_sweep": {"shape_class", "knob", "default", "winner",
+                   "n_candidates", "n_rejected", "default_s",
+                   "best_s"},
+    "tune_apply": {"shape_class", "db_hit", "db_path", "knobs",
+                   "default_s", "tuned_s"},
     "counters": {"counters", "gauges"},
 }
 
@@ -1202,6 +1218,46 @@ def report(path, file=None):
             p(f"  incremental GLS: {incremental_resolves} full "
               "resolve(s) against the batch oracle")
 
+    # ---- tuning (tune/, ISSUE 19) -----------------------------------
+    t_probe = by_type.get("tune_probe", [])
+    t_sweep = by_type.get("tune_sweep", [])
+    t_apply = by_type.get("tune_apply", [])
+    tune_db_hits = sum(1 for ev in t_apply if ev.get("db_hit"))
+    tune_db_misses = len(t_apply) - tune_db_hits
+    if t_probe or t_sweep or t_apply:
+        p("")
+        p("-- tuning --")
+        if t_probe:
+            ev = t_probe[-1]
+            gf = ev.get("matmul_gflops")
+            floor = ev.get("dispatch_floor_s")
+            p(f"  backend {ev['fingerprint']}"
+              + (f"  dispatch floor {floor * 1e6:.1f} us"
+                 if floor else "")
+              + (f"  matmul {gf:.1f} GFLOP/s" if gf else ""))
+        for ev in t_sweep:
+            margin = None
+            if ev.get("default_s") and ev.get("best_s") is not None:
+                margin = (float(ev["default_s"]) - float(ev["best_s"])) \
+                    / float(ev["default_s"])
+            p(f"  sweep [{ev['shape_class']}] {ev['knob']}: "
+              f"{ev['n_candidates']} candidate(s), "
+              f"{ev['n_rejected']} identity-rejected; winner "
+              f"{ev['winner']} (default {ev['default']})"
+              + (f"  margin {margin * 100:.1f}%"
+                 if margin is not None else ""))
+        for ev in t_apply:
+            knobs = ev.get("knobs") or {}
+            detail = ", ".join(f"{k}={v}"
+                               for k, v in sorted(knobs.items())) \
+                or "defaults"
+            p(f"  apply [{ev['shape_class']}] "
+              f"{'DB HIT' if ev.get('db_hit') else 'swept'}: {detail}")
+        if t_apply:
+            p(f"  tuning DB: {tune_db_hits} hit(s), "
+              f"{tune_db_misses} miss(es) "
+              f"({'zero re-sweeps' if t_apply and not t_sweep else f'{len(t_sweep)} knob sweep(s) paid'})")
+
     skips = by_type.get("archive_skip", [])
     if skips:
         p("")
@@ -1284,6 +1340,11 @@ def report(path, file=None):
         "n_alert": len(alerts),
         "alert_fp_rate": alert_fp_rate,
         "incremental_resolves": incremental_resolves,
+        "n_tune_probe": len(t_probe),
+        "n_tune_sweep": len(t_sweep),
+        "n_tune_apply": len(t_apply),
+        "tune_db_hits": tune_db_hits,
+        "tune_db_misses": tune_db_misses,
         "counters": counters,
         "gauges": gauges,
     }

@@ -61,14 +61,9 @@ def resolve_gls_device(device=None):
 
     if device is None:
         device = getattr(config, "gls_device", "auto")
-    if device == "auto":
-        import jax
+    from ..tune.capability import resolve_auto
 
-        return jax.default_backend() == "tpu"
-    if device in (True, False):
-        return bool(device)
-    raise ValueError(
-        f"gls_device must be True, False or 'auto', got {device!r}")
+    return resolve_auto("gls_device", device)
 
 
 class TimingJob:

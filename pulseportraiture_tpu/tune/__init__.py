@@ -1,0 +1,32 @@
+"""Per-backend autotune subsystem (ISSUE 19; ROADMAP item 5b).
+
+Three layers:
+
+- :mod:`~.capability` — the backend capability table + the single
+  table-driven resolver every ``'auto'`` tri-state in config.py
+  resolves through (no more scattered ``== "tpu"`` spellings).
+- :mod:`~.store` — the persisted JSON tuning DB keyed (backend
+  fingerprint, shape class): loud on corruption/staleness, atomic on
+  write, zero re-sweeps on a warm hit.
+- :mod:`~.autotune` — the reusable sweep harness (identity-preserving
+  tier by default, numerics tier behind an explicit opt-in, min-of-N
+  timing, per-candidate byte-identity gate, combined no-regression
+  gate).
+"""
+
+from .autotune import (IDENTITY_TIER, NUMERICS_TIER, Knob, SweepResult,
+                       apply_from_db, apply_knobs, ensure_tuned,
+                       shape_class_for, sweep, tuned_config)
+from .capability import (KNOB_POLARITY, CapabilityRecord,
+                         backend_fingerprint, capability_record,
+                         capability_summary, resolve_auto)
+from .store import SCHEMA_VERSION, TuningStore
+
+__all__ = [
+    "KNOB_POLARITY", "CapabilityRecord", "backend_fingerprint",
+    "capability_record", "capability_summary", "resolve_auto",
+    "SCHEMA_VERSION", "TuningStore",
+    "IDENTITY_TIER", "NUMERICS_TIER", "Knob", "SweepResult",
+    "apply_from_db", "apply_knobs", "ensure_tuned", "shape_class_for",
+    "sweep", "tuned_config",
+]

@@ -1,0 +1,287 @@
+"""Reusable per-backend autotune harness (ISSUE 19 tentpole, layer 2).
+
+The round-12 ``PPT_RETUNE`` sweep and the round-9 pipeline-depth A/B
+were one-off hand-run scripts: time the default, time each candidate,
+eyeball the table, hard-code the winner.  This module generalizes
+them into a harness any campaign (or bench, or CLI) can call:
+
+- **Knob tiers.**  :data:`IDENTITY_TIER` holds ONLY knobs whose every
+  value is documented output-identity-preserving (fused block size,
+  bucket pad, pipeline depth, LM ``compact_every``, harmonic-window
+  K) — and the harness does not trust the documentation: every
+  candidate's artifact (.tim bytes / digest — whatever ``run_fn``
+  returns) is gated byte-identical against the default before its
+  timing is even considered.  :data:`NUMERICS_TIER` (dtype choices)
+  is swept ONLY behind the explicit ``numerics=True`` opt-in and is
+  exempt from the byte gate — changing digits is its point, and it
+  must never happen silently.
+- **Min-of-N timing** in the spirit of profiling.devtime: each
+  candidate is timed ``nrun`` times and the minimum wall is compared;
+  ``time_fn`` is injectable (the test stub pattern profiling's
+  ``devtime_fn`` established) so tests sweep without a clock.
+- **Per-knob independent sweep + combined no-regression gate**: each
+  knob is swept against the default config alone; the combined
+  winner set is then re-validated (bytes + wall) against the default
+  and FALLS BACK to defaults if it regresses — ``tuned_s <=
+  default_s`` holds by construction in every result this harness
+  returns.
+- **Persistence**: winners land in the JSON tuning DB
+  (tune/store.TuningStore) keyed (backend fingerprint, shape class);
+  :func:`ensure_tuned` on a warm DB applies the stored knobs and
+  pays ZERO re-sweeps — the trace witnesses it as a ``tune_apply``
+  event with ``db_hit=true`` and no ``tune_sweep`` events.
+"""
+
+import contextlib
+import time
+from typing import NamedTuple
+
+from ..telemetry import NULL_TRACER
+from .capability import capability_record
+from .store import TuningStore
+
+__all__ = ["Knob", "IDENTITY_TIER", "NUMERICS_TIER", "SweepResult",
+           "tuned_config", "shape_class_for", "sweep", "ensure_tuned",
+           "apply_knobs", "apply_from_db"]
+
+
+class Knob(NamedTuple):
+    """One sweepable knob: ``name`` is both the config.py attribute
+    and the tuning-DB key; ``candidates`` are the values to try
+    beyond whatever the current config default is (the default is
+    always in the comparison set — that is what makes the
+    no-regression gate deterministic)."""
+
+    name: str
+    candidates: tuple
+
+
+# Output-identity-preserving tier: every candidate value of every knob
+# here is documented byte-identical (and the sweep enforces it anyway).
+IDENTITY_TIER = (
+    Knob("fused_block", (None, 8, 16, 32)),
+    Knob("bucket_pad", (False, True)),
+    Knob("stream_pipeline_depth", (1, 2, 4)),
+    Knob("lm_compact_every", (None, 8, 16, 32)),
+    Knob("fit_harmonic_window", ("auto", None)),
+)
+
+# Numerics tier: value choices that CHANGE DIGITS.  Only swept behind
+# the explicit numerics=True / config.tune_numerics opt-in; winners
+# are recorded with identity_preserving=False in the DB meta.
+NUMERICS_TIER = (
+    Knob("cross_spectrum_dtype", ("bfloat16", None)),
+    Knob("dft_precision", ("highest", "default")),
+)
+
+
+class SweepResult(NamedTuple):
+    knobs: dict        # accepted winners (attr -> value); {} = defaults
+    default_s: float   # min-of-N wall of the default config
+    tuned_s: float     # min-of-N wall of the accepted set (<= default_s)
+    n_swept: int       # candidates actually timed
+    n_rejected: int    # candidates refused by the identity gate
+
+
+@contextlib.contextmanager
+def tuned_config(overrides):
+    """Apply ``overrides`` (config attr -> value) for the duration of
+    the block and restore the previous values after — the sweep's
+    candidate-isolation primitive (also what tests use to fake a
+    tuned process)."""
+    from .. import config
+
+    saved = {k: getattr(config, k) for k in overrides}
+    try:
+        for k, v in overrides.items():
+            setattr(config, k, v)
+        yield
+    finally:
+        for k, v in saved.items():
+            setattr(config, k, v)
+
+
+def apply_knobs(knobs):
+    """Set accepted winners on config (persistently for this process
+    — the campaign-startup path, unlike the scoped tuned_config)."""
+    from .. import config
+
+    for k, v in knobs.items():
+        setattr(config, k, v)
+
+
+def shape_class_for(nchan, nbin):
+    """Canonical tuning-DB shape-class key for a bucket layout."""
+    return f"{int(nchan)}x{int(nbin)}"
+
+
+def _default_time_fn(run_fn, nrun):
+    def time_fn(overrides):
+        best = None
+        for _ in range(max(1, int(nrun))):
+            t0 = time.perf_counter()
+            run_fn(overrides)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+    return time_fn
+
+
+def sweep(run_fn, knobs=None, time_fn=None, nrun=3, numerics=False,
+          tracer=NULL_TRACER, shape_class="default"):
+    """One full sweep against the CURRENT config defaults.
+
+    ``run_fn(overrides)`` executes the representative workload under
+    the candidate overrides and returns its identity artifact (.tim
+    bytes or any stable digest).  ``time_fn(overrides)`` returns the
+    candidate's wall seconds (default: min-of-``nrun`` walls of
+    ``run_fn`` itself).  Each knob sweeps independently; identity-tier
+    candidates whose artifact differs from the default's are REJECTED
+    before timing; the combined winner set is re-validated and falls
+    back to defaults on any regression.  Emits one ``tune_sweep``
+    event per knob."""
+    from .. import config
+
+    if knobs is None:
+        knobs = IDENTITY_TIER + (NUMERICS_TIER if numerics else ())
+    if time_fn is None:
+        time_fn = _default_time_fn(run_fn, nrun)
+    identity_names = {k.name for k in IDENTITY_TIER}
+    baseline = run_fn({})
+    default_s = float(time_fn({}))
+    winners = {}
+    n_swept = n_rejected = 0
+    for knob in knobs:
+        default_val = getattr(config, knob.name)
+        best_val, best_s = default_val, default_s
+        rejected = []
+        for cand in knob.candidates:
+            if cand == default_val:
+                continue
+            ov = {knob.name: cand}
+            gate = knob.name in identity_names or not numerics
+            if gate and run_fn(ov) != baseline:
+                # identity gate: a knob value that changes bytes is
+                # out of the running no matter how fast it measures
+                rejected.append(cand)
+                n_rejected += 1
+                continue
+            t = float(time_fn(ov))
+            n_swept += 1
+            if t < best_s:
+                best_val, best_s = cand, t
+        if tracer.enabled:
+            tracer.emit(
+                "tune_sweep", shape_class=str(shape_class),
+                knob=knob.name, default=repr(default_val),
+                winner=repr(best_val),
+                n_candidates=len(knob.candidates),
+                n_rejected=len(rejected),
+                default_s=round(default_s, 6), best_s=round(best_s, 6))
+        if best_val != default_val:
+            winners[knob.name] = best_val
+    tuned_s = default_s
+    if winners:
+        with tuned_config(winners):
+            combined_ok = run_fn({}) == baseline
+            t_comb = float(time_fn({})) if combined_ok else None
+        if not combined_ok or t_comb > default_s:
+            # no-regression gate: the combination must beat what it
+            # replaced, byte-for-byte and on the clock, or we ship
+            # the defaults — a tuned campaign is never slower
+            winners = {}
+        else:
+            tuned_s = t_comb
+    return SweepResult(knobs=winners, default_s=default_s,
+                       tuned_s=tuned_s, n_swept=n_swept,
+                       n_rejected=n_rejected)
+
+
+def ensure_tuned(run_fn, shape_class, db_path=None, knobs=None,
+                 time_fn=None, nrun=3, numerics=None,
+                 tracer=NULL_TRACER, apply=True):
+    """The campaign entry point: return (and by default apply) the
+    winning knobs for this backend + shape class, sweeping ONLY when
+    the tuning DB has no entry.
+
+    ``db_path`` None falls back to ``config.tune_db``; with no DB path
+    at all the sweep runs unpersisted.  ``numerics`` None follows
+    ``config.tune_numerics``.  Emits ``tune_probe`` (the capability
+    record) and ``tune_apply`` (with the DB-hit witness) either way."""
+    from .. import config
+
+    if db_path is None:
+        db_path = getattr(config, "tune_db", None)
+    if numerics is None:
+        numerics = bool(getattr(config, "tune_numerics", False))
+    if tracer.enabled:
+        rec = capability_record()
+        tracer.emit("tune_probe", backend=rec.platform,
+                    device_kind=rec.device_kind,
+                    fingerprint=rec.fingerprint,
+                    dispatch_floor_s=rec.dispatch_floor_s,
+                    matmul_gflops=rec.matmul_gflops,
+                    dft_gflops=rec.dft_gflops)
+    store = TuningStore(db_path) if db_path else None
+    ent = store.get(shape_class) if store else None
+    if ent is not None:
+        winners = dict(ent["knobs"])
+        if tracer.enabled:
+            tracer.emit("tune_apply", shape_class=str(shape_class),
+                        db_hit=True, db_path=str(db_path),
+                        knobs={k: repr(v) for k, v in winners.items()},
+                        default_s=ent.get("default_s"),
+                        tuned_s=ent.get("tuned_s"))
+        if apply:
+            apply_knobs(winners)
+        return winners
+    res = sweep(run_fn, knobs=knobs, time_fn=time_fn, nrun=nrun,
+                numerics=numerics, tracer=tracer,
+                shape_class=shape_class)
+    if store is not None:
+        store.put(shape_class, res.knobs,
+                  default_s=res.default_s, tuned_s=res.tuned_s,
+                  n_swept=res.n_swept,
+                  identity_preserving=not numerics)
+    if tracer.enabled:
+        tracer.emit("tune_apply", shape_class=str(shape_class),
+                    db_hit=False,
+                    db_path=str(db_path) if db_path else None,
+                    knobs={k: repr(v) for k, v in res.knobs.items()},
+                    default_s=round(res.default_s, 6),
+                    tuned_s=round(res.tuned_s, 6))
+    if apply:
+        apply_knobs(res.knobs)
+    return res.knobs
+
+
+def apply_from_db(shape_class=None, db_path=None, tracer=NULL_TRACER):
+    """Apply persisted winners WITHOUT the ability to sweep (the CLI
+    cold path, e.g. ``ppserve --tune-db``): load the DB, pick
+    ``shape_class`` (or the sole stored class when None), apply, and
+    witness the hit.  Returns the applied knobs ({} when the DB has
+    nothing for this backend — loudly warned by the store)."""
+    from .. import config
+
+    if db_path is None:
+        db_path = getattr(config, "tune_db", None)
+    if not db_path:
+        return {}
+    store = TuningStore(db_path)
+    classes = store.shape_classes()
+    if shape_class is None:
+        if len(classes) != 1:
+            return {}
+        shape_class = classes[0]
+    ent = store.get(shape_class)
+    if ent is None:
+        return {}
+    winners = dict(ent["knobs"])
+    if tracer.enabled:
+        tracer.emit("tune_apply", shape_class=str(shape_class),
+                    db_hit=True, db_path=str(db_path),
+                    knobs={k: repr(v) for k, v in winners.items()},
+                    default_s=ent.get("default_s"),
+                    tuned_s=ent.get("tuned_s"))
+    apply_knobs(winners)
+    return winners

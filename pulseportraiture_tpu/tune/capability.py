@@ -1,0 +1,240 @@
+"""Per-backend capability table + the ONE tri-state resolver
+(ISSUE 19 tentpole, layer 1; ROADMAP item 5b).
+
+Before this module every performance tri-state in config.py —
+``fit_fused``, ``fit_pallas``, ``bucket_pad``, the ``*_device`` knobs,
+``dft_fold``, ``use_matmul_dft`` — resolved its ``'auto'`` arm with a
+private ``jax.default_backend() == "tpu"`` spelling, scattered across
+nine modules.  One rule, nine drifting copies.  This module collapses
+them:
+
+- :func:`resolve_auto` is the single resolution point for every
+  ``'auto'`` tri-state.  Each knob declares its *polarity* in
+  :data:`KNOB_POLARITY` (``'tpu'``: 'auto' engages the fast arm on
+  TPU backends; ``'not_tpu'``: inverted — e.g. ``dft_fold``, whose
+  fold trick pays only where the matmul DFT does NOT).  A source-scan
+  test (tests/test_tune.py) asserts no ``== "tpu"`` spelling survives
+  outside this package, so the rule cannot drift again.
+
+- :func:`capability_record` derives a per-backend
+  :class:`CapabilityRecord` once per process from the live
+  ``jax.devices()``: platform, device kind, Pallas availability,
+  preferred cross-spectrum dtype, sub-byte unpack support, plus
+  cheap *measured* probes (dispatch floor, tiny matmul/DFT
+  throughput).  The record is keyed by :func:`backend_fingerprint`
+  (platform + device kind + jax version) — the same key the tuning
+  DB (tune/store.py) uses, so persisted winners are never applied to
+  a different backend than the one that measured them.
+
+Import discipline: this module imports ONLY jax + stdlib.  config.py,
+ops/*, fit/* all call into here, so importing any of them back would
+cycle.  ``jax.default_backend()`` is read LIVE on every
+:func:`resolve_auto` call (never cached): tests monkeypatch it on the
+shared jax module object to exercise both polarities from a CPU host.
+"""
+
+import threading
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["KNOB_POLARITY", "CapabilityRecord", "backend_fingerprint",
+           "capability_record", "capability_summary", "resolve_auto"]
+
+# knob -> polarity of its 'auto' arm.  'tpu': auto means ON for TPU
+# backends; 'not_tpu': auto means ON everywhere EXCEPT TPU.  Every
+# tri-state in config.py appears here; adding a knob without a row is
+# a loud KeyError at its first 'auto' resolution, not silent drift.
+KNOB_POLARITY = {
+    # fit engine lanes
+    "fit_fused": "tpu",         # fused DFT->cross-spectrum program
+    "fit_pallas": "tpu",        # Pallas kernels (compiled lane)
+    "fast_fit": "tpu",          # device-resident fast fit default
+    "use_matmul_dft": "tpu",    # matmul DFT vs jnp.fft
+    "dft_fold": "not_tpu",      # fold trick pays where matmul DFT off
+    # device-vs-host stage placement
+    "gauss_device": "tpu",
+    "align_device": "tpu",
+    "gls_device": "tpu",
+    "zap_device": "tpu",
+    # pipeline layout / kernel mode
+    "bucket_pad": "tpu",        # pow2 bucket lattice coarsening
+    "pallas_interpret": "not_tpu",  # interpret-mode Pallas off-TPU
+    "device_f32": "tpu",        # preferred on-device real dtype lane
+    "noise_matmul_cumsum": "tpu",   # triangular-matmul cumsum spelling
+}
+
+
+class CapabilityRecord(NamedTuple):
+    """What one backend can do + what it measures (one per process).
+
+    The static fields come from the device table; the ``*_s`` /
+    ``*_gflops`` fields are tiny live probes (a handful of dispatches,
+    ~ms total) and are None until :func:`capability_record` is called
+    with ``probe=True`` (the default) — callers that only need the
+    static table (e.g. the serve stat wire) pass ``probe=False``
+    on the first call to skip them entirely."""
+
+    fingerprint: str
+    platform: str           # jax.default_backend(): 'cpu'/'gpu'/'tpu'
+    device_kind: str        # jax.devices()[0].device_kind
+    n_devices: int
+    pallas_available: bool  # jax.experimental.pallas importable
+    preferred_cross_dtype: str   # cross-spectrum accumulation dtype
+    subbyte_unpack: bool    # native sub-byte (int4) unpack lanes
+    dispatch_floor_s: Optional[float]   # measured per-dispatch floor
+    matmul_gflops: Optional[float]      # tiny f32 matmul probe
+    dft_gflops: Optional[float]         # tiny rfft probe
+
+    def wire_summary(self):
+        """The JSON-safe subset a serving host reports over the
+        ``stat`` wire op (serve/server.stats)."""
+        return {"fingerprint": self.fingerprint,
+                "platform": self.platform,
+                "device_kind": self.device_kind,
+                "n_devices": self.n_devices,
+                "pallas_available": self.pallas_available,
+                "matmul_gflops": self.matmul_gflops}
+
+
+def backend_fingerprint():
+    """Stable identity of THIS process's backend: platform + device
+    kind + jax version.  The tuning DB key — winners measured on one
+    fingerprint are refused (loudly) on any other."""
+    devs = jax.devices()
+    kind = devs[0].device_kind if devs else "none"
+    return f"{jax.default_backend()}:{kind}:jax-{jax.__version__}"
+
+
+def _pallas_available():
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+def _probe_dispatch_floor(nrun=3, K=4):
+    """Min-of-N slope of a trivial dispatch — the per-dispatch floor
+    in seconds (profiling.devtime's estimator, inlined to keep this
+    module free of package imports)."""
+    import time
+
+    x = jnp.zeros((8,), jnp.float32)
+    f = jax.jit(lambda v: v + 1.0)
+    f(x).block_until_ready()  # compile outside the clock
+    best = None
+    for _ in range(nrun):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        y = x
+        for _ in range(K):
+            y = f(y)
+        y.block_until_ready()
+        tK = time.perf_counter() - t0
+        slope = (tK - t1) / (K - 1)
+        if slope <= 0.0:
+            slope = tK / K
+        best = slope if best is None else min(best, slope)
+    return best
+
+
+def _probe_matmul_gflops(n=256, nrun=3):
+    import time
+
+    a = jnp.ones((n, n), jnp.float32)
+    f = jax.jit(lambda m: m @ m)
+    f(a).block_until_ready()
+    best = None
+    for _ in range(nrun):
+        t0 = time.perf_counter()
+        f(a).block_until_ready()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return (2.0 * n ** 3 / max(best, 1e-9)) / 1e9
+
+
+def _probe_dft_gflops(nchan=64, nbin=512, nrun=3):
+    import time
+
+    x = jnp.ones((nchan, nbin), jnp.float32)
+    f = jax.jit(lambda v: jnp.fft.rfft(v, axis=-1))
+    f(x).block_until_ready()
+    best = None
+    for _ in range(nrun):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    import math
+
+    flops = 5.0 * nchan * nbin * math.log2(max(nbin, 2))
+    return (flops / max(best, 1e-9)) / 1e9
+
+
+_cache_lock = threading.Lock()
+_cached = {}   # fingerprint -> CapabilityRecord
+
+
+def capability_record(probe=True):
+    """The process-wide :class:`CapabilityRecord` for the live
+    backend, derived once per fingerprint and cached.  ``probe=False``
+    skips the timing probes on a cold cache (fields stay None); a
+    later ``probe=True`` call upgrades the cached record in place."""
+    fp = backend_fingerprint()
+    with _cache_lock:
+        rec = _cached.get(fp)
+    if rec is not None and (rec.dispatch_floor_s is not None
+                            or not probe):
+        return rec
+    platform = jax.default_backend()
+    devs = jax.devices()
+    kind = devs[0].device_kind if devs else "none"
+    rec = CapabilityRecord(
+        fingerprint=fp,
+        platform=platform,
+        device_kind=kind,
+        n_devices=len(devs),
+        pallas_available=_pallas_available(),
+        # TPU MXUs accumulate the cross-spectrum fastest in f32
+        # (complex64); wide hosts keep the f64 reference spelling
+        preferred_cross_dtype=("complex64" if platform == "tpu"
+                               else "complex128"),
+        subbyte_unpack=platform == "tpu",
+        dispatch_floor_s=_probe_dispatch_floor() if probe else None,
+        matmul_gflops=_probe_matmul_gflops() if probe else None,
+        dft_gflops=_probe_dft_gflops() if probe else None,
+    )
+    with _cache_lock:
+        _cached[fp] = rec
+    return rec
+
+
+def capability_summary():
+    """JSON-safe record summary for the stat wire (static fields only
+    on first call — the serving loop must not pay probe latency in a
+    stat handler)."""
+    return capability_record(probe=False).wire_summary()
+
+
+def resolve_auto(knob, setting, label=None):
+    """THE tri-state resolver: ``True``/``False`` pass through,
+    ``'auto'`` (string, case/space-insensitive) resolves through
+    :data:`KNOB_POLARITY`, anything else raises the knob's strict
+    ValueError (``label`` overrides the knob name in the message so
+    call sites keep their historical spellings, e.g.
+    ``config.dft_fold``)."""
+    if setting is True or setting is False:
+        return setting
+    is_auto = setting == "auto" or (
+        isinstance(setting, str) and setting.strip().lower() == "auto")
+    if not is_auto:
+        raise ValueError(
+            f"{label or knob} must be True, False, or 'auto'; got "
+            f"{setting!r}")
+    polarity = KNOB_POLARITY[knob]
+    on_tpu = jax.default_backend() == "tpu"
+    return on_tpu if polarity == "tpu" else not on_tpu

@@ -1,0 +1,117 @@
+"""Persisted tuning database (ISSUE 19 tentpole, layer 2b).
+
+One JSON file holds the autotuner's accepted winners, keyed by
+(backend fingerprint, shape class).  The contract the autotuner and
+the campaign CLIs rely on:
+
+- **Zero re-sweeps on a warm DB**: a second run with the same
+  fingerprint + shape class loads the stored knobs and never times a
+  candidate (witnessed in the trace by ``tune_apply`` with
+  ``db_hit=true`` and no ``tune_sweep`` events — bench_autotune.py
+  gates it).
+- **Stale or corrupt DBs are refused LOUDLY, never fatally**: a file
+  that fails to parse, has the wrong schema version, or was measured
+  under a different backend fingerprint produces a ``warnings.warn``
+  and an empty store — a campaign falls back to defaults, it never
+  crashes on somebody's leftover DB.
+- **Atomic writes**: tmp + ``os.replace`` so a crashed sweep can't
+  leave a half-written DB for the next run to choke on.
+
+Schema (version 1)::
+
+    {"version": 1,
+     "fingerprint": "cpu:TFRT_CPU_0:jax-0.4...",
+     "entries": {"<shape_class>": {"knobs": {...},
+                                   "default_s": ..., "tuned_s": ...,
+                                   "n_swept": ...}}}
+
+One file == one fingerprint: heterogeneous fleets point each host at
+its own path (or share a directory — see tune.db_path_for).
+"""
+
+import json
+import os
+import warnings
+
+from .capability import backend_fingerprint
+
+__all__ = ["TuningStore", "SCHEMA_VERSION"]
+
+SCHEMA_VERSION = 1
+
+
+class TuningStore:
+    """Load/store tuning winners at ``path`` for the live backend
+    fingerprint (override with ``fingerprint=`` for tests)."""
+
+    def __init__(self, path, fingerprint=None):
+        self.path = str(path)
+        self.fingerprint = (fingerprint if fingerprint is not None
+                            else backend_fingerprint())
+
+    # ------------------------------------------------------------------
+
+    def _load_raw(self):
+        """The validated entries dict, or {} with a loud warning when
+        the file is missing-but-unreadable, corrupt, mis-versioned, or
+        fingerprint-stale."""
+        if not os.path.exists(self.path):
+            return {}
+        try:
+            with open(self.path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError) as e:
+            warnings.warn(
+                f"tuning DB {self.path!r} is unreadable/corrupt "
+                f"({type(e).__name__}: {e}); ignoring it and running "
+                "with default knobs (delete the file to silence this)",
+                stacklevel=3)
+            return {}
+        if not isinstance(doc, dict) \
+                or doc.get("version") != SCHEMA_VERSION \
+                or not isinstance(doc.get("entries"), dict):
+            warnings.warn(
+                f"tuning DB {self.path!r} has an unknown schema "
+                f"(version={doc.get('version') if isinstance(doc, dict) else None!r}); "
+                "ignoring it and running with default knobs",
+                stacklevel=3)
+            return {}
+        if doc.get("fingerprint") != self.fingerprint:
+            warnings.warn(
+                f"tuning DB {self.path!r} was measured on backend "
+                f"{doc.get('fingerprint')!r} but this process is "
+                f"{self.fingerprint!r}; ignoring it and running with "
+                "default knobs (re-run the autotune sweep here)",
+                stacklevel=3)
+            return {}
+        return doc["entries"]
+
+    def get(self, shape_class):
+        """The stored entry dict for ``shape_class`` (``{"knobs":
+        ..., ...}``) or None."""
+        ent = self._load_raw().get(str(shape_class))
+        if ent is not None and not isinstance(ent.get("knobs"), dict):
+            warnings.warn(
+                f"tuning DB {self.path!r} entry {shape_class!r} is "
+                "malformed; ignoring it", stacklevel=2)
+            return None
+        return ent
+
+    def put(self, shape_class, knobs, **meta):
+        """Persist one sweep's winners (atomic; merges with existing
+        same-fingerprint entries — a stale-fingerprint file is
+        OVERWRITTEN, matching the loud refusal on load)."""
+        entries = self._load_raw()
+        entries[str(shape_class)] = {"knobs": dict(knobs), **meta}
+        doc = {"version": SCHEMA_VERSION,
+               "fingerprint": self.fingerprint,
+               "entries": entries}
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, indent=1, sort_keys=True)
+        os.replace(tmp, self.path)
+
+    def shape_classes(self):
+        return sorted(self._load_raw())

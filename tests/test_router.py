@@ -418,3 +418,73 @@ def test_router_concurrent_clients(campaign, tmp_path):
     assert sum(len(r.TOA_list) for r in results.values()) == 8
     assert sum(st["n_requests"] for st in stats.values()) == 4
     assert all(st["outstanding"] == 0 for st in stats.values())
+
+
+class _StatStubTransport:
+    """A host that exists only as its stat() report: fixed pending
+    load + measured TOAs/s + capability record — the unit surface for
+    the backend-aware cost model (ISSUE 19) without paying real
+    fits."""
+
+    def __init__(self, label, pending, toas_per_s):
+        self.label = label
+        self.pending = pending
+        self.toas_per_s = toas_per_s
+
+    def stat(self):
+        return {"pending_archives": self.pending, "queue_len": 0,
+                "n_live": 0, "toas_per_s": self.toas_per_s,
+                "capability": {"platform": "cpu",
+                               "fingerprint": "stub:cpu:jax-0"}}
+
+    def close(self):
+        pass
+
+
+def test_router_cost_model_heterogeneous_placement():
+    """Backend-aware placement (ISSUE 19): equal archive loads on a
+    fast (10 TOAs/s) and a slow (2 TOAs/s) host must rank the fast
+    host first under the cost model (cost = load / relative speed),
+    degrade to EXACT least-loaded order with cost_model=False or when
+    nothing is measured, and surface each host's measured rate in
+    stats()."""
+    slow = _StatStubTransport("slow", pending=4, toas_per_s=2.0)
+    fast = _StatStubTransport("fast", pending=4, toas_per_s=10.0)
+    router = ToaRouter([slow, fast])  # slow listed first (index 0)
+    try:
+        ranked, _ = router._rank("m.gmodel", 1)
+        assert [m.label for m in ranked] == ["fast", "slow"]
+        loads = router.fleet.probe_all()
+        costs, speeds = router._costs(loads)
+        by_label = {m.label: costs[m] for m in costs}
+        # slow runs at 2/10 relative speed -> 5x the cost per archive
+        assert by_label["slow"] == pytest.approx(5 * by_label["fast"])
+        st = router.stats()
+        assert st["fast"]["toas_per_s"] == 10.0
+        assert st["slow"]["toas_per_s"] == 2.0
+    finally:
+        router.close()
+
+    # cost model OFF: raw least-loaded, ties broken by index
+    router = ToaRouter([slow, fast], cost_model=False)
+    try:
+        ranked, _ = router._rank("m.gmodel", 1)
+        assert [m.label for m in ranked] == ["slow", "fast"]
+        costs, speeds = router._costs(router.fleet.probe_all())
+        assert all(s == 1.0 for s in speeds.values())
+        assert {c for c in costs.values()} == {4}
+    finally:
+        router.close()
+
+    # unmeasured fleet (cold hosts / pre-cost-model peers): the cost
+    # model degrades to exact least-loaded — speeds all 1.0
+    cold_a = _StatStubTransport("a", pending=2, toas_per_s=None)
+    cold_b = _StatStubTransport("b", pending=1, toas_per_s=None)
+    router = ToaRouter([cold_a, cold_b])
+    try:
+        ranked, _ = router._rank("m.gmodel", 1)
+        assert [m.label for m in ranked] == ["b", "a"]
+        costs, speeds = router._costs(router.fleet.probe_all())
+        assert all(s == 1.0 for s in speeds.values())
+    finally:
+        router.close()

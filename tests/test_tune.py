@@ -1,0 +1,358 @@
+"""Per-backend autotune subsystem (ISSUE 19): the capability table's
+fingerprint and tri-state resolver, the persisted tuning store's
+stale/corrupt refusals, the sweep harness's identity gate and
+no-regression fallback, the warm-DB zero-resweep witness, and the
+source-scan lock that keeps every 'auto' spelling on the ONE
+resolver."""
+
+import glob
+import json
+import os
+import re
+import warnings
+
+import pytest
+
+from pulseportraiture_tpu import config, telemetry
+from pulseportraiture_tpu.tune import (IDENTITY_TIER, Knob, TuningStore,
+                                       apply_from_db, ensure_tuned,
+                                       shape_class_for, sweep,
+                                       tuned_config)
+from pulseportraiture_tpu.tune import capability as cap
+
+
+# ---------------------------------------------------------------------------
+# capability table
+
+
+def test_backend_fingerprint_stable():
+    """Same process, same backend -> same fingerprint; the string
+    carries the platform, device kind, and jax version the tuning DB
+    keys on."""
+    import jax
+
+    fp = cap.backend_fingerprint()
+    assert fp == cap.backend_fingerprint()
+    platform, kind, jaxver = fp.split(":")
+    assert platform == jax.default_backend()
+    assert kind == jax.devices()[0].device_kind
+    assert jaxver == f"jax-{jax.__version__}"
+
+
+def test_capability_record_cached_and_upgraded():
+    """probe=False serves the static table without timing probes; a
+    later probe=True upgrades the cached record in place; the wire
+    summary is JSON-safe."""
+    rec0 = cap.capability_record(probe=False)
+    assert rec0.fingerprint == cap.backend_fingerprint()
+    assert isinstance(rec0.pallas_available, bool)
+    rec1 = cap.capability_record(probe=True)
+    assert rec1.fingerprint == rec0.fingerprint
+    assert rec1.dispatch_floor_s is not None
+    assert rec1.dispatch_floor_s >= 0
+    assert rec1.matmul_gflops > 0 and rec1.dft_gflops > 0
+    assert cap.capability_record() is rec1  # cached
+    json.dumps(cap.capability_summary())
+
+
+def test_resolve_auto_tristate_lattice(monkeypatch):
+    """The full lattice for BOTH polarities: booleans pass through,
+    'auto' (any case/whitespace) resolves by KNOB_POLARITY against the
+    LIVE backend, anything else is the knob's strict ValueError."""
+    assert cap.resolve_auto("fit_fused", True) is True
+    assert cap.resolve_auto("fit_fused", False) is False
+    on_cpu = cap.resolve_auto("fit_fused", "auto")
+    assert on_cpu is False        # tpu-polarity knob off-TPU
+    assert cap.resolve_auto("dft_fold", "auto") is True   # inverted
+    assert cap.resolve_auto("fit_fused", " AUTO ") is on_cpu
+    monkeypatch.setattr(cap.jax, "default_backend", lambda: "tpu")
+    assert cap.resolve_auto("fit_fused", "auto") is True
+    assert cap.resolve_auto("dft_fold", "auto") is False
+    monkeypatch.undo()
+    with pytest.raises(ValueError, match="fit_fused"):
+        cap.resolve_auto("fit_fused", "ture")
+    with pytest.raises(ValueError, match="config.dft_fold"):
+        cap.resolve_auto("dft_fold", 1, label="config.dft_fold")
+    with pytest.raises(KeyError):
+        cap.resolve_auto("no_such_knob", "auto")  # no polarity row
+
+
+def test_no_adhoc_tpu_spellings_outside_tune():
+    """The collapse is locked: no module outside tune/ may spell the
+    backend test privately — every 'auto' resolution goes through
+    resolve_auto, one rule, one test, no drift."""
+    pkg = os.path.join(os.path.dirname(__file__), "..",
+                       "pulseportraiture_tpu")
+    pat = re.compile(r"default_backend\(\)\s*[!=]=\s*[\"']tpu[\"']")
+    offenders = []
+    for path in glob.glob(os.path.join(pkg, "**", "*.py"),
+                          recursive=True):
+        if os.sep + "tune" + os.sep in path:
+            continue
+        if pat.search(open(path).read()):
+            offenders.append(os.path.relpath(path, pkg))
+    assert not offenders, (
+        f"ad-hoc 'tpu' backend tests outside tune/: {offenders} — "
+        "route them through tune.capability.resolve_auto")
+
+
+# ---------------------------------------------------------------------------
+# tuning store
+
+
+def test_store_roundtrip(tmp_path):
+    db = str(tmp_path / "db.json")
+    store = TuningStore(db)
+    store.put("16x128", {"fused_block": 16}, default_s=1.0,
+              tuned_s=0.8, n_swept=7, identity_preserving=True)
+    fresh = TuningStore(db)
+    ent = fresh.get("16x128")
+    assert ent["knobs"] == {"fused_block": 16}
+    assert ent["tuned_s"] == 0.8 and ent["identity_preserving"] is True
+    assert fresh.shape_classes() == ["16x128"]
+    assert fresh.get("999x999") is None
+    raw = json.load(open(db))
+    assert raw["fingerprint"] == cap.backend_fingerprint()
+
+
+def test_store_corrupt_refused_loudly(tmp_path):
+    """Garbage bytes never crash a campaign: the store WARNS and
+    behaves empty (defaults), and the next put overwrites cleanly."""
+    db = str(tmp_path / "db.json")
+    open(db, "w").write("{not json!!")
+    with pytest.warns(UserWarning, match="corrupt"):
+        assert TuningStore(db).get("16x128") is None
+    with pytest.warns(UserWarning, match="corrupt"):
+        TuningStore(db).put("16x128", {"fused_block": 16})
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # the rewritten DB is clean
+        ent = TuningStore(db).get("16x128")
+    assert ent["knobs"] == {"fused_block": 16}
+
+
+def test_store_stale_fingerprint_refused(tmp_path):
+    """A DB measured on a DIFFERENT backend fingerprint is refused
+    with a warning — winners never cross backends — and the next put
+    re-keys the file to the live fingerprint."""
+    db = str(tmp_path / "db.json")
+    json.dump({"version": 1, "fingerprint": "tpu:TPU v4:jax-9.9",
+               "entries": {"16x128": {"knobs": {"fused_block": 8}}}},
+              open(db, "w"))
+    with pytest.warns(UserWarning, match="fingerprint"):
+        assert TuningStore(db).get("16x128") is None
+    with pytest.warns(UserWarning, match="fingerprint"):
+        TuningStore(db).put("16x128", {"fused_block": 16})
+    raw = json.load(open(db))
+    assert raw["fingerprint"] == cap.backend_fingerprint()
+    assert TuningStore(db).get("16x128")["knobs"] == {"fused_block": 16}
+
+
+def test_store_wrong_schema_version_refused(tmp_path):
+    db = str(tmp_path / "db.json")
+    json.dump({"version": 99,
+               "fingerprint": cap.backend_fingerprint(),
+               "entries": {}}, open(db, "w"))
+    with pytest.warns(UserWarning, match="version"):
+        assert TuningStore(db).shape_classes() == []
+
+
+# ---------------------------------------------------------------------------
+# sweep harness (stubbed workload — no jax in the timed path)
+
+
+def _stub_workload(byte_changers=(), times=None):
+    """run_fn returns bytes that differ when any knob in
+    ``byte_changers`` deviates from config; time_fn reads the LIVE
+    config (so the combined validation pass sees applied winners)."""
+    times = times or {}
+
+    def run_fn(overrides):
+        with tuned_config(overrides):
+            bad = tuple(getattr(config, k) for k in byte_changers)
+        return b"tim" + repr(bad).encode()
+
+    def time_fn(overrides):
+        with tuned_config(overrides):
+            for (k, v), t in times.items():
+                if getattr(config, k) == v:
+                    return t
+        return 1.0
+
+    return run_fn, time_fn
+
+
+def test_sweep_picks_winner_and_never_regresses():
+    """A knob value that measures faster (and keeps bytes) wins;
+    tuned_s <= default_s holds by the combined no-regression gate."""
+    run_fn, time_fn = _stub_workload(
+        times={("stream_pipeline_depth", 1): 0.5})
+    knobs = (Knob("stream_pipeline_depth", (1, 4)),)
+    res = sweep(run_fn, knobs=knobs, time_fn=time_fn)
+    assert res.knobs == {"stream_pipeline_depth": 1}
+    assert res.tuned_s == 0.5 and res.default_s == 1.0
+    assert res.n_rejected == 0
+    # the winner was never APPLIED by sweep itself
+    assert config.stream_pipeline_depth == 2
+
+
+def test_sweep_identity_gate_rejects_byte_changer():
+    """A candidate that changes the artifact bytes is out of the
+    running no matter how fast it measures — the byte gate runs
+    BEFORE the clock."""
+    run_fn, time_fn = _stub_workload(
+        byte_changers=("fused_block",),
+        times={("fused_block", 16): 0.01})  # fastest, but byte-dirty
+    res = sweep(run_fn, knobs=(Knob("fused_block", (16,)),),
+                time_fn=time_fn)
+    assert res.knobs == {} and res.n_rejected == 1 and res.n_swept == 0
+    assert res.tuned_s == res.default_s
+
+
+def test_sweep_combined_regression_falls_back_to_defaults():
+    """Two knobs that each measure faster alone but regress combined:
+    the combined validation ships the DEFAULTS (a tuned campaign is
+    never slower)."""
+    def run_fn(overrides):
+        return b"tim"
+
+    def time_fn(overrides):
+        with tuned_config(overrides):
+            d = config.stream_pipeline_depth
+            c = config.lm_compact_every
+        if d == 1 and c == 8:
+            return 2.0       # the combination regresses
+        if d == 1 or c == 8:
+            return 0.5       # each wins alone
+        return 1.0
+
+    res = sweep(run_fn, time_fn=time_fn,
+                knobs=(Knob("stream_pipeline_depth", (1,)),
+                       Knob("lm_compact_every", (8,))))
+    assert res.knobs == {} and res.tuned_s == res.default_s == 1.0
+
+
+def test_ensure_tuned_db_hit_pays_zero_resweeps(tmp_path):
+    """First call sweeps and persists; second call loads the DB and
+    NEVER calls the workload — witnessed by the call counter and by
+    the trace (tune_apply db_hit=true, zero tune_sweep events)."""
+    db = str(tmp_path / "db.json")
+    calls = [0]
+    base_run, time_fn = _stub_workload(
+        times={("stream_pipeline_depth", 1): 0.5})
+
+    def run_fn(overrides):
+        calls[0] += 1
+        return base_run(overrides)
+
+    knobs = (Knob("stream_pipeline_depth", (1,)),)
+    trace1 = str(tmp_path / "t1.jsonl")
+    with telemetry.Tracer(trace1, run="tune") as tr:
+        w1 = ensure_tuned(run_fn, "16x128", db_path=db, knobs=knobs,
+                          time_fn=time_fn, tracer=tr, apply=False)
+    assert w1 == {"stream_pipeline_depth": 1} and calls[0] > 0
+    _, evs = telemetry.validate_trace(trace1)
+    assert [e["db_hit"] for e in evs if e["type"] == "tune_apply"] \
+        == [False]
+    assert any(e["type"] == "tune_sweep" for e in evs)
+    assert any(e["type"] == "tune_probe" for e in evs)
+
+    calls[0] = 0
+    trace2 = str(tmp_path / "t2.jsonl")
+    with telemetry.Tracer(trace2, run="tune") as tr:
+        w2 = ensure_tuned(run_fn, "16x128", db_path=db, knobs=knobs,
+                          time_fn=time_fn, tracer=tr, apply=False)
+    assert w2 == w1 and calls[0] == 0
+    _, evs = telemetry.validate_trace(trace2)
+    assert [e["db_hit"] for e in evs if e["type"] == "tune_apply"] \
+        == [True]
+    assert not any(e["type"] == "tune_sweep" for e in evs)
+    summary = telemetry.report(trace2, file=__import__("io").StringIO())
+    assert summary["tune_db_hits"] == 1 and summary["n_tune_sweep"] == 0
+
+
+def test_ensure_tuned_applies_winners_scoped(tmp_path):
+    """apply=True sets the winners on config (the campaign-startup
+    path); apply_from_db replays them in a fresh 'process'."""
+    db = str(tmp_path / "db.json")
+    run_fn, time_fn = _stub_workload(
+        times={("stream_pipeline_depth", 1): 0.5})
+    knobs = (Knob("stream_pipeline_depth", (1,)),)
+    old = config.stream_pipeline_depth
+    try:
+        ensure_tuned(run_fn, "16x128", db_path=db, knobs=knobs,
+                     time_fn=time_fn)
+        assert config.stream_pipeline_depth == 1
+        config.stream_pipeline_depth = old
+        # the CLI cold path: sole stored class is picked when None
+        assert apply_from_db(db_path=db) \
+            == {"stream_pipeline_depth": 1}
+        assert config.stream_pipeline_depth == 1
+    finally:
+        config.stream_pipeline_depth = old
+
+
+def test_numerics_tier_never_swept_silently(tmp_path):
+    """Without the explicit numerics opt-in, dtype knobs are not in
+    the default sweep set — byte-identity is the default contract."""
+    names = {k.name for k in IDENTITY_TIER}
+    assert "cross_spectrum_dtype" not in names
+    assert "dft_precision" not in names
+    seen = []
+
+    def run_fn(overrides):
+        seen.append(dict(overrides))
+        return b"tim"
+
+    sweep(run_fn, time_fn=lambda ov: 1.0)
+    swept_names = {k for ov in seen for k in ov}
+    assert "cross_spectrum_dtype" not in swept_names
+    assert "dft_precision" not in swept_names
+
+
+def test_shape_class_key():
+    assert shape_class_for(16, 128) == "16x128"
+    assert shape_class_for(16.0, 128.0) == "16x128"
+
+
+# ---------------------------------------------------------------------------
+# env hooks (satellite b)
+
+
+def test_tune_env_hooks(monkeypatch):
+    old = (config.tune_db, config.autotune, config.tune_numerics)
+    try:
+        for name in ("PPT_TUNE_DB", "PPT_AUTOTUNE",
+                     "PPT_TUNE_NUMERICS"):
+            assert name in config.KNOWN_PPT_ENV
+        monkeypatch.setenv("PPT_TUNE_DB", "/tmp/db.json")
+        monkeypatch.setenv("PPT_AUTOTUNE", "on")
+        monkeypatch.setenv("PPT_TUNE_NUMERICS", "off")
+        changed = config.env_overrides()
+        assert {"tune_db", "autotune", "tune_numerics"} <= set(changed)
+        assert config.tune_db == "/tmp/db.json"
+        assert config.autotune is True
+        assert config.tune_numerics is False
+        monkeypatch.setenv("PPT_TUNE_DB", "off")
+        config.env_overrides()
+        assert config.tune_db is None
+        monkeypatch.setenv("PPT_AUTOTUNE", "maybe")
+        with pytest.raises(ValueError, match="PPT_AUTOTUNE"):
+            config.env_overrides()
+        monkeypatch.setenv("PPT_AUTOTUNE", "off")
+        monkeypatch.setenv("PPT_TUNE_NUMERICS", "2")
+        with pytest.raises(ValueError, match="PPT_TUNE_NUMERICS"):
+            config.env_overrides()
+    finally:
+        (config.tune_db, config.autotune, config.tune_numerics) = old
+        for name in ("PPT_TUNE_DB", "PPT_AUTOTUNE",
+                     "PPT_TUNE_NUMERICS"):
+            monkeypatch.delenv(name, raising=False)
+        config.env_overrides()
+
+
+def test_tune_keys_in_telemetry_snapshot():
+    for key in ("tune_db", "autotune", "tune_numerics",
+                "lm_compact_every"):
+        assert key in telemetry.CONFIG_SNAPSHOT_KEYS
+    for ev in ("tune_probe", "tune_sweep", "tune_apply"):
+        assert ev in telemetry.EVENT_FIELDS
