@@ -1,0 +1,251 @@
+"""Fleet observability overhead + fidelity benchmark (ISSUE 20
+acceptance gates): the SAME routed campaign with the metrics registry
++ SLO tracking + distributed tracing fully ON vs fully OFF, enforcing
+that observability is honest about its cost — identical science
+output, bounded wall overhead, and lossless trace reconstruction.
+
+Arms (one process, bench_router's virtual-device discipline):
+  refs — one-shot ``stream_wideband_TOAs`` per unique archive: the
+         fresh-fit ``.tim`` bytes BOTH routed arms are gated against.
+  off  — router + PPT_NHOSTS emulated hosts, ``metrics=False``, no
+         SLO targets, no telemetry: PPT_NREQ requests, baseline wall.
+  on   — fresh router + hosts with ``metrics=True``, per-tenant SLO
+         targets, and a telemetry trace per process (1 router + N
+         hosts): the SAME request replay.
+
+Gates (the first two always enforced; the third disableable):
+  tim_identical — every ``.tim`` from BOTH arms must be byte-identical
+         to its one-shot reference: the registry, the SLO observes,
+         and the trace-id stamping may not perturb a single output
+         byte.
+  merge_ok — ``pptrace merge`` over the on-arm's 1+N traces must
+         reconstruct 100% of the requests: every submitted request
+         appears in the cross-host timeline exactly once, with its
+         host-side serve span joined and a critical-path stage named
+         (``merge_frac`` == 1.0).
+  overhead_ok — the on-arm wall may exceed the off-arm wall by at most
+         PPT_OBS_OVERHEAD_GATE percent (default 3; 0 disables for
+         smoke shapes, where per-request jitter dwarfs the registry's
+         nanoseconds).
+
+The on-arm router additionally serves its fleet-wide ``metrics`` op
+(what ``ppmon`` polls) while requests are in flight; the reply's
+fleet/router quantiles and per-tenant SLO snapshot ride in the JSON
+line.  Knobs via env: PPT_NARCH (4), PPT_NSUB (2), PPT_NCHAN (16),
+PPT_NBIN (128), PPT_NREQ (8), PPT_NHOSTS (2),
+PPT_OBS_OVERHEAD_GATE (3), PPT_CAMPAIGN_CACHE, PPT_TELEMETRY.
+Prints ONE JSON line.
+"""
+
+import io
+import json
+import os
+import shutil
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _ensure_devices(n):
+    """Force >= n virtual CPU devices BEFORE jax initializes (the
+    bench_stream discipline) so each emulated host owns its own
+    device."""
+    if "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+
+
+def main():
+    NHOSTS = max(2, int(os.environ.get("PPT_NHOSTS", 2)))
+    _ensure_devices(NHOSTS)
+    import pulseportraiture_tpu  # noqa: F401
+    from pulseportraiture_tpu import config
+    config.dft_precision = "default"
+    config.cross_spectrum_dtype = "bfloat16"
+    config.env_overrides()
+
+    import jax
+
+    from pulseportraiture_tpu import telemetry
+    from pulseportraiture_tpu.io.gmodel import write_gmodel
+    from pulseportraiture_tpu.obs.merge import merge_traces
+    from pulseportraiture_tpu.pipeline import stream_wideband_TOAs
+    from pulseportraiture_tpu.serve import (InProcTransport, ToaClient,
+                                            ToaRouter, ToaServer)
+    from pulseportraiture_tpu.synth import default_test_model
+    from pulseportraiture_tpu.synth.archive import make_fake_pulsar
+
+    NARCH = max(2, int(os.environ.get("PPT_NARCH", 4)))
+    NSUB = int(os.environ.get("PPT_NSUB", 2))
+    NCHAN = int(os.environ.get("PPT_NCHAN", 16))
+    NBIN = int(os.environ.get("PPT_NBIN", 128))
+    NREQ = max(2, int(os.environ.get("PPT_NREQ", 8)))
+    GATE = float(os.environ.get("PPT_OBS_OVERHEAD_GATE", 3.0))
+    PAR = {"PSR": "FAKE", "P0": 0.003, "DM": 50.0, "PEPOCH": 56000.0}
+    cache = os.environ.get("PPT_CAMPAIGN_CACHE", "/tmp/ppt_campaign")
+    tag = f"obs{NARCH}x{NSUB}x{NCHAN}x{NBIN}"
+    root = os.path.join(cache, tag)
+    os.makedirs(root, exist_ok=True)
+    trace_base = config.telemetry_path  # PPT_TELEMETRY (or None)
+
+    mpath = os.path.join(root, "model.gmodel")
+    if not os.path.exists(mpath):
+        write_gmodel(default_test_model(1500.0), mpath, quiet=True)
+    files = []
+    for i in range(NARCH):
+        path = os.path.join(root, f"a{i:04d}.fits")
+        if not os.path.exists(path):
+            make_fake_pulsar(mpath, PAR, outfile=path, nsub=NSUB,
+                             nchan=NCHAN, nbin=NBIN, nu0=1500.0,
+                             bw=600.0, phase=0.01 * (i % 50),
+                             dDM=1e-4 * (i % 40), noise_stds=0.05,
+                             quiet=True, rng=i)
+        files.append(path)
+    seq = [j % NARCH for j in range(NREQ)]  # the request replay
+    tenants = ["interactive", "bulk"]
+
+    out_root = os.path.join(root, "obs_out")
+    shutil.rmtree(out_root, ignore_errors=True)
+    os.makedirs(out_root, exist_ok=True)
+
+    def tim(arm, j):
+        return os.path.join(out_root, f"{arm}_{j}.tim")
+
+    # ---- one-shot references per unique archive --------------------
+    ref_bytes = {}
+    for i in range(NARCH):
+        ref = tim("ref", i)
+        stream_wideband_TOAs([files[i]], mpath, nsub_batch=64,
+                             tim_out=ref, quiet=True)
+        ref_bytes[i] = open(ref, "rb").read()
+
+    def run_arm(arm, metrics, slo_targets, traced):
+        """One routed replay; returns (wall_s, router, live_metrics,
+        [trace paths]).  The caller closes the router."""
+        rtrace = f"{trace_base}.obsr" if (trace_base and traced) \
+            else None
+        straces = [f"{trace_base}.obs{h}"
+                   if (trace_base and traced) else None
+                   for h in range(NHOSTS)]
+        servers = [
+            ToaServer(nsub_batch=64, quiet=True, metrics=metrics,
+                      telemetry=straces[h],
+                      stream_devices=[jax.local_devices()[h]]).start()
+            for h in range(NHOSTS)]
+        for s in servers:  # warm jit caches OUTSIDE the timed window
+            ToaClient(s).get_TOAs([files[0]], mpath, timeout=600)
+        router = ToaRouter(
+            [InProcTransport(s, label=f"host{h}")
+             for h, s in enumerate(servers)],
+            metrics=metrics, slo_targets=slo_targets,
+            telemetry=rtrace)
+        t0 = time.perf_counter()
+        handles = [router.submit([files[k]], mpath,
+                                 tim_out=tim(arm, j), name=f"{arm}{j}",
+                                 tenant=tenants[j % len(tenants)])
+                   for j, k in enumerate(seq)]
+        for h in handles:
+            h.result(3600)
+        wall = time.perf_counter() - t0
+        live = router.metrics() if metrics else None
+        router.close()
+        for s in servers:
+            s.stop()
+        return wall, live, ([rtrace] + straces) if rtrace else []
+
+    # ---- off arm: observability fully dark --------------------------
+    off_wall, _, _ = run_arm("off", metrics=False, slo_targets=None,
+                             traced=False)
+    # ---- on arm: registry + SLO + tracing all live -------------------
+    on_wall, live, traces = run_arm(
+        "on", metrics=True,
+        slo_targets={"interactive": 30.0, "bulk": 60.0}, traced=True)
+
+    # ---- gate: byte-identity vs the one-shot references -------------
+    tim_identical = all(
+        open(tim(arm, j), "rb").read() == ref_bytes[k]
+        for arm in ("off", "on") for j, k in enumerate(seq))
+    assert tim_identical, (
+        "a routed .tim diverged from its one-shot reference — the "
+        "metrics/SLO/trace-id path perturbed the science output")
+
+    # ---- gate: wall overhead of observability -----------------------
+    overhead_pct = 100.0 * (on_wall - off_wall) / max(off_wall, 1e-9)
+    overhead_ok = bool(overhead_pct <= GATE) if GATE > 0 else None
+    assert overhead_ok is not False, (
+        f"metrics-on replay cost {overhead_pct:.2f}% over the dark "
+        f"arm (gate {GATE}%) — the registry is on the hot path")
+
+    # ---- gate: 100% cross-host merge reconstruction -----------------
+    merge_frac = None
+    merge_ok = None
+    n_slo_breach = 0
+    if traces:
+        merged = merge_traces(traces)
+        # the warmup ToaClient fits also carry trace-ids (every
+        # request does) — the gate is over the ROUTED replay: each
+        # submitted request reconstructs EXACTLY once, with its
+        # host-side serve span joined and a critical stage named
+        per_name = {}
+        for r in merged["requests"].values():
+            per_name.setdefault(r["req"], []).append(r)
+        want = {f"on{j}" for j in range(NREQ)}
+        covered = sum(
+            1 for n in want
+            if len(per_name.get(n, ())) == 1
+            and per_name[n][0]["n_host_spans"] >= 1
+            and per_name[n][0]["critical"] is not None
+            and per_name[n][0]["error"] is None)
+        merge_frac = covered / NREQ
+        merge_ok = merge_frac == 1.0
+        assert merge_ok, (
+            f"merge reconstructed {covered}/{NREQ} requests "
+            f"({merged['n_requests']} timelines) — trace-id "
+            "propagation dropped a request")
+        summary = telemetry.report(traces[0], file=io.StringIO())
+        n_slo_breach = summary["n_slo_breach"]
+
+    # ---- the live fleet view ppmon polls ----------------------------
+    fleet_view = None
+    if live is not None:
+        f, r = live["fleet"], live["router"]
+        assert f["n_hosts"] == NHOSTS
+        assert r["metrics"]["counters"]["route_done"] == NREQ
+        assert f["p99_s"] is not None and r["p99_s"] is not None
+        fleet_view = {
+            "fleet_p50_s": f["p50_s"], "fleet_p99_s": f["p99_s"],
+            "route_p50_s": r["p50_s"], "route_p99_s": r["p99_s"],
+            "queue_depth": f["queue_depth"],
+            "toas_per_s": f["toas_per_s"],
+            "slo": {t: {"attainment": s["attainment"],
+                        "alerting": s["alerting"]}
+                    for t, s in (r["slo"] or {}).items()},
+        }
+        assert set(fleet_view["slo"]) == set(tenants)
+
+    print(json.dumps({
+        "metric": f"routed replay of {NREQ} requests over {NARCH} "
+                  f"archives x {NSUB}sub x {NCHAN}ch x {NBIN}bin on "
+                  f"{NHOSTS} emulated hosts, observability on vs off",
+        "value": round(NREQ / max(on_wall, 1e-9), 2),
+        "unit": "requests/sec",
+        "off_requests_per_sec": round(NREQ / max(off_wall, 1e-9), 2),
+        "overhead_pct": round(overhead_pct, 2),
+        "overhead_ok": overhead_ok,
+        "overhead_gate_pct": GATE,
+        "tim_identical": bool(tim_identical),
+        "merge_frac": merge_frac,
+        "merge_ok": merge_ok,
+        "n_traces_merged": len(traces),
+        "n_slo_breach": n_slo_breach,
+        "fleet_view": fleet_view,
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -113,10 +113,19 @@ def build_parser():
                         "route_retry/route_done) here; analyze with "
                         "tools/pptrace.py. Also via PPT_TELEMETRY. "
                         "[default: off]")
-    from .ppserve import add_cache_flags, add_tune_flags
+    p.add_argument("--monitor", dest="monitor", type=int,
+                   default=None, metavar="PORT",
+                   help="Expose the router's live fleet-wide "
+                        "'metrics' op on 127.0.0.1:PORT while the "
+                        "batch routes (port 0 = ephemeral, printed): "
+                        "point 'ppmon 127.0.0.1:PORT' at it for the "
+                        "live dashboard. [default: off]")
+    from .ppserve import (add_cache_flags, add_obs_flags,
+                          add_tune_flags)
 
     add_cache_flags(p)
     add_tune_flags(p)
+    add_obs_flags(p)
     p.add_argument("--quiet", action="store_true", default=False)
     return p
 
@@ -142,10 +151,15 @@ def main(argv=None):
                              "one of off/auto/on, got "
                              f"{args.transport_compress!r}")
         config.transport_compress = table[v]
-    from .ppserve import apply_cache_flags, apply_tune_flags
+    from .ppserve import (apply_cache_flags, apply_obs_flags,
+                          apply_tune_flags)
 
     apply_cache_flags(args, "pproute")
     apply_tune_flags(args, "pproute")
+    apply_obs_flags(args, "pproute")
+    if args.monitor is not None and not 0 <= args.monitor <= 65535:
+        raise SystemExit(f"--monitor: port out of range, got "
+                         f"{args.monitor}")
     if args.hosts is not None and args.fleet_file is not None:
         raise SystemExit("pproute: --hosts and --fleet-file are "
                          "mutually exclusive (static list vs watched "
@@ -195,6 +209,18 @@ def main(argv=None):
                            fleet_file=fleet_file)
     except TransportError as e:
         raise SystemExit(f"pproute: {e}")
+    monitor = None
+    if args.monitor is not None:
+        # the TransportServer speaks the same framed ops over the
+        # router as over a ToaServer — 'metrics' returns the
+        # fleet-wide aggregation, which is exactly what ppmon polls
+        from ..serve import TransportServer
+
+        monitor = TransportServer(router, host="127.0.0.1",
+                                  port=args.monitor,
+                                  quiet=args.quiet).start()
+        print(f"pproute: monitor endpoint on {monitor.label} "
+              "(poll with ppmon)", flush=True)
     failures = 0
     t0 = time.time()
     with router:
@@ -230,6 +256,8 @@ def main(argv=None):
                       f"{len(res.order)} archive(s) on "
                       f"{h.host.label} -> {res.tim_out}")
         placed = router.stats()
+    if monitor is not None:
+        monitor.close()
     if not args.quiet:
         share = ", ".join(f"{lbl}: {st['n_archives']} archive(s)/"
                           f"{st['n_requests']} request(s)"

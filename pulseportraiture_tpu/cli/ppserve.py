@@ -118,8 +118,56 @@ def build_parser():
                         "[default: none]")
     add_cache_flags(p)
     add_tune_flags(p)
+    add_obs_flags(p)
     p.add_argument("--quiet", action="store_true", default=False)
     return p
+
+
+def add_obs_flags(p):
+    """The fleet-observability flags (ISSUE 20), shared by ppserve /
+    pproute: the streaming metrics registry and the per-tenant SLO
+    targets the burn-rate engine tracks."""
+    p.add_argument("--metrics", dest="metrics", default=None,
+                   metavar="off|on",
+                   help="Streaming metrics registry (counters + "
+                        "log-bucket latency histograms, exported over "
+                        "the 'metrics' transport op for ppmon). .tim "
+                        "output is byte-identical either way. Also "
+                        "via PPT_METRICS. [default: on]")
+    p.add_argument("--slo-targets", dest="slo_targets", default=None,
+                   metavar="t:SEC,...|SEC|off",
+                   help="Per-tenant request-latency SLO targets in "
+                        "seconds ('*' = default tenant; a bare number "
+                        "applies to every tenant). Burn-rate "
+                        "breaches emit slo_breach telemetry and ride "
+                        "the metrics export. Also via "
+                        "PPT_SLO_TARGETS. [default: off]")
+
+
+def apply_obs_flags(args, prog):
+    """Validate the obs flags LOUDLY and apply them to config before
+    server/router construction (the ctors snapshot config.metrics /
+    config.slo_targets when not passed explicitly)."""
+    from .. import config
+
+    if args.metrics is not None:
+        table = {"off": False, "on": True}
+        v = str(args.metrics).lower()
+        if v not in table:
+            raise SystemExit(
+                f"{prog}: --metrics: expected 'off' or 'on', got "
+                f"{args.metrics!r}")
+        config.metrics = table[v]
+    if args.slo_targets is not None:
+        s = str(args.slo_targets).strip()
+        if s.lower() in ("off", "none"):
+            config.slo_targets = None
+        else:
+            try:
+                config.slo_targets = config.parse_tenant_spec(
+                    s, "--slo-targets", cast=float, allow_bare=True)
+            except ValueError as e:
+                raise SystemExit(f"{prog}: {e}")
 
 
 def add_tune_flags(p):
@@ -316,6 +364,7 @@ def main(argv=None):
         enable_compile_cache(args.compile_cache)
     apply_cache_flags(args, "ppserve")
     apply_tune_flags(args, "ppserve")
+    apply_obs_flags(args, "ppserve")
     os.makedirs(args.outdir, exist_ok=True)
 
     from ..serve import ServeRejected, ToaServer

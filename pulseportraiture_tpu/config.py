@@ -492,6 +492,34 @@ compile_cache_dir = None
 # with tools/pptrace.py.
 telemetry_path = None
 
+# --- Fleet observability (obs/, ISSUE 20) ---------------------------------
+# Streaming metrics registry on ToaServer and ToaRouter: thread-safe
+# counters/gauges plus fixed log-bucket latency histograms (p50/p99
+# without sample retention), exported over the transports' 'metrics'
+# op and aggregated fleet-wide by the router — what ppmon polls.  On
+# by default: the off-cost is a handful of dict increments per request
+# (never a device sync), and .tim output is byte-identical either way
+# (bench_obs.py gates both).  False = the registries are never built
+# and every instrumentation site is one attribute test.  Set via
+# PPT_METRICS=off|on or ppserve/pproute --metrics.
+metrics = True
+
+# Per-tenant request-latency SLO targets in SECONDS for the burn-rate
+# engine (obs/slo.py): {tenant: target_s} with '*' as the default
+# objective, or a bare number applying to every tenant.  None
+# (default) = no SLO tracking.  A tenant burning error budget >= 10x
+# too fast over BOTH the 5-minute and 1-hour windows raises one
+# slo_breach telemetry event per breach edge; attainment and burn
+# rates ride the metrics export either way.  Set via
+# PPT_SLO_TARGETS="interactive:0.5,bulk:30[,*:5]" (or a bare
+# "<seconds>") or ppserve/pproute --slo-targets.
+slo_targets = None
+
+# ppmon dashboard refresh interval in milliseconds (how often the
+# router's 'metrics' op is polled).  Set via PPT_MON_INTERVAL_MS or
+# ppmon --interval.
+mon_interval_ms = 1000.0
+
 # Harmonic window for the fast fit lane.  A smooth template's power
 # spectrum decays to numerical zero well below the Nyquist harmonic
 # (the bench Gaussian template holds all but ~7e-13 of its power in
@@ -634,6 +662,9 @@ RCSTRINGS = {
 #   PPT_TUNE_DB=<path>|off          -> tune_db
 #   PPT_AUTOTUNE=off|on             -> autotune
 #   PPT_TUNE_NUMERICS=off|on        -> tune_numerics
+#   PPT_METRICS=off|on              -> metrics
+#   PPT_SLO_TARGETS=t:S,...|<S>|off -> slo_targets
+#   PPT_MON_INTERVAL_MS=<float>     -> mon_interval_ms
 #
 # Unset variables leave the module values untouched; a typo in a
 # KNOWN variable's value raises (strict like the config parsers — a
@@ -667,6 +698,7 @@ KNOWN_PPT_ENV = frozenset({
     "PPT_RAW_SUBBYTE", "PPT_TRANSPORT_COMPRESS",
     "PPT_RESULT_CACHE", "PPT_CACHE_DIR", "PPT_CACHE_MAX_MB",
     "PPT_TUNE_DB", "PPT_AUTOTUNE", "PPT_TUNE_NUMERICS",
+    "PPT_METRICS", "PPT_SLO_TARGETS", "PPT_MON_INTERVAL_MS",
     # benchmark / smoke-test shape and mode knobs
     "PPT_NB", "PPT_NE", "PPT_NPSR", "PPT_NARCH", "PPT_NSUB",
     "PPT_NSUBB", "PPT_NCHAN", "PPT_NBIN", "PPT_NITER", "PPT_K",
@@ -677,7 +709,7 @@ KNOWN_PPT_ENV = frozenset({
     "PPT_HARMONIC_WINDOW", "PPT_TUNNEL_EMU", "PPT_RETUNE",
     "PPT_ZIPF_S", "PPT_CACHE_SPEEDUP_GATE",
     "PPT_NSEEDS", "PPT_INGEST_P99_GATE",
-    "PPT_TUNE_NRUN", "PPT_SLOW_MS",
+    "PPT_TUNE_NRUN", "PPT_SLOW_MS", "PPT_OBS_OVERHEAD_GATE",
 })
 
 def parse_hostport(spec):
@@ -1234,6 +1266,39 @@ def env_overrides():
                 f"{tnum!r}")
         cfg.tune_numerics = table[tnum]
         changed.append("tune_numerics")
+    met = _os.environ.get("PPT_METRICS", "").lower()
+    if met:
+        table = {"off": False, "false": False, "0": False,
+                 "on": True, "true": True, "1": True}
+        if met not in table:
+            raise ValueError(
+                f"PPT_METRICS must be 'off' or 'on', got {met!r}")
+        cfg.metrics = table[met]
+        changed.append("metrics")
+    slo = _os.environ.get("PPT_SLO_TARGETS", "")
+    if slo:
+        if slo.lower() in ("off", "none", "0"):
+            cfg.slo_targets = None
+        else:
+            # bare seconds (every tenant) or tenant:seconds pairs;
+            # float cast — sub-second interactive objectives are the
+            # common case
+            cfg.slo_targets = parse_tenant_spec(
+                slo, "PPT_SLO_TARGETS", cast=float, allow_bare=True)
+        changed.append("slo_targets")
+    mon = _os.environ.get("PPT_MON_INTERVAL_MS", "")
+    if mon:
+        try:
+            v = float(mon)
+        except ValueError:
+            raise ValueError(
+                "PPT_MON_INTERVAL_MS must be a positive number of "
+                f"milliseconds, got {mon!r}")
+        if not v > 0:
+            raise ValueError(
+                f"PPT_MON_INTERVAL_MS must be > 0, got {v}")
+        cfg.mon_interval_ms = v
+        changed.append("mon_interval_ms")
     tel = _os.environ.get("PPT_TELEMETRY", "")
     if tel:
         # 'off'/'none'/'0' disable explicitly (so a wrapper script can

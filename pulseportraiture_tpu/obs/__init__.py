@@ -1,0 +1,31 @@
+"""Live observability plane (ISSUE 20): distributed request tracing,
+streaming metrics, and SLO monitoring for the serving fleet.
+
+Post-hoc JSONL traces (``telemetry.py``) answer "what happened"; this
+package answers "what is happening".  Three pieces:
+
+- ``obs.metrics`` — thread-safe counters/gauges plus fixed log-bucket
+  latency histograms (p50/p90/p99 without sample retention), exported
+  over the ``metrics`` transport op and mergeable fleet-wide because
+  every host shares the same bucket bounds.
+- ``obs.slo`` — per-tenant latency objectives with multi-window
+  burn-rate tracking and edge-triggered breach events.
+- ``obs.merge`` — stitch a router trace plus N host traces into
+  per-request cross-host span timelines keyed by ``trace_id``.
+
+The ``trace_id`` minted at submit time (``new_trace_id``) rides the
+wire submit op and is stamped into every event a request touches on
+any host, so ``pptrace merge`` can reconstruct each request's life
+across processes.
+"""
+
+from .metrics import (HIST_BOUNDS, MetricsRegistry, global_registry,
+                      merge_exports, quantile_from_export, record_h2d)
+from .slo import SloTracker
+from .trace import new_trace_id
+
+__all__ = [
+    "HIST_BOUNDS", "MetricsRegistry", "SloTracker", "global_registry",
+    "merge_exports", "new_trace_id", "quantile_from_export",
+    "record_h2d",
+]

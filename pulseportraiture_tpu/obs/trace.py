@@ -1,0 +1,17 @@
+"""Trace-context minting for distributed request tracing.
+
+A ``trace_id`` is minted once — at ``ToaRouter.submit`` (or at
+``ToaServer.submit`` for direct clients) — and then propagated
+unchanged through the wire submit op, ``ServeRequest``, hedge and
+failover re-dispatches, and every telemetry event the request touches
+on any host.  The id is an opaque 16-hex-char token; nothing parses
+it, everything joins on it.
+"""
+
+import uuid
+
+
+def new_trace_id():
+    """Mint a fresh opaque trace id (16 hex chars, collision-safe for
+    any realistic campaign size)."""
+    return uuid.uuid4().hex[:16]

@@ -84,6 +84,7 @@ from ..fit.portrait import (FitFlags, _fast_batch_fn, estimate_tau_batch,
                             use_bf16_cross_spectrum, use_fast_fit_default)
 from ..io.psrfits import read_archive
 from ..io.tim import TOA, write_TOAs
+from ..obs.metrics import record_h2d
 from ..ops.noise import get_SNR, get_noise_PS
 from ..telemetry import NULL_TRACER, finite, log, resolve_tracer
 from ..utils.bunch import DataBunch
@@ -1576,6 +1577,10 @@ class _DevicePipeline:
         # the cost model learns THIS link from every copy (shipped
         # bytes over copy wall — conservative: stacking rides in)
         self.cost.observe_link(nbytes, dt)
+        # live link-stall accounting for the 'metrics' op (ISSUE 20):
+        # process-global counters ToaServer.metrics() folds in, so
+        # ppmon shows the stall fraction without a trace on disk
+        record_h2d(nbytes, dt, overlap)
         if tr.enabled:
             ev = dict(seq=seq, device=self.idev, bytes=int(nbytes),
                       h2d_s=round(dt, 6), overlap=overlap,

@@ -35,6 +35,8 @@ import itertools
 import threading
 import time
 
+from ..obs.trace import new_trace_id
+
 __all__ = ["ServeRejected", "ServeRequest", "AdmissionQueue"]
 
 
@@ -66,7 +68,7 @@ class ServeRequest:
     _ids = itertools.count()
 
     def __init__(self, datafiles, modelfile, options=None, tim_out=None,
-                 name=None, tenant=None):
+                 name=None, tenant=None, trace_id=None):
         from ..pipeline.toas import _is_metafile, _read_metafile
 
         if isinstance(datafiles, str):
@@ -85,6 +87,10 @@ class ServeRequest:
         # QoS lane label: requests of one tenant share a weighted-fair
         # admission lane and a pending-archive quota
         self.tenant = str(tenant) if tenant is not None else "default"
+        # distributed-tracing context (ISSUE 20): minted by the router
+        # (or here for direct clients), stamped into every telemetry
+        # event this request touches on any host
+        self.trace_id = str(trace_id) if trace_id else new_trace_id()
         # lifecycle timestamps (monotonic): submit by the queue, admit/
         # done by the server — what the request_done latency split and
         # the pptrace serve section report
@@ -194,6 +200,19 @@ class AdmissionQueue:
     def pending_archives(self):
         with self._cv:
             return self._pending
+
+    def load_snapshot(self):
+        """One lock-held snapshot of (queue_len, pending_archives).
+
+        ``len(q)`` and ``q.pending_archives`` are two separate lock
+        acquisitions — a stat/metrics reply built from both can report
+        TORN load (a submit landing between the reads shows its
+        archives but not its queue entry, or vice versa).  Every
+        stat-shaped reply must read load through here (ISSUE 20
+        satellite)."""
+        with self._cv:
+            return (sum(len(q) for q in self._lanes.values()),
+                    self._pending)
 
     def tenant_snapshot(self):
         """{tenant: {queued, pending_archives, cache_hits}} — the QoS

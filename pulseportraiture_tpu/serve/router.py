@@ -141,6 +141,12 @@ class RouteHandle:
     def done(self):
         return self._done.is_set()
 
+    def wait(self, timeout=None):
+        """Non-raising poll (the remote-transport primitive — lets a
+        TransportServer front a router the same way it fronts a
+        server); True when result() will not block."""
+        return self._done.wait(timeout)
+
     def result(self, timeout=None):
         """Block for the per-request DataBunch (the one-shot driver's
         result shape) or raise the request's failure; either way the
@@ -180,7 +186,8 @@ class ToaRouter:
                  quiet=True, probe_ms=None, hedge_ms=None,
                  write_tim="host", quality_refit=False,
                  fleet_file=None, fleet_poll_s=1.0,
-                 result_cache=None, cache_dir=None, cost_model=None):
+                 result_cache=None, cache_dir=None, cost_model=None,
+                 metrics=None, slo_targets=None):
         from .. import config
 
         transports = list(transports)
@@ -220,6 +227,20 @@ class ToaRouter:
                                           mode=result_cache)
         self.cache_hits = 0
         self.cache_bytes = 0
+        # live observability plane (ISSUE 20): router-side streaming
+        # counters + route-latency histograms, and per-tenant SLO
+        # burn-rate tracking over the END-TO-END routed latency (the
+        # number a client actually experiences, failovers and hedges
+        # included).  None reads config.metrics / config.slo_targets.
+        from ..obs.metrics import MetricsRegistry
+        from ..obs.slo import SloTracker
+
+        want_metrics = (config.metrics if metrics is None
+                        else bool(metrics))
+        self._metrics = MetricsRegistry() if want_metrics else None
+        targets = (config.slo_targets if slo_targets is None
+                   else slo_targets)
+        self._slo = SloTracker(targets) if targets else None
         self._lock = threading.Lock()
         self._affinity = {}   # abspath(modelfile) -> FleetMember
         self._inflight = {}   # label -> set of RouteHandle
@@ -334,7 +355,7 @@ class ToaRouter:
 
     def _place(self, datafiles, modelfile, tim_out, name, options,
                tenant, excluded=frozenset(), attempt0=0,
-               affinity=True):
+               affinity=True, trace_id=None):
         """The placement loop: try ranked hosts, retry retryable
         backpressure / unreachable hosts up to retry_max attempts with
         capped exponential backoff between full fleet passes; feed the
@@ -363,7 +384,8 @@ class ToaRouter:
                 try:
                     handle = host.transport.submit(
                         datafiles, modelfile, tim_out=tim_out,
-                        name=name, options=options, tenant=tenant)
+                        name=name, options=options, tenant=tenant,
+                        trace_id=trace_id)
                 except ServeRejected as e:
                     if not e.retryable:
                         raise  # could never fit anywhere: caller's bug
@@ -391,14 +413,19 @@ class ToaRouter:
             "ToaRouter: submit failed with no recorded error")
 
     def submit(self, datafiles, modelfile, tim_out=None, name=None,
-               tenant=None, **options):
+               tenant=None, trace_id=None, **options):
         """Place one request on the fleet (thread-safe); returns a
         :class:`RouteHandle`.  Retries retryable backpressure and
         unreachable hosts up to ``retry_max`` placements with capped
         exponential backoff between full fleet passes; raises the last
         failure when the budget is exhausted, and terminal
         ``ServeRejected`` (retryable=False) immediately.  ``tenant``
-        labels the request for the per-host QoS lanes."""
+        labels the request for the per-host QoS lanes.  ``trace_id``
+        (None = mint one here) is the distributed-tracing context: it
+        crosses the wire on every placement — hedges, failovers, and
+        refits included — so ``pptrace merge`` can stitch the
+        request's life across the router trace and N host traces."""
+        from ..obs.trace import new_trace_id
         from ..pipeline.toas import _is_metafile, _read_metafile
 
         if self._closed:
@@ -417,18 +444,21 @@ class ToaRouter:
         host_tim = tim_out if (self.write_tim == "host"
                                and self.hedge_s is None) else None
         t0 = time.monotonic()
+        trace_id = str(trace_id) if trace_id else new_trace_id()
         cache_key = None
         if self.cache is not None:
             hit_rh, cache_key = self._cache_lookup(
                 datafiles, modelfile, tim_out, name, tenant, options,
-                n_archives, t0)
+                n_archives, t0, trace_id)
             if hit_rh is not None:
                 return hit_rh
         host, handle, attempt, sticky = self._place(
-            datafiles, modelfile, host_tim, name, options, tenant)
+            datafiles, modelfile, host_tim, name, options, tenant,
+            trace_id=trace_id)
         spec = dict(datafiles=datafiles, modelfile=str(modelfile),
                     tim_out=tim_out, options=dict(options),
-                    tenant=tenant, host_tim=host_tim)
+                    tenant=tenant, host_tim=host_tim,
+                    trace_id=trace_id)
         rh = RouteHandle(self, host, handle,
                          name if name is not None
                          else getattr(handle, "name", None),
@@ -440,15 +470,18 @@ class ToaRouter:
             host.n_archives += n_archives
             self._affinity[mkey] = host
             self._inflight.setdefault(host.label, set()).add(rh)
+        if self._metrics is not None:
+            self._metrics.inc("route_submits")
         if self.tracer.enabled:
             self.tracer.emit(
                 "route_submit", req=rh.name, host=host.label,
                 n_archives=n_archives, attempt=attempt,
-                affinity=bool(sticky), tenant=tenant)
+                affinity=bool(sticky), tenant=tenant,
+                trace_id=trace_id)
         return rh
 
     def _cache_lookup(self, datafiles, modelfile, tim_out, name,
-                      tenant, options, n_archives, t0):
+                      tenant, options, n_archives, t0, trace_id=None):
         """Content-addressed lookup before placement (ISSUE 17).
         Returns ``(hit_handle, key)``: on a hit, a PRE-RESOLVED
         :class:`RouteHandle` — result set, ``_done`` set,
@@ -470,7 +503,8 @@ class ToaRouter:
         if ent is None:
             if self.tracer.enabled:
                 self.tracer.emit("cache_miss", req=name,
-                                 source="router", tenant=tenant)
+                                 source="router", tenant=tenant,
+                                 trace_id=trace_id)
             return None, key
         result, entry_path, n_bytes = ent
         if tim_out:
@@ -486,18 +520,32 @@ class ToaRouter:
         rh._result = result
         self.cache_hits += 1
         self.cache_bytes += n_bytes
+        wall = time.monotonic() - t0
+        if self._metrics is not None:
+            self._metrics.inc("route_submits")
+            self._metrics.inc("route_done")
+            self._metrics.inc("cache_hits")
+            self._metrics.inc("cache_bytes", n_bytes)
+            self._metrics.observe("route_latency_s", wall)
+        if self._slo is not None:
+            breach = self._slo.observe(tenant or "default", wall)
+            if breach is not None and self.tracer.enabled:
+                self.tracer.emit("slo_breach", source="router",
+                                 **breach)
         if self.tracer.enabled:
             self.tracer.emit("route_submit", req=name, host=None,
                              n_archives=n_archives, attempt=0,
-                             affinity=False, tenant=tenant)
+                             affinity=False, tenant=tenant,
+                             trace_id=trace_id)
             self.tracer.emit("cache_hit", req=name, bytes=n_bytes,
-                             source="router", tenant=tenant)
+                             source="router", tenant=tenant,
+                             trace_id=trace_id)
             self.tracer.counter("cache_hit")
             self.tracer.emit("route_done", req=name, host=None,
-                             wall_s=round(time.monotonic() - t0, 6),
+                             wall_s=round(wall, 6),
                              n_toas=len(result.TOA_list), error=None,
                              tenant=tenant, hedged=False,
-                             failover=None)
+                             failover=None, trace_id=trace_id)
         rh._done.set()
         return rh, key
 
@@ -676,15 +724,37 @@ class ToaRouter:
                                  bytes=stored)
         rh._result = result
         rh._error = error
+        wall_s = time.monotonic() - rh._t_submit
+        tenant = rh.spec.get("tenant")
+        if self._metrics is not None:
+            self._metrics.inc("route_done")
+            if error is not None:
+                self._metrics.inc("route_failed")
+            if hedged:
+                self._metrics.inc("route_hedged")
+            if action is not None:
+                self._metrics.inc(f"route_failover_{action}")
+            if result is not None:
+                self._metrics.inc("toas_total",
+                                  len(result.TOA_list or ()))
+            self._metrics.observe("route_latency_s", wall_s)
+        if self._slo is not None:
+            breach = self._slo.observe(
+                tenant or "default",
+                wall_s if error is None else float("inf"))
+            if breach is not None and self.tracer.enabled:
+                self.tracer.emit("slo_breach", source="router",
+                                 **breach)
         if self.tracer.enabled:
             self.tracer.emit(
                 "route_done", req=rh.name,
                 host=winner.label if winner is not None else None,
-                wall_s=round(time.monotonic() - rh._t_submit, 6),
+                wall_s=round(wall_s, 6),
                 n_toas=len(result.TOA_list) if result else 0,
                 error=str(error) if error else None,
-                tenant=rh.spec.get("tenant"), hedged=bool(hedged),
-                failover=action)
+                tenant=tenant, hedged=bool(hedged),
+                failover=action,
+                trace_id=rh.spec.get("trace_id"))
         rh._done.set()
         if error is not None:
             raise error
@@ -720,7 +790,8 @@ class ToaRouter:
             handle = host.transport.submit(
                 rh.datafiles, rh.spec["modelfile"], tim_out=None,
                 name=rh.name, options=rh.spec["options"],
-                tenant=rh.spec.get("tenant"))
+                tenant=rh.spec.get("tenant"),
+                trace_id=rh.spec.get("trace_id"))
         except (ServeRejected, TransportError) as e:
             log(f"hedge of {rh.name!r} on {host.label} not placed: "
                 f"{e}", quiet=self.quiet, level="warn", tracer=None)
@@ -733,9 +804,12 @@ class ToaRouter:
             rh.attempts.append((host, handle, True))
             host.outstanding += rh.n_archives
             self._inflight.setdefault(host.label, set()).add(rh)
+        if self._metrics is not None:
+            self._metrics.inc("hedges_launched")
         if self.tracer.enabled:
             self.tracer.emit("route_hedge", req=rh.name,
-                             primary=primary.label, host=host.label)
+                             primary=primary.label, host=host.label,
+                             trace_id=rh.spec.get("trace_id"))
 
     # ------------------------------------------------------------------
     # failover
@@ -789,7 +863,8 @@ class ToaRouter:
                 if self.tracer.enabled:
                     self.tracer.emit("route_failover", req=rh.name,
                                      dead_host=member.label,
-                                     action="collected", host=None)
+                                     action="collected", host=None,
+                                     trace_id=rh.spec.get("trace_id"))
                 log(f"failover: {rh.name!r} collected from its "
                     f"durable .tim after {member.label} died "
                     "(no re-fit)", quiet=self.quiet, level="warn",
@@ -806,7 +881,8 @@ class ToaRouter:
             host, handle2, attempt, _sticky = self._place(
                 rh.datafiles, rh.spec["modelfile"], None, rh.name,
                 rh.spec["options"], rh.spec.get("tenant"),
-                excluded=frozenset(rh.excluded), affinity=False)
+                excluded=frozenset(rh.excluded), affinity=False,
+                trace_id=rh.spec.get("trace_id"))
             with self._lock:
                 rh.attempts.append((host, handle2,
                                     rh.tim_out is not None))
@@ -821,7 +897,8 @@ class ToaRouter:
                 self.tracer.emit("route_failover", req=rh.name,
                                  dead_host=member.label,
                                  action="redispatch", host=host.label,
-                                 attempt=attempt)
+                                 attempt=attempt,
+                                 trace_id=rh.spec.get("trace_id"))
             log(f"failover: {rh.name!r} re-dispatched to "
                 f"{host.label} after {member.label} died "
                 f"(excluded: {sorted(rh.excluded)})",
@@ -830,7 +907,8 @@ class ToaRouter:
             if self.tracer.enabled:
                 self.tracer.emit("route_failover", req=rh.name,
                                  dead_host=member.label,
-                                 action="failed", host=None)
+                                 action="failed", host=None,
+                                 trace_id=rh.spec.get("trace_id"))
             try:
                 self._finish(rh, None, error=e, action="failed")
             except Exception:
@@ -947,7 +1025,8 @@ class ToaRouter:
                     name=f"{rh.name}:refit",
                     options={**rh.spec["options"],
                              "zap_channels": zap_map},
-                    tenant=rh.spec.get("tenant"))
+                    tenant=rh.spec.get("tenant"),
+                    trace_id=rh.spec.get("trace_id"))
                 # BOUNDED: the refit rides inside the original
                 # request's collection — a hung refit host must fall
                 # back to serving the original, never wedge the client
@@ -1017,6 +1096,107 @@ class ToaRouter:
                               "state": m.state,
                               "toas_per_s": m.toas_per_s}
                     for m in self.fleet.members()}
+
+    def metrics(self):
+        """Fleet-wide live metrics (ISSUE 20): per-host ``metrics``
+        replies plus the merged view — queue depth, in-flight, TOAs/s,
+        p50/p90/p99 (bucket-wise histogram merge over the shared
+        bounds), cache hit rate, link stall fraction, and the health
+        states — and the router's own route-latency registry + SLO
+        snapshot.  A host whose ``metrics`` op fails (dead, or a
+        pre-obs build) degrades to its ``stat`` fields with the error
+        recorded; the reply never raises on a sick fleet — ppmon must
+        render outages, not crash on them."""
+        from ..obs import metrics as obs_metrics
+
+        with self._lock:
+            members = [(m.label, m.state, m.outstanding, m.n_requests,
+                        m.n_archives, m.toas_per_s, m.transport)
+                       for m in self.fleet.members()]
+        hosts = {}
+        host_exports = []
+        for (label, state, outstanding, n_req, n_arch, rate,
+             transport) in members:
+            ent = {"state": state, "outstanding": outstanding,
+                   "n_requests": n_req, "n_archives": n_arch,
+                   "toas_per_s": rate, "queue_len": None,
+                   "pending_archives": None, "n_live": None,
+                   "link_stall_frac": None, "slo": None,
+                   "metrics": None, "p50_s": None, "p99_s": None,
+                   "error": None}
+            try:
+                m = transport.metrics()
+            except Exception as e:
+                ent["error"] = str(e)
+                try:
+                    st = transport.stat()
+                except Exception:
+                    pass  # unreachable: the state field tells why
+                else:
+                    for k in ("queue_len", "pending_archives",
+                              "n_live", "toas_per_s"):
+                        ent[k] = st.get(k)
+            else:
+                for k in ("queue_len", "pending_archives", "n_live",
+                          "toas_per_s", "link_stall_frac", "slo",
+                          "cache_hits", "cache_bytes"):
+                    ent[k] = m.get(k)
+                ent["metrics"] = m.get("metrics")
+                if ent["metrics"]:
+                    host_exports.append(ent["metrics"])
+                    h = (ent["metrics"].get("histograms") or {}) \
+                        .get("request_latency_s")
+                    if h:
+                        ent["p50_s"] = obs_metrics \
+                            .quantile_from_export(h, 0.50)
+                        ent["p99_s"] = obs_metrics \
+                            .quantile_from_export(h, 0.99)
+            hosts[label] = ent
+        merged = obs_metrics.merge_exports(host_exports)
+        hl = merged["histograms"].get("request_latency_s")
+
+        def _q(h, q):
+            return obs_metrics.quantile_from_export(h, q) if h else None
+
+        def _sum(key):
+            vals = [hosts[lb][key] for lb in hosts
+                    if hosts[lb][key] is not None]
+            return sum(vals) if vals else None
+
+        router_ex = (self._metrics.export()
+                     if self._metrics is not None else None)
+        n_sub = (router_ex or {}).get("counters", {}) \
+            .get("route_submits", 0)
+        rl = (router_ex or {}).get("histograms", {}) \
+            .get("route_latency_s")
+        return {
+            "metrics_enabled": self._metrics is not None,
+            "hosts": hosts,
+            "fleet": {
+                "n_hosts": len(hosts),
+                "states": {lb: hosts[lb]["state"] for lb in hosts},
+                "queue_depth": _sum("queue_len"),
+                "pending_archives": _sum("pending_archives"),
+                "in_flight": sum(hosts[lb]["outstanding"]
+                                 for lb in hosts),
+                "toas_per_s": _sum("toas_per_s"),
+                "link_stall_frac": obs_metrics.link_stall_frac(merged),
+                "p50_s": _q(hl, 0.50), "p90_s": _q(hl, 0.90),
+                "p99_s": _q(hl, 0.99),
+                "metrics": merged,
+            },
+            "router": {
+                "cache_hits": self.cache_hits,
+                "cache_bytes": self.cache_bytes,
+                "cache_hit_rate": (round(self.cache_hits / n_sub, 4)
+                                   if n_sub else None),
+                "p50_s": _q(rl, 0.50), "p90_s": _q(rl, 0.90),
+                "p99_s": _q(rl, 0.99),
+                "metrics": router_ex,
+                "slo": (self._slo.snapshot()
+                        if self._slo is not None else None),
+            },
+        }
 
     def close(self):
         """Close every transport (idempotent).  The router never owns

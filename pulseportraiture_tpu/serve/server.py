@@ -113,7 +113,8 @@ class ToaServer:
                  warmup_options=None, quiet=True, quality_refit=None,
                  quality_max_gof=None, quality_min_snr=None,
                  zap_nstd=None, tenant_quota=None, tenant_weight=None,
-                 result_cache=None, cache_dir=None):
+                 result_cache=None, cache_dir=None, metrics=None,
+                 slo_targets=None):
         from .. import config
 
         if max_wait_ms is None:
@@ -157,6 +158,20 @@ class ToaServer:
         # None until the first real fit (cache hits never count —
         # they say nothing about this host's compute speed)
         self._toa_rate = None
+        # live observability plane (ISSUE 20): streaming counters +
+        # log-bucket latency histograms (p50/p99 without sample
+        # retention) exported over the ``metrics`` transport op, and
+        # per-tenant SLO burn-rate tracking when targets are set.
+        # None reads config.metrics / config.slo_targets.
+        from ..obs.metrics import MetricsRegistry
+        from ..obs.slo import SloTracker
+
+        want_metrics = (config.metrics if metrics is None
+                        else bool(metrics))
+        self._metrics = MetricsRegistry() if want_metrics else None
+        targets = (config.slo_targets if slo_targets is None
+                   else slo_targets)
+        self._slo = SloTracker(targets) if targets else None
         # multi-tenant QoS (ISSUE 13): per-tenant weighted-fair lanes
         # + quotas; None reads config.serve_tenant_quota/_weight
         self.queue = AdmissionQueue(queue_depth,
@@ -198,16 +213,19 @@ class ToaServer:
     # ------------------------------------------------------------------
 
     def submit(self, datafiles, modelfile, tim_out=None, name=None,
-               tenant=None, **options):
+               tenant=None, trace_id=None, **options):
         """Enqueue one request (thread-safe).  Raises
         :class:`ServeRejected` when the admission queue is full
         (backpressure), the request's tenant is over its quota, or the
         server is stopping; returns a :class:`ServeRequest` whose
         ``result()`` blocks for the per-request DataBunch.  ``tenant``
         labels the request's weighted-fair QoS lane (None =
-        'default')."""
+        'default').  ``trace_id`` is the distributed-tracing context a
+        router minted upstream (None mints one here), stamped into
+        every event this request touches."""
         req = ServeRequest(datafiles, modelfile, options=options,
-                           tim_out=tim_out, name=name, tenant=tenant)
+                           tim_out=tim_out, name=name, tenant=tenant,
+                           trace_id=trace_id)
         if self._stopping.is_set():
             raise ServeRejected(
                 f"server is stopping; request {req.name!r} rejected")
@@ -221,7 +239,8 @@ class ToaServer:
         if self.tracer.enabled:
             self.tracer.emit("request_submit", req=req.name,
                              n_archives=len(req.datafiles),
-                             tenant=req.tenant)
+                             tenant=req.tenant,
+                             trace_id=req.trace_id)
         return req
 
     def _cache_try_hit(self, req):
@@ -246,7 +265,8 @@ class ToaServer:
         if ent is None:
             if self.tracer.enabled:
                 self.tracer.emit("cache_miss", req=req.name,
-                                 source="server", tenant=req.tenant)
+                                 source="server", tenant=req.tenant,
+                                 trace_id=req.trace_id)
             return False
         result, entry_path, n_bytes = ent
         if req.tim_out:
@@ -257,12 +277,17 @@ class ToaServer:
         self.queue.record_hit(req.tenant, len(req.datafiles))
         self._cache_hits += 1
         self._cache_bytes += n_bytes
+        if self._metrics is not None:
+            self._metrics.inc("cache_hits")
+            self._metrics.inc("cache_bytes", n_bytes)
         if self.tracer.enabled:
             self.tracer.emit("request_submit", req=req.name,
                              n_archives=len(req.datafiles),
-                             tenant=req.tenant)
+                             tenant=req.tenant,
+                             trace_id=req.trace_id)
             self.tracer.emit("cache_hit", req=req.name, bytes=n_bytes,
-                             source="server", tenant=req.tenant)
+                             source="server", tenant=req.tenant,
+                             trace_id=req.trace_id)
             self.tracer.counter("cache_hit")
         self._complete(req, result=result)
         return True
@@ -276,8 +301,12 @@ class ToaServer:
         placement and the transport ``stat`` op read."""
         from ..tune.capability import capability_summary
 
-        return {"pending_archives": self.queue.pending_archives,
-                "queue_len": len(self.queue),
+        # ONE lock-held read of both queue load fields: reading
+        # pending_archives and len(queue) separately can tear against
+        # a concurrent submit (ISSUE 20 satellite)
+        queue_len, pending = self.queue.load_snapshot()
+        return {"pending_archives": pending,
+                "queue_len": queue_len,
                 "n_live": len(self._live),
                 # hit traffic is O(1) and never occupies the executor,
                 # so it rides OUTSIDE the load signal above — a
@@ -290,6 +319,35 @@ class ToaServer:
                 # measured TOAs/s the router's cost model divides by
                 "toas_per_s": self._toa_rate,
                 "capability": capability_summary()}
+
+    def metrics(self):
+        """Live-metrics reply (the ``metrics`` transport op): the
+        stat-shaped load snapshot plus the streaming registry export
+        (counters, gauges, latency histograms) and the per-tenant SLO
+        snapshot.  Process-global h2d counters fold in so the link
+        stall fraction rides the same reply.  Histograms use the
+        fleet-shared ``obs.metrics.HIST_BOUNDS``, which is what lets a
+        router merge replies bucket-wise."""
+        from ..obs import metrics as obs_metrics
+
+        queue_len, pending = self.queue.load_snapshot()
+        out = {"pending_archives": pending,
+               "queue_len": queue_len,
+               "n_live": len(self._live),
+               "cache_hits": self._cache_hits,
+               "cache_bytes": self._cache_bytes,
+               "toas_per_s": self._toa_rate,
+               "metrics_enabled": self._metrics is not None,
+               "metrics": None, "link_stall_frac": None,
+               "slo": self._slo.snapshot() if self._slo else None}
+        if self._metrics is not None:
+            ex = self._metrics.export()
+            g = obs_metrics.global_registry().export()
+            merged = obs_metrics.merge_exports([ex, g])
+            merged["gauges"] = {**g["gauges"], **ex["gauges"]}
+            out["metrics"] = merged
+            out["link_stall_frac"] = obs_metrics.link_stall_frac(merged)
+        return out
 
     def start(self):
         """Run the optional AOT warmup, then start the serving thread.
@@ -510,14 +568,22 @@ class ToaServer:
     # -- executor hooks (server thread) --------------------------------
 
     def _launched(self, seq, owners, pad):
+        if self._metrics is not None:
+            self._metrics.inc("dispatches")
+            self._metrics.inc("rows_dispatched", len(owners))
         if not self.tracer.enabled:
             return
-        names = {self._by_iarch[ia][0].name for ia, _ in owners
-                 if ia in self._by_iarch}
+        members = {self._by_iarch[ia][0] for ia, _ in owners
+                   if ia in self._by_iarch}
+        names = {r.name for r in members}
         self.tracer.emit("batch_coalesce", seq=seq,
                          n_requests=len(names),
                          requests=sorted(names), rows=len(owners),
-                         pad=int(pad))
+                         pad=int(pad),
+                         # request-membership by trace context: the
+                         # field pptrace merge joins dispatches on
+                         trace_ids=sorted({r.trace_id
+                                           for r in members}))
 
     def _archive_done(self, iarch, m, out):
         ent = self._by_iarch.pop(iarch, None)
@@ -755,19 +821,40 @@ class ToaServer:
                 self._toa_rate = (rate if self._toa_rate is None
                                   else 0.7 * self._toa_rate
                                   + 0.3 * rate)
+        t_sub = req.t_submit if req.t_submit is not None \
+            else req.t_done
+        t_adm = req.t_admit if req.t_admit is not None \
+            else req.t_done
+        wall_s = req.t_done - t_sub
+        queue_s = t_adm - t_sub
+        if self._metrics is not None:
+            self._metrics.inc("requests_total")
+            if error is not None:
+                self._metrics.inc("requests_failed")
+            if result is not None:
+                self._metrics.inc("toas_total",
+                                  len(result.TOA_list or ()))
+            self._metrics.observe("request_latency_s", wall_s)
+            self._metrics.observe("queue_wait_s", queue_s)
+        if self._slo is not None:
+            # an errored request burns budget like an infinitely slow
+            # one: failures violate a latency objective by definition
+            breach = self._slo.observe(
+                getattr(req, "tenant", None) or "default",
+                wall_s if error is None else float("inf"))
+            if breach is not None and self.tracer.enabled:
+                self.tracer.emit("slo_breach", source="server",
+                                 **breach)
         if self.tracer.enabled:
-            t_sub = req.t_submit if req.t_submit is not None \
-                else req.t_done
-            t_adm = req.t_admit if req.t_admit is not None \
-                else req.t_done
             self.tracer.emit(
                 "request_done", req=req.name,
                 n_toas=len(result.TOA_list) if result else 0,
                 n_archives=len(result.order) if result else 0,
-                wall_s=round(req.t_done - t_sub, 6),
-                queue_s=round(t_adm - t_sub, 6),
+                wall_s=round(wall_s, 6),
+                queue_s=round(queue_s, 6),
                 error=str(error) if error else None,
-                tenant=getattr(req, "tenant", None))
+                tenant=getattr(req, "tenant", None),
+                trace_id=req.trace_id)
         req._event.set()
 
     def _fail_requests(self, requests, error):

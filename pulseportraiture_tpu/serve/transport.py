@@ -37,7 +37,9 @@ and plain frames stay bit-identical to prior releases).  Ops:
 
   {"op": "submit", "datafiles": [...], "modelfile": m,
    "tim_out": p|null, "name": n|null, "tenant": t|null,
-   "options": {...}}
+   "trace_id": id|null, "options": {...}}
+      (trace_id: distributed-tracing context minted by the router —
+       ISSUE 20; absent/null on old peers, the server mints its own)
       -> {"ok": true, "handle": k}
       -> {"ok": false, "error": msg, "rejected": true,
           "retryable": bool}                 (ServeRejected)
@@ -51,6 +53,13 @@ and plain frames stay bit-identical to prior releases).  Ops:
           "n_live": n, "cache_hits": n, "cache_bytes": n}
          (cache_* count result-cache hit traffic served OUTSIDE the
           load signal; absent on pre-cache hosts — readers default 0)
+  {"op": "metrics"}
+      -> {"ok": true, ...ToaServer.metrics()...}
+         (ISSUE 20: the stat-shaped load snapshot plus the streaming
+          registry export — counters/gauges/log-bucket latency
+          histograms — the link stall fraction, and the per-tenant
+          SLO snapshot; a pre-obs host replies unknown-op and the
+          caller degrades to ``stat``)
   {"op": "drain"}
       -> {"ok": true, "n_done": n}          (this connection's handles
                                              all resolved)
@@ -211,9 +220,10 @@ class InProcTransport:
         self._lock = threading.Lock()
 
     def submit(self, datafiles, modelfile, tim_out=None, name=None,
-               options=None, tenant=None):
+               options=None, tenant=None, trace_id=None):
         req = self.server.submit(datafiles, modelfile, tim_out=tim_out,
                                  name=name, tenant=tenant,
+                                 trace_id=trace_id,
                                  **dict(options or {}))
         with self._lock:
             self._handles.append(req)
@@ -244,6 +254,9 @@ class InProcTransport:
 
     def stat(self):
         return self.server.stats()
+
+    def metrics(self):
+        return self.server.metrics()
 
     def drain(self, timeout=None):
         """Wait for the not-yet-collected requests submitted through
@@ -300,6 +313,10 @@ class KillableTransport:
         self._check()
         return self.inner.stat()
 
+    def metrics(self):
+        self._check()
+        return self.inner.metrics()
+
     def __getattr__(self, name):
         return getattr(self.inner, name)
 
@@ -355,7 +372,7 @@ class SocketTransport:
         return reply
 
     def submit(self, datafiles, modelfile, tim_out=None, name=None,
-               options=None, tenant=None):
+               options=None, tenant=None, trace_id=None):
         reply = self._call({"op": "submit",
                             "datafiles": list(datafiles)
                             if not isinstance(datafiles, str)
@@ -363,6 +380,7 @@ class SocketTransport:
                             "modelfile": str(modelfile),
                             "tim_out": tim_out, "name": name,
                             "tenant": tenant,
+                            "trace_id": trace_id,
                             "options": dict(options or {})})
         if reply.get("ok"):
             return reply["handle"]
@@ -409,6 +427,17 @@ class SocketTransport:
         for k in ("toas_per_s", "capability"):
             out[k] = reply.get(k)
         return out
+
+    def metrics(self):
+        """The live-metrics op (ISSUE 20).  A pre-obs host replies
+        unknown-op — surfaced as a TransportError naming the mismatch
+        so a fleet aggregator can degrade that host to ``stat``."""
+        reply = self._call({"op": "metrics"})
+        if not reply.get("ok"):
+            raise TransportError(
+                f"metrics on {self.label} failed (pre-obs host?): "
+                f"{reply.get('error')}")
+        return {k: v for k, v in reply.items() if k != "ok"}
 
     def drain(self, timeout=None):
         """Wait for this connection's outstanding requests.  The
@@ -504,6 +533,7 @@ class TransportServer:
                             tim_out=msg.get("tim_out"),
                             name=msg.get("name"),
                             tenant=msg.get("tenant"),
+                            trace_id=msg.get("trace_id"),
                             **dict(msg.get("options") or {}))
                     except ServeRejected as e:
                         _send_frame(conn, {
@@ -553,6 +583,15 @@ class TransportServer:
                 elif op == "stat":
                     st = self.server.stats()
                     _send_frame(conn, {"ok": True, **st})
+                elif op == "metrics":
+                    try:
+                        m = self.server.metrics()
+                    except Exception as e:
+                        _send_frame(conn, {
+                            "ok": False, "error": str(e),
+                            "etype": type(e).__name__})
+                    else:
+                        _send_frame(conn, {"ok": True, **m})
                 elif op == "drain":
                     # bounded: reply well under the client's socket
                     # timeout with the still-pending count; the
@@ -579,7 +618,7 @@ class TransportServer:
                         "ok": False,
                         "error": f"unknown op {op!r} (protocol "
                                  "mismatch? known ops: submit, "
-                                 "result, stat, drain)"})
+                                 "result, stat, metrics, drain)"})
         except OSError:
             pass  # peer reset mid-reply
         finally:

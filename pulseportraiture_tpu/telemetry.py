@@ -73,6 +73,7 @@ CONFIG_SNAPSHOT_KEYS = (
     "ingest_poll_ms", "ingest_stable_ms",
     "alert_cusum_k", "alert_cusum_h", "gls_resolve_every",
     "tune_db", "autotune", "tune_numerics", "lm_compact_every",
+    "slo_targets", "metrics", "mon_interval_ms",
 )
 
 # The event vocabulary: type -> fields REQUIRED beyond (type, t).
@@ -234,6 +235,14 @@ EVENT_FIELDS = {
                    "best_s"},
     "tune_apply": {"shape_class", "db_hit", "db_path", "knobs",
                    "default_s", "tuned_s"},
+    # the SLO engine (obs/slo.py, ISSUE 20): one slo_breach per EDGE
+    # into fast-burn — both the short and long burn-rate windows
+    # crossed the threshold for the tenant's latency objective.  The
+    # event also carries 'source' ('router' = end-to-end routed
+    # latency, 'server' = per-host serve latency) and 'window_s'
+    # extras; re-armed only after the short window recovers, so a
+    # sustained breach emits once, not once per request.
+    "slo_breach": {"tenant", "target_s", "burn_short", "burn_long"},
     "counters": {"counters", "gauges"},
 }
 
@@ -792,9 +801,15 @@ def report(path, file=None):
     warmups = by_type.get("warmup_compile", [])
     occupancy = None
     req_p50 = req_p99 = None
-    if req_done or coalesce or warmups:
-        p("")
-        p("-- serve (continuous batching) --")
+    # every section below prints its header unconditionally with an
+    # explicit "(no ... events)" line when the trace has none (ISSUE 20
+    # satellite): a vanished section reads as a broken report, and an
+    # operator diffing two traces needs the absence stated, not implied
+    p("")
+    p("-- serve (continuous batching) --")
+    if not (req_done or coalesce or warmups):
+        p("  (no serve events)")
+    else:
         n_sub = len(by_type.get("request_submit", []))
         if req_done:
             walls = np.asarray([ev["wall_s"] for ev in req_done], float)
@@ -836,9 +851,11 @@ def report(path, file=None):
     cache_bytes_served = None
     cache_bytes_stored = None
     cache_tenant_hits = {}
-    if c_hit or c_miss or c_store or c_evict:
-        p("")
-        p("-- result cache (content-addressed) --")
+    p("")
+    p("-- result cache (content-addressed) --")
+    if not (c_hit or c_miss or c_store or c_evict):
+        p("  (no cache events)")
+    else:
         n_lookup = len(c_hit) + len(c_miss)
         cache_hit_rate = len(c_hit) / max(n_lookup, 1)
         cache_bytes_served = sum(int(ev["bytes"]) for ev in c_hit)
@@ -882,9 +899,11 @@ def report(path, file=None):
     r_done = by_type.get("route_done", [])
     router_imbalance = None
     router_host_counts = {}
-    if r_starts or r_sub or r_retry or r_done:
-        p("")
-        p("-- router (cross-host request sharding) --")
+    p("")
+    p("-- router (cross-host request sharding) --")
+    if not (r_starts or r_sub or r_retry or r_done):
+        p("  (no router events)")
+    else:
         n_hosts = max((ev["n_hosts"] for ev in r_starts), default=0)
         per_host = {}
         for ev in r_sub:
@@ -958,9 +977,11 @@ def report(path, file=None):
     fleet_states = {}
     n_failover_collected = None
     tenant_latency = {}
-    if ftrans or fover or hedges or tenant_evs:
-        p("")
-        p("-- fleet (membership / failover / QoS) --")
+    p("")
+    p("-- fleet (membership / failover / QoS) --")
+    if not (ftrans or fover or hedges or tenant_evs):
+        p("  (no fleet events)")
+    else:
         if ftrans:
             per_host_edges = {}
             for ev in ftrans:
@@ -1022,9 +1043,11 @@ def report(path, file=None):
     tjobs = by_type.get("template_job", [])
     template_pad_frac = None
     template_wall_s = None
-    if tfit or tjobs:
-        p("")
-        p("-- template factory (batched LM buckets) --")
+    p("")
+    p("-- template factory (batched LM buckets) --")
+    if not (tfit or tjobs):
+        p("  (no template events)")
+    else:
         by_stage = {}
         for ev in tfit:
             s = by_stage.setdefault(ev["stage"],
@@ -1067,9 +1090,11 @@ def report(path, file=None):
     timing_wall_s = None
     n_timing_pulsars = None
     timing_dispatches = None
-    if tim_fit or fleet_ends:
-        p("")
-        p("-- timing (fleet-batched wideband GLS) --")
+    p("")
+    p("-- timing (fleet-batched wideband GLS) --")
+    if not (tim_fit or fleet_ends):
+        p("  (no timing events)")
+    else:
         if fleet_ends:
             n_timing_pulsars = sum(int(ev["n_pulsars"])
                                    for ev in fleet_ends)
@@ -1109,9 +1134,11 @@ def report(path, file=None):
     zap_wall_s = None
     refit_rate = None
     n_refit_improved = None
-    if zprop or zapp or refits:
-        p("")
-        p("-- data quality (zap + refit) --")
+    p("")
+    p("-- data quality (zap + refit) --")
+    if not (zprop or zapp or refits):
+        p("  (no quality events)")
+    else:
         if zprop:
             zap_wall_s = sum(float(ev["wall_s"]) for ev in zprop)
             n_dev = sum(1 for ev in zprop if ev.get("device"))
@@ -1178,9 +1205,11 @@ def report(path, file=None):
     if by_type.get("counters"):
         incremental_resolves = (by_type["counters"][-1]["counters"]
                                 .get("incremental_resolves"))
-    if admits or iskips or alerts:
-        p("")
-        p("-- online ingest + alerts --")
+    p("")
+    p("-- online ingest + alerts --")
+    if not (admits or iskips or alerts):
+        p("  (no ingest events)")
+    else:
         if admits:
             waits = [float(ev["wait_s"]) for ev in admits
                      if ev.get("wait_s") is not None]
@@ -1224,9 +1253,11 @@ def report(path, file=None):
     t_apply = by_type.get("tune_apply", [])
     tune_db_hits = sum(1 for ev in t_apply if ev.get("db_hit"))
     tune_db_misses = len(t_apply) - tune_db_hits
-    if t_probe or t_sweep or t_apply:
-        p("")
-        p("-- tuning --")
+    p("")
+    p("-- tuning --")
+    if not (t_probe or t_sweep or t_apply):
+        p("  (no tuning events)")
+    else:
         if t_probe:
             ev = t_probe[-1]
             gf = ev.get("matmul_gflops")
@@ -1258,10 +1289,35 @@ def report(path, file=None):
               f"{tune_db_misses} miss(es) "
               f"({'zero re-sweeps' if t_apply and not t_sweep else f'{len(t_sweep)} knob sweep(s) paid'})")
 
+    # ---- slo (latency objectives / burn-rate breaches) --------------
+    breaches = by_type.get("slo_breach", [])
+    slo_breach_tenants = {}
+    p("")
+    p("-- slo (latency objectives) --")
+    if not breaches:
+        p("  (no slo_breach events — objectives held, or no "
+          "slo_targets configured)")
+    else:
+        for ev in breaches:
+            slo_breach_tenants[ev["tenant"]] = \
+                slo_breach_tenants.get(ev["tenant"], 0) + 1
+        p(f"  {len(breaches)} fast-burn breach(es) across "
+          f"{len(slo_breach_tenants)} tenant(s); each is an EDGE — a "
+          "sustained breach emits once until the short window "
+          "recovers:")
+        for ev in breaches[:10]:
+            p(f"    t={ev['t']:.2f}s tenant {ev['tenant']!r} "
+              f"({ev.get('source', '?')}): target "
+              f"{ev['target_s']:.3f}s, burn short "
+              f"{ev['burn_short']:.1f}x / long {ev['burn_long']:.1f}x "
+              "of error budget")
+
     skips = by_type.get("archive_skip", [])
-    if skips:
-        p("")
-        p(f"-- skipped archives ({len(skips)}) --")
+    p("")
+    p(f"-- skipped archives ({len(skips)}) --")
+    if not skips:
+        p("  (no archive_skip events)")
+    else:
         for ev in skips[:10]:
             p(f"  {ev['datafile']}: {ev['reason']}")
 
@@ -1340,6 +1396,8 @@ def report(path, file=None):
         "n_alert": len(alerts),
         "alert_fp_rate": alert_fp_rate,
         "incremental_resolves": incremental_resolves,
+        "n_slo_breach": len(breaches),
+        "slo_breach_tenants": slo_breach_tenants,
         "n_tune_probe": len(t_probe),
         "n_tune_sweep": len(t_sweep),
         "n_tune_apply": len(t_apply),
@@ -1366,11 +1424,25 @@ def main(argv=None):
     vp = sub.add_parser("validate",
                         help="schema-check a trace and exit")
     vp.add_argument("trace", help="trace .jsonl path")
+    mp = sub.add_parser(
+        "merge",
+        help="stitch a router trace + N host traces into per-request "
+             "cross-host span timelines (joined on trace_id)")
+    mp.add_argument("traces", nargs="+",
+                    help="trace .jsonl paths (router + hosts, any "
+                         "order — roles are auto-detected)")
+    mp.add_argument("--json", action="store_true",
+                    help="emit the merged structure as JSON instead "
+                         "of the text timeline")
     args = p.parse_args(argv)
     if args.cmd == "validate":
         manifest, events = validate_trace(args.trace)
         print(f"{args.trace}: ok (schema {manifest['schema']}, "
               f"{len(events)} events)")
+        return 0
+    if args.cmd == "merge":
+        from .obs.merge import main_merge
+        main_merge(args.traces, as_json=args.json)
         return 0
     report(args.trace)
     return 0

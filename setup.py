@@ -23,6 +23,7 @@ setup(
             "ppspline=pulseportraiture_tpu.cli.ppspline:main",
             "ppzap=pulseportraiture_tpu.cli.ppzap:main",
             "ppwatch=pulseportraiture_tpu.cli.ppwatch:main",
+            "ppmon=pulseportraiture_tpu.cli.ppmon:main",
         ]
     },
 )

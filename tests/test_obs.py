@@ -1,0 +1,519 @@
+"""Fleet observability plane (ISSUE 20): log-bucket histogram
+quantiles vs exact, SLO burn-rate arithmetic under an injected clock,
+distributed trace-ids stitched end-to-end over the socket lane (router
++ 2 hosts, failover + hedge, every request covered exactly once), the
+fleet-wide ``metrics`` op, the ppmon --once --json schema, the
+torn-load-snapshot fix, and the PPT_METRICS / PPT_SLO_TARGETS /
+PPT_MON_INTERVAL_MS env hooks."""
+
+import io
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu import config, telemetry
+from pulseportraiture_tpu.io import write_gmodel
+from pulseportraiture_tpu.obs import (HIST_BOUNDS, MetricsRegistry,
+                                      SloTracker, merge_exports,
+                                      quantile_from_export)
+from pulseportraiture_tpu.obs.merge import merge_traces
+from pulseportraiture_tpu.pipeline import stream_wideband_TOAs
+from pulseportraiture_tpu.serve import (DEAD, AdmissionQueue,
+                                        ServeRequest, SocketTransport,
+                                        ToaRouter, ToaServer,
+                                        TransportServer)
+from pulseportraiture_tpu.serve.transport import KillableTransport
+from pulseportraiture_tpu.synth import default_test_model, make_fake_pulsar
+from pulseportraiture_tpu.utils.mjd import MJD
+
+PAR = {"PSR": "J1744-1134", "RAJ": "17:44:29.4", "DECJ": "-11:34:54.6",
+       "P0": 0.004074, "PEPOCH": 55000.0, "DM": 3.139}
+
+# worst-case quantile relative error of the 8-per-decade log buckets:
+# a reported quantile is the geometric midpoint of its bucket, so it
+# is off by at most a half-bucket factor of 10**(1/16)
+_HALF_BUCKET = 10.0 ** (1.0 / 16.0)
+
+
+@pytest.fixture(scope="module")
+def campaign(tmp_path_factory):
+    """3 tiny archives + the one-shot .tim reference bytes."""
+    root = tmp_path_factory.mktemp("obs")
+    model = default_test_model(1500.0)
+    gmodel = str(root / "model.gmodel")
+    write_gmodel(model, gmodel, quiet=True)
+    files = []
+    for i in range(3):
+        path = str(root / f"ep{i}.fits")
+        make_fake_pulsar(model, PAR, outfile=path, nsub=2, nchan=16,
+                         nbin=128, nu0=1500.0, bw=400.0, tsub=60.0,
+                         phase=0.01 * i, dDM=1e-4,
+                         start_MJD=MJD(55100 + i, 0.1), noise_stds=0.08,
+                         dedispersed=False, quiet=True, rng=300 + i)
+        files.append(path)
+    ref = str(root / "ref01.tim")
+    stream_wideband_TOAs(files[:2], gmodel, nsub_batch=8, tim_out=ref,
+                         quiet=True)
+    return files, gmodel, open(ref, "rb").read()
+
+
+# ---------------------------------------------------------------------------
+# histogram quantiles
+# ---------------------------------------------------------------------------
+
+def test_histogram_quantiles_within_bucket_error():
+    """Quantiles derived from bucket counts track the exact sample
+    quantiles within the documented half-bucket factor, with no sample
+    retention; bucket-wise merge of split registries is exact."""
+    rng = np.random.default_rng(0)
+    lat = np.exp(rng.normal(np.log(0.05), 1.0, size=5000))
+    reg = MetricsRegistry()
+    a, b = MetricsRegistry(), MetricsRegistry()
+    for i, v in enumerate(lat):
+        reg.observe("lat", float(v))
+        (a if i % 2 else b).observe("lat", float(v))
+    h = reg.export()["histograms"]["lat"]
+    assert h["count"] == lat.size
+    assert h["sum"] == pytest.approx(float(lat.sum()), rel=1e-6)
+    for q in (0.50, 0.90, 0.99):
+        est = quantile_from_export(h, q)
+        exact = float(np.quantile(lat, q))
+        assert exact / _HALF_BUCKET <= est <= exact * _HALF_BUCKET, \
+            (q, est, exact)
+        assert reg.quantile("lat", q) == est
+    # fleet merge: summing per-host buckets == one histogram over all
+    merged = merge_exports([a.export(), b.export()])
+    assert merged["histograms"]["lat"]["counts"] == h["counts"]
+    assert merge_exports([])["histograms"] == {}
+    # a peer on a different bound table is refused, not under-merged
+    bad = a.export()
+    bad["histograms"]["lat"]["counts"] = [0, 1]
+    with pytest.raises(ValueError, match="bucket-count mismatch"):
+        merge_exports([b.export(), bad])
+    # out-of-range samples land in the edge buckets, never lost
+    edge = MetricsRegistry()
+    edge.observe("lat", 1e-9)
+    edge.observe("lat", 1e9)
+    he = edge.export()["histograms"]["lat"]
+    assert he["count"] == 2
+    assert quantile_from_export(he, 0.25) == HIST_BOUNDS[0]
+    assert quantile_from_export(he, 1.0) == HIST_BOUNDS[-1]
+    assert quantile_from_export({"count": 0, "counts": []}, 0.5) is None
+
+
+def test_registry_counters_concurrent():
+    """Counter increments from many threads never lose updates (one
+    lock over the name tables)."""
+    reg = MetricsRegistry()
+
+    def work():
+        for _ in range(1000):
+            reg.inc("n")
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.counter("n") == 8000
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate engine
+# ---------------------------------------------------------------------------
+
+def test_slo_burn_rate_edges_and_rearm():
+    """Multi-window burn arithmetic under an injected clock: breach
+    only when BOTH windows burn >= threshold, edge-triggered once per
+    excursion, re-armed after the short window recovers; errors (inf
+    latency) burn budget; untargeted tenants never breach."""
+    clk = [0.0]
+    trk = SloTracker({"interactive": 0.1}, objective=0.99,
+                     windows=(10.0, 100.0), clock=lambda: clk[0])
+    assert trk.target_for("interactive") == 0.1
+    assert trk.target_for("bulk") is None  # no '*' default here
+    # budget = 0.01; one bad request -> bad fraction 1.0 in both
+    # windows -> burn 100x on each -> breach on the first observe
+    br = trk.observe("interactive", float("inf"))
+    assert br is not None
+    assert br["tenant"] == "interactive"
+    assert br["target_s"] == 0.1
+    assert br["burn_short"] == br["burn_long"] == pytest.approx(100.0)
+    # still hot: a second bad sample is NOT a second event
+    clk[0] = 1.0
+    assert trk.observe("interactive", 5.0) is None
+    # recovery: good traffic drops the short burn below threshold
+    clk[0] = 5.0
+    for _ in range(99):
+        assert trk.observe("interactive", 0.01) is None
+    snap = trk.snapshot()
+    assert snap["interactive"]["alerting"] is False
+    assert snap["interactive"]["total"] == 101
+    assert snap["interactive"]["good"] == 99
+    assert snap["interactive"]["attainment"] == pytest.approx(
+        99 / 101, abs=1e-3)
+    assert set(snap["interactive"]["burn"]) == {"10", "100"}
+    # past both windows the rings are empty again -> a fresh
+    # excursion fires a SECOND edge
+    clk[0] = 500.0
+    br2 = trk.observe("interactive", 5.0)
+    assert br2 is not None and br2["burn_short"] >= 10.0
+    assert trk.burn_rate("interactive", 10.0) == pytest.approx(100.0)
+    # untargeted tenant: attainment bookkeeping only, never a breach
+    assert trk.observe("bulk", 1e9) is None
+    assert trk.snapshot()["bulk"]["attainment"] is None
+    # bare-number targets apply to every tenant via '*'
+    assert SloTracker({"*": 2.0}).target_for("anyone") == 2.0
+
+
+def test_slo_short_window_alone_does_not_page():
+    """A transient blip hot in the short window but cold in the long
+    one must NOT breach (the reason for multi-window alerting)."""
+    clk = [0.0]
+    trk = SloTracker({"*": 0.1}, objective=0.99,
+                     windows=(10.0, 100.0), clock=lambda: clk[0])
+    # long window full of good traffic first
+    for i in range(200):
+        clk[0] = 0.4 * i  # spread over 80 s
+        assert trk.observe("t", 0.01) is None
+    # now a burst of bads inside the short window only: short burn
+    # goes hot, long burn stays ~2.4x < 10 -> no breach
+    clk[0] = 81.0
+    for _ in range(5):
+        assert trk.observe("t", 9.9) is None
+    assert trk.burn_rate("t", 10.0, now=81.0) >= 10.0
+    assert trk.burn_rate("t", 100.0, now=81.0) < 10.0
+
+
+# ---------------------------------------------------------------------------
+# torn-load-snapshot fix (satellite)
+# ---------------------------------------------------------------------------
+
+def test_admission_queue_load_snapshot_is_atomic():
+    """load_snapshot() returns (queue_len, pending_archives) from ONE
+    lock acquisition: with every queued request holding exactly 7
+    archives and a writer thread hammering submit/get/release, a
+    snapshot can never observe pending outside [7*len, 7*len + 7]
+    (the in-service request) — the torn two-lock read could."""
+    q = AdmissionQueue(max_pending=10_000)
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        try:
+            while not stop.is_set():
+                q.submit(ServeRequest(["a"] * 7, "m"))
+                got = q.get(timeout=0.5)
+                q.release(7, tenant=got.tenant)
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        deadline = time.monotonic() + 1.0
+        n = 0
+        while time.monotonic() < deadline:
+            qlen, pending = q.load_snapshot()
+            assert 7 * qlen <= pending <= 7 * qlen + 7, (qlen, pending)
+            n += 1
+        assert n > 100  # the sampler actually raced the writer
+    finally:
+        stop.set()
+        t.join()
+        q.close()
+    assert not errors
+
+
+# ---------------------------------------------------------------------------
+# env hooks + manifest snapshot (satellite)
+# ---------------------------------------------------------------------------
+
+def test_obs_env_hooks_and_manifest_snapshot(tmp_path, monkeypatch):
+    saved = (config.metrics, config.slo_targets, config.mon_interval_ms)
+    try:
+        for name in ("PPT_METRICS", "PPT_SLO_TARGETS",
+                     "PPT_MON_INTERVAL_MS"):
+            assert name in config.KNOWN_PPT_ENV
+        monkeypatch.setenv("PPT_METRICS", "off")
+        monkeypatch.setenv("PPT_SLO_TARGETS", "interactive:0.5,*:5")
+        monkeypatch.setenv("PPT_MON_INTERVAL_MS", "250")
+        changed = config.env_overrides()
+        assert {"metrics", "slo_targets", "mon_interval_ms"} <= \
+            set(changed)
+        assert config.metrics is False
+        assert config.slo_targets == {"interactive": 0.5, "*": 5.0}
+        assert config.mon_interval_ms == 250.0
+        monkeypatch.setenv("PPT_SLO_TARGETS", "off")
+        config.env_overrides()
+        assert config.slo_targets is None
+        # strict parses: a typo'd VALUE raises naming the knob
+        for name, bad in (("PPT_METRICS", "maybe"),
+                          ("PPT_SLO_TARGETS", "t:fast"),
+                          ("PPT_MON_INTERVAL_MS", "0"),
+                          ("PPT_MON_INTERVAL_MS", "soon")):
+            monkeypatch.setenv(name, bad)
+            with pytest.raises(ValueError, match=name):
+                config.env_overrides()
+            monkeypatch.delenv(name)
+        # the knobs ride every trace manifest's config snapshot
+        for key in ("metrics", "slo_targets", "mon_interval_ms"):
+            assert key in telemetry.CONFIG_SNAPSHOT_KEYS
+        trace = str(tmp_path / "t.jsonl")
+        telemetry.Tracer(trace, run="snap").close()
+        manifest, _ = telemetry.load_trace(trace)
+        assert "slo_targets" in manifest["config"]
+        assert "metrics" in manifest["config"]
+    finally:
+        (config.metrics, config.slo_targets,
+         config.mon_interval_ms) = saved
+
+
+# ---------------------------------------------------------------------------
+# pptrace: no section vanishes on zero events (satellite)
+# ---------------------------------------------------------------------------
+
+def test_pptrace_sections_survive_empty_trace(tmp_path):
+    """A manifest-only trace renders EVERY section with an explicit
+    '(no ... events)' line — nothing crashes, nothing vanishes."""
+    trace = str(tmp_path / "empty.jsonl")
+    telemetry.Tracer(trace, run="empty").close()
+    buf = io.StringIO()
+    summary = telemetry.report(trace, file=buf)
+    text = buf.getvalue()
+    for header in ("-- serve (continuous batching) --",
+                   "-- result cache (content-addressed) --",
+                   "-- router (cross-host request sharding) --",
+                   "-- fleet (membership / failover / QoS) --",
+                   "-- template factory (batched LM buckets) --",
+                   "-- timing (fleet-batched wideband GLS) --",
+                   "-- data quality (zap + refit) --",
+                   "-- online ingest + alerts --",
+                   "-- tuning --",
+                   "-- slo (latency objectives) --",
+                   "-- skipped archives (0) --"):
+        assert header in text, header
+    assert text.count("(no ") >= 11
+    assert summary["n_requests"] == 0
+    assert summary["n_slo_breach"] == 0
+
+
+def test_merge_refuses_pre_tracing_traces(tmp_path):
+    trace = str(tmp_path / "old.jsonl")
+    tr = telemetry.Tracer(trace, run="old")
+    tr.emit("log", level="info", msg="no ids here")
+    tr.close()
+    with pytest.raises(ValueError, match="no trace_id"):
+        merge_traces([trace])
+
+
+# ---------------------------------------------------------------------------
+# the e2e: router + 2 socket hosts, failover + hedge, merged
+# timelines, fleet metrics op, ppmon --once --json
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fleet_run(campaign, tmp_path_factory):
+    """ONE routed fleet run shared by the merge/metrics/ppmon tests:
+    2 socket hosts with per-host traces, hedging forced on every
+    request, one mid-flight host kill, SLO targets set impossibly
+    tight so breaches fire."""
+    files, gmodel, refb = campaign
+    root = tmp_path_factory.mktemp("fleetrun")
+    rtrace = str(root / "router.jsonl")
+    straces = [str(root / "hostA.jsonl"), str(root / "hostB.jsonl")]
+    out = {"tims": {}, "refb": refb}
+    with ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True,
+                   telemetry=straces[0]) as h0, \
+            ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True,
+                      telemetry=straces[1]) as h1:
+        with TransportServer(h0, port=0) as lis_a, \
+                TransportServer(h1, port=0) as lis_b:
+            k0 = KillableTransport(
+                SocketTransport(f"127.0.0.1:{lis_a.port}"))
+            t1 = SocketTransport(f"127.0.0.1:{lis_b.port}")
+            router = ToaRouter([k0, t1], telemetry=rtrace,
+                               hedge_ms=0.0,
+                               slo_targets={"*": 1e-6})
+            names = {}
+            tim0 = str(root / "A.tim")
+            rh = router.submit(files[:2], gmodel, tim_out=tim0,
+                               name="A", tenant="interactive")
+            names["A"] = rh
+            assert rh.host.label == k0.label
+            k0.kill()  # dies with A in flight -> failover path
+            names["B"] = router.submit(
+                files[2:3], gmodel, tim_out=str(root / "B.tim"),
+                name="B", tenant="bulk")
+            for name, h in names.items():
+                out["tims"][name] = h.result(300)
+            # fleet-wide metrics + the ppmon surface, polled while
+            # the router is live (a monitor endpoint like
+            # `pproute --monitor`)
+            out["fleet_metrics"] = router.metrics()
+            with TransportServer(router, port=0) as mon:
+                from pulseportraiture_tpu.cli import ppmon
+
+                mt = SocketTransport(f"127.0.0.1:{mon.port}")
+                out["mon_reply"] = mt.metrics()
+                mt.close()
+                buf = io.StringIO()
+                ppmon.render(out["mon_reply"], file=buf)
+                out["mon_text"] = buf.getvalue()
+            out["host_metrics"] = SocketTransport(
+                f"127.0.0.1:{lis_b.port}").metrics()
+            out["stats"] = router.stats()
+            router.close()
+    out["traces"] = [rtrace] + straces
+    out["bytes"] = {n: open(str(root / f"{n}.tim"), "rb").read()
+                    for n in names}
+    return out
+
+
+def test_trace_ids_stitch_across_hosts(fleet_run):
+    """Every request appears EXACTLY once in the merged cross-host
+    timeline, the failover and the hedge ride their requests, and the
+    per-request segments name a critical-path stage."""
+    merged = merge_traces(fleet_run["traces"])
+    assert merged["n_traces"] == 3
+    roles = {t["role"] for t in merged["traces"]}
+    assert roles == {"router", "host"}
+    reqs = merged["requests"].values()
+    by_name = {}
+    for r in reqs:
+        by_name.setdefault(r["req"], []).append(r)
+    # exactly-once coverage: one trace_id per submitted request
+    assert set(by_name) == {"A", "B"}
+    assert all(len(v) == 1 for v in by_name.values()), by_name
+    assert merged["n_requests"] == 2
+    for r in reqs:
+        assert r["router_wall_s"] is not None
+        assert r["error"] is None
+        assert r["n_host_spans"] >= 1  # host-side spans joined in
+        assert r["critical"] in ("queue", "serve", "wire+collect")
+        assert r["segments"]
+    assert by_name["A"][0]["tenant"] == "interactive"
+    # the kill produced a failover on A; hedge_ms=0 hedged >= 1 req
+    assert by_name["A"][0]["failovers"], by_name["A"][0]
+    assert any(r["hedged"] for r in reqs)
+    # the merged text renderer names spans and flags
+    buf = io.StringIO()
+    from pulseportraiture_tpu.obs.merge import format_merge
+
+    format_merge(merged, file=buf)
+    text = buf.getvalue()
+    assert "req A" in text and "req B" in text
+    assert "failover" in text and "serve" in text
+
+
+def test_fleet_run_tim_bytes_identical_and_slo_breaches(fleet_run):
+    """Metrics + SLO tracking on changes NOTHING about the output:
+    request A's .tim is byte-identical to the one-shot reference; the
+    impossible SLO targets produced slo_breach telemetry the report
+    surfaces."""
+    assert fleet_run["bytes"]["A"] == fleet_run["refb"]
+    assert all(st["outstanding"] == 0
+               for st in fleet_run["stats"].values())
+    rtrace = fleet_run["traces"][0]
+    _, events = telemetry.validate_trace(rtrace)
+    breaches = [e for e in events if e["type"] == "slo_breach"]
+    assert breaches and breaches[0]["burn_short"] >= 10.0
+    buf = io.StringIO()
+    summary = telemetry.report(rtrace, file=buf)
+    assert summary["n_slo_breach"] >= 1
+    assert "interactive" in summary["slo_breach_tenants"] or \
+        "bulk" in summary["slo_breach_tenants"]
+    assert "-- slo (latency objectives) --" in buf.getvalue()
+    assert "fast-burn breach" in buf.getvalue()
+
+
+def test_router_metrics_aggregates_fleet(fleet_run):
+    """ToaRouter.metrics(): per-host replies + the merged fleet view
+    (queue depth, in-flight, latency quantiles from bucket-merged
+    histograms, health states) + the router's own latency and SLO
+    snapshot; a DEAD host degrades to an error entry instead of
+    poisoning the reply."""
+    m = fleet_run["fleet_metrics"]
+    assert m["metrics_enabled"] is True
+    assert m["fleet"]["n_hosts"] == 2
+    states = set(m["fleet"]["states"].values())
+    assert DEAD in states  # the killed host is reported, not hidden
+    dead_lb = [lb for lb, st in m["fleet"]["states"].items()
+               if st == DEAD][0]
+    assert m["hosts"][dead_lb]["error"]
+    live_lb = [lb for lb in m["hosts"] if lb != dead_lb][0]
+    live = m["hosts"][live_lb]
+    # n_live may be nonzero: a hedge/failover loser's handle is never
+    # collected (its .tim is the durable artifact), so only assert the
+    # field came through the wire
+    assert live["queue_len"] == 0 and live["n_live"] is not None
+    assert live["metrics"]["counters"]["requests_total"] >= 2
+    assert live["p99_s"] is not None
+    assert m["fleet"]["in_flight"] == 0
+    assert m["fleet"]["queue_depth"] == 0
+    assert m["fleet"]["p99_s"] is not None
+    assert m["fleet"]["p50_s"] <= m["fleet"]["p99_s"]
+    r = m["router"]
+    assert r["p99_s"] is not None
+    assert r["metrics"]["counters"]["route_submits"] == 2
+    assert r["metrics"]["counters"]["route_done"] == 2
+    # the impossible targets: every routed request burned budget
+    assert r["slo"]["interactive"]["alerting"] is True
+    assert r["slo"]["interactive"]["attainment"] == 0.0
+    # single-host reply shape (the direct ppserve --listen view)
+    hm = fleet_run["host_metrics"]
+    assert hm["metrics_enabled"] is True
+    assert "request_latency_s" in hm["metrics"]["histograms"]
+    assert hm["slo"] is None  # no targets configured host-side
+
+
+def test_ppmon_once_json_schema(fleet_run):
+    """The monitor endpoint serves the fleet-shaped metrics reply over
+    the wire, and ppmon's renderer + --once --json contract hold."""
+    reply = fleet_run["mon_reply"]
+    # the --once --json output IS this reply: it must be pure JSON
+    flat = json.loads(json.dumps(reply))
+    assert set(flat) == {"metrics_enabled", "hosts", "fleet", "router"}
+    for key in ("n_hosts", "states", "queue_depth", "in_flight",
+                "toas_per_s", "link_stall_frac", "p50_s", "p90_s",
+                "p99_s", "metrics"):
+        assert key in flat["fleet"], key
+    for ent in flat["hosts"].values():
+        for key in ("state", "outstanding", "queue_len", "p50_s",
+                    "p99_s", "toas_per_s", "error"):
+            assert key in ent, key
+    assert flat["router"]["slo"], "per-tenant SLO attainment missing"
+    for tenant, s in flat["router"]["slo"].items():
+        assert {"target_s", "attainment", "alerting",
+                "burn"} <= set(s)
+    text = fleet_run["mon_text"]
+    assert "ppmon: fleet (2 host(s))" in text
+    assert "routed latency" in text and "-- slo --" in text
+
+
+def test_ppmon_cli_once_json(fleet_run, capsys, tmp_path):
+    """ppmon --once --json end-to-end against a live host endpoint
+    (single-host shape), plus the unreachable-endpoint exit code."""
+    with ToaServer(nsub_batch=8, max_wait_ms=30, quiet=True) as srv:
+        with TransportServer(srv, port=0) as lis:
+            from pulseportraiture_tpu.cli import ppmon
+
+            rc = ppmon.main([f"127.0.0.1:{lis.port}", "--once",
+                             "--json"])
+            assert rc == 0
+            reply = json.loads(capsys.readouterr().out)
+            assert reply["metrics_enabled"] is True
+            assert reply["queue_len"] == 0
+            buf = io.StringIO()
+            ppmon.render(reply, file=buf)
+            assert "ppmon: host" in buf.getvalue()
+            port = lis.port
+    from pulseportraiture_tpu.cli import ppmon
+
+    with pytest.raises(SystemExit, match="cannot reach"):
+        ppmon.main([f"127.0.0.1:{port}", "--once", "--json"])
+    with pytest.raises(SystemExit, match="endpoint"):
+        ppmon.main(["not-an-endpoint", "--once"])

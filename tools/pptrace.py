@@ -5,6 +5,7 @@ Thin wrapper over ``python -m pulseportraiture_tpu.telemetry``:
 
     python tools/pptrace.py report  /path/to/trace.jsonl
     python tools/pptrace.py validate /path/to/trace.jsonl
+    python tools/pptrace.py merge router.jsonl hostA.jsonl hostB.jsonl
 
 Traces are written by the campaign drivers when telemetry is enabled
 (``config.telemetry_path``, ``PPT_TELEMETRY=...``, ``pptoas
@@ -21,7 +22,15 @@ docs/GUIDE.md "Operating an elastic fleet".  Cache-enabled runs add
 the "cache" section: hit rate over lookups, bytes served-from-cache
 vs fitted-and-stored, the router/server hit split, per-tenant
 hits-vs-fits, and eviction pressure; see docs/GUIDE.md "The result
-cache".
+cache".  SLO-tracked runs add the "slo" section (fast-burn breach
+ledger).
+
+``merge`` (ISSUE 20) stitches a router trace plus N host traces into
+per-request CROSS-HOST span timelines joined on ``trace_id``: router
+placement -> host queue wait -> serve -> wire+collect, with hedges,
+failovers, and coalesced-batch membership called out and the
+critical-path stage named per request (``--json`` for the raw merged
+structure); see docs/GUIDE.md "Watching the fleet live".
 """
 
 import os
